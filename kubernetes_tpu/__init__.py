@@ -1,0 +1,17 @@
+"""kubernetes_tpu — a TPU-native cluster orchestration framework.
+
+A from-scratch re-design of the reference container-cluster manager
+(Kubernetes pre-1.0, see /root/reference) built TPU-first:
+
+- Declarative REST API over a CAS-versioned store with watch streams
+  (reference: pkg/apiserver, pkg/tools/etcd_helper.go).
+- Reconciliation controllers (reference: pkg/controller, pkg/service,
+  pkg/cloudprovider/nodecontroller).
+- A node agent with pluggable runtime (reference: pkg/kubelet).
+- The differentiator: a batched scheduler whose predicate/priority
+  pipeline emits dense pod x node feasibility and score matrices solved
+  as an assignment problem on TPU via JAX/XLA/pjit (reference scalar
+  loop: plugin/pkg/scheduler/generic_scheduler.go:60-171).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,66 @@
+"""Object model: typed API objects, resource quantities, label/field
+selectors, validation, and columnar (struct-of-arrays) encodings for the
+TPU scheduler path.
+
+Reference parity: pkg/api/types.go, pkg/api/resource/, pkg/labels/,
+pkg/fields/, pkg/api/validation/validation.go.
+"""
+
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+from kubernetes_tpu.models.objects import (
+    ObjectMeta,
+    Container,
+    ContainerPort,
+    ResourceRequirements,
+    PodSpec,
+    PodStatus,
+    Pod,
+    NodeStatus,
+    NodeSpec,
+    Node,
+    ServiceSpec,
+    ServicePort,
+    Service,
+    Endpoints,
+    EndpointAddress,
+    ReplicationControllerSpec,
+    ReplicationController,
+    Binding,
+    Event,
+    Namespace,
+    Volume,
+    Probe,
+    DeleteOptions,
+    ListMeta,
+    Status,
+)
+
+__all__ = [
+    "Quantity",
+    "parse_quantity",
+    "ObjectMeta",
+    "Container",
+    "ContainerPort",
+    "ResourceRequirements",
+    "PodSpec",
+    "PodStatus",
+    "Pod",
+    "NodeStatus",
+    "NodeSpec",
+    "Node",
+    "ServiceSpec",
+    "ServicePort",
+    "Service",
+    "Endpoints",
+    "EndpointAddress",
+    "ReplicationControllerSpec",
+    "ReplicationController",
+    "Binding",
+    "Event",
+    "Namespace",
+    "Volume",
+    "Probe",
+    "DeleteOptions",
+    "ListMeta",
+    "Status",
+]

@@ -1,0 +1,365 @@
+"""Columnar (struct-of-arrays) encodings of pods and nodes.
+
+This is the matrix schema consumed by the TPU scheduler path: the
+reference's per-pod Go loops over object graphs
+(plugin/pkg/scheduler/generic_scheduler.go:106-171,
+plugin/pkg/scheduler/algorithm/predicates/predicates.go) become dense
+ops over these arrays.
+
+Design notes (TPU-first):
+- Resources are lowered once, host-side, to integer-valued float32
+  columns: CPU in millicores, memory in MiB (ceil). float32 holds
+  integers exactly up to 2^24, i.e. 16 TiB of MiB-granular memory and
+  16M millicores — beyond any single node. Integer score truncation
+  (priorities.go:39) is then exact on device for Mi-granular quantities.
+- Set-valued predicates (nodeSelector subset-match, hostPort conflicts,
+  exclusive-disk conflicts) use snapshot-scoped vocabularies: every
+  distinct key=value / port / volume-id observed is assigned an id, and
+  membership becomes uint32 bitsets. Subset/intersection tests are then
+  bitwise AND + reductions — MXU/VPU friendly, no string work on device.
+- Pods with identical selector sets share a row in a deduped selector
+  table (usually tiny), so the expensive [S, N] match matrix is computed
+  once per distinct selector, then gathered per pod.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.models.objects import (
+    Node,
+    Pod,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    Service,
+)
+
+MIB = 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Vocabularies
+# ---------------------------------------------------------------------------
+
+
+class Vocab:
+    """Snapshot-scoped string->id mapping used for bitset encodings."""
+
+    def __init__(self):
+        self.index: Dict[str, int] = {}
+
+    def id(self, token: str) -> int:
+        i = self.index.get(token)
+        if i is None:
+            i = len(self.index)
+            self.index[token] = i
+        return i
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    @property
+    def words(self) -> int:
+        """Number of uint32 words needed for a bitset (at least 1)."""
+        return max(1, (len(self.index) + 31) // 32)
+
+
+def bitset(ids: Sequence[int], words: int) -> np.ndarray:
+    out = np.zeros(words, dtype=np.uint32)
+    for i in ids:
+        out[i >> 5] |= np.uint32(1 << (i & 31))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Resource lowering
+# ---------------------------------------------------------------------------
+
+
+def pod_resource_request(pod: Pod) -> Tuple[int, int]:
+    """Sum of container requests: (milli-CPU, memory bytes).
+
+    Reference: predicates.go:106-114 getResourceRequest — sums
+    requests.cpu.MilliValue() and requests.memory.Value() per container.
+    """
+    cpu = 0
+    mem = 0
+    for c in pod.spec.containers:
+        req = c.resources.requests
+        if RESOURCE_CPU in req:
+            cpu += req[RESOURCE_CPU].milli_value()
+        if RESOURCE_MEMORY in req:
+            mem += req[RESOURCE_MEMORY].value()
+    return cpu, mem
+
+
+def mem_to_mib(mem_bytes: int) -> int:
+    """Lower bytes to MiB, rounding up so requests never under-count."""
+    return -((-mem_bytes) // MIB)
+
+
+def pod_host_ports(pod: Pod) -> List[int]:
+    """All nonzero hostPorts of a pod (reference: predicates.go:351-360)."""
+    ports = []
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port > 0:
+                ports.append(p.host_port)
+    return ports
+
+
+def pod_exclusive_volumes(pod: Pod) -> List[str]:
+    """Volume ids subject to single-attach exclusivity.
+
+    Reference: predicates.go:59-95 NoDiskConflict — GCE PD and AWS EBS
+    volumes may not be attached read-write by two pods on one node (the
+    v0.19 check ignores read-only flags and simply forbids same-id
+    co-location).
+    """
+    vols = []
+    for v in pod.spec.volumes:
+        if v.gce_persistent_disk is not None and v.gce_persistent_disk.pd_name:
+            vols.append("gce-pd:" + v.gce_persistent_disk.pd_name)
+        if (
+            v.aws_elastic_block_store is not None
+            and v.aws_elastic_block_store.volume_id
+        ):
+            vols.append("aws-ebs:" + v.aws_elastic_block_store.volume_id)
+    return vols
+
+
+# ---------------------------------------------------------------------------
+# Columnar batches
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodColumns:
+    """Struct-of-arrays for P pending pods."""
+
+    names: List[str]  # namespace/name keys, host-side only
+    cpu_milli: np.ndarray  # f32[P]
+    mem_mib: np.ndarray  # f32[P]
+    selector_id: np.ndarray  # i32[P] — row into sel_table (-0 == no selector row 0)
+    port_bits: np.ndarray  # u32[P, PW]
+    vol_bits: np.ndarray  # u32[P, VW]
+    pinned_node: np.ndarray  # i32[P] — node index or -1
+    service_id: np.ndarray  # i32[P] — first matching service, -1 if none
+    # Deduped selector table: row u of sel_bits is a bitset of required
+    # key=value ids; row 0 is always the empty selector.
+    sel_bits: np.ndarray  # u32[U, LW]
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+
+@dataclass
+class NodeColumns:
+    """Struct-of-arrays for N nodes (capacity + current occupancy)."""
+
+    names: List[str]
+    cpu_cap: np.ndarray  # f32[N] millicores
+    mem_cap: np.ndarray  # f32[N] MiB
+    cpu_used: np.ndarray  # f32[N] millicores, from already-assigned pods
+    mem_used: np.ndarray  # f32[N] MiB
+    label_bits: np.ndarray  # u32[N, LW] — key=value ids present on node
+    used_port_bits: np.ndarray  # u32[N, PW] — hostPorts taken by existing pods
+    used_vol_bits: np.ndarray  # u32[N, VW] — exclusive volumes attached
+    service_counts: np.ndarray  # f32[N, S] — matching-pod count per service
+    schedulable: np.ndarray  # bool[N] — Ready and not unschedulable
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+
+@dataclass
+class Snapshot:
+    """One scheduling problem: P pending pods x N nodes.
+
+    Produced host-side from API objects; everything the device solver
+    needs and nothing it does not (names stay on host).
+    """
+
+    pods: PodColumns
+    nodes: NodeColumns
+    label_vocab: Vocab
+    port_vocab: Vocab
+    vol_vocab: Vocab
+    service_names: List[str]
+
+
+def pod_key(pod: Pod) -> str:
+    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+
+def node_is_ready(node: Node) -> bool:
+    """Reference: StoreToNodeLister filters to Ready nodes
+    (pkg/client/cache/listers.go) and spec.unschedulable gates fit."""
+    if node.spec.unschedulable:
+        return False
+    for c in node.status.conditions:
+        if c.type == "Ready":
+            return c.status == "True"
+    # Nodes with no conditions reported are treated as ready (matches the
+    # reference's permissive default for freshly registered nodes).
+    return True
+
+
+def _first_matching_service(pod: Pod, services: List[Service]) -> int:
+    """Index of the first service whose selector matches the pod.
+
+    Reference: pkg/registry/service/registry GetPodServices as used by
+    CalculateSpreadPriority (spreading.go:44-56); v0.19 uses the first
+    matching service's selector.
+    """
+    labels = pod.metadata.labels or {}
+    for i, svc in enumerate(services):
+        sel = svc.spec.selector
+        if not sel:
+            continue
+        if svc.metadata.namespace != pod.metadata.namespace:
+            continue
+        if all(labels.get(k) == v for k, v in sel.items()):
+            return i
+    return -1
+
+
+def build_snapshot(
+    pending_pods: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned_pods: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+) -> Snapshot:
+    """Lower API objects into a dense scheduling snapshot.
+
+    `assigned_pods` are pods already bound to nodes (they contribute to
+    occupancy the way MapPodsToMachines does, predicates.go:379-392).
+    """
+    nodes = list(nodes)
+    pending_pods = list(pending_pods)
+    services = list(services)
+    node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
+    N, P, S = len(nodes), len(pending_pods), len(services)
+
+    label_vocab, port_vocab, vol_vocab = Vocab(), Vocab(), Vocab()
+
+    # -- vocabulary passes (host-side, one sweep each) --
+    for n in nodes:
+        for k, v in (n.metadata.labels or {}).items():
+            label_vocab.id(f"{k}={v}")
+    sel_keys: Dict[Tuple[Tuple[str, str], ...], int] = {(): 0}
+    pod_sel_rows = np.zeros(P, dtype=np.int32)
+    for i, p in enumerate(pending_pods):
+        sel = tuple(sorted((p.spec.node_selector or {}).items()))
+        for k, v in sel:
+            label_vocab.id(f"{k}={v}")
+        row = sel_keys.setdefault(sel, len(sel_keys))
+        pod_sel_rows[i] = row
+        for port in pod_host_ports(p):
+            port_vocab.id(str(port))
+        for vol in pod_exclusive_volumes(p):
+            vol_vocab.id(vol)
+    for p in assigned_pods:
+        for port in pod_host_ports(p):
+            port_vocab.id(str(port))
+        for vol in pod_exclusive_volumes(p):
+            vol_vocab.id(vol)
+
+    LW, PW, VW = label_vocab.words, port_vocab.words, vol_vocab.words
+
+    # -- pod columns --
+    cpu_req = np.zeros(P, dtype=np.float32)
+    mem_req = np.zeros(P, dtype=np.float32)
+    port_bits = np.zeros((P, PW), dtype=np.uint32)
+    vol_bits = np.zeros((P, VW), dtype=np.uint32)
+    pinned = np.full(P, -1, dtype=np.int32)
+    service_id = np.full(P, -1, dtype=np.int32)
+    for i, p in enumerate(pending_pods):
+        cpu, mem = pod_resource_request(p)
+        cpu_req[i] = cpu
+        mem_req[i] = mem_to_mib(mem)
+        port_bits[i] = bitset([port_vocab.id(str(x)) for x in pod_host_ports(p)], PW)
+        vol_bits[i] = bitset(
+            [vol_vocab.id(v) for v in pod_exclusive_volumes(p)], VW
+        )
+        if p.spec.node_name:
+            pinned[i] = node_index.get(p.spec.node_name, -2)  # -2: unknown node
+        service_id[i] = _first_matching_service(p, services)
+
+    sel_bits = np.zeros((len(sel_keys), LW), dtype=np.uint32)
+    for sel, row in sel_keys.items():
+        sel_bits[row] = bitset([label_vocab.id(f"{k}={v}") for k, v in sel], LW)
+
+    # -- node columns --
+    cpu_cap = np.zeros(N, dtype=np.float32)
+    mem_cap = np.zeros(N, dtype=np.float32)
+    cpu_used = np.zeros(N, dtype=np.float32)
+    mem_used = np.zeros(N, dtype=np.float32)
+    label_bits = np.zeros((N, LW), dtype=np.uint32)
+    used_port_bits = np.zeros((N, PW), dtype=np.uint32)
+    used_vol_bits = np.zeros((N, VW), dtype=np.uint32)
+    service_counts = np.zeros((N, max(S, 1)), dtype=np.float32)
+    schedulable = np.zeros(N, dtype=bool)
+    for j, n in enumerate(nodes):
+        cap = n.status.capacity or {}
+        if RESOURCE_CPU in cap:
+            cpu_cap[j] = cap[RESOURCE_CPU].milli_value()
+        if RESOURCE_MEMORY in cap:
+            mem_cap[j] = mem_to_mib(cap[RESOURCE_MEMORY].value())
+        label_bits[j] = bitset(
+            [label_vocab.id(f"{k}={v}") for k, v in (n.metadata.labels or {}).items()],
+            LW,
+        )
+        schedulable[j] = node_is_ready(n)
+
+    for p in assigned_pods:
+        j = node_index.get(p.spec.node_name)
+        if j is None:
+            continue
+        cpu, mem = pod_resource_request(p)
+        cpu_used[j] += cpu
+        mem_used[j] += mem_to_mib(mem)
+        used_port_bits[j] |= bitset(
+            [port_vocab.id(str(x)) for x in pod_host_ports(p)], PW
+        )
+        used_vol_bits[j] |= bitset(
+            [vol_vocab.id(v) for v in pod_exclusive_volumes(p)], VW
+        )
+        svc = _first_matching_service(p, services)
+        if svc >= 0:
+            service_counts[j, svc] += 1
+
+    return Snapshot(
+        pods=PodColumns(
+            names=[pod_key(p) for p in pending_pods],
+            cpu_milli=cpu_req,
+            mem_mib=mem_req,
+            selector_id=pod_sel_rows,
+            port_bits=port_bits,
+            vol_bits=vol_bits,
+            pinned_node=pinned,
+            service_id=service_id,
+            sel_bits=sel_bits,
+        ),
+        nodes=NodeColumns(
+            names=[n.metadata.name for n in nodes],
+            cpu_cap=cpu_cap,
+            mem_cap=mem_cap,
+            cpu_used=cpu_used,
+            mem_used=mem_used,
+            label_bits=label_bits,
+            used_port_bits=used_port_bits,
+            used_vol_bits=used_vol_bits,
+            service_counts=service_counts,
+            schedulable=schedulable,
+        ),
+        label_vocab=label_vocab,
+        port_vocab=port_vocab,
+        vol_vocab=vol_vocab,
+        service_names=[f"{s.metadata.namespace}/{s.metadata.name}" for s in services],
+    )

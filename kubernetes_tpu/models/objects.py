@@ -1,0 +1,490 @@
+"""Typed API objects.
+
+Behavioral parity with the reference's internal object model
+(pkg/api/types.go): Pod, Node, Service, Endpoints, ReplicationController,
+Binding, Event, Namespace, Secret, plus list/status envelope types.
+Wire form is camelCase JSON via kubernetes_tpu.models.serde.
+
+Only fields the framework actually consumes are modeled; the codec
+ignores unknown wire fields so richer manifests still load.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubernetes_tpu.models.quantity import Quantity
+
+# Resource names (reference: pkg/api/types.go ResourceName consts).
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+
+ResourceList = Dict[str, Quantity]
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class ObjectMeta:
+    """Reference: pkg/api/types.go ObjectMeta."""
+
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    creation_timestamp: str = ""
+    deletion_timestamp: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    generate_name: str = ""
+
+
+@dataclass
+class ListMeta:
+    resource_version: str = ""
+
+
+@dataclass
+class TypeMeta:
+    kind: str = ""
+    api_version: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    limits: Dict[str, Quantity] = field(default_factory=dict)
+    requests: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class ExecAction:
+    command: List[str] = field(default_factory=list)
+
+
+@dataclass
+class HTTPGetAction:
+    path: str = ""
+    port: int = 0
+    host: str = ""
+
+
+@dataclass
+class TCPSocketAction:
+    port: int = 0
+
+
+@dataclass
+class Probe:
+    exec: Optional[ExecAction] = None
+    http_get: Optional[HTTPGetAction] = None
+    tcp_socket: Optional[TCPSocketAction] = None
+    initial_delay_seconds: int = 0
+    timeout_seconds: int = 1
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Container:
+    """Reference: pkg/api/types.go Container."""
+
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    working_dir: str = ""
+    ports: List[ContainerPort] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
+    image_pull_policy: str = "IfNotPresent"
+
+
+@dataclass
+class EmptyDirVolumeSource:
+    medium: str = ""
+
+
+@dataclass
+class HostPathVolumeSource:
+    path: str = ""
+
+
+@dataclass
+class GCEPersistentDiskVolumeSource:
+    pd_name: str = ""
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class AWSElasticBlockStoreVolumeSource:
+    volume_id: str = field(default="", metadata={"wire": "volumeID"})
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class SecretVolumeSource:
+    secret_name: str = ""
+
+
+@dataclass
+class Volume:
+    """Reference: pkg/api/types.go Volume / VolumeSource (subset)."""
+
+    name: str = ""
+    empty_dir: Optional[EmptyDirVolumeSource] = None
+    host_path: Optional[HostPathVolumeSource] = None
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    secret: Optional[SecretVolumeSource] = None
+
+
+@dataclass
+class PodSpec:
+    """Reference: pkg/api/types.go PodSpec."""
+
+    volumes: List[Volume] = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    restart_policy: str = "Always"
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""
+    host_network: bool = False
+    service_account: str = ""
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: Dict[str, Any] = field(default_factory=dict)
+    ready: bool = False
+    restart_count: int = 0
+    image: str = ""
+    container_id: str = field(default="", metadata={"wire": "containerID"})
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+
+
+@dataclass
+class PodStatus:
+    """Reference: pkg/api/types.go PodStatus. phase in
+    Pending|Running|Succeeded|Failed|Unknown."""
+
+    phase: str = "Pending"
+    conditions: List[PodCondition] = field(default_factory=list)
+    message: str = ""
+    reason: str = ""
+    host_ip: str = ""
+    pod_ip: str = ""
+    start_time: str = ""
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    kind: str = "Pod"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""  # Ready
+    status: str = ""  # True | False | Unknown
+    last_heartbeat_time: str = ""
+    last_transition_time: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class NodeAddress:
+    type: str = ""  # InternalIP | ExternalIP | Hostname
+    address: str = ""
+
+
+@dataclass
+class NodeStatus:
+    """Reference: pkg/api/types.go NodeStatus (capacity drives scheduling)."""
+
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    phase: str = ""
+    conditions: List[NodeCondition] = field(default_factory=list)
+    addresses: List[NodeAddress] = field(default_factory=list)
+    node_info: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeSpec:
+    pod_cidr: str = field(default="", metadata={"wire": "podCIDR"})
+    external_id: str = field(default="", metadata={"wire": "externalID"})
+    unschedulable: bool = False
+
+
+@dataclass
+class Node:
+    kind: str = "Node"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+
+# ---------------------------------------------------------------------------
+# Service / Endpoints
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    target_port: Any = 0  # int or named port string
+    node_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    """Reference: pkg/api/types.go ServiceSpec."""
+
+    ports: List[ServicePort] = field(default_factory=list)
+    selector: Dict[str, str] = field(default_factory=dict)
+    cluster_ip: str = ""
+    type: str = "ClusterIP"
+    external_ips: List[str] = field(default_factory=list)
+    session_affinity: str = "None"
+
+
+@dataclass
+class Service:
+    kind: str = "Service"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = field(default="", metadata={"wire": "ip"})
+    target_ref: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints:
+    kind: str = "Endpoints"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# ReplicationController
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ReplicationControllerSpec:
+    replicas: int = 0
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicationControllerStatus:
+    replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicationController:
+    kind: str = "ReplicationController"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicationControllerSpec = field(default_factory=ReplicationControllerSpec)
+    status: ReplicationControllerStatus = field(
+        default_factory=ReplicationControllerStatus
+    )
+
+
+# ---------------------------------------------------------------------------
+# Binding / Event / Namespace / Secret / envelopes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    api_version: str = ""
+    resource_version: str = ""
+    field_path: str = ""
+
+
+@dataclass
+class Binding:
+    """Reference: pkg/api/types.go Binding — metadata names the pod,
+    target names the node (pkg/registry/pod/etcd/etcd.go:123-181)."""
+
+    kind: str = "Binding"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    target: ObjectReference = field(default_factory=ObjectReference)
+
+
+@dataclass
+class Event:
+    kind: str = "Event"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    source: Dict[str, str] = field(default_factory=dict)
+    first_timestamp: str = ""
+    last_timestamp: str = ""
+    count: int = 0
+
+
+@dataclass
+class NamespaceSpec:
+    finalizers: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = "Active"
+
+
+@dataclass
+class Namespace:
+    kind: str = "Namespace"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NamespaceSpec = field(default_factory=NamespaceSpec)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+
+@dataclass
+class Secret:
+    kind: str = "Secret"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+
+
+@dataclass
+class DeleteOptions:
+    kind: str = "DeleteOptions"
+    api_version: str = "v1"
+    grace_period_seconds: Optional[int] = None
+
+
+@dataclass
+class StatusDetails:
+    name: str = ""
+    kind: str = ""
+    causes: List[Dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class Status:
+    """Reference: pkg/api/types.go Status — API error/success envelope."""
+
+    kind: str = "Status"
+    api_version: str = "v1"
+    metadata: ListMeta = field(default_factory=ListMeta)
+    status: str = ""  # Success | Failure
+    message: str = ""
+    reason: str = ""
+    details: Optional[StatusDetails] = None
+    code: int = 0
+
+
+# Registry of kinds for decode dispatch (reference: runtime.Scheme type map).
+KINDS = {
+    "Pod": Pod,
+    "Node": Node,
+    "Minion": Node,
+    "Service": Service,
+    "Endpoints": Endpoints,
+    "ReplicationController": ReplicationController,
+    "Binding": Binding,
+    "Event": Event,
+    "Namespace": Namespace,
+    "Secret": Secret,
+    "DeleteOptions": DeleteOptions,
+    "Status": Status,
+}

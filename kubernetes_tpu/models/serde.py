@@ -1,0 +1,132 @@
+"""Dataclass <-> wire (camelCase JSON) codec.
+
+Plays the role of the reference's runtime.Codec / generated conversions
+(pkg/runtime/scheme.go, pkg/api/v1/conversion_generated.go): every API
+object serializes to the camelCase JSON wire form and decodes back into
+typed Python dataclasses, recursively, driven by type hints. Unknown
+wire fields are ignored (forward compatibility); zero-valued fields are
+omitted on encode like Go's `omitempty`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Type, get_args, get_origin, get_type_hints
+
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+
+_SPECIAL_CAMEL = {
+    # Wire names that simple snake->camel conversion would get wrong.
+    "api_version": "apiVersion",
+    "cluster_ip": "clusterIP",
+    "pod_ip": "podIP",
+    "host_ip": "hostIP",
+    "external_ips": "externalIPs",
+    "node_port": "nodePort",
+    "target_port": "targetPort",
+    "host_port": "hostPort",
+    "container_port": "containerPort",
+    "image_pull_policy": "imagePullPolicy",
+    "tcp_socket": "tcpSocket",
+    "http_get": "httpGet",
+    "uid": "uid",
+}
+
+
+def snake_to_camel(name: str) -> str:
+    if name in _SPECIAL_CAMEL:
+        return _SPECIAL_CAMEL[name]
+    parts = name.split("_")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+_hints_cache: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    h = _hints_cache.get(cls)
+    if h is None:
+        h = get_type_hints(cls)
+        _hints_cache[cls] = h
+    return h
+
+
+def _is_zero(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, (list, dict, str)) and not v:
+        return True
+    if isinstance(v, bool):
+        return v is False
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v == 0
+    if isinstance(v, Quantity):
+        return v.is_zero()
+    return False
+
+
+def to_wire(obj: Any, *, omit_empty: bool = True) -> Any:
+    """Recursively encode a dataclass (or container) to wire-form JSON."""
+    if obj is None:
+        return None
+    if isinstance(obj, Quantity):
+        return str(obj)
+    if dataclasses.is_dataclass(obj):
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if omit_empty and _is_zero(v) and not f.metadata.get("always"):
+                continue
+            out[f.metadata.get("wire", snake_to_camel(f.name))] = to_wire(
+                v, omit_empty=omit_empty
+            )
+        return out
+    if isinstance(obj, dict):
+        return {k: to_wire(v, omit_empty=omit_empty) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v, omit_empty=omit_empty) for v in obj]
+    return obj
+
+
+def _decode_value(hint: Any, v: Any) -> Any:
+    if v is None:
+        return None
+    origin = get_origin(hint)
+    if origin is typing.Union:  # Optional[T] and friends
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return _decode_value(args[0], v)
+        return v
+    if hint is Quantity:
+        return parse_quantity(v)
+    if dataclasses.is_dataclass(hint):
+        return from_wire(hint, v)
+    if origin in (list, typing.List):
+        (elem,) = get_args(hint) or (Any,)
+        return [_decode_value(elem, x) for x in v]
+    if origin in (dict, typing.Dict):
+        args = get_args(hint)
+        elem = args[1] if len(args) == 2 else Any
+        return {k: _decode_value(elem, x) for k, x in v.items()}
+    return v
+
+
+def from_wire(cls: Type, data: Dict[str, Any] | None):
+    """Decode wire-form JSON into dataclass `cls`, ignoring unknown keys."""
+    if data is None:
+        return None
+    if not isinstance(data, dict):
+        raise ValueError(f"cannot decode {cls.__name__} from {type(data).__name__}")
+    hints = _hints(cls)
+    kwargs: Dict[str, Any] = {}
+    wire_index = {
+        f.metadata.get("wire", snake_to_camel(f.name)): f.name
+        for f in dataclasses.fields(cls)
+    }
+    for wire_key, v in data.items():
+        name = wire_index.get(wire_key)
+        if name is None:
+            continue
+        kwargs[name] = _decode_value(hints[name], v)
+    return cls(**kwargs)

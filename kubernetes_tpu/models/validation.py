@@ -1,0 +1,149 @@
+"""Object validation.
+
+Behavioral parity with pkg/api/validation/validation.go (subset): DNS
+naming rules, required fields, uniqueness constraints, port ranges.
+Errors are collected (not fail-fast) like the reference's field-error
+lists (pkg/util/fielderrors/).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from kubernetes_tpu.models.objects import (
+    Node,
+    Pod,
+    ReplicationController,
+    Service,
+)
+
+# RFC 1123 subdomain/label (reference: util.IsDNS1123Subdomain/Label).
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_DNS1123_SUBDOMAIN = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$"
+)
+_LABEL_VALUE = re.compile(r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$")
+
+RESTART_POLICIES = {"Always", "OnFailure", "Never"}
+PULL_POLICIES = {"Always", "Never", "IfNotPresent"}
+PROTOCOLS = {"TCP", "UDP"}
+
+
+class ValidationError(ValueError):
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+def is_dns1123_label(s: str) -> bool:
+    return bool(s) and len(s) <= 63 and bool(_DNS1123_LABEL.match(s))
+
+
+def is_dns1123_subdomain(s: str) -> bool:
+    return bool(s) and len(s) <= 253 and bool(_DNS1123_SUBDOMAIN.match(s))
+
+
+def _validate_meta(meta, errs: List[str], *, namespace_required: bool = True) -> None:
+    if not meta.name and not meta.generate_name:
+        errs.append("metadata.name: required")
+    elif meta.name and not is_dns1123_subdomain(meta.name):
+        errs.append(f"metadata.name: invalid name {meta.name!r}")
+    if namespace_required and not meta.namespace:
+        errs.append("metadata.namespace: required")
+    for k, v in (meta.labels or {}).items():
+        if not _LABEL_VALUE.match(v):
+            errs.append(f"metadata.labels[{k}]: invalid value {v!r}")
+
+
+def _validate_containers(containers, errs: List[str]) -> None:
+    if not containers:
+        errs.append("spec.containers: required")
+    names = set()
+    for i, c in enumerate(containers):
+        where = f"spec.containers[{i}]"
+        if not is_dns1123_label(c.name):
+            errs.append(f"{where}.name: invalid {c.name!r}")
+        if c.name in names:
+            errs.append(f"{where}.name: duplicate {c.name!r}")
+        names.add(c.name)
+        if not c.image:
+            errs.append(f"{where}.image: required")
+        if c.image_pull_policy and c.image_pull_policy not in PULL_POLICIES:
+            errs.append(f"{where}.imagePullPolicy: invalid {c.image_pull_policy!r}")
+        for p in c.ports:
+            if not (0 < p.container_port < 65536):
+                errs.append(f"{where}.ports: containerPort {p.container_port} invalid")
+            if p.host_port and not (0 < p.host_port < 65536):
+                errs.append(f"{where}.ports: hostPort {p.host_port} invalid")
+            if p.protocol not in PROTOCOLS:
+                errs.append(f"{where}.ports: protocol {p.protocol!r} invalid")
+
+
+def validate_pod(pod: Pod) -> None:
+    errs: List[str] = []
+    _validate_meta(pod.metadata, errs)
+    _validate_containers(pod.spec.containers, errs)
+    if pod.spec.restart_policy not in RESTART_POLICIES:
+        errs.append(f"spec.restartPolicy: invalid {pod.spec.restart_policy!r}")
+    vol_names = set()
+    for i, v in enumerate(pod.spec.volumes):
+        if not is_dns1123_label(v.name):
+            errs.append(f"spec.volumes[{i}].name: invalid {v.name!r}")
+        if v.name in vol_names:
+            errs.append(f"spec.volumes[{i}].name: duplicate {v.name!r}")
+        vol_names.add(v.name)
+    for c in pod.spec.containers:
+        for m in c.volume_mounts:
+            if m.name not in vol_names:
+                errs.append(f"volumeMounts: unknown volume {m.name!r}")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_node(node: Node) -> None:
+    errs: List[str] = []
+    _validate_meta(node.metadata, errs, namespace_required=False)
+    for k, q in (node.status.capacity or {}).items():
+        if q.milli_value() < 0:
+            errs.append(f"status.capacity[{k}]: must be nonnegative")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_service(svc: Service) -> None:
+    errs: List[str] = []
+    _validate_meta(svc.metadata, errs)
+    if not svc.spec.ports:
+        errs.append("spec.ports: required")
+    for i, p in enumerate(svc.spec.ports):
+        if not (0 < p.port < 65536):
+            errs.append(f"spec.ports[{i}].port: invalid {p.port}")
+        if p.protocol not in PROTOCOLS:
+            errs.append(f"spec.ports[{i}].protocol: invalid {p.protocol!r}")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_replication_controller(rc: ReplicationController) -> None:
+    errs: List[str] = []
+    _validate_meta(rc.metadata, errs)
+    if rc.spec.replicas < 0:
+        errs.append("spec.replicas: must be nonnegative")
+    if not rc.spec.selector:
+        errs.append("spec.selector: required")
+    tmpl = rc.spec.template
+    if tmpl is None:
+        errs.append("spec.template: required")
+    else:
+        labels = tmpl.metadata.labels or {}
+        for k, v in rc.spec.selector.items():
+            if labels.get(k) != v:
+                errs.append(f"spec.template.metadata.labels: selector {k}={v} not matched")
+        _validate_containers(tmpl.spec.containers, errs)
+        if tmpl.spec.restart_policy != "Always":
+            # Reference: RC templates must have RestartPolicy Always
+            # (validation.go ValidateReplicationControllerSpec).
+            errs.append("spec.template.spec.restartPolicy: must be Always")
+    if errs:
+        raise ValidationError(errs)

@@ -1,0 +1,157 @@
+"""Columnar encoding tests — the matrix schema feeding the TPU solver."""
+
+import numpy as np
+
+from kubernetes_tpu.models import (
+    Container,
+    ContainerPort,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Service,
+    ServiceSpec,
+)
+from kubernetes_tpu.models.columnar import build_snapshot, pod_resource_request
+from kubernetes_tpu.models.objects import (
+    GCEPersistentDiskVolumeSource,
+    NodeCondition,
+    ResourceRequirements,
+    Volume,
+)
+from kubernetes_tpu.models.quantity import parse_quantity
+
+
+def mk_pod(name, cpu="100m", mem="64Mi", node_name="", selector=None, host_port=0, pd=None, labels=None):
+    vols = []
+    if pd:
+        vols.append(Volume(name="v", gce_persistent_disk=GCEPersistentDiskVolumeSource(pd_name=pd)))
+    ports = [ContainerPort(container_port=80, host_port=host_port)] if host_port else []
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default", labels=labels or {}),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="c",
+                    image="nginx",
+                    ports=ports,
+                    resources=ResourceRequirements(
+                        requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+                    ),
+                )
+            ],
+            volumes=vols,
+            node_name=node_name,
+            node_selector=selector or {},
+        ),
+    )
+
+
+def mk_node(name, cpu="4", mem="8Gi", labels=None, ready=True):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(
+            capacity={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)},
+            conditions=[NodeCondition(type="Ready", status="True" if ready else "False")],
+        ),
+    )
+
+
+def test_resource_request_sums_containers():
+    pod = mk_pod("p")
+    pod.spec.containers.append(
+        Container(
+            name="c2",
+            image="x",
+            resources=ResourceRequirements(
+                requests={"cpu": parse_quantity("1"), "memory": parse_quantity("1Gi")}
+            ),
+        )
+    )
+    cpu, mem = pod_resource_request(pod)
+    assert cpu == 1100
+    assert mem == 64 * 1024**2 + 1024**3
+
+
+def test_snapshot_shapes_and_resources():
+    pods = [mk_pod(f"p{i}", cpu="250m", mem="128Mi") for i in range(3)]
+    nodes = [mk_node(f"n{j}") for j in range(2)]
+    snap = build_snapshot(pods, nodes)
+    assert snap.pods.count == 3
+    assert snap.nodes.count == 2
+    np.testing.assert_array_equal(snap.pods.cpu_milli, [250, 250, 250])
+    np.testing.assert_array_equal(snap.pods.mem_mib, [128, 128, 128])
+    np.testing.assert_array_equal(snap.nodes.cpu_cap, [4000, 4000])
+    np.testing.assert_array_equal(snap.nodes.mem_cap, [8192, 8192])
+    assert snap.nodes.schedulable.all()
+
+
+def test_occupancy_from_assigned_pods():
+    nodes = [mk_node("n0"), mk_node("n1")]
+    assigned = [
+        mk_pod("a0", cpu="1", mem="1Gi", node_name="n0"),
+        mk_pod("a1", cpu="500m", mem="512Mi", node_name="n0"),
+        mk_pod("a2", cpu="2", mem="2Gi", node_name="missing"),
+    ]
+    snap = build_snapshot([], nodes, assigned_pods=assigned)
+    np.testing.assert_array_equal(snap.nodes.cpu_used, [1500, 0])
+    np.testing.assert_array_equal(snap.nodes.mem_used, [1536, 0])
+
+
+def test_selector_dedup_and_bits():
+    pods = [
+        mk_pod("p0", selector={"disk": "ssd"}),
+        mk_pod("p1", selector={"disk": "ssd"}),
+        mk_pod("p2"),
+        mk_pod("p3", selector={"disk": "hdd", "zone": "a"}),
+    ]
+    nodes = [mk_node("n0", labels={"disk": "ssd"}), mk_node("n1", labels={"disk": "hdd", "zone": "a"})]
+    snap = build_snapshot(pods, nodes)
+    # p0 and p1 share a selector row; p2 is the empty row 0.
+    assert snap.pods.selector_id[0] == snap.pods.selector_id[1]
+    assert snap.pods.selector_id[2] == 0
+    assert snap.pods.selector_id[3] not in (0, snap.pods.selector_id[0])
+    assert snap.pods.sel_bits.shape[0] == 3  # empty, ssd, hdd+zone
+    # Subset check host-side: p3's selector bits are all present on n1.
+    sel = snap.pods.sel_bits[snap.pods.selector_id[3]]
+    assert ((sel & snap.nodes.label_bits[1]) == sel).all()
+    assert not ((sel & snap.nodes.label_bits[0]) == sel).all()
+
+
+def test_ports_and_volumes_bits():
+    pods = [mk_pod("p0", host_port=8080, pd="disk-1")]
+    nodes = [mk_node("n0"), mk_node("n1")]
+    assigned = [mk_pod("a0", host_port=8080, node_name="n0", pd="disk-1")]
+    snap = build_snapshot(pods, nodes, assigned_pods=assigned)
+    # Conflict on n0 (same hostPort + same PD), clean on n1.
+    assert (snap.pods.port_bits[0] & snap.nodes.used_port_bits[0]).any()
+    assert not (snap.pods.port_bits[0] & snap.nodes.used_port_bits[1]).any()
+    assert (snap.pods.vol_bits[0] & snap.nodes.used_vol_bits[0]).any()
+
+
+def test_pinned_node_and_readiness():
+    pods = [mk_pod("p0", node_name="n1"), mk_pod("p1", node_name="ghost")]
+    nodes = [mk_node("n0", ready=False), mk_node("n1")]
+    snap = build_snapshot(pods, nodes)
+    assert snap.pods.pinned_node[0] == 1
+    assert snap.pods.pinned_node[1] == -2  # unknown node
+    np.testing.assert_array_equal(snap.nodes.schedulable, [False, True])
+
+
+def test_service_mapping_and_counts():
+    svc = Service(
+        metadata=ObjectMeta(name="web", namespace="default"),
+        spec=ServiceSpec(selector={"app": "web"}),
+    )
+    pods = [mk_pod("p0", labels={"app": "web"}), mk_pod("p1", labels={"app": "db"})]
+    nodes = [mk_node("n0"), mk_node("n1")]
+    assigned = [
+        mk_pod("a0", labels={"app": "web"}, node_name="n0"),
+        mk_pod("a1", labels={"app": "web"}, node_name="n0"),
+        mk_pod("a2", labels={"app": "web"}, node_name="n1"),
+    ]
+    snap = build_snapshot(pods, nodes, assigned_pods=assigned, services=[svc])
+    assert snap.pods.service_id[0] == 0
+    assert snap.pods.service_id[1] == -1
+    np.testing.assert_array_equal(snap.nodes.service_counts[:, 0], [2, 1])

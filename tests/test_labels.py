@@ -1,0 +1,53 @@
+"""Label/field selector parity (reference: pkg/labels/, pkg/fields/)."""
+
+import pytest
+
+from kubernetes_tpu.models import labels
+
+
+def test_selector_from_set():
+    sel = labels.selector_from_set({"a": "b", "c": "d"})
+    assert sel.matches({"a": "b", "c": "d", "extra": "x"})
+    assert not sel.matches({"a": "b"})
+    assert not sel.matches({})
+    assert labels.selector_from_set({}).matches({"anything": "goes"})
+
+
+@pytest.mark.parametrize(
+    "expr,labels_map,want",
+    [
+        ("x=a", {"x": "a"}, True),
+        ("x=a", {"x": "b"}, False),
+        ("x==a", {"x": "a"}, True),
+        ("x!=a", {"x": "b"}, True),
+        ("x!=a", {"x": "a"}, False),
+        ("x!=a", {}, True),
+        ("x in (a,b)", {"x": "b"}, True),
+        ("x in (a,b)", {"x": "c"}, False),
+        ("x in (a,b)", {}, False),
+        ("x notin (a,b)", {"x": "c"}, True),
+        ("x notin (a,b)", {"x": "a"}, False),
+        ("x notin (a,b)", {}, True),
+        ("x", {"x": "anything"}, True),
+        ("x", {}, False),
+        ("x=a,y=b", {"x": "a", "y": "b"}, True),
+        ("x=a,y=b", {"x": "a"}, False),
+        ("x in (a,b),y!=c", {"x": "a", "y": "d"}, True),
+        ("", {"x": "a"}, True),
+    ],
+)
+def test_parse_and_match(expr, labels_map, want):
+    assert labels.parse(expr).matches(labels_map) is want
+
+
+def test_parse_invalid():
+    with pytest.raises(ValueError):
+        labels.parse("x==,=")
+
+
+def test_field_selector():
+    fs = labels.parse_fields("spec.nodeName=,status.phase!=Failed")
+    assert fs.matches({"spec.nodeName": "", "status.phase": "Running"})
+    assert not fs.matches({"spec.nodeName": "n1", "status.phase": "Running"})
+    assert not fs.matches({"spec.nodeName": "", "status.phase": "Failed"})
+    assert labels.parse_fields("").matches({"a": "b"})

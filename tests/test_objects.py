@@ -1,0 +1,143 @@
+"""Object model codec + validation tests (reference: pkg/api/)."""
+
+import pytest
+
+from kubernetes_tpu.models import (
+    Container,
+    ContainerPort,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ReplicationController,
+    ReplicationControllerSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from kubernetes_tpu.models.objects import (
+    KINDS,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.models.quantity import parse_quantity
+from kubernetes_tpu.models.serde import from_wire, to_wire
+from kubernetes_tpu.models.validation import (
+    ValidationError,
+    validate_pod,
+    validate_replication_controller,
+    validate_service,
+)
+
+
+def make_pod(name="p1", ns="default", cpu="100m", mem="64Mi", **spec_kw):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="main",
+                    image="nginx",
+                    resources=ResourceRequirements(
+                        requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+                    ),
+                )
+            ],
+            **spec_kw,
+        ),
+    )
+
+
+def test_pod_wire_roundtrip():
+    pod = make_pod(node_selector={"disk": "ssd"})
+    wire = to_wire(pod)
+    assert wire["kind"] == "Pod"
+    assert wire["metadata"]["name"] == "p1"
+    assert wire["spec"]["nodeSelector"] == {"disk": "ssd"}
+    assert wire["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "100m"
+    back = from_wire(Pod, wire)
+    assert back.metadata.name == "p1"
+    assert back.spec.node_selector == {"disk": "ssd"}
+    assert back.spec.containers[0].resources.requests["cpu"].milli_value() == 100
+    assert back.spec.containers[0].resources.requests["memory"].value() == 64 * 1024**2
+
+
+def test_unknown_fields_ignored():
+    pod = from_wire(Pod, {"metadata": {"name": "x", "futureField": 1}, "spec": {}})
+    assert pod.metadata.name == "x"
+
+
+def test_omit_empty():
+    wire = to_wire(Pod(metadata=ObjectMeta(name="x")))
+    assert "nodeName" not in wire.get("spec", {})
+    assert "labels" not in wire["metadata"]
+
+
+def test_node_capacity_roundtrip():
+    node = Node(
+        metadata=ObjectMeta(name="n1"),
+        status=NodeStatus(
+            capacity={"cpu": parse_quantity("4"), "memory": parse_quantity("8Gi")}
+        ),
+    )
+    back = from_wire(Node, to_wire(node))
+    assert back.status.capacity["cpu"].milli_value() == 4000
+    assert back.status.capacity["memory"].value() == 8 * 1024**3
+
+
+def test_kind_registry():
+    assert KINDS["Pod"] is Pod
+    assert KINDS["Minion"] is Node  # legacy alias
+
+
+def test_validate_pod_ok():
+    validate_pod(make_pod())
+
+
+def test_validate_pod_errors():
+    bad = Pod(metadata=ObjectMeta(name="UPPER", namespace="default"))
+    with pytest.raises(ValidationError) as exc:
+        validate_pod(bad)
+    msgs = " ".join(exc.value.errors)
+    assert "invalid name" in msgs
+    assert "containers" in msgs
+
+
+def test_validate_pod_duplicate_ports_container_names():
+    pod = make_pod()
+    pod.spec.containers.append(
+        Container(name="main", image="x", ports=[ContainerPort(container_port=0)])
+    )
+    with pytest.raises(ValidationError) as exc:
+        validate_pod(pod)
+    assert any("duplicate" in e for e in exc.value.errors)
+
+
+def test_validate_service():
+    svc = Service(
+        metadata=ObjectMeta(name="s1", namespace="default"),
+        spec=ServiceSpec(ports=[ServicePort(port=80)], selector={"app": "web"}),
+    )
+    validate_service(svc)
+    svc.spec.ports = []
+    with pytest.raises(ValidationError):
+        validate_service(svc)
+
+
+def test_validate_rc():
+    pod = make_pod()
+    rc = ReplicationController(
+        metadata=ObjectMeta(name="rc1", namespace="default"),
+        spec=ReplicationControllerSpec(
+            replicas=3,
+            selector={"app": "web"},
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(labels={"app": "web"}), spec=pod.spec
+            ),
+        ),
+    )
+    validate_replication_controller(rc)
+    rc.spec.selector = {"app": "other"}
+    with pytest.raises(ValidationError):
+        validate_replication_controller(rc)

@@ -1,0 +1,62 @@
+"""Quantity parsing/formatting parity (reference: pkg/api/resource/)."""
+
+import pytest
+
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+
+
+@pytest.mark.parametrize(
+    "s,milli",
+    [
+        ("0", 0),
+        ("100m", 100),
+        ("1", 1000),
+        ("2", 2000),
+        ("250m", 250),
+        ("1.5", 1500),
+        ("0.1", 100),
+        ("1k", 1_000_000),
+        ("1M", 1_000_000_000),
+        ("1Ki", 1024 * 1000),
+        ("1Mi", 1024**2 * 1000),
+        ("64Mi", 64 * 1024**2 * 1000),
+        ("1Gi", 1024**3 * 1000),
+        ("1.5Gi", 1536 * 1024**2 * 1000),
+        ("-1", -1000),
+        ("+1", 1000),
+    ],
+)
+def test_parse(s, milli):
+    assert parse_quantity(s).milli == milli
+
+
+def test_milli_and_value():
+    q = parse_quantity("2500m")
+    assert q.milli_value() == 2500
+    assert q.value() == 3  # rounds up like the reference's Value()
+    assert parse_quantity("2").value() == 2
+    assert parse_quantity("64Mi").value() == 64 * 1024**2
+
+
+def test_roundtrip_strings():
+    for s in ["100m", "2", "64Mi", "1Gi", "500m", "4", "10k", "128Ki"]:
+        assert str(parse_quantity(s)) == s
+
+
+def test_arithmetic_and_compare():
+    a, b = parse_quantity("1"), parse_quantity("500m")
+    assert (a + b).milli == 1500
+    assert (a - b).milli == 500
+    assert b < a
+    assert parse_quantity("1024Mi") == parse_quantity("1Gi")
+
+
+def test_invalid():
+    for bad in ["", "abc", "1Q", "--1", "1..5"]:
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+
+def test_from_int():
+    assert Quantity.from_int(4).milli_value() == 4000
+    assert Quantity.from_milli(250).milli_value() == 250
