@@ -1,0 +1,19 @@
+"""Client library: typed REST client + list/watch cache substrate.
+
+Reference: pkg/client/ (typed client, request.go), pkg/client/cache/
+(Store, FIFO, Reflector, listers), pkg/controller/framework (Informer),
+pkg/client/record (events).
+"""
+
+from kubernetes_tpu.client.rest import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.client.cache import FIFO, Informer, Reflector, ThreadSafeStore
+
+__all__ = [
+    "Client",
+    "HTTPTransport",
+    "LocalTransport",
+    "FIFO",
+    "Informer",
+    "Reflector",
+    "ThreadSafeStore",
+]

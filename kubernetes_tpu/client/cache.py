@@ -1,0 +1,296 @@
+"""List/watch cache substrate: ThreadSafeStore, FIFO, Reflector, Informer.
+
+Reference: pkg/client/cache/ (store.go, fifo.go, reflector.go:80-268)
+and pkg/controller/framework/controller.go (NewInformer). The Reflector
+lists, primes its store, then applies watch deltas; on watch failure it
+backs off and re-lists — components therefore tolerate apiserver
+restarts and compaction (410 Gone) transparently.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.store.watch import ADDED, DELETED, ERROR, MODIFIED
+
+
+def meta_namespace_key(obj) -> str:
+    """Default key func (reference: cache.MetaNamespaceKeyFunc)."""
+    if isinstance(obj, dict):
+        meta = obj.get("metadata", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+    else:
+        ns, name = obj.metadata.namespace, obj.metadata.name
+    return f"{ns}/{name}" if ns else name
+
+
+class ThreadSafeStore:
+    """Keyed object cache (reference: cache.ThreadSafeStore)."""
+
+    def __init__(self, key_func: Callable = meta_namespace_key):
+        self._lock = threading.RLock()
+        self._items: Dict[str, Any] = {}
+        self.key_func = key_func
+
+    def add(self, obj) -> None:
+        with self._lock:
+            self._items[self.key_func(obj)] = obj
+
+    update = add
+
+    def delete(self, obj) -> None:
+        with self._lock:
+            self._items.pop(self.key_func(obj), None)
+
+    def get(self, key: str):
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._items.values())
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items.keys())
+
+    def replace(self, objs: List[Any]) -> None:
+        with self._lock:
+            self._items = {self.key_func(o): o for o in objs}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class FIFO:
+    """Producer/consumer queue with key-dedup: a Pop returns the latest
+    version of each enqueued object (reference: cache.FIFO, fifo.go:49-184)."""
+
+    def __init__(self, key_func: Callable = meta_namespace_key):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: Dict[str, Any] = {}
+        self._queue: List[str] = []
+        self._closed = False
+        self.key_func = key_func
+
+    def add(self, obj) -> None:
+        key = self.key_func(obj)
+        with self._cond:
+            if key not in self._items:
+                self._queue.append(key)
+            self._items[key] = obj
+            self._cond.notify()
+
+    update = add
+
+    def delete(self, obj) -> None:
+        key = self.key_func(obj)
+        with self._cond:
+            self._items.pop(key, None)
+            # Lazy removal: Pop skips keys without items.
+
+    def pop(self, timeout: Optional[float] = None):
+        """Blocking pop (reference: fifo.go:168). None on close/timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                while self._queue:
+                    key = self._queue.pop(0)
+                    if key in self._items:
+                        return self._items.pop(key)
+                if self._closed:
+                    return None
+                wait = None
+                if deadline is not None:
+                    wait = deadline - time.monotonic()
+                    if wait <= 0:
+                        return None
+                self._cond.wait(timeout=wait)
+
+    def replace(self, objs: List[Any]) -> None:
+        with self._cond:
+            self._items = {self.key_func(o): o for o in objs}
+            self._queue = list(self._items.keys())
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len([k for k in self._queue if k in self._items])
+
+
+class Reflector:
+    """List+watch loop feeding a store (reference: reflector.go:80-268).
+
+    `store` needs add/update/delete/replace. Objects land in wire form
+    unless `decode` converts them.
+    """
+
+    def __init__(
+        self,
+        client,
+        resource: str,
+        store,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+        decode: Optional[Callable[[dict], Any]] = None,
+        resync_period: float = 0.0,
+        on_event: Optional[Callable] = None,
+    ):
+        self.client = client
+        self.resource = resource
+        self.store = store
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.field_selector = field_selector
+        self.decode = decode or (lambda o: o)
+        self.resync_period = resync_period
+        self.on_event = on_event
+        self.last_sync_version = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._synced = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "Reflector":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- the loop -----------------------------------------------------
+
+    def _run(self) -> None:
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+                backoff = 0.05
+            except Exception:
+                if self._stop.is_set():
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def _list_and_watch(self) -> None:
+        # Typed clients return (items, version); raw ones a wire dict.
+        items, version = self.client.list(
+            self.resource,
+            namespace=self.namespace,
+            label_selector=self.label_selector,
+            field_selector=self.field_selector,
+        )
+        objs = [self.decode(o) if isinstance(o, dict) else o for o in items]
+        self.store.replace(objs)
+        self.last_sync_version = version
+        self._synced.set()
+        if self.on_event:
+            for o in objs:
+                self.on_event(ADDED, o)
+
+        while not self._stop.is_set():
+            try:
+                stream = self.client.watch(
+                    self.resource,
+                    namespace=self.namespace,
+                    since=self.last_sync_version,
+                    label_selector=self.label_selector,
+                    field_selector=self.field_selector,
+                )
+            except APIError as e:
+                if e.code == 410:  # compacted: re-list
+                    return
+                raise
+            try:
+                self._consume(stream)
+            finally:
+                stream.close()
+
+    def _consume(self, stream) -> None:
+        while not self._stop.is_set():
+            ev = stream.next(timeout=0.2)
+            if ev is None:
+                if stream.closed:
+                    return  # watch dropped; outer loop re-establishes
+                continue
+            if ev.type == ERROR:
+                return
+            obj = self.decode(ev.object) if isinstance(ev.object, dict) else ev.object
+            if ev.version:
+                self.last_sync_version = ev.version
+            if ev.type == ADDED:
+                self.store.add(obj)
+            elif ev.type == MODIFIED:
+                self.store.update(obj)
+            elif ev.type == DELETED:
+                self.store.delete(obj)
+            if self.on_event:
+                self.on_event(ev.type, obj)
+
+
+class Informer:
+    """Reflector + cache + event handlers (reference:
+    framework.NewInformer, controller.go:201)."""
+
+    def __init__(
+        self,
+        client,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+        decode: Optional[Callable] = None,
+        on_add: Optional[Callable] = None,
+        on_update: Optional[Callable] = None,
+        on_delete: Optional[Callable] = None,
+    ):
+        self.store = ThreadSafeStore()
+        self._on_add = on_add
+        self._on_update = on_update
+        self._on_delete = on_delete
+        self.reflector = Reflector(
+            client,
+            resource,
+            self.store,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_selector=field_selector,
+            decode=decode,
+            on_event=self._handle,
+        )
+
+    def _handle(self, etype: str, obj) -> None:
+        if etype == ADDED and self._on_add:
+            self._on_add(obj)
+        elif etype == MODIFIED and self._on_update:
+            self._on_update(obj)
+        elif etype == DELETED and self._on_delete:
+            self._on_delete(obj)
+
+    def start(self) -> "Informer":
+        self.reflector.start()
+        return self
+
+    def stop(self) -> None:
+        self.reflector.stop()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self.reflector.wait_for_sync(timeout)

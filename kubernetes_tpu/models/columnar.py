@@ -310,7 +310,9 @@ def build_snapshot(
         if RESOURCE_CPU in cap:
             cpu_cap[j] = cap[RESOURCE_CPU].milli_value()
         if RESOURCE_MEMORY in cap:
-            mem_cap[j] = mem_to_mib(cap[RESOURCE_MEMORY].value())
+            # Capacity rounds DOWN (requests round up) so lowering can
+            # only under-promise, never overcommit a node.
+            mem_cap[j] = cap[RESOURCE_MEMORY].value() // MIB
         label_bits[j] = bitset(
             [label_vocab.id(f"{k}={v}") for k, v in (n.metadata.labels or {}).items()],
             LW,

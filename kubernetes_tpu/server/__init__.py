@@ -1,0 +1,13 @@
+"""API server: REST + watch over the versioned store.
+
+Reference: pkg/apiserver/ + pkg/master/ + pkg/registry/. The core
+(`APIServer`) is transport-independent; `httpserver` exposes it over
+HTTP with chunked watch streams. Components in the same process can
+use the core directly (the reference's cmd/integration runs everything
+in one process the same way).
+"""
+
+from kubernetes_tpu.server.api import APIError, APIServer
+from kubernetes_tpu.server.registry import RESOURCES, ResourceInfo
+
+__all__ = ["APIServer", "APIError", "RESOURCES", "ResourceInfo"]

@@ -1,0 +1,351 @@
+"""Transport-independent API server core.
+
+REST verbs (create/get/list/update/delete/watch) with the reference's
+semantics (pkg/apiserver/resthandler.go):
+
+- create: defaulting (uid, creationTimestamp, namespace, generateName),
+  validation, AlreadyExists on duplicates.
+- update: CAS when the client supplies metadata.resourceVersion,
+  last-write-wins when it doesn't (reference allows both).
+- list/watch: label & field selector filtering; lists carry the store
+  version so watches can resume exactly after them.
+- bind: the parity-critical guarded write — pod.spec.nodeName is set
+  iff currently empty (pkg/registry/pod/etcd/etcd.go:123-181).
+- update_status: status subresource writes that preserve spec.
+
+All objects cross this boundary in wire form (camelCase dicts); typed
+callers use the client layer.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kubernetes_tpu.models import labels as labelpkg
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import now_iso, new_uid
+from kubernetes_tpu.models.validation import ValidationError
+from kubernetes_tpu.server.registry import RESOURCES, ResourceInfo, fields_for
+from kubernetes_tpu.store import (
+    AlreadyExistsError,
+    ConflictError,
+    KVStore,
+    NotFoundError,
+)
+from kubernetes_tpu.store.watch import Event, WatchStream
+
+
+class APIError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        self.code = code
+        self.reason = reason
+        self.message = message
+        super().__init__(message)
+
+    def to_status(self) -> dict:
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "reason": self.reason,
+            "message": self.message,
+            "code": self.code,
+        }
+
+
+def _not_found(resource: str, name: str) -> APIError:
+    return APIError(404, "NotFound", f'{resource} "{name}" not found')
+
+
+def _conflict(msg: str) -> APIError:
+    return APIError(409, "Conflict", msg)
+
+
+def _invalid(msg: str) -> APIError:
+    return APIError(422, "Invalid", msg)
+
+
+def _bad_request(msg: str) -> APIError:
+    return APIError(400, "BadRequest", msg)
+
+
+class _FilteredStream:
+    """Wraps a store WatchStream, applying selector filters."""
+
+    def __init__(self, inner: WatchStream, pred):
+        self._inner = inner
+        self._pred = pred
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            t = None if deadline is None else max(0.0, deadline - time.monotonic())
+            ev = self._inner.next(timeout=t)
+            if ev is None:
+                return None
+            if self._pred(ev.object):
+                return ev
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
+    def close(self) -> None:
+        self._inner.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
+
+    def __iter__(self):
+        while True:
+            ev = self.next()
+            if ev is None:
+                return
+            yield ev
+
+
+class APIServer:
+    """The master: storage-backed REST resources (pkg/master/master.go)."""
+
+    def __init__(self, store: Optional[KVStore] = None):
+        self.store = store or KVStore()
+        self._lock = threading.Lock()
+        self._rand = random.Random(0xC0FFEE)
+        # Ensure the default namespace exists (reference auto-creates).
+        try:
+            self.store.create(
+                "/registry/namespaces/default",
+                {
+                    "kind": "Namespace",
+                    "apiVersion": "v1",
+                    "metadata": {
+                        "name": "default",
+                        "uid": new_uid(),
+                        "creationTimestamp": now_iso(),
+                    },
+                    "spec": {},
+                    "status": {"phase": "Active"},
+                },
+            )
+        except AlreadyExistsError:
+            pass
+
+    # -- helpers ------------------------------------------------------
+
+    def _info(self, resource: str) -> ResourceInfo:
+        info = RESOURCES.get(resource)
+        if info is None:
+            raise _bad_request(f"unknown resource {resource!r}")
+        return info
+
+    def _gen_name(self, base: str) -> str:
+        suffix = "".join(self._rand.choices(string.ascii_lowercase + "0123456789", k=5))
+        return base + suffix
+
+    # -- verbs --------------------------------------------------------
+
+    def create(self, resource: str, namespace: str, obj: dict) -> dict:
+        info = self._info(resource)
+        meta = obj.setdefault("metadata", {})
+        if info.namespaced:
+            ns = meta.get("namespace") or namespace or "default"
+            meta["namespace"] = ns
+            if namespace and meta["namespace"] != namespace:
+                raise _bad_request(
+                    f"namespace mismatch: body {meta['namespace']!r} vs url {namespace!r}"
+                )
+        else:
+            meta.pop("namespace", None)
+            ns = ""
+        if not meta.get("name") and meta.get("generateName"):
+            meta["name"] = self._gen_name(meta["generateName"])
+        if not meta.get("name"):
+            raise _invalid("metadata.name: required")
+        obj.setdefault("kind", info.kind)
+        obj.setdefault("apiVersion", "v1")
+        if obj["kind"] != info.kind:
+            raise _bad_request(f"kind {obj['kind']!r} does not match {info.kind!r}")
+        meta["uid"] = new_uid()
+        meta["creationTimestamp"] = now_iso()
+        meta.pop("resourceVersion", None)
+        self._validate(info, obj)
+        try:
+            return self.store.create(
+                info.key(ns, meta["name"]), obj, ttl=info.ttl
+            )
+        except AlreadyExistsError:
+            raise _conflict(f'{info.name} "{meta["name"]}" already exists')
+
+    def _validate(self, info: ResourceInfo, obj: dict) -> None:
+        if info.validator is None:
+            return
+        typed = serde.from_wire(info.cls, obj)
+        try:
+            info.validator(typed)
+        except ValidationError as e:
+            raise _invalid("; ".join(e.errors))
+
+    def _ns(self, info: ResourceInfo, namespace: str) -> str:
+        return (namespace or "default") if info.namespaced else ""
+
+    def get(self, resource: str, namespace: str, name: str) -> dict:
+        info = self._info(resource)
+        try:
+            return self.store.get(info.key(self._ns(info, namespace), name))
+        except NotFoundError:
+            raise _not_found(info.name, name)
+
+    def list(
+        self,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> dict:
+        info = self._info(resource)
+        items, version = self.store.list(info.prefix(namespace))
+        pred = self._selector_pred(resource, label_selector, field_selector)
+        items = [o for o in items if pred(o)]
+        return {
+            "kind": info.kind + "List",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": str(version)},
+            "items": items,
+        }
+
+    def _selector_pred(self, resource: str, label_selector: str, field_selector: str):
+        lsel = labelpkg.parse(label_selector)
+        fsel = labelpkg.parse_fields(field_selector)
+        if lsel.empty() and fsel.empty():
+            return lambda o: True
+
+        def pred(o: dict) -> bool:
+            if not lsel.empty():
+                if not lsel.matches(o.get("metadata", {}).get("labels", {})):
+                    return False
+            if not fsel.empty():
+                if not fsel.matches(fields_for(resource, o)):
+                    return False
+            return True
+
+        return pred
+
+    def update(self, resource: str, namespace: str, name: str, obj: dict) -> dict:
+        info = self._info(resource)
+        meta = obj.setdefault("metadata", {})
+        if meta.get("name") and meta["name"] != name:
+            raise _bad_request(f"name mismatch: body {meta['name']!r} vs url {name!r}")
+        meta["name"] = name
+        namespace = self._ns(info, namespace)
+        if info.namespaced:
+            meta.setdefault("namespace", namespace)
+        key = info.key(namespace, name)
+        try:
+            current = self.store.get(key)
+        except NotFoundError:
+            raise _not_found(info.name, name)
+        # Immutable server-side fields carry over.
+        meta["uid"] = current["metadata"].get("uid", "")
+        meta["creationTimestamp"] = current["metadata"].get("creationTimestamp", "")
+        expected = None
+        if meta.get("resourceVersion"):
+            try:
+                expected = int(meta["resourceVersion"])
+            except ValueError:
+                raise _bad_request(
+                    f"invalid resourceVersion {meta['resourceVersion']!r}"
+                )
+        self._validate(info, obj)
+        try:
+            return self.store.set(key, obj, expected_version=expected)
+        except ConflictError as e:
+            raise _conflict(str(e))
+        except NotFoundError:
+            raise _not_found(info.name, name)
+
+    def update_status(self, resource: str, namespace: str, name: str, obj: dict) -> dict:
+        """Status subresource: replace only .status (pkg/registry/pod/etcd
+        StatusREST)."""
+        info = self._info(resource)
+        key = info.key(self._ns(info, namespace), name)
+        new_status = obj.get("status", {})
+
+        def apply(cur: dict) -> dict:
+            cur["status"] = new_status
+            return cur
+
+        try:
+            return self.store.guaranteed_update(key, apply)
+        except NotFoundError:
+            raise _not_found(info.name, name)
+
+    def delete(self, resource: str, namespace: str, name: str) -> dict:
+        info = self._info(resource)
+        try:
+            self.store.delete(info.key(self._ns(info, namespace), name))
+        except NotFoundError:
+            raise _not_found(info.name, name)
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Success",
+            "code": 200,
+        }
+
+    def watch(
+        self,
+        resource: str,
+        namespace: str = "",
+        since: int = 0,
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> _FilteredStream:
+        info = self._info(resource)
+        try:
+            inner = self.store.watch(info.prefix(namespace), since=since)
+        except Exception as e:  # CompactedError -> 410 Gone
+            raise APIError(410, "Expired", str(e))
+        return _FilteredStream(
+            inner, self._selector_pred(resource, label_selector, field_selector)
+        )
+
+    # -- bindings (the scheduler's commit path) ------------------------
+
+    def bind(self, namespace: str, binding: dict) -> dict:
+        """POST /bindings: set pod.spec.nodeName iff currently empty.
+
+        Reference: BindingREST.Create -> assignPod -> GuaranteedUpdate
+        with the emptiness guard (pkg/registry/pod/etcd/etcd.go:123-181).
+        """
+        pod_name = binding.get("metadata", {}).get("name", "")
+        target = binding.get("target", {})
+        node_name = target.get("name", "")
+        if not pod_name or not node_name:
+            raise _bad_request("binding requires metadata.name and target.name")
+        if target.get("kind", "") not in ("", "Node", "Minion"):
+            raise _bad_request(f"cannot bind to {target.get('kind')!r}")
+        key = RESOURCES["pods"].key(namespace or "default", pod_name)
+
+        def assign(cur: dict) -> dict:
+            spec = cur.setdefault("spec", {})
+            if spec.get("nodeName"):
+                raise _conflict(
+                    f'pod "{pod_name}" is already assigned to node '
+                    f'"{spec["nodeName"]}"'
+                )
+            spec["nodeName"] = node_name
+            return cur
+
+        try:
+            self.store.guaranteed_update(key, assign)
+        except NotFoundError:
+            raise _not_found("pods", pod_name)
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Success",
+            "code": 201,
+        }

@@ -1,0 +1,318 @@
+"""HTTP transport for the API server.
+
+Reference: route installation in pkg/apiserver/api_installer.go:268-284
+and the chunked-JSON watch server (pkg/apiserver/watch.go:45-102).
+
+Routes (all under /api/v1):
+    GET|POST   /{resource}                          cluster-scoped or all-ns
+    GET|PUT|DELETE /{resource}/{name}               cluster-scoped
+    GET|POST   /namespaces/{ns}/{resource}
+    GET|PUT|DELETE /namespaces/{ns}/{resource}/{name}
+    PUT        /namespaces/{ns}/{resource}/{name}/status
+    POST       /namespaces/{ns}/bindings
+    POST       /namespaces/{ns}/pods/{name}/binding
+    GET        /watch/{resource}            (+ /watch/namespaces/{ns}/{resource})
+Plus /healthz, /metrics, /version, /api.
+
+Watch responses are chunked newline-delimited JSON frames
+{"type": ..., "object": ...} — same wire shape as the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu import __version__
+from kubernetes_tpu.server.api import APIError, APIServer
+from kubernetes_tpu.server.registry import RESOURCES
+from kubernetes_tpu.utils import metrics
+
+_REQS = metrics.DEFAULT.counter(
+    "apiserver_request_count", "API requests by verb/resource/code",
+    ("verb", "resource", "code"),
+)
+_LATENCY = metrics.DEFAULT.summary(
+    "apiserver_request_latencies_seconds", "API request latency",
+    ("verb", "resource"),
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-apiserver"
+    api: APIServer  # set by serve()
+
+    # Silence default stderr logging; metrics carry the signal.
+    def log_message(self, fmt, *args):  # noqa: N802
+        pass
+
+    # -- plumbing -----------------------------------------------------
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length == 0:
+            return {}
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise APIError(400, "BadRequest", f"invalid JSON body: {e}")
+
+    def _route(self) -> Tuple[str, ...]:
+        parsed = urlparse(self.path)
+        self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        return tuple(s for s in parsed.path.split("/") if s)
+
+    # -- verbs --------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_PUT(self):  # noqa: N802
+        self._dispatch("PUT")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    def _dispatch(self, verb: str) -> None:
+        start = time.monotonic()
+        resource = ""
+        code = 200
+        try:
+            parts = self._route()
+            if parts == ("healthz",):
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if parts == ("metrics",):
+                body = metrics.DEFAULT.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if parts == ("version",):
+                self._send_json(200, {"gitVersion": __version__, "platform": "tpu"})
+                return
+            if parts == ("api",):
+                self._send_json(200, {"kind": "APIVersions", "versions": ["v1"]})
+                return
+            if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+                raise APIError(404, "NotFound", f"unknown path {self.path!r}")
+            rest = parts[2:]
+            resource, code = self._api_v1(verb, rest)
+        except APIError as e:
+            code = e.code
+            self._send_json(e.code, e.to_status())
+        except (BrokenPipeError, ConnectionResetError):
+            code = 499
+        except Exception as e:  # pragma: no cover - crash containment
+            code = 500
+            try:
+                self._send_json(
+                    500,
+                    {
+                        "kind": "Status",
+                        "status": "Failure",
+                        "reason": "InternalError",
+                        "message": str(e),
+                        "code": 500,
+                    },
+                )
+            except Exception:
+                pass
+        finally:
+            _REQS.inc(verb=verb, resource=resource, code=str(code))
+            _LATENCY.observe(time.monotonic() - start, verb=verb, resource=resource)
+
+    # -- /api/v1 router ----------------------------------------------
+
+    def _api_v1(self, verb: str, rest: Tuple[str, ...]) -> Tuple[str, int]:
+        api = self.api
+        q = self.query
+        lsel = q.get("labelSelector", "")
+        fsel = q.get("fieldSelector", "")
+
+        if not rest:
+            self._send_json(
+                200,
+                {
+                    "kind": "APIResourceList",
+                    "resources": sorted(
+                        {i.name for i in RESOURCES.values()}
+                    ),
+                },
+            )
+            return "", 200
+
+        # Watch endpoints: /watch/{resource} or /watch/namespaces/{ns}/{resource}
+        if rest[0] == "watch":
+            wrest = rest[1:]
+            if len(wrest) == 1:
+                resource, ns = wrest[0], ""
+            elif len(wrest) == 3 and wrest[0] == "namespaces":
+                resource, ns = wrest[2], wrest[1]
+            else:
+                raise APIError(404, "NotFound", f"bad watch path {self.path!r}")
+            self._serve_watch(resource, ns, lsel, fsel, q)
+            return resource, 200
+
+        # Namespaced paths.
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            ns = rest[1]
+            resource = rest[2]
+            if resource == "bindings" and verb == "POST":
+                out = api.bind(ns, self._read_body())
+                self._send_json(201, out)
+                return "bindings", 201
+            if len(rest) == 3:
+                return self._collection(verb, resource, ns, lsel, fsel)
+            name = rest[3]
+            if len(rest) == 5 and rest[4] == "binding" and verb == "POST":
+                body = self._read_body()
+                body.setdefault("metadata", {})["name"] = name
+                out = api.bind(ns, body)
+                self._send_json(201, out)
+                return "bindings", 201
+            if len(rest) == 5 and rest[4] == "status" and verb == "PUT":
+                out = api.update_status(resource, ns, name, self._read_body())
+                self._send_json(200, out)
+                return resource, 200
+            if len(rest) == 4:
+                return self._item(verb, resource, ns, name)
+            raise APIError(404, "NotFound", f"unknown path {self.path!r}")
+
+        # Cluster-scoped or cross-namespace.
+        resource = rest[0]
+        info = RESOURCES.get(resource)
+        if info is None:
+            raise APIError(404, "NotFound", f"unknown resource {resource!r}")
+        if len(rest) == 1:
+            return self._collection(verb, resource, "", lsel, fsel)
+        if len(rest) == 2:
+            if info.namespaced:
+                raise APIError(
+                    400, "BadRequest", f"{resource} is namespaced; use /namespaces/.."
+                )
+            return self._item(verb, resource, "", rest[1])
+        raise APIError(404, "NotFound", f"unknown path {self.path!r}")
+
+    def _collection(self, verb, resource, ns, lsel, fsel) -> Tuple[str, int]:
+        api = self.api
+        if verb == "GET":
+            if self.query.get("watch") in ("true", "1"):
+                self._serve_watch(resource, ns, lsel, fsel, self.query)
+                return resource, 200
+            self._send_json(200, api.list(resource, ns, lsel, fsel))
+            return resource, 200
+        if verb == "POST":
+            out = api.create(resource, ns, self._read_body())
+            self._send_json(201, out)
+            return resource, 201
+        raise APIError(405, "MethodNotAllowed", f"{verb} not allowed on collection")
+
+    def _item(self, verb, resource, ns, name) -> Tuple[str, int]:
+        api = self.api
+        if verb == "GET":
+            self._send_json(200, api.get(resource, ns, name))
+        elif verb == "PUT":
+            self._send_json(200, api.update(resource, ns, name, self._read_body()))
+        elif verb == "DELETE":
+            self._send_json(200, api.delete(resource, ns, name))
+        else:
+            raise APIError(405, "MethodNotAllowed", f"{verb} not allowed on item")
+        return resource, 200
+
+    def _serve_watch(self, resource, ns, lsel, fsel, q) -> None:
+        try:
+            since = int(q.get("resourceVersion", "0") or "0")
+            timeout = float(q.get("timeoutSeconds", "0") or "0") or None
+        except ValueError:
+            raise APIError(
+                400, "BadRequest",
+                "resourceVersion/timeoutSeconds must be numeric",
+            )
+        stream = self.api.watch(
+            resource, ns, since=since, label_selector=lsel, field_selector=fsel
+        )
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while True:
+                wait = 1.0
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        break
+                ev = stream.next(timeout=wait)
+                if ev is None:
+                    if stream.closed:
+                        break
+                    continue
+                frame = json.dumps({"type": ev.type, "object": ev.object}).encode()
+                frame += b"\n"
+                self.wfile.write(b"%x\r\n" % len(frame) + frame + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, socket.timeout):
+            pass
+        finally:
+            stream.close()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
+            self.close_connection = True
+
+
+class APIHTTPServer:
+    """Owns the listening socket + serving thread."""
+
+    def __init__(self, api: APIServer, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"api": api})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.api = api
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIHTTPServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

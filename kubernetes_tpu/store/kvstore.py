@@ -1,0 +1,241 @@
+"""The versioned KV store.
+
+Semantics mirror the reference's etcd usage through EtcdHelper
+(pkg/tools/etcd_helper.go):
+
+- A single global, monotonically increasing logical clock. Every write
+  bumps it; every object carries the version of its last write in
+  metadata.resourceVersion (pkg/tools/etcd_object.go).
+- Create fails if the key exists (AlreadyExists); CompareAndSwap update
+  fails on version mismatch (Conflict); `guaranteed_update` is the CAS
+  retry loop of EtcdHelper.GuaranteedUpdate (etcd_helper.go:510-600).
+- Watch(prefix, since) replays buffered history after `since`, then
+  streams live events in version order (etcd_helper_watch.go:73-165).
+  Asking for a version older than the history window raises
+  CompactedError (clients must re-list, like etcd index cleared errors).
+- Values are wire-form dicts (deep-copied on the way in and out), so
+  storage is serialization-faithful like etcd's JSON payloads.
+- Optional per-key TTL (events registry uses it, reference: event TTL).
+
+Thread-safe; many reader/writer threads, one lock (control-plane rates
+are tiny next to the TPU solver's work).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.store.watch import ADDED, DELETED, Event, MODIFIED, WatchStream
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFoundError(StoreError):
+    pass
+
+
+class AlreadyExistsError(StoreError):
+    pass
+
+
+class ConflictError(StoreError):
+    pass
+
+
+class CompactedError(StoreError):
+    """Watch window no longer covers the requested version."""
+
+
+class KVStore:
+    def __init__(self, history_limit: int = 10000):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Tuple[dict, int]] = {}  # key -> (wire obj, version)
+        self._ttl: Dict[str, float] = {}  # key -> expiry monotonic time
+        self._version = 0
+        # History ring for watch replay: (version, type, key, obj).
+        self._history: deque = deque(maxlen=history_limit)
+        self._oldest = 0  # lowest version NOT compacted out of history
+        self._watchers: List[Tuple[str, WatchStream]] = []  # (prefix, stream)
+
+    # -- version plumbing ---------------------------------------------
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def _bump(self) -> int:
+        self._version += 1
+        return self._version
+
+    @staticmethod
+    def _stamp(obj: dict, version: int) -> dict:
+        obj.setdefault("metadata", {})["resourceVersion"] = str(version)
+        return obj
+
+    def _expire_locked(self) -> None:
+        if not self._ttl:
+            return
+        now = time.monotonic()
+        expired = [k for k, t in self._ttl.items() if t <= now]
+        for k in expired:
+            del self._ttl[k]
+            if k in self._data:
+                obj, _ = self._data.pop(k)
+                v = self._bump()
+                self._record(v, DELETED, k, obj)
+
+    def _record(self, version: int, etype: str, key: str, obj: dict) -> None:
+        # History and watch consumers get their own copies: stored state
+        # must never be reachable (hence mutable) through an event.
+        obj = copy.deepcopy(obj)
+        if not self._history:
+            self._oldest = version
+        self._history.append((version, etype, key, obj))
+        if len(self._history) == self._history.maxlen:
+            self._oldest = self._history[0][0]
+        live = []
+        for prefix, stream in self._watchers:
+            if stream.closed:
+                continue  # prune dead watchers as we go
+            if key.startswith(prefix):
+                stream.push(Event(etype, copy.deepcopy(obj), version))
+            if not stream.closed:
+                live.append((prefix, stream))
+        self._watchers = live
+
+    # -- CRUD ---------------------------------------------------------
+
+    def create(self, key: str, obj: dict, ttl: Optional[float] = None) -> dict:
+        with self._lock:
+            self._expire_locked()
+            if key in self._data:
+                raise AlreadyExistsError(key)
+            obj = copy.deepcopy(obj)
+            v = self._bump()
+            self._stamp(obj, v)
+            self._data[key] = (obj, v)
+            if ttl is not None:
+                self._ttl[key] = time.monotonic() + ttl
+            self._record(v, ADDED, key, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, key: str) -> dict:
+        with self._lock:
+            self._expire_locked()
+            if key not in self._data:
+                raise NotFoundError(key)
+            return copy.deepcopy(self._data[key][0])
+
+    def set(
+        self, key: str, obj: dict, expected_version: Optional[int] = None
+    ) -> dict:
+        """Update; CAS when expected_version is given (etcd CompareAndSwap)."""
+        with self._lock:
+            self._expire_locked()
+            if key not in self._data:
+                raise NotFoundError(key)
+            _, cur_v = self._data[key]
+            if expected_version is not None and cur_v != expected_version:
+                raise ConflictError(
+                    f"{key}: version {expected_version} != current {cur_v}"
+                )
+            obj = copy.deepcopy(obj)
+            v = self._bump()
+            self._stamp(obj, v)
+            self._data[key] = (obj, v)
+            self._record(v, MODIFIED, key, obj)
+            return copy.deepcopy(obj)
+
+    def delete(self, key: str, expected_version: Optional[int] = None) -> dict:
+        with self._lock:
+            self._expire_locked()
+            if key not in self._data:
+                raise NotFoundError(key)
+            obj, cur_v = self._data[key]
+            if expected_version is not None and cur_v != expected_version:
+                raise ConflictError(
+                    f"{key}: version {expected_version} != current {cur_v}"
+                )
+            del self._data[key]
+            self._ttl.pop(key, None)
+            v = self._bump()
+            self._record(v, DELETED, key, obj)
+            return copy.deepcopy(obj)
+
+    def list(self, prefix: str) -> Tuple[List[dict], int]:
+        """All objects under prefix + the store version (for watch resume)."""
+        with self._lock:
+            self._expire_locked()
+            out = [
+                copy.deepcopy(obj)
+                for key, (obj, _) in sorted(self._data.items())
+                if key.startswith(prefix)
+            ]
+            return out, self._version
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            self._expire_locked()
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    # -- GuaranteedUpdate (etcd_helper.go:510-600) ---------------------
+
+    def guaranteed_update(
+        self, key: str, update_fn: Callable[[dict], dict], max_retries: int = 16
+    ) -> dict:
+        """Read-modify-write with CAS retry. update_fn gets a private copy
+        and returns the new object (or raises to abort)."""
+        for _ in range(max_retries):
+            with self._lock:
+                self._expire_locked()
+                if key not in self._data:
+                    raise NotFoundError(key)
+                cur, cur_v = self._data[key]
+                cur = copy.deepcopy(cur)
+            new = update_fn(cur)
+            try:
+                return self.set(key, new, expected_version=cur_v)
+            except ConflictError:
+                continue
+        raise ConflictError(f"{key}: too many CAS retries")
+
+    # -- Watch --------------------------------------------------------
+
+    def watch(self, prefix: str, since: int = 0, maxsize: int = 4096) -> WatchStream:
+        """Stream events for keys under prefix with version > since.
+
+        since=0 means "from now". History older than the replay buffer
+        raises CompactedError — caller must re-list (Reflector does).
+        """
+        with self._lock:
+            self._expire_locked()
+            if since and self._history and since + 1 < self._oldest:
+                raise CompactedError(
+                    f"version {since} compacted (oldest {self._oldest})"
+                )
+            stream = WatchStream(maxsize=maxsize)
+            self._watchers = [(p, s) for p, s in self._watchers if not s.closed]
+            self._watchers.append((prefix, stream))
+            if since:
+                for v, etype, key, obj in self._history:
+                    if v > since and key.startswith(prefix):
+                        stream.push(Event(etype, copy.deepcopy(obj), v))
+            return stream
+
+    def stop_watch(self, stream: WatchStream) -> None:
+        stream.close()
+        with self._lock:
+            self._watchers = [(p, s) for p, s in self._watchers if not s.closed]
+
+    def close(self) -> None:
+        with self._lock:
+            for _, s in self._watchers:
+                s.close()
+            self._watchers = []

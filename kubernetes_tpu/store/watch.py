@@ -1,0 +1,110 @@
+"""Watch events and streams.
+
+Behavioral parity with pkg/watch/ (Event{Added,Modified,Deleted,Error},
+watch.Interface) and the etcd->watch translation in
+pkg/tools/etcd_helper_watch.go. A WatchStream is a bounded queue the
+store pushes into; consumers iterate or poll with timeouts.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterator, List, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Event:
+    type: str
+    object: Any  # wire-form dict (or Status dict for ERROR)
+    version: int = 0  # store logical clock at event time
+
+    @property
+    def key(self) -> str:
+        meta = self.object.get("metadata", {}) if isinstance(self.object, dict) else {}
+        ns = meta.get("namespace", "")
+        return f"{ns}/{meta.get('name', '')}" if ns else meta.get("name", "")
+
+
+class WatchStream:
+    """One consumer's view of a watch. Closed by either side."""
+
+    def __init__(self, maxsize: int = 4096):
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize)
+        self._closed = threading.Event()
+
+    def push(self, ev: Event) -> bool:
+        if self._closed.is_set():
+            return False
+        try:
+            self._q.put_nowait(ev)
+            return True
+        except queue.Full:
+            # Slow consumer: drop the stream (reference watchers are also
+            # terminated and must re-list; Reflector handles that).
+            self.close()
+            return False
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Next event, or None on close/timeout."""
+        if self._closed.is_set() and self._q.empty():
+            return None
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return ev
+
+    def close(self) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            try:
+                self._q.put_nowait(None)  # wake blocked consumers
+            except queue.Full:
+                pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            ev = self.next()
+            if ev is None:
+                return
+            yield ev
+
+
+class Broadcaster:
+    """Fan-out of events to many streams (reference: pkg/watch/mux.go)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams: List[WatchStream] = []
+
+    def watch(self, maxsize: int = 4096) -> WatchStream:
+        s = WatchStream(maxsize=maxsize)
+        with self._lock:
+            self._streams.append(s)
+        return s
+
+    def action(self, ev: Event) -> None:
+        with self._lock:
+            live = []
+            for s in self._streams:
+                if s.push(ev) or not s.closed:
+                    if not s.closed:
+                        live.append(s)
+            self._streams = live
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._streams:
+                s.close()
+            self._streams = []

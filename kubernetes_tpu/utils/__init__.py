@@ -1,0 +1,1 @@
+"""Shared utilities: metrics, rate limiting, backoff, loops."""

@@ -1,0 +1,166 @@
+"""Prometheus-compatible metrics.
+
+Reference: prometheus client usage across daemons — scheduler
+(plugin/pkg/scheduler/metrics/metrics.go), apiserver
+(pkg/apiserver/metrics.go), kubelet (pkg/kubelet/metrics/metrics.go).
+Counters, gauges, and summaries with label sets, rendered in the
+Prometheus text exposition format at /metrics.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(labels.get(k, "") for k in self.label_names)
+
+    @staticmethod
+    def _fmt_labels(names, values) -> str:
+        if not names:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in zip(names, values))
+        return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{self._fmt_labels(self.label_names, k)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{self._fmt_labels(self.label_names, k)} {v}")
+        return out
+
+
+class Summary(_Metric):
+    """Windowless summary: running count/sum + streaming quantile estimate
+    over a bounded reservoir (good enough for SLO checks; the reference
+    uses client_golang summaries with decay)."""
+
+    RESERVOIR = 1024
+
+    def __init__(self, name, help_, label_names=(), quantiles=(0.5, 0.9, 0.99)):
+        super().__init__(name, help_, label_names)
+        self.quantiles = quantiles
+        self._stats: Dict[Tuple[str, ...], Dict] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            s = self._stats.setdefault(k, {"count": 0, "sum": 0.0, "res": []})
+            s["count"] += 1
+            s["sum"] += value
+            res = s["res"]
+            if len(res) < self.RESERVOIR:
+                res.append(value)
+            else:
+                # Reservoir sampling keeps the estimate unbiased.
+                import random
+
+                i = random.randrange(s["count"])
+                if i < self.RESERVOIR:
+                    res[i] = value
+
+    def quantile(self, q: float, **labels) -> float:
+        with self._lock:
+            s = self._stats.get(self._key(labels))
+            if not s or not s["res"]:
+                return math.nan
+            xs = sorted(s["res"])
+            idx = min(len(xs) - 1, max(0, int(math.ceil(q * len(xs))) - 1))
+            return xs[idx]
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
+        with self._lock:
+            for k, s in sorted(self._stats.items()):
+                xs = sorted(s["res"])
+                for q in self.quantiles:
+                    if xs:
+                        idx = min(len(xs) - 1, max(0, int(math.ceil(q * len(xs))) - 1))
+                        val = xs[idx]
+                    else:
+                        val = math.nan
+                    names = self.label_names + ("quantile",)
+                    values = k + (str(q),)
+                    out.append(f"{self.name}{self._fmt_labels(names, values)} {val}")
+                out.append(
+                    f"{self.name}_sum{self._fmt_labels(self.label_names, k)} {s['sum']}"
+                )
+                out.append(
+                    f"{self.name}_count{self._fmt_labels(self.label_names, k)} {s['count']}"
+                )
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            return self._metrics.setdefault(metric.name, metric)
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self.register(Counter(name, help_, labels))  # type: ignore
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))  # type: ignore
+
+    def summary(self, name, help_="", labels=()) -> Summary:
+        return self.register(Summary(name, help_, labels))  # type: ignore
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT = Registry()

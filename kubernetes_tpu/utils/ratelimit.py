@@ -1,0 +1,77 @@
+"""Token-bucket rate limiter + exponential backoff.
+
+Reference: pkg/util/throttle.go (RateLimiter) used for binding QPS
+(factory.go:43-46) and client QPS; per-key exponential backoff mirrors
+the scheduler's podBackoff (factory.go:334-378).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class TokenBucket:
+    def __init__(self, qps: float, burst: int):
+        if qps <= 0:
+            raise ValueError("qps must be positive")
+        self.qps = qps
+        self.burst = max(1, burst)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+        self._last = now
+
+    def try_accept(self) -> bool:
+        with self._lock:
+            self._refill()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def accept(self) -> None:
+        """Block until a token is available (reference: RateLimiter.Accept)."""
+        while True:
+            with self._lock:
+                self._refill()
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
+class Backoff:
+    """Per-key exponential backoff (reference: podBackoff,
+    factory.go:334-378 — 1s initial, 60s max, halved-life garbage
+    collection handled by expire())."""
+
+    def __init__(self, initial: float = 1.0, max_backoff: float = 60.0):
+        self.initial = initial
+        self.max = max_backoff
+        self._lock = threading.Lock()
+        self._entries: Dict[str, tuple] = {}  # key -> (duration, last_update)
+
+    def duration(self, key: str) -> float:
+        """Current duration for key, doubling it for next time."""
+        with self._lock:
+            dur, _ = self._entries.get(key, (self.initial, 0.0))
+            self._entries[key] = (min(dur * 2, self.max), time.monotonic())
+            return dur
+
+    def reset(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def expire(self, older_than: float = 120.0) -> None:
+        cutoff = time.monotonic() - older_than
+        with self._lock:
+            self._entries = {
+                k: v for k, v in self._entries.items() if v[1] >= cutoff
+            }

@@ -1,0 +1,257 @@
+"""API server tests over both transports (reference: pkg/apiserver/,
+pkg/registry/pod/etcd/etcd_test.go binding tests)."""
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.server import APIError, APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+def pod_wire(name, ns="default", node="", labels=None):
+    return {
+        "kind": "Pod",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {
+            "containers": [{"name": "c", "image": "nginx"}],
+            **({"nodeName": node} if node else {}),
+        },
+    }
+
+
+def node_wire(name):
+    return {
+        "kind": "Node",
+        "apiVersion": "v1",
+        "metadata": {"name": name},
+        "status": {"capacity": {"cpu": "4", "memory": "8Gi"}},
+    }
+
+
+@pytest.fixture(params=["local", "http"])
+def client(request):
+    api = APIServer()
+    if request.param == "local":
+        yield Client(LocalTransport(api))
+    else:
+        server = APIHTTPServer(api).start()
+        yield Client(HTTPTransport(server.address))
+        server.stop()
+
+
+class TestCRUD:
+    def test_create_get_defaults(self, client):
+        created = client.create("pods", pod_wire("p1"))
+        assert created.metadata.uid
+        assert created.metadata.creation_timestamp
+        assert created.metadata.resource_version
+        got = client.get("pods", "p1", namespace="default")
+        assert got.metadata.name == "p1"
+
+    def test_create_duplicate_conflict(self, client):
+        client.create("pods", pod_wire("p1"))
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod_wire("p1"))
+        assert e.value.code == 409
+
+    def test_create_invalid_422(self, client):
+        bad = pod_wire("p1")
+        bad["spec"]["containers"] = []
+        with pytest.raises(APIError) as e:
+            client.create("pods", bad)
+        assert e.value.code == 422
+
+    def test_get_missing_404(self, client):
+        with pytest.raises(APIError) as e:
+            client.get("pods", "nope", namespace="default")
+        assert e.value.code == 404
+
+    def test_list_with_selectors(self, client):
+        client.create("pods", pod_wire("a", labels={"app": "web"}))
+        client.create("pods", pod_wire("b", labels={"app": "db"}))
+        client.create("pods", pod_wire("c", labels={"app": "web"}, node="n1"))
+        items, version = client.list("pods", namespace="default")
+        assert {p.metadata.name for p in items} == {"a", "b", "c"}
+        assert version > 0
+        items, _ = client.list("pods", namespace="default", label_selector="app=web")
+        assert {p.metadata.name for p in items} == {"a", "c"}
+        items, _ = client.list(
+            "pods", namespace="default", field_selector="spec.nodeName="
+        )
+        assert {p.metadata.name for p in items} == {"a", "b"}
+
+    def test_update_and_cas(self, client):
+        client.create("pods", pod_wire("p1"))
+        got = client.get("pods", "p1", namespace="default")
+        got.metadata.labels = {"v": "2"}
+        updated = client.update("pods", got, namespace="default")
+        assert updated.metadata.labels == {"v": "2"}
+        # Stale resourceVersion -> 409.
+        got.metadata.labels = {"v": "3"}
+        with pytest.raises(APIError) as e:
+            client.update("pods", got, namespace="default")
+        assert e.value.code == 409
+
+    def test_delete(self, client):
+        client.create("pods", pod_wire("p1"))
+        client.delete("pods", "p1", namespace="default")
+        with pytest.raises(APIError):
+            client.get("pods", "p1", namespace="default")
+
+    def test_cluster_scoped_nodes(self, client):
+        client.create("nodes", node_wire("n1"))
+        got = client.get("nodes", "n1")
+        assert got.status.capacity["cpu"].milli_value() == 4000
+        items, _ = client.list("nodes")
+        assert [n.metadata.name for n in items] == ["n1"]
+
+    def test_update_status_preserves_spec(self, client):
+        client.create("pods", pod_wire("p1"))
+        got = client.get("pods", "p1", namespace="default")
+        got.status.phase = "Running"
+        out = client.update_status("pods", got, namespace="default")
+        assert out.status.phase == "Running"
+        assert out.spec.containers[0].image == "nginx"
+
+
+class TestBinding:
+    def test_bind_sets_node_name(self, client):
+        client.create("pods", pod_wire("p1"))
+        client.bind("p1", "n1", namespace="default")
+        got = client.get("pods", "p1", namespace="default")
+        assert got.spec.node_name == "n1"
+
+    def test_bind_twice_conflict(self, client):
+        """The guarded write: nodeName set iff empty
+        (pkg/registry/pod/etcd/etcd.go:140-167)."""
+        client.create("pods", pod_wire("p1"))
+        client.bind("p1", "n1", namespace="default")
+        with pytest.raises(APIError) as e:
+            client.bind("p1", "n2", namespace="default")
+        assert e.value.code == 409
+        assert client.get("pods", "p1", namespace="default").spec.node_name == "n1"
+
+    def test_bind_missing_pod(self, client):
+        with pytest.raises(APIError) as e:
+            client.bind("ghost", "n1", namespace="default")
+        assert e.value.code == 404
+
+
+class TestWatch:
+    def test_watch_stream(self, client):
+        items, version = client.list("pods", namespace="default")
+        stream = client.watch("pods", namespace="default", since=version)
+        client.create("pods", pod_wire("w1"))
+        client.bind("w1", "n1", namespace="default")
+        ev1 = stream.next(timeout=2)
+        ev2 = stream.next(timeout=2)
+        assert ev1.type == "ADDED" and ev1.object["metadata"]["name"] == "w1"
+        assert ev2.type == "MODIFIED"
+        assert ev2.object["spec"]["nodeName"] == "n1"
+        stream.close()
+
+    def test_watch_field_selector_unassigned(self, client):
+        """The scheduler's unassigned-pod watch (factory.go:226)."""
+        _, version = client.list("pods", namespace="default")
+        stream = client.watch(
+            "pods", namespace="default", since=version, field_selector="spec.nodeName="
+        )
+        client.create("pods", pod_wire("u1"))
+        client.create("pods", pod_wire("a1", node="n1"))
+        ev = stream.next(timeout=2)
+        assert ev.object["metadata"]["name"] == "u1"
+        ev = stream.next(timeout=0.3)
+        assert ev is None  # assigned pod filtered out
+        stream.close()
+
+
+def test_events_ttl_resource():
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    client.record_event(pod_wire("p1"), "Scheduled", "ok", source="test")
+    items, _ = client.list("events", namespace="default")
+    assert len(items) == 1
+    assert items[0].reason == "Scheduled"
+
+
+def test_healthz_metrics_version():
+    import json
+    import urllib.request
+
+    api = APIServer()
+    server = APIHTTPServer(api).start()
+    try:
+        base = server.address
+        assert urllib.request.urlopen(base + "/healthz").read() == b"ok"
+        v = json.loads(urllib.request.urlopen(base + "/version").read())
+        assert v["platform"] == "tpu"
+        # Generate one request then check it shows up in metrics.
+        Client(HTTPTransport(base)).create("pods", pod_wire("m1"))
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "apiserver_request_count" in text
+    finally:
+        server.stop()
+
+
+class TestRegressionsFromReview:
+    def test_default_namespace_symmetry(self):
+        """create with empty ns must be reachable via get/update/delete
+        with empty ns."""
+        api = APIServer()
+        c = Client(LocalTransport(api))
+        c.create("pods", pod_wire("p1", ns=""))
+        got = c.get("pods", "p1")
+        assert got.metadata.namespace == "default"
+        got.metadata.labels = {"a": "b"}
+        c.update("pods", got)
+        c.update_status("pods", got)
+        c.delete("pods", "p1")
+
+    def test_watch_event_mutation_does_not_corrupt_store(self):
+        api = APIServer()
+        c = Client(LocalTransport(api))
+        c.create("pods", pod_wire("p1"))
+        w = api.watch("pods", "default")
+        c.bind("p1", "n1", namespace="default")
+        ev = w.next(timeout=1)
+        ev.object["spec"]["nodeName"] = "CORRUPTED"
+        assert api.get("pods", "default", "p1")["spec"]["nodeName"] == "n1"
+        w.close()
+
+    def test_closed_watchers_pruned(self):
+        api = APIServer()
+        base = len(api.store._watchers)
+        for _ in range(5):
+            api.watch("pods", "default").close()
+        api.store.create("/prune-trigger", {"metadata": {"name": "x"}})
+        assert len(api.store._watchers) == base
+
+    def test_watch_bad_resource_version_400(self):
+        import urllib.error
+        import urllib.request
+
+        api = APIServer()
+        server = APIHTTPServer(api).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    server.address + "/api/v1/watch/pods?resourceVersion=abc"
+                )
+            assert e.value.code == 400
+        finally:
+            server.stop()
+
+    def test_node_capacity_rounds_down(self):
+        from kubernetes_tpu.models.columnar import build_snapshot
+        from kubernetes_tpu.models.objects import Node, NodeStatus, ObjectMeta
+        from kubernetes_tpu.models.quantity import parse_quantity
+
+        node = Node(
+            metadata=ObjectMeta(name="n"),
+            status=NodeStatus(
+                capacity={"memory": parse_quantity("100.5Mi"), "cpu": parse_quantity("1")}
+            ),
+        )
+        snap = build_snapshot([], [node])
+        assert snap.nodes.mem_cap[0] == 100  # floor, not ceil

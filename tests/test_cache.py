@@ -1,0 +1,124 @@
+"""Cache substrate tests: FIFO, Reflector, Informer (reference:
+pkg/client/cache/fifo_test.go, reflector_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, FIFO, Informer, LocalTransport, Reflector
+from kubernetes_tpu.client.cache import ThreadSafeStore
+from kubernetes_tpu.server import APIServer
+
+
+def pod_wire(name, ns="default", node=""):
+    return {
+        "kind": "Pod",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "containers": [{"name": "c", "image": "nginx"}],
+            **({"nodeName": node} if node else {}),
+        },
+    }
+
+
+class TestFIFO:
+    def test_dedup_returns_latest(self):
+        f = FIFO()
+        f.add({"metadata": {"name": "a", "namespace": "ns"}, "v": 1})
+        f.add({"metadata": {"name": "a", "namespace": "ns"}, "v": 2})
+        f.add({"metadata": {"name": "b", "namespace": "ns"}, "v": 1})
+        assert f.pop()["v"] == 2
+        assert f.pop()["metadata"]["name"] == "b"
+        assert f.pop(timeout=0.05) is None
+
+    def test_blocking_pop(self):
+        f = FIFO()
+        out = []
+
+        def consumer():
+            out.append(f.pop(timeout=2))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        f.add({"metadata": {"name": "x", "namespace": "ns"}})
+        t.join()
+        assert out[0]["metadata"]["name"] == "x"
+
+    def test_delete_skipped(self):
+        f = FIFO()
+        f.add({"metadata": {"name": "a", "namespace": "ns"}})
+        f.delete({"metadata": {"name": "a", "namespace": "ns"}})
+        assert f.pop(timeout=0.05) is None
+
+
+class TestReflector:
+    def test_list_then_watch(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create("pods", pod_wire("pre"))
+        store = ThreadSafeStore()
+        r = Reflector(client, "pods", store, namespace="default").start()
+        try:
+            assert r.wait_for_sync()
+            assert store.get("default/pre") is not None
+            client.create("pods", pod_wire("live"))
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and len(store) < 2:
+                time.sleep(0.01)
+            assert {k for k in store.keys()} == {"default/pre", "default/live"}
+            client.delete("pods", "pre", namespace="default")
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and len(store) > 1:
+                time.sleep(0.01)
+            assert store.keys() == ["default/live"]
+        finally:
+            r.stop()
+
+    def test_field_selector_feed_into_fifo(self):
+        """The scheduler's unassigned-pod FIFO (factory.go:180-215)."""
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        fifo = FIFO()
+        r = Reflector(
+            client, "pods", fifo, namespace="", field_selector="spec.nodeName="
+        ).start()
+        try:
+            assert r.wait_for_sync()
+            client.create("pods", pod_wire("unassigned"))
+            client.create("pods", pod_wire("assigned", node="n1"))
+            got = fifo.pop(timeout=2)
+            assert got["metadata"]["name"] == "unassigned"
+            assert fifo.pop(timeout=0.2) is None
+        finally:
+            r.stop()
+
+
+class TestInformer:
+    def test_handlers_fire(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        adds, updates, deletes = [], [], []
+        inf = Informer(
+            client,
+            "pods",
+            namespace="default",
+            on_add=lambda o: adds.append(o["metadata"]["name"]),
+            on_update=lambda o: updates.append(o["metadata"]["name"]),
+            on_delete=lambda o: deletes.append(o["metadata"]["name"]),
+        ).start()
+        try:
+            assert inf.wait_for_sync()
+            client.create("pods", pod_wire("x"))
+            client.bind("x", "n1", namespace="default")
+            client.delete("pods", "x", namespace="default")
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and not deletes:
+                time.sleep(0.01)
+            assert adds == ["x"]
+            assert updates == ["x"]
+            assert deletes == ["x"]
+        finally:
+            inf.stop()
