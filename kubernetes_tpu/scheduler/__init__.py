@@ -1,0 +1,41 @@
+"""Scheduler: scalar reference path (the parity oracle) + TPU batch path.
+
+Reference: plugin/pkg/scheduler/. The scalar path mirrors the
+reference's predicate/priority formulas exactly (including integer
+truncation and greedy capacity re-simulation) and serves as the
+semantic oracle; the TPU path solves the same problem as dense
+pod x node matrices (kubernetes_tpu.ops) and is checked against the
+oracle at >=99% decision parity.
+"""
+
+from kubernetes_tpu.scheduler.types import (
+    HostPriority,
+    StaticNodeLister,
+    StaticPodLister,
+    StaticServiceLister,
+)
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler, NoNodesError
+from kubernetes_tpu.scheduler.plugins import (
+    default_predicates,
+    default_priorities,
+    get_algorithm_provider,
+    register_algorithm_provider,
+    register_fit_predicate,
+    register_priority_function,
+)
+
+__all__ = [
+    "HostPriority",
+    "StaticNodeLister",
+    "StaticPodLister",
+    "StaticServiceLister",
+    "GenericScheduler",
+    "FitError",
+    "NoNodesError",
+    "default_predicates",
+    "default_priorities",
+    "get_algorithm_provider",
+    "register_algorithm_provider",
+    "register_fit_predicate",
+    "register_priority_function",
+]

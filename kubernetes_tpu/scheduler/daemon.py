@@ -1,0 +1,296 @@
+"""The scheduler daemon: watch-fed caches -> schedule -> bind loop.
+
+Reference: plugin/pkg/scheduler/scheduler.go (Scheduler.Run /
+scheduleOne), plugin/pkg/scheduler/factory/factory.go (ConfigFactory:
+unassigned-pod FIFO, node/service caches, binder, backoff requeue).
+
+Two operating modes share this daemon:
+- scalar: one pod per scheduleOne (the reference's shape);
+- batch (TPU): drain the FIFO, solve the whole backlog as matrices,
+  then bind the returned assignment (see kubernetes_tpu.ops.solver);
+  falls back to scalar when the device path errors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.client.cache import FIFO, Informer, Reflector, ThreadSafeStore
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import Node, Pod, Service
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler, NoNodesError
+from kubernetes_tpu.scheduler.modeler import SimpleModeler
+from kubernetes_tpu.scheduler.plugins import (
+    DEFAULT_PROVIDER,
+    PluginFactoryArgs,
+    get_algorithm_provider,
+    get_fit_predicates,
+    get_priority_configs,
+)
+from kubernetes_tpu.scheduler.types import StaticNodeLister, StaticServiceLister
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.ratelimit import Backoff, TokenBucket
+
+_E2E_LATENCY = metrics.DEFAULT.summary(
+    "scheduler_e2e_scheduling_latency_seconds",
+    "E2e scheduling latency (scheduling algorithm + binding)",
+)
+_ALGO_LATENCY = metrics.DEFAULT.summary(
+    "scheduler_scheduling_algorithm_latency_seconds", "Scheduling algorithm latency"
+)
+_BIND_LATENCY = metrics.DEFAULT.summary(
+    "scheduler_binding_latency_seconds", "Binding latency"
+)
+_SCHEDULED = metrics.DEFAULT.counter(
+    "scheduler_pods_scheduled_total", "Pods successfully bound", ("result",)
+)
+
+
+def _decode_pod(wire: dict) -> Pod:
+    return serde.from_wire(Pod, wire)
+
+
+def _decode_node(wire: dict) -> Node:
+    return serde.from_wire(Node, wire)
+
+
+def _decode_service(wire: dict) -> Service:
+    return serde.from_wire(Service, wire)
+
+
+class _StorePodLister:
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    def list(self, selector=None) -> List[Pod]:
+        pods = self.store.list()
+        if selector is not None and not selector.empty():
+            pods = [p for p in pods if selector.matches(p.metadata.labels)]
+        return pods
+
+
+class _StoreNodeLister:
+    """Ready-filtered node lister (reference: StoreToNodeLister with
+    NodeCondition filtering, factory.go:166,209)."""
+
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    @staticmethod
+    def _ready(node: Node) -> bool:
+        if node.spec.unschedulable:
+            return False
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                return c.status == "True"
+        return True
+
+    def list(self) -> List[Node]:
+        return [n for n in self.store.list() if self._ready(n)]
+
+    def get(self, name: str) -> Node:
+        # Nodes are cluster-scoped: store key is the bare name -> O(1).
+        node = self.store.get(name)
+        if node is None:
+            raise KeyError(f"node {name!r} not found")
+        return node
+
+
+class SchedulerConfig:
+    """Wires caches + algorithm (reference: factory.CreateFromKeys)."""
+
+    def __init__(
+        self,
+        client,
+        provider_name: str = DEFAULT_PROVIDER,
+        policy: Optional[dict] = None,
+        bind_qps: float = 0.0,
+        assume_ttl: float = 30.0,
+    ):
+        self.client = client
+        # Unassigned pods -> FIFO (factory.go:180-186, field selector
+        # "spec.nodeName=").
+        self.pod_queue = FIFO()
+        self._pod_reflector = Reflector(
+            client,
+            "pods",
+            self.pod_queue,
+            field_selector="spec.nodeName=",
+            decode=_decode_pod,
+        )
+
+        # Scheduled pods cache (for occupancy).
+        self.scheduled_pods = Informer(
+            client, "pods", field_selector="spec.nodeName!=", decode=_decode_pod
+        )
+        # Nodes + services caches (factory.go:187-193).
+        self.nodes = Informer(client, "nodes", decode=_decode_node)
+        self.services = Informer(client, "services", decode=_decode_service)
+
+        self.modeler = SimpleModeler(
+            scheduled_pods=lambda: self.scheduled_pods.store.list(),
+            ttl=assume_ttl,
+        )
+        self.pod_lister = self.modeler.pod_lister()
+        self.node_lister = _StoreNodeLister(self.nodes.store)
+        self.service_lister = _ServiceListerAdapter(self.services.store)
+
+        args = PluginFactoryArgs(
+            pod_lister=self.pod_lister,
+            service_lister=self.service_lister,
+            node_lister=self.node_lister,
+        )
+        if policy is not None:
+            from kubernetes_tpu.scheduler.plugins import build_from_policy
+
+            self.predicates, self.priorities = build_from_policy(policy, args)
+        else:
+            provider = get_algorithm_provider(provider_name)
+            self.predicates = get_fit_predicates(provider.predicate_keys, args)
+            self.priorities = get_priority_configs(provider.priority_keys, args)
+
+        self.algorithm = GenericScheduler(
+            self.predicates, self.priorities, self.pod_lister
+        )
+        self.binder = client
+        self.backoff = Backoff(initial=1.0, max_backoff=60.0)
+        # Reference hard-codes 15 qps/20 burst (factory.go:43-46); 0
+        # disables throttling (the TPU path needs to go far faster).
+        self.bind_limiter = TokenBucket(bind_qps, 20) if bind_qps > 0 else None
+
+    def start(self) -> "SchedulerConfig":
+        self._pod_reflector.start()
+        self.scheduled_pods.start()
+        self.nodes.start()
+        self.services.start()
+        return self
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return all(
+            x.wait_for_sync(timeout)
+            for x in (self._pod_reflector, self.scheduled_pods, self.nodes, self.services)
+        )
+
+    def stop(self) -> None:
+        self.pod_queue.close()
+        for x in (self._pod_reflector, self.scheduled_pods, self.nodes, self.services):
+            x.stop()
+
+
+class _ServiceListerAdapter(StaticServiceLister):
+    def __init__(self, store: ThreadSafeStore):
+        self.store = store
+
+    @property
+    def services(self) -> List[Service]:
+        return self.store.list()
+
+    def list(self) -> List[Service]:
+        return self.store.list()
+
+
+class Scheduler:
+    """The daemon (reference: scheduler.go:109-158)."""
+
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Scheduler":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.config.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def run(self) -> None:
+        # Crash containment (reference: util.HandleCrash wrapping every
+        # control loop) — a transient error must not kill the daemon.
+        while not self._stop.is_set():
+            try:
+                self.schedule_one()
+            except Exception:
+                if not self._stop.is_set():
+                    time.sleep(0.1)
+
+    def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
+        """Pop one pending pod, schedule, bind, assume. Returns True if
+        a pod was processed (scheduler.go:113-158)."""
+        cfg = self.config
+        pod = cfg.pod_queue.pop(timeout=timeout)
+        if pod is None:
+            return False
+        if pod.spec.node_name:
+            return True  # raced: already bound
+        if cfg.bind_limiter is not None:
+            cfg.bind_limiter.accept()
+        start = time.monotonic()
+        try:
+            t0 = time.monotonic()
+            dest = cfg.algorithm.schedule(pod, cfg.node_lister)
+            _ALGO_LATENCY.observe(time.monotonic() - t0)
+        except (FitError, NoNodesError, KeyError) as e:
+            # KeyError: a node vanished between list and predicate lookup
+            # (the watch mutates the cache concurrently) — treat like an
+            # unschedulable attempt and retry.
+            _SCHEDULED.inc(result="unschedulable")
+            cfg.client.record_event(pod, "FailedScheduling", str(e), source="scheduler")
+            self._requeue_later(pod)
+            return True
+        try:
+            t0 = time.monotonic()
+            cfg.binder.bind(
+                pod.metadata.name, dest, namespace=pod.metadata.namespace or "default"
+            )
+            _BIND_LATENCY.observe(time.monotonic() - t0)
+        except APIError as e:
+            _SCHEDULED.inc(result="bind_error")
+            cfg.client.record_event(
+                pod, "FailedBinding", str(e), source="scheduler"
+            )
+            self._requeue_later(pod)
+            return True
+        # Assume so capacity is held before the watch confirms
+        # (scheduler.go:142-157).
+        pod.spec.node_name = dest
+        cfg.modeler.assume_pod(pod)
+        _SCHEDULED.inc(result="scheduled")
+        _E2E_LATENCY.observe(time.monotonic() - start)
+        cfg.client.record_event(
+            pod, "Scheduled", f"Successfully assigned {pod.metadata.name} to {dest}",
+            source="scheduler",
+        )
+        return True
+
+    def _requeue_later(self, pod: Pod) -> None:
+        """Exponential-backoff retry. Mirrors factory.go:257-286: after
+        the backoff, RE-FETCH the pod from the apiserver and drop it if
+        it is gone or got assigned in the meantime."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        delay = self.config.backoff.duration(key)
+
+        def later():
+            time.sleep(delay)
+            if self._stop.is_set():
+                return
+            try:
+                fresh = self.config.client.get(
+                    "pods", pod.metadata.name,
+                    namespace=pod.metadata.namespace or "default",
+                )
+            except APIError:
+                return  # deleted: stop retrying
+            except Exception:
+                fresh = pod  # apiserver hiccup: retry with the snapshot
+            if not fresh.spec.node_name:
+                self.config.pod_queue.add(fresh)
+
+        threading.Thread(target=later, daemon=True).start()

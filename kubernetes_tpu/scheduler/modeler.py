@@ -1,0 +1,71 @@
+"""System modeler: optimistic assumed-pod cache.
+
+Reference: plugin/pkg/scheduler/modeler.go — after a successful bind
+the scheduler "assumes" the pod onto its node so in-flight bindings
+count against capacity before the apiserver watch confirms them
+(scheduler.go:142-157). Assumptions live in a TTL cache (30s) and are
+dropped early when the real pod shows up via watch
+(factory.go:91-114)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.models.objects import Pod
+from kubernetes_tpu.models import labels as labelpkg
+
+
+class SimpleModeler:
+    def __init__(
+        self,
+        scheduled_pods: Callable[[], List[Pod]],
+        ttl: float = 30.0,
+    ):
+        self._scheduled = scheduled_pods
+        self._ttl = ttl
+        self._lock = threading.Lock()
+        self._assumed: Dict[str, tuple] = {}  # key -> (pod, expiry)
+
+    @staticmethod
+    def _key(pod: Pod) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def assume_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._assumed[self._key(pod)] = (pod, time.monotonic() + self._ttl)
+
+    def forget_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._assumed.pop(self._key(pod), None)
+
+    def _live_assumed(self) -> List[Pod]:
+        now = time.monotonic()
+        with self._lock:
+            self._assumed = {
+                k: v for k, v in self._assumed.items() if v[1] > now
+            }
+            return [pod for pod, _ in self._assumed.values()]
+
+    def pod_lister(self):
+        """Merged lister: scheduled pods U live assumptions not yet
+        visible as scheduled (modeler.go:134-179)."""
+        modeler = self
+
+        class _Lister:
+            def list(self, selector: Optional[labelpkg.Selector] = None) -> List[Pod]:
+                scheduled = modeler._scheduled()
+                seen = {modeler._key(p) for p in scheduled}
+                out = list(scheduled)
+                for pod in modeler._live_assumed():
+                    key = modeler._key(pod)
+                    if key in seen:
+                        modeler.forget_pod(pod)  # confirmed by the watch
+                        continue
+                    out.append(pod)
+                if selector is not None and not selector.empty():
+                    out = [p for p in out if selector.matches(p.metadata.labels)]
+                return out
+
+        return _Lister()

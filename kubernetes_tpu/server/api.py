@@ -36,7 +36,7 @@ from kubernetes_tpu.store import (
     KVStore,
     NotFoundError,
 )
-from kubernetes_tpu.store.watch import Event, WatchStream
+from kubernetes_tpu.store.watch import ADDED, DELETED, MODIFIED, Event, WatchStream
 
 
 class APIError(Exception):
@@ -74,11 +74,20 @@ def _bad_request(msg: str) -> APIError:
 
 
 class _FilteredStream:
-    """Wraps a store WatchStream, applying selector filters."""
+    """Wraps a store WatchStream, applying selector filters.
 
-    def __init__(self, inner: WatchStream, pred):
+    An ADDED/MODIFIED event whose object no longer matches the filter is
+    rewritten as DELETED, so consumers watching e.g. spec.nodeName=""
+    see pods leave their view when another actor binds them (reference:
+    the modified-out-of-filter -> Deleted translation in
+    pkg/tools/etcd_helper_watch.go sendModify). A spurious DELETED for
+    an object the consumer never saw is a harmless no-op delete.
+    """
+
+    def __init__(self, inner: WatchStream, pred, filtered: bool):
         self._inner = inner
         self._pred = pred
+        self._filtered = filtered
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -87,8 +96,15 @@ class _FilteredStream:
             ev = self._inner.next(timeout=t)
             if ev is None:
                 return None
-            if self._pred(ev.object):
+            if not self._filtered or self._pred(ev.object):
                 return ev
+            # Non-matching events (etcd_helper_watch.go sendModify/sendDelete
+            # shape): ADDED of a never-matching object is skipped; MODIFIED
+            # means it may have matched before -> synthesize DELETED so
+            # consumers drop it (a spurious delete is a no-op); DELETED
+            # passes through for the same reason.
+            if ev.type in (MODIFIED, DELETED):
+                return Event(DELETED, ev.object, ev.version)
             if deadline is not None and time.monotonic() >= deadline:
                 return None
 
@@ -309,7 +325,9 @@ class APIServer:
         except Exception as e:  # CompactedError -> 410 Gone
             raise APIError(410, "Expired", str(e))
         return _FilteredStream(
-            inner, self._selector_pred(resource, label_selector, field_selector)
+            inner,
+            self._selector_pred(resource, label_selector, field_selector),
+            filtered=bool(label_selector or field_selector),
         )
 
     # -- bindings (the scheduler's commit path) ------------------------

@@ -1,0 +1,306 @@
+"""Predicate parity tests — tables mirror the reference's
+plugin/pkg/scheduler/algorithm/predicates/predicates_test.go. These are
+the oracle for the TPU batch path's >=99% parity requirement."""
+
+import pytest
+
+from kubernetes_tpu.models.objects import (
+    AWSElasticBlockStoreVolumeSource,
+    Container,
+    ContainerPort,
+    GCEPersistentDiskVolumeSource,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    Volume,
+)
+from kubernetes_tpu.models.quantity import Quantity
+from kubernetes_tpu.scheduler import predicates as preds
+from kubernetes_tpu.scheduler.types import StaticNodeLister
+
+
+def resource_pod(*reqs):
+    """newResourcePod (predicates_test.go:55-75): containers with LIMITS."""
+    containers = [
+        Container(
+            name=f"c{i}",
+            image="x",
+            resources=ResourceRequirements(
+                limits={
+                    "cpu": Quantity.from_milli(cpu),
+                    "memory": Quantity.from_int(mem),
+                }
+            ),
+        )
+        for i, (cpu, mem) in enumerate(reqs)
+    ]
+    return Pod(spec=PodSpec(containers=containers))
+
+
+def make_node(cpu_milli, mem, pods=32, name="machine"):
+    """makeResources (predicates_test.go:40-52)."""
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(
+            capacity={
+                "cpu": Quantity.from_milli(cpu_milli),
+                "memory": Quantity.from_int(mem),
+                "pods": Quantity.from_int(pods),
+            }
+        ),
+    )
+
+
+class TestPodFitsResources:
+    """predicates_test.go TestPodFitsResources (enough/not-enough pods)."""
+
+    @pytest.mark.parametrize(
+        "pod,existing,fits,name",
+        [
+            (Pod(), [resource_pod((10, 20))], True, "no resources requested always fits"),
+            (resource_pod((1, 1)), [resource_pod((10, 20))], False, "too many resources fails"),
+            (resource_pod((1, 1)), [resource_pod((5, 5))], True, "both resources fit"),
+            (resource_pod((1, 2)), [resource_pod((5, 19))], False, "one resource fits"),
+            (resource_pod((5, 1)), [resource_pod((5, 19))], True, "equal edge case"),
+        ],
+    )
+    def test_enough_pod_slots(self, pod, existing, fits, name):
+        node = make_node(10, 20, pods=32)
+        fit = preds.ResourceFit(StaticNodeLister([node]))
+        assert fit(pod, existing, "machine") is fits, name
+
+    @pytest.mark.parametrize(
+        "pod,existing,fits,name",
+        [
+            (Pod(), [resource_pod((10, 20))], False, "no pod slots: zero-request fails"),
+            (resource_pod((1, 1)), [resource_pod((5, 5))], False, "no pod slots: fits otherwise"),
+            (resource_pod((5, 1)), [resource_pod((5, 19))], False, "no pod slots: equal edge"),
+        ],
+    )
+    def test_not_enough_pod_slots(self, pod, existing, fits, name):
+        node = make_node(10, 20, pods=1)
+        fit = preds.ResourceFit(StaticNodeLister([node]))
+        assert fit(pod, existing, "machine") is fits, name
+
+    def test_zero_capacity_means_unlimited_resource(self):
+        """CheckPodsExceedingCapacity: totalMilliCPU == 0 -> cpu always
+        fits (predicates.go:123-124)."""
+        node = make_node(0, 0, pods=10)
+        fit = preds.ResourceFit(StaticNodeLister([node]))
+        assert fit(resource_pod((10**9, 10**9)), [], "machine") is True
+
+    def test_overcommitted_node_rejects_everything(self):
+        """If ANY pod in the greedy simulation exceeds capacity —
+        including a pre-existing one — the node fails for the new pod
+        (PodFitsResources checks len(exceeding) > 0, predicates.go:152)."""
+        node = make_node(10, 100, pods=32)
+        fit = preds.ResourceFit(StaticNodeLister([node]))
+        # existing: 8 cpu fits; 5 cpu does NOT (8+5>10) -> node rejects
+        # even a tiny new pod.
+        existing = [resource_pod((8, 1)), resource_pod((5, 1))]
+        assert fit(resource_pod((2, 1)), existing, "machine") is False
+        # Without the overflowing existing pod the small pod fits.
+        assert fit(resource_pod((2, 1)), [resource_pod((8, 1))], "machine") is True
+
+
+class TestPodFitsHost:
+    """predicates_test.go TestPodFitsHost (:185-218)."""
+
+    @pytest.mark.parametrize(
+        "pod_node,node,fits",
+        [
+            ("", "foo", True),
+            ("foo", "foo", True),
+            ("bar", "foo", False),
+        ],
+    )
+    def test_table(self, pod_node, node, fits):
+        pod = Pod(spec=PodSpec(node_name=pod_node))
+        assert preds.pod_fits_host(pod, [], node) is fits
+
+
+def port_pod(*host_ports):
+    return Pod(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="c",
+                    image="x",
+                    ports=[ContainerPort(container_port=80, host_port=hp) for hp in host_ports],
+                )
+            ]
+        )
+    )
+
+
+class TestPodFitsPorts:
+    """predicates_test.go TestPodFitsPorts (:248-301)."""
+
+    @pytest.mark.parametrize(
+        "pod,existing,fits,name",
+        [
+            (Pod(), [], True, "nothing running"),
+            (port_pod(8080), [port_pod(9090)], True, "other port"),
+            (port_pod(8080), [port_pod(8080)], False, "same port conflict"),
+            (port_pod(8000, 8080), [port_pod(8080)], False, "second port conflicts"),
+            (port_pod(8000, 8080), [port_pod(8001, 8080)], False, "dup in existing"),
+        ],
+    )
+    def test_table(self, pod, existing, fits, name):
+        assert preds.pod_fits_ports(pod, existing, "machine") is fits, name
+
+    def test_host_port_zero_ignored(self):
+        assert preds.pod_fits_ports(port_pod(0), [port_pod(0)], "machine") is True
+
+
+def gce_pod(pd_name, read_only=False):
+    return Pod(
+        spec=PodSpec(
+            volumes=[
+                Volume(
+                    name="v",
+                    gce_persistent_disk=GCEPersistentDiskVolumeSource(
+                        pd_name=pd_name, read_only=read_only
+                    ),
+                )
+            ]
+        )
+    )
+
+
+def ebs_pod(volume_id):
+    return Pod(
+        spec=PodSpec(
+            volumes=[
+                Volume(
+                    name="v",
+                    aws_elastic_block_store=AWSElasticBlockStoreVolumeSource(
+                        volume_id=volume_id
+                    ),
+                )
+            ]
+        )
+    )
+
+
+class TestNoDiskConflict:
+    """predicates_test.go TestDiskConflicts/TestAWSDiskConflicts
+    (:305-390) + the read-only exemption in isVolumeConflict."""
+
+    def test_gce_conflicts(self):
+        assert preds.no_disk_conflict(gce_pod("foo"), [], "m") is True
+        assert preds.no_disk_conflict(gce_pod("foo"), [gce_pod("bar")], "m") is True
+        assert preds.no_disk_conflict(gce_pod("foo"), [gce_pod("foo")], "m") is False
+        assert preds.no_disk_conflict(Pod(), [gce_pod("foo")], "m") is True
+
+    def test_gce_both_read_only_ok(self):
+        a, b = gce_pod("foo", read_only=True), gce_pod("foo", read_only=True)
+        assert preds.no_disk_conflict(a, [b], "m") is True
+        rw = gce_pod("foo", read_only=False)
+        assert preds.no_disk_conflict(rw, [b], "m") is False
+        assert preds.no_disk_conflict(b, [rw], "m") is False
+
+    def test_ebs_conflicts_even_read_only(self):
+        assert preds.no_disk_conflict(ebs_pod("vol1"), [ebs_pod("vol1")], "m") is False
+        assert preds.no_disk_conflict(ebs_pod("vol1"), [ebs_pod("vol2")], "m") is True
+
+
+def selector_pod(selector=None, labels=None):
+    return Pod(
+        metadata=ObjectMeta(labels=labels or {}),
+        spec=PodSpec(node_selector=selector or {}),
+    )
+
+
+def labeled_node(name, labels):
+    return Node(metadata=ObjectMeta(name=name, labels=labels))
+
+
+class TestPodSelectorMatches:
+    """predicates_test.go TestPodSelectorMatches (:395-430)."""
+
+    @pytest.mark.parametrize(
+        "selector,node_labels,fits",
+        [
+            ({}, {}, True),
+            ({"foo": "bar"}, {"foo": "bar"}, True),
+            ({"foo": "bar"}, {"foo": "baz"}, False),
+            ({"foo": "bar"}, {}, False),
+            ({"foo": "bar", "baz": "qux"}, {"foo": "bar", "baz": "qux", "x": "y"}, True),
+            ({"foo": "bar", "baz": "qux"}, {"foo": "bar"}, False),
+        ],
+    )
+    def test_table(self, selector, node_labels, fits):
+        node = labeled_node("machine", node_labels)
+        pred = preds.NodeSelectorMatches(StaticNodeLister([node]))
+        assert pred(selector_pod(selector), [], "machine") is fits
+
+
+class TestNodeLabelPresence:
+    """predicates_test.go TestNodeLabelPresence (:433-500)."""
+
+    @pytest.mark.parametrize(
+        "labels,presence,fits",
+        [
+            (["baz"], True, False),   # label absent, wanted
+            (["baz"], False, True),   # label absent, unwanted
+            (["foo"], True, True),    # present, wanted
+            (["foo"], False, False),  # present, unwanted
+            (["foo", "bar"], True, True),
+            (["foo", "bar"], False, False),
+            (["foo", "baz"], True, False),  # one of them missing
+        ],
+    )
+    def test_table(self, labels, presence, fits):
+        node = labeled_node("machine", {"foo": "1", "bar": "2"})
+        pred = preds.NodeLabelChecker(StaticNodeLister([node]), labels, presence)
+        assert pred(Pod(), [], "machine") is fits
+
+
+class TestServiceAffinity:
+    """predicates_test.go TestServiceAffinity (:503-620, condensed)."""
+
+    def _setup(self):
+        from kubernetes_tpu.models.objects import Service, ServiceSpec
+        from kubernetes_tpu.scheduler.types import StaticPodLister, StaticServiceLister
+
+        n1 = labeled_node("machine1", {"region": "r1", "zone": "z11"})
+        n2 = labeled_node("machine2", {"region": "r1", "zone": "z12"})
+        n3 = labeled_node("machine3", {"region": "r2", "zone": "z21"})
+        nodes = StaticNodeLister([n1, n2, n3])
+        svc = Service(
+            metadata=ObjectMeta(name="s1", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        return nodes, svc, StaticPodLister, StaticServiceLister
+
+    def test_pod_with_selector_labels(self):
+        nodes, svc, PL, SL = self._setup()
+        pred = preds.ServiceAffinity(PL([]), SL([]), nodes, ["region"])
+        pod = selector_pod({"region": "r1"})
+        assert pred(pod, [], "machine1") is True
+        assert pred(pod, [], "machine3") is False
+
+    def test_affinity_from_service_peer(self):
+        nodes, svc, PL, SL = self._setup()
+        peer = Pod(
+            metadata=ObjectMeta(name="peer", namespace="default", labels={"app": "web"}),
+            spec=PodSpec(node_name="machine3"),
+        )
+        pred = preds.ServiceAffinity(PL([peer]), SL([svc]), nodes, ["region"])
+        pod = selector_pod(labels={"app": "web"})
+        pod.metadata.namespace = "default"
+        # Peer runs in r2 -> only r2 nodes fit.
+        assert pred(pod, [], "machine3") is True
+        assert pred(pod, [], "machine1") is False
+
+    def test_no_peers_all_fit(self):
+        nodes, svc, PL, SL = self._setup()
+        pred = preds.ServiceAffinity(PL([]), SL([svc]), nodes, ["region"])
+        pod = selector_pod(labels={"app": "web"})
+        pod.metadata.namespace = "default"
+        assert pred(pod, [], "machine1") is True
+        assert pred(pod, [], "machine3") is True

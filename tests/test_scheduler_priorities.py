@@ -1,0 +1,327 @@
+"""Priority parity tests — tables mirror
+plugin/pkg/scheduler/algorithm/priorities/{priorities_test.go,
+spreading_test.go}. Expected scores include the reference's integer
+truncations; these numbers are the oracle for the TPU batch path."""
+
+import pytest
+
+from kubernetes_tpu.models.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    Service,
+    ServiceSpec,
+)
+from kubernetes_tpu.models.quantity import Quantity
+from kubernetes_tpu.scheduler import priorities as prios
+from kubernetes_tpu.scheduler.types import (
+    StaticNodeLister,
+    StaticPodLister,
+    StaticServiceLister,
+)
+
+
+def make_minion(name, milli_cpu, memory):
+    """makeMinion (priorities_test.go:29-39)."""
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(
+            capacity={
+                "cpu": Quantity.from_milli(milli_cpu),
+                "memory": Quantity.from_int(memory),
+            }
+        ),
+    )
+
+
+def _containers(*limits):
+    return [
+        Container(
+            name=f"c{i}",
+            image="x",
+            resources=ResourceRequirements(
+                limits={
+                    k: (Quantity.from_milli(v) if k == "cpu" else Quantity.from_int(v))
+                    for k, v in lim.items()
+                }
+            ),
+        )
+        for i, lim in enumerate(limits)
+    ]
+
+
+# Fixtures mirroring priorities_test.go:56-100.
+def no_resources_pod(node=""):
+    return Pod(spec=PodSpec(node_name=node))
+
+
+def cpu_only_pod(node="machine1"):
+    return Pod(spec=PodSpec(node_name=node, containers=_containers({"cpu": 1000}, {"cpu": 2000})))
+
+
+def cpu_mem_pod(node="machine2"):
+    return Pod(
+        spec=PodSpec(
+            node_name=node,
+            containers=_containers(
+                {"cpu": 1000, "memory": 2000}, {"cpu": 2000, "memory": 3000}
+            ),
+        )
+    )
+
+
+def scores(result):
+    return {hp.host: hp.score for hp in result}
+
+
+class TestLeastRequested:
+    """priorities_test.go TestLeastRequested expectations (:100-260)."""
+
+    @pytest.mark.parametrize(
+        "pod,pods,nodes,expected,name",
+        [
+            (
+                no_resources_pod(), [],
+                [("machine1", 4000, 10000), ("machine2", 4000, 10000)],
+                {"machine1": 10, "machine2": 10},
+                "nothing scheduled, nothing requested",
+            ),
+            (
+                cpu_mem_pod(""), [],
+                [("machine1", 4000, 10000), ("machine2", 6000, 10000)],
+                {"machine1": 3, "machine2": 5},
+                "nothing scheduled, resources requested, differently sized",
+            ),
+            (
+                no_resources_pod(),
+                ["cpu_only:machine1", "cpu_only:machine1", "cpu_only:machine2", "cpu_mem:machine2"],
+                [("machine1", 10000, 20000), ("machine2", 10000, 20000)],
+                {"machine1": 7, "machine2": 5},
+                "no resources requested, pods scheduled with resources",
+            ),
+            (
+                cpu_mem_pod(""),
+                ["cpu_only:machine1", "cpu_mem:machine2"],
+                [("machine1", 10000, 20000), ("machine2", 10000, 20000)],
+                {"machine1": 5, "machine2": 4},
+                "resources requested, pods scheduled with resources",
+            ),
+            (
+                cpu_mem_pod(""),
+                ["cpu_only:machine1", "cpu_mem:machine2"],
+                [("machine1", 10000, 20000), ("machine2", 10000, 50000)],
+                {"machine1": 5, "machine2": 6},
+                "differently sized machines",
+            ),
+            (
+                cpu_only_pod(""),
+                ["cpu_only:machine1", "cpu_mem:machine2"],
+                [("machine1", 4000, 10000), ("machine2", 4000, 10000)],
+                {"machine1": 5, "machine2": 2},
+                "requested resources exceed minion capacity",
+            ),
+            (
+                no_resources_pod(), [],
+                [("machine1", 0, 0), ("machine2", 0, 0)],
+                {"machine1": 0, "machine2": 0},
+                "zero minion resources",
+            ),
+        ],
+    )
+    def test_table(self, pod, pods, nodes, expected, name):
+        existing = []
+        for spec in pods:
+            kind, node = spec.split(":")
+            existing.append(cpu_only_pod(node) if kind == "cpu_only" else cpu_mem_pod(node))
+        lister = StaticNodeLister([make_minion(n, c, m) for n, c, m in nodes])
+        got = scores(prios.least_requested_priority(pod, StaticPodLister(existing), lister))
+        assert got == expected, name
+
+
+class TestBalancedResourceAllocation:
+    """priorities_test.go TestBalancedResourceAllocation (:430-600)."""
+
+    @pytest.mark.parametrize(
+        "pod,pods,nodes,expected,name",
+        [
+            (
+                no_resources_pod(), [],
+                [("machine1", 4000, 10000), ("machine2", 4000, 10000)],
+                {"machine1": 10, "machine2": 10},
+                "nothing scheduled, nothing requested",
+            ),
+            (
+                cpu_mem_pod(""), [],
+                [("machine1", 4000, 10000), ("machine2", 6000, 10000)],
+                {"machine1": 7, "machine2": 10},
+                "nothing scheduled, resources requested, differently sized",
+            ),
+            (
+                no_resources_pod(),
+                ["cpu_only:machine1", "cpu_only:machine1", "cpu_only:machine2", "cpu_mem:machine2"],
+                [("machine1", 10000, 20000), ("machine2", 10000, 20000)],
+                {"machine1": 4, "machine2": 6},
+                "no resources requested, pods scheduled with resources",
+            ),
+            (
+                cpu_mem_pod(""),
+                ["cpu_only:machine1", "cpu_mem:machine2"],
+                [("machine1", 10000, 20000), ("machine2", 10000, 20000)],
+                {"machine1": 6, "machine2": 9},
+                "resources requested, pods scheduled",
+            ),
+            (
+                cpu_mem_pod(""),
+                ["cpu_only:machine1", "cpu_mem:machine2"],
+                [("machine1", 10000, 20000), ("machine2", 10000, 50000)],
+                {"machine1": 6, "machine2": 6},
+                "differently sized machines",
+            ),
+            (
+                cpu_only_pod(""),
+                ["cpu_only:machine1", "cpu_mem:machine2"],
+                [("machine1", 4000, 10000), ("machine2", 4000, 10000)],
+                {"machine1": 0, "machine2": 0},
+                "requested exceed capacity",
+            ),
+            (
+                no_resources_pod(), [],
+                [("machine1", 0, 0), ("machine2", 0, 0)],
+                {"machine1": 0, "machine2": 0},
+                "zero minion resources",
+            ),
+        ],
+    )
+    def test_table(self, pod, pods, nodes, expected, name):
+        existing = []
+        for spec in pods:
+            kind, node = spec.split(":")
+            existing.append(cpu_only_pod(node) if kind == "cpu_only" else cpu_mem_pod(node))
+        lister = StaticNodeLister([make_minion(n, c, m) for n, c, m in nodes])
+        got = scores(
+            prios.balanced_resource_allocation(pod, StaticPodLister(existing), lister)
+        )
+        assert got == expected, name
+
+
+def labeled_pod(labels, ns="default", node=""):
+    return Pod(
+        metadata=ObjectMeta(name=f"p{id(labels) % 1000}", namespace=ns, labels=labels),
+        spec=PodSpec(node_name=node),
+    )
+
+
+def plain_node(name, labels=None):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}))
+
+
+class TestServiceSpread:
+    """spreading_test.go TestServiceSpreadPriority expectations."""
+
+    def test_no_services_all_ten(self):
+        pod = labeled_pod({"app": "web"})
+        nodes = StaticNodeLister([plain_node("m1"), plain_node("m2")])
+        got = scores(
+            prios.ServiceSpread(StaticServiceLister([]))(
+                pod, StaticPodLister([]), nodes
+            )
+        )
+        assert got == {"m1": 10, "m2": 10}
+
+    def test_spread(self):
+        svc = Service(
+            metadata=ObjectMeta(name="s", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        pod = labeled_pod({"app": "web"})
+        existing = [
+            labeled_pod({"app": "web"}, node="m1"),
+            labeled_pod({"app": "web"}, node="m1"),
+            labeled_pod({"app": "web"}, node="m2"),
+        ]
+        nodes = StaticNodeLister([plain_node("m1"), plain_node("m2"), plain_node("m3")])
+        got = scores(
+            prios.ServiceSpread(StaticServiceLister([svc]))(
+                pod, StaticPodLister(existing), nodes
+            )
+        )
+        # maxCount=2: m1 -> 10*(2-2)/2=0, m2 -> 10*(2-1)/2=5, m3 -> 10.
+        assert got == {"m1": 0, "m2": 5, "m3": 10}
+
+    def test_other_namespace_ignored(self):
+        svc = Service(
+            metadata=ObjectMeta(name="s", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        pod = labeled_pod({"app": "web"})
+        existing = [labeled_pod({"app": "web"}, ns="other", node="m1")]
+        nodes = StaticNodeLister([plain_node("m1"), plain_node("m2")])
+        got = scores(
+            prios.ServiceSpread(StaticServiceLister([svc]))(
+                pod, StaticPodLister(existing), nodes
+            )
+        )
+        assert got == {"m1": 10, "m2": 10}
+
+
+class TestServiceAntiAffinity:
+    """spreading_test.go TestZoneSpreadPriority expectations."""
+
+    def test_zone_spread(self):
+        svc = Service(
+            metadata=ObjectMeta(name="s", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        nodes = StaticNodeLister(
+            [
+                plain_node("m1", {"zone": "z1"}),
+                plain_node("m2", {"zone": "z1"}),
+                plain_node("m3", {"zone": "z2"}),
+                plain_node("m4"),  # unlabeled -> score 0
+            ]
+        )
+        existing = [
+            labeled_pod({"app": "web"}, node="m1"),
+            labeled_pod({"app": "web"}, node="m3"),
+            labeled_pod({"app": "web"}, node="m3"),
+        ]
+        fn = prios.ServiceAntiAffinity(StaticServiceLister([svc]), "zone")
+        got = scores(fn(labeled_pod({"app": "web"}), StaticPodLister(existing), nodes))
+        # 3 service pods: z1 has 1, z2 has 2.
+        # z1 nodes: 10*(3-1)/3 = 6 (int), z2: 10*(3-2)/3 = 3 (int), m4: 0.
+        assert got == {"m1": 6, "m2": 6, "m3": 3, "m4": 0}
+
+
+class TestNodeLabelPriority:
+    """priorities_test.go TestNewNodeLabelPriority (:278-366)."""
+
+    @pytest.mark.parametrize(
+        "label,presence,expected",
+        [
+            ("baz", True, {"m1": 0, "m2": 0, "m3": 0}),
+            ("baz", False, {"m1": 10, "m2": 10, "m3": 10}),
+            ("foo", True, {"m1": 10, "m2": 0, "m3": 0}),
+            ("foo", False, {"m1": 0, "m2": 10, "m3": 10}),
+        ],
+    )
+    def test_table(self, label, presence, expected):
+        nodes = StaticNodeLister(
+            [
+                plain_node("m1", {"foo": "1"}),
+                plain_node("m2", {"bar": "1"}),
+                plain_node("m3", {"bar": "1"}),
+            ]
+        )
+        fn = prios.NodeLabelPrioritizer(label, presence)
+        got = scores(fn(Pod(), StaticPodLister([]), nodes))
+        assert got == expected
+
+
+def test_equal_priority():
+    nodes = StaticNodeLister([plain_node("m1"), plain_node("m2")])
+    got = scores(prios.equal_priority(Pod(), StaticPodLister([]), nodes))
+    assert got == {"m1": 1, "m2": 1}
