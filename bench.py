@@ -1,0 +1,73 @@
+"""Scheduler benchmark — prints ONE JSON line for the driver.
+
+Headline (BASELINE.md north star): schedule a 50k-pending-pod backlog
+onto 5k nodes in < 2s wall-clock, vs the reference's sequential
+~15 bindings/s ceiling (scheduler bind rate limit, factory.go:43-46).
+
+Measures the full pipeline: columnar lowering (host) -> upload ->
+jitted sequential-parity solve (device) -> assignment readback.
+Compile time is excluded via a warmup solve on identical shapes.
+
+Env overrides: BENCH_PODS, BENCH_NODES, BENCH_REPEATS.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_PODS_PER_SEC = 15.0  # reference bind rate limit ceiling
+
+
+def main() -> None:
+    n_pods = int(os.environ.get("BENCH_PODS", "50000"))
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+
+    import numpy as np
+
+    from __graft_entry__ import _synthetic_problem
+    from kubernetes_tpu.ops import device_snapshot
+    from kubernetes_tpu.ops.solver import solve
+
+    # Warmup: compile on identical shapes (cheap tiny problem first to
+    # fail fast on any lowering error, then the real shape).
+    snap = _synthetic_problem(n_pods, n_nodes, seed=1)
+    d = device_snapshot(snap)
+    solve(d.pods, d.nodes).block_until_ready()
+
+    times = []
+    placed = 0
+    for r in range(repeats):
+        t0 = time.perf_counter()
+        snap = _synthetic_problem(n_pods, n_nodes, seed=2 + r)
+        d = device_snapshot(snap)
+        out = solve(d.pods, d.nodes)
+        assignment = np.asarray(out)[: d.n_pods]
+        t1 = time.perf_counter()
+        times.append(t1 - t0)
+        placed = int((assignment >= 0).sum())
+
+    best = min(times)
+    pods_per_sec = n_pods / best
+    print(
+        json.dumps(
+            {
+                "metric": f"pods_scheduled_per_sec_{n_pods//1000}kx{n_nodes}",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 1),
+            }
+        )
+    )
+    print(
+        f"# wall {best:.3f}s for {n_pods} pods x {n_nodes} nodes "
+        f"({placed} placed); times={['%.3f' % t for t in times]}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

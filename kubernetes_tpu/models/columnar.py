@@ -4,28 +4,41 @@ This is the matrix schema consumed by the TPU scheduler path: the
 reference's per-pod Go loops over object graphs
 (plugin/pkg/scheduler/generic_scheduler.go:106-171,
 plugin/pkg/scheduler/algorithm/predicates/predicates.go) become dense
-ops over these arrays.
+ops over these arrays. Semantics mirror the scalar oracle
+(kubernetes_tpu.scheduler.predicates/priorities) bit for bit wherever
+integers allow.
 
 Design notes (TPU-first):
 - Resources are lowered once, host-side, to integer-valued float32
-  columns: CPU in millicores, memory in MiB (ceil). float32 holds
-  integers exactly up to 2^24, i.e. 16 TiB of MiB-granular memory and
-  16M millicores — beyond any single node. Integer score truncation
-  (priorities.go:39) is then exact on device for Mi-granular quantities.
+  columns: CPU in millicores, memory in MiB. float32 holds integers
+  exactly up to 2^24, i.e. 16 TiB of MiB-granular memory and 16M
+  millicores — beyond any single node. Requests round UP to MiB and
+  capacity rounds DOWN, so lowering can under-promise but never
+  overcommit. Integer score truncation (priorities.go:39) is then exact
+  on device for Mi-granular quantities.
+- Resource accounting uses container LIMITS, matching the v0.19
+  reference (getResourceRequest, predicates.go:106-114).
+- PodFitsResources parity needs three per-node facts (predicates.go:
+  116-156): the greedy-fitted usage sums, whether ANY existing pod
+  overflowed the greedy simulation (such nodes reject every new pod),
+  and the existing-pod count vs pods capacity. Priorities instead use
+  the FULL usage sums including overflowing pods (calculateOccupancy,
+  priorities.go:44-58). Both are encoded.
 - Set-valued predicates (nodeSelector subset-match, hostPort conflicts,
   exclusive-disk conflicts) use snapshot-scoped vocabularies: every
   distinct key=value / port / volume-id observed is assigned an id, and
-  membership becomes uint32 bitsets. Subset/intersection tests are then
-  bitwise AND + reductions — MXU/VPU friendly, no string work on device.
+  membership becomes uint32 bitsets. Volumes carry two bitsets (all
+  mounts vs read-write mounts) so the GCE-PD both-read-only exemption
+  (predicates.go:59-66) survives lowering; AWS EBS volumes set both
+  bits because they conflict regardless of read-only.
 - Pods with identical selector sets share a row in a deduped selector
-  table (usually tiny), so the expensive [S, N] match matrix is computed
-  once per distinct selector, then gathered per pod.
+  table, so selector bitsets are stored once per distinct selector.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -34,6 +47,7 @@ from kubernetes_tpu.models.objects import (
     Pod,
     RESOURCE_CPU,
     RESOURCE_MEMORY,
+    RESOURCE_PODS,
     Service,
 )
 
@@ -79,30 +93,30 @@ def bitset(ids: Sequence[int], words: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def pod_resource_request(pod: Pod) -> Tuple[int, int]:
-    """Sum of container requests: (milli-CPU, memory bytes).
+def pod_resource_limits(pod: Pod) -> Tuple[int, int]:
+    """Sum of container LIMITS: (milli-CPU, memory bytes).
 
-    Reference: predicates.go:106-114 getResourceRequest — sums
-    requests.cpu.MilliValue() and requests.memory.Value() per container.
+    Reference: predicates.go:106-114 getResourceRequest — v0.19 sums
+    limits.Cpu().MilliValue() and limits.Memory().Value().
     """
     cpu = 0
     mem = 0
     for c in pod.spec.containers:
-        req = c.resources.requests
-        if RESOURCE_CPU in req:
-            cpu += req[RESOURCE_CPU].milli_value()
-        if RESOURCE_MEMORY in req:
-            mem += req[RESOURCE_MEMORY].value()
+        lim = c.resources.limits
+        if RESOURCE_CPU in lim:
+            cpu += lim[RESOURCE_CPU].milli_value()
+        if RESOURCE_MEMORY in lim:
+            mem += lim[RESOURCE_MEMORY].value()
     return cpu, mem
 
 
-def mem_to_mib(mem_bytes: int) -> int:
-    """Lower bytes to MiB, rounding up so requests never under-count."""
+def mem_to_mib_ceil(mem_bytes: int) -> int:
     return -((-mem_bytes) // MIB)
 
 
 def pod_host_ports(pod: Pod) -> List[int]:
-    """All nonzero hostPorts of a pod (reference: predicates.go:351-360)."""
+    """Nonzero hostPorts (getUsedPorts skips 0 at the check site,
+    predicates.go:337-349)."""
     ports = []
     for c in pod.spec.containers:
         for p in c.ports:
@@ -111,23 +125,25 @@ def pod_host_ports(pod: Pod) -> List[int]:
     return ports
 
 
-def pod_exclusive_volumes(pod: Pod) -> List[str]:
-    """Volume ids subject to single-attach exclusivity.
+def pod_volumes(pod: Pod) -> List[Tuple[str, bool]]:
+    """Exclusive volumes as (id, read_write) pairs.
 
-    Reference: predicates.go:59-95 NoDiskConflict — GCE PD and AWS EBS
-    volumes may not be attached read-write by two pods on one node (the
-    v0.19 check ignores read-only flags and simply forbids same-id
-    co-location).
+    GCE PD mounts conflict unless BOTH are read-only; AWS EBS mounts
+    always conflict (isVolumeConflict, predicates.go:53-78) — EBS is
+    returned as read_write=True regardless.
     """
     vols = []
     for v in pod.spec.volumes:
         if v.gce_persistent_disk is not None and v.gce_persistent_disk.pd_name:
-            vols.append("gce-pd:" + v.gce_persistent_disk.pd_name)
+            vols.append(
+                ("gce-pd:" + v.gce_persistent_disk.pd_name,
+                 not v.gce_persistent_disk.read_only)
+            )
         if (
             v.aws_elastic_block_store is not None
             and v.aws_elastic_block_store.volume_id
         ):
-            vols.append("aws-ebs:" + v.aws_elastic_block_store.volume_id)
+            vols.append(("aws-ebs:" + v.aws_elastic_block_store.volume_id, True))
     return vols
 
 
@@ -143,14 +159,15 @@ class PodColumns:
     names: List[str]  # namespace/name keys, host-side only
     cpu_milli: np.ndarray  # f32[P]
     mem_mib: np.ndarray  # f32[P]
-    selector_id: np.ndarray  # i32[P] — row into sel_table (-0 == no selector row 0)
+    zero_req: np.ndarray  # bool[P] — cpu==0 and mem==0 (different fit rule)
+    selector_id: np.ndarray  # i32[P] — row into sel_bits (0 = empty selector)
     port_bits: np.ndarray  # u32[P, PW]
-    vol_bits: np.ndarray  # u32[P, VW]
-    pinned_node: np.ndarray  # i32[P] — node index or -1
+    vol_any_bits: np.ndarray  # u32[P, VW] — all exclusive mounts
+    vol_rw_bits: np.ndarray  # u32[P, VW] — read-write mounts only
+    pinned_node: np.ndarray  # i32[P] — node index, -1 unpinned, -2 unknown
     service_id: np.ndarray  # i32[P] — first matching service, -1 if none
-    # Deduped selector table: row u of sel_bits is a bitset of required
-    # key=value ids; row 0 is always the empty selector.
-    sel_bits: np.ndarray  # u32[U, LW]
+    svc_member: np.ndarray  # f32[P, S] — 1.0 per service whose selector matches
+    sel_bits: np.ndarray  # u32[U, LW] — deduped selector table
 
     @property
     def count(self) -> int:
@@ -164,11 +181,20 @@ class NodeColumns:
     names: List[str]
     cpu_cap: np.ndarray  # f32[N] millicores
     mem_cap: np.ndarray  # f32[N] MiB
-    cpu_used: np.ndarray  # f32[N] millicores, from already-assigned pods
-    mem_used: np.ndarray  # f32[N] MiB
-    label_bits: np.ndarray  # u32[N, LW] — key=value ids present on node
-    used_port_bits: np.ndarray  # u32[N, PW] — hostPorts taken by existing pods
-    used_vol_bits: np.ndarray  # u32[N, VW] — exclusive volumes attached
+    pods_cap: np.ndarray  # f32[N] max pods
+    # Feasibility-side occupancy: greedy-fitted sums + overflow flag
+    # (CheckPodsExceedingCapacity semantics).
+    cpu_fit_used: np.ndarray  # f32[N]
+    mem_fit_used: np.ndarray  # f32[N]
+    overcommitted: np.ndarray  # bool[N] — some existing pod overflowed
+    # Scoring-side occupancy: FULL sums (calculateOccupancy semantics).
+    cpu_used: np.ndarray  # f32[N]
+    mem_used: np.ndarray  # f32[N]
+    pods_used: np.ndarray  # f32[N] — count of existing (non-terminal) pods
+    label_bits: np.ndarray  # u32[N, LW]
+    used_port_bits: np.ndarray  # u32[N, PW]
+    used_vol_any_bits: np.ndarray  # u32[N, VW]
+    used_vol_rw_bits: np.ndarray  # u32[N, VW]
     service_counts: np.ndarray  # f32[N, S] — matching-pod count per service
     schedulable: np.ndarray  # bool[N] — Ready and not unschedulable
 
@@ -179,11 +205,7 @@ class NodeColumns:
 
 @dataclass
 class Snapshot:
-    """One scheduling problem: P pending pods x N nodes.
-
-    Produced host-side from API objects; everything the device solver
-    needs and nothing it does not (names stay on host).
-    """
+    """One scheduling problem: P pending pods x N nodes."""
 
     pods: PodColumns
     nodes: NodeColumns
@@ -210,13 +232,13 @@ def node_is_ready(node: Node) -> bool:
     return True
 
 
-def _first_matching_service(pod: Pod, services: List[Service]) -> int:
-    """Index of the first service whose selector matches the pod.
-
-    Reference: pkg/registry/service/registry GetPodServices as used by
-    CalculateSpreadPriority (spreading.go:44-56); v0.19 uses the first
-    matching service's selector.
-    """
+def _service_membership(pod: Pod, services: List[Service]) -> np.ndarray:
+    """Multi-hot f32[S]: which same-namespace service selectors match
+    the pod's labels. The pending pod spreads against its FIRST match
+    (GetPodServices / spreading.go:44-56), but as an *existing* pod it
+    is counted by every service whose selector matches it
+    (pod_lister.list(selector) in CalculateSpreadPriority)."""
+    out = np.zeros(max(len(services), 1), dtype=np.float32)
     labels = pod.metadata.labels or {}
     for i, svc in enumerate(services):
         sel = svc.spec.selector
@@ -225,8 +247,14 @@ def _first_matching_service(pod: Pod, services: List[Service]) -> int:
         if svc.metadata.namespace != pod.metadata.namespace:
             continue
         if all(labels.get(k) == v for k, v in sel.items()):
-            return i
-    return -1
+            out[i] = 1.0
+    return out
+
+
+def _first_matching_service(pod: Pod, services: List[Service]) -> int:
+    member = _service_membership(pod, services)
+    nz = np.nonzero(member[: len(services)])[0]
+    return int(nz[0]) if len(nz) else -1
 
 
 def build_snapshot(
@@ -237,12 +265,21 @@ def build_snapshot(
 ) -> Snapshot:
     """Lower API objects into a dense scheduling snapshot.
 
-    `assigned_pods` are pods already bound to nodes (they contribute to
-    occupancy the way MapPodsToMachines does, predicates.go:379-392).
+    `assigned_pods` are pods already bound to nodes; they contribute to
+    occupancy the way MapPodsToMachines does (predicates.go:379-392),
+    with terminal-phase pods filtered out.
     """
     nodes = list(nodes)
     pending_pods = list(pending_pods)
     services = list(services)
+    # Terminal-phase filtering applies to OCCUPANCY (MapPodsToMachines /
+    # filterNonRunningPods, predicates.go:361-377) but NOT to service
+    # spreading counts — CalculateSpreadPriority lists pods by selector
+    # with no phase filter (spreading.go:44-57).
+    all_assigned = list(assigned_pods)
+    assigned_pods = [
+        p for p in all_assigned if p.status.phase not in ("Succeeded", "Failed")
+    ]
     node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
     N, P, S = len(nodes), len(pending_pods), len(services)
 
@@ -262,12 +299,12 @@ def build_snapshot(
         pod_sel_rows[i] = row
         for port in pod_host_ports(p):
             port_vocab.id(str(port))
-        for vol in pod_exclusive_volumes(p):
+        for vol, _rw in pod_volumes(p):
             vol_vocab.id(vol)
     for p in assigned_pods:
         for port in pod_host_ports(p):
             port_vocab.id(str(port))
-        for vol in pod_exclusive_volumes(p):
+        for vol, _rw in pod_volumes(p):
             vol_vocab.id(vol)
 
     LW, PW, VW = label_vocab.words, port_vocab.words, vol_vocab.words
@@ -275,21 +312,27 @@ def build_snapshot(
     # -- pod columns --
     cpu_req = np.zeros(P, dtype=np.float32)
     mem_req = np.zeros(P, dtype=np.float32)
+    zero_req = np.zeros(P, dtype=bool)
     port_bits = np.zeros((P, PW), dtype=np.uint32)
-    vol_bits = np.zeros((P, VW), dtype=np.uint32)
+    vol_any = np.zeros((P, VW), dtype=np.uint32)
+    vol_rw = np.zeros((P, VW), dtype=np.uint32)
     pinned = np.full(P, -1, dtype=np.int32)
     service_id = np.full(P, -1, dtype=np.int32)
+    svc_member = np.zeros((P, max(S, 1)), dtype=np.float32)
     for i, p in enumerate(pending_pods):
-        cpu, mem = pod_resource_request(p)
+        cpu, mem = pod_resource_limits(p)
         cpu_req[i] = cpu
-        mem_req[i] = mem_to_mib(mem)
+        mem_req[i] = mem_to_mib_ceil(mem)
+        zero_req[i] = cpu == 0 and mem == 0
         port_bits[i] = bitset([port_vocab.id(str(x)) for x in pod_host_ports(p)], PW)
-        vol_bits[i] = bitset(
-            [vol_vocab.id(v) for v in pod_exclusive_volumes(p)], VW
-        )
+        vols = pod_volumes(p)
+        vol_any[i] = bitset([vol_vocab.id(v) for v, _ in vols], VW)
+        vol_rw[i] = bitset([vol_vocab.id(v) for v, rw in vols if rw], VW)
         if p.spec.node_name:
-            pinned[i] = node_index.get(p.spec.node_name, -2)  # -2: unknown node
-        service_id[i] = _first_matching_service(p, services)
+            pinned[i] = node_index.get(p.spec.node_name, -2)
+        svc_member[i] = _service_membership(p, services)
+        nz = np.nonzero(svc_member[i][:S])[0]
+        service_id[i] = int(nz[0]) if len(nz) else -1
 
     sel_bits = np.zeros((len(sel_keys), LW), dtype=np.uint32)
     for sel, row in sel_keys.items():
@@ -298,11 +341,17 @@ def build_snapshot(
     # -- node columns --
     cpu_cap = np.zeros(N, dtype=np.float32)
     mem_cap = np.zeros(N, dtype=np.float32)
+    pods_cap = np.zeros(N, dtype=np.float32)
+    cpu_fit_used = np.zeros(N, dtype=np.float32)
+    mem_fit_used = np.zeros(N, dtype=np.float32)
+    overcommitted = np.zeros(N, dtype=bool)
     cpu_used = np.zeros(N, dtype=np.float32)
     mem_used = np.zeros(N, dtype=np.float32)
+    pods_used = np.zeros(N, dtype=np.float32)
     label_bits = np.zeros((N, LW), dtype=np.uint32)
     used_port_bits = np.zeros((N, PW), dtype=np.uint32)
-    used_vol_bits = np.zeros((N, VW), dtype=np.uint32)
+    used_vol_any = np.zeros((N, VW), dtype=np.uint32)
+    used_vol_rw = np.zeros((N, VW), dtype=np.uint32)
     service_counts = np.zeros((N, max(S, 1)), dtype=np.float32)
     schedulable = np.zeros(N, dtype=bool)
     for j, n in enumerate(nodes):
@@ -313,6 +362,8 @@ def build_snapshot(
             # Capacity rounds DOWN (requests round up) so lowering can
             # only under-promise, never overcommit a node.
             mem_cap[j] = cap[RESOURCE_MEMORY].value() // MIB
+        if RESOURCE_PODS in cap:
+            pods_cap[j] = cap[RESOURCE_PODS].value()
         label_bits[j] = bitset(
             [label_vocab.id(f"{k}={v}") for k, v in (n.metadata.labels or {}).items()],
             LW,
@@ -323,40 +374,65 @@ def build_snapshot(
         j = node_index.get(p.spec.node_name)
         if j is None:
             continue
-        cpu, mem = pod_resource_request(p)
+        cpu, mem = pod_resource_limits(p)
+        mem_mib = mem_to_mib_ceil(mem)
+        # Scoring-side: full sums + pod count.
         cpu_used[j] += cpu
-        mem_used[j] += mem_to_mib(mem)
+        mem_used[j] += mem_mib
+        pods_used[j] += 1
+        # Feasibility-side: greedy simulation in list order.
+        fits_cpu = cpu_cap[j] == 0 or cpu_fit_used[j] + cpu <= cpu_cap[j]
+        fits_mem = mem_cap[j] == 0 or mem_fit_used[j] + mem_mib <= mem_cap[j]
+        if fits_cpu and fits_mem:
+            cpu_fit_used[j] += cpu
+            mem_fit_used[j] += mem_mib
+        else:
+            overcommitted[j] = True
         used_port_bits[j] |= bitset(
             [port_vocab.id(str(x)) for x in pod_host_ports(p)], PW
         )
-        used_vol_bits[j] |= bitset(
-            [vol_vocab.id(v) for v in pod_exclusive_volumes(p)], VW
-        )
-        svc = _first_matching_service(p, services)
-        if svc >= 0:
-            service_counts[j, svc] += 1
+        vols = pod_volumes(p)
+        used_vol_any[j] |= bitset([vol_vocab.id(v) for v, _ in vols], VW)
+        used_vol_rw[j] |= bitset([vol_vocab.id(v) for v, rw in vols if rw], VW)
+
+    # Spreading counts: every pod (phase-unfiltered) contributes to
+    # every service whose selector matches its labels.
+    for p in all_assigned:
+        j = node_index.get(p.spec.node_name)
+        if j is None:
+            continue
+        service_counts[j] += _service_membership(p, services)
 
     return Snapshot(
         pods=PodColumns(
             names=[pod_key(p) for p in pending_pods],
             cpu_milli=cpu_req,
             mem_mib=mem_req,
+            zero_req=zero_req,
             selector_id=pod_sel_rows,
             port_bits=port_bits,
-            vol_bits=vol_bits,
+            vol_any_bits=vol_any,
+            vol_rw_bits=vol_rw,
             pinned_node=pinned,
             service_id=service_id,
+            svc_member=svc_member,
             sel_bits=sel_bits,
         ),
         nodes=NodeColumns(
             names=[n.metadata.name for n in nodes],
             cpu_cap=cpu_cap,
             mem_cap=mem_cap,
+            pods_cap=pods_cap,
+            cpu_fit_used=cpu_fit_used,
+            mem_fit_used=mem_fit_used,
+            overcommitted=overcommitted,
             cpu_used=cpu_used,
             mem_used=mem_used,
+            pods_used=pods_used,
             label_bits=label_bits,
             used_port_bits=used_port_bits,
-            used_vol_bits=used_vol_bits,
+            used_vol_any_bits=used_vol_any,
+            used_vol_rw_bits=used_vol_rw,
             service_counts=service_counts,
             schedulable=schedulable,
         ),

@@ -1,0 +1,13 @@
+"""Device ops: the TPU scheduling solver.
+
+The reference's per-pod Go loops (generic_scheduler.go:106-171) become
+a jitted lax.scan whose carry is the cluster occupancy state and whose
+per-step body evaluates every predicate and priority for one pod
+against ALL nodes as vector ops. Node-axis arrays shard over a
+jax.sharding.Mesh for multi-chip scale-out.
+"""
+
+from kubernetes_tpu.ops.matrices import DeviceSnapshot, device_snapshot
+from kubernetes_tpu.ops.solver import solve, solve_assignments
+
+__all__ = ["DeviceSnapshot", "device_snapshot", "solve", "solve_assignments"]

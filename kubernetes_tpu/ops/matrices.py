@@ -1,0 +1,122 @@
+"""Host snapshot -> device arrays.
+
+Uploads the columnar Snapshot (kubernetes_tpu.models.columnar) to the
+accelerator, optionally sharding every node-axis array over a
+jax.sharding.Mesh axis ("nodes"). Pod-axis arrays are replicated: the
+solver scans over pods, so each step broadcasts one pod against the
+sharded node state (the TPU analog of the reference's
+pod-at-a-time loop against the full cluster).
+
+Shapes are padded to multiples of `pad_to` so repeated solves with
+slightly different cluster sizes reuse the compiled executable
+(XLA static-shape requirement; SURVEY.md hard part (d)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.columnar import Snapshot
+
+
+def _pad(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
+    """Pad axis 0 to length n."""
+    if arr.shape[0] == n:
+        return arr
+    pad_width = [(0, n - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=fill)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m if x > 0 else m
+
+
+@dataclass
+class DeviceSnapshot:
+    """Device-resident scheduling problem. `pods`/`nodes` are dicts of
+    jnp arrays; padded entries are masked off (pods: pinned == -2 never
+    fits anywhere; nodes: schedulable == False)."""
+
+    pods: Dict[str, jnp.ndarray]
+    nodes: Dict[str, jnp.ndarray]
+    n_pods: int  # real (unpadded) counts
+    n_nodes: int
+
+    @property
+    def pod_count_padded(self) -> int:
+        return int(self.pods["cpu"].shape[0])
+
+    @property
+    def node_count_padded(self) -> int:
+        return int(self.nodes["cpu_cap"].shape[0])
+
+
+def device_snapshot(
+    snap: Snapshot,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    node_axis: str = "nodes",
+    pad_to: int = 128,
+) -> DeviceSnapshot:
+    P, N = snap.pods.count, snap.nodes.count
+    PP = _round_up(P, pad_to)
+    # The node axis must divide evenly across mesh shards.
+    node_mult = pad_to
+    if mesh is not None:
+        node_mult = max(pad_to, int(np.prod([mesh.shape[a] for a in mesh.axis_names])))
+    NP = _round_up(N, node_mult)
+
+    p = snap.pods
+    sel_rows = p.sel_bits[p.selector_id] if P else np.zeros((0, p.sel_bits.shape[1]), np.uint32)
+    pods = {
+        "cpu": _pad(p.cpu_milli, PP),
+        "mem": _pad(p.mem_mib, PP),
+        "zero_req": _pad(p.zero_req, PP, fill=False),
+        "sel": _pad(sel_rows, PP),
+        "port": _pad(p.port_bits, PP),
+        "vol_any": _pad(p.vol_any_bits, PP),
+        "vol_rw": _pad(p.vol_rw_bits, PP),
+        # Padding pods are pinned to -2 (an impossible node) so they
+        # always come back unassigned.
+        "pinned": _pad(p.pinned_node, PP, fill=-2),
+        "svc": _pad(p.service_id, PP, fill=-1),
+        "svc_member": _pad(p.svc_member, PP),
+    }
+    n = snap.nodes
+    nodes = {
+        "cpu_cap": _pad(n.cpu_cap, NP),
+        "mem_cap": _pad(n.mem_cap, NP),
+        "pods_cap": _pad(n.pods_cap, NP),
+        "cpu_fit": _pad(n.cpu_fit_used, NP),
+        "mem_fit": _pad(n.mem_fit_used, NP),
+        "over": _pad(n.overcommitted, NP, fill=False),
+        "cpu_used": _pad(n.cpu_used, NP),
+        "mem_used": _pad(n.mem_used, NP),
+        "pods_used": _pad(n.pods_used, NP),
+        "labels": _pad(n.label_bits, NP),
+        "uport": _pad(n.used_port_bits, NP),
+        "uvol_any": _pad(n.used_vol_any_bits, NP),
+        "uvol_rw": _pad(n.used_vol_rw_bits, NP),
+        "svc_counts": _pad(n.service_counts, NP),
+        # Padding nodes are unschedulable -> never chosen.
+        "sched": _pad(n.schedulable, NP, fill=False),
+    }
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        node_sharding = NamedSharding(mesh, PS(node_axis))
+        repl = NamedSharding(mesh, PS())
+        nodes = {
+            k: jax.device_put(v, node_sharding) for k, v in nodes.items()
+        }
+        pods = {k: jax.device_put(v, repl) for k, v in pods.items()}
+    else:
+        nodes = {k: jnp.asarray(v) for k, v in nodes.items()}
+        pods = {k: jnp.asarray(v) for k, v in pods.items()}
+
+    return DeviceSnapshot(pods=pods, nodes=nodes, n_pods=P, n_nodes=N)

@@ -1,0 +1,181 @@
+"""The TPU assignment solver.
+
+Replicates the reference's sequential greedy semantics — pod k's
+placement affects pod k+1's feasibility and scores — as a jitted
+lax.scan whose carry is the cluster occupancy state. Each scan step
+evaluates the full default predicate/priority pipeline for ONE pod
+against ALL nodes as vector ops:
+
+  predicates (masks):           reference
+    resources + pod count       PodFitsResources  predicates.go:139-156
+    nodeSelector subset         MatchNodeSelector predicates.go:184-190
+    hostPort conflicts          PodFitsPorts      predicates.go:337-349
+    exclusive volumes           NoDiskConflict    predicates.go:85-95
+    pinned host                 HostName          predicates.go:192-197
+  priorities (scores, exact integer math):
+    LeastRequested              priorities.go:31-95 (int32 division)
+    BalancedResourceAllocation  priorities.go:146-205 (f32 fractions)
+    ServiceSpreading            spreading.go:38-87 (f32, like Go's float32)
+
+Score-tie selection is "lowest node index", matching the scalar
+oracle's deterministic tie-break (generic.py select_host).
+
+All node-axis tensors may be sharded over a Mesh axis; XLA SPMD then
+turns the per-step argmax into a sharded reduce + tiny all-reduce over
+ICI, and the occupancy updates stay local to the owning shard.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.ops.matrices import DeviceSnapshot
+
+# Weighted-sum weights for the default provider (defaults.go:51-60):
+# LeastRequested=1, BalancedResourceAllocation=1, ServiceSpreading=1.
+DEFAULT_WEIGHTS = (1, 1, 1)
+
+
+def _feasible(pod: Dict, nodes: Dict, N: int) -> jnp.ndarray:
+    """All default predicates as one bool[N] mask."""
+    cpu_cap, mem_cap = nodes["cpu_cap"], nodes["mem_cap"]
+    # -- PodFitsResources --
+    fits_cpu = (cpu_cap == 0) | (nodes["cpu_fit"] + pod["cpu"] <= cpu_cap)
+    fits_mem = (mem_cap == 0) | (nodes["mem_fit"] + pod["mem"] <= mem_cap)
+    fits_count = nodes["pods_used"] + 1 <= nodes["pods_cap"]
+    nonzero_ok = (~nodes["over"]) & fits_cpu & fits_mem & fits_count
+    # Zero-request pods only check pod-count headroom (predicates.go:146).
+    zero_ok = nodes["pods_used"] < nodes["pods_cap"]
+    res_ok = jnp.where(pod["zero_req"], zero_ok, nonzero_ok)
+    # -- MatchNodeSelector: selector bits must be a subset of labels --
+    sel = pod["sel"][None, :]
+    sel_ok = jnp.all((sel & nodes["labels"]) == sel, axis=1)
+    # -- PodFitsPorts --
+    port_ok = ~jnp.any(pod["port"][None, :] & nodes["uport"], axis=1)
+    # -- NoDiskConflict: conflict when either side holds it read-write --
+    vol_conflict = jnp.any(
+        (pod["vol_rw"][None, :] & nodes["uvol_any"])
+        | (pod["vol_any"][None, :] & nodes["uvol_rw"]),
+        axis=1,
+    )
+    # -- HostName --
+    idx = jnp.arange(N, dtype=jnp.int32)
+    host_ok = (pod["pinned"] == -1) | (idx == pod["pinned"])
+    return res_ok & sel_ok & port_ok & (~vol_conflict) & host_ok & nodes["sched"]
+
+
+def _scores(pod: Dict, nodes: Dict, weights) -> jnp.ndarray:
+    """Weighted default priorities as one int32[N] score vector."""
+    # Integer score math in int32: columns are integer-valued f32 with
+    # magnitudes < 2^24, so the cast is exact and the Go int64 division
+    # semantics (truncation of nonnegative quotients) are reproduced
+    # without float rounding hazards.
+    cpu_cap = nodes["cpu_cap"].astype(jnp.int32)
+    mem_cap = nodes["mem_cap"].astype(jnp.int32)
+    cpu_req = (nodes["cpu_used"] + pod["cpu"]).astype(jnp.int32)
+    mem_req = (nodes["mem_used"] + pod["mem"]).astype(jnp.int32)
+
+    def calc_score(req, cap):
+        # priorities.go:31-40: 0 if cap == 0 or req > cap.
+        raw = jnp.where(cap > 0, ((cap - req) * 10) // jnp.maximum(cap, 1), 0)
+        return jnp.where((cap == 0) | (req > cap), 0, raw)
+
+    lr = (calc_score(cpu_req, cpu_cap) + calc_score(mem_req, mem_cap)) // 2
+
+    # BalancedResourceAllocation (priorities.go:146-205). TPU float
+    # division is reciprocal-based and NOT correctly rounded (~1 ulp
+    # low), which truncates scores one short at exact boundaries like
+    # |0.75-0.25|*10 == 5. The epsilon absorbs that device error; it is
+    # far below the smallest legitimate gap between distinct exact
+    # score values for realistic capacities.
+    cfrac = jnp.where(cpu_cap == 0, 1.0, cpu_req / jnp.maximum(cpu_cap, 1))
+    mfrac = jnp.where(mem_cap == 0, 1.0, mem_req / jnp.maximum(mem_cap, 1))
+    bra = jnp.where(
+        (cfrac >= 1) | (mfrac >= 1),
+        0,
+        (10 - jnp.abs(cfrac - mfrac) * 10 + 1e-5).astype(jnp.int32),
+    )
+
+    # ServiceSpreading (spreading.go:38-87) in exact integer math
+    # (counts are small integers): 10*(maxc-count) // maxc. Go truncates
+    # the float32 quotient; integer division agrees except where Go's
+    # f32 rounding lands exactly on an integer from below — rare and
+    # covered by the >=99% parity budget.
+    svc = pod["svc"]
+    counts = jax.lax.dynamic_index_in_dim(
+        nodes["svc_counts"], jnp.maximum(svc, 0), axis=1, keepdims=False
+    ).astype(jnp.int32)
+    maxc = jnp.max(counts)
+    spread_raw = (10 * (maxc - counts)) // jnp.maximum(maxc, 1)
+    spread = jnp.where((svc < 0) | (maxc == 0), 10, spread_raw)
+
+    w_lr, w_bra, w_spread = weights
+    return lr * w_lr + bra * w_bra + spread * w_spread
+
+
+def _commit(nodes: Dict, pod: Dict, choice: jnp.ndarray, N: int) -> Dict:
+    """Apply one placement to the occupancy carry (the batch analog of
+    Modeler.AssumePod, modeler.go:113)."""
+    assigned = choice >= 0
+    onehot = (jnp.arange(N, dtype=jnp.int32) == choice) & assigned
+    fonehot = onehot.astype(jnp.float32)
+    new = dict(nodes)
+    new["cpu_fit"] = nodes["cpu_fit"] + fonehot * pod["cpu"]
+    new["mem_fit"] = nodes["mem_fit"] + fonehot * pod["mem"]
+    new["cpu_used"] = nodes["cpu_used"] + fonehot * pod["cpu"]
+    new["mem_used"] = nodes["mem_used"] + fonehot * pod["mem"]
+    new["pods_used"] = nodes["pods_used"] + fonehot
+    mask = onehot[:, None]
+    new["uport"] = jnp.where(mask, nodes["uport"] | pod["port"][None, :], nodes["uport"])
+    new["uvol_any"] = jnp.where(
+        mask, nodes["uvol_any"] | pod["vol_any"][None, :], nodes["uvol_any"]
+    )
+    new["uvol_rw"] = jnp.where(
+        mask, nodes["uvol_rw"] | pod["vol_rw"][None, :], nodes["uvol_rw"]
+    )
+    # As an existing pod, the placement counts toward EVERY service
+    # whose selector matches it (multi-hot membership row).
+    new["svc_counts"] = nodes["svc_counts"] + (
+        fonehot[:, None] * pod["svc_member"][None, :]
+    )
+    return new
+
+
+@functools.partial(jax.jit, static_argnames=("weights",))
+def solve(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+) -> jnp.ndarray:
+    """Sequential-parity assignment: i32[P] of node indices (-1 =
+    unschedulable). The scan IS the reference's scheduleOne loop."""
+    N = nodes["cpu_cap"].shape[0]
+
+    def step(carry, pod):
+        feas = _feasible(pod, carry, N)
+        score = _scores(pod, carry, weights)
+        masked = jnp.where(feas, score, -1)
+        best = jnp.argmax(masked)  # first max = lowest node index
+        choice = jnp.where(jnp.any(feas), best.astype(jnp.int32), -1)
+        return _commit(carry, pod, choice, N), choice
+
+    _, assignment = jax.lax.scan(step, nodes, pods)
+    return assignment
+
+
+def solve_assignments(
+    dsnap: DeviceSnapshot, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS
+) -> np.ndarray:
+    """Run the solver and strip padding: returns i32[n_pods] with real
+    node indices (-1 unschedulable)."""
+    out = np.asarray(solve(dsnap.pods, dsnap.nodes, weights))
+    out = out[: dsnap.n_pods]
+    # Padding nodes can never be chosen (schedulable=False), but clamp
+    # defensively so a bug can't leak a phantom index.
+    out = np.where(out >= dsnap.n_nodes, -1, out)
+    return out

@@ -1,0 +1,101 @@
+"""Batch scheduling: solve a whole pending-pod backlog at once.
+
+The TPU path (north star): lower the backlog + cluster to a columnar
+Snapshot, upload, run the jitted sequential-parity solver, and return
+per-pod node assignments. `schedule_backlog_scalar` drives the exact
+same problem through the scalar oracle pipeline — it is both the
+fallback path (reference: stock FitPredicate path when the sidecar is
+unavailable) and the parity yardstick.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.models.columnar import Snapshot, build_snapshot
+from kubernetes_tpu.models.objects import Node, Pod, Service
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler, NoNodesError
+from kubernetes_tpu.scheduler.plugins import (
+    PluginFactoryArgs,
+    default_predicates,
+    default_priorities,
+)
+from kubernetes_tpu.scheduler.types import (
+    StaticNodeLister,
+    StaticPodLister,
+    StaticServiceLister,
+)
+
+
+def schedule_backlog_scalar(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+) -> List[Optional[str]]:
+    """Schedule the backlog one pod at a time through the scalar oracle,
+    committing each placement before the next (the reference's
+    scheduleOne + AssumePod semantics). Returns node names (None =
+    unschedulable)."""
+    committed: List[Pod] = list(assigned)
+    pod_lister = StaticPodLister(committed)  # shared, mutated as we commit
+    args = PluginFactoryArgs(
+        pod_lister=pod_lister,
+        service_lister=StaticServiceLister(list(services)),
+        node_lister=StaticNodeLister(list(nodes)),
+    )
+    scheduler = GenericScheduler(
+        default_predicates(args), default_priorities(args), pod_lister
+    )
+    out: List[Optional[str]] = []
+    ready_nodes = StaticNodeLister(
+        [n for n in nodes if _node_ready(n)]
+    )
+    for pod in pending:
+        try:
+            dest = scheduler.schedule(pod, ready_nodes)
+        except (FitError, NoNodesError):
+            out.append(None)
+            continue
+        out.append(dest)
+        placed = copy.deepcopy(pod)
+        placed.spec.node_name = dest
+        pod_lister.pods.append(placed)
+    return out
+
+
+def _node_ready(node: Node) -> bool:
+    from kubernetes_tpu.models.columnar import node_is_ready
+
+    return node_is_ready(node)
+
+
+def schedule_backlog_tpu(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+    mesh=None,
+) -> List[Optional[str]]:
+    """Schedule the backlog on the accelerator. Same decision semantics
+    as schedule_backlog_scalar (>=99% parity target, BASELINE.md)."""
+    from kubernetes_tpu.ops import device_snapshot, solve_assignments
+
+    snap = build_snapshot(pending, nodes, assigned_pods=assigned, services=services)
+    dsnap = device_snapshot(snap, mesh=mesh)
+    assignment = solve_assignments(dsnap)
+    names = snap.nodes.names
+    return [names[i] if i >= 0 else None for i in assignment]
+
+
+def parity_report(
+    scalar: Sequence[Optional[str]], batch: Sequence[Optional[str]]
+) -> Tuple[float, List[int]]:
+    """Fraction of identical decisions + indices of mismatches."""
+    assert len(scalar) == len(batch)
+    mismatches = [i for i, (a, b) in enumerate(zip(scalar, batch)) if a != b]
+    parity = 1.0 - len(mismatches) / max(1, len(scalar))
+    return parity, mismatches

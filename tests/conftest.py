@@ -1,12 +1,25 @@
 """Test configuration: force an 8-device virtual CPU platform so
 multi-chip sharding paths (Mesh/pjit/shard_map) are exercised without
-TPU hardware. Must run before jax is imported anywhere."""
+TPU hardware.
+
+Two subtleties on this machine:
+- A sitecustomize imports jax at interpreter start and registers the
+  tunneled TPU platform, so JAX_PLATFORMS set here via os.environ is
+  too late — jax.config.update('jax_platforms', ...) is the reliable
+  override (and insulates tests from TPU-tunnel outages).
+- XLA_FLAGS must still be set before the CPU backend initializes,
+  which happens at first use, so setting it here works.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after env setup on purpose)
+
+jax.config.update("jax_platforms", "cpu")

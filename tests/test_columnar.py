@@ -13,7 +13,7 @@ from kubernetes_tpu.models import (
     Service,
     ServiceSpec,
 )
-from kubernetes_tpu.models.columnar import build_snapshot, pod_resource_request
+from kubernetes_tpu.models.columnar import build_snapshot, pod_resource_limits
 from kubernetes_tpu.models.objects import (
     GCEPersistentDiskVolumeSource,
     NodeCondition,
@@ -37,7 +37,7 @@ def mk_pod(name, cpu="100m", mem="64Mi", node_name="", selector=None, host_port=
                     image="nginx",
                     ports=ports,
                     resources=ResourceRequirements(
-                        requests={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
+                        limits={"cpu": parse_quantity(cpu), "memory": parse_quantity(mem)}
                     ),
                 )
             ],
@@ -58,18 +58,18 @@ def mk_node(name, cpu="4", mem="8Gi", labels=None, ready=True):
     )
 
 
-def test_resource_request_sums_containers():
+def test_resource_limits_sum_containers():
     pod = mk_pod("p")
     pod.spec.containers.append(
         Container(
             name="c2",
             image="x",
             resources=ResourceRequirements(
-                requests={"cpu": parse_quantity("1"), "memory": parse_quantity("1Gi")}
+                limits={"cpu": parse_quantity("1"), "memory": parse_quantity("1Gi")}
             ),
         )
     )
-    cpu, mem = pod_resource_request(pod)
+    cpu, mem = pod_resource_limits(pod)
     assert cpu == 1100
     assert mem == 64 * 1024**2 + 1024**3
 
@@ -127,7 +127,7 @@ def test_ports_and_volumes_bits():
     # Conflict on n0 (same hostPort + same PD), clean on n1.
     assert (snap.pods.port_bits[0] & snap.nodes.used_port_bits[0]).any()
     assert not (snap.pods.port_bits[0] & snap.nodes.used_port_bits[1]).any()
-    assert (snap.pods.vol_bits[0] & snap.nodes.used_vol_bits[0]).any()
+    assert (snap.pods.vol_any_bits[0] & snap.nodes.used_vol_any_bits[0]).any()
 
 
 def test_pinned_node_and_readiness():

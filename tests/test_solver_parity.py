@@ -1,0 +1,300 @@
+"""TPU solver vs scalar oracle parity — the core correctness bar for
+the batch path (BASELINE.md: >=99% decision parity; these small cases
+must be exact)."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.models.objects import (
+    Container,
+    ContainerPort,
+    GCEPersistentDiskVolumeSource,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    Service,
+    ServiceSpec,
+    Volume,
+)
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+from kubernetes_tpu.scheduler.batch import (
+    parity_report,
+    schedule_backlog_scalar,
+    schedule_backlog_tpu,
+)
+
+MIB = 1024**2
+
+
+def mk_pod(
+    name,
+    cpu=100,
+    mem_mib=64,
+    selector=None,
+    host_port=0,
+    pd=None,
+    pinned="",
+    labels=None,
+    ns="default",
+):
+    vols = []
+    if pd:
+        vols.append(
+            Volume(name="v", gce_persistent_disk=GCEPersistentDiskVolumeSource(pd_name=pd))
+        )
+    ports = [ContainerPort(container_port=80, host_port=host_port)] if host_port else []
+    limits = {}
+    if cpu:
+        limits["cpu"] = Quantity.from_milli(cpu)
+    if mem_mib:
+        limits["memory"] = parse_quantity(f"{mem_mib}Mi")
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="c", image="x", ports=ports,
+                    resources=ResourceRequirements(limits=limits),
+                )
+            ],
+            volumes=vols,
+            node_selector=selector or {},
+            node_name=pinned,
+        ),
+    )
+
+
+def mk_node(name, cpu=4000, mem_mib=8192, pods=40, labels=None, ready=True):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(
+            capacity={
+                "cpu": Quantity.from_milli(cpu),
+                "memory": parse_quantity(f"{mem_mib}Mi"),
+                "pods": Quantity.from_int(pods),
+            },
+            conditions=[NodeCondition(type="Ready", status="True" if ready else "False")],
+        ),
+    )
+
+
+def assert_parity(pending, nodes, assigned=(), services=(), min_parity=1.0):
+    scalar = schedule_backlog_scalar(pending, nodes, assigned, services)
+    batch = schedule_backlog_tpu(pending, nodes, assigned, services)
+    parity, mismatches = parity_report(scalar, batch)
+    assert parity >= min_parity, (
+        f"parity {parity:.3f}, mismatches at {mismatches[:10]}: "
+        + ", ".join(
+            f"#{i} scalar={scalar[i]} batch={batch[i]}" for i in mismatches[:5]
+        )
+    )
+    return scalar, batch
+
+
+class TestExactParity:
+    def test_empty_cluster(self):
+        scalar, batch = assert_parity([mk_pod("p0")], [])
+        assert scalar == [None]
+
+    def test_single_pod_single_node(self):
+        scalar, batch = assert_parity([mk_pod("p0")], [mk_node("n0")])
+        assert scalar == ["n0"]
+
+    def test_sequential_spreading(self):
+        """Identical pods must spread the same way in both paths (each
+        placement changes the next pod's scores)."""
+        pods = [mk_pod(f"p{i}", cpu=500, mem_mib=512) for i in range(8)]
+        nodes = [mk_node(f"n{j}", cpu=2000, mem_mib=4096) for j in range(3)]
+        assert_parity(pods, nodes)
+
+    def test_capacity_exhaustion(self):
+        pods = [mk_pod(f"p{i}", cpu=600, mem_mib=64) for i in range(5)]
+        nodes = [mk_node("n0", cpu=1000, mem_mib=8192, pods=40)]
+        scalar, batch = assert_parity(pods, nodes)
+        assert scalar[0] == "n0" and scalar[1] is None  # 600+600 > 1000
+
+    def test_pod_count_capacity(self):
+        pods = [mk_pod(f"p{i}", cpu=10, mem_mib=1) for i in range(4)]
+        nodes = [mk_node("n0", pods=2), mk_node("n1", pods=2)]
+        scalar, batch = assert_parity(pods, nodes)
+        assert scalar.count(None) == 0
+
+    def test_zero_request_pods(self):
+        pods = [mk_pod(f"p{i}", cpu=0, mem_mib=0) for i in range(3)]
+        nodes = [mk_node("n0", pods=2), mk_node("n1", pods=1)]
+        assert_parity(pods, nodes)
+
+    def test_node_selector(self):
+        pods = [
+            mk_pod("ssd1", selector={"disk": "ssd"}),
+            mk_pod("hdd1", selector={"disk": "hdd"}),
+            mk_pod("any1"),
+            mk_pod("impossible", selector={"disk": "tape"}),
+        ]
+        nodes = [
+            mk_node("n-ssd", labels={"disk": "ssd"}),
+            mk_node("n-hdd", labels={"disk": "hdd"}),
+        ]
+        scalar, batch = assert_parity(pods, nodes)
+        assert scalar[0] == "n-ssd" and scalar[1] == "n-hdd"
+        assert scalar[3] is None
+
+    def test_host_ports(self):
+        pods = [mk_pod(f"hp{i}", host_port=8080) for i in range(3)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes)
+        assert scalar[2] is None  # only 2 nodes can hold port 8080
+
+    def test_volumes_exclusive(self):
+        pods = [mk_pod("v1", pd="disk-a"), mk_pod("v2", pd="disk-a")]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes)
+        assert set(scalar) == {"n0", "n1"}
+
+    def test_pinned_host(self):
+        pods = [mk_pod("pin", pinned="n1"), mk_pod("ghost", pinned="nope")]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes)
+        assert scalar == ["n1", None]
+
+    def test_not_ready_node_excluded(self):
+        pods = [mk_pod("p0")]
+        nodes = [mk_node("dead", cpu=64000, ready=False), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes)
+        assert scalar == ["n1"]
+
+    def test_existing_occupancy(self):
+        assigned = [mk_pod("a0", cpu=3000, mem_mib=4096)]
+        assigned[0].spec.node_name = "n0"
+        pods = [mk_pod("p0", cpu=500, mem_mib=512)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes, assigned=assigned)
+        assert scalar == ["n1"]  # n0 is loaded
+
+    def test_overcommitted_node_rejected(self):
+        """A node whose existing pods overflow greedy capacity rejects
+        all new pods (predicates.go:152) — but still scores."""
+        assigned = [
+            mk_pod("a0", cpu=3000, mem_mib=64),
+            mk_pod("a1", cpu=3000, mem_mib=64),
+            mk_pod("a2", cpu=3000, mem_mib=64),  # 9000m > 4000m
+        ]
+        for a in assigned:
+            a.spec.node_name = "n0"
+        pods = [mk_pod("p0", cpu=100, mem_mib=64)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes, assigned=assigned)
+        assert scalar == ["n1"]
+
+    def test_service_spreading(self):
+        svc = Service(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        assigned = [
+            mk_pod("a0", labels={"app": "web"}),
+            mk_pod("a1", labels={"app": "web"}),
+        ]
+        assigned[0].spec.node_name = "n0"
+        assigned[1].spec.node_name = "n0"
+        pods = [mk_pod(f"w{i}", labels={"app": "web"}) for i in range(4)]
+        nodes = [mk_node("n0"), mk_node("n1"), mk_node("n2")]
+        assert_parity(pods, nodes, assigned=assigned, services=[svc])
+
+
+class TestRandomizedParity:
+    """Fuzz parity across random clusters. The sequential-parity solver
+    should match the oracle exactly on Mi-granular inputs."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_cluster(self, seed):
+        rng = random.Random(seed)
+        n_nodes = rng.randint(1, 12)
+        n_pods = rng.randint(1, 40)
+        zones = ["a", "b", "c"]
+        nodes = [
+            mk_node(
+                f"n{j}",
+                cpu=rng.choice([1000, 2000, 4000, 8000]),
+                mem_mib=rng.choice([1024, 4096, 8192]),
+                pods=rng.choice([3, 10, 40]),
+                labels={"zone": rng.choice(zones)} if rng.random() < 0.7 else {},
+                ready=rng.random() > 0.1,
+            )
+            for j in range(n_nodes)
+        ]
+        svc = Service(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        assigned = []
+        for i in range(rng.randint(0, 10)):
+            a = mk_pod(
+                f"a{i}",
+                cpu=rng.choice([0, 100, 500, 1000]),
+                mem_mib=rng.choice([0, 64, 512, 1024]),
+                labels={"app": "web"} if rng.random() < 0.5 else {},
+            )
+            a.spec.node_name = rng.choice(nodes).metadata.name
+            assigned.append(a)
+        pods = [
+            mk_pod(
+                f"p{i}",
+                cpu=rng.choice([0, 50, 100, 500, 1500]),
+                mem_mib=rng.choice([0, 16, 128, 1024]),
+                selector={"zone": rng.choice(zones)} if rng.random() < 0.3 else None,
+                host_port=rng.choice([0, 0, 0, 8080, 9090]),
+                labels={"app": "web"} if rng.random() < 0.4 else {},
+            )
+            for i in range(n_pods)
+        ]
+        assert_parity(pods, nodes, assigned=assigned, services=[svc])
+
+
+class TestSpreadingParityRegressions:
+    """Review findings: overlapping service selectors and terminal-phase
+    pods must not diverge from the scalar oracle."""
+
+    def test_overlapping_service_selectors(self):
+        svc_a = Service(
+            metadata=ObjectMeta(name="svc-a", namespace="default"),
+            spec=ServiceSpec(selector={"a": "1"}),
+        )
+        svc_b = Service(
+            metadata=ObjectMeta(name="svc-b", namespace="default"),
+            spec=ServiceSpec(selector={"b": "1"}),
+        )
+        # Assigned pod matches BOTH services; its own first match is
+        # svc-a, but it must still count against svc-b's spreading.
+        both = mk_pod("both", labels={"a": "1", "b": "1"})
+        both.spec.node_name = "n0"
+        pods = [mk_pod(f"b{i}", labels={"b": "1"}) for i in range(3)]
+        nodes = [mk_node("n0"), mk_node("n1"), mk_node("n2")]
+        assert_parity(pods, nodes, assigned=[both], services=[svc_a, svc_b])
+
+    def test_terminal_phase_pod_still_counts_for_spreading(self):
+        svc = Service(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=ServiceSpec(selector={"app": "web"}),
+        )
+        done = mk_pod("done", labels={"app": "web"})
+        done.spec.node_name = "n0"
+        done.status.phase = "Succeeded"  # free resources, still spreads
+        pods = [mk_pod(f"w{i}", labels={"app": "web"}) for i in range(3)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        scalar, batch = assert_parity(pods, nodes, assigned=[done], services=[svc])
+
+    def test_terminal_phase_pod_frees_occupancy(self):
+        """...but its resources do NOT count (filterNonRunningPods)."""
+        done = mk_pod("done", cpu=3900, mem_mib=64)
+        done.spec.node_name = "n0"
+        done.status.phase = "Failed"
+        pods = [mk_pod("p0", cpu=3000, mem_mib=64)]
+        nodes = [mk_node("n0", cpu=4000)]
+        scalar, batch = assert_parity(pods, nodes, assigned=[done])
+        assert scalar == ["n0"]  # failed pod's cpu is released
