@@ -1,0 +1,18 @@
+"""Reconciliation controllers.
+
+Reference: pkg/controller/ (replication), pkg/service/ (endpoints),
+pkg/cloudprovider/nodecontroller/ (node lifecycle), aggregated by
+cmd/kube-controller-manager.
+"""
+
+from kubernetes_tpu.controllers.replication import ReplicationManager
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.manager import ControllerManager
+
+__all__ = [
+    "ReplicationManager",
+    "EndpointsController",
+    "NodeLifecycleController",
+    "ControllerManager",
+]

@@ -1,0 +1,191 @@
+"""EndpointsController: joins services and ready pods into Endpoints.
+
+Reference: pkg/service/endpoints_controller.go:59,255 — for each
+service, list pods matching its selector, keep the ready ones with pod
+IPs, and write an Endpoints object mirroring the service's ports.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from kubernetes_tpu.client.cache import Informer
+from kubernetes_tpu.models import labels as labelpkg
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import (
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
+    Pod,
+    Service,
+)
+from kubernetes_tpu.server.api import APIError
+
+
+def _decode_pod(wire: dict) -> Pod:
+    return serde.from_wire(Pod, wire)
+
+
+def _decode_service(wire: dict) -> Service:
+    return serde.from_wire(Service, wire)
+
+
+def _pod_ready(pod: Pod) -> bool:
+    if pod.status.phase != "Running" or not pod.status.pod_ip:
+        return False
+    for c in pod.status.conditions:
+        if c.type == "Ready":
+            return c.status == "True"
+    return False
+
+
+class EndpointsController:
+    def __init__(self, client, sync_period: float = 3.0):
+        self.client = client
+        self.sync_period = sync_period
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        mark = lambda o: self._dirty.set()  # noqa: E731
+        self.services = Informer(
+            client, "services", decode=_decode_service,
+            on_add=mark, on_update=mark, on_delete=mark,
+        )
+        self.pods = Informer(
+            client, "pods", decode=_decode_pod,
+            on_add=mark, on_update=mark, on_delete=mark,
+        )
+
+    def start(self) -> "EndpointsController":
+        self.services.start()
+        self.pods.start()
+        self.services.wait_for_sync()
+        self.pods.wait_for_sync()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+        self.services.stop()
+        self.pods.stop()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._dirty.wait(timeout=self.sync_period)
+            self._dirty.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.sync_all()
+            except Exception:
+                pass
+
+    def sync_all(self) -> None:
+        services = self.services.store.list()
+        for svc in services:
+            try:
+                self.sync_service(svc)
+            except Exception:
+                pass
+        self._gc_orphans(services)
+
+    def _gc_orphans(self, services: List[Service]) -> None:
+        """Endpoints whose service is gone are garbage-collected
+        (reference: endpoints_controller.go removes them)."""
+        live = {f"{s.metadata.namespace}/{s.metadata.name}" for s in services}
+        try:
+            eps, _ = self.client.list("endpoints")
+        except APIError:
+            return
+        for ep in eps:
+            key = f"{ep.metadata.namespace}/{ep.metadata.name}"
+            if key not in live:
+                try:
+                    self.client.delete(
+                        "endpoints", ep.metadata.name,
+                        namespace=ep.metadata.namespace or "default",
+                    )
+                except APIError:
+                    pass
+
+    @staticmethod
+    def _resolve_target_port(service_port, pod: Pod) -> int:
+        """findPort (reference: pkg/util/findPort as used by the
+        endpoints controller): int targetPort used directly; named
+        targetPort resolved against the pod's container ports; empty
+        falls back to the service port."""
+        tp = service_port.target_port
+        if isinstance(tp, int) and tp:
+            return tp
+        if isinstance(tp, str) and tp:
+            for c in pod.spec.containers:
+                for p in c.ports:
+                    if p.name == tp:
+                        return p.container_port
+        return service_port.port
+
+    def sync_service(self, svc: Service) -> None:
+        if not svc.spec.selector:
+            return  # headless/external services manage their own endpoints
+        sel = labelpkg.selector_from_set(svc.spec.selector)
+        addresses: List[EndpointAddress] = []
+        first_pod: Optional[Pod] = None
+        for pod in self.pods.store.list():
+            if pod.metadata.namespace != svc.metadata.namespace:
+                continue
+            if not sel.matches(pod.metadata.labels):
+                continue
+            if not _pod_ready(pod):
+                continue
+            if first_pod is None:
+                first_pod = pod
+            addresses.append(
+                EndpointAddress(
+                    ip=pod.status.pod_ip,
+                    target_ref={
+                        "kind": "Pod",
+                        "name": pod.metadata.name,
+                        "namespace": pod.metadata.namespace,
+                        "uid": pod.metadata.uid,
+                    },
+                )
+            )
+        addresses.sort(key=lambda a: a.ip)
+        subsets = []
+        if addresses:
+            subsets = [
+                EndpointSubset(
+                    addresses=addresses,
+                    ports=[
+                        EndpointPort(
+                            name=p.name,
+                            port=self._resolve_target_port(p, first_pod),
+                            protocol=p.protocol,
+                        )
+                        for p in svc.spec.ports
+                    ],
+                )
+            ]
+        ep = Endpoints()
+        ep.metadata.name = svc.metadata.name
+        ep.metadata.namespace = svc.metadata.namespace
+        ep.subsets = subsets
+        ns = svc.metadata.namespace or "default"
+        try:
+            current = self.client.get("endpoints", svc.metadata.name, namespace=ns)
+            if serde.to_wire(current.subsets) == serde.to_wire(ep.subsets):
+                return  # no change
+            current.subsets = ep.subsets
+            self.client.update("endpoints", current, namespace=ns)
+        except APIError as e:
+            if e.code == 404:
+                try:
+                    self.client.create("endpoints", ep, namespace=ns)
+                except APIError:
+                    pass

@@ -1,0 +1,47 @@
+"""ControllerManager: launches all control loops against one client.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go:201-263.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.replication import ReplicationManager
+
+
+class ControllerManager:
+    def __init__(
+        self,
+        client,
+        enable_replication: bool = True,
+        enable_endpoints: bool = True,
+        enable_node_lifecycle: bool = True,
+        node_grace_period: float = 8.0,
+        node_eviction_timeout: float = 4.0,
+    ):
+        self.controllers: List = []
+        if enable_replication:
+            self.replication = ReplicationManager(client)
+            self.controllers.append(self.replication)
+        if enable_endpoints:
+            self.endpoints = EndpointsController(client)
+            self.controllers.append(self.endpoints)
+        if enable_node_lifecycle:
+            self.node_lifecycle = NodeLifecycleController(
+                client,
+                grace_period=node_grace_period,
+                eviction_timeout=node_eviction_timeout,
+            )
+            self.controllers.append(self.node_lifecycle)
+
+    def start(self) -> "ControllerManager":
+        for c in self.controllers:
+            c.start()
+        return self
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()

@@ -1,0 +1,229 @@
+"""ReplicationManager: keeps actual pod counts equal to RC replicas.
+
+Reference: pkg/controller/replication_controller.go:98-384. The
+expectation tracker prevents over-creation while watch events are in
+flight (controller_utils.go RCExpectations): after issuing N creates we
+wait to observe N adds before diffing again.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.client.cache import Informer
+from kubernetes_tpu.models import labels as labelpkg
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import Pod, ReplicationController
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+
+_SYNCS = metrics.DEFAULT.counter(
+    "replication_controller_syncs_total", "RC sync passes", ("result",)
+)
+
+
+def _decode_rc(wire: dict) -> ReplicationController:
+    return serde.from_wire(ReplicationController, wire)
+
+
+def _decode_pod(wire: dict) -> Pod:
+    return serde.from_wire(Pod, wire)
+
+
+class _Expectations:
+    """Per-RC add/del expectations (controller_utils.go)."""
+
+    TIMEOUT = 30.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._exp: Dict[str, tuple] = {}  # key -> (adds, dels, stamp)
+
+    def expect(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            self._exp[key] = (adds, dels, time.monotonic())
+
+    def observe_add(self, key: str) -> None:
+        with self._lock:
+            if key in self._exp:
+                a, d, t = self._exp[key]
+                self._exp[key] = (max(0, a - 1), d, t)
+
+    def observe_del(self, key: str) -> None:
+        with self._lock:
+            if key in self._exp:
+                a, d, t = self._exp[key]
+                self._exp[key] = (a, max(0, d - 1), t)
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            if key not in self._exp:
+                return True
+            a, d, t = self._exp[key]
+            if a <= 0 and d <= 0:
+                return True
+            if time.monotonic() - t > self.TIMEOUT:
+                return True  # expectations expire; resync will fix drift
+            return False
+
+
+class ReplicationManager:
+    BURST_REPLICAS = 500  # reference: 500 (replication_controller.go:64)
+
+    def __init__(self, client, sync_period: float = 5.0):
+        self.client = client
+        self.sync_period = sync_period
+        self.expectations = _Expectations()
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.rcs = Informer(
+            client, "replicationcontrollers", decode=_decode_rc,
+            on_add=lambda o: self._dirty.set(),
+            on_update=lambda o: self._dirty.set(),
+            on_delete=lambda o: self._dirty.set(),
+        )
+        self.pods = Informer(
+            client, "pods", decode=_decode_pod,
+            on_add=self._pod_added,
+            on_delete=self._pod_deleted,
+        )
+
+    # -- watch handlers ----------------------------------------------
+
+    def _rc_key_for_pod(self, pod: Pod) -> Optional[str]:
+        for rc in self.rcs.store.list():
+            if rc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = rc.spec.selector
+            if sel and labelpkg.selector_from_set(sel).matches(pod.metadata.labels):
+                return f"{rc.metadata.namespace}/{rc.metadata.name}"
+        return None
+
+    def _pod_added(self, pod: Pod) -> None:
+        key = self._rc_key_for_pod(pod)
+        if key:
+            self.expectations.observe_add(key)
+        self._dirty.set()
+
+    def _pod_deleted(self, pod: Pod) -> None:
+        key = self._rc_key_for_pod(pod)
+        if key:
+            self.expectations.observe_del(key)
+        self._dirty.set()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "ReplicationManager":
+        self.rcs.start()
+        self.pods.start()
+        self.rcs.wait_for_sync()
+        self.pods.wait_for_sync()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+        self.rcs.stop()
+        self.pods.stop()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._dirty.wait(timeout=self.sync_period)
+            self._dirty.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.sync_all()
+            except Exception:
+                pass
+
+    # -- reconciliation ----------------------------------------------
+
+    def sync_all(self) -> None:
+        # Per-RC error isolation: one broken RC must not starve the rest
+        # (the reference syncs per queue key with individual handling).
+        for rc in self.rcs.store.list():
+            try:
+                self.sync_rc(rc)
+            except Exception:
+                _SYNCS.inc(result="error")
+
+    def _matching_pods(self, rc: ReplicationController) -> List[Pod]:
+        sel = labelpkg.selector_from_set(rc.spec.selector)
+        return [
+            p
+            for p in self.pods.store.list()
+            if p.metadata.namespace == rc.metadata.namespace
+            and sel.matches(p.metadata.labels)
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+
+    def sync_rc(self, rc: ReplicationController) -> None:
+        """syncReplicationController (:351) + manageReplicas (:294)."""
+        key = f"{rc.metadata.namespace}/{rc.metadata.name}"
+        if not self.expectations.satisfied(key):
+            return
+        pods = self._matching_pods(rc)
+        diff = len(pods) - rc.spec.replicas
+        if diff < 0:
+            count = min(-diff, self.BURST_REPLICAS)
+            self.expectations.expect(key, adds=count, dels=0)
+            for _ in range(count):
+                if not self._create_pod(rc):
+                    # Lower expectations by exactly the failed create so
+                    # concurrent watch-observed adds still count
+                    # (reference: rm.expectations.CreationObserved on
+                    # failure, replication_controller.go:294+).
+                    self.expectations.observe_add(key)
+            _SYNCS.inc(result="scale_up")
+        elif diff > 0:
+            count = min(diff, self.BURST_REPLICAS)
+            # Prefer killing unassigned/pending pods first (reference
+            # sorts by activePods ordering).
+            pods.sort(key=lambda p: (p.spec.node_name != "", p.status.phase == "Running"))
+            victims = pods[:count]
+            self.expectations.expect(key, adds=0, dels=len(victims))
+            for p in victims:
+                try:
+                    self.client.delete(
+                        "pods", p.metadata.name,
+                        namespace=p.metadata.namespace or "default",
+                    )
+                except APIError:
+                    self.expectations.observe_del(key)
+            _SYNCS.inc(result="scale_down")
+        else:
+            _SYNCS.inc(result="in_sync")
+        # Status writeback (:384) — guard on the value actually written,
+        # else unchanged writes loop through the watch forever.
+        if rc.status.replicas != len(pods):
+            rc.status.replicas = len(pods)
+            try:
+                self.client.update_status(
+                    "replicationcontrollers", rc,
+                    namespace=rc.metadata.namespace or "default",
+                )
+            except APIError:
+                pass
+
+    def _create_pod(self, rc: ReplicationController) -> bool:
+        tmpl = rc.spec.template
+        if tmpl is None:
+            return False
+        pod = Pod()
+        pod.metadata.generate_name = rc.metadata.name + "-"
+        pod.metadata.namespace = rc.metadata.namespace or "default"
+        pod.metadata.labels = dict(tmpl.metadata.labels or {})
+        pod.spec = serde.from_wire(type(tmpl.spec), serde.to_wire(tmpl.spec))
+        try:
+            self.client.create("pods", pod, namespace=pod.metadata.namespace)
+            return True
+        except APIError:
+            return False

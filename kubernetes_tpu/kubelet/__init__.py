@@ -1,0 +1,14 @@
+"""Node agent (kubelet equivalent).
+
+Reference: pkg/kubelet/. Watches the apiserver for pods assigned to its
+node, drives a pluggable container runtime to match desired state,
+writes status back, heartbeats NodeStatus, and runs liveness/readiness
+probes. The runtime abstraction mirrors pkg/kubelet/container/runtime.go
+with a fake implementation (the reference's own integration strategy:
+cmd/integration runs kubelets with FakeDockerClient).
+"""
+
+from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime, RuntimeContainer
+from kubernetes_tpu.kubelet.agent import Kubelet
+
+__all__ = ["ContainerRuntime", "FakeRuntime", "RuntimeContainer", "Kubelet"]

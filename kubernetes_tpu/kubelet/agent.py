@@ -1,0 +1,390 @@
+"""The kubelet daemon.
+
+Reference: pkg/kubelet/kubelet.go (syncLoop :1657, syncPod :1092),
+pod_workers.go (per-pod serialized workers), status_manager.go
+(apiserver writeback), prober (liveness/readiness), and node
+registration/heartbeats (cmd/kubelet/app/server.go + NodeStatus).
+
+Sources of truth:
+- apiserver watch filtered to spec.nodeName == this node (the
+  reference's apiserver source, pkg/kubelet/config/apiserver.go);
+- optional static-pod manifest dir (file source, config/file.go) —
+  mirrored to the apiserver as "<name>-<node>" pods like mirror pods.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.client.cache import Informer
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import (
+    ContainerStatus,
+    Node,
+    NodeCondition,
+    Pod,
+    PodCondition,
+    now_iso,
+)
+from kubernetes_tpu.models.quantity import parse_quantity
+from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+
+_SYNC_LATENCY = metrics.DEFAULT.summary(
+    "kubelet_sync_pod_latency_seconds", "Pod sync latency", ("node",)
+)
+_PODS_RUNNING = metrics.DEFAULT.gauge(
+    "kubelet_running_pods", "Pods running on this node", ("node",)
+)
+
+
+def _decode_pod(wire: dict) -> Pod:
+    return serde.from_wire(Pod, wire)
+
+
+class _PodWorker:
+    """Serialized per-pod sync executor (pod_workers.go:91-123)."""
+
+    def __init__(self, sync_fn):
+        self._sync = sync_fn
+        self._lock = threading.Lock()
+        self._pending: Optional[Pod] = None
+        self._running = False
+
+    def update(self, pod: Pod) -> None:
+        with self._lock:
+            self._pending = pod
+            if self._running:
+                return
+            self._running = True
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                pod = self._pending
+                self._pending = None
+                if pod is None:
+                    self._running = False
+                    return
+            try:
+                self._sync(pod)
+            except Exception:
+                pass  # crash containment (util.HandleCrash)
+
+
+class Kubelet:
+    def __init__(
+        self,
+        client,
+        node_name: str,
+        runtime: Optional[ContainerRuntime] = None,
+        cpu: str = "4",
+        memory: str = "8Gi",
+        max_pods: int = 110,
+        labels: Optional[Dict[str, str]] = None,
+        heartbeat_period: float = 5.0,
+        sync_period: float = 3.0,
+        manifest_dir: Optional[str] = None,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.runtime = runtime or FakeRuntime()
+        self.cpu = cpu
+        self.memory = memory
+        self.max_pods = max_pods
+        self.labels = labels or {}
+        self.heartbeat_period = heartbeat_period
+        self.sync_period = sync_period
+        self.manifest_dir = manifest_dir
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._workers: Dict[str, _PodWorker] = {}
+        self._workers_lock = threading.Lock()
+        self._probe_failures: Dict[str, int] = {}
+        self.pods = Informer(
+            client,
+            "pods",
+            field_selector=f"spec.nodeName={node_name}",
+            decode=_decode_pod,
+            on_add=self._dispatch,
+            on_update=self._dispatch,
+            on_delete=self._handle_delete,
+        )
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "Kubelet":
+        self.register_node()
+        self.pods.start()
+        self.pods.wait_for_sync()
+        for target in (self._heartbeat_loop, self._resync_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.manifest_dir:
+            t = threading.Thread(target=self._manifest_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.pods.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- node registration + heartbeat (NodeStatus) -------------------
+
+    def register_node(self) -> None:
+        node = Node()
+        node.metadata.name = self.node_name
+        node.metadata.labels = dict(self.labels)
+        node.status.capacity = {
+            "cpu": parse_quantity(self.cpu),
+            "memory": parse_quantity(self.memory),
+            "pods": parse_quantity(str(self.max_pods)),
+        }
+        node.status.conditions = [self._ready_condition()]
+        try:
+            self.client.create("nodes", node)
+        except APIError as e:
+            if e.code != 409:
+                raise
+            self._heartbeat()  # already registered: refresh status
+
+    def _ready_condition(self) -> NodeCondition:
+        return NodeCondition(
+            type="Ready",
+            status="True",
+            last_heartbeat_time=now_iso(),
+            reason="KubeletReady",
+            message="kubelet is posting ready status",
+        )
+
+    def _heartbeat(self) -> None:
+        try:
+            node = self.client.get("nodes", self.node_name)
+        except APIError:
+            self.register_node()
+            return
+        node.status.conditions = [self._ready_condition()]
+        node.status.capacity = {
+            "cpu": parse_quantity(self.cpu),
+            "memory": parse_quantity(self.memory),
+            "pods": parse_quantity(str(self.max_pods)),
+        }
+        try:
+            self.client.update_status("nodes", node)
+        except APIError:
+            pass
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_period):
+            try:
+                self._heartbeat()
+            except Exception:
+                pass
+
+    # -- pod sync -----------------------------------------------------
+
+    def _key(self, pod: Pod) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def _dispatch(self, pod: Pod) -> None:
+        key = self._key(pod)
+        with self._workers_lock:
+            worker = self._workers.get(key)
+            if worker is None:
+                worker = _PodWorker(self._sync_pod)
+                self._workers[key] = worker
+        worker.update(pod)
+
+    def _handle_delete(self, pod: Pod) -> None:
+        uid = pod.metadata.uid or pod.metadata.name
+        self.runtime.kill_pod(uid)
+        with self._workers_lock:
+            self._workers.pop(self._key(pod), None)
+
+    def _resync_loop(self) -> None:
+        """Periodic full resync + orphan GC (syncLoop tick)."""
+        while not self._stop.wait(self.sync_period):
+            try:
+                pods = self.pods.store.list()
+                known_uids = set()
+                for pod in pods:
+                    known_uids.add(pod.metadata.uid or pod.metadata.name)
+                    self._dispatch(pod)
+                for uid in self.runtime.list_pods():
+                    if uid not in known_uids:
+                        self.runtime.kill_pod(uid)  # orphan (container GC)
+                _PODS_RUNNING.set(len(pods), node=self.node_name)
+            except Exception:
+                pass
+
+    def _sync_pod(self, pod: Pod) -> None:
+        """One reconciliation of a single pod (kubelet.go:1092)."""
+        start = time.monotonic()
+        if pod.status.phase in ("Succeeded", "Failed"):
+            return
+        uid = pod.metadata.uid or pod.metadata.name
+
+        # Probes may demand restarts before the runtime sync.
+        self._run_probes(pod, uid)
+
+        containers = self.runtime.sync_pod(pod)
+
+        # Restart policy (dockertools/manager.go:1287+), decided PER
+        # CONTAINER: Always restarts any exited container; OnFailure
+        # only those that exited nonzero (a completed exit-0 workload
+        # container must stay completed).
+        policy = pod.spec.restart_policy
+        restarted = False
+        for c in containers:
+            if c.state != "exited":
+                continue
+            if policy == "Always" or (policy == "OnFailure" and c.exit_code != 0):
+                self.runtime.restart_container(uid, c.name)
+                restarted = True
+        if restarted:
+            containers = self.runtime.sync_pod(pod)  # refresh statuses
+
+        phase = self._pod_phase(pod, containers)
+        statuses = [
+            ContainerStatus(
+                name=c.name,
+                state={c.state: {}},
+                ready=c.state == "running",
+                restart_count=c.restart_count,
+                image=c.image,
+                container_id=c.container_id,
+            )
+            for c in containers
+        ]
+        ready = all(s.ready for s in statuses) and bool(statuses)
+        old_wire = serde.to_wire(pod.status)
+        pod.status.phase = phase
+        pod.status.host_ip = "127.0.0.1"
+        pod.status.pod_ip = self._pod_ip(uid)
+        if not pod.status.start_time:
+            pod.status.start_time = now_iso()
+        pod.status.conditions = [
+            PodCondition(type="Ready", status="True" if ready else "False")
+        ]
+        pod.status.container_statuses = statuses
+        # Status dedup (reference: status_manager.go) — an unchanged
+        # write would bounce back through the watch and re-trigger this
+        # sync, a self-sustaining hot loop.
+        if serde.to_wire(pod.status) != old_wire:
+            try:
+                self.client.update_status(
+                    "pods", pod, namespace=pod.metadata.namespace or "default"
+                )
+            except APIError:
+                pass
+        _SYNC_LATENCY.observe(time.monotonic() - start, node=self.node_name)
+
+    def _pod_ip(self, uid: str) -> str:
+        # Deterministic fake pod IP from the uid (dataplane tests use it).
+        h = abs(hash(uid))
+        return f"10.{(h >> 16) % 256}.{(h >> 8) % 256}.{h % 254 + 1}"
+
+    def _pod_phase(self, pod: Pod, containers) -> str:
+        """Phase derivation (reference: kubelet.go GetPodStatus logic)."""
+        if not containers:
+            return "Pending"
+        states = [c.state for c in containers]
+        codes = [c.exit_code for c in containers]
+        if all(s == "exited" for s in states):
+            if pod.spec.restart_policy == "Never":
+                return "Failed" if any(codes) else "Succeeded"
+            if pod.spec.restart_policy == "OnFailure" and not any(codes):
+                return "Succeeded"
+        if any(s == "running" for s in states):
+            return "Running"
+        return "Pending"
+
+    # -- probes -------------------------------------------------------
+
+    def _run_probes(self, pod: Pod, uid: str) -> None:
+        """Liveness probes kill unhealthy containers so the restart
+        policy path brings them back (prober/prober.go)."""
+        for c in pod.spec.containers:
+            probe = c.liveness_probe
+            if probe is None or probe.exec is None:
+                continue
+            healthy = self.runtime.exec_probe(pod, c.name, probe.exec.command)
+            key = f"{uid}/{c.name}"
+            if healthy:
+                self._probe_failures.pop(key, None)
+                continue
+            failures = self._probe_failures.get(key, 0) + 1
+            self._probe_failures[key] = failures
+            if failures >= 3:  # failureThreshold default
+                if hasattr(self.runtime, "fail_container"):
+                    self.runtime.fail_container(uid, c.name, exit_code=137)
+                self._probe_failures[key] = 0
+                self.client.record_event(
+                    pod, "Unhealthy",
+                    f"Liveness probe failed for {c.name}; restarting",
+                    source=f"kubelet/{self.node_name}",
+                )
+
+    # -- static pods (file source, config/file.go) --------------------
+
+    def _manifest_loop(self) -> None:
+        """Static-pod file source: applies manifest adds/edits/removals
+        as mirror pods (reference: config/file.go + mirror pods)."""
+        # fname -> (content, mirror_name, namespace); only successful
+        # applies are recorded so failures retry next tick.
+        applied: Dict[str, tuple] = {}
+        while not self._stop.wait(2.0):
+            try:
+                files = {
+                    f for f in os.listdir(self.manifest_dir) if f.endswith(".json")
+                }
+            except OSError:
+                continue
+            # Removed manifests: delete their mirror pods.
+            for fname in list(applied):
+                if fname not in files:
+                    _, mirror, ns = applied.pop(fname)
+                    try:
+                        self.client.delete("pods", mirror, namespace=ns)
+                    except APIError:
+                        pass
+            for fname in sorted(files):
+                path = os.path.join(self.manifest_dir, fname)
+                try:
+                    with open(path) as f:
+                        content = f.read()
+                    wire = json.loads(content)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                name = wire.get("metadata", {}).get("name", "")
+                if not name:
+                    continue
+                prev = applied.get(fname)
+                if prev is not None and prev[0] == content:
+                    continue  # unchanged
+                mirror = f"{name}-{self.node_name}"
+                ns = wire.get("metadata", {}).get("namespace", "default")
+                wire["metadata"]["name"] = mirror
+                wire.setdefault("spec", {})["nodeName"] = self.node_name
+                try:
+                    if prev is not None:
+                        # Edited: replace the mirror pod.
+                        try:
+                            self.client.delete("pods", prev[1], namespace=prev[2])
+                        except APIError:
+                            pass
+                    self.client.create("pods", wire, namespace=ns)
+                    applied[fname] = (content, mirror, ns)
+                except APIError as e:
+                    if e.code == 409:  # already mirrored (restart case)
+                        applied[fname] = (content, mirror, ns)

@@ -1,0 +1,134 @@
+"""Container runtime abstraction + fake implementation.
+
+Reference: pkg/kubelet/container/runtime.go (Runtime interface) and
+pkg/kubelet/dockertools/fake_docker_client.go (the fake that backs all
+integration testing). The fake tracks desired containers per pod,
+honors restart policy, and lets tests inject failures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.models.objects import Pod
+
+
+@dataclass
+class RuntimeContainer:
+    name: str
+    image: str
+    container_id: str
+    state: str = "running"  # running | exited | waiting
+    exit_code: int = 0
+    restart_count: int = 0
+    started_at: float = field(default_factory=time.monotonic)
+
+
+class ContainerRuntime:
+    """What the kubelet needs from a runtime (runtime.go:304)."""
+
+    def sync_pod(self, pod: Pod) -> List[RuntimeContainer]:
+        """Start missing containers / replace changed images; exited
+        containers are left alone (restart policy is the kubelet's
+        call, made per-container via restart_container)."""
+        raise NotImplementedError
+
+    def restart_container(self, pod_uid: str, name: str) -> None:
+        raise NotImplementedError
+
+    def kill_pod(self, pod_uid: str) -> None:
+        raise NotImplementedError
+
+    def list_pods(self) -> Dict[str, List[RuntimeContainer]]:
+        """pod uid -> containers (for orphan GC)."""
+        raise NotImplementedError
+
+    def exec_probe(self, pod: Pod, container: str, command: List[str]) -> bool:
+        """Run a probe; True = healthy."""
+        raise NotImplementedError
+
+
+class FakeRuntime(ContainerRuntime):
+    """In-memory runtime. Containers 'run' instantly; tests can fail
+    them (fail_container) or make probes flap (set_probe_result)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pods: Dict[str, Dict[str, RuntimeContainer]] = {}
+        self._probe_results: Dict[str, bool] = {}  # "uid/container" -> healthy
+        self._next_id = 0
+        self.calls: List[str] = []  # recorded operations, oldest first
+
+    def _cid(self) -> str:
+        self._next_id += 1
+        return f"fake://{self._next_id}"
+
+    # -- ContainerRuntime ---------------------------------------------
+
+    def sync_pod(self, pod: Pod) -> List[RuntimeContainer]:
+        uid = pod.metadata.uid or pod.metadata.name
+        with self._lock:
+            containers = self._pods.setdefault(uid, {})
+            desired = {c.name: c for c in pod.spec.containers}
+            # Kill containers no longer desired.
+            for name in list(containers):
+                if name not in desired:
+                    self.calls.append(f"kill {uid}/{name}")
+                    del containers[name]
+            for name, spec in desired.items():
+                cur = containers.get(name)
+                if cur is None:
+                    self.calls.append(f"start {uid}/{name}")
+                    containers[name] = RuntimeContainer(
+                        name=name, image=spec.image, container_id=self._cid()
+                    )
+                elif cur.image != spec.image:
+                    self.calls.append(f"recreate {uid}/{name}")
+                    containers[name] = RuntimeContainer(
+                        name=name,
+                        image=spec.image,
+                        container_id=self._cid(),
+                        restart_count=cur.restart_count + 1,
+                    )
+            return [c for c in containers.values()]
+
+    def restart_container(self, pod_uid: str, name: str) -> None:
+        with self._lock:
+            cur = self._pods.get(pod_uid, {}).get(name)
+            if cur is not None and cur.state == "exited":
+                self.calls.append(f"restart {pod_uid}/{name}")
+                cur.state = "running"
+                cur.exit_code = 0
+                cur.restart_count += 1
+                cur.container_id = self._cid()
+
+    def kill_pod(self, pod_uid: str) -> None:
+        with self._lock:
+            if pod_uid in self._pods:
+                self.calls.append(f"killpod {pod_uid}")
+                del self._pods[pod_uid]
+
+    def list_pods(self) -> Dict[str, List[RuntimeContainer]]:
+        with self._lock:
+            return {uid: list(cs.values()) for uid, cs in self._pods.items()}
+
+    def exec_probe(self, pod: Pod, container: str, command: List[str]) -> bool:
+        uid = pod.metadata.uid or pod.metadata.name
+        with self._lock:
+            return self._probe_results.get(f"{uid}/{container}", True)
+
+    # -- test hooks ---------------------------------------------------
+
+    def fail_container(self, pod_uid: str, name: str, exit_code: int = 1) -> None:
+        with self._lock:
+            c = self._pods.get(pod_uid, {}).get(name)
+            if c is not None:
+                c.state = "exited"
+                c.exit_code = exit_code
+
+    def set_probe_result(self, pod_uid: str, container: str, healthy: bool) -> None:
+        with self._lock:
+            self._probe_results[f"{pod_uid}/{container}"] = healthy

@@ -1,0 +1,358 @@
+"""Whole-system integration: apiserver + scheduler + controller manager
++ fake-runtime kubelets in one process.
+
+Reference analog: cmd/integration/integration.go:99 startComponents —
+real control plane with two kubelets on FakeDockerClient, asserting
+pods get scheduled and run.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.scheduler.daemon import Scheduler, SchedulerConfig
+from kubernetes_tpu.server import APIServer
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def rc_wire(name, replicas, app, cpu="100m", mem="64Mi"):
+    return {
+        "kind": "ReplicationController",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"app": app},
+            "template": {
+                "metadata": {"labels": {"app": app}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "main",
+                            "image": "nginx",
+                            "resources": {"limits": {"cpu": cpu, "memory": mem}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+@pytest.fixture
+def cluster():
+    """Control plane + 2 kubelets, all in-process."""
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    runtimes = {n: FakeRuntime() for n in ("node-1", "node-2")}
+    kubelets = [
+        Kubelet(
+            Client(LocalTransport(api)),
+            node_name=name,
+            runtime=rt,
+            heartbeat_period=0.5,
+            sync_period=0.3,
+        ).start()
+        for name, rt in runtimes.items()
+    ]
+    sched_cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    assert sched_cfg.wait_for_sync()
+    scheduler = Scheduler(sched_cfg).start()
+    manager = ControllerManager(
+        Client(LocalTransport(api)),
+        node_grace_period=2.0,
+        node_eviction_timeout=1.0,
+    ).start()
+    yield api, client, kubelets, runtimes, scheduler, manager
+    manager.stop()
+    scheduler.stop()
+    for k in kubelets:
+        k.stop()
+
+
+class TestEndToEnd:
+    def test_rc_to_running_pods(self, cluster):
+        """Create an RC -> pods created -> scheduled -> Running with
+        container statuses (the reference's integration.go:405 flow)."""
+        api, client, kubelets, runtimes, *_ = cluster
+        client.create("replicationcontrollers", rc_wire("web", 6, "web"))
+
+        def all_running():
+            pods, _ = client.list("pods", namespace="default")
+            return len(pods) == 6 and all(
+                p.status.phase == "Running" and p.spec.node_name for p in pods
+            )
+
+        assert wait_until(all_running, timeout=15), _dump(client)
+        pods, _ = client.list("pods", namespace="default")
+        by_node = {}
+        for p in pods:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+            assert p.status.pod_ip
+            assert p.status.container_statuses[0].ready
+        assert set(by_node) <= {"node-1", "node-2"}
+        # Both kubelets actually started containers.
+        assert len(by_node) == 2
+
+    def test_scale_up_and_down(self, cluster):
+        api, client, *_ = cluster
+        client.create("replicationcontrollers", rc_wire("app", 3, "app"))
+        assert wait_until(
+            lambda: len(client.list("pods", namespace="default")[0]) == 3
+        )
+        rc = client.get("replicationcontrollers", "app", namespace="default")
+        rc.spec.replicas = 5
+        client.update("replicationcontrollers", rc, namespace="default")
+        assert wait_until(
+            lambda: len(client.list("pods", namespace="default")[0]) == 5
+        )
+        rc = client.get("replicationcontrollers", "app", namespace="default")
+        rc.spec.replicas = 1
+        client.update("replicationcontrollers", rc, namespace="default")
+        assert wait_until(
+            lambda: len(client.list("pods", namespace="default")[0]) == 1, timeout=15
+        )
+
+    def test_deleted_pod_recreated(self, cluster):
+        api, client, *_ = cluster
+        client.create("replicationcontrollers", rc_wire("ha", 2, "ha"))
+        assert wait_until(
+            lambda: len(client.list("pods", namespace="default")[0]) == 2
+        )
+        victim = client.list("pods", namespace="default")[0][0]
+        client.delete("pods", victim.metadata.name, namespace="default")
+        assert wait_until(
+            lambda: len(client.list("pods", namespace="default")[0]) == 2
+            and all(
+                p.metadata.name != victim.metadata.name
+                for p in client.list("pods", namespace="default")[0]
+            )
+        )
+
+    def test_endpoints_follow_service(self, cluster):
+        api, client, *_ = cluster
+        client.create(
+            "services",
+            {
+                "kind": "Service",
+                "metadata": {"name": "websvc", "namespace": "default"},
+                "spec": {"selector": {"app": "web"}, "ports": [{"port": 80}]},
+            },
+        )
+        client.create("replicationcontrollers", rc_wire("web", 3, "web"))
+
+        def endpoints_ready():
+            try:
+                ep = client.get("endpoints", "websvc", namespace="default")
+            except Exception:
+                return False
+            return ep.subsets and len(ep.subsets[0].addresses) == 3
+
+        assert wait_until(endpoints_ready, timeout=15), _dump(client)
+
+    def test_node_death_evicts_and_reschedules(self, cluster):
+        """Kill a kubelet; its pods must move to the surviving node
+        (nodecontroller eviction + RC recreate + scheduler)."""
+        api, client, kubelets, runtimes, *_ = cluster
+        client.create("replicationcontrollers", rc_wire("mv", 4, "mv"))
+        assert wait_until(
+            lambda: all(
+                p.status.phase == "Running"
+                for p in client.list("pods", namespace="default")[0]
+            )
+            and len(client.list("pods", namespace="default")[0]) == 4,
+            timeout=15,
+        )
+        dead = kubelets[0]
+        dead.stop()  # heartbeats cease
+
+        def all_on_survivor():
+            pods, _ = client.list("pods", namespace="default")
+            return len(pods) == 4 and all(
+                p.spec.node_name == "node-2" for p in pods
+            )
+
+        assert wait_until(all_on_survivor, timeout=30), _dump(client)
+
+    def test_liveness_probe_restarts_container(self, cluster):
+        api, client, kubelets, runtimes, *_ = cluster
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "flaky", "namespace": "default"},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "x",
+                            "livenessProbe": {"exec": {"command": ["check"]}},
+                            "resources": {"limits": {"cpu": "50m", "memory": "16Mi"}},
+                        }
+                    ]
+                },
+            },
+        )
+        assert wait_until(
+            lambda: client.get("pods", "flaky", namespace="default").status.phase
+            == "Running"
+        )
+        pod = client.get("pods", "flaky", namespace="default")
+        node = pod.spec.node_name
+        rt = runtimes[node]
+        rt.set_probe_result(pod.metadata.uid, "c", False)
+
+        def restarted():
+            p = client.get("pods", "flaky", namespace="default")
+            cs = p.status.container_statuses
+            return cs and cs[0].restart_count >= 1 and p.status.phase == "Running"
+
+        assert wait_until(restarted, timeout=15)
+
+
+def _dump(client):
+    pods, _ = client.list("pods", namespace="default")
+    return "; ".join(
+        f"{p.metadata.name}@{p.spec.node_name or '-'}:{p.status.phase}" for p in pods
+    )
+
+
+class TestReviewRegressions:
+    def test_on_failure_keeps_succeeded_container_done(self, cluster):
+        """restartPolicy=OnFailure: exit-0 container stays exited while
+        a failed sibling restarts."""
+        api, client, kubelets, runtimes, *_ = cluster
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "mixed", "namespace": "default"},
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [
+                        {"name": "done", "image": "x",
+                         "resources": {"limits": {"cpu": "50m", "memory": "16Mi"}}},
+                        {"name": "flaky", "image": "x",
+                         "resources": {"limits": {"cpu": "50m", "memory": "16Mi"}}},
+                    ],
+                },
+            },
+        )
+        assert wait_until(
+            lambda: client.get("pods", "mixed", namespace="default").status.phase
+            == "Running"
+        )
+        pod = client.get("pods", "mixed", namespace="default")
+        rt = runtimes[pod.spec.node_name]
+        uid = pod.metadata.uid
+        rt.fail_container(uid, "done", exit_code=0)  # completed
+        rt.fail_container(uid, "flaky", exit_code=1)  # crashed
+
+        def flaky_restarted_done_not():
+            p = client.get("pods", "mixed", namespace="default")
+            by_name = {c.name: c for c in p.status.container_statuses}
+            return (
+                by_name.get("flaky") is not None
+                and by_name["flaky"].restart_count >= 1
+                and by_name.get("done") is not None
+                and by_name["done"].restart_count == 0
+            )
+
+        assert wait_until(flaky_restarted_done_not, timeout=10)
+
+    def test_endpoints_gc_on_service_delete(self, cluster):
+        api, client, *_ = cluster
+        client.create(
+            "services",
+            {
+                "kind": "Service",
+                "metadata": {"name": "gone", "namespace": "default"},
+                "spec": {"selector": {"app": "x"}, "ports": [{"port": 80}]},
+            },
+        )
+        assert wait_until(
+            lambda: any(
+                e.metadata.name == "gone" for e in client.list("endpoints")[0]
+            )
+        )
+        client.delete("services", "gone", namespace="default")
+        assert wait_until(
+            lambda: all(
+                e.metadata.name != "gone" for e in client.list("endpoints")[0]
+            ),
+            timeout=10,
+        )
+
+    def test_named_target_port_resolved(self, cluster):
+        api, client, *_ = cluster
+        client.create(
+            "services",
+            {
+                "kind": "Service",
+                "metadata": {"name": "named", "namespace": "default"},
+                "spec": {
+                    "selector": {"app": "np"},
+                    "ports": [{"port": 80, "targetPort": "http"}],
+                },
+            },
+        )
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "np1", "namespace": "default",
+                             "labels": {"app": "np"}},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "image": "x",
+                         "ports": [{"name": "http", "containerPort": 8080}],
+                         "resources": {"limits": {"cpu": "50m", "memory": "16Mi"}}}
+                    ]
+                },
+            },
+        )
+
+        def resolved():
+            try:
+                ep = client.get("endpoints", "named", namespace="default")
+            except Exception:
+                return False
+            return (
+                ep.subsets
+                and ep.subsets[0].ports[0].port == 8080
+            )
+
+        assert wait_until(resolved, timeout=10)
+
+    def test_kubelet_status_writes_are_deduped(self, cluster):
+        """A settled pod must not generate a stream of status writes."""
+        api, client, *_ = cluster
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "settle", "namespace": "default"},
+                "spec": {"containers": [
+                    {"name": "c", "image": "x",
+                     "resources": {"limits": {"cpu": "50m", "memory": "16Mi"}}}
+                ]},
+            },
+        )
+        assert wait_until(
+            lambda: client.get("pods", "settle", namespace="default").status.phase
+            == "Running"
+        )
+        v1 = client.get("pods", "settle", namespace="default").metadata.resource_version
+        time.sleep(1.5)  # several sync periods
+        v2 = client.get("pods", "settle", namespace="default").metadata.resource_version
+        assert v1 == v2, "status writes not deduped"
