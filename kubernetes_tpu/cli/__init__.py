@@ -1,0 +1,10 @@
+"""kubectl-style CLI (`ktctl`).
+
+Reference: pkg/kubectl/ — command tree (get, create, delete, describe,
+scale, label, expose, config), resource builder over files/stdin,
+printers. Entry point: kubernetes_tpu.cli.main.
+"""
+
+from kubernetes_tpu.cli.ktctl import main
+
+__all__ = ["main"]

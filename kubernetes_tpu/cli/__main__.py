@@ -1,0 +1,7 @@
+"""python -m kubernetes_tpu.cli — ktctl entry point."""
+
+import sys
+
+from kubernetes_tpu.cli.ktctl import main
+
+sys.exit(main())

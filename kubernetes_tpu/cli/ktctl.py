@@ -1,0 +1,487 @@
+"""ktctl — the CLI.
+
+Reference: pkg/kubectl/cmd/ (cobra command tree), pkg/kubectl/resource
+(builder: files/stdin -> objects), resource_printer.go (table/json/yaml
+printers), describe.go, scale.go, expose.go.
+
+Usage:
+    ktctl [--server URL] [-n NAMESPACE] [-o table|json|yaml|name] CMD ...
+
+Commands: get, create, apply, delete, describe, scale, label, expose,
+run, logs(stub), version, api-resources.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.server.registry import RESOURCES
+
+# Short aliases (reference: kubectl.go resource shortcuts).
+ALIASES = {
+    "po": "pods",
+    "pod": "pods",
+    "no": "nodes",
+    "node": "nodes",
+    "svc": "services",
+    "service": "services",
+    "rc": "replicationcontrollers",
+    "ep": "endpoints",
+    "ev": "events",
+    "ns": "namespaces",
+    "namespace": "namespaces",
+    "secret": "secrets",
+    "event": "events",
+}
+
+
+def resolve_resource(name: str) -> str:
+    name = name.lower()
+    name = ALIASES.get(name, name)
+    if name not in RESOURCES:
+        raise SystemExit(f"error: unknown resource type {name!r}")
+    return RESOURCES[name].name
+
+
+# ---------------------------------------------------------------------------
+# Printers (reference: resource_printer.go)
+# ---------------------------------------------------------------------------
+
+
+def _age(ts: str) -> str:
+    import time
+    from datetime import datetime, timezone
+
+    if not ts:
+        return "<none>"
+    try:
+        then = datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=timezone.utc
+        )
+    except ValueError:
+        return "<none>"
+    secs = int(time.time() - then.timestamp())
+    if secs < 120:
+        return f"{secs}s"
+    if secs < 7200:
+        return f"{secs // 60}m"
+    if secs < 172800:
+        return f"{secs // 3600}h"
+    return f"{secs // 86400}d"
+
+
+def _pod_row(o) -> List[str]:
+    statuses = o.status.container_statuses
+    ready = sum(1 for c in statuses if c.ready)
+    restarts = sum(c.restart_count for c in statuses)
+    return [
+        o.metadata.name,
+        f"{ready}/{max(len(statuses), len(o.spec.containers))}",
+        o.status.phase,
+        str(restarts),
+        o.spec.node_name or "<none>",
+        _age(o.metadata.creation_timestamp),
+    ]
+
+
+def _node_row(o) -> List[str]:
+    ready = "Unknown"
+    for c in o.status.conditions:
+        if c.type == "Ready":
+            ready = {"True": "Ready", "False": "NotReady"}.get(c.status, "Unknown")
+    if o.spec.unschedulable:
+        ready += ",SchedulingDisabled"
+    cap = o.status.capacity
+    return [
+        o.metadata.name,
+        ready,
+        str(cap.get("cpu", "")),
+        str(cap.get("memory", "")),
+        _age(o.metadata.creation_timestamp),
+    ]
+
+
+def _svc_row(o) -> List[str]:
+    ports = ",".join(f"{p.port}/{p.protocol}" for p in o.spec.ports)
+    return [
+        o.metadata.name,
+        o.spec.type,
+        o.spec.cluster_ip or "<none>",
+        ports or "<none>",
+        _age(o.metadata.creation_timestamp),
+    ]
+
+
+def _rc_row(o) -> List[str]:
+    return [
+        o.metadata.name,
+        str(o.spec.replicas),
+        str(o.status.replicas),
+        ",".join(f"{k}={v}" for k, v in o.spec.selector.items()),
+        _age(o.metadata.creation_timestamp),
+    ]
+
+
+def _ep_row(o) -> List[str]:
+    addrs = []
+    for s in o.subsets:
+        for a in s.addresses:
+            for p in s.ports:
+                addrs.append(f"{a.ip}:{p.port}")
+    return [o.metadata.name, ",".join(addrs[:4]) + ("..." if len(addrs) > 4 else "") or "<none>", _age(o.metadata.creation_timestamp)]
+
+
+def _event_row(o) -> List[str]:
+    return [
+        _age(o.last_timestamp or o.first_timestamp),
+        o.reason,
+        f"{o.involved_object.kind}/{o.involved_object.name}",
+        (o.source or {}).get("component", ""),
+        o.message[:80],
+    ]
+
+
+TABLE_COLUMNS = {
+    "pods": (["NAME", "READY", "STATUS", "RESTARTS", "NODE", "AGE"], _pod_row),
+    "nodes": (["NAME", "STATUS", "CPU", "MEMORY", "AGE"], _node_row),
+    "services": (["NAME", "TYPE", "CLUSTER-IP", "PORTS", "AGE"], _svc_row),
+    "replicationcontrollers": (
+        ["NAME", "DESIRED", "CURRENT", "SELECTOR", "AGE"],
+        _rc_row,
+    ),
+    "endpoints": (["NAME", "ENDPOINTS", "AGE"], _ep_row),
+    "events": (["AGE", "REASON", "OBJECT", "SOURCE", "MESSAGE"], _event_row),
+}
+
+
+def _generic_row(o) -> List[str]:
+    return [o.metadata.name, _age(o.metadata.creation_timestamp)]
+
+
+def print_table(resource: str, objs: List[Any], out=None) -> None:
+    out = out or sys.stdout
+    headers, row_fn = TABLE_COLUMNS.get(resource, (["NAME", "AGE"], _generic_row))
+    rows = [headers] + [row_fn(o) for o in objs]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
+    for r in rows:
+        out.write("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
+
+
+def print_objs(resource: str, objs: List[Any], fmt: str, out=None) -> None:
+    out = out or sys.stdout
+    if fmt == "table":
+        print_table(resource, objs, out)
+    elif fmt == "name":
+        for o in objs:
+            out.write(f"{resource}/{o.metadata.name}\n")
+    else:
+        wires = [serde.to_wire(o) for o in objs]
+        payload = wires[0] if len(wires) == 1 else {"kind": "List", "items": wires}
+        if fmt == "json":
+            out.write(json.dumps(payload, indent=2) + "\n")
+        else:
+            out.write(yaml.safe_dump(payload, sort_keys=False))
+
+
+# ---------------------------------------------------------------------------
+# Resource builder (reference: resource/builder.go — files/stdin -> objects)
+# ---------------------------------------------------------------------------
+
+
+def load_manifests(filename: str) -> List[Dict]:
+    if filename == "-":
+        text = sys.stdin.read()
+    else:
+        with open(filename) as f:
+            text = f.read()
+    docs: List[Dict] = []
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        if doc.get("kind") == "List":
+            docs.extend(doc.get("items", []))
+        else:
+            docs.append(doc)
+    return docs
+
+
+def resource_for_kind(kind: str) -> str:
+    for name, info in RESOURCES.items():
+        if info.kind == kind and name == info.name:
+            return name
+    raise SystemExit(f"error: no resource for kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_get(client: Client, args) -> int:
+    resource = resolve_resource(args.resource)
+    if args.name:
+        obj = client.get(resource, args.name, namespace=args.namespace)
+        print_objs(resource, [obj], args.output)
+    else:
+        objs, _ = client.list(
+            resource,
+            namespace="" if args.all_namespaces else args.namespace,
+            label_selector=args.selector or "",
+        )
+        print_objs(resource, objs, args.output)
+    return 0
+
+
+def cmd_create(client: Client, args) -> int:
+    count = 0
+    for wire in load_manifests(args.filename):
+        resource = resource_for_kind(wire.get("kind", ""))
+        out = client.create(resource, wire, namespace=args.namespace)
+        print(f"{resource}/{out.metadata.name} created")
+        count += 1
+    if count == 0:
+        print("error: no objects in input", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_apply(client: Client, args) -> int:
+    """Create-or-update (kubectl apply shape)."""
+    for wire in load_manifests(args.filename):
+        resource = resource_for_kind(wire.get("kind", ""))
+        name = wire.get("metadata", {}).get("name", "")
+        try:
+            client.create(resource, wire, namespace=args.namespace)
+            print(f"{resource}/{name} created")
+        except APIError as e:
+            if e.code != 409:
+                raise
+            wire.setdefault("metadata", {}).pop("resourceVersion", None)
+            client.update(resource, wire, namespace=args.namespace)
+            print(f"{resource}/{name} configured")
+    return 0
+
+
+def cmd_delete(client: Client, args) -> int:
+    if args.filename:
+        for wire in load_manifests(args.filename):
+            resource = resource_for_kind(wire.get("kind", ""))
+            name = wire.get("metadata", {}).get("name", "")
+            client.delete(resource, name, namespace=args.namespace)
+            print(f"{resource}/{name} deleted")
+        return 0
+    if not args.resource or not args.name:
+        raise SystemExit("error: delete requires RESOURCE NAME or -f FILE")
+    resource = resolve_resource(args.resource)
+    client.delete(resource, args.name, namespace=args.namespace)
+    print(f"{resource}/{args.name} deleted")
+    return 0
+
+
+def cmd_describe(client: Client, args) -> int:
+    """reference: describe.go — object + its events."""
+    resource = resolve_resource(args.resource)
+    obj = client.get(resource, args.name, namespace=args.namespace)
+    wire = serde.to_wire(obj)
+    print(yaml.safe_dump(wire, sort_keys=False).rstrip())
+    try:
+        events, _ = client.list(
+            "events",
+            namespace=args.namespace,
+            field_selector=f"involvedObject.name={args.name}",
+        )
+    except APIError:
+        events = []
+    if events:
+        print("\nEvents:")
+        for e in events[-10:]:
+            print(f"  {e.reason}\t{e.message}")
+    return 0
+
+
+def cmd_scale(client: Client, args) -> int:
+    """reference: scale.go."""
+    resource = resolve_resource(args.resource)
+    if resource != "replicationcontrollers":
+        raise SystemExit("error: scale only supports replicationcontrollers")
+    rc = client.get(resource, args.name, namespace=args.namespace)
+    rc.spec.replicas = args.replicas
+    client.update(resource, rc, namespace=args.namespace)
+    print(f"replicationcontroller/{args.name} scaled to {args.replicas}")
+    return 0
+
+
+def cmd_label(client: Client, args) -> int:
+    resource = resolve_resource(args.resource)
+    obj = client.get(resource, args.name, namespace=args.namespace)
+    for kv in args.labels:
+        if kv.endswith("-"):
+            obj.metadata.labels.pop(kv[:-1], None)
+        elif "=" in kv:
+            k, v = kv.split("=", 1)
+            if obj.metadata.labels.get(k) is not None and not args.overwrite:
+                raise SystemExit(
+                    f"error: label {k!r} already set; use --overwrite"
+                )
+            obj.metadata.labels[k] = v
+        else:
+            raise SystemExit(f"error: bad label spec {kv!r}")
+    client.update(resource, obj, namespace=args.namespace)
+    print(f"{resource}/{args.name} labeled")
+    return 0
+
+
+def cmd_expose(client: Client, args) -> int:
+    """reference: expose.go — make a Service fronting an RC."""
+    rc = client.get("replicationcontrollers", args.name, namespace=args.namespace)
+    svc = {
+        "kind": "Service",
+        "metadata": {"name": args.service_name or args.name},
+        "spec": {
+            "selector": dict(rc.spec.selector),
+            "ports": [{"port": args.port, "targetPort": args.target_port or args.port}],
+        },
+    }
+    out = client.create("services", svc, namespace=args.namespace)
+    print(f"services/{out.metadata.name} exposed")
+    return 0
+
+
+def cmd_run(client: Client, args) -> int:
+    """reference: run.go — create an RC running an image."""
+    rc = {
+        "kind": "ReplicationController",
+        "metadata": {"name": args.name},
+        "spec": {
+            "replicas": args.replicas,
+            "selector": {"run": args.name},
+            "template": {
+                "metadata": {"labels": {"run": args.name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": args.name,
+                            "image": args.image,
+                            "resources": {
+                                "limits": {"cpu": args.cpu, "memory": args.memory}
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    out = client.create("replicationcontrollers", rc, namespace=args.namespace)
+    print(f"replicationcontrollers/{out.metadata.name} created")
+    return 0
+
+
+def cmd_api_resources(client: Client, args) -> int:
+    seen = set()
+    print(f"{'NAME':32}{'NAMESPACED':12}KIND")
+    for name, info in sorted(RESOURCES.items()):
+        if info.name in seen or name != info.name:
+            continue
+        seen.add(info.name)
+        print(f"{info.name:32}{str(info.namespaced).lower():12}{info.kind}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Global flags live on a parent parser attached to every
+    # subcommand, so `ktctl get pods -o yaml` parses naturally.
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    common.add_argument("--namespace", "-n", default="default")
+    common.add_argument("--output", "-o", default="table",
+                        choices=["table", "json", "yaml", "name"])
+    p = argparse.ArgumentParser(prog="ktctl", description="kubernetes-tpu CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("get", parents=[common])
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?")
+    g.add_argument("--selector", "-l")
+    g.add_argument("--all-namespaces", "-A", action="store_true")
+    g.set_defaults(fn=cmd_get)
+
+    c = sub.add_parser("create", parents=[common])
+    c.add_argument("--filename", "-f", required=True)
+    c.set_defaults(fn=cmd_create)
+
+    a = sub.add_parser("apply", parents=[common])
+    a.add_argument("--filename", "-f", required=True)
+    a.set_defaults(fn=cmd_apply)
+
+    d = sub.add_parser("delete", parents=[common])
+    d.add_argument("resource", nargs="?")
+    d.add_argument("name", nargs="?")
+    d.add_argument("--filename", "-f")
+    d.set_defaults(fn=cmd_delete)
+
+    ds = sub.add_parser("describe", parents=[common])
+    ds.add_argument("resource")
+    ds.add_argument("name")
+    ds.set_defaults(fn=cmd_describe)
+
+    sc = sub.add_parser("scale", parents=[common])
+    sc.add_argument("resource")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.set_defaults(fn=cmd_scale)
+
+    lb = sub.add_parser("label", parents=[common])
+    lb.add_argument("resource")
+    lb.add_argument("name")
+    lb.add_argument("labels", nargs="+")
+    lb.add_argument("--overwrite", action="store_true")
+    lb.set_defaults(fn=cmd_label)
+
+    ex = sub.add_parser("expose", parents=[common])
+    ex.add_argument("resource")  # only rc supported
+    ex.add_argument("name")
+    ex.add_argument("--port", type=int, required=True)
+    ex.add_argument("--target-port", type=int)
+    ex.add_argument("--service-name")
+    ex.set_defaults(fn=cmd_expose)
+
+    rn = sub.add_parser("run", parents=[common])
+    rn.add_argument("name")
+    rn.add_argument("--image", required=True)
+    rn.add_argument("--replicas", "-r", type=int, default=1)
+    rn.add_argument("--cpu", default="100m")
+    rn.add_argument("--memory", default="64Mi")
+    rn.set_defaults(fn=cmd_run)
+
+    ar = sub.add_parser("api-resources", parents=[common])
+    ar.set_defaults(fn=cmd_api_resources)
+    return p
+
+
+def main(argv: Optional[List[str]] = None, client: Optional[Client] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if client is None:
+        client = Client(HTTPTransport(args.server))
+    try:
+        return args.fn(client, args)
+    except APIError as e:
+        print(f"Error from server ({e.reason}): {e.message}", file=sys.stderr)
+        return 1
+    except (OSError, ConnectionError) as e:
+        print(f"Unable to connect to server {args.server}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,6 +207,13 @@ class HTTPTransport(Transport):
             return self._do(
                 "POST", f"/api/v1/namespaces/{namespace or 'default'}/bindings", body=body
             )
+        if op == "bind_bulk":
+            (namespace,) = args
+            return self._do(
+                "POST",
+                f"/api/v1/namespaces/{namespace or 'default'}/bulkbindings",
+                body=body,
+            )
         raise ValueError(f"unknown op {op!r}")
 
     def watch(self, resource, namespace, since, lsel, fsel):
@@ -308,6 +315,24 @@ class Client:
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         self._throttle()
         self.t.request("DELETE", "delete", (resource, namespace, name))
+
+    def bind_bulk(self, bindings, namespace: str = "default") -> list:
+        """Commit many (pod_name, node_name) bindings in one request;
+        returns per-item Status dicts (the batch solver's commit path)."""
+        wire = [
+            {
+                "kind": "Binding",
+                "apiVersion": "v1",
+                "metadata": {"name": p, "namespace": namespace},
+                "target": {"kind": "Node", "name": n},
+            }
+            for p, n in bindings
+        ]
+        self._throttle()
+        out = self.t.request("POST", "bind_bulk", (namespace,), {"bindings": wire})
+        if isinstance(out, dict):
+            return out.get("results", [])
+        return out
 
     def bind(self, pod_name: str, node_name: str, namespace: str = "default") -> None:
         """POST a Binding (scheduler commit; factory.go:311-315)."""

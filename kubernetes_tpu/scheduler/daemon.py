@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.client.cache import FIFO, Informer, Reflector, ThreadSafeStore
 from kubernetes_tpu.models import serde
@@ -211,12 +211,15 @@ class Scheduler:
         if self._thread:
             self._thread.join(timeout=5)
 
+    def _step(self) -> None:
+        self.schedule_one()
+
     def run(self) -> None:
         # Crash containment (reference: util.HandleCrash wrapping every
         # control loop) — a transient error must not kill the daemon.
         while not self._stop.is_set():
             try:
-                self.schedule_one()
+                self._step()
             except Exception:
                 if not self._stop.is_set():
                     time.sleep(0.1)
@@ -294,3 +297,161 @@ class Scheduler:
                 self.config.pod_queue.add(fresh)
 
         threading.Thread(target=later, daemon=True).start()
+
+    def _requeue_many(self, pods: List[Pod]) -> None:
+        """Batch-friendly requeue: ONE worker thread re-adds the whole
+        rejected set at each pod's backoff deadline (the per-pod-thread
+        scalar mechanism would spawn up to max_batch threads)."""
+        if not pods:
+            return
+        now = time.monotonic()
+        schedule = sorted(
+            (
+                now
+                + self.config.backoff.duration(
+                    f"{p.metadata.namespace}/{p.metadata.name}"
+                ),
+                i,
+            )
+            for i, p in enumerate(pods)
+        )
+
+        def worker():
+            for deadline, i in schedule:
+                wait = deadline - time.monotonic()
+                if wait > 0 and self._stop.wait(wait):
+                    return
+                pod = pods[i]
+                try:
+                    fresh = self.config.client.get(
+                        "pods", pod.metadata.name,
+                        namespace=pod.metadata.namespace or "default",
+                    )
+                except APIError:
+                    continue  # deleted: drop
+                except Exception:
+                    fresh = pod
+                if not fresh.spec.node_name:
+                    self.config.pod_queue.add(fresh)
+
+        threading.Thread(target=worker, daemon=True).start()
+
+
+class BatchScheduler(Scheduler):
+    """TPU-backed batch mode: drain the whole pending backlog, solve it
+    as one device problem, commit via bulk bindings. Falls back to the
+    scalar per-pod path when the device solve fails (the north star's
+    stock-FitPredicate fallback). Decision parity with the scalar path
+    is the solver's contract (kubernetes_tpu.ops.solver)."""
+
+    def __init__(
+        self,
+        config: SchedulerConfig,
+        max_batch: int = 65536,
+        batch_window: float = 0.02,
+    ):
+        super().__init__(config)
+        self.max_batch = max_batch
+        self.batch_window = batch_window
+        self.fallback_count = 0
+
+    def _step(self) -> None:
+        self.schedule_batch()
+
+    def _drain(self, timeout: Optional[float]) -> List[Pod]:
+        """Pop the first pod (blocking) then everything already queued,
+        up to max_batch (amortizes solves under churn)."""
+        first = self.config.pod_queue.pop(timeout=timeout)
+        if first is None:
+            return []
+        batch = [first]
+        deadline = time.monotonic() + self.batch_window
+        while len(batch) < self.max_batch:
+            wait = deadline - time.monotonic()
+            pod = self.config.pod_queue.pop(timeout=max(0.0, wait))
+            if pod is None:
+                break
+            batch.append(pod)
+        return [p for p in batch if not p.spec.node_name]
+
+    def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
+        """One drain+solve+commit cycle; returns pods processed."""
+        from kubernetes_tpu.scheduler.batch import (
+            schedule_backlog_scalar,
+            schedule_backlog_tpu,
+        )
+
+        cfg = self.config
+        pending = self._drain(timeout)
+        if not pending:
+            return 0
+        start = time.monotonic()
+        nodes = cfg.nodes.store.list()  # unfiltered; snapshot encodes readiness
+        assigned = cfg.pod_lister.list()
+        services = cfg.service_lister.list()
+        try:
+            t0 = time.monotonic()
+            destinations = schedule_backlog_tpu(pending, nodes, assigned, services)
+            _ALGO_LATENCY.observe(time.monotonic() - t0)
+        except Exception:
+            # Device path unavailable: stock scalar fallback.
+            self.fallback_count += 1
+            try:
+                destinations = schedule_backlog_scalar(
+                    pending, nodes, assigned, services
+                )
+            except Exception:
+                self._requeue_many(pending)
+                return len(pending)
+
+        # Commit placed pods in one bulk call, grouped by namespace.
+        by_ns: Dict[str, List] = {}
+        placed: List[Tuple[Pod, str]] = []
+        rejected: List[Pod] = []
+        for pod, dest in zip(pending, destinations):
+            if dest is None:
+                _SCHEDULED.inc(result="unschedulable")
+                cfg.client.record_event(
+                    pod, "FailedScheduling", "no node fits", source="scheduler"
+                )
+                rejected.append(pod)
+                continue
+            ns = pod.metadata.namespace or "default"
+            by_ns.setdefault(ns, []).append((pod.metadata.name, dest))
+            placed.append((pod, dest))
+
+        t0 = time.monotonic()
+        outcome: Dict[Tuple[str, str], dict] = {}
+        try:
+            for ns, items in by_ns.items():
+                results = cfg.binder.bind_bulk(items, namespace=ns)
+                for (pod_name, _dest), res in zip(items, results):
+                    outcome[(ns, pod_name)] = res
+        except Exception:
+            # Transport/apiserver failure mid-commit: pods without a
+            # recorded outcome get retried (already-committed ones are
+            # 409s next round, which is fine).
+            pass
+        if by_ns:
+            _BIND_LATENCY.observe(time.monotonic() - t0)
+
+        for pod, dest in placed:
+            ns = pod.metadata.namespace or "default"
+            res = outcome.get((ns, pod.metadata.name), {})
+            if res.get("status") == "Success":
+                pod.spec.node_name = dest
+                cfg.modeler.assume_pod(pod)
+                _SCHEDULED.inc(result="scheduled")
+                cfg.client.record_event(
+                    pod, "Scheduled",
+                    f"Successfully assigned {pod.metadata.name} to {dest}",
+                    source="scheduler",
+                )
+            elif res.get("code") == 409:
+                _SCHEDULED.inc(result="bind_conflict")  # raced; pod is bound
+            else:
+                _SCHEDULED.inc(result="bind_error")
+                rejected.append(pod)
+        self._requeue_many(rejected)
+        _E2E_LATENCY.observe(time.monotonic() - start)
+        return len(pending)

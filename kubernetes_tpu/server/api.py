@@ -361,9 +361,28 @@ class APIServer:
             self.store.guaranteed_update(key, assign)
         except NotFoundError:
             raise _not_found("pods", pod_name)
+        except ConflictError as e:
+            # CAS retry exhaustion surfaces as 409 like any other
+            # write conflict (the caller retries the pod).
+            raise _conflict(str(e))
         return {
             "kind": "Status",
             "apiVersion": "v1",
             "status": "Success",
             "code": 201,
         }
+
+    def bind_bulk(self, namespace: str, bindings: list) -> list:
+        """Commit many bindings in one call (no reference analog — this
+        is the batch-solver commit path: one request for a whole solved
+        backlog instead of one per pod). Each binding still goes through
+        the same guarded CAS write; per-item results are returned."""
+        if isinstance(bindings, dict):
+            bindings = bindings.get("bindings", [])
+        results = []
+        for binding in bindings:
+            try:
+                results.append(self.bind(namespace, binding))
+            except APIError as e:
+                results.append(e.to_status())
+        return results

@@ -187,6 +187,13 @@ class _Handler(BaseHTTPRequestHandler):
                 out = api.bind(ns, self._read_body())
                 self._send_json(201, out)
                 return "bindings", 201
+            if resource == "bulkbindings" and verb == "POST":
+                body = self._read_body()
+                results = api.bind_bulk(ns, body.get("bindings", []))
+                self._send_json(
+                    200, {"kind": "BindingResultList", "results": results}
+                )
+                return "bulkbindings", 200
             if len(rest) == 3:
                 return self._collection(verb, resource, ns, lsel, fsel)
             name = rest[3]

@@ -1,0 +1,139 @@
+"""ktctl CLI tests (reference analog: hack/test-cmd.sh golden tests)."""
+
+import io
+import json
+import sys
+
+import pytest
+
+from kubernetes_tpu.cli.ktctl import main
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.server import APIServer
+
+
+@pytest.fixture
+def env(tmp_path):
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    def run(*argv, expect=0):
+        out = io.StringIO()
+        old = sys.stdout
+        sys.stdout = out
+        try:
+            rc = main(list(argv), client=client)
+        finally:
+            sys.stdout = old
+        assert rc == expect, out.getvalue()
+        return out.getvalue()
+    return api, client, run, tmp_path
+
+
+RC_YAML = """
+kind: ReplicationController
+metadata:
+  name: web
+spec:
+  replicas: 2
+  selector: {app: web}
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+      - name: main
+        image: nginx
+        resources:
+          limits: {cpu: 100m, memory: 64Mi}
+"""
+
+
+def test_create_get_table(env, tmp_path):
+    api, client, run, _ = env
+    f = tmp_path / "rc.yaml"
+    f.write_text(RC_YAML)
+    out = run("create", "-f", str(f))
+    assert "replicationcontrollers/web created" in out
+    out = run("get", "rc")
+    assert "web" in out and "DESIRED" in out
+    out = run("get", "rc", "web", "-o", "json")
+    assert json.loads(out)["spec"]["replicas"] == 2
+
+
+def test_apply_update(env, tmp_path):
+    api, client, run, _ = env
+    f = tmp_path / "rc.yaml"
+    f.write_text(RC_YAML)
+    run("apply", "-f", str(f))
+    f.write_text(RC_YAML.replace("replicas: 2", "replicas: 4"))
+    out = run("apply", "-f", str(f))
+    assert "configured" in out
+    assert client.get("replicationcontrollers", "web").spec.replicas == 4
+
+
+def test_scale_and_delete(env, tmp_path):
+    api, client, run, _ = env
+    f = tmp_path / "rc.yaml"
+    f.write_text(RC_YAML)
+    run("create", "-f", str(f))
+    out = run("scale", "rc", "web", "--replicas", "5")
+    assert "scaled to 5" in out
+    assert client.get("replicationcontrollers", "web").spec.replicas == 5
+    run("delete", "rc", "web")
+    out = run("get", "rc", "missing", expect=1)
+
+
+def test_run_expose_describe(env):
+    api, client, run, _ = env
+    run("run", "app1", "--image", "nginx", "-r", "3")
+    rc = client.get("replicationcontrollers", "app1")
+    assert rc.spec.replicas == 3
+    out = run("expose", "rc", "app1", "--port", "80")
+    assert "exposed" in out
+    svc = client.get("services", "app1")
+    assert svc.spec.selector == {"run": "app1"}
+    out = run("describe", "rc", "app1")
+    assert "app1" in out and "replicas" in out
+
+
+def test_label_and_selector_get(env):
+    api, client, run, _ = env
+    client.create("pods", {
+        "kind": "Pod", "metadata": {"name": "p1", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "x"}]},
+    })
+    run("label", "pod", "p1", "tier=web")
+    assert client.get("pods", "p1").metadata.labels == {"tier": "web"}
+    # Overwrite protection without --overwrite.
+    with pytest.raises(SystemExit):
+        main(["label", "pod", "p1", "tier=db"], client=client)
+    run("label", "pod", "p1", "tier=db", "--overwrite")
+    assert client.get("pods", "p1").metadata.labels == {"tier": "db"}
+    out = run("get", "pods", "--selector", "tier=db")
+    assert "p1" in out
+    run("label", "pod", "p1", "tier-")
+    assert client.get("pods", "p1").metadata.labels == {}
+
+
+def test_nodes_and_api_resources(env):
+    api, client, run, _ = env
+    client.create("nodes", {
+        "kind": "Node", "metadata": {"name": "n1"},
+        "status": {"capacity": {"cpu": "4", "memory": "8Gi"},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+    out = run("get", "nodes")
+    assert "n1" in out and "Ready" in out
+    out = run("api-resources")
+    assert "pods" in out and "replicationcontrollers" in out
+
+
+def test_yaml_output_roundtrip(env, tmp_path):
+    api, client, run, _ = env
+    f = tmp_path / "rc.yaml"
+    f.write_text(RC_YAML)
+    run("create", "-f", str(f))
+    out = run("get", "rc", "web", "-o", "yaml")
+    import yaml as _yaml
+
+    doc = _yaml.safe_load(out)
+    assert doc["spec"]["template"]["spec"]["containers"][0]["image"] == "nginx"
