@@ -323,15 +323,15 @@ def cmd_label(client: Client, args) -> int:
     resource = resolve_resource(args.resource)
     obj = client.get(resource, args.name, namespace=args.namespace)
     for kv in args.labels:
-        if kv.endswith("-"):
-            obj.metadata.labels.pop(kv[:-1], None)
-        elif "=" in kv:
+        if "=" in kv:
             k, v = kv.split("=", 1)
             if obj.metadata.labels.get(k) is not None and not args.overwrite:
                 raise SystemExit(
                     f"error: label {k!r} already set; use --overwrite"
                 )
             obj.metadata.labels[k] = v
+        elif kv.endswith("-"):
+            obj.metadata.labels.pop(kv[:-1], None)
         else:
             raise SystemExit(f"error: bad label spec {kv!r}")
     client.update(resource, obj, namespace=args.namespace)
@@ -341,6 +341,9 @@ def cmd_label(client: Client, args) -> int:
 
 def cmd_expose(client: Client, args) -> int:
     """reference: expose.go — make a Service fronting an RC."""
+    resource = resolve_resource(args.resource)
+    if resource != "replicationcontrollers":
+        raise SystemExit(f"error: cannot expose {resource}; only replicationcontrollers")
     rc = client.get("replicationcontrollers", args.name, namespace=args.namespace)
     svc = {
         "kind": "Service",

@@ -446,6 +446,154 @@ class Secret:
 
 
 @dataclass
+class ServiceAccount:
+    """Reference: pkg/api/types.go ServiceAccount."""
+
+    kind: str = "ServiceAccount"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[ObjectReference] = field(default_factory=list)
+    image_pull_secrets: List[Dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class LimitRangeItem:
+    """Reference: pkg/api/types.go LimitRangeItem — per-type min/max/default."""
+
+    type: str = "Container"  # Pod | Container
+    max: ResourceList = field(default_factory=dict)
+    min: ResourceList = field(default_factory=dict)
+    default: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange:
+    kind: str = "LimitRange"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: ResourceList = field(default_factory=dict)
+    used: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    """Reference: pkg/api/types.go ResourceQuota. Hard limits include
+    cpu/memory plus object counts (pods, services, ...)."""
+
+    kind: str = "ResourceQuota"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class PersistentVolumeSource:
+    """Exactly one of the fields should be set (reference:
+    pkg/api/types.go PersistentVolumeSource)."""
+
+    host_path: Optional[HostPathVolumeSource] = None
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    nfs: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: ResourceList = field(default_factory=dict)
+    access_modes: List[str] = field(default_factory=list)  # RWO/ROX/RWX
+    persistent_volume_source: PersistentVolumeSource = field(
+        default_factory=PersistentVolumeSource
+    )
+    claim_ref: Optional[ObjectReference] = None
+    persistent_volume_reclaim_policy: str = "Retain"
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = "Pending"  # Pending|Available|Bound|Released|Failed
+    message: str = ""
+    reason: str = ""
+
+
+@dataclass
+class PersistentVolume:
+    kind: str = "PersistentVolume"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(default_factory=PersistentVolumeStatus)
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: List[str] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = "Pending"  # Pending|Bound|Lost
+    access_modes: List[str] = field(default_factory=list)
+    capacity: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    kind: str = "PersistentVolumeClaim"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus
+    )
+
+
+@dataclass
+class PodTemplate:
+    """Reference: pkg/api/types.go PodTemplate (pkg/registry/podtemplate)."""
+
+    kind: str = "PodTemplate"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ComponentCondition:
+    type: str = "Healthy"
+    status: str = "Unknown"  # True|False|Unknown
+    message: str = ""
+    error: str = ""
+
+
+@dataclass
+class ComponentStatus:
+    """Reference: pkg/registry/componentstatus — health of master components."""
+
+    kind: str = "ComponentStatus"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    conditions: List[ComponentCondition] = field(default_factory=list)
+
+
+@dataclass
 class DeleteOptions:
     kind: str = "DeleteOptions"
     api_version: str = "v1"
@@ -485,6 +633,13 @@ KINDS = {
     "Event": Event,
     "Namespace": Namespace,
     "Secret": Secret,
+    "ServiceAccount": ServiceAccount,
+    "LimitRange": LimitRange,
+    "ResourceQuota": ResourceQuota,
+    "PersistentVolume": PersistentVolume,
+    "PersistentVolumeClaim": PersistentVolumeClaim,
+    "PodTemplate": PodTemplate,
+    "ComponentStatus": ComponentStatus,
     "DeleteOptions": DeleteOptions,
     "Status": Status,
 }

@@ -147,3 +147,92 @@ def validate_replication_controller(rc: ReplicationController) -> None:
             errs.append("spec.template.spec.restartPolicy: must be Always")
     if errs:
         raise ValidationError(errs)
+
+
+ACCESS_MODES = {"ReadWriteOnce", "ReadOnlyMany", "ReadWriteMany"}
+RECLAIM_POLICIES = {"Retain", "Recycle", "Delete"}
+LIMIT_TYPES = {"Pod", "Container"}
+
+
+def validate_service_account(sa) -> None:
+    errs: List[str] = []
+    _validate_meta(sa.metadata, errs)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_limit_range(lr) -> None:
+    """Reference: validation.go ValidateLimitRange — types unique, min<=max."""
+    errs: List[str] = []
+    _validate_meta(lr.metadata, errs)
+    seen = set()
+    for i, item in enumerate(lr.spec.limits):
+        if item.type not in LIMIT_TYPES:
+            errs.append(f"spec.limits[{i}].type: invalid {item.type!r}")
+        if item.type in seen:
+            errs.append(f"spec.limits[{i}].type: duplicate {item.type!r}")
+        seen.add(item.type)
+        for k, mn in (item.min or {}).items():
+            mx = (item.max or {}).get(k)
+            if mx is not None and mn.milli_value() > mx.milli_value():
+                errs.append(f"spec.limits[{i}].min[{k}]: exceeds max")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_resource_quota(rq) -> None:
+    errs: List[str] = []
+    _validate_meta(rq.metadata, errs)
+    for k, q in (rq.spec.hard or {}).items():
+        if q.milli_value() < 0:
+            errs.append(f"spec.hard[{k}]: must be nonnegative")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_persistent_volume(pv) -> None:
+    """Reference: validation.go ValidatePersistentVolume."""
+    errs: List[str] = []
+    _validate_meta(pv.metadata, errs, namespace_required=False)
+    if not pv.spec.capacity:
+        errs.append("spec.capacity: required")
+    if not pv.spec.access_modes:
+        errs.append("spec.accessModes: required")
+    for m in pv.spec.access_modes:
+        if m not in ACCESS_MODES:
+            errs.append(f"spec.accessModes: invalid {m!r}")
+    if pv.spec.persistent_volume_reclaim_policy not in RECLAIM_POLICIES:
+        errs.append(
+            "spec.persistentVolumeReclaimPolicy: invalid "
+            f"{pv.spec.persistent_volume_reclaim_policy!r}"
+        )
+    src = pv.spec.persistent_volume_source
+    set_sources = [
+        s
+        for s in (
+            src.host_path,
+            src.gce_persistent_disk,
+            src.aws_elastic_block_store,
+            src.nfs,
+        )
+        if s is not None
+    ]
+    if len(set_sources) != 1:
+        errs.append("spec.persistentVolumeSource: exactly one source required")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_persistent_volume_claim(pvc) -> None:
+    errs: List[str] = []
+    _validate_meta(pvc.metadata, errs)
+    if not pvc.spec.access_modes:
+        errs.append("spec.accessModes: required")
+    for m in pvc.spec.access_modes:
+        if m not in ACCESS_MODES:
+            errs.append(f"spec.accessModes: invalid {m!r}")
+    req = pvc.spec.resources.requests or pvc.spec.resources.limits
+    if "storage" not in req:
+        errs.append("spec.resources: storage request required")
+    if errs:
+        raise ValidationError(errs)

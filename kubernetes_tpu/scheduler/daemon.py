@@ -273,6 +273,25 @@ class Scheduler:
         )
         return True
 
+    def _refetch_and_requeue(self, pod: Pod) -> None:
+        """Re-fetch `pod` and re-add it to the queue if still pending.
+        Drops it only when the apiserver says it no longer exists (404);
+        any other error retries with the stale snapshot — the bind CAS
+        still protects against double-assignment."""
+        try:
+            fresh = self.config.client.get(
+                "pods", pod.metadata.name,
+                namespace=pod.metadata.namespace or "default",
+            )
+        except APIError as e:
+            if e.code == 404:
+                return  # deleted: stop retrying
+            fresh = pod  # transient server error: retry with the snapshot
+        except Exception:
+            fresh = pod  # apiserver hiccup: retry with the snapshot
+        if not fresh.spec.node_name:
+            self.config.pod_queue.add(fresh)
+
     def _requeue_later(self, pod: Pod) -> None:
         """Exponential-backoff retry. Mirrors factory.go:257-286: after
         the backoff, RE-FETCH the pod from the apiserver and drop it if
@@ -284,17 +303,7 @@ class Scheduler:
             time.sleep(delay)
             if self._stop.is_set():
                 return
-            try:
-                fresh = self.config.client.get(
-                    "pods", pod.metadata.name,
-                    namespace=pod.metadata.namespace or "default",
-                )
-            except APIError:
-                return  # deleted: stop retrying
-            except Exception:
-                fresh = pod  # apiserver hiccup: retry with the snapshot
-            if not fresh.spec.node_name:
-                self.config.pod_queue.add(fresh)
+            self._refetch_and_requeue(pod)
 
         threading.Thread(target=later, daemon=True).start()
 
@@ -321,18 +330,7 @@ class Scheduler:
                 wait = deadline - time.monotonic()
                 if wait > 0 and self._stop.wait(wait):
                     return
-                pod = pods[i]
-                try:
-                    fresh = self.config.client.get(
-                        "pods", pod.metadata.name,
-                        namespace=pod.metadata.namespace or "default",
-                    )
-                except APIError:
-                    continue  # deleted: drop
-                except Exception:
-                    fresh = pod
-                if not fresh.spec.node_name:
-                    self.config.pod_queue.add(fresh)
+                self._refetch_and_requeue(pods[i])
 
         threading.Thread(target=worker, daemon=True).start()
 

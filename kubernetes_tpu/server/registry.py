@@ -63,6 +63,54 @@ _register(
 _register(ResourceInfo("events", "Event", O.Event, ttl=3600.0))
 _register(ResourceInfo("namespaces", "Namespace", O.Namespace, namespaced=False))
 _register(ResourceInfo("secrets", "Secret", O.Secret))
+_register(
+    ResourceInfo(
+        "serviceaccounts",
+        "ServiceAccount",
+        O.ServiceAccount,
+        validator=V.validate_service_account,
+    )
+)
+_register(
+    ResourceInfo(
+        "limitranges", "LimitRange", O.LimitRange, validator=V.validate_limit_range
+    )
+)
+_register(
+    ResourceInfo(
+        "resourcequotas",
+        "ResourceQuota",
+        O.ResourceQuota,
+        validator=V.validate_resource_quota,
+    ),
+    "quota",
+)
+_register(
+    ResourceInfo(
+        "persistentvolumes",
+        "PersistentVolume",
+        O.PersistentVolume,
+        namespaced=False,
+        validator=V.validate_persistent_volume,
+    ),
+    "pv",
+)
+_register(
+    ResourceInfo(
+        "persistentvolumeclaims",
+        "PersistentVolumeClaim",
+        O.PersistentVolumeClaim,
+        validator=V.validate_persistent_volume_claim,
+    ),
+    "pvc",
+)
+_register(ResourceInfo("podtemplates", "PodTemplate", O.PodTemplate))
+_register(
+    ResourceInfo(
+        "componentstatuses", "ComponentStatus", O.ComponentStatus, namespaced=False
+    ),
+    "cs",
+)
 
 
 # Field extractors for field selectors (reference: pkg/registry/pod/strategy

@@ -1,0 +1,184 @@
+"""CRUD + validation tests for the registry resources added for parity
+with the reference's pkg/registry/ set: serviceaccounts, limitranges,
+resourcequotas, persistentvolumes, persistentvolumeclaims, podtemplates,
+componentstatuses (pkg/master/master.go:460-494)."""
+
+import pytest
+
+from kubernetes_tpu.models import objects as O
+from kubernetes_tpu.models.serde import from_wire, to_wire
+from kubernetes_tpu.models.validation import ValidationError
+from kubernetes_tpu.server.api import APIError, APIServer
+
+
+@pytest.fixture
+def api():
+    return APIServer()
+
+
+def test_serviceaccount_crud(api):
+    sa = {"kind": "ServiceAccount", "metadata": {"name": "default"}}
+    created = api.create("serviceaccounts", "default", sa)
+    assert created["metadata"]["uid"]
+    got = api.get("serviceaccounts", "default", "default")
+    assert got["metadata"]["name"] == "default"
+    lst = api.list("serviceaccounts", "default")
+    assert len(lst["items"]) == 1
+
+
+def test_limitrange_crud_and_validation(api):
+    lr = {
+        "kind": "LimitRange",
+        "metadata": {"name": "limits"},
+        "spec": {
+            "limits": [
+                {
+                    "type": "Container",
+                    "max": {"cpu": "2", "memory": "1Gi"},
+                    "min": {"cpu": "100m"},
+                    "default": {"cpu": "500m", "memory": "256Mi"},
+                }
+            ]
+        },
+    }
+    api.create("limitranges", "default", lr)
+    got = api.get("limitranges", "default", "limits")
+    assert got["spec"]["limits"][0]["max"]["cpu"] == "2"
+
+    bad = {
+        "kind": "LimitRange",
+        "metadata": {"name": "bad"},
+        "spec": {"limits": [{"type": "Container", "min": {"cpu": "4"}, "max": {"cpu": "1"}}]},
+    }
+    with pytest.raises(APIError):
+        api.create("limitranges", "default", bad)
+
+
+def test_resourcequota_crud(api):
+    rq = {
+        "kind": "ResourceQuota",
+        "metadata": {"name": "quota"},
+        "spec": {"hard": {"cpu": "20", "memory": "64Gi", "pods": "10"}},
+    }
+    api.create("resourcequotas", "default", rq)
+    got = api.get("resourcequotas", "default", "quota")
+    assert got["spec"]["hard"]["pods"] == "10"
+    # alias
+    assert api.list("quota", "default")["items"]
+
+
+def test_persistentvolume_validation_and_crud(api):
+    pv = {
+        "kind": "PersistentVolume",
+        "metadata": {"name": "pv0001"},
+        "spec": {
+            "capacity": {"storage": "10Gi"},
+            "accessModes": ["ReadWriteOnce"],
+            "persistentVolumeSource": {"hostPath": {"path": "/tmp/pv0001"}},
+        },
+    }
+    api.create("persistentvolumes", "", pv)
+    got = api.get("persistentvolumes", "", "pv0001")
+    assert got["spec"]["capacity"]["storage"] == "10Gi"
+
+    with pytest.raises(APIError):
+        # no source set
+        api.create(
+            "persistentvolumes",
+            "",
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": "pv-bad"},
+                "spec": {"capacity": {"storage": "1Gi"}, "accessModes": ["ReadWriteOnce"]},
+            },
+        )
+
+
+def test_pvc_crud(api):
+    pvc = {
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "claim1"},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": "3Gi"}},
+        },
+    }
+    api.create("persistentvolumeclaims", "default", pvc)
+    got = api.get("persistentvolumeclaims", "default", "claim1")
+    assert got.get("status", {}).get("phase", "Pending") == "Pending"
+
+
+def test_podtemplate_and_componentstatus(api):
+    tmpl = {
+        "kind": "PodTemplate",
+        "metadata": {"name": "web-template"},
+        "template": {
+            "metadata": {"labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+        },
+    }
+    api.create("podtemplates", "default", tmpl)
+    assert api.get("podtemplates", "default", "web-template")
+
+    cs = {
+        "kind": "ComponentStatus",
+        "metadata": {"name": "scheduler"},
+        "conditions": [{"type": "Healthy", "status": "True"}],
+    }
+    api.create("componentstatuses", "", cs)
+    got = api.get("componentstatuses", "", "scheduler")
+    assert got["conditions"][0]["status"] == "True"
+
+
+def test_watch_new_resources(api):
+    stream = api.watch("resourcequotas", "default")
+    api.create(
+        "resourcequotas",
+        "default",
+        {"kind": "ResourceQuota", "metadata": {"name": "q"}, "spec": {"hard": {"pods": "5"}}},
+    )
+    ev = stream.next(timeout=2.0)
+    assert ev is not None and ev.type == "ADDED"
+    assert ev.object["metadata"]["name"] == "q"
+    stream.close()
+
+
+def test_roundtrip_typed_objects():
+    pv = O.PersistentVolume(
+        metadata=O.ObjectMeta(name="pv1"),
+        spec=O.PersistentVolumeSpec(
+            capacity={"storage": O.Quantity.from_int(10 * 1024**3)},
+            access_modes=["ReadWriteOnce"],
+            persistent_volume_source=O.PersistentVolumeSource(
+                host_path=O.HostPathVolumeSource(path="/tmp/x")
+            ),
+        ),
+    )
+    wire = to_wire(pv)
+    back = from_wire(O.PersistentVolume, wire)
+    assert isinstance(back, O.PersistentVolume)
+    assert back.spec.persistent_volume_source.host_path.path == "/tmp/x"
+
+    lr = O.LimitRange(
+        metadata=O.ObjectMeta(name="lr", namespace="default"),
+        spec=O.LimitRangeSpec(
+            limits=[
+                O.LimitRangeItem(
+                    type="Container",
+                    max={"cpu": O.Quantity.from_milli(2000)},
+                )
+            ]
+        ),
+    )
+    back = from_wire(O.LimitRange, to_wire(lr))
+    assert back.spec.limits[0].max["cpu"].milli_value() == 2000
+
+
+def test_validation_error_collects():
+    with pytest.raises(ValidationError) as ei:
+        from kubernetes_tpu.models import validation as V
+
+        V.validate_persistent_volume(
+            O.PersistentVolume(metadata=O.ObjectMeta(name="Bad_Name"))
+        )
+    assert len(ei.value.errors) >= 2
