@@ -120,6 +120,22 @@ class VolumeMount:
 
 
 @dataclass
+class Capabilities:
+    add: List[str] = field(default_factory=list)
+    drop: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SecurityContext:
+    """Reference: pkg/api/types.go SecurityContext (pkg/securitycontext/)."""
+
+    privileged: bool = False
+    capabilities: Optional[Capabilities] = None
+    run_as_user: Optional[int] = None
+    se_linux_options: Optional[Dict[str, str]] = None
+
+
+@dataclass
 class Container:
     """Reference: pkg/api/types.go Container."""
 
@@ -135,6 +151,7 @@ class Container:
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
     image_pull_policy: str = "IfNotPresent"
+    security_context: Optional[SecurityContext] = None
 
 
 @dataclass

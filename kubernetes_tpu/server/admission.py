@@ -1,0 +1,485 @@
+"""Admission control: mutate/deny requests after authn/authz, before
+storage.
+
+Behavioral parity with the reference's admission framework
+(pkg/admission/: Interface, chain.go, plugins.go) and the standard
+plugin set (plugin/pkg/admission/): AlwaysAdmit, AlwaysDeny,
+LimitRanger, NamespaceAutoprovision, NamespaceExists,
+NamespaceLifecycle, ResourceQuota, ServiceAccount,
+SecurityContextDeny, DenyExecOnPrivileged.
+
+Plugins see wire-form dicts (the apiserver's storage currency) and may
+mutate them in place (LimitRanger defaulting, ServiceAccount
+defaulting) or raise AdmissionError to reject (HTTP 403, matching the
+reference's apiserver.errToAPIStatus forbidden mapping).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+
+CREATE = "CREATE"
+UPDATE = "UPDATE"
+DELETE = "DELETE"
+CONNECT = "CONNECT"
+
+
+class AdmissionError(Exception):
+    """Rejection; surfaces as HTTP 403 Forbidden (or the plugin's code,
+    e.g. 404 NotFound from the namespace plugins)."""
+
+    def __init__(self, message: str, code: int = 403):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.reason = {404: "NotFound", 409: "Conflict"}.get(code, "Forbidden")
+
+
+@dataclass
+class Attributes:
+    """Reference: pkg/admission/attributes.go."""
+
+    operation: str  # CREATE | UPDATE | DELETE | CONNECT
+    resource: str  # plural REST name, e.g. "pods"
+    namespace: str = ""
+    name: str = ""
+    subresource: str = ""
+    obj: Optional[dict] = None  # wire form; None for DELETE
+
+
+class Interface:
+    """A single admission plugin (pkg/admission/interfaces.go)."""
+
+    def handles(self, operation: str) -> bool:
+        return True
+
+    def admit(self, attrs: Attributes) -> None:  # may mutate attrs.obj
+        raise NotImplementedError
+
+
+class Chain(list):
+    """Ordered plugin list; first rejection wins (pkg/admission/chain.go)."""
+
+    def admit(self, attrs: Attributes) -> None:
+        for plugin in self:
+            if plugin.handles(attrs.operation):
+                plugin.admit(attrs)
+
+
+# -- plugin registry (pkg/admission/plugins.go) -----------------------------
+
+_PLUGINS: Dict[str, Callable] = {}
+_plugins_lock = threading.Lock()
+
+
+def register_plugin(name: str, factory: Callable) -> None:
+    with _plugins_lock:
+        if name in _PLUGINS:
+            raise ValueError(f"admission plugin {name!r} already registered")
+        _PLUGINS[name] = factory
+
+
+def new_from_plugins(api, names: List[str]) -> Chain:
+    """Instantiate a chain from plugin names (--admission-control flag,
+    cmd/kube-apiserver/app/server.go:184)."""
+    chain = Chain()
+    for name in names:
+        factory = _PLUGINS.get(name)
+        if factory is None:
+            raise ValueError(f"unknown admission plugin {name!r}")
+        chain.append(factory(api))
+    return chain
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _pod_resource_total(pod: dict, key: str) -> Quantity:
+    """Sum a resource across containers (limits, falling back to requests)."""
+    total = 0
+    for c in pod.get("spec", {}).get("containers", []):
+        res = c.get("resources", {})
+        v = (res.get("limits") or {}).get(key) or (res.get("requests") or {}).get(key)
+        if v:
+            total += parse_quantity(v).milli_value()
+    return Quantity.from_milli(total)
+
+
+# -- plugins ----------------------------------------------------------------
+
+
+class AlwaysAdmit(Interface):
+    """plugin/pkg/admission/admit."""
+
+    def admit(self, attrs: Attributes) -> None:
+        return None
+
+
+class AlwaysDeny(Interface):
+    """plugin/pkg/admission/deny."""
+
+    def admit(self, attrs: Attributes) -> None:
+        raise AdmissionError("admission plugin AlwaysDeny rejected the request")
+
+
+class NamespaceExists(Interface):
+    """Reject requests in namespaces that do not exist
+    (plugin/pkg/admission/namespace/exists)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation in (CREATE, UPDATE, DELETE)
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.resource == "namespaces":
+            return
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            self.api.get("namespaces", "", attrs.namespace)
+        except APIError:
+            raise AdmissionError(f"namespace {attrs.namespace!r} does not exist", 404)
+
+
+class NamespaceAutoprovision(Interface):
+    """Create the namespace on first use
+    (plugin/pkg/admission/namespace/autoprovision)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation == CREATE
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.resource == "namespaces":
+            return
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            self.api.get("namespaces", "", attrs.namespace)
+        except APIError:
+            try:
+                self.api.create(
+                    "namespaces", "", {"metadata": {"name": attrs.namespace}}
+                )
+            except APIError as e:
+                if e.code != 409:  # racing creator won: fine
+                    raise
+
+
+class NamespaceLifecycle(Interface):
+    """Reject creates in missing or Terminating namespaces
+    (plugin/pkg/admission/namespace/lifecycle)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation == CREATE
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.resource == "namespaces":
+            return
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            ns = self.api.get("namespaces", "", attrs.namespace)
+        except APIError:
+            raise AdmissionError(f"namespace {attrs.namespace!r} does not exist", 404)
+        if ns.get("status", {}).get("phase") == "Terminating":
+            raise AdmissionError(
+                f"namespace {attrs.namespace!r} is terminating; "
+                f"cannot create {attrs.resource}"
+            )
+
+
+class LimitRanger(Interface):
+    """Apply container defaults and enforce min/max from LimitRange
+    objects (plugin/pkg/admission/limitranger/admission.go)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation in (CREATE, UPDATE)
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        items = self.api.list("limitranges", attrs.namespace)["items"]
+        for lr in items:
+            for limit in lr.get("spec", {}).get("limits", []):
+                if limit.get("type", "Container") == "Container":
+                    self._apply_container_limit(limit, attrs.obj)
+                elif limit.get("type") == "Pod":
+                    self._check_pod_limit(limit, attrs.obj)
+
+    def _apply_container_limit(self, limit: dict, pod: dict) -> None:
+        defaults = limit.get("default", {})
+        mins = limit.get("min", {})
+        maxes = limit.get("max", {})
+        for c in pod.get("spec", {}).get("containers", []):
+            res = c.setdefault("resources", {})
+            limits = res.setdefault("limits", {})
+            for key, v in defaults.items():
+                limits.setdefault(key, v)
+            for key, mn in mins.items():
+                have = limits.get(key)
+                if have and parse_quantity(have).milli_value() < parse_quantity(
+                    mn
+                ).milli_value():
+                    raise AdmissionError(
+                        f"minimum {key} usage per Container is {mn}; "
+                        f"container {c.get('name')!r} requests {have}"
+                    )
+            for key, mx in maxes.items():
+                have = limits.get(key)
+                if have and parse_quantity(have).milli_value() > parse_quantity(
+                    mx
+                ).milli_value():
+                    raise AdmissionError(
+                        f"maximum {key} usage per Container is {mx}; "
+                        f"container {c.get('name')!r} requests {have}"
+                    )
+
+    def _check_pod_limit(self, limit: dict, pod: dict) -> None:
+        for key, mx in (limit.get("max") or {}).items():
+            total = _pod_resource_total(pod, key)
+            if total.milli_value() > parse_quantity(mx).milli_value():
+                raise AdmissionError(
+                    f"maximum {key} usage per Pod is {mx}; total requested {total}"
+                )
+        for key, mn in (limit.get("min") or {}).items():
+            total = _pod_resource_total(pod, key)
+            if total.milli_value() and total.milli_value() < parse_quantity(
+                mn
+            ).milli_value():
+                raise AdmissionError(
+                    f"minimum {key} usage per Pod is {mn}; total requested {total}"
+                )
+
+
+# Hard-limit keys a ResourceQuota can carry for object counts
+# (reference: pkg/api/types.go ResourceQuota resource names).
+_QUOTA_COUNT_KEYS = {
+    "pods": "pods",
+    "services": "services",
+    "replicationcontrollers": "replicationcontrollers",
+    "secrets": "secrets",
+    "persistentvolumeclaims": "persistentvolumeclaims",
+    "resourcequotas": "resourcequotas",
+}
+
+
+class ResourceQuotaAdmission(Interface):
+    """Enforce namespace ResourceQuota hard limits and keep
+    status.used current (plugin/pkg/admission/resourcequota).
+
+    The apiserver serializes admission with the store write (see
+    APIServer create/update/delete), so the check-then-act here cannot
+    race another writer past a hard limit."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation in (CREATE, UPDATE, DELETE)
+
+    def admit(self, attrs: Attributes) -> None:
+        if not attrs.namespace or attrs.resource == "resourcequotas":
+            return
+        quotas = self.api.list("resourcequotas", attrs.namespace)["items"]
+        for quota in quotas:
+            hard = quota.get("spec", {}).get("hard", {})
+            if self._relevant(hard, attrs):
+                self._enforce(quota, hard, attrs)
+
+    @staticmethod
+    def _relevant(hard: dict, attrs: Attributes) -> bool:
+        """Skip quotas that track nothing this request touches."""
+        if attrs.resource in hard and attrs.resource in _QUOTA_COUNT_KEYS:
+            return True
+        return attrs.resource == "pods" and ("cpu" in hard or "memory" in hard)
+
+    def _usage(self, namespace: str, hard: dict) -> dict:
+        used: Dict[str, str] = {}
+        for key in hard:
+            if key in _QUOTA_COUNT_KEYS:
+                n = len(self.api.list(key, namespace)["items"])
+                used[key] = str(n)
+            elif key in ("cpu", "memory"):
+                total = 0
+                for pod in self.api.list("pods", namespace)["items"]:
+                    total += _pod_resource_total(pod, key).milli_value()
+                used[key] = str(Quantity.from_milli(total))
+        return used
+
+    def _old_pod_total(self, attrs: Attributes, key: str) -> int:
+        """Milli-total of `key` in the stored version of attrs' pod (for
+        UPDATE/DELETE deltas); 0 when it doesn't exist."""
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            old = self.api.get("pods", attrs.namespace, attrs.name)
+        except APIError:
+            return 0
+        return _pod_resource_total(old, key).milli_value()
+
+    def _enforce(self, quota: dict, hard: dict, attrs: Attributes) -> None:
+        # `used` reflects the store BEFORE this request's write lands
+        # (admission precedes the write); fold the delta in so the
+        # recorded status matches the post-write world.
+        used = self._usage(attrs.namespace, hard)
+        counted = attrs.resource in hard and attrs.resource in _QUOTA_COUNT_KEYS
+        if attrs.operation == CREATE and counted:
+            n = int(used[attrs.resource]) + 1
+            if n > parse_quantity(hard[attrs.resource]).value():
+                raise AdmissionError(
+                    f"limited to {hard[attrs.resource]} {attrs.resource}", 403
+                )
+            used[attrs.resource] = str(n)
+        elif attrs.operation == DELETE and counted:
+            from kubernetes_tpu.server.api import APIError
+
+            try:
+                self.api.get(attrs.resource, attrs.namespace, attrs.name)
+            except APIError:
+                return  # nothing will be deleted; leave status alone
+            used[attrs.resource] = str(max(0, int(used[attrs.resource]) - 1))
+        if attrs.resource == "pods":
+            for key in ("cpu", "memory"):
+                if key not in hard:
+                    continue
+                have = parse_quantity(used[key]).milli_value()
+                if attrs.operation == CREATE and attrs.obj is not None:
+                    delta = _pod_resource_total(attrs.obj, key).milli_value()
+                elif attrs.operation == UPDATE and attrs.obj is not None:
+                    delta = _pod_resource_total(
+                        attrs.obj, key
+                    ).milli_value() - self._old_pod_total(attrs, key)
+                elif attrs.operation == DELETE:
+                    delta = -self._old_pod_total(attrs, key)
+                else:
+                    delta = 0
+                cap = parse_quantity(hard[key]).milli_value()
+                if delta > 0 and have + delta > cap:
+                    raise AdmissionError(
+                        f"{key} quota exceeded: used {used[key]}, "
+                        f"requested {Quantity.from_milli(delta)}, "
+                        f"hard limit {hard[key]}"
+                    )
+                used[key] = str(Quantity.from_milli(max(0, have + delta)))
+        # Refresh status (best-effort; reference does a CAS loop).
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            self.api.update_status(
+                "resourcequotas",
+                attrs.namespace,
+                quota["metadata"]["name"],
+                {"status": {"hard": dict(hard), "used": used}},
+            )
+        except APIError:
+            pass
+
+
+class ServiceAccountAdmission(Interface):
+    """Default pods to the 'default' ServiceAccount and require the
+    referenced account to exist (plugin/pkg/admission/serviceaccount)."""
+
+    def __init__(self, api, require_account: bool = False):
+        self.api = api
+        self.require_account = require_account
+
+    def handles(self, operation: str) -> bool:
+        return operation == CREATE
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        spec = attrs.obj.setdefault("spec", {})
+        if not spec.get("serviceAccount"):
+            spec["serviceAccount"] = "default"
+        if self.require_account:
+            from kubernetes_tpu.server.api import APIError
+
+            try:
+                self.api.get("serviceaccounts", attrs.namespace, spec["serviceAccount"])
+            except APIError:
+                raise AdmissionError(
+                    f"service account {attrs.namespace}/{spec['serviceAccount']} "
+                    "does not exist"
+                )
+
+
+class SecurityContextDeny(Interface):
+    """Reject pods that request privileged mode, added capabilities, or
+    custom SELinux/RunAsUser options
+    (plugin/pkg/admission/securitycontext/scdeny)."""
+
+    def handles(self, operation: str) -> bool:
+        return operation in (CREATE, UPDATE)
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        for c in attrs.obj.get("spec", {}).get("containers", []):
+            sc = c.get("securityContext") or {}
+            if sc.get("privileged"):
+                raise AdmissionError(
+                    f"container {c.get('name')!r}: privileged mode is forbidden"
+                )
+            if (sc.get("capabilities") or {}).get("add"):
+                raise AdmissionError(
+                    f"container {c.get('name')!r}: added capabilities are forbidden"
+                )
+            if sc.get("seLinuxOptions") or sc.get("runAsUser") is not None:
+                raise AdmissionError(
+                    f"container {c.get('name')!r}: SecurityContext overrides "
+                    "are forbidden"
+                )
+
+
+class DenyExecOnPrivileged(Interface):
+    """Deny exec/attach on pods with privileged containers
+    (plugin/pkg/admission/exec)."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation == CONNECT
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.subresource not in ("exec", "attach"):
+            return
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            pod = self.api.get("pods", attrs.namespace, attrs.name)
+        except APIError:
+            return
+        for c in pod.get("spec", {}).get("containers", []):
+            if (c.get("securityContext") or {}).get("privileged"):
+                raise AdmissionError(
+                    "cannot exec into or attach to a privileged container"
+                )
+
+
+register_plugin("AlwaysAdmit", lambda api: AlwaysAdmit())
+register_plugin("AlwaysDeny", lambda api: AlwaysDeny())
+register_plugin("NamespaceExists", NamespaceExists)
+register_plugin("NamespaceAutoProvision", NamespaceAutoprovision)
+register_plugin("NamespaceLifecycle", NamespaceLifecycle)
+register_plugin("LimitRanger", LimitRanger)
+register_plugin("ResourceQuota", ResourceQuotaAdmission)
+register_plugin("ServiceAccount", ServiceAccountAdmission)
+register_plugin("SecurityContextDeny", lambda api: SecurityContextDeny())
+register_plugin("DenyExecOnPrivileged", DenyExecOnPrivileged)

@@ -19,6 +19,7 @@ callers use the client layer.
 
 from __future__ import annotations
 
+import contextlib
 import random
 import string
 import threading
@@ -126,10 +127,16 @@ class _FilteredStream:
 class APIServer:
     """The master: storage-backed REST resources (pkg/master/master.go)."""
 
-    def __init__(self, store: Optional[KVStore] = None):
+    def __init__(self, store: Optional[KVStore] = None, admission=None):
         self.store = store or KVStore()
-        self._lock = threading.Lock()
+        # Reentrant: admission plugins may issue writes of their own
+        # (NamespaceAutoprovision creates the namespace mid-admission).
+        self._lock = threading.RLock()
         self._rand = random.Random(0xC0FFEE)
+        # Admission chain (kubernetes_tpu.server.admission.Chain); None
+        # means admit everything (reference default --admission-control
+        # AlwaysAdmit, cmd/kube-apiserver/app/server.go:117).
+        self.admission = admission
         # Ensure the default namespace exists (reference auto-creates).
         try:
             self.store.create(
@@ -187,13 +194,45 @@ class APIServer:
         meta["uid"] = new_uid()
         meta["creationTimestamp"] = now_iso()
         meta.pop("resourceVersion", None)
-        self._validate(info, obj)
+        with self._write_guard():
+            self._admit("CREATE", info, ns, meta["name"], obj)
+            self._validate(info, obj)
+            try:
+                return self.store.create(
+                    info.key(ns, meta["name"]), obj, ttl=info.ttl
+                )
+            except AlreadyExistsError:
+                raise _conflict(f'{info.name} "{meta["name"]}" already exists')
+
+    def _write_guard(self):
+        """Serialize admission's check-then-act with the store write so
+        concurrent requests cannot both pass a quota/limit check and
+        blow past a hard limit (the reference serializes via CAS on
+        quota status; an in-process lock is the equivalent here). A
+        no-op when no admission chain is configured."""
+        if self.admission is None:
+            return contextlib.nullcontext()
+        return self._lock
+
+    def _admit(
+        self, operation: str, info: ResourceInfo, ns: str, name: str, obj
+    ) -> None:
+        if self.admission is None:
+            return
+        from kubernetes_tpu.server.admission import AdmissionError, Attributes
+
         try:
-            return self.store.create(
-                info.key(ns, meta["name"]), obj, ttl=info.ttl
+            self.admission.admit(
+                Attributes(
+                    operation=operation,
+                    resource=info.name,
+                    namespace=ns,
+                    name=name,
+                    obj=obj,
+                )
             )
-        except AlreadyExistsError:
-            raise _conflict(f'{info.name} "{meta["name"]}" already exists')
+        except AdmissionError as e:
+            raise APIError(e.code, e.reason, e.message)
 
     def _validate(self, info: ResourceInfo, obj: dict) -> None:
         if info.validator is None:
@@ -274,13 +313,39 @@ class APIServer:
                 raise _bad_request(
                     f"invalid resourceVersion {meta['resourceVersion']!r}"
                 )
-        self._validate(info, obj)
+        with self._write_guard():
+            self._admit("UPDATE", info, namespace, name, obj)
+            self._validate(info, obj)
+            try:
+                return self.store.set(key, obj, expected_version=expected)
+            except ConflictError as e:
+                raise _conflict(str(e))
+            except NotFoundError:
+                raise _not_found(info.name, name)
+
+    def connect(
+        self, resource: str, namespace: str, name: str, subresource: str
+    ) -> None:
+        """Admission gate for CONNECT subresources (exec/attach/proxy).
+        Reference: CONNECT verbs in pkg/apiserver/api_installer.go:268-284
+        pass through the admission chain before upgrade."""
+        info = self._info(resource)
+        if self.admission is None:
+            return
+        from kubernetes_tpu.server.admission import AdmissionError, Attributes
+
         try:
-            return self.store.set(key, obj, expected_version=expected)
-        except ConflictError as e:
-            raise _conflict(str(e))
-        except NotFoundError:
-            raise _not_found(info.name, name)
+            self.admission.admit(
+                Attributes(
+                    operation="CONNECT",
+                    resource=info.name,
+                    namespace=self._ns(info, namespace),
+                    name=name,
+                    subresource=subresource,
+                )
+            )
+        except AdmissionError as e:
+            raise APIError(e.code, e.reason, e.message)
 
     def update_status(self, resource: str, namespace: str, name: str, obj: dict) -> dict:
         """Status subresource: replace only .status (pkg/registry/pod/etcd
@@ -300,10 +365,12 @@ class APIServer:
 
     def delete(self, resource: str, namespace: str, name: str) -> dict:
         info = self._info(resource)
-        try:
-            self.store.delete(info.key(self._ns(info, namespace), name))
-        except NotFoundError:
-            raise _not_found(info.name, name)
+        with self._write_guard():
+            self._admit("DELETE", info, self._ns(info, namespace), name, None)
+            try:
+                self.store.delete(info.key(self._ns(info, namespace), name))
+            except NotFoundError:
+                raise _not_found(info.name, name)
         return {
             "kind": "Status",
             "apiVersion": "v1",

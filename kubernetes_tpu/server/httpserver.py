@@ -122,6 +122,7 @@ class _Handler(BaseHTTPRequestHandler):
             if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
                 raise APIError(404, "NotFound", f"unknown path {self.path!r}")
             rest = parts[2:]
+            self._check_auth(verb, rest)
             resource, code = self._api_v1(verb, rest)
         except APIError as e:
             code = e.code
@@ -146,6 +147,46 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             _REQS.inc(verb=verb, resource=resource, code=str(code))
             _LATENCY.observe(time.monotonic() - start, verb=verb, resource=resource)
+
+    def _check_auth(self, verb: str, rest: Tuple[str, ...]) -> None:
+        """Authenticate + authorize an /api request. Reference:
+        handler chain in pkg/master/master.go:584-585 (authn wraps
+        authz wraps the REST mux); 401 on bad credentials, 403 on
+        policy denial."""
+        authenticator = getattr(self, "authenticator", None)
+        authorizer = getattr(self, "authorizer", None)
+        if authenticator is None and authorizer is None:
+            return
+        from kubernetes_tpu.server import auth as authpkg
+
+        user = authpkg.UserInfo(name="system:anonymous")
+        if authenticator is not None:
+            try:
+                user = authenticator.authenticate_request(
+                    self.headers.get("Authorization", "")
+                )
+            except authpkg.AuthenticationError as e:
+                raise APIError(401, "Unauthorized", str(e))
+        if authorizer is not None:
+            # Derive (resource, namespace) from the path shape.
+            resource, ns = "", ""
+            if rest and rest[0] == "watch":
+                rest = rest[1:]
+            if len(rest) >= 3 and rest[0] == "namespaces":
+                ns, resource = rest[1], rest[2]
+            elif rest:
+                resource = rest[0]
+            try:
+                authorizer.authorize(
+                    authpkg.AuthzAttributes(
+                        user=user,
+                        readonly=verb in ("GET", "HEAD"),
+                        resource=resource,
+                        namespace=ns,
+                    )
+                )
+            except authpkg.AuthorizationError as e:
+                raise APIError(403, "Forbidden", str(e))
 
     # -- /api/v1 router ----------------------------------------------
 
@@ -207,6 +248,17 @@ class _Handler(BaseHTTPRequestHandler):
                 out = api.update_status(resource, ns, name, self._read_body())
                 self._send_json(200, out)
                 return resource, 200
+            if len(rest) == 5 and rest[4] in ("exec", "attach") and verb == "POST":
+                # CONNECT subresources (pkg/apiserver/api_installer.go
+                # CONNECT routes). Admission (DenyExecOnPrivileged) runs;
+                # the stream itself is served by the node agent's API
+                # (pkg/kubelet/server.go /exec/), not the apiserver.
+                api.connect(resource, ns, name, rest[4])
+                raise APIError(
+                    501,
+                    "NotImplemented",
+                    f"{rest[4]} streaming is served by the node agent API",
+                )
             if len(rest) == 4:
                 return self._item(verb, resource, ns, name)
             raise APIError(404, "NotFound", f"unknown path {self.path!r}")
@@ -299,8 +351,19 @@ class _Handler(BaseHTTPRequestHandler):
 class APIHTTPServer:
     """Owns the listening socket + serving thread."""
 
-    def __init__(self, api: APIServer, host: str = "127.0.0.1", port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"api": api})
+    def __init__(
+        self,
+        api: APIServer,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authenticator=None,
+        authorizer=None,
+    ):
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"api": api, "authenticator": authenticator, "authorizer": authorizer},
+        )
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.api = api

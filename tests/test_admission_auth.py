@@ -1,0 +1,440 @@
+"""Admission chain, plugins, and authn/authz tests.
+
+Reference behavior: pkg/admission/ + plugin/pkg/admission/ (chain,
+LimitRanger, ResourceQuota, namespace plugins, ServiceAccount,
+SecurityContextDeny), pkg/apiserver/authn.go, pkg/auth/authorizer/abac,
+pkg/serviceaccount/jwt.go."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.server import admission as adm
+from kubernetes_tpu.server import auth as authpkg
+from kubernetes_tpu.server.api import APIError, APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+def make_api(*plugin_names):
+    api = APIServer()
+    api.admission = adm.new_from_plugins(api, list(plugin_names))
+    return api
+
+
+POD = {
+    "kind": "Pod",
+    "metadata": {"name": "p1"},
+    "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+}
+
+
+def pod_with_resources(cpu="500m", mem="128Mi", name="p1"):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "resources": {"limits": {"cpu": cpu, "memory": mem}},
+                }
+            ]
+        },
+    }
+
+
+class TestChain:
+    def test_always_deny(self):
+        api = make_api("AlwaysDeny")
+        with pytest.raises(APIError) as ei:
+            api.create("pods", "default", dict(POD))
+        assert ei.value.code == 403
+
+    def test_always_admit(self):
+        api = make_api("AlwaysAdmit")
+        assert api.create("pods", "default", json.loads(json.dumps(POD)))
+
+    def test_unknown_plugin(self):
+        with pytest.raises(ValueError):
+            adm.new_from_plugins(APIServer(), ["NoSuchPlugin"])
+
+    def test_first_rejection_wins(self):
+        api = make_api("AlwaysAdmit", "AlwaysDeny")
+        with pytest.raises(APIError):
+            api.create("pods", "default", dict(POD))
+
+
+class TestNamespacePlugins:
+    def test_exists_rejects_missing(self):
+        api = make_api("NamespaceExists")
+        pod = json.loads(json.dumps(POD))
+        pod["metadata"]["namespace"] = "nope"
+        with pytest.raises(APIError) as ei:
+            api.create("pods", "nope", pod)
+        assert ei.value.code == 404
+
+    def test_autoprovision_creates(self):
+        api = make_api("NamespaceAutoProvision")
+        pod = json.loads(json.dumps(POD))
+        pod["metadata"]["namespace"] = "fresh"
+        api.create("pods", "fresh", pod)
+        assert api.get("namespaces", "", "fresh")["metadata"]["name"] == "fresh"
+
+    def test_lifecycle_rejects_terminating(self):
+        api = make_api("NamespaceLifecycle")
+        api.create("namespaces", "", {"metadata": {"name": "dying"}})
+        api.update_status(
+            "namespaces", "", "dying", {"status": {"phase": "Terminating"}}
+        )
+        pod = json.loads(json.dumps(POD))
+        pod["metadata"]["namespace"] = "dying"
+        with pytest.raises(APIError) as ei:
+            api.create("pods", "dying", pod)
+        assert ei.value.code == 403
+
+
+class TestLimitRanger:
+    def setup_method(self):
+        self.api = make_api("LimitRanger")
+        self.api.create(
+            "limitranges",
+            "default",
+            {
+                "kind": "LimitRange",
+                "metadata": {"name": "limits"},
+                "spec": {
+                    "limits": [
+                        {
+                            "type": "Container",
+                            "min": {"cpu": "100m"},
+                            "max": {"cpu": "2", "memory": "1Gi"},
+                            "default": {"cpu": "250m", "memory": "128Mi"},
+                        }
+                    ]
+                },
+            },
+        )
+
+    def test_defaults_applied(self):
+        created = self.api.create("pods", "default", json.loads(json.dumps(POD)))
+        limits = created["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["cpu"] == "250m"
+        assert limits["memory"] == "128Mi"
+
+    def test_max_enforced(self):
+        with pytest.raises(APIError) as ei:
+            self.api.create("pods", "default", pod_with_resources(cpu="4"))
+        assert "maximum cpu" in ei.value.message
+
+    def test_min_enforced(self):
+        with pytest.raises(APIError) as ei:
+            self.api.create("pods", "default", pod_with_resources(cpu="50m"))
+        assert "minimum cpu" in ei.value.message
+
+
+class TestResourceQuota:
+    def setup_method(self):
+        self.api = make_api("ResourceQuota")
+        self.api.create(
+            "resourcequotas",
+            "default",
+            {
+                "kind": "ResourceQuota",
+                "metadata": {"name": "q"},
+                "spec": {"hard": {"pods": "2", "cpu": "1"}},
+            },
+        )
+
+    def test_pod_count_enforced(self):
+        self.api.create("pods", "default", pod_with_resources(cpu="100m", name="a"))
+        self.api.create("pods", "default", pod_with_resources(cpu="100m", name="b"))
+        with pytest.raises(APIError) as ei:
+            self.api.create("pods", "default", pod_with_resources(cpu="100m", name="c"))
+        assert "limited to 2 pods" in ei.value.message
+
+    def test_cpu_quota_enforced(self):
+        self.api.create("pods", "default", pod_with_resources(cpu="800m", name="a"))
+        with pytest.raises(APIError) as ei:
+            self.api.create("pods", "default", pod_with_resources(cpu="500m", name="b"))
+        assert "cpu quota exceeded" in ei.value.message
+
+    def test_status_used_updated(self):
+        self.api.create("pods", "default", pod_with_resources(cpu="800m", name="a"))
+        q = self.api.get("resourcequotas", "default", "q")
+        assert q["status"]["used"]["pods"] == "1"
+        assert q["status"]["used"]["cpu"] == "800m"
+
+
+class TestServiceAccountAndSecurityContext:
+    def test_sa_defaulted(self):
+        api = make_api("ServiceAccount")
+        created = api.create("pods", "default", json.loads(json.dumps(POD)))
+        assert created["spec"]["serviceAccount"] == "default"
+
+    def test_privileged_denied(self):
+        api = make_api("SecurityContextDeny")
+        pod = json.loads(json.dumps(POD))
+        pod["spec"]["containers"][0]["securityContext"] = {"privileged": True}
+        with pytest.raises(APIError) as ei:
+            api.create("pods", "default", pod)
+        assert "privileged" in ei.value.message
+
+
+class TestAuthenticators:
+    def test_password(self):
+        a = authpkg.PasswordAuthenticator(
+            {"alice": ("secret", authpkg.UserInfo(name="alice", uid="1"))}
+        )
+        assert a.authenticate_password("alice", "secret").name == "alice"
+        with pytest.raises(authpkg.AuthenticationError):
+            a.authenticate_password("alice", "wrong")
+
+    def test_token_file(self, tmp_path):
+        p = tmp_path / "tokens.csv"
+        p.write_text("tok123,bob,2,admins,devs\n# comment\n")
+        a = authpkg.TokenAuthenticator.from_file(str(p))
+        info = a.authenticate_token("tok123")
+        assert info.name == "bob" and "admins" in info.groups
+
+    def test_sa_jwt_roundtrip(self):
+        mgr = authpkg.ServiceAccountTokenManager(b"cluster-signing-key")
+        tok = mgr.mint("default", "builder", uid="u1", secret_name="builder-token")
+        info = mgr.authenticate_token(tok)
+        assert info.name == "system:serviceaccount:default:builder"
+        assert "system:serviceaccounts" in info.groups
+        # Tampering is detected.
+        h, c, s = tok.split(".")
+        bad_claims = base64.urlsafe_b64encode(
+            json.dumps({"iss": authpkg.ISSUER}).encode()
+        ).rstrip(b"=").decode()
+        with pytest.raises(authpkg.AuthenticationError):
+            mgr.authenticate_token(f"{h}.{bad_claims}.{s}")
+
+
+class TestABAC:
+    def make(self):
+        return authpkg.ABACAuthorizer(
+            [
+                authpkg.Policy(user="admin"),
+                authpkg.Policy(user="reader", readonly=True),
+                authpkg.Policy(group="schedulers", resource="pods"),
+                authpkg.Policy(user="nsuser", namespace="team1"),
+            ]
+        )
+
+    def attrs(self, name, groups=(), **kw):
+        return authpkg.AuthzAttributes(
+            user=authpkg.UserInfo(name=name, groups=tuple(groups)), **kw
+        )
+
+    def test_admin_all(self):
+        self.make().authorize(self.attrs("admin", resource="pods"))
+
+    def test_reader_only_reads(self):
+        a = self.make()
+        a.authorize(self.attrs("reader", readonly=True, resource="pods"))
+        with pytest.raises(authpkg.AuthorizationError):
+            a.authorize(self.attrs("reader", readonly=False, resource="pods"))
+
+    def test_group_and_resource_scope(self):
+        a = self.make()
+        a.authorize(self.attrs("x", groups=["schedulers"], resource="pods"))
+        with pytest.raises(authpkg.AuthorizationError):
+            a.authorize(self.attrs("x", groups=["schedulers"], resource="nodes"))
+
+    def test_namespace_scope(self):
+        a = self.make()
+        a.authorize(self.attrs("nsuser", resource="pods", namespace="team1"))
+        with pytest.raises(authpkg.AuthorizationError):
+            a.authorize(self.attrs("nsuser", resource="pods", namespace="team2"))
+
+    def test_policy_file(self, tmp_path):
+        p = tmp_path / "policy.jsonl"
+        p.write_text(
+            '{"user": "alice"}\n'
+            '# comment\n'
+            '{"group": "system:serviceaccounts", "readonly": true}\n'
+        )
+        a = authpkg.ABACAuthorizer.from_file(str(p))
+        a.authorize(self.attrs("alice", resource="pods"))
+        a.authorize(
+            self.attrs("sa", groups=["system:serviceaccounts"], readonly=True)
+        )
+
+
+class TestHTTPAuth:
+    """Auth enforced at the HTTP boundary: 401 bad creds, 403 denied."""
+
+    def setup_method(self):
+        authn = authpkg.UnionAuthenticator(
+            password=authpkg.PasswordAuthenticator(
+                {"admin": ("pw", authpkg.UserInfo(name="admin"))}
+            ),
+            tokens=[
+                authpkg.TokenAuthenticator(
+                    {"rotoken": authpkg.UserInfo(name="reader")}
+                )
+            ],
+        )
+        authz = authpkg.ABACAuthorizer(
+            [
+                authpkg.Policy(user="admin"),
+                authpkg.Policy(user="reader", readonly=True),
+            ]
+        )
+        self.srv = APIHTTPServer(
+            APIServer(), authenticator=authn, authorizer=authz
+        ).start()
+        self.base = self.srv.address
+
+    def teardown_method(self):
+        self.srv.stop()
+
+    def req(self, method, path, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            self.base + path, data=data, method=method, headers=headers or {}
+        )
+        try:
+            with urllib.request.urlopen(r) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def basic(self, user, pw):
+        return {
+            "Authorization": "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()
+        }
+
+    def test_no_creds_401(self):
+        code, _ = self.req("GET", "/api/v1/pods")
+        assert code == 401
+
+    def test_bad_password_401(self):
+        code, _ = self.req("GET", "/api/v1/pods", headers=self.basic("admin", "no"))
+        assert code == 401
+
+    def test_admin_can_write(self):
+        code, _ = self.req(
+            "POST",
+            "/api/v1/namespaces/default/pods",
+            body=POD,
+            headers=self.basic("admin", "pw"),
+        )
+        assert code == 201
+
+    def test_reader_can_read_not_write(self):
+        hdr = {"Authorization": "Bearer rotoken"}
+        code, _ = self.req("GET", "/api/v1/pods", headers=hdr)
+        assert code == 200
+        code, _ = self.req(
+            "POST", "/api/v1/namespaces/default/pods", body=POD, headers=hdr
+        )
+        assert code == 403
+
+    def test_healthz_unauthenticated(self):
+        r = urllib.request.Request(self.base + "/healthz")
+        with urllib.request.urlopen(r) as resp:
+            assert resp.status == 200
+
+
+class TestResourceQuotaUpdateDelete:
+    """UPDATE and DELETE paths of the quota plugin (reference handles
+    Create and Update; delete reconciliation keeps used accurate)."""
+
+    def setup_method(self):
+        self.api = make_api("ResourceQuota")
+        self.api.create(
+            "resourcequotas",
+            "default",
+            {
+                "kind": "ResourceQuota",
+                "metadata": {"name": "q"},
+                "spec": {"hard": {"pods": "5", "cpu": "1"}},
+            },
+        )
+
+    def test_update_enforces_cpu(self):
+        self.api.create("pods", "default", pod_with_resources(cpu="800m", name="a"))
+        grown = pod_with_resources(cpu="4", name="a")
+        with pytest.raises(APIError) as ei:
+            self.api.update("pods", "default", "a", grown)
+        assert "cpu quota exceeded" in ei.value.message
+        # Shrinking is always allowed.
+        self.api.update("pods", "default", "a", pod_with_resources(cpu="100m", name="a"))
+        q = self.api.get("resourcequotas", "default", "q")
+        assert q["status"]["used"]["cpu"] == "100m"
+
+    def test_delete_decrements_used(self):
+        self.api.create("pods", "default", pod_with_resources(cpu="500m", name="a"))
+        self.api.delete("pods", "default", "a")
+        q = self.api.get("resourcequotas", "default", "q")
+        assert q["status"]["used"]["pods"] == "0"
+        assert q["status"]["used"]["cpu"] == "0"
+
+    def test_delete_missing_leaves_status(self):
+        self.api.create("pods", "default", pod_with_resources(cpu="500m", name="a"))
+        with pytest.raises(APIError):
+            self.api.delete("pods", "default", "ghost")
+        q = self.api.get("resourcequotas", "default", "q")
+        assert q["status"]["used"]["pods"] == "1"
+
+    def test_concurrent_creates_cannot_exceed(self):
+        import threading
+
+        api = make_api("ResourceQuota")
+        api.create(
+            "resourcequotas",
+            "default",
+            {
+                "kind": "ResourceQuota",
+                "metadata": {"name": "q"},
+                "spec": {"hard": {"pods": "3"}},
+            },
+        )
+        results = []
+
+        def creator(i):
+            try:
+                api.create("pods", "default", pod_with_resources(name=f"p{i}"))
+                results.append(True)
+            except APIError:
+                results.append(False)
+
+        threads = [threading.Thread(target=creator, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(results) == 3
+        assert len(api.list("pods", "default")["items"]) == 3
+
+
+class TestExecAdmission:
+    def test_deny_exec_on_privileged(self):
+        api = make_api("DenyExecOnPrivileged")
+        pod = json.loads(json.dumps(POD))
+        pod["spec"]["containers"][0]["securityContext"] = {"privileged": True}
+        api.create("pods", "default", pod)
+        with pytest.raises(APIError) as ei:
+            api.connect("pods", "default", "p1", "exec")
+        assert ei.value.code == 403
+        # Unprivileged pods pass the gate.
+        unpriv = json.loads(json.dumps(POD))
+        unpriv["metadata"]["name"] = "p2"
+        api.create("pods", "default", unpriv)
+        api.connect("pods", "default", "p2", "exec")  # no raise
+
+
+class TestAdmissionErrorReasons:
+    def test_missing_namespace_reason_notfound(self):
+        api = make_api("NamespaceExists")
+        pod = json.loads(json.dumps(POD))
+        pod["metadata"]["namespace"] = "nope"
+        with pytest.raises(APIError) as ei:
+            api.create("pods", "nope", pod)
+        assert ei.value.code == 404 and ei.value.reason == "NotFound"
