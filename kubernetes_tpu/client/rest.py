@@ -214,6 +214,9 @@ class HTTPTransport(Transport):
                 f"/api/v1/namespaces/{namespace or 'default'}/bulkbindings",
                 body=body,
             )
+        if op == "finalize_namespace":
+            (name,) = args
+            return self._do("PUT", f"/api/v1/namespaces/{name}/finalize", body=body)
         raise ValueError(f"unknown op {op!r}")
 
     def watch(self, resource, namespace, since, lsel, fsel):
@@ -315,6 +318,18 @@ class Client:
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         self._throttle()
         self.t.request("DELETE", "delete", (resource, namespace, name))
+
+    def finalize_namespace(self, name: str, finalizers) -> None:
+        """PUT the namespace 'finalize' subresource
+        (pkg/registry/namespace/etcd FinalizeREST)."""
+        self._throttle()
+        self.t.request(
+            "PUT",
+            "finalize_namespace",
+            (name,),
+            {"kind": "Namespace", "metadata": {"name": name},
+             "spec": {"finalizers": list(finalizers)}},
+        )
 
     def bind_bulk(self, bindings, namespace: str = "default") -> list:
         """Commit many (pod_name, node_name) bindings in one request;

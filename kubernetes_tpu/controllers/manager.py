@@ -8,8 +8,17 @@ from __future__ import annotations
 from typing import List, Optional
 
 from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.namespace import NamespaceManager
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replication import ReplicationManager
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaManager
+from kubernetes_tpu.controllers.serviceaccounts import (
+    ServiceAccountsController,
+    TokenController,
+)
+from kubernetes_tpu.controllers.volumeclaimbinder import (
+    PersistentVolumeClaimBinder,
+)
 
 
 class ControllerManager:
@@ -19,8 +28,13 @@ class ControllerManager:
         enable_replication: bool = True,
         enable_endpoints: bool = True,
         enable_node_lifecycle: bool = True,
+        enable_namespace: bool = True,
+        enable_resource_quota: bool = True,
+        enable_service_accounts: bool = True,
+        enable_pv_binder: bool = True,
         node_grace_period: float = 8.0,
         node_eviction_timeout: float = 4.0,
+        sa_token_manager=None,
     ):
         self.controllers: List = []
         if enable_replication:
@@ -36,6 +50,21 @@ class ControllerManager:
                 eviction_timeout=node_eviction_timeout,
             )
             self.controllers.append(self.node_lifecycle)
+        if enable_namespace:
+            self.namespace = NamespaceManager(client)
+            self.controllers.append(self.namespace)
+        if enable_resource_quota:
+            self.resource_quota = ResourceQuotaManager(client)
+            self.controllers.append(self.resource_quota)
+        if enable_service_accounts:
+            self.service_accounts = ServiceAccountsController(client)
+            self.controllers.append(self.service_accounts)
+            if sa_token_manager is not None:
+                self.tokens = TokenController(client, sa_token_manager)
+                self.controllers.append(self.tokens)
+        if enable_pv_binder:
+            self.pv_binder = PersistentVolumeClaimBinder(client)
+            self.controllers.append(self.pv_binder)
 
     def start(self) -> "ControllerManager":
         for c in self.controllers:

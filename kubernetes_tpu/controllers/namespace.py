@@ -1,0 +1,104 @@
+"""NamespaceManager: two-phase namespace deletion.
+
+Reference: pkg/namespace/namespace_controller.go — when a namespace
+enters Terminating (deletionTimestamp set by the registry while
+spec.finalizers is non-empty), purge all namespaced content, clear the
+'kubernetes' finalizer via the finalize subresource, then delete the
+now-finalizer-free namespace for real.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+
+# Content purged on namespace termination (reference
+# namespace_controller.go deleteAllContent; extended to every
+# namespaced resource this framework serves).
+_NAMESPACED_RESOURCES = [
+    "pods",
+    "replicationcontrollers",
+    "services",
+    "endpoints",
+    "secrets",
+    "serviceaccounts",
+    "limitranges",
+    "resourcequotas",
+    "persistentvolumeclaims",
+    "podtemplates",
+    "events",
+]
+
+_SYNCS = metrics.DEFAULT.counter(
+    "namespace_controller_syncs_total", "namespace sync passes", ("result",)
+)
+
+
+class NamespaceManager:
+    def __init__(self, client, sync_period: float = 1.0):
+        self.client = client
+        self.sync_period = sync_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NamespaceManager":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+            except Exception:
+                _SYNCS.inc(result="error")
+            self._stop.wait(self.sync_period)
+
+    def sync_once(self) -> int:
+        """One pass over all namespaces; returns count finalized."""
+        done = 0
+        namespaces, _ = self.client.list("namespaces")
+        for ns in namespaces:
+            if ns.status.phase != "Terminating":
+                continue
+            self._terminate(ns.metadata.name, ns.spec.finalizers)
+            done += 1
+            _SYNCS.inc(result="terminated")
+        return done
+
+    def _terminate(self, name: str, finalizers: List[str]) -> None:
+        for resource in _NAMESPACED_RESOURCES:
+            try:
+                items, _ = self.client.list(resource, namespace=name)
+            except APIError:
+                continue
+            for obj in items:
+                try:
+                    self.client.delete(
+                        resource, obj.metadata.name, namespace=name
+                    )
+                except APIError:
+                    pass  # already gone / racing deleter
+        # Remove only OUR finalizer; foreign finalizers (guarding
+        # external cleanup owned by other controllers) must stay until
+        # their owners remove them (namespace_controller.go finalize).
+        remaining = [f for f in finalizers if f != "kubernetes"]
+        if remaining != list(finalizers):
+            try:
+                self.client.finalize_namespace(name, remaining)
+            except APIError:
+                return
+        if remaining:
+            return  # someone else's finalizer still pending
+        try:
+            self.client.delete("namespaces", name)
+        except APIError:
+            pass

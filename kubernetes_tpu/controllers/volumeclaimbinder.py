@@ -1,0 +1,170 @@
+"""PersistentVolumeClaimBinder: match Pending claims to Available
+volumes.
+
+Reference: pkg/volumeclaimbinder/persistent_volume_claim_binder.go —
+smallest-sufficient-volume matching on capacity + access modes, bind by
+cross-referencing pv.spec.claimRef <-> pvc.spec.volumeName, release on
+claim deletion honoring the reclaim policy (Retain keeps the volume
+Released; Recycle returns it to Available).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from kubernetes_tpu.models.objects import ObjectReference
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+
+_SYNCS = metrics.DEFAULT.counter(
+    "pv_claim_binder_syncs_total", "PV claim binder passes", ("result",)
+)
+
+
+def _storage_milli(resource_list) -> int:
+    q = (resource_list or {}).get("storage")
+    return q.milli_value() if q is not None else 0
+
+
+class PersistentVolumeClaimBinder:
+    def __init__(self, client, sync_period: float = 2.0):
+        self.client = client
+        self.sync_period = sync_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PersistentVolumeClaimBinder":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+            except Exception:
+                _SYNCS.inc(result="error")
+            self._stop.wait(self.sync_period)
+
+    def sync_once(self) -> int:
+        """Bind pending claims, release orphaned volumes; returns the
+        number of bindings made."""
+        volumes, _ = self.client.list("persistentvolumes")
+        claims, _ = self.client.list("persistentvolumeclaims")
+        bound = 0
+
+        # Phase transitions for fresh volumes. Status writes bump the
+        # resourceVersion, so re-list before the CAS'd bind updates.
+        transitioned = False
+        for pv in volumes:
+            if pv.status.phase == "Pending":
+                pv.status.phase = "Available"
+                self._put_pv_status(pv)
+                transitioned = True
+        if transitioned:
+            volumes, _ = self.client.list("persistentvolumes")
+
+        # Release volumes whose claim vanished.
+        claim_keys = {
+            (c.metadata.namespace, c.metadata.name) for c in claims
+        }
+        for pv in volumes:
+            ref = pv.spec.claim_ref
+            if pv.status.phase == "Bound" and ref is not None:
+                if (ref.namespace, ref.name) not in claim_keys:
+                    self._release(pv)
+
+        # Bind pending claims: smallest sufficient Available volume.
+        available = [
+            pv
+            for pv in volumes
+            if pv.status.phase in ("Available", "Pending")
+            and pv.spec.claim_ref is None
+        ]
+        available.sort(key=lambda pv: _storage_milli(pv.spec.capacity))
+        for claim in claims:
+            if claim.status.phase == "Bound" or claim.spec.volume_name:
+                continue
+            want = _storage_milli(
+                claim.spec.resources.requests or claim.spec.resources.limits
+            )
+            modes = set(claim.spec.access_modes)
+            match = None
+            for pv in available:
+                if _storage_milli(pv.spec.capacity) < want:
+                    continue
+                if not modes.issubset(set(pv.spec.access_modes)):
+                    continue
+                match = pv
+                break
+            if match is None:
+                continue
+            if self._bind(match, claim):
+                available.remove(match)
+                bound += 1
+                _SYNCS.inc(result="bound")
+        return bound
+
+    def _bind(self, pv, claim) -> bool:
+        pv.spec.claim_ref = ObjectReference(
+            kind="PersistentVolumeClaim",
+            namespace=claim.metadata.namespace,
+            name=claim.metadata.name,
+            uid=claim.metadata.uid,
+        )
+        try:
+            pv = self.client.update("persistentvolumes", pv)
+        except APIError:
+            return False
+        pv.status.phase = "Bound"
+        self._put_pv_status(pv)
+        claim.spec.volume_name = pv.metadata.name
+        try:
+            claim = self.client.update(
+                "persistentvolumeclaims", claim, namespace=claim.metadata.namespace
+            )
+        except APIError:
+            # Roll the volume back to Available so it isn't stranded.
+            pv.spec.claim_ref = None
+            pv.status.phase = "Available"
+            try:
+                self.client.update("persistentvolumes", pv)
+            except APIError:
+                pass
+            self._put_pv_status(pv)
+            return False
+        claim.status.phase = "Bound"
+        claim.status.capacity = dict(pv.spec.capacity)
+        claim.status.access_modes = list(pv.spec.access_modes)
+        try:
+            self.client.update_status(
+                "persistentvolumeclaims", claim, namespace=claim.metadata.namespace
+            )
+        except APIError:
+            pass
+        return True
+
+    def _release(self, pv) -> None:
+        if pv.spec.persistent_volume_reclaim_policy == "Recycle":
+            pv.spec.claim_ref = None
+            try:
+                self.client.update("persistentvolumes", pv)
+            except APIError:
+                return
+            pv.status.phase = "Available"
+        else:  # Retain (and Delete, which we model as Retain + operator action)
+            pv.status.phase = "Released"
+        self._put_pv_status(pv)
+        _SYNCS.inc(result="released")
+
+    def _put_pv_status(self, pv) -> None:
+        try:
+            self.client.update_status("persistentvolumes", pv)
+        except APIError:
+            pass

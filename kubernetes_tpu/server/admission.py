@@ -60,6 +60,11 @@ class Interface:
     def admit(self, attrs: Attributes) -> None:  # may mutate attrs.obj
         raise NotImplementedError
 
+    def commit(self, attrs: Attributes) -> None:
+        """Called after the store write succeeded (best-effort hook for
+        usage bookkeeping); must not raise."""
+        return None
+
 
 class Chain(list):
     """Ordered plugin list; first rejection wins (pkg/admission/chain.go)."""
@@ -68,6 +73,11 @@ class Chain(list):
         for plugin in self:
             if plugin.handles(attrs.operation):
                 plugin.admit(attrs)
+
+    def commit(self, attrs: Attributes) -> None:
+        for plugin in self:
+            if plugin.handles(attrs.operation):
+                plugin.commit(attrs)
 
 
 # -- plugin registry (pkg/admission/plugins.go) -----------------------------
@@ -267,15 +277,19 @@ class LimitRanger(Interface):
 
 
 # Hard-limit keys a ResourceQuota can carry for object counts
-# (reference: pkg/api/types.go ResourceQuota resource names).
-_QUOTA_COUNT_KEYS = {
-    "pods": "pods",
-    "services": "services",
-    "replicationcontrollers": "replicationcontrollers",
-    "secrets": "secrets",
-    "persistentvolumeclaims": "persistentvolumeclaims",
-    "resourcequotas": "resourcequotas",
-}
+# (reference: pkg/api/types.go ResourceQuota resource names). Shared
+# with the ResourceQuotaManager backstop controller — one list, one
+# definition of "countable".
+COUNTED_RESOURCES = frozenset(
+    {
+        "pods",
+        "services",
+        "replicationcontrollers",
+        "secrets",
+        "persistentvolumeclaims",
+        "resourcequotas",
+    }
+)
 
 
 class ResourceQuotaAdmission(Interface):
@@ -293,30 +307,59 @@ class ResourceQuotaAdmission(Interface):
         return operation in (CREATE, UPDATE, DELETE)
 
     def admit(self, attrs: Attributes) -> None:
+        """Enforce only; no status writes here. A rejected (or later
+        failing) request must leave quota status untouched — recording
+        happens in commit() after the store write lands."""
         if not attrs.namespace or attrs.resource == "resourcequotas":
             return
-        quotas = self.api.list("resourcequotas", attrs.namespace)["items"]
-        for quota in quotas:
+        for quota in self.api.list("resourcequotas", attrs.namespace)["items"]:
             hard = quota.get("spec", {}).get("hard", {})
             if self._relevant(hard, attrs):
-                self._enforce(quota, hard, attrs)
+                self._enforce(hard, attrs)
+
+    def commit(self, attrs: Attributes) -> None:
+        """Post-write: recompute used from the store (now exact — the
+        write already landed) and persist it when it changed."""
+        if not attrs.namespace or attrs.resource == "resourcequotas":
+            return
+        from kubernetes_tpu.server.api import APIError
+
+        for quota in self.api.list("resourcequotas", attrs.namespace)["items"]:
+            hard = quota.get("spec", {}).get("hard", {})
+            if not self._relevant(hard, attrs):
+                continue
+            used = self._usage(attrs.namespace, hard)
+            if used == quota.get("status", {}).get("used", {}):
+                continue  # unchanged: skip the write, don't wake watchers
+            try:
+                self.api.update_status(
+                    "resourcequotas",
+                    attrs.namespace,
+                    quota["metadata"]["name"],
+                    {"status": {"hard": dict(hard), "used": used}},
+                )
+            except APIError:
+                pass  # backstop controller reconciles
 
     @staticmethod
     def _relevant(hard: dict, attrs: Attributes) -> bool:
         """Skip quotas that track nothing this request touches."""
-        if attrs.resource in hard and attrs.resource in _QUOTA_COUNT_KEYS:
+        if attrs.resource in hard and attrs.resource in COUNTED_RESOURCES:
             return True
         return attrs.resource == "pods" and ("cpu" in hard or "memory" in hard)
 
     def _usage(self, namespace: str, hard: dict) -> dict:
         used: Dict[str, str] = {}
+        pods = None
         for key in hard:
-            if key in _QUOTA_COUNT_KEYS:
+            if key in COUNTED_RESOURCES:
                 n = len(self.api.list(key, namespace)["items"])
                 used[key] = str(n)
             elif key in ("cpu", "memory"):
+                if pods is None:
+                    pods = self.api.list("pods", namespace)["items"]
                 total = 0
-                for pod in self.api.list("pods", namespace)["items"]:
+                for pod in pods:
                     total += _pod_resource_total(pod, key).milli_value()
                 used[key] = str(Quantity.from_milli(total))
         return used
@@ -332,42 +375,30 @@ class ResourceQuotaAdmission(Interface):
             return 0
         return _pod_resource_total(old, key).milli_value()
 
-    def _enforce(self, quota: dict, hard: dict, attrs: Attributes) -> None:
-        # `used` reflects the store BEFORE this request's write lands
-        # (admission precedes the write); fold the delta in so the
-        # recorded status matches the post-write world.
+    def _enforce(self, hard: dict, attrs: Attributes) -> None:
+        # `used` reflects the store BEFORE this request's write lands.
         used = self._usage(attrs.namespace, hard)
-        counted = attrs.resource in hard and attrs.resource in _QUOTA_COUNT_KEYS
+        counted = attrs.resource in hard and attrs.resource in COUNTED_RESOURCES
         if attrs.operation == CREATE and counted:
-            n = int(used[attrs.resource]) + 1
-            if n > parse_quantity(hard[attrs.resource]).value():
+            if int(used[attrs.resource]) + 1 > parse_quantity(
+                hard[attrs.resource]
+            ).value():
                 raise AdmissionError(
                     f"limited to {hard[attrs.resource]} {attrs.resource}", 403
                 )
-            used[attrs.resource] = str(n)
-        elif attrs.operation == DELETE and counted:
-            from kubernetes_tpu.server.api import APIError
-
-            try:
-                self.api.get(attrs.resource, attrs.namespace, attrs.name)
-            except APIError:
-                return  # nothing will be deleted; leave status alone
-            used[attrs.resource] = str(max(0, int(used[attrs.resource]) - 1))
         if attrs.resource == "pods":
             for key in ("cpu", "memory"):
                 if key not in hard:
                     continue
-                have = parse_quantity(used[key]).milli_value()
                 if attrs.operation == CREATE and attrs.obj is not None:
                     delta = _pod_resource_total(attrs.obj, key).milli_value()
                 elif attrs.operation == UPDATE and attrs.obj is not None:
                     delta = _pod_resource_total(
                         attrs.obj, key
                     ).milli_value() - self._old_pod_total(attrs, key)
-                elif attrs.operation == DELETE:
-                    delta = -self._old_pod_total(attrs, key)
                 else:
-                    delta = 0
+                    continue  # deletes only shrink usage
+                have = parse_quantity(used[key]).milli_value()
                 cap = parse_quantity(hard[key]).milli_value()
                 if delta > 0 and have + delta > cap:
                     raise AdmissionError(
@@ -375,19 +406,6 @@ class ResourceQuotaAdmission(Interface):
                         f"requested {Quantity.from_milli(delta)}, "
                         f"hard limit {hard[key]}"
                     )
-                used[key] = str(Quantity.from_milli(max(0, have + delta)))
-        # Refresh status (best-effort; reference does a CAS loop).
-        from kubernetes_tpu.server.api import APIError
-
-        try:
-            self.api.update_status(
-                "resourcequotas",
-                attrs.namespace,
-                quota["metadata"]["name"],
-                {"status": {"hard": dict(hard), "used": used}},
-            )
-        except APIError:
-            pass
 
 
 class ServiceAccountAdmission(Interface):

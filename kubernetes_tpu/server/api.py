@@ -191,6 +191,12 @@ class APIServer:
         obj.setdefault("apiVersion", "v1")
         if obj["kind"] != info.kind:
             raise _bad_request(f"kind {obj['kind']!r} does not match {info.kind!r}")
+        if info.name == "namespaces":
+            # Reference: namespaces default to the "kubernetes" finalizer
+            # (pkg/registry/namespace/etcd + pkg/api defaults), making
+            # deletion two-phase (Terminating -> content purge -> gone).
+            obj.setdefault("spec", {}).setdefault("finalizers", ["kubernetes"])
+            obj.setdefault("status", {}).setdefault("phase", "Active")
         meta["uid"] = new_uid()
         meta["creationTimestamp"] = now_iso()
         meta.pop("resourceVersion", None)
@@ -198,11 +204,13 @@ class APIServer:
             self._admit("CREATE", info, ns, meta["name"], obj)
             self._validate(info, obj)
             try:
-                return self.store.create(
+                out = self.store.create(
                     info.key(ns, meta["name"]), obj, ttl=info.ttl
                 )
             except AlreadyExistsError:
                 raise _conflict(f'{info.name} "{meta["name"]}" already exists')
+            self._commit("CREATE", info, ns, meta["name"], obj)
+            return out
 
     def _write_guard(self):
         """Serialize admission's check-then-act with the store write so
@@ -233,6 +241,27 @@ class APIServer:
             )
         except AdmissionError as e:
             raise APIError(e.code, e.reason, e.message)
+
+    def _commit(
+        self, operation: str, info: ResourceInfo, ns: str, name: str, obj
+    ) -> None:
+        """Post-write admission hook (usage bookkeeping); never raises."""
+        if self.admission is None:
+            return
+        from kubernetes_tpu.server.admission import Attributes
+
+        try:
+            self.admission.commit(
+                Attributes(
+                    operation=operation,
+                    resource=info.name,
+                    namespace=ns,
+                    name=name,
+                    obj=obj,
+                )
+            )
+        except Exception:
+            pass
 
     def _validate(self, info: ResourceInfo, obj: dict) -> None:
         if info.validator is None:
@@ -317,11 +346,56 @@ class APIServer:
             self._admit("UPDATE", info, namespace, name, obj)
             self._validate(info, obj)
             try:
-                return self.store.set(key, obj, expected_version=expected)
+                out = self.store.set(key, obj, expected_version=expected)
             except ConflictError as e:
                 raise _conflict(str(e))
             except NotFoundError:
                 raise _not_found(info.name, name)
+            self._commit("UPDATE", info, namespace, name, obj)
+            return out
+
+    def _mark_namespace_terminating(self, name: str) -> Optional[dict]:
+        """Two-phase namespace deletion (pkg/registry/namespace/etcd):
+        while spec.finalizers is non-empty, DELETE marks the namespace
+        Terminating (deletionTimestamp + status.phase) and returns it;
+        the namespace controller purges content, finalizes, and re-issues
+        the DELETE which then actually removes the object. Returns None
+        when the namespace should be deleted for real."""
+        key = "/registry/namespaces/" + name
+        try:
+            cur = self.store.get(key)
+        except NotFoundError:
+            raise _not_found("namespaces", name)
+        if not cur.get("spec", {}).get("finalizers"):
+            return None
+
+        def mark(obj: dict) -> dict:
+            obj.setdefault("metadata", {}).setdefault(
+                "deletionTimestamp", now_iso()
+            )
+            obj.setdefault("status", {})["phase"] = "Terminating"
+            return obj
+
+        try:
+            return self.store.guaranteed_update(key, mark)
+        except NotFoundError:
+            raise _not_found("namespaces", name)
+
+    def finalize_namespace(self, name: str, obj: dict) -> dict:
+        """The 'finalize' subresource: replace spec.finalizers from the
+        wire body (pkg/registry/namespace/etcd FinalizeREST)."""
+        finalizers = list(obj.get("spec", {}).get("finalizers", []))
+
+        def apply(cur: dict) -> dict:
+            cur.setdefault("spec", {})["finalizers"] = finalizers
+            return cur
+
+        try:
+            return self.store.guaranteed_update(
+                "/registry/namespaces/" + name, apply
+            )
+        except NotFoundError:
+            raise _not_found("namespaces", name)
 
     def connect(
         self, resource: str, namespace: str, name: str, subresource: str
@@ -365,12 +439,17 @@ class APIServer:
 
     def delete(self, resource: str, namespace: str, name: str) -> dict:
         info = self._info(resource)
+        if info.name == "namespaces":
+            marked = self._mark_namespace_terminating(name)
+            if marked is not None:
+                return marked
         with self._write_guard():
             self._admit("DELETE", info, self._ns(info, namespace), name, None)
             try:
                 self.store.delete(info.key(self._ns(info, namespace), name))
             except NotFoundError:
                 raise _not_found(info.name, name)
+            self._commit("DELETE", info, self._ns(info, namespace), name, None)
         return {
             "kind": "Status",
             "apiVersion": "v1",

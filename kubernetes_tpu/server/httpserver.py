@@ -172,7 +172,9 @@ class _Handler(BaseHTTPRequestHandler):
             resource, ns = "", ""
             if rest and rest[0] == "watch":
                 rest = rest[1:]
-            if len(rest) >= 3 and rest[0] == "namespaces":
+            if len(rest) == 3 and rest[0] == "namespaces" and rest[2] == "finalize":
+                resource = "namespaces"  # cluster-scoped subresource path
+            elif len(rest) >= 3 and rest[0] == "namespaces":
                 ns, resource = rest[1], rest[2]
             elif rest:
                 resource = rest[0]
@@ -219,6 +221,18 @@ class _Handler(BaseHTTPRequestHandler):
                 raise APIError(404, "NotFound", f"bad watch path {self.path!r}")
             self._serve_watch(resource, ns, lsel, fsel, q)
             return resource, 200
+
+        # Namespace finalize subresource (not a namespaced collection
+        # path): PUT /api/v1/namespaces/{name}/finalize.
+        if (
+            len(rest) == 3
+            and rest[0] == "namespaces"
+            and rest[2] == "finalize"
+            and verb == "PUT"
+        ):
+            out = self.api.finalize_namespace(rest[1], self._read_body())
+            self._send_json(200, out)
+            return "namespaces", 200
 
         # Namespaced paths.
         if rest[0] == "namespaces" and len(rest) >= 3:

@@ -1,0 +1,283 @@
+"""Tests for the satellite controllers: NamespaceManager,
+ResourceQuotaManager, ServiceAccounts/Token controllers, PV claim
+binder.
+
+Reference behaviors: pkg/namespace/, pkg/resourcequota/,
+pkg/serviceaccount/, pkg/volumeclaimbinder/."""
+
+import base64
+
+import pytest
+
+from kubernetes_tpu.client.rest import Client, LocalTransport
+from kubernetes_tpu.controllers.namespace import NamespaceManager
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaManager
+from kubernetes_tpu.controllers.serviceaccounts import (
+    ServiceAccountsController,
+    TokenController,
+)
+from kubernetes_tpu.controllers.volumeclaimbinder import (
+    PersistentVolumeClaimBinder,
+)
+from kubernetes_tpu.server.api import APIError, APIServer
+from kubernetes_tpu.server.auth import ServiceAccountTokenManager
+
+
+@pytest.fixture
+def api():
+    return APIServer()
+
+
+@pytest.fixture
+def client(api):
+    return Client(LocalTransport(api))
+
+
+def mkpod(name, ns="default", cpu=None):
+    spec = {"containers": [{"name": "c", "image": "i"}]}
+    if cpu:
+        spec["containers"][0]["resources"] = {"limits": {"cpu": cpu}}
+    return {"kind": "Pod", "metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+class TestNamespaceManager:
+    def test_two_phase_delete(self, api, client):
+        api.create("namespaces", "", {"metadata": {"name": "team"}})
+        api.create("pods", "team", mkpod("p1", "team"))
+        api.create("secrets", "team", {"kind": "Secret", "metadata": {"name": "s1"}})
+        # DELETE marks Terminating (finalizer defaulting) instead of removing.
+        api.delete("namespaces", "", "team")
+        ns = api.get("namespaces", "", "team")
+        assert ns["status"]["phase"] == "Terminating"
+        assert ns["metadata"]["deletionTimestamp"]
+        # Controller purges content, finalizes, deletes.
+        mgr = NamespaceManager(client)
+        assert mgr.sync_once() == 1
+        with pytest.raises(APIError):
+            api.get("namespaces", "", "team")
+        assert api.list("pods", "team")["items"] == []
+        assert api.list("secrets", "team")["items"] == []
+
+    def test_active_namespaces_untouched(self, api, client):
+        api.create("namespaces", "", {"metadata": {"name": "keep"}})
+        api.create("pods", "keep", mkpod("p1", "keep"))
+        NamespaceManager(client).sync_once()
+        assert api.get("namespaces", "", "keep")
+        assert len(api.list("pods", "keep")["items"]) == 1
+
+    def test_no_finalizer_deletes_immediately(self, api):
+        api.create("namespaces", "", {"metadata": {"name": "plain"}})
+        api.finalize_namespace("plain", {"spec": {"finalizers": []}})
+        api.delete("namespaces", "", "plain")
+        with pytest.raises(APIError):
+            api.get("namespaces", "", "plain")
+
+
+class TestResourceQuotaManager:
+    def test_recomputes_drifted_usage(self, api, client):
+        api.create(
+            "resourcequotas",
+            "default",
+            {
+                "kind": "ResourceQuota",
+                "metadata": {"name": "q"},
+                "spec": {"hard": {"pods": "10", "cpu": "4"}},
+            },
+        )
+        api.create("pods", "default", mkpod("a", cpu="500m"))
+        api.create("pods", "default", mkpod("b", cpu="250m"))
+        mgr = ResourceQuotaManager(client)
+        assert mgr.sync_once() == 1
+        q = api.get("resourcequotas", "default", "q")
+        assert q["status"]["used"]["pods"] == "2"
+        assert q["status"]["used"]["cpu"] == "750m"
+        # Second pass: no drift, no write.
+        assert mgr.sync_once() == 0
+
+
+class TestServiceAccountControllers:
+    def test_default_sa_created(self, api, client):
+        api.create("namespaces", "", {"metadata": {"name": "apps"}})
+        ctl = ServiceAccountsController(client)
+        created = ctl.sync_once()
+        assert created >= 2  # default + apps
+        assert api.get("serviceaccounts", "apps", "default")
+        assert api.get("serviceaccounts", "default", "default")
+        # Idempotent.
+        assert ctl.sync_once() == 0
+
+    def test_token_minted_and_verifiable(self, api, client):
+        ServiceAccountsController(client).sync_once()
+        mgr = ServiceAccountTokenManager(b"test-key")
+        tc = TokenController(client, mgr)
+        minted = tc.sync_once()
+        assert minted >= 1
+        secret = api.get("secrets", "default", "default-token")
+        assert secret["type"] == "kubernetes.io/service-account-token"
+        token = base64.b64decode(secret["data"]["token"]).decode()
+        info = mgr.authenticate_token(token)
+        assert info.name == "system:serviceaccount:default:default"
+        # SA references the secret; second sync is a no-op.
+        sa = api.get("serviceaccounts", "default", "default")
+        assert any(s["name"] == "default-token" for s in sa["secrets"])
+        assert tc.sync_once() == 0
+
+
+def mkpv(name, storage, modes=("ReadWriteOnce",), reclaim="Retain"):
+    return {
+        "kind": "PersistentVolume",
+        "metadata": {"name": name},
+        "spec": {
+            "capacity": {"storage": storage},
+            "accessModes": list(modes),
+            "persistentVolumeSource": {"hostPath": {"path": f"/tmp/{name}"}},
+            "persistentVolumeReclaimPolicy": reclaim,
+        },
+    }
+
+
+def mkpvc(name, storage, modes=("ReadWriteOnce",), ns="default"):
+    return {
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "accessModes": list(modes),
+            "resources": {"requests": {"storage": storage}},
+        },
+    }
+
+
+class TestPVClaimBinder:
+    def test_smallest_sufficient_binding(self, api, client):
+        api.create("persistentvolumes", "", mkpv("small", "1Gi"))
+        api.create("persistentvolumes", "", mkpv("big", "100Gi"))
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "500Mi"))
+        binder = PersistentVolumeClaimBinder(client)
+        assert binder.sync_once() == 1
+        pvc = api.get("persistentvolumeclaims", "default", "c1")
+        assert pvc["spec"]["volumeName"] == "small"
+        assert pvc["status"]["phase"] == "Bound"
+        pv = api.get("persistentvolumes", "", "small")
+        assert pv["status"]["phase"] == "Bound"
+        assert pv["spec"]["claimRef"]["name"] == "c1"
+        big = api.get("persistentvolumes", "", "big")
+        assert big["status"]["phase"] == "Available"
+
+    def test_too_small_not_bound(self, api, client):
+        api.create("persistentvolumes", "", mkpv("tiny", "100Mi"))
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "5Gi"))
+        assert PersistentVolumeClaimBinder(client).sync_once() == 0
+        pvc = api.get("persistentvolumeclaims", "default", "c1")
+        assert not pvc["spec"].get("volumeName")
+
+    def test_access_mode_mismatch(self, api, client):
+        api.create("persistentvolumes", "", mkpv("rwo", "10Gi", modes=("ReadWriteOnce",)))
+        api.create(
+            "persistentvolumeclaims",
+            "default",
+            mkpvc("c1", "1Gi", modes=("ReadWriteMany",)),
+        )
+        assert PersistentVolumeClaimBinder(client).sync_once() == 0
+
+    def test_release_on_claim_delete_retain(self, api, client):
+        api.create("persistentvolumes", "", mkpv("v", "10Gi"))
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
+        binder = PersistentVolumeClaimBinder(client)
+        binder.sync_once()
+        api.delete("persistentvolumeclaims", "default", "c1")
+        binder.sync_once()
+        pv = api.get("persistentvolumes", "", "v")
+        assert pv["status"]["phase"] == "Released"
+
+    def test_release_recycle_returns_available(self, api, client):
+        api.create("persistentvolumes", "", mkpv("v", "10Gi", reclaim="Recycle"))
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
+        binder = PersistentVolumeClaimBinder(client)
+        binder.sync_once()
+        api.delete("persistentvolumeclaims", "default", "c1")
+        binder.sync_once()
+        pv = api.get("persistentvolumes", "", "v")
+        assert pv["status"]["phase"] == "Available"
+        assert not pv["spec"].get("claimRef")
+        # Rebindable.
+        api.create("persistentvolumeclaims", "default", mkpvc("c2", "1Gi"))
+        assert binder.sync_once() == 1
+
+
+class TestReviewRegressions:
+    def test_rejected_create_leaves_quota_status(self, api):
+        """A failed store write must not inflate status.used."""
+        from kubernetes_tpu.server import admission as adm
+
+        api.admission = adm.new_from_plugins(api, ["ResourceQuota"])
+        api.create(
+            "resourcequotas",
+            "default",
+            {
+                "kind": "ResourceQuota",
+                "metadata": {"name": "q"},
+                "spec": {"hard": {"pods": "5"}},
+            },
+        )
+        api.create("pods", "default", mkpod("a"))
+        with pytest.raises(APIError):  # duplicate name -> 409 post-admission
+            api.create("pods", "default", mkpod("a"))
+        q = api.get("resourcequotas", "default", "q")
+        assert q["status"]["used"]["pods"] == "1"
+
+    def test_foreign_finalizer_blocks_deletion(self, api, client):
+        api.create(
+            "namespaces",
+            "",
+            {
+                "metadata": {"name": "guarded"},
+                "spec": {"finalizers": ["kubernetes", "example.com/cleanup"]},
+            },
+        )
+        api.delete("namespaces", "", "guarded")
+        NamespaceManager(client).sync_once()
+        ns = api.get("namespaces", "", "guarded")
+        assert ns["spec"]["finalizers"] == ["example.com/cleanup"]
+        assert ns["status"]["phase"] == "Terminating"
+        # Once the foreign owner removes its finalizer, deletion completes.
+        api.finalize_namespace("guarded", {"spec": {"finalizers": []}})
+        NamespaceManager(client).sync_once()
+        with pytest.raises(APIError):
+            api.get("namespaces", "", "guarded")
+
+    def test_finalize_authorized_as_namespaces(self):
+        """PUT /namespaces/{name}/finalize authorizes as resource
+        'namespaces', not 'finalize'."""
+        import json as _json
+        import urllib.request
+
+        from kubernetes_tpu.server import auth as authpkg
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api2 = APIServer()
+        authn = authpkg.UnionAuthenticator(
+            tokens=[
+                authpkg.TokenAuthenticator(
+                    {"ctl": authpkg.UserInfo(name="controller")}
+                )
+            ]
+        )
+        authz = authpkg.ABACAuthorizer(
+            [authpkg.Policy(user="controller", resource="namespaces")]
+        )
+        srv = APIHTTPServer(api2, authenticator=authn, authorizer=authz).start()
+        try:
+            api2.create("namespaces", "", {"metadata": {"name": "x"}})
+            body = _json.dumps(
+                {"spec": {"finalizers": []}}
+            ).encode()
+            r = urllib.request.Request(
+                srv.address + "/api/v1/namespaces/x/finalize",
+                data=body,
+                method="PUT",
+                headers={"Authorization": "Bearer ctl"},
+            )
+            with urllib.request.urlopen(r) as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
