@@ -63,18 +63,21 @@ class NamespaceManager:
             self._stop.wait(self.sync_period)
 
     def sync_once(self) -> int:
-        """One pass over all namespaces; returns count finalized."""
+        """One pass over all namespaces; returns count finalized (a
+        namespace still held by a foreign finalizer doesn't count)."""
         done = 0
         namespaces, _ = self.client.list("namespaces")
         for ns in namespaces:
             if ns.status.phase != "Terminating":
                 continue
-            self._terminate(ns.metadata.name, ns.spec.finalizers)
-            done += 1
-            _SYNCS.inc(result="terminated")
+            if self._terminate(ns.metadata.name, ns.spec.finalizers):
+                done += 1
+                _SYNCS.inc(result="terminated")
+            else:
+                _SYNCS.inc(result="blocked")
         return done
 
-    def _terminate(self, name: str, finalizers: List[str]) -> None:
+    def _terminate(self, name: str, finalizers: List[str]) -> bool:
         for resource in _NAMESPACED_RESOURCES:
             try:
                 items, _ = self.client.list(resource, namespace=name)
@@ -95,10 +98,11 @@ class NamespaceManager:
             try:
                 self.client.finalize_namespace(name, remaining)
             except APIError:
-                return
+                return False
         if remaining:
-            return  # someone else's finalizer still pending
+            return False  # someone else's finalizer still pending
         try:
             self.client.delete("namespaces", name)
         except APIError:
-            pass
+            return False
+        return True

@@ -76,9 +76,14 @@ class PersistentVolumeClaimBinder:
         }
         for pv in volumes:
             ref = pv.spec.claim_ref
-            if pv.status.phase == "Bound" and ref is not None:
-                if (ref.namespace, ref.name) not in claim_keys:
-                    self._release(pv)
+            if ref is None or (ref.namespace, ref.name) in claim_keys:
+                continue
+            if pv.status.phase == "Bound":
+                self._release(pv)
+            else:
+                # Reserved (claimRef set) but never fully bound, and
+                # the claim is gone: just return it to the pool.
+                self._rollback(pv.metadata.name)
 
         # Bind pending claims: smallest sufficient Available volume.
         available = [
@@ -90,6 +95,24 @@ class PersistentVolumeClaimBinder:
         available.sort(key=lambda pv: _storage_milli(pv.spec.capacity))
         for claim in claims:
             if claim.status.phase == "Bound" or claim.spec.volume_name:
+                continue
+            # Self-heal: a volume already reserved for this claim by an
+            # earlier partial bind completes first, instead of grabbing
+            # (and stranding) a second volume.
+            reserved = next(
+                (
+                    pv
+                    for pv in volumes
+                    if pv.spec.claim_ref is not None
+                    and (pv.spec.claim_ref.namespace, pv.spec.claim_ref.name)
+                    == (claim.metadata.namespace, claim.metadata.name)
+                ),
+                None,
+            )
+            if reserved is not None:
+                if self._bind(reserved, claim):
+                    bound += 1
+                    _SYNCS.inc(result="bound")
                 continue
             want = _storage_milli(
                 claim.spec.resources.requests or claim.spec.resources.limits
@@ -112,32 +135,36 @@ class PersistentVolumeClaimBinder:
         return bound
 
     def _bind(self, pv, claim) -> bool:
-        pv.spec.claim_ref = ObjectReference(
-            kind="PersistentVolumeClaim",
-            namespace=claim.metadata.namespace,
-            name=claim.metadata.name,
-            uid=claim.metadata.uid,
+        ref = pv.spec.claim_ref
+        already_reserved = ref is not None and (ref.namespace, ref.name) == (
+            claim.metadata.namespace,
+            claim.metadata.name,
         )
-        try:
-            pv = self.client.update("persistentvolumes", pv)
-        except APIError:
-            return False
-        pv.status.phase = "Bound"
-        self._put_pv_status(pv)
+        if not already_reserved:
+            pv.spec.claim_ref = ObjectReference(
+                kind="PersistentVolumeClaim",
+                namespace=claim.metadata.namespace,
+                name=claim.metadata.name,
+                uid=claim.metadata.uid,
+            )
+            try:
+                pv = self.client.update("persistentvolumes", pv)
+            except APIError:
+                return False
+        if pv.status.phase != "Bound":
+            pv.status.phase = "Bound"
+            self._put_pv_status(pv)
         claim.spec.volume_name = pv.metadata.name
         try:
             claim = self.client.update(
                 "persistentvolumeclaims", claim, namespace=claim.metadata.namespace
             )
-        except APIError:
-            # Roll the volume back to Available so it isn't stranded.
-            pv.spec.claim_ref = None
-            pv.status.phase = "Available"
-            try:
-                self.client.update("persistentvolumes", pv)
-            except APIError:
-                pass
-            self._put_pv_status(pv)
+        except APIError as e:
+            if e.code == 404:
+                # Claim vanished: roll the volume back to Available.
+                # (On transient errors the reservation stands — the
+                # self-heal path in sync_once completes it next pass.)
+                self._rollback(pv.metadata.name)
             return False
         claim.status.phase = "Bound"
         claim.status.capacity = dict(pv.spec.capacity)
@@ -149,6 +176,27 @@ class PersistentVolumeClaimBinder:
         except APIError:
             pass
         return True
+
+    def _rollback(self, pv_name: str) -> None:
+        """Return a reserved volume to Available. GET-retry (guaranteed
+        update): the status writes in _bind bumped the resourceVersion
+        past any copy we hold, so updating a stale object would always
+        CAS-conflict and strand the volume claimRef'd but Available."""
+        for _ in range(3):
+            try:
+                fresh = self.client.get("persistentvolumes", pv_name)
+            except APIError:
+                return
+            fresh.spec.claim_ref = None
+            try:
+                fresh = self.client.update("persistentvolumes", fresh)
+            except APIError as e:
+                if e.code == 409:
+                    continue
+                return
+            fresh.status.phase = "Available"
+            self._put_pv_status(fresh)
+            return
 
     def _release(self, pv) -> None:
         if pv.spec.persistent_volume_reclaim_policy == "Recycle":

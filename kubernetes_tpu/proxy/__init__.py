@@ -1,0 +1,26 @@
+"""Service dataplane (kube-proxy equivalent).
+
+Reference: pkg/proxy/ — userspace TCP/UDP proxy with iptables portal
+redirection and a round-robin load balancer with session affinity.
+
+TPU-native framing: the portal layer is a pure rule table (the
+iptables analog is data, not kernel state) so the whole service
+routing function — clusterIP:port -> backend — is a deterministic
+lookup that tests and the batch path can evaluate without root.
+Actual packet shuffling remains a host-side userspace copy loop,
+exactly as in the reference (proxysocket.go).
+"""
+
+from kubernetes_tpu.proxy.roundrobin import LoadBalancerRR
+from kubernetes_tpu.proxy.ruletable import PortalRuleTable
+from kubernetes_tpu.proxy.proxier import Proxier
+from kubernetes_tpu.proxy.config import ServiceConfig, EndpointsConfig, ProxyServer
+
+__all__ = [
+    "LoadBalancerRR",
+    "PortalRuleTable",
+    "Proxier",
+    "ServiceConfig",
+    "EndpointsConfig",
+    "ProxyServer",
+]

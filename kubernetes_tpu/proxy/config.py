@@ -1,0 +1,110 @@
+"""Service/endpoints config watchers + kube-proxy daemon assembly.
+
+Reference: pkg/proxy/config/config.go:60-94 (ServiceConfig /
+EndpointsConfig deliver full desired-state snapshots to handlers) and
+cmd/kube-proxy/app/server.go:91-132 (wiring: config sources -> Proxier
++ LoadBalancerRR).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.client.cache import Informer
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import Endpoints, Service
+from kubernetes_tpu.proxy.proxier import Proxier
+from kubernetes_tpu.proxy.roundrobin import LoadBalancerRR
+from kubernetes_tpu.proxy.ruletable import PortalRuleTable
+
+
+class _SnapshotConfig:
+    """Watches one resource and delivers the FULL object list to each
+    handler on every change (the reference's OnUpdate contract)."""
+
+    def __init__(self, client, resource: str, decode: Callable):
+        self._handlers: List[Callable] = []
+        self._lock = threading.Lock()
+        self.informer = Informer(
+            client,
+            resource,
+            decode=decode,
+            on_add=self._changed,
+            on_update=self._changed,
+            on_delete=self._changed,
+        )
+
+    def register_handler(self, handler: Callable) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    def _changed(self, _obj) -> None:
+        snapshot = self.informer.store.list()
+        with self._lock:
+            handlers = list(self._handlers)
+        for h in handlers:
+            try:
+                h(snapshot)
+            except Exception:
+                pass
+
+    def start(self):
+        self.informer.start()
+        return self
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self.informer.wait_for_sync(timeout)
+
+    def stop(self) -> None:
+        self.informer.stop()
+
+
+class ServiceConfig(_SnapshotConfig):
+    def __init__(self, client):
+        super().__init__(
+            client, "services", lambda w: serde.from_wire(Service, w)
+        )
+
+
+class EndpointsConfig(_SnapshotConfig):
+    def __init__(self, client):
+        super().__init__(
+            client, "endpoints", lambda w: serde.from_wire(Endpoints, w)
+        )
+
+
+class ProxyServer:
+    """The kube-proxy daemon: one Proxier + one LoadBalancerRR fed by
+    service/endpoints watches (reference: cmd/kube-proxy/app/
+    server.go:91-132)."""
+
+    def __init__(self, client, listen_ip: str = "127.0.0.1"):
+        self.client = client
+        self.lb = LoadBalancerRR()
+        self.rules = PortalRuleTable()
+        self.proxier = Proxier(self.lb, self.rules, listen_ip=listen_ip)
+        self.service_config = ServiceConfig(client)
+        self.endpoints_config = EndpointsConfig(client)
+        self.service_config.register_handler(self.proxier.on_update)
+        self.endpoints_config.register_handler(self.lb.on_update)
+
+    def start(self) -> "ProxyServer":
+        self.service_config.start()
+        self.endpoints_config.start()
+        self.service_config.wait_for_sync()
+        self.endpoints_config.wait_for_sync()
+        # Prime with current state — informer events may have fired
+        # before handlers could see a complete snapshot.
+        self.proxier.on_update(self.service_config.informer.store.list())
+        self.lb.on_update(self.endpoints_config.informer.store.list())
+        return self
+
+    def stop(self) -> None:
+        self.service_config.stop()
+        self.endpoints_config.stop()
+        self.proxier.stop()
+
+    def resolve_portal(self, ip: str, port: int, protocol: str = "TCP"):
+        """Where a client hitting clusterIP:port actually lands."""
+        return self.rules.resolve(ip, port, protocol)

@@ -1,0 +1,299 @@
+"""Userspace service proxier.
+
+Reference: pkg/proxy/proxier.go — Proxier.OnUpdate (:264-321) diffs the
+desired service list against running portals, opens a listener socket
+per service port (addServiceOnPort :222), installs portal redirect
+rules (openPortal :376), and shuttles bytes between accepted client
+connections and a load-balanced backend endpoint
+(pkg/proxy/proxysocket.go TCP copy loop, udp_server.go).
+
+The listener sockets and the copy loop here are real; only the DNAT
+hop is the in-memory PortalRuleTable (see ruletable.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.proxy.roundrobin import (
+    ErrMissingEndpoints,
+    ErrMissingServiceEntry,
+    LoadBalancerRR,
+    ServicePortName,
+)
+from kubernetes_tpu.proxy.ruletable import PortalRule, PortalRuleTable
+
+_BUFSIZE = 65536
+_UDP_IDLE_TIMEOUT = 10.0
+
+
+@dataclass
+class ServiceInfo:
+    """One proxied service port (reference: proxier.go serviceInfo)."""
+
+    portal_ip: str
+    portal_port: int
+    protocol: str
+    proxy_port: int
+    socket: object
+    session_affinity: str = "None"
+    node_port: int = 0
+    is_alive: bool = True
+    threads: List[threading.Thread] = field(default_factory=list)
+
+
+class Proxier:
+    """Owns one listener socket per (service, port)."""
+
+    def __init__(
+        self,
+        load_balancer: Optional[LoadBalancerRR] = None,
+        rule_table: Optional[PortalRuleTable] = None,
+        listen_ip: str = "127.0.0.1",
+    ):
+        # `is None` checks: an empty PortalRuleTable is falsy (__len__).
+        self.lb = load_balancer if load_balancer is not None else LoadBalancerRR()
+        self.rules = rule_table if rule_table is not None else PortalRuleTable()
+        self.listen_ip = listen_ip
+        self._lock = threading.Lock()
+        self._services: Dict[ServicePortName, ServiceInfo] = {}
+        self._stopped = False
+
+    # -- lifecycle ----------------------------------------------------
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            infos = list(self._services.items())
+            self._services.clear()
+        for name, info in infos:
+            self._close_service(name, info)
+
+    # -- desired state ------------------------------------------------
+
+    def on_update(self, services: List) -> None:
+        """Reconcile running portals against the full service list
+        (reference: Proxier.OnUpdate, proxier.go:264-321)."""
+        active: Dict[ServicePortName, object] = {}
+        for svc in services:
+            if not svc.spec.cluster_ip or svc.spec.cluster_ip == "None":
+                continue  # headless: no portal
+            ns = svc.metadata.namespace or "default"
+            for port in svc.spec.ports:
+                name: ServicePortName = (ns, svc.metadata.name, port.name)
+                active[name] = (svc, port)
+        with self._lock:
+            if self._stopped:
+                return
+            to_close = {
+                name: info
+                for name, info in self._services.items()
+                if name not in active
+            }
+            for name in to_close:
+                del self._services[name]
+        for name, info in to_close.items():
+            self._close_service(name, info, drop_lb=True)
+        for name, (svc, port) in active.items():
+            self._ensure_service(name, svc, port)
+
+    def service_info(self, name: ServicePortName) -> Optional[ServiceInfo]:
+        with self._lock:
+            return self._services.get(name)
+
+    def _ensure_service(self, name: ServicePortName, svc, port) -> None:
+        with self._lock:
+            info = self._services.get(name)
+            if info is not None:
+                if (
+                    info.portal_ip == svc.spec.cluster_ip
+                    and info.portal_port == port.port
+                    and info.protocol == port.protocol.upper()
+                    and info.session_affinity == (svc.spec.session_affinity or "None")
+                    and info.node_port == getattr(port, "node_port", 0)
+                ):
+                    return  # unchanged
+        if info is not None:
+            # Reconfiguration: tear down the portal but KEEP the load
+            # balancer's endpoint list — endpoints didn't change, and a
+            # fresh empty entry would blackhole until the next
+            # endpoints event.
+            self._close_service(name, info, drop_lb=False)
+        proto = port.protocol.upper()
+        sock = self._open_socket(proto)
+        proxy_port = sock.getsockname()[1]
+        info = ServiceInfo(
+            portal_ip=svc.spec.cluster_ip,
+            portal_port=port.port,
+            protocol=proto,
+            proxy_port=proxy_port,
+            socket=sock,
+            session_affinity=svc.spec.session_affinity or "None",
+            node_port=getattr(port, "node_port", 0),
+        )
+        self.lb.new_service(name, affinity_type=info.session_affinity)
+        self.rules.ensure_rule(
+            PortalRule(
+                portal_ip=info.portal_ip,
+                portal_port=info.portal_port,
+                protocol=proto,
+                proxy_ip=self.listen_ip,
+                proxy_port=proxy_port,
+                service=f"{name[0]}/{name[1]}:{name[2]}",
+            )
+        )
+        # NodePort: an extra rule on the node's own address (reference
+        # proxier.go openNodePort).
+        if info.node_port:
+            self.rules.ensure_rule(
+                PortalRule(
+                    portal_ip="0.0.0.0",
+                    portal_port=info.node_port,
+                    protocol=proto,
+                    proxy_ip=self.listen_ip,
+                    proxy_port=proxy_port,
+                    service=f"{name[0]}/{name[1]}:{name[2]}",
+                )
+            )
+        accept = threading.Thread(
+            target=self._tcp_accept_loop if proto == "TCP" else self._udp_loop,
+            args=(name, info),
+            daemon=True,
+        )
+        info.threads.append(accept)
+        with self._lock:
+            self._services[name] = info
+        accept.start()
+
+    def _open_socket(self, proto: str):
+        kind = socket.SOCK_STREAM if proto == "TCP" else socket.SOCK_DGRAM
+        sock = socket.socket(socket.AF_INET, kind)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.listen_ip, 0))
+        if proto == "TCP":
+            sock.listen(64)
+        return sock
+
+    def _close_service(
+        self, name: ServicePortName, info: ServiceInfo, drop_lb: bool = True
+    ) -> None:
+        info.is_alive = False
+        self.rules.delete_rule(info.portal_ip, info.portal_port, info.protocol)
+        if info.node_port:
+            self.rules.delete_rule("0.0.0.0", info.node_port, info.protocol)
+        if drop_lb:
+            self.lb.delete_service(name)
+        try:
+            info.socket.close()
+        except OSError:
+            pass
+
+    # -- TCP path (reference: proxysocket.go ProxyLoop + proxyTCP) ----
+
+    def _tcp_accept_loop(self, name: ServicePortName, info: ServiceInfo) -> None:
+        while info.is_alive:
+            try:
+                client, addr = info.socket.accept()
+            except OSError:
+                return
+            try:
+                backend = self._connect_backend(name, addr[0])
+            except (ErrMissingServiceEntry, ErrMissingEndpoints, OSError):
+                client.close()
+                continue
+            for a, b in ((client, backend), (backend, client)):
+                t = threading.Thread(
+                    target=self._copy_bytes, args=(a, b), daemon=True
+                )
+                t.start()
+
+    def _connect_backend(self, name: ServicePortName, client_ip: str):
+        # Retry across endpoints like the reference's tryConnect
+        # (proxysocket.go): a dead backend shouldn't fail the session
+        # while others remain.
+        last_err: Optional[Exception] = None
+        for _ in range(max(1, len(self.lb.endpoints_for(name)))):
+            endpoint = self.lb.next_endpoint(name, client_ip)
+            host, _, port = endpoint.rpartition(":")
+            try:
+                return socket.create_connection((host, int(port)), timeout=5)
+            except OSError as e:
+                last_err = e
+                # A sticky (ClientIP-affinity) client would otherwise
+                # get the same dead endpoint back on every retry.
+                self.lb.invalidate_affinity(name, client_ip)
+        raise last_err if last_err else OSError("no endpoints")
+
+    @staticmethod
+    def _copy_bytes(src, dst) -> None:
+        try:
+            while True:
+                data = src.recv(_BUFSIZE)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # -- UDP path (reference: udp_server.go / proxysocket.go UDP) -----
+
+    def _udp_loop(self, name: ServicePortName, info: ServiceInfo) -> None:
+        # client addr -> backend socket: UDP "sessions" keyed on the
+        # 5-tuple, as the reference's activeClients map does.
+        sessions: Dict[Tuple[str, int], socket.socket] = {}
+
+        def reply_loop(client_addr, backend_sock):
+            backend_sock.settimeout(_UDP_IDLE_TIMEOUT)
+            try:
+                while info.is_alive:
+                    data = backend_sock.recv(_BUFSIZE)
+                    if not data:
+                        break
+                    info.socket.sendto(data, client_addr)
+            except OSError:
+                pass
+            finally:
+                sessions.pop(client_addr, None)
+                try:
+                    backend_sock.close()
+                except OSError:
+                    pass
+
+        while info.is_alive:
+            try:
+                data, client_addr = info.socket.recvfrom(_BUFSIZE)
+            except OSError:
+                return
+            backend_sock = sessions.get(client_addr)
+            if backend_sock is None:
+                try:
+                    endpoint = self.lb.next_endpoint(name, client_addr[0])
+                except (ErrMissingServiceEntry, ErrMissingEndpoints):
+                    continue
+                host, _, port = endpoint.rpartition(":")
+                backend_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                backend_sock.connect((host, int(port)))
+                sessions[client_addr] = backend_sock
+                # Not tracked in info.threads: reply loops are
+                # per-session and self-clean in their finally block.
+                threading.Thread(
+                    target=reply_loop, args=(client_addr, backend_sock),
+                    daemon=True,
+                ).start()
+            try:
+                backend_sock.send(data)
+            except OSError:
+                sessions.pop(client_addr, None)

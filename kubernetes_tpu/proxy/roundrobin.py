@@ -1,0 +1,168 @@
+"""Round-robin endpoint load balancer with session affinity.
+
+Reference: pkg/proxy/roundrobin.go — LoadBalancerRR keeps a per
+service-port endpoint list plus a rotating index; NextEndpoint
+(:54-77) returns the next endpoint, honoring ClientIP session
+affinity with a TTL (affinity state per service, roundrobin.go
+affinityState / affinityPolicy).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Service-port key: (namespace, service-name, port-name).
+ServicePortName = Tuple[str, str, str]
+
+
+class ErrMissingServiceEntry(KeyError):
+    pass
+
+
+class ErrMissingEndpoints(KeyError):
+    pass
+
+
+@dataclass
+class _AffinityState:
+    """One sticky client (reference: roundrobin.go affinityState)."""
+
+    client_ip: str
+    endpoint: str
+    last_used: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _BalancerState:
+    endpoints: List[str] = field(default_factory=list)
+    index: int = 0
+    affinity_type: str = "None"  # None | ClientIP
+    ttl_seconds: int = 180 * 60  # reference default: 3 hours
+    affinity_map: Dict[str, _AffinityState] = field(default_factory=dict)
+
+
+class LoadBalancerRR:
+    """Round-robin with optional ClientIP affinity."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._services: Dict[ServicePortName, _BalancerState] = {}
+
+    def new_service(
+        self, svc: ServicePortName, affinity_type: str = "None",
+        ttl_seconds: int = 0,
+    ) -> None:
+        """Register a service port (reference: NewService,
+        roundrobin.go:88-102)."""
+        if ttl_seconds == 0:
+            ttl_seconds = 180 * 60
+        with self._lock:
+            state = self._services.get(svc)
+            if state is None:
+                self._services[svc] = _BalancerState(
+                    affinity_type=affinity_type, ttl_seconds=ttl_seconds
+                )
+            else:
+                state.affinity_type = affinity_type
+                state.ttl_seconds = ttl_seconds
+
+    def delete_service(self, svc: ServicePortName) -> None:
+        with self._lock:
+            self._services.pop(svc, None)
+
+    def next_endpoint(
+        self, svc: ServicePortName, client_ip: str = ""
+    ) -> str:
+        """Pick "host:port" for one new connection (reference:
+        NextEndpoint, roundrobin.go:54-77 + affinity check)."""
+        with self._lock:
+            state = self._services.get(svc)
+            if state is None:
+                raise ErrMissingServiceEntry(svc)
+            if not state.endpoints:
+                raise ErrMissingEndpoints(svc)
+            if state.affinity_type == "ClientIP" and client_ip:
+                aff = state.affinity_map.get(client_ip)
+                if aff is not None:
+                    if (
+                        time.monotonic() - aff.last_used < state.ttl_seconds
+                        and aff.endpoint in state.endpoints
+                    ):
+                        aff.last_used = time.monotonic()
+                        return aff.endpoint
+                    del state.affinity_map[client_ip]
+            endpoint = state.endpoints[state.index]
+            state.index = (state.index + 1) % len(state.endpoints)
+            if state.affinity_type == "ClientIP" and client_ip:
+                state.affinity_map[client_ip] = _AffinityState(
+                    client_ip=client_ip, endpoint=endpoint
+                )
+            return endpoint
+
+    def on_update(self, endpoints_list: List) -> None:
+        """Full-state endpoints update (reference: OnUpdate,
+        roundrobin.go:134-177): rebuild per service-port endpoint
+        lists; registered services missing from the update lose their
+        endpoints."""
+        seen: Dict[ServicePortName, List[str]] = {}
+        for ep in endpoints_list:
+            ns = ep.metadata.namespace or "default"
+            name = ep.metadata.name
+            for subset in ep.subsets:
+                for port in subset.ports:
+                    key = (ns, name, port.name)
+                    eps = seen.setdefault(key, [])
+                    for addr in subset.addresses:
+                        eps.append(f"{addr.ip}:{port.port}")
+        with self._lock:
+            for key, eps in seen.items():
+                state = self._services.get(key)
+                if state is None:
+                    state = self._services[key] = _BalancerState()
+                if sorted(state.endpoints) != sorted(eps):
+                    state.endpoints = eps
+                    state.index = 0
+                    # Stale affinity entries pointing at removed
+                    # endpoints are dropped lazily in next_endpoint.
+        # Services not mentioned keep their registration but lose
+        # endpoints only on explicit empty update (reference keeps the
+        # same semantics: a full OnUpdate replaces everything present).
+        with self._lock:
+            for key, state in self._services.items():
+                if key not in seen and state.endpoints:
+                    # Endpoints object deleted entirely.
+                    present = any(
+                        (k[0], k[1]) == (key[0], key[1]) for k in seen
+                    )
+                    if not present:
+                        state.endpoints = []
+                        state.index = 0
+
+    def endpoints_for(self, svc: ServicePortName) -> List[str]:
+        with self._lock:
+            state = self._services.get(svc)
+            return list(state.endpoints) if state else []
+
+    def invalidate_affinity(self, svc: ServicePortName, client_ip: str) -> None:
+        """Drop one client's sticky endpoint (used by the proxier when
+        a connect to it fails, so retries rotate to live backends)."""
+        with self._lock:
+            state = self._services.get(svc)
+            if state is not None and client_ip:
+                state.affinity_map.pop(client_ip, None)
+
+    def clean_expired_affinity(self) -> None:
+        """Drop affinity entries past their TTL."""
+        now = time.monotonic()
+        with self._lock:
+            for state in self._services.values():
+                dead = [
+                    ip
+                    for ip, aff in state.affinity_map.items()
+                    if now - aff.last_used >= state.ttl_seconds
+                ]
+                for ip in dead:
+                    del state.affinity_map[ip]

@@ -1,0 +1,76 @@
+"""Portal rule table — the iptables analog as pure data.
+
+Reference: pkg/util/iptables/ (EnsureRule/DeleteRule around exec'd
+iptables) + Proxier.openPortal/closePortal (pkg/proxy/proxier.go:376+)
+which install DNAT redirects clusterIP:port -> proxier socket.
+
+Here the "kernel" is an in-memory, thread-safe rule table: ensure_rule
+and delete_rule carry the same idempotency contract as the reference's
+wrapper (ensure reports whether the rule already existed), and
+`resolve` performs the DNAT hop a real kernel would, so tests and the
+in-process dataplane route exactly like the deployed system would.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# (portal_ip, portal_port, protocol) -> redirect target
+PortalKey = Tuple[str, int, str]
+
+
+@dataclass(frozen=True)
+class PortalRule:
+    portal_ip: str
+    portal_port: int
+    protocol: str  # TCP | UDP
+    proxy_ip: str
+    proxy_port: int
+    service: str = ""  # "ns/name:port" for observability
+
+
+class PortalRuleTable:
+    """DNAT-style portal redirection rules."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: Dict[PortalKey, PortalRule] = {}
+
+    @staticmethod
+    def _key(ip: str, port: int, protocol: str) -> PortalKey:
+        return (ip, port, protocol.upper())
+
+    def ensure_rule(self, rule: PortalRule) -> bool:
+        """Install a portal rule; True if it already existed (the
+        reference's EnsureRule contract)."""
+        key = self._key(rule.portal_ip, rule.portal_port, rule.protocol)
+        with self._lock:
+            existed = self._rules.get(key) == rule
+            self._rules[key] = rule
+            return existed
+
+    def delete_rule(self, ip: str, port: int, protocol: str) -> None:
+        with self._lock:
+            self._rules.pop(self._key(ip, port, protocol), None)
+
+    def resolve(
+        self, ip: str, port: int, protocol: str = "TCP"
+    ) -> Optional[Tuple[str, int]]:
+        """The DNAT hop: where does traffic to this portal land?"""
+        with self._lock:
+            rule = self._rules.get(self._key(ip, port, protocol))
+            return (rule.proxy_ip, rule.proxy_port) if rule else None
+
+    def rules(self) -> List[PortalRule]:
+        with self._lock:
+            return list(self._rules.values())
+
+    def flush(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rules)

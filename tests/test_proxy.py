@@ -1,0 +1,296 @@
+"""Service dataplane tests (reference behaviors: pkg/proxy/
+proxier_test.go, roundrobin_test.go) — real sockets end to end."""
+
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client.rest import Client, LocalTransport
+from kubernetes_tpu.models.objects import (
+    EndpointAddress,
+    EndpointPort,
+    Endpoints,
+    EndpointSubset,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.proxy import (
+    EndpointsConfig,
+    LoadBalancerRR,
+    Proxier,
+    ProxyServer,
+    ServiceConfig,
+)
+from kubernetes_tpu.proxy.roundrobin import (
+    ErrMissingEndpoints,
+    ErrMissingServiceEntry,
+)
+from kubernetes_tpu.server.api import APIServer
+
+
+# -- backends ---------------------------------------------------------
+
+
+class _EchoTCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _TCPHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            data = self.request.recv(4096)
+            if not data:
+                return
+            self.request.sendall(self.server.tag + data)
+
+
+@pytest.fixture
+def tcp_backends():
+    servers = []
+    for tag in (b"A:", b"B:"):
+        srv = _EchoTCP(("127.0.0.1", 0), _TCPHandler)
+        srv.tag = tag
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+    yield servers
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _endpoints(name, ports_addrs, ns="default", portname=""):
+    return Endpoints(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        subsets=[
+            EndpointSubset(
+                addresses=[EndpointAddress(ip=ip) for ip, _ in ports_addrs],
+                ports=[EndpointPort(name=portname, port=ports_addrs[0][1])],
+            )
+        ]
+        if ports_addrs and len({p for _, p in ports_addrs}) == 1
+        else [
+            EndpointSubset(
+                addresses=[EndpointAddress(ip=ip)],
+                ports=[EndpointPort(name=portname, port=port)],
+            )
+            for ip, port in ports_addrs
+        ],
+    )
+
+
+def _service(name, cluster_ip, port, ns="default", affinity="None", portname=""):
+    return Service(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=ServiceSpec(
+            cluster_ip=cluster_ip,
+            session_affinity=affinity,
+            ports=[ServicePort(name=portname, protocol="TCP", port=port)],
+        ),
+    )
+
+
+def _roundtrip(addr, payload=b"hi"):
+    with socket.create_connection(addr, timeout=5) as s:
+        s.sendall(payload)
+        return s.recv(4096)
+
+
+# -- LoadBalancerRR ---------------------------------------------------
+
+
+class TestLoadBalancerRR:
+    def test_missing_service(self):
+        lb = LoadBalancerRR()
+        with pytest.raises(ErrMissingServiceEntry):
+            lb.next_endpoint(("default", "svc", ""))
+
+    def test_missing_endpoints(self):
+        lb = LoadBalancerRR()
+        lb.new_service(("default", "svc", ""))
+        with pytest.raises(ErrMissingEndpoints):
+            lb.next_endpoint(("default", "svc", ""))
+
+    def test_round_robin_rotation(self):
+        lb = LoadBalancerRR()
+        lb.on_update([_endpoints("svc", [("1.1.1.1", 1), ("2.2.2.2", 2)])])
+        key = ("default", "svc", "")
+        got = [lb.next_endpoint(key) for _ in range(4)]
+        assert got == ["1.1.1.1:1", "2.2.2.2:2", "1.1.1.1:1", "2.2.2.2:2"]
+
+    def test_client_ip_affinity(self):
+        lb = LoadBalancerRR()
+        lb.new_service(("default", "svc", ""), affinity_type="ClientIP")
+        lb.on_update([_endpoints("svc", [("1.1.1.1", 1), ("2.2.2.2", 2)])])
+        key = ("default", "svc", "")
+        first = lb.next_endpoint(key, client_ip="9.9.9.9")
+        # Same client sticks; another client rotates.
+        assert lb.next_endpoint(key, client_ip="9.9.9.9") == first
+        other = lb.next_endpoint(key, client_ip="8.8.8.8")
+        assert other != first
+        assert lb.next_endpoint(key, client_ip="9.9.9.9") == first
+
+    def test_endpoints_removed_on_delete(self):
+        lb = LoadBalancerRR()
+        lb.on_update([_endpoints("svc", [("1.1.1.1", 1)])])
+        lb.on_update([])  # endpoints object deleted
+        with pytest.raises(ErrMissingEndpoints):
+            lb.next_endpoint(("default", "svc", ""))
+
+
+# -- Proxier over real TCP -------------------------------------------
+
+
+class TestProxierTCP:
+    def test_portal_roundtrip_and_rotation(self, tcp_backends):
+        proxier = Proxier()
+        eps = [
+            ("127.0.0.1", srv.server_address[1]) for srv in tcp_backends
+        ]
+        proxier.lb.on_update([_endpoints("web", eps)])
+        proxier.on_update([_service("web", "10.0.0.1", 80)])
+        try:
+            target = proxier.rules.resolve("10.0.0.1", 80, "TCP")
+            assert target is not None
+            replies = {_roundtrip(target) for _ in range(4)}
+            assert replies == {b"A:hi", b"B:hi"}  # both backends hit
+        finally:
+            proxier.stop()
+
+    def test_dead_backend_retry(self, tcp_backends):
+        """A connection-refused endpoint is skipped for the session
+        (reference: proxysocket.go tryConnect)."""
+        proxier = Proxier()
+        live = ("127.0.0.1", tcp_backends[0].server_address[1])
+        dead_sock = socket.socket()
+        dead_sock.bind(("127.0.0.1", 0))
+        dead_port = dead_sock.getsockname()[1]
+        dead_sock.close()  # now nothing listens there
+        proxier.lb.on_update(
+            [_endpoints("web", [("127.0.0.1", dead_port), live])]
+        )
+        proxier.on_update([_service("web", "10.0.0.1", 80)])
+        try:
+            target = proxier.rules.resolve("10.0.0.1", 80, "TCP")
+            for _ in range(3):
+                assert _roundtrip(target) == b"A:hi"
+        finally:
+            proxier.stop()
+
+    def test_service_removal_closes_portal(self, tcp_backends):
+        proxier = Proxier()
+        eps = [("127.0.0.1", tcp_backends[0].server_address[1])]
+        proxier.lb.on_update([_endpoints("web", eps)])
+        proxier.on_update([_service("web", "10.0.0.1", 80)])
+        target = proxier.rules.resolve("10.0.0.1", 80, "TCP")
+        assert target is not None
+        proxier.on_update([])  # service deleted
+        try:
+            assert proxier.rules.resolve("10.0.0.1", 80, "TCP") is None
+            # The listener is gone. A raw connect may still "succeed"
+            # via Linux's ephemeral-port self-connect quirk, but the
+            # backend can no longer be reached through it.
+            try:
+                reply = _roundtrip(target)
+                assert not reply.startswith(b"A:")
+            except OSError:
+                pass
+        finally:
+            proxier.stop()
+
+    def test_session_affinity_sticks(self, tcp_backends):
+        proxier = Proxier()
+        eps = [
+            ("127.0.0.1", srv.server_address[1]) for srv in tcp_backends
+        ]
+        proxier.lb.on_update([_endpoints("web", eps)])
+        proxier.on_update(
+            [_service("web", "10.0.0.1", 80, affinity="ClientIP")]
+        )
+        try:
+            target = proxier.rules.resolve("10.0.0.1", 80, "TCP")
+            tags = {_roundtrip(target)[:2] for _ in range(4)}
+            assert len(tags) == 1  # same client ip -> same backend
+        finally:
+            proxier.stop()
+
+
+class TestProxierUDP:
+    def test_udp_echo(self):
+        backend = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        backend.bind(("127.0.0.1", 0))
+        backend.settimeout(5)
+
+        def udp_echo():
+            while True:
+                try:
+                    data, addr = backend.recvfrom(4096)
+                except OSError:
+                    return
+                backend.sendto(b"U:" + data, addr)
+
+        threading.Thread(target=udp_echo, daemon=True).start()
+        proxier = Proxier()
+        port = backend.getsockname()[1]
+        svc = _service("dns", "10.0.0.2", 53)
+        svc.spec.ports[0].protocol = "UDP"
+        ep = _endpoints("dns", [("127.0.0.1", port)])
+        ep.subsets[0].ports[0].protocol = "UDP"
+        proxier.lb.on_update([ep])
+        proxier.on_update([svc])
+        try:
+            target = proxier.rules.resolve("10.0.0.2", 53, "UDP")
+            assert target is not None
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            c.settimeout(5)
+            c.sendto(b"ping", target)
+            data, _ = c.recvfrom(4096)
+            assert data == b"U:ping"
+            c.close()
+        finally:
+            proxier.stop()
+            backend.close()
+
+
+# -- Full daemon against in-process apiserver ------------------------
+
+
+class TestProxyServer:
+    def test_watch_driven_dataplane(self, tcp_backends):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        server = ProxyServer(client).start()
+        try:
+            svc = _service("web", "10.1.0.1", 80)
+            client.create("services", serde.to_wire(svc))
+            eps = _endpoints(
+                "web",
+                [("127.0.0.1", s.server_address[1]) for s in tcp_backends],
+            )
+            client.create("endpoints", serde.to_wire(eps))
+            deadline = time.monotonic() + 5
+            target = None
+            while time.monotonic() < deadline:
+                target = server.resolve_portal("10.1.0.1", 80)
+                if target and server.lb.endpoints_for(("default", "web", "")):
+                    break
+                time.sleep(0.05)
+            assert target is not None
+            replies = {_roundtrip(target) for _ in range(4)}
+            assert replies == {b"A:hi", b"B:hi"}
+            # Deleting the service tears the portal down via watch.
+            client.delete("services", "web", namespace="default")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if server.resolve_portal("10.1.0.1", 80) is None:
+                    break
+                time.sleep(0.05)
+            assert server.resolve_portal("10.1.0.1", 80) is None
+        finally:
+            server.stop()
