@@ -80,9 +80,11 @@ class PersistentVolumeClaimBinder:
                 continue
             if pv.status.phase == "Bound":
                 self._release(pv)
-            else:
+            elif pv.status.phase != "Released":
                 # Reserved (claimRef set) but never fully bound, and
                 # the claim is gone: just return it to the pool.
+                # Released volumes stay Released — Retain semantics;
+                # re-pooling them would hand old data to a new tenant.
                 self._rollback(pv.metadata.name)
 
         # Bind pending claims: smallest sufficient Available volume.

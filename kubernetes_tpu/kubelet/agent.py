@@ -91,10 +91,26 @@ class Kubelet:
         heartbeat_period: float = 5.0,
         sync_period: float = 3.0,
         manifest_dir: Optional[str] = None,
+        root_dir: Optional[str] = None,
+        mounter=None,
     ):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime or FakeRuntime()
+        # Volume subsystem: active when a root dir is configured
+        # (reference: kubelet --root-dir, default /var/lib/kubelet).
+        self.volumes = None
+        if root_dir:
+            from kubernetes_tpu.volumes import VolumeHost, VolumePluginManager
+
+            self.volumes = VolumePluginManager(
+                VolumeHost(
+                    root_dir=root_dir,
+                    client=client,
+                    mounter=mounter,
+                    node_name=node_name,
+                )
+            )
         self.cpu = cpu
         self.memory = memory
         self.max_pods = max_pods
@@ -106,6 +122,7 @@ class Kubelet:
         self._threads: List[threading.Thread] = []
         self._workers: Dict[str, _PodWorker] = {}
         self._workers_lock = threading.Lock()
+        self._volumes_mounted: set = set()
         self._probe_failures: Dict[str, int] = {}
         self.pods = Informer(
             client,
@@ -208,6 +225,12 @@ class Kubelet:
     def _handle_delete(self, pod: Pod) -> None:
         uid = pod.metadata.uid or pod.metadata.name
         self.runtime.kill_pod(uid)
+        if self.volumes is not None:
+            try:
+                self.volumes.teardown_pod_volumes(uid)
+            except Exception:
+                pass
+        self._volumes_mounted.discard(uid)
         with self._workers_lock:
             self._workers.pop(self._key(pod), None)
 
@@ -222,7 +245,13 @@ class Kubelet:
                     self._dispatch(pod)
                 for uid in self.runtime.list_pods():
                     if uid not in known_uids:
-                        self.runtime.kill_pod(uid)  # orphan (container GC)
+                        try:
+                            self.runtime.kill_pod(uid)  # orphan GC
+                            if self.volumes is not None:
+                                self.volumes.teardown_pod_volumes(uid)
+                        except Exception:
+                            pass  # one bad orphan must not stall the tick
+                        self._volumes_mounted.discard(uid)
                 _PODS_RUNNING.set(len(pods), node=self.node_name)
             except Exception:
                 pass
@@ -233,6 +262,22 @@ class Kubelet:
         if pod.status.phase in ("Succeeded", "Failed"):
             return
         uid = pod.metadata.uid or pod.metadata.name
+
+        # Volumes first (kubelet.go:1135 mountExternalVolumes): a pod
+        # whose volumes can't materialize must not start containers.
+        # Mounted once per pod instance — re-running every resync tick
+        # would hammer the apiserver (secret/claim GETs) and rewrite
+        # secret files non-atomically under running containers.
+        if (
+            self.volumes is not None
+            and pod.spec.volumes
+            and uid not in self._volumes_mounted
+        ):
+            try:
+                self.volumes.mount_pod_volumes(pod)
+            except Exception:
+                return  # retried by the resync tick
+            self._volumes_mounted.add(uid)
 
         # Probes may demand restarts before the runtime sync.
         self._run_probes(pod, uid)

@@ -184,8 +184,52 @@ class SecretVolumeSource:
 
 
 @dataclass
+class NFSVolumeSource:
+    server: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class GitRepoVolumeSource:
+    repository: str = ""
+    revision: str = ""
+
+
+@dataclass
+class GlusterfsVolumeSource:
+    endpoints_name: str = field(default="", metadata={"wire": "endpoints"})
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class RBDVolumeSource:
+    monitors: List[str] = field(default_factory=list)
+    image: str = ""
+    pool: str = "rbd"
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class ISCSIVolumeSource:
+    target_portal: str = ""
+    iqn: str = ""
+    lun: int = 0
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class PersistentVolumeClaimVolumeSource:
+    claim_name: str = ""
+    read_only: bool = False
+
+
+@dataclass
 class Volume:
-    """Reference: pkg/api/types.go Volume / VolumeSource (subset)."""
+    """Reference: pkg/api/types.go Volume / VolumeSource."""
 
     name: str = ""
     empty_dir: Optional[EmptyDirVolumeSource] = None
@@ -193,6 +237,12 @@ class Volume:
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
     secret: Optional[SecretVolumeSource] = None
+    nfs: Optional[NFSVolumeSource] = None
+    git_repo: Optional[GitRepoVolumeSource] = None
+    glusterfs: Optional[GlusterfsVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
 
 
 @dataclass
@@ -527,7 +577,10 @@ class PersistentVolumeSource:
     host_path: Optional[HostPathVolumeSource] = None
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
-    nfs: Optional[Dict[str, Any]] = None
+    nfs: Optional[NFSVolumeSource] = None
+    glusterfs: Optional[GlusterfsVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
 
 
 @dataclass

@@ -214,6 +214,9 @@ def validate_persistent_volume(pv) -> None:
             src.gce_persistent_disk,
             src.aws_elastic_block_store,
             src.nfs,
+            src.glusterfs,
+            src.rbd,
+            src.iscsi,
         )
         if s is not None
     ]

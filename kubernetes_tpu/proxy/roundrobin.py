@@ -127,19 +127,16 @@ class LoadBalancerRR:
                     state.index = 0
                     # Stale affinity entries pointing at removed
                     # endpoints are dropped lazily in next_endpoint.
-        # Services not mentioned keep their registration but lose
-        # endpoints only on explicit empty update (reference keeps the
-        # same semantics: a full OnUpdate replaces everything present).
+        # The update is the full desired state: any registered
+        # service-port key absent from it has no endpoints anymore —
+        # including a single named port dropped from an Endpoints
+        # object whose other ports remain (reference: roundrobin.go
+        # OnUpdate removes every key missing from the update).
         with self._lock:
             for key, state in self._services.items():
                 if key not in seen and state.endpoints:
-                    # Endpoints object deleted entirely.
-                    present = any(
-                        (k[0], k[1]) == (key[0], key[1]) for k in seen
-                    )
-                    if not present:
-                        state.endpoints = []
-                        state.index = 0
+                    state.endpoints = []
+                    state.index = 0
 
     def endpoints_for(self, svc: ServicePortName) -> List[str]:
         with self._lock:

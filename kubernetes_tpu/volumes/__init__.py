@@ -1,0 +1,22 @@
+"""Volume subsystem (reference: pkg/volume/ + pkg/util/mount/)."""
+
+from kubernetes_tpu.volumes.mount import ExecMounter, FakeMounter, MountPoint, Mounter
+from kubernetes_tpu.volumes.plugins import (
+    Builder,
+    Cleaner,
+    VolumeHost,
+    VolumePlugin,
+    VolumePluginManager,
+)
+
+__all__ = [
+    "Builder",
+    "Cleaner",
+    "ExecMounter",
+    "FakeMounter",
+    "MountPoint",
+    "Mounter",
+    "VolumeHost",
+    "VolumePlugin",
+    "VolumePluginManager",
+]

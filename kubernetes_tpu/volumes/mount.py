@@ -1,0 +1,94 @@
+"""Mount utility abstraction.
+
+Reference: pkg/util/mount/ — Interface{Mount, Unmount, List} with a
+real exec'd implementation and a FakeMounter for tests. Network/block
+volume plugins never touch mount(8) directly; they go through this
+seam so the whole volume subsystem is testable without privileges.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class MountPoint:
+    device: str
+    path: str
+    fstype: str
+    opts: tuple = ()
+
+
+class Mounter:
+    """Interface (reference: mount.Interface)."""
+
+    def mount(self, source: str, target: str, fstype: str, options: List[str]) -> None:
+        raise NotImplementedError
+
+    def unmount(self, target: str) -> None:
+        raise NotImplementedError
+
+    def list(self) -> List[MountPoint]:
+        raise NotImplementedError
+
+    def is_mount_point(self, path: str) -> bool:
+        return any(m.path == path for m in self.list())
+
+
+class FakeMounter(Mounter):
+    """In-memory mount table + action log (reference: mount.FakeMounter)."""
+
+    def __init__(self, fail_on: Optional[set] = None):
+        self._lock = threading.Lock()
+        self.mounts: List[MountPoint] = []
+        self.log: List[tuple] = []
+        self.fail_on = fail_on or set()
+
+    def mount(self, source, target, fstype, options) -> None:
+        with self._lock:
+            self.log.append(("mount", source, target, fstype, tuple(options)))
+            if target in self.fail_on:
+                raise OSError(f"fake mount failure for {target}")
+            self.mounts.append(MountPoint(source, target, fstype, tuple(options)))
+
+    def unmount(self, target) -> None:
+        with self._lock:
+            self.log.append(("unmount", target))
+            self.mounts = [m for m in self.mounts if m.path != target]
+
+    def list(self) -> List[MountPoint]:
+        with self._lock:
+            return list(self.mounts)
+
+
+class ExecMounter(Mounter):
+    """Shells out to mount(8)/umount(8) (reference: mount.Mounter).
+    Requires privileges; used only in real deployments."""
+
+    def mount(self, source, target, fstype, options) -> None:
+        cmd = ["mount"]
+        if fstype:
+            cmd += ["-t", fstype]
+        if options:
+            cmd += ["-o", ",".join(options)]
+        cmd += [source, target]
+        subprocess.run(cmd, check=True, capture_output=True)
+
+    def unmount(self, target) -> None:
+        subprocess.run(["umount", target], check=True, capture_output=True)
+
+    def list(self) -> List[MountPoint]:
+        out = []
+        with open("/proc/mounts") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 4:
+                    out.append(
+                        MountPoint(
+                            parts[0], parts[1], parts[2], tuple(parts[3].split(","))
+                        )
+                    )
+        return out

@@ -1,0 +1,482 @@
+"""Volume plugin framework.
+
+Reference: pkg/volume/ (volume.go Builder/Cleaner interfaces,
+plugins.go VolumePluginMgr.FindPluginBySpec) and the per-plugin
+packages: empty_dir, host_path, secret, git_repo, nfs, gce_pd,
+aws_ebs, iscsi, glusterfs, rbd, persistent_claim.
+
+Layout mirrors the reference kubelet's disk format:
+  <root>/pods/<pod-uid>/volumes/<escaped-plugin-name>/<volume-name>
+
+Local plugins (empty_dir, host_path, secret, git_repo) do real
+filesystem work; network/block plugins (nfs, gce_pd, aws_ebs, iscsi,
+glusterfs, rbd) drive the Mounter seam (mount.py) so they run
+unprivileged under FakeMounter and for real under ExecMounter.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import shutil
+import subprocess
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubernetes_tpu.models.objects import Volume
+from kubernetes_tpu.volumes.mount import FakeMounter, Mounter
+
+
+@dataclass
+class VolumeHost:
+    """What plugins may use from their host kubelet (reference:
+    volume.VolumeHost)."""
+
+    root_dir: str
+    client: object = None  # apiserver client (secret/claim plugins)
+    mounter: Mounter = None
+    node_name: str = ""
+
+    def __post_init__(self):
+        if self.mounter is None:
+            self.mounter = FakeMounter()
+
+    def pod_volume_dir(self, pod_uid: str, plugin_name: str, volume_name: str) -> str:
+        escaped = plugin_name.replace("/", "~")
+        return os.path.join(
+            self.root_dir, "pods", pod_uid, "volumes", escaped, volume_name
+        )
+
+    def pod_volumes_root(self, pod_uid: str) -> str:
+        return os.path.join(self.root_dir, "pods", pod_uid, "volumes")
+
+
+class Builder:
+    """Sets up a volume for a pod (reference: volume.Builder)."""
+
+    def setup(self) -> str:
+        """Materialize the volume; returns the host path to mount into
+        containers."""
+        raise NotImplementedError
+
+    def get_path(self) -> str:
+        raise NotImplementedError
+
+
+class Cleaner:
+    """Tears a volume down (reference: volume.Cleaner)."""
+
+    def teardown(self) -> None:
+        raise NotImplementedError
+
+
+class VolumePlugin:
+    name: str = ""
+
+    def init(self, host: VolumeHost) -> None:
+        self.host = host
+
+    def can_support(self, volume: Volume) -> bool:
+        raise NotImplementedError
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        raise NotImplementedError
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirCleaner(
+            self.host.pod_volume_dir(pod_uid, self.name, volume_name)
+        )
+
+
+class _DirCleaner(Cleaner):
+    def __init__(self, path: str, mounter: Optional[Mounter] = None):
+        self.path = path
+        self.mounter = mounter
+
+    def teardown(self) -> None:
+        if self.mounter is not None and self.mounter.is_mount_point(self.path):
+            self.mounter.unmount(self.path)
+        if os.path.islink(self.path):
+            os.unlink(self.path)
+        elif os.path.isdir(self.path):
+            shutil.rmtree(self.path, ignore_errors=True)
+
+
+class _DirBuilder(Builder):
+    def __init__(self, path: str):
+        self.path = path
+
+    def get_path(self) -> str:
+        return self.path
+
+
+# ---------------------------------------------------------------------------
+# Local plugins
+# ---------------------------------------------------------------------------
+
+
+class EmptyDirPlugin(VolumePlugin):
+    """pkg/volume/empty_dir/ — a fresh directory per (pod, volume)."""
+
+    name = "kubernetes.io/empty-dir"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.empty_dir is not None
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        path = self.host.pod_volume_dir(
+            pod.metadata.uid or pod.metadata.name, self.name, volume.name
+        )
+
+        class B(_DirBuilder):
+            def setup(self) -> str:
+                os.makedirs(self.path, exist_ok=True)
+                return self.path
+
+        return B(path)
+
+
+class HostPathPlugin(VolumePlugin):
+    """pkg/volume/host_path/ — expose an existing host path; nothing
+    is created or destroyed."""
+
+    name = "kubernetes.io/host-path"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.host_path is not None
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        class B(_DirBuilder):
+            def setup(self) -> str:
+                return self.path
+
+        return B(volume.host_path.path)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        class NoopCleaner(Cleaner):
+            def teardown(self) -> None:
+                pass
+
+        return NoopCleaner()
+
+
+class SecretPlugin(VolumePlugin):
+    """pkg/volume/secret/ — fetch the Secret and write each key as a
+    file (values are base64 in the wire format)."""
+
+    name = "kubernetes.io/secret"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.secret is not None
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        host = self.host
+        path = host.pod_volume_dir(
+            pod.metadata.uid or pod.metadata.name, self.name, volume.name
+        )
+        secret_name = volume.secret.secret_name
+        namespace = pod.metadata.namespace or "default"
+
+        class B(_DirBuilder):
+            def setup(self) -> str:
+                secret = host.client.get(
+                    "secrets", secret_name, namespace=namespace
+                )
+                os.makedirs(self.path, exist_ok=True)
+                data = secret.data if not isinstance(secret, dict) else secret.get("data", {})
+                for key, b64 in (data or {}).items():
+                    with open(os.path.join(self.path, key), "wb") as f:
+                        f.write(base64.b64decode(b64))
+                return self.path
+
+        return B(path)
+
+
+class GitRepoPlugin(VolumePlugin):
+    """pkg/volume/git_repo/ — clone a repository into the volume dir."""
+
+    name = "kubernetes.io/git-repo"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.git_repo is not None
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        path = self.host.pod_volume_dir(
+            pod.metadata.uid or pod.metadata.name, self.name, volume.name
+        )
+        repo = volume.git_repo.repository
+        revision = volume.git_repo.revision
+        # A pod spec is untrusted input: a repository/revision starting
+        # with "-" would be parsed as a git OPTION (e.g.
+        # --upload-pack=<cmd> executes arbitrary commands as the
+        # kubelet user).
+        if repo.startswith("-") or revision.startswith("-"):
+            raise ValueError("gitRepo repository/revision may not start with '-'")
+
+        class B(_DirBuilder):
+            def setup(self) -> str:
+                os.makedirs(self.path, exist_ok=True)
+                if not os.listdir(self.path):
+                    subprocess.run(
+                        ["git", "clone", "--", repo, self.path],
+                        check=True, capture_output=True,
+                    )
+                    if revision:
+                        subprocess.run(
+                            ["git", "checkout", revision, "--"],
+                            cwd=self.path, check=True, capture_output=True,
+                        )
+                return self.path
+
+        return B(path)
+
+
+# ---------------------------------------------------------------------------
+# Network / block plugins — all reduce to "mount a remote source at the
+# per-pod dir" through the Mounter seam.
+# ---------------------------------------------------------------------------
+
+
+class _MountedPlugin(VolumePlugin):
+    def _source(self, volume: Volume) -> tuple:
+        """(device/source, fstype, options) for this volume."""
+        raise NotImplementedError
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        host = self.host
+        path = host.pod_volume_dir(
+            pod.metadata.uid or pod.metadata.name, self.name, volume.name
+        )
+        source, fstype, options = self._source(volume)
+
+        class B(_DirBuilder):
+            def setup(self) -> str:
+                os.makedirs(self.path, exist_ok=True)
+                if not host.mounter.is_mount_point(self.path):
+                    host.mounter.mount(source, self.path, fstype, options)
+                return self.path
+
+        return B(path)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirCleaner(
+            self.host.pod_volume_dir(pod_uid, self.name, volume_name),
+            mounter=self.host.mounter,
+        )
+
+
+class NFSPlugin(_MountedPlugin):
+    name = "kubernetes.io/nfs"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.nfs is not None
+
+    def _source(self, volume: Volume):
+        nfs = volume.nfs
+        opts = ["ro"] if nfs.read_only else []
+        return f"{nfs.server}:{nfs.path}", "nfs", opts
+
+
+class GCEPersistentDiskPlugin(_MountedPlugin):
+    name = "kubernetes.io/gce-pd"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.gce_persistent_disk is not None
+
+    def _source(self, volume: Volume):
+        pd = volume.gce_persistent_disk
+        opts = ["ro"] if pd.read_only else []
+        return f"/dev/disk/by-id/google-{pd.pd_name}", pd.fs_type or "ext4", opts
+
+
+class AWSElasticBlockStorePlugin(_MountedPlugin):
+    name = "kubernetes.io/aws-ebs"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.aws_elastic_block_store is not None
+
+    def _source(self, volume: Volume):
+        ebs = volume.aws_elastic_block_store
+        opts = ["ro"] if ebs.read_only else []
+        return f"aws://{ebs.volume_id}", ebs.fs_type or "ext4", opts
+
+
+class GlusterfsPlugin(_MountedPlugin):
+    name = "kubernetes.io/glusterfs"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.glusterfs is not None
+
+    def _source(self, volume: Volume):
+        g = volume.glusterfs
+        opts = ["ro"] if g.read_only else []
+        return f"{g.endpoints_name}:{g.path}", "glusterfs", opts
+
+
+class RBDPlugin(_MountedPlugin):
+    name = "kubernetes.io/rbd"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.rbd is not None
+
+    def _source(self, volume: Volume):
+        r = volume.rbd
+        opts = ["ro"] if r.read_only else []
+        return f"rbd:{r.pool}/{r.image}", r.fs_type or "ext4", opts
+
+
+class ISCSIPlugin(_MountedPlugin):
+    name = "kubernetes.io/iscsi"
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.iscsi is not None
+
+    def _source(self, volume: Volume):
+        i = volume.iscsi
+        opts = ["ro"] if i.read_only else []
+        return f"{i.target_portal}:{i.iqn}:lun{i.lun}", i.fs_type or "ext4", opts
+
+
+# ---------------------------------------------------------------------------
+# persistent_claim — delegates to the plugin matching the bound PV
+# ---------------------------------------------------------------------------
+
+
+class PersistentClaimPlugin(VolumePlugin):
+    """pkg/volume/persistent_claim/ — resolve PVC -> bound PV ->
+    underlying plugin, and build THAT volume in this pod's dirs."""
+
+    name = "kubernetes.io/persistent-claim"
+
+    def __init__(self, manager: "VolumePluginManager"):
+        self.manager = manager
+
+    def can_support(self, volume: Volume) -> bool:
+        return volume.persistent_volume_claim is not None
+
+    def new_builder(self, volume: Volume, pod) -> Builder:
+        claim_name = volume.persistent_volume_claim.claim_name
+        namespace = pod.metadata.namespace or "default"
+        claim = self.host.client.get(
+            "persistentvolumeclaims", claim_name, namespace=namespace
+        )
+        volume_name = (
+            claim.spec.volume_name
+            if not isinstance(claim, dict)
+            else claim.get("spec", {}).get("volumeName", "")
+        )
+        if not volume_name:
+            raise ValueError(f"claim {namespace}/{claim_name} is not bound yet")
+        pv = self.host.client.get("persistentvolumes", volume_name)
+        src = pv.spec.persistent_volume_source
+        # Re-wrap the PV's source as a pod Volume carrying the claim
+        # volume's name, so paths land under this pod. A read-only
+        # claim must stay read-only regardless of what the PV says —
+        # copy each source (never mutate the cached PV) and force the
+        # flag through.
+        import dataclasses as _dc
+
+        def _ro(source):
+            if source is None:
+                return None
+            if volume.persistent_volume_claim.read_only and hasattr(
+                source, "read_only"
+            ):
+                return _dc.replace(source, read_only=True)
+            return source
+
+        inner = Volume(
+            name=volume.name,
+            host_path=_ro(src.host_path),
+            gce_persistent_disk=_ro(src.gce_persistent_disk),
+            aws_elastic_block_store=_ro(src.aws_elastic_block_store),
+            nfs=_ro(src.nfs),
+            glusterfs=_ro(src.glusterfs),
+            rbd=_ro(src.rbd),
+            iscsi=_ro(src.iscsi),
+        )
+        plugin = self.manager.find_plugin(inner, exclude=self.name)
+        if plugin is None:
+            raise ValueError(f"no plugin supports PV {volume_name}")
+        return plugin.new_builder(inner, pod)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        # The delegate built under its own plugin dir; pod-level GC
+        # (teardown_orphans) sweeps every plugin dir, so nothing to do.
+        class NoopCleaner(Cleaner):
+            def teardown(self) -> None:
+                pass
+
+        return NoopCleaner()
+
+
+# ---------------------------------------------------------------------------
+# Manager
+# ---------------------------------------------------------------------------
+
+
+class VolumePluginManager:
+    """Registry + dispatch (reference: volume.VolumePluginMgr)."""
+
+    def __init__(self, host: VolumeHost, plugins: Optional[List[VolumePlugin]] = None):
+        self.host = host
+        if plugins is None:
+            plugins = [
+                EmptyDirPlugin(),
+                HostPathPlugin(),
+                SecretPlugin(),
+                GitRepoPlugin(),
+                NFSPlugin(),
+                GCEPersistentDiskPlugin(),
+                AWSElasticBlockStorePlugin(),
+                GlusterfsPlugin(),
+                RBDPlugin(),
+                ISCSIPlugin(),
+                PersistentClaimPlugin(self),
+            ]
+        self.plugins = plugins
+        for p in self.plugins:
+            p.init(host)
+
+    def find_plugin(self, volume: Volume, exclude: str = "") -> Optional[VolumePlugin]:
+        for p in self.plugins:
+            if p.name != exclude and p.can_support(volume):
+                return p
+        return None
+
+    # -- kubelet entry points -----------------------------------------
+
+    def mount_pod_volumes(self, pod) -> dict:
+        """SetUp every volume in the pod spec; returns
+        {volume_name: host_path} (reference: kubelet.go
+        mountExternalVolumes :1135)."""
+        paths = {}
+        for volume in pod.spec.volumes:
+            plugin = self.find_plugin(volume)
+            if plugin is None:
+                raise ValueError(f"no plugin for volume {volume.name!r}")
+            paths[volume.name] = plugin.new_builder(volume, pod).setup()
+        return paths
+
+    def teardown_pod_volumes(self, pod_uid: str) -> None:
+        """Tear down everything under the pod's volumes dir (reference:
+        kubelet cleanupOrphanedVolumes)."""
+        root = self.host.pod_volumes_root(pod_uid)
+        if not os.path.isdir(root):
+            return
+        for escaped in os.listdir(root):
+            plugin_dir = os.path.join(root, escaped)
+            plugin_name = escaped.replace("~", "/")
+            plugin = next(
+                (p for p in self.plugins if p.name == plugin_name), None
+            )
+            for volume_name in os.listdir(plugin_dir):
+                if plugin is not None:
+                    plugin.new_cleaner(volume_name, pod_uid).teardown()
+                else:
+                    _DirCleaner(
+                        os.path.join(plugin_dir, volume_name),
+                        mounter=self.host.mounter,
+                    ).teardown()
+        shutil.rmtree(os.path.join(self.host.root_dir, "pods", pod_uid),
+                      ignore_errors=True)

@@ -143,6 +143,36 @@ class TestLoadBalancerRR:
         with pytest.raises(ErrMissingEndpoints):
             lb.next_endpoint(("default", "svc", ""))
 
+    def test_dropped_named_port_clears_its_endpoints(self):
+        """Removing one named port from an Endpoints object clears that
+        port's list even though the object still carries other ports."""
+        lb = LoadBalancerRR()
+        both = Endpoints(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            subsets=[
+                EndpointSubset(
+                    addresses=[EndpointAddress(ip="1.1.1.1")],
+                    ports=[EndpointPort(name="http", port=80),
+                           EndpointPort(name="metrics", port=9090)],
+                )
+            ],
+        )
+        lb.on_update([both])
+        assert lb.next_endpoint(("default", "svc", "metrics")) == "1.1.1.1:9090"
+        only_http = Endpoints(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            subsets=[
+                EndpointSubset(
+                    addresses=[EndpointAddress(ip="1.1.1.1")],
+                    ports=[EndpointPort(name="http", port=80)],
+                )
+            ],
+        )
+        lb.on_update([only_http])
+        with pytest.raises(ErrMissingEndpoints):
+            lb.next_endpoint(("default", "svc", "metrics"))
+        assert lb.next_endpoint(("default", "svc", "http")) == "1.1.1.1:80"
+
 
 # -- Proxier over real TCP -------------------------------------------
 

@@ -1,0 +1,308 @@
+"""Volume plugin framework tests (reference behaviors:
+pkg/volume/*/..._test.go, pkg/util/mount)."""
+
+import base64
+import os
+import subprocess
+
+import pytest
+
+from kubernetes_tpu.client.rest import Client, LocalTransport
+from kubernetes_tpu.models.objects import (
+    EmptyDirVolumeSource,
+    GitRepoVolumeSource,
+    HostPathVolumeSource,
+    NFSVolumeSource,
+    ObjectMeta,
+    PersistentVolumeClaimVolumeSource,
+    Pod,
+    PodSpec,
+    SecretVolumeSource,
+    Volume,
+)
+from kubernetes_tpu.server.api import APIServer
+from kubernetes_tpu.volumes import FakeMounter, VolumeHost, VolumePluginManager
+
+
+def mkpod(name="p1", uid="uid-1", volumes=()):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=PodSpec(volumes=list(volumes)),
+    )
+
+
+@pytest.fixture
+def host(tmp_path):
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    h = VolumeHost(root_dir=str(tmp_path), client=client, mounter=FakeMounter())
+    h.api = api  # for tests to seed objects
+    return h
+
+
+@pytest.fixture
+def mgr(host):
+    return VolumePluginManager(host)
+
+
+class TestEmptyDir:
+    def test_setup_teardown(self, mgr):
+        pod = mkpod(volumes=[Volume(name="scratch", empty_dir=EmptyDirVolumeSource())])
+        paths = mgr.mount_pod_volumes(pod)
+        assert os.path.isdir(paths["scratch"])
+        assert "empty-dir" in paths["scratch"]
+        mgr.teardown_pod_volumes("uid-1")
+        assert not os.path.exists(paths["scratch"])
+
+    def test_idempotent_setup(self, mgr):
+        pod = mkpod(volumes=[Volume(name="s", empty_dir=EmptyDirVolumeSource())])
+        p1 = mgr.mount_pod_volumes(pod)["s"]
+        open(os.path.join(p1, "data.txt"), "w").write("keep")
+        p2 = mgr.mount_pod_volumes(pod)["s"]
+        assert p1 == p2
+        assert os.path.exists(os.path.join(p2, "data.txt"))
+
+
+class TestHostPath:
+    def test_exposes_existing_path(self, mgr, tmp_path):
+        target = tmp_path / "data"
+        target.mkdir()
+        pod = mkpod(
+            volumes=[Volume(name="h", host_path=HostPathVolumeSource(path=str(target)))]
+        )
+        paths = mgr.mount_pod_volumes(pod)
+        assert paths["h"] == str(target)
+        # Teardown must NOT delete a host path.
+        mgr.teardown_pod_volumes("uid-1")
+        assert target.is_dir()
+
+
+class TestSecret:
+    def test_writes_decoded_keys(self, mgr, host):
+        host.api.create(
+            "secrets",
+            "default",
+            {
+                "kind": "Secret",
+                "metadata": {"name": "creds"},
+                "data": {"user": base64.b64encode(b"alice").decode()},
+            },
+        )
+        pod = mkpod(
+            volumes=[Volume(name="sec", secret=SecretVolumeSource(secret_name="creds"))]
+        )
+        paths = mgr.mount_pod_volumes(pod)
+        assert open(os.path.join(paths["sec"], "user"), "rb").read() == b"alice"
+
+    def test_missing_secret_fails_setup(self, mgr):
+        pod = mkpod(
+            volumes=[Volume(name="sec", secret=SecretVolumeSource(secret_name="nope"))]
+        )
+        with pytest.raises(Exception):
+            mgr.mount_pod_volumes(pod)
+
+
+class TestGitRepo:
+    def test_clones_local_repo(self, mgr, tmp_path):
+        src = tmp_path / "srcrepo"
+        src.mkdir()
+        subprocess.run(["git", "init", "-q"], cwd=src, check=True)
+        (src / "hello.txt").write_text("world")
+        subprocess.run(["git", "add", "."], cwd=src, check=True)
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "commit", "-q", "-m", "init"],
+            cwd=src, check=True,
+        )
+        pod = mkpod(
+            volumes=[Volume(name="code", git_repo=GitRepoVolumeSource(repository=str(src)))]
+        )
+        paths = mgr.mount_pod_volumes(pod)
+        assert open(os.path.join(paths["code"], "hello.txt")).read() == "world"
+
+
+class TestNetworkVolumes:
+    def test_nfs_mounts_through_mounter(self, mgr, host):
+        pod = mkpod(
+            volumes=[
+                Volume(
+                    name="share",
+                    nfs=NFSVolumeSource(server="fs1", path="/exports", read_only=True),
+                )
+            ]
+        )
+        paths = mgr.mount_pod_volumes(pod)
+        mounts = host.mounter.list()
+        assert len(mounts) == 1
+        assert mounts[0].device == "fs1:/exports"
+        assert mounts[0].fstype == "nfs"
+        assert "ro" in mounts[0].opts
+        assert mounts[0].path == paths["share"]
+        # Teardown unmounts before removing the dir.
+        mgr.teardown_pod_volumes("uid-1")
+        assert host.mounter.list() == []
+        assert ("unmount", paths["share"]) in host.mounter.log
+
+    def test_mount_is_idempotent(self, mgr, host):
+        pod = mkpod(volumes=[Volume(name="share", nfs=NFSVolumeSource(server="a", path="/x"))])
+        mgr.mount_pod_volumes(pod)
+        mgr.mount_pod_volumes(pod)
+        assert len(host.mounter.list()) == 1
+
+
+class TestPersistentClaim:
+    def test_delegates_to_bound_pv(self, mgr, host, tmp_path):
+        data = tmp_path / "pvdata"
+        data.mkdir()
+        host.api.create(
+            "persistentvolumes",
+            "",
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": "pv1"},
+                "spec": {
+                    "capacity": {"storage": "1Gi"},
+                    "accessModes": ["ReadWriteOnce"],
+                    "persistentVolumeSource": {"hostPath": {"path": str(data)}},
+                },
+            },
+        )
+        host.api.create(
+            "persistentvolumeclaims",
+            "default",
+            {
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": "claim1"},
+                "spec": {"volumeName": "pv1", "accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+            },
+        )
+        pod = mkpod(
+            volumes=[
+                Volume(
+                    name="store",
+                    persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                        claim_name="claim1"
+                    ),
+                )
+            ]
+        )
+        paths = mgr.mount_pod_volumes(pod)
+        assert paths["store"] == str(data)
+
+    def test_read_only_claim_forces_ro_mount(self, mgr, host):
+        host.api.create(
+            "persistentvolumes",
+            "",
+            {
+                "kind": "PersistentVolume",
+                "metadata": {"name": "pvnfs"},
+                "spec": {
+                    "capacity": {"storage": "1Gi"},
+                    "accessModes": ["ReadOnlyMany"],
+                    "persistentVolumeSource": {
+                        "nfs": {"server": "fs1", "path": "/exports"}
+                    },
+                },
+            },
+        )
+        host.api.create(
+            "persistentvolumeclaims",
+            "default",
+            {
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": "roclaim"},
+                "spec": {"volumeName": "pvnfs", "accessModes": ["ReadOnlyMany"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+            },
+        )
+        pod = mkpod(
+            volumes=[
+                Volume(
+                    name="store",
+                    persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                        claim_name="roclaim", read_only=True
+                    ),
+                )
+            ]
+        )
+        paths = mgr.mount_pod_volumes(pod)
+        (mount,) = host.mounter.list()
+        assert mount.path == paths["store"]
+        assert "ro" in mount.opts  # claim read_only overrides PV source
+
+    def test_git_repo_rejects_option_injection(self, mgr):
+        pod = mkpod(
+            volumes=[
+                Volume(
+                    name="code",
+                    git_repo=GitRepoVolumeSource(
+                        repository="--upload-pack=touch /tmp/pwned"
+                    ),
+                )
+            ]
+        )
+        with pytest.raises(ValueError):
+            mgr.mount_pod_volumes(pod)
+
+    def test_unbound_claim_fails(self, mgr, host):
+        host.api.create(
+            "persistentvolumeclaims",
+            "default",
+            {
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": "pending"},
+                "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+            },
+        )
+        pod = mkpod(
+            volumes=[
+                Volume(
+                    name="store",
+                    persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                        claim_name="pending"
+                    ),
+                )
+            ]
+        )
+        with pytest.raises(Exception):
+            mgr.mount_pod_volumes(pod)
+
+
+class TestKubeletIntegration:
+    def test_volumes_mounted_and_cleaned(self, tmp_path):
+        import time
+
+        from kubernetes_tpu.kubelet.agent import Kubelet
+        from kubernetes_tpu.models import serde
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        kubelet = Kubelet(
+            client, "n1", root_dir=str(tmp_path), heartbeat_period=0.5,
+            sync_period=0.2,
+        ).start()
+        try:
+            pod = mkpod(
+                name="volpod", uid="",
+                volumes=[Volume(name="scratch", empty_dir=EmptyDirVolumeSource())],
+            )
+            pod.spec.containers = []
+            wire = serde.to_wire(pod)
+            wire["spec"]["containers"] = [{"name": "c", "image": "busybox"}]
+            wire["spec"]["nodeName"] = "n1"
+            created = client.create("pods", wire)
+            uid = created.metadata.uid
+            voldir = os.path.join(str(tmp_path), "pods", uid, "volumes")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not os.path.isdir(voldir):
+                time.sleep(0.05)
+            assert os.path.isdir(voldir)
+            client.delete("pods", "volpod", namespace="default")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and os.path.exists(voldir):
+                time.sleep(0.05)
+            assert not os.path.exists(voldir)
+        finally:
+            kubelet.stop()
