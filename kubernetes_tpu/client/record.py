@@ -1,0 +1,236 @@
+"""Event recording: broadcaster, recorder, dedup/compression.
+
+Reference: pkg/client/record/event.go (EventBroadcaster +
+EventRecorder.Eventf -> sinks) and events_cache.go:52-69 (aggregation:
+events identical in (source, involvedObject, reason, message) within
+the cache window become ONE Event whose count/lastTimestamp advance —
+design doc docs/design/event_compression.md).
+
+Events are observability, never control flow: recording is async and
+every failure is swallowed (the reference drops events on sink errors
+too, after retries).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.models.objects import now_iso
+
+# Aggregation cache: the reference uses an LRU of 4096 with no TTL; a
+# TTL keeps long-lived daemons from resurrecting week-old counts.
+_CACHE_TTL = 3600.0
+_CACHE_MAX = 4096
+
+
+def _event_key(ev: dict) -> Tuple:
+    inv = ev.get("involvedObject", {})
+    return (
+        ev.get("source", {}).get("component", ""),
+        inv.get("kind", ""),
+        inv.get("namespace", ""),
+        inv.get("name", ""),
+        inv.get("uid", ""),
+        ev.get("reason", ""),
+        ev.get("message", ""),
+    )
+
+
+@dataclass
+class _CacheEntry:
+    name: str  # stored event's object name
+    namespace: str
+    count: int
+    first_timestamp: str
+    last_seen: float = field(default_factory=time.monotonic)
+
+
+class EventAggregator:
+    """Dedup state (reference: events_cache.go eventsCache)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, _CacheEntry] = {}
+
+    def observe(self, ev: dict) -> Optional[_CacheEntry]:
+        """Returns the existing entry (bumped) when `ev` is a repeat,
+        else None (and starts tracking it once recorded)."""
+        key = _event_key(ev)
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and now - entry.last_seen < _CACHE_TTL:
+                entry.count += 1
+                entry.last_seen = now
+                return entry
+            return None
+
+    def track(self, ev: dict) -> None:
+        key = _event_key(ev)
+        with self._lock:
+            if len(self._entries) >= _CACHE_MAX:
+                # Evict oldest-seen (simple scan; 4096 max).
+                oldest = min(self._entries, key=lambda k: self._entries[k].last_seen)
+                del self._entries[oldest]
+            self._entries[key] = _CacheEntry(
+                name=ev["metadata"]["name"],
+                namespace=ev["metadata"]["namespace"],
+                count=int(ev.get("count", 1)),
+                first_timestamp=ev.get("firstTimestamp", ""),
+            )
+
+
+class EventRecorder:
+    """Component-scoped recorder (reference: EventRecorder.Eventf)."""
+
+    def __init__(self, broadcaster: "EventBroadcaster", component: str):
+        self.broadcaster = broadcaster
+        self.component = component
+
+    def event(self, involved, reason: str, message: str) -> None:
+        wire = involved if isinstance(involved, dict) else None
+        if wire is None:
+            from kubernetes_tpu.models import serde
+
+            wire = serde.to_wire(involved)
+        meta = wire.get("metadata", {})
+        ns = meta.get("namespace", "") or "default"
+        ts = now_iso()
+        self.broadcaster.emit(
+            {
+                "kind": "Event",
+                "apiVersion": "v1",
+                "metadata": {
+                    "name": f"{meta.get('name', 'unknown')}.{int(time.time() * 1e6):x}",
+                    "namespace": ns,
+                },
+                "involvedObject": {
+                    "kind": wire.get("kind", ""),
+                    "name": meta.get("name", ""),
+                    "namespace": ns,
+                    "uid": meta.get("uid", ""),
+                },
+                "reason": reason,
+                "message": message,
+                "source": {"component": self.component},
+                "firstTimestamp": ts,
+                "lastTimestamp": ts,
+                "count": 1,
+            }
+        )
+
+    def eventf(self, involved, reason: str, message_fmt: str, *args) -> None:
+        self.event(involved, reason, message_fmt % args if args else message_fmt)
+
+
+class EventBroadcaster:
+    """Fan-out hub: recorders push, sinks drain asynchronously
+    (reference: event.go NewBroadcaster over watch.Mux)."""
+
+    def __init__(self, queue_len: int = 1000):
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=queue_len)
+        self._watchers: List[Callable[[dict], None]] = []
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._started = False
+        self._stopped = False
+
+    def new_recorder(self, component: str = "") -> EventRecorder:
+        return EventRecorder(self, component)
+
+    def emit(self, ev: dict) -> None:
+        try:
+            self._queue.put_nowait(ev)
+        except queue.Full:
+            pass  # observability must never block or break callers
+
+    def start_logging(self, log_fn: Callable[[str], None]) -> "EventBroadcaster":
+        def handler(ev: dict) -> None:
+            inv = ev.get("involvedObject", {})
+            log_fn(
+                f"event: {inv.get('namespace', '')}/{inv.get('name', '')} "
+                f"{ev.get('reason', '')}: {ev.get('message', '')}"
+            )
+
+        return self._add_watcher(handler)
+
+    def start_recording_to_sink(self, client) -> "EventBroadcaster":
+        """Write events through the dedup cache to the events API
+        (reference: StartRecordingToSink + recordToSink)."""
+        aggregator = EventAggregator()
+
+        def handler(ev: dict) -> None:
+            entry = aggregator.observe(ev)
+            if entry is not None:
+                # Repeat: advance count/lastTimestamp on the stored
+                # event.
+                try:
+                    stored = client.get(
+                        "events", entry.name, namespace=entry.namespace
+                    )
+                    stored.count = entry.count
+                    stored.last_timestamp = now_iso()
+                    client.update("events", stored, namespace=entry.namespace)
+                    return
+                except Exception:
+                    # The stored event expired from the TTL'd events
+                    # resource: re-create it (carrying the running
+                    # count) instead of going dark for the cache TTL.
+                    ev = dict(ev, count=entry.count)
+            try:
+                client.create("events", ev, namespace=ev["metadata"]["namespace"])
+                aggregator.track(ev)
+            except Exception:
+                pass
+
+        return self._add_watcher(handler)
+
+    def _add_watcher(self, handler: Callable[[dict], None]) -> "EventBroadcaster":
+        with self._lock:
+            self._watchers.append(handler)
+            if not self._started:
+                self._started = True
+                t = threading.Thread(target=self._drain, daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def flush(self, timeout: float = 2.0) -> bool:
+        """Block until everything enqueued BEFORE this call has been
+        fully handled by all sinks (marker ride-through)."""
+        done = threading.Event()
+        try:
+            self._queue.put(("__flush__", done), timeout=timeout)
+        except queue.Full:
+            return False
+        return done.wait(timeout)
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            if isinstance(ev, tuple) and ev[0] == "__flush__":
+                ev[1].set()
+                continue
+            with self._lock:
+                watchers = list(self._watchers)
+            for w in watchers:
+                try:
+                    w(ev)
+                except Exception:
+                    pass
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        """Flush then stop the drain thread."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=timeout)

@@ -260,6 +260,9 @@ class Client:
     def __init__(self, transport: Transport, qps: float = 0.0, burst: int = 10):
         self.t = transport
         self._bucket = TokenBucket(qps, burst) if qps > 0 else None
+        self._recorder_lock = threading.Lock()
+        self._broadcaster = None
+        self._recorders: dict = {}
 
     def _throttle(self):
         if self._bucket is not None:
@@ -381,29 +384,34 @@ class Client:
         source: str = "",
         namespace: str = "default",
     ) -> None:
+        """Record through the shared broadcaster: async, deduped
+        (repeats compress into one Event with a rising count —
+        reference events_cache.go:52-69)."""
         wire = self._wire(involved)
-        meta = wire.get("metadata", {})
-        ns = meta.get("namespace", namespace) or namespace
-        name = f"{meta.get('name', 'unknown')}.{int(time.time() * 1e6):x}"
-        ev = {
-            "kind": "Event",
-            "apiVersion": "v1",
-            "metadata": {"name": name, "namespace": ns},
-            "involvedObject": {
-                "kind": wire.get("kind", ""),
-                "name": meta.get("name", ""),
-                "namespace": ns,
-                "uid": meta.get("uid", ""),
-            },
-            "reason": reason,
-            "message": message,
-            "source": {"component": source},
-            "firstTimestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "lastTimestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "count": 1,
-        }
-        try:
-            self._throttle()
-            self.t.request("POST", "create", ("events", ns), ev)
-        except APIError:
-            pass  # events are best-effort (reference drops them too)
+        if not wire.get("metadata", {}).get("namespace"):
+            wire = dict(wire, metadata=dict(wire.get("metadata", {}),
+                                            namespace=namespace))
+        self.recorder(source).event(wire, reason, message)
+
+    def recorder(self, component: str = ""):
+        """Component-scoped EventRecorder on this client's shared
+        broadcaster+sink (lazily started)."""
+        with self._recorder_lock:
+            if self._broadcaster is None:
+                from kubernetes_tpu.client.record import EventBroadcaster
+
+                self._broadcaster = EventBroadcaster().start_recording_to_sink(self)
+            rec = self._recorders.get(component)
+            if rec is None:
+                rec = self._recorders[component] = self._broadcaster.new_recorder(
+                    component
+                )
+            return rec
+
+    def flush_events(self, timeout: float = 2.0) -> None:
+        """Block until previously recorded events have been written
+        through the sink (tests / clean shutdown)."""
+        with self._recorder_lock:
+            b = self._broadcaster
+        if b is not None:
+            b.flush(timeout)

@@ -1,0 +1,36 @@
+"""Cloud provider layer.
+
+Reference: pkg/cloudprovider/cloud.go — Interface{Instances,
+TCPLoadBalancer, Zones, Routes, Clusters} with per-cloud
+implementations and a plugin registry (pkg/cloudprovider/plugins.go).
+
+TPU-native framing: in this framework the "cloud" is the accelerator
+fabric itself. The TPU provider (tpu.py) discovers the pod slice's
+hosts/chips/ICI topology through JAX instead of querying a VM API:
+instances are TPU hosts, zones are slice coordinates, routes are ICI
+links. The fake provider mirrors pkg/cloudprovider/fake/fake.go.
+"""
+
+from kubernetes_tpu.cloudprovider.interface import (
+    CloudProvider,
+    Instance,
+    LoadBalancerStub,
+    Route,
+    Zone,
+    get_provider,
+    register_provider,
+)
+from kubernetes_tpu.cloudprovider.fake import FakeCloudProvider
+from kubernetes_tpu.cloudprovider.tpu import TPUCloudProvider
+
+__all__ = [
+    "CloudProvider",
+    "FakeCloudProvider",
+    "Instance",
+    "LoadBalancerStub",
+    "Route",
+    "TPUCloudProvider",
+    "Zone",
+    "get_provider",
+    "register_provider",
+]

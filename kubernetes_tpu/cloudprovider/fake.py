@@ -1,0 +1,60 @@
+"""Fake cloud provider for tests.
+
+Reference: pkg/cloudprovider/fake/fake.go — fully configurable
+instances/zones/routes plus a call log so controllers can be tested
+against deterministic cloud state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.cloudprovider.interface import (
+    CloudProvider,
+    Instance,
+    LoadBalancerStub,
+    Route,
+    Zone,
+    register_provider,
+)
+
+
+class FakeCloudProvider(CloudProvider):
+    name = "fake"
+
+    def __init__(
+        self,
+        instances: Optional[List[Instance]] = None,
+        zones: Optional[Dict[str, Zone]] = None,
+        routes: Optional[List[Route]] = None,
+    ):
+        self._instances = instances if instances is not None else []
+        self._zones = zones or {}
+        self._routes = routes if routes is not None else []
+        self._lb = LoadBalancerStub()
+        self.calls: List[str] = []
+
+    def instances(self) -> Optional[List[Instance]]:
+        self.calls.append("instances")
+        return list(self._instances)
+
+    def zone_of(self, instance_name: str) -> Optional[Zone]:
+        self.calls.append(f"zone_of:{instance_name}")
+        return self._zones.get(instance_name)
+
+    def routes(self) -> Optional[List[Route]]:
+        self.calls.append("routes")
+        return list(self._routes)
+
+    def load_balancer(self) -> Optional[LoadBalancerStub]:
+        return self._lb
+
+    def cluster_names(self) -> List[str]:
+        return ["fake-cluster"]
+
+    # test helpers
+    def set_instances(self, instances: List[Instance]) -> None:
+        self._instances = list(instances)
+
+
+register_provider("fake", FakeCloudProvider)

@@ -35,8 +35,14 @@ class ControllerManager:
         node_grace_period: float = 8.0,
         node_eviction_timeout: float = 4.0,
         sa_token_manager=None,
+        cloud_provider=None,
     ):
         self.controllers: List = []
+        if cloud_provider is not None:
+            from kubernetes_tpu.controllers.cloudnodes import CloudNodeController
+
+            self.cloud_nodes = CloudNodeController(client, cloud_provider)
+            self.controllers.append(self.cloud_nodes)
         if enable_replication:
             self.replication = ReplicationManager(client)
             self.controllers.append(self.replication)

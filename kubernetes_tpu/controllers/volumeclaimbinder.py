@@ -101,13 +101,21 @@ class PersistentVolumeClaimBinder:
             # Self-heal: a volume already reserved for this claim by an
             # earlier partial bind completes first, instead of grabbing
             # (and stranding) a second volume.
+            # Match by uid, not just ns/name: a Released volume whose
+            # old claim shared this claim's NAME must never self-heal
+            # onto the new claim (old tenant's data).
             reserved = next(
                 (
                     pv
                     for pv in volumes
                     if pv.spec.claim_ref is not None
+                    and pv.status.phase != "Released"
                     and (pv.spec.claim_ref.namespace, pv.spec.claim_ref.name)
                     == (claim.metadata.namespace, claim.metadata.name)
+                    and (
+                        not pv.spec.claim_ref.uid
+                        or pv.spec.claim_ref.uid == claim.metadata.uid
+                    )
                 ),
                 None,
             )

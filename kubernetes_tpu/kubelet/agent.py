@@ -243,15 +243,20 @@ class Kubelet:
                 for pod in pods:
                     known_uids.add(pod.metadata.uid or pod.metadata.name)
                     self._dispatch(pod)
-                for uid in self.runtime.list_pods():
-                    if uid not in known_uids:
-                        try:
-                            self.runtime.kill_pod(uid)  # orphan GC
-                            if self.volumes is not None:
-                                self.volumes.teardown_pod_volumes(uid)
-                        except Exception:
-                            pass  # one bad orphan must not stall the tick
-                        self._volumes_mounted.discard(uid)
+                # Orphan GC over the UNION of runtime pods and on-disk
+                # volume dirs: after a kubelet restart the runtime may
+                # have forgotten a pod whose volumes still exist.
+                orphans = set(self.runtime.list_pods())
+                if self.volumes is not None:
+                    orphans.update(self.volumes.list_pod_uids())
+                for uid in orphans - known_uids:
+                    try:
+                        self.runtime.kill_pod(uid)
+                        if self.volumes is not None:
+                            self.volumes.teardown_pod_volumes(uid)
+                    except Exception:
+                        pass  # one bad orphan must not stall the tick
+                    self._volumes_mounted.discard(uid)
                 _PODS_RUNNING.set(len(pods), node=self.node_name)
             except Exception:
                 pass

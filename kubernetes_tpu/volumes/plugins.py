@@ -458,6 +458,15 @@ class VolumePluginManager:
             paths[volume.name] = plugin.new_builder(volume, pod).setup()
         return paths
 
+    def list_pod_uids(self) -> List[str]:
+        """Pod uids that have on-disk volume state (reference: the
+        kubelet's cleanupOrphanedVolumes scans the disk layout — the
+        runtime's memory of pods is not the source of truth for GC)."""
+        pods_dir = os.path.join(self.host.root_dir, "pods")
+        if not os.path.isdir(pods_dir):
+            return []
+        return os.listdir(pods_dir)
+
     def teardown_pod_volumes(self, pod_uid: str) -> None:
         """Tear down everything under the pod's volumes dir (reference:
         kubelet cleanupOrphanedVolumes)."""

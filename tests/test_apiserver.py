@@ -167,10 +167,19 @@ class TestWatch:
 
 
 def test_events_ttl_resource():
+    import time as _time
+
     api = APIServer()
     client = Client(LocalTransport(api))
+    # Recording is async through the broadcaster now: poll for arrival.
     client.record_event(pod_wire("p1"), "Scheduled", "ok", source="test")
-    items, _ = client.list("events", namespace="default")
+    deadline = _time.monotonic() + 5
+    items = []
+    while _time.monotonic() < deadline:
+        items, _ = client.list("events", namespace="default")
+        if items:
+            break
+        _time.sleep(0.02)
     assert len(items) == 1
     assert items[0].reason == "Scheduled"
 

@@ -1,0 +1,111 @@
+"""Event recording tests (reference behaviors: pkg/client/record/
+event_test.go, events_cache.go dedup)."""
+
+import time
+
+from kubernetes_tpu.client.record import EventAggregator, EventBroadcaster
+from kubernetes_tpu.client.rest import Client, LocalTransport
+from kubernetes_tpu.server.api import APIServer
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def mkpod(name="p1"):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "uid": "u1"},
+    }
+
+
+class TestAggregator:
+    def test_first_observation_not_a_repeat(self):
+        agg = EventAggregator()
+        ev = {
+            "metadata": {"name": "e1", "namespace": "default"},
+            "involvedObject": {"kind": "Pod", "name": "p1"},
+            "reason": "Started",
+            "message": "ok",
+            "source": {"component": "kubelet"},
+        }
+        assert agg.observe(ev) is None
+        agg.track(ev)
+        entry = agg.observe(dict(ev, metadata={"name": "e2", "namespace": "default"}))
+        assert entry is not None and entry.count == 2
+        assert entry.name == "e1"  # repeats point at the stored event
+
+    def test_different_message_not_aggregated(self):
+        agg = EventAggregator()
+        ev = {
+            "metadata": {"name": "e1", "namespace": "default"},
+            "involvedObject": {"kind": "Pod", "name": "p1"},
+            "reason": "Failed",
+            "message": "a",
+            "source": {"component": "kubelet"},
+        }
+        agg.track(ev)
+        other = dict(ev, message="b")
+        assert agg.observe(other) is None
+
+
+class TestBroadcasterSink:
+    def setup_method(self):
+        self.api = APIServer()
+        self.client = Client(LocalTransport(self.api))
+
+    def test_dedup_compresses_repeats(self):
+        for _ in range(5):
+            self.client.record_event(mkpod(), "FailedScheduling", "no fit", "scheduler")
+        assert _wait(
+            lambda: any(
+                e.get("count") == 5
+                for e in self.api.list("events", "default")["items"]
+            )
+        )
+        events = self.api.list("events", "default")["items"]
+        assert len(events) == 1  # ONE compressed event, not five
+        ev = events[0]
+        assert ev["reason"] == "FailedScheduling"
+        assert ev["source"]["component"] == "scheduler"
+        assert ev["count"] == 5
+
+    def test_distinct_reasons_separate_events(self):
+        self.client.record_event(mkpod(), "Started", "up", "kubelet")
+        self.client.record_event(mkpod(), "Killing", "down", "kubelet")
+        assert _wait(
+            lambda: len(self.api.list("events", "default")["items"]) == 2
+        )
+
+    def test_eventf_formatting(self):
+        rec = self.client.recorder("scheduler")
+        rec.eventf(mkpod(), "Scheduled", "bound to %s", "node-3")
+        assert _wait(
+            lambda: any(
+                e["message"] == "bound to node-3"
+                for e in self.api.list("events", "default")["items"]
+            )
+        )
+
+    def test_logging_watcher(self):
+        lines = []
+        b = EventBroadcaster().start_logging(lines.append)
+        rec = b.new_recorder("test")
+        rec.event(mkpod(), "Pulled", "image ready")
+        assert _wait(lambda: len(lines) == 1)
+        assert "default/p1 Pulled: image ready" in lines[0]
+        b.shutdown()
+
+    def test_sink_failure_never_raises(self):
+        class BoomTransport(LocalTransport):
+            def request(self, *a, **k):
+                raise RuntimeError("sink down")
+
+        bad = Client(BoomTransport(self.api))
+        bad.record_event(mkpod(), "X", "y", "z")  # must not raise
+        bad.flush_events()
