@@ -8,7 +8,9 @@ Measures the full pipeline: columnar lowering (host) -> upload ->
 jitted sequential-parity solve (device) -> assignment readback.
 Compile time is excluded via a warmup solve on identical shapes.
 
-Env overrides: BENCH_PODS, BENCH_NODES, BENCH_REPEATS.
+Env overrides: BENCH_PODS, BENCH_NODES, BENCH_REPEATS,
+BENCH_MODE=backlog|churn (churn = BASELINE config 5: sustained
+create/delete stream against a device-resident SolverSession).
 """
 
 import json
@@ -19,6 +21,105 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_PODS_PER_SEC = 15.0  # reference bind rate limit ceiling
+
+
+def churn_main() -> None:
+    """BASELINE config 5: 1k pods/s create/delete churn with
+    incremental device updates (no re-lowering the cluster)."""
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    rate = int(os.environ.get("BENCH_CHURN_RATE", "1000"))  # pods/s each way
+    ticks = int(os.environ.get("BENCH_CHURN_TICKS", "10"))
+
+    import random
+
+    from __graft_entry__ import _synthetic_problem  # noqa: F401 (warms imports)
+    from kubernetes_tpu.ops import SolverSession
+    from kubernetes_tpu.models.objects import (
+        Container, Node, NodeCondition, NodeStatus, ObjectMeta, Pod, PodSpec,
+        ResourceRequirements,
+    )
+    from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+
+    rng = random.Random(0)
+    nodes = [
+        Node(
+            metadata=ObjectMeta(name=f"n{j}"),
+            status=NodeStatus(
+                capacity={
+                    "cpu": Quantity.from_milli(rng.choice([8000, 16000, 32000])),
+                    "memory": parse_quantity(f"{rng.choice([16, 32, 64])}Gi"),
+                    "pods": Quantity.from_int(110),
+                },
+                conditions=[NodeCondition(type="Ready", status="True")],
+            ),
+        )
+        for j in range(n_nodes)
+    ]
+
+    def mkpod(i):
+        return Pod(
+            metadata=ObjectMeta(name=f"p{i}", namespace="default"),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        name="c", image="app",
+                        resources=ResourceRequirements(
+                            limits={
+                                "cpu": Quantity.from_milli(
+                                    rng.choice([100, 250, 500])
+                                ),
+                                "memory": parse_quantity(
+                                    f"{rng.choice([64, 128, 256])}Mi"
+                                ),
+                            }
+                        ),
+                    )
+                ]
+            ),
+        )
+
+    session = SolverSession(nodes)
+    # Warm-up tick compiles the solve + scatter executables.
+    counter = 0
+    live = []  # O(1) deletes via swap-with-last (don't time bookkeeping)
+    for _ in range(rate):
+        counter += 1
+        session.add_pending(mkpod(counter))
+    for key, dest in session.solve():
+        if dest is not None:
+            live.append(key)
+
+    t0 = time.perf_counter()
+    scheduled = 0
+    for _ in range(ticks):
+        for _ in range(rate):
+            counter += 1
+            session.add_pending(mkpod(counter))
+        for _ in range(min(rate, len(live))):
+            i = rng.randrange(len(live))
+            live[i], live[-1] = live[-1], live[i]
+            session.delete_assigned(live.pop())
+        for key, dest in session.solve():
+            if dest is not None:
+                live.append(key)
+                scheduled += 1
+    elapsed = time.perf_counter() - t0
+    pods_per_sec = scheduled / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"churn_scheduled_per_sec_{n_nodes}nodes",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 1),
+            }
+        )
+    )
+    print(
+        f"# churn: {ticks} ticks x {rate} create+delete/s, {scheduled} "
+        f"scheduled in {elapsed:.2f}s ({len(live)} live)",
+        file=sys.stderr,
+    )
 
 
 def main() -> None:
@@ -70,4 +171,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODE", "backlog") == "churn":
+        churn_main()
+    else:
+        main()

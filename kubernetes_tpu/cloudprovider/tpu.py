@@ -64,7 +64,8 @@ class TPUCloudProvider(CloudProvider):
 
     def instances(self) -> List[Instance]:
         out = []
-        for pid, devs in sorted(self._hosts().items()):
+        hosts = self._hosts()
+        for pid, devs in sorted(hosts.items()):
             kind = getattr(devs[0], "device_kind", "unknown")
             platform = getattr(devs[0], "platform", "tpu")
             coords = [c for c in (self._coords(d) for d in devs) if c]
@@ -84,7 +85,7 @@ class TPUCloudProvider(CloudProvider):
             out.append(
                 Instance(
                     name=self.host_name(pid),
-                    addresses=("127.0.0.1",) if len(self._hosts()) == 1 else (),
+                    addresses=("127.0.0.1",) if len(hosts) == 1 else (),
                     instance_type=f"{platform}-{len(devs)}x-{str(kind).replace(' ', '-')}",
                     instance_id=f"{self.slice_name}/host-{pid}",
                     labels=tuple(sorted(labels.items())),
@@ -102,10 +103,10 @@ class TPUCloudProvider(CloudProvider):
         return None
 
     def routes(self) -> List[Route]:
-        """ICI connectivity between hosts. With physical coords, hosts
-        whose chip bounding boxes touch are neighbors; otherwise
-        (single-host or CPU fallback) a simple ring over host indices —
-        the wraparound torus links every host has on real slices."""
+        """ICI connectivity between hosts, modeled as a ring over host
+        indices — the wraparound links every host has on real torus
+        slices. (Finer-grained coords-based adjacency would refine
+        this; the ring is what consumers can rely on today.)"""
         hosts = sorted(self._hosts())
         if len(hosts) <= 1:
             return []

@@ -70,14 +70,19 @@ class PersistentVolumeClaimBinder:
         if transitioned:
             volumes, _ = self.client.list("persistentvolumes")
 
-        # Release volumes whose claim vanished.
-        claim_keys = {
-            (c.metadata.namespace, c.metadata.name) for c in claims
+        # Release volumes whose claim vanished — including a claim
+        # deleted and RECREATED under the same name (uid mismatch): the
+        # reservation belonged to the old claim, never the new one.
+        claim_uids = {
+            (c.metadata.namespace, c.metadata.name): c.metadata.uid for c in claims
         }
         for pv in volumes:
             ref = pv.spec.claim_ref
-            if ref is None or (ref.namespace, ref.name) in claim_keys:
+            if ref is None:
                 continue
+            current_uid = claim_uids.get((ref.namespace, ref.name))
+            if current_uid is not None and (not ref.uid or ref.uid == current_uid):
+                continue  # the claim it references still exists
             if pv.status.phase == "Bound":
                 self._release(pv)
             elif pv.status.phase != "Released":

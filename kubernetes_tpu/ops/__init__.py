@@ -8,6 +8,15 @@ jax.sharding.Mesh for multi-chip scale-out.
 """
 
 from kubernetes_tpu.ops.matrices import DeviceSnapshot, device_snapshot
-from kubernetes_tpu.ops.solver import solve, solve_assignments
+from kubernetes_tpu.ops.solver import solve, solve_assignments, solve_with_state
+from kubernetes_tpu.ops.incremental import RebuildRequired, SolverSession
 
-__all__ = ["DeviceSnapshot", "device_snapshot", "solve", "solve_assignments"]
+__all__ = [
+    "DeviceSnapshot",
+    "RebuildRequired",
+    "SolverSession",
+    "device_snapshot",
+    "solve",
+    "solve_assignments",
+    "solve_with_state",
+]

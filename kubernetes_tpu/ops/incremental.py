@@ -1,0 +1,403 @@
+"""Incremental solver session: device-resident cluster state + churn.
+
+BASELINE.md config 5 (50k-pod churn replay at 1k pods/s) cannot afford
+re-lowering and re-uploading the full pod x node problem every tick.
+This session keeps the NODE state (occupancy, bitsets, service counts
+— the big, long-lived half of the problem) resident on the
+accelerator:
+
+- solve() feeds the pending backlog through solve_with_state, whose
+  DONATED node carry becomes the next tick's device state — bindings
+  commit on device with zero host round-trip of node columns;
+- pod deletions touch one node row each: the host mirror recomputes
+  that row (greedy-fit order, reference MapPodsToMachines semantics)
+  and a jitted scatter patches just those rows on device;
+- pending pods are transient per tick and upload as small bucketed
+  arrays (bucket sizes limit XLA recompiles; SURVEY.md hard part (d)).
+
+Vocabularies (labels / hostPorts / volumes) and the service set are
+frozen at session start with headroom; overflow raises RebuildRequired
+and the owner builds a fresh session (cheap resync — the host store
+stays the source of truth, SURVEY.md §5 checkpoint model).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.columnar import (
+    MIB,
+    Vocab,
+    bitset,
+    mem_to_mib_ceil,
+    node_is_ready,
+    pod_host_ports,
+    pod_key,
+    pod_resource_limits,
+    pod_volumes,
+)
+from kubernetes_tpu.models.objects import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    Node,
+    Pod,
+    Service,
+)
+from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
+
+
+class RebuildRequired(Exception):
+    """Capacity (vocab words / node slots / services) exhausted — build
+    a fresh session from the authoritative host store."""
+
+
+def _bucket(n: int, minimum: int = 128) -> int:
+    """Next power-of-two bucket >= n (>= minimum)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.partial(jax.jit, donate_argnames=("nodes",))
+def _scatter_rows(nodes: Dict[str, jnp.ndarray], idx: jnp.ndarray, rows: Dict):
+    return {k: nodes[k].at[idx].set(rows[k]) for k in nodes}
+
+
+@dataclass
+class _LoweredPod:
+    """Host-side lowered pod row (everything solve() needs)."""
+
+    key: str
+    cpu: float
+    mem_mib: float
+    zero_req: bool
+    sel_ids: List[int]
+    port_ids: List[int]
+    vol_any_ids: List[int]
+    vol_rw_ids: List[int]
+    # Pinned NODE NAME ("" = unpinned): resolved to a slot index at
+    # solve() time — slot indices are recycled across node churn, so an
+    # index resolved at add time could point at a different node.
+    pinned_name: str
+    svc_member: np.ndarray  # f32[S_cap]
+    svc: int
+
+
+class SolverSession:
+    """Long-lived incremental scheduling session over one cluster."""
+
+    def __init__(
+        self,
+        nodes: Sequence[Node],
+        services: Sequence[Service] = (),
+        assigned: Sequence[Pod] = (),
+        label_words: int = 4,
+        port_words: int = 4,
+        vol_words: int = 4,
+        node_capacity: int = 0,
+        weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+        mesh=None,
+    ):
+        nodes = list(nodes)
+        self.services = list(services)
+        self.weights = tuple(weights)
+        self.mesh = mesh
+        self.LW, self.PW, self.VW = label_words, port_words, vol_words
+        self.S = max(1, len(self.services))
+        self.N_cap = _bucket(max(node_capacity, len(nodes), 1))
+        self.label_vocab, self.port_vocab, self.vol_vocab = Vocab(), Vocab(), Vocab()
+
+        self.node_names: List[Optional[str]] = [None] * self.N_cap
+        self.node_index: Dict[str, int] = {}
+        # Assigned pods per node slot, in arrival order (greedy-fit
+        # recompute on delete follows this order, as the reference's
+        # MapPodsToMachines list order does).
+        self._assigned: List[List[_LoweredPod]] = [[] for _ in range(self.N_cap)]
+        self._pod_node: Dict[str, int] = {}
+        self._node_specs: List[Optional[Node]] = [None] * self.N_cap
+
+        self.h = self._empty_node_columns()
+        for node in nodes:
+            self._admit_node(node)
+        for pod in assigned:
+            lp = self._lower_pod(pod)
+            j = self.node_index.get(pod.spec.node_name)
+            if j is None:
+                continue
+            self._assigned[j].append(lp)
+            self._pod_node[lp.key] = j
+        for j in range(self.N_cap):
+            if self.node_names[j] is not None:
+                self._recompute_node_row(j)
+
+        self._pending: List[_LoweredPod] = []
+        self.dev = self._upload_all()
+        self._dirty: set = set()
+
+    # -- lowering -----------------------------------------------------
+
+    def _vocab_id(self, vocab: Vocab, words: int, token: str) -> int:
+        i = vocab.id(token)
+        if i >= words * 32:
+            raise RebuildRequired(f"vocab overflow: {token!r}")
+        return i
+
+    def _lower_pod(self, pod: Pod) -> _LoweredPod:
+        cpu, mem = pod_resource_limits(pod)
+        sel_ids = [
+            self._vocab_id(self.label_vocab, self.LW, f"{k}={v}")
+            for k, v in sorted((pod.spec.node_selector or {}).items())
+        ]
+        port_ids = [
+            self._vocab_id(self.port_vocab, self.PW, str(p))
+            for p in pod_host_ports(pod)
+        ]
+        vols = pod_volumes(pod)
+        vol_any = [self._vocab_id(self.vol_vocab, self.VW, v) for v, _ in vols]
+        vol_rw = [self._vocab_id(self.vol_vocab, self.VW, v) for v, rw in vols if rw]
+        member = np.zeros(self.S, dtype=np.float32)
+        labels = pod.metadata.labels or {}
+        first = -1
+        for s, svc in enumerate(self.services):
+            sel = svc.spec.selector
+            if not sel or svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            if all(labels.get(k) == v for k, v in sel.items()):
+                member[s] = 1.0
+                if first < 0:
+                    first = s
+        return _LoweredPod(
+            key=pod_key(pod),
+            cpu=float(cpu),
+            mem_mib=float(mem_to_mib_ceil(mem)),
+            zero_req=(cpu == 0 and mem == 0),
+            sel_ids=sel_ids,
+            port_ids=port_ids,
+            vol_any_ids=vol_any,
+            vol_rw_ids=vol_rw,
+            pinned_name=pod.spec.node_name or "",
+            svc_member=member,
+            svc=first,
+        )
+
+    # -- node columns (host mirror) -----------------------------------
+
+    def _empty_node_columns(self) -> Dict[str, np.ndarray]:
+        N = self.N_cap
+        return {
+            "cpu_cap": np.zeros(N, np.float32),
+            "mem_cap": np.zeros(N, np.float32),
+            "pods_cap": np.zeros(N, np.float32),
+            "cpu_fit": np.zeros(N, np.float32),
+            "mem_fit": np.zeros(N, np.float32),
+            "over": np.zeros(N, bool),
+            "cpu_used": np.zeros(N, np.float32),
+            "mem_used": np.zeros(N, np.float32),
+            "pods_used": np.zeros(N, np.float32),
+            "labels": np.zeros((N, self.LW), np.uint32),
+            "uport": np.zeros((N, self.PW), np.uint32),
+            "uvol_any": np.zeros((N, self.VW), np.uint32),
+            "uvol_rw": np.zeros((N, self.VW), np.uint32),
+            "svc_counts": np.zeros((N, self.S), np.float32),
+            "sched": np.zeros(N, bool),
+        }
+
+    def _admit_node(self, node: Node) -> int:
+        name = node.metadata.name
+        j = self.node_index.get(name)
+        if j is None:
+            try:
+                j = self.node_names.index(None)
+            except ValueError:
+                raise RebuildRequired("node slots exhausted")
+            self.node_names[j] = name
+            self.node_index[name] = j
+        self._node_specs[j] = node
+        return j
+
+    def _recompute_node_row(self, j: int) -> None:
+        """Rebuild slot j's full row from spec + assigned pods (the
+        only non-monotonic operation: deletes can't be expressed as
+        bitset increments)."""
+        node = self._node_specs[j]
+        h = self.h
+        for k in h:
+            h[k][j] = 0
+        if node is None:
+            return
+        cap = node.status.capacity or {}
+        if RESOURCE_CPU in cap:
+            h["cpu_cap"][j] = cap[RESOURCE_CPU].milli_value()
+        if RESOURCE_MEMORY in cap:
+            h["mem_cap"][j] = cap[RESOURCE_MEMORY].value() // MIB
+        if RESOURCE_PODS in cap:
+            h["pods_cap"][j] = cap[RESOURCE_PODS].value()
+        h["labels"][j] = bitset(
+            [
+                self._vocab_id(self.label_vocab, self.LW, f"{k}={v}")
+                for k, v in (node.metadata.labels or {}).items()
+            ],
+            self.LW,
+        )
+        h["sched"][j] = node_is_ready(node)
+        for lp in self._assigned[j]:
+            # Greedy-fit order = arrival order (reference semantics).
+            fits_cpu = h["cpu_cap"][j] == 0 or (
+                h["cpu_fit"][j] + lp.cpu <= h["cpu_cap"][j]
+            )
+            fits_mem = h["mem_cap"][j] == 0 or (
+                h["mem_fit"][j] + lp.mem_mib <= h["mem_cap"][j]
+            )
+            if fits_cpu and fits_mem:
+                h["cpu_fit"][j] += lp.cpu
+                h["mem_fit"][j] += lp.mem_mib
+            else:
+                h["over"][j] = True
+            h["cpu_used"][j] += lp.cpu
+            h["mem_used"][j] += lp.mem_mib
+            h["pods_used"][j] += 1
+            h["uport"][j] |= bitset(lp.port_ids, self.PW)
+            h["uvol_any"][j] |= bitset(lp.vol_any_ids, self.VW)
+            h["uvol_rw"][j] |= bitset(lp.vol_rw_ids, self.VW)
+            h["svc_counts"][j] += lp.svc_member
+
+    def _apply_commit_host(self, j: int, lp: _LoweredPod) -> None:
+        """Mirror of solver._commit — keeps host state bit-identical to
+        the device carry for nodes untouched by deletes."""
+        h = self.h
+        h["cpu_fit"][j] += lp.cpu
+        h["mem_fit"][j] += lp.mem_mib
+        h["cpu_used"][j] += lp.cpu
+        h["mem_used"][j] += lp.mem_mib
+        h["pods_used"][j] += 1
+        h["uport"][j] |= bitset(lp.port_ids, self.PW)
+        h["uvol_any"][j] |= bitset(lp.vol_any_ids, self.VW)
+        h["uvol_rw"][j] |= bitset(lp.vol_rw_ids, self.VW)
+        h["svc_counts"][j] += lp.svc_member
+
+    # -- device transfer ----------------------------------------------
+
+    def _upload_all(self) -> Dict[str, jnp.ndarray]:
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            sharding = NamedSharding(self.mesh, PS("nodes"))
+            return {k: jax.device_put(v, sharding) for k, v in self.h.items()}
+        return {k: jnp.asarray(v) for k, v in self.h.items()}
+
+    def _flush_dirty(self) -> None:
+        if not self._dirty:
+            return
+        idx = sorted(self._dirty)
+        self._dirty.clear()
+        # Bucket the scatter width: pad by repeating the last index
+        # (identical row, harmless duplicate) so recompiles are rare.
+        width = _bucket(len(idx), minimum=8)
+        padded = idx + [idx[-1]] * (width - len(idx))
+        rows = {k: self.h[k][padded] for k in self.h}
+        self.dev = _scatter_rows(
+            self.dev, jnp.asarray(padded, dtype=jnp.int32), rows
+        )
+
+    # -- public API ---------------------------------------------------
+
+    def add_pending(self, pod: Pod) -> None:
+        self._pending.append(self._lower_pod(pod))
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def upsert_node(self, node: Node) -> None:
+        j = self._admit_node(node)
+        self._recompute_node_row(j)
+        self._dirty.add(j)
+
+    def remove_node(self, name: str) -> None:
+        j = self.node_index.pop(name, None)
+        if j is None:
+            return
+        self.node_names[j] = None
+        self._node_specs[j] = None
+        for lp in self._assigned[j]:
+            self._pod_node.pop(lp.key, None)
+        self._assigned[j] = []
+        self._recompute_node_row(j)  # zeroes the row; sched stays False
+        self._dirty.add(j)
+
+    def delete_assigned(self, key: str) -> bool:
+        """A running pod vanished: free its occupancy (one node row)."""
+        j = self._pod_node.pop(key, None)
+        if j is None:
+            return False
+        self._assigned[j] = [lp for lp in self._assigned[j] if lp.key != key]
+        self._recompute_node_row(j)
+        self._dirty.add(j)
+        return True
+
+    def solve(self) -> List[Tuple[str, Optional[str]]]:
+        """Schedule the pending backlog against the device-resident
+        cluster state; commits ride the donated carry. Returns
+        [(pod_key, node_name | None)] and clears the backlog."""
+        pending, self._pending = self._pending, []
+        if not pending:
+            self._flush_dirty()
+            return []
+        self._flush_dirty()
+        pods = self._pod_arrays(pending)
+        assignment, self.dev = solve_with_state(pods, self.dev, self.weights)
+        out: List[Tuple[str, Optional[str]]] = []
+        picks = np.asarray(assignment)[: len(pending)]
+        for lp, j in zip(pending, picks.tolist()):
+            if j < 0 or j >= self.N_cap or self.node_names[j] is None:
+                out.append((lp.key, None))
+                continue
+            self._assigned[j].append(lp)
+            self._pod_node[lp.key] = j
+            self._apply_commit_host(j, lp)
+            out.append((lp.key, self.node_names[j]))
+        return out
+
+    def _pod_arrays(self, pending: List[_LoweredPod]) -> Dict[str, jnp.ndarray]:
+        P = len(pending)
+        PP = _bucket(P)
+        arr = {
+            "cpu": np.zeros(PP, np.float32),
+            "mem": np.zeros(PP, np.float32),
+            "zero_req": np.zeros(PP, bool),
+            "sel": np.zeros((PP, self.LW), np.uint32),
+            "port": np.zeros((PP, self.PW), np.uint32),
+            "vol_any": np.zeros((PP, self.VW), np.uint32),
+            "vol_rw": np.zeros((PP, self.VW), np.uint32),
+            # Padding slots pinned to -2: never placeable.
+            "pinned": np.full(PP, -2, np.int32),
+            "svc": np.full(PP, -1, np.int32),
+            "svc_member": np.zeros((PP, self.S), np.float32),
+        }
+        for i, lp in enumerate(pending):
+            arr["cpu"][i] = lp.cpu
+            arr["mem"][i] = lp.mem_mib
+            arr["zero_req"][i] = lp.zero_req
+            arr["sel"][i] = bitset(lp.sel_ids, self.LW)
+            arr["port"][i] = bitset(lp.port_ids, self.PW)
+            arr["vol_any"][i] = bitset(lp.vol_any_ids, self.VW)
+            arr["vol_rw"][i] = bitset(lp.vol_rw_ids, self.VW)
+            if lp.pinned_name:
+                arr["pinned"][i] = self.node_index.get(lp.pinned_name, -2)
+            else:
+                arr["pinned"][i] = -1
+            arr["svc"][i] = lp.svc
+            arr["svc_member"][i] = lp.svc_member
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            repl = NamedSharding(self.mesh, PS())
+            return {k: jax.device_put(v, repl) for k, v in arr.items()}
+        return {k: jnp.asarray(v) for k, v in arr.items()}

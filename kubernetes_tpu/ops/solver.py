@@ -146,14 +146,7 @@ def _commit(nodes: Dict, pod: Dict, choice: jnp.ndarray, N: int) -> Dict:
     return new
 
 
-@functools.partial(jax.jit, static_argnames=("weights",))
-def solve(
-    pods: Dict[str, jnp.ndarray],
-    nodes: Dict[str, jnp.ndarray],
-    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
-) -> jnp.ndarray:
-    """Sequential-parity assignment: i32[P] of node indices (-1 =
-    unschedulable). The scan IS the reference's scheduleOne loop."""
+def _scan_solve(pods, nodes, weights):
     N = nodes["cpu_cap"].shape[0]
 
     def step(carry, pod):
@@ -164,8 +157,35 @@ def solve(
         choice = jnp.where(jnp.any(feas), best.astype(jnp.int32), -1)
         return _commit(carry, pod, choice, N), choice
 
-    _, assignment = jax.lax.scan(step, nodes, pods)
+    return jax.lax.scan(step, nodes, pods)
+
+
+@functools.partial(jax.jit, static_argnames=("weights",))
+def solve(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+) -> jnp.ndarray:
+    """Sequential-parity assignment: i32[P] of node indices (-1 =
+    unschedulable). The scan IS the reference's scheduleOne loop."""
+    _, assignment = _scan_solve(pods, nodes, weights)
     return assignment
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights",), donate_argnames=("nodes",)
+)
+def solve_with_state(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Like solve, but also returns the post-commit occupancy carry.
+    `nodes` is DONATED: the caller's buffers are consumed and the
+    returned state aliases them — the substrate for incremental churn
+    (SolverSession keeps this state device-resident across ticks)."""
+    final, assignment = _scan_solve(pods, nodes, weights)
+    return assignment, final
 
 
 def solve_assignments(

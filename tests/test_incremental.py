@@ -1,0 +1,205 @@
+"""Incremental solver session tests: the device-resident state after
+adds/deletes/binds must make the SAME decisions a fresh full solve
+makes from the authoritative object state (BASELINE config 5
+substrate)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.models.objects import (
+    Container,
+    ContainerPort,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    Service,
+    ServiceSpec,
+)
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+from kubernetes_tpu.ops import RebuildRequired, SolverSession
+from kubernetes_tpu.scheduler.batch import schedule_backlog_scalar
+
+
+def mknode(name, cpu_milli=4000, mem="8Gi", pods=110, labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(
+            capacity={
+                "cpu": Quantity.from_milli(cpu_milli),
+                "memory": parse_quantity(mem),
+                "pods": Quantity.from_int(pods),
+            },
+            conditions=[NodeCondition(type="Ready", status="True")],
+        ),
+    )
+
+
+def mkpod(name, cpu=100, mem="128Mi", labels=None, node_selector=None,
+          host_port=0, node_name=""):
+    ports = [ContainerPort(container_port=80, host_port=host_port)] if host_port else []
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default", labels=labels or {}),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="c", image="i", ports=ports,
+                    resources=ResourceRequirements(
+                        limits={
+                            "cpu": Quantity.from_milli(cpu),
+                            "memory": parse_quantity(mem),
+                        }
+                    ),
+                )
+            ],
+            node_selector=node_selector or {},
+            node_name=node_name,
+        ),
+    )
+
+
+class TestSessionBasics:
+    def test_single_tick_matches_scalar_oracle(self):
+        nodes = [mknode(f"n{i}", cpu_milli=2000) for i in range(4)]
+        pods = [mkpod(f"p{i}", cpu=500) for i in range(10)]
+        session = SolverSession(nodes)
+        for p in pods:
+            session.add_pending(p)
+        got = dict(session.solve())
+        want = dict(
+            zip(
+                [f"default/p{i}" for i in range(10)],
+                schedule_backlog_scalar(pods, nodes),
+            )
+        )
+        assert got == want  # 4 nodes x 4 cpu slots = 16 >= 10 placed
+
+    def test_capacity_spills_to_unschedulable(self):
+        session = SolverSession([mknode("n0", cpu_milli=1000)])
+        for i in range(3):
+            session.add_pending(mkpod(f"p{i}", cpu=500))
+        result = dict(session.solve())
+        placed = [k for k, v in result.items() if v]
+        assert len(placed) == 2  # 1000m / 500m
+        assert result["default/p2"] is None
+
+    def test_occupancy_carries_across_ticks(self):
+        session = SolverSession([mknode("n0", cpu_milli=1000)])
+        session.add_pending(mkpod("a", cpu=600))
+        assert dict(session.solve()) == {"default/a": "n0"}
+        session.add_pending(mkpod("b", cpu=600))
+        # 600m already committed on device: b can't fit.
+        assert dict(session.solve()) == {"default/b": None}
+
+    def test_delete_frees_occupancy(self):
+        session = SolverSession([mknode("n0", cpu_milli=1000)])
+        session.add_pending(mkpod("a", cpu=600))
+        session.solve()
+        assert session.delete_assigned("default/a")
+        session.add_pending(mkpod("b", cpu=600))
+        assert dict(session.solve()) == {"default/b": "n0"}
+
+    def test_delete_frees_host_port(self):
+        session = SolverSession([mknode("n0")])
+        session.add_pending(mkpod("a", host_port=8080))
+        session.solve()
+        session.add_pending(mkpod("b", host_port=8080))
+        assert dict(session.solve()) == {"default/b": None}  # conflict
+        session.delete_assigned("default/a")
+        session.add_pending(mkpod("c", host_port=8080))
+        assert dict(session.solve()) == {"default/c": "n0"}
+
+    def test_node_upsert_and_remove(self):
+        session = SolverSession([mknode("n0", cpu_milli=100)], node_capacity=8)
+        session.add_pending(mkpod("a", cpu=500))
+        assert dict(session.solve()) == {"default/a": None}
+        session.upsert_node(mknode("n1", cpu_milli=4000))
+        session.add_pending(mkpod("b", cpu=500))
+        assert dict(session.solve()) == {"default/b": "n1"}
+        session.remove_node("n1")
+        session.add_pending(mkpod("c", cpu=500))
+        assert dict(session.solve()) == {"default/c": None}
+
+    def test_pinned_pod_survives_slot_recycling(self):
+        """A pod pinned to node A must NOT land on node B when B
+        recycles A's slot between add_pending and solve."""
+        session = SolverSession([mknode("n0"), mknode("A")], node_capacity=2)
+        session.add_pending(mkpod("p", node_name="A"))
+        session.remove_node("A")
+        session.upsert_node(mknode("B"))  # reuses A's slot
+        assert dict(session.solve()) == {"default/p": None}
+        # And a pin added BEFORE the node registers resolves at solve.
+        session.add_pending(mkpod("q", node_name="C"))
+        session.upsert_node(mknode("C"))
+        assert dict(session.solve()) == {"default/q": "C"}
+
+    def test_vocab_overflow_raises(self):
+        session = SolverSession([mknode("n0")], label_words=1)
+        with pytest.raises(RebuildRequired):
+            for i in range(40):  # 1 word = 32 label ids
+                session.add_pending(
+                    mkpod(f"p{i}", node_selector={f"k{i}": "v"})
+                )
+
+
+class TestChurnParity:
+    def test_churn_replay_matches_fresh_solves(self):
+        """Random create/delete churn: after every tick, the session's
+        decisions equal a fresh scalar solve from the surviving object
+        state."""
+        rng = random.Random(7)
+        nodes = [
+            mknode(f"n{i}", cpu_milli=rng.choice([2000, 4000]),
+                   labels={"zone": f"z{i % 2}"})
+            for i in range(6)
+        ]
+        services = [
+            Service(
+                metadata=ObjectMeta(name="svc", namespace="default"),
+                spec=ServiceSpec(selector={"app": "a"}),
+            )
+        ]
+        session = SolverSession(nodes, services=services)
+        live = {}  # key -> (pod, node_name)
+        counter = 0
+        for tick in range(6):
+            batch = []
+            for _ in range(rng.randrange(2, 6)):
+                counter += 1
+                pod = mkpod(
+                    f"p{counter}",
+                    cpu=rng.choice([200, 400, 800]),
+                    labels={"app": "a"} if rng.random() < 0.5 else {},
+                    node_selector={"zone": "z0"} if rng.random() < 0.3 else {},
+                )
+                batch.append(pod)
+                session.add_pending(pod)
+            # Random deletes of running pods.
+            for key in rng.sample(sorted(live), min(2, len(live))):
+                session.delete_assigned(key)
+                del live[key]
+            results = dict(session.solve())
+            # Oracle: fresh scalar solve on the same object state.
+            assigned_objs = []
+            for key, (pod, node_name) in live.items():
+                import copy
+
+                placed = copy.deepcopy(pod)
+                placed.spec.node_name = node_name
+                placed.status.phase = "Running"
+                assigned_objs.append(placed)
+            want = schedule_backlog_scalar(
+                batch, nodes, assigned=assigned_objs, services=services
+            )
+            for pod, expect in zip(batch, want):
+                key = f"default/{pod.metadata.name}"
+                assert results[key] == expect, (
+                    f"tick {tick}: {key} -> {results[key]} want {expect}"
+                )
+                if results[key] is not None:
+                    live[key] = (pod, results[key])

@@ -270,6 +270,38 @@ class TestPersistentClaim:
             mgr.mount_pod_volumes(pod)
 
 
+class TestOrphanDiskGC:
+    def test_restart_orphans_swept_from_disk(self, tmp_path):
+        """Volume dirs for pods the RUNTIME has forgotten (kubelet
+        restart) must still be GC'd: the orphan sweep unions runtime
+        pods with on-disk volume state."""
+        import time
+
+        from kubernetes_tpu.kubelet.agent import Kubelet
+        from kubernetes_tpu.models.objects import EmptyDirVolumeSource
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        # Simulate a pre-restart leftover: volumes on disk, no runtime
+        # record, no apiserver pod.
+        h = VolumeHost(root_dir=str(tmp_path), client=client)
+        mgr = VolumePluginManager(h)
+        ghost = mkpod(name="ghost", uid="ghost-uid",
+                      volumes=[Volume(name="s", empty_dir=EmptyDirVolumeSource())])
+        mgr.mount_pod_volumes(ghost)
+        leftover = os.path.join(str(tmp_path), "pods", "ghost-uid")
+        assert os.path.isdir(leftover)
+        kubelet = Kubelet(client, "n1", root_dir=str(tmp_path),
+                          heartbeat_period=0.5, sync_period=0.1).start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and os.path.exists(leftover):
+                time.sleep(0.05)
+            assert not os.path.exists(leftover)
+        finally:
+            kubelet.stop()
+
+
 class TestKubeletIntegration:
     def test_volumes_mounted_and_cleaned(self, tmp_path):
         import time
