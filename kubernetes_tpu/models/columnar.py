@@ -232,29 +232,65 @@ def node_is_ready(node: Node) -> bool:
     return True
 
 
+class ServiceMatcher:
+    """Inverted index over service selectors: pod -> multi-hot
+    membership in O(pod labels), not O(services).
+
+    Semantics identical to the naive scan: a pod matches a service iff
+    they share a namespace, the selector is non-empty, and every
+    selector pair appears in the pod's labels. The pending pod spreads
+    against its FIRST match (GetPodServices / spreading.go:44-56), but
+    as an *existing* pod it is counted by every matching service
+    (pod_lister.list(selector) in CalculateSpreadPriority). At 50k
+    pods x 500 services the naive scan is 25M dict compares — the
+    dominant host cost of snapshot lowering.
+    """
+
+    def __init__(self, services: List[Service]):
+        self.S = len(services)
+        self.out_width = max(self.S, 1)
+        # namespace -> ((k,v) -> np.array of service indices)
+        self._pair_index: Dict[str, Dict[Tuple[str, str], np.ndarray]] = {}
+        self._sel_size = np.zeros(max(self.S, 1), dtype=np.int32)
+        by_ns: Dict[str, Dict[Tuple[str, str], List[int]]] = {}
+        for i, svc in enumerate(services):
+            sel = svc.spec.selector
+            if not sel:
+                continue  # selector-less services never match
+            self._sel_size[i] = len(sel)
+            ns_idx = by_ns.setdefault(svc.metadata.namespace, {})
+            for pair in sel.items():
+                ns_idx.setdefault(pair, []).append(i)
+        for ns, idx in by_ns.items():
+            self._pair_index[ns] = {
+                pair: np.asarray(ids, dtype=np.int64) for pair, ids in idx.items()
+            }
+
+    def membership(self, pod: Pod) -> np.ndarray:
+        """Multi-hot f32[max(S,1)]."""
+        out = np.zeros(self.out_width, dtype=np.float32)
+        idx = self._pair_index.get(pod.metadata.namespace)
+        labels = pod.metadata.labels
+        if not idx or not labels:
+            return out
+        counts = np.zeros(self.out_width, dtype=np.int32)
+        for pair in labels.items():
+            ids = idx.get(pair)
+            if ids is not None:
+                counts[ids] += 1
+        matched = (counts == self._sel_size) & (self._sel_size > 0)
+        out[: len(matched)] = matched
+        return out
+
+    def first_match(self, member: np.ndarray) -> int:
+        nz = np.nonzero(member[: self.S])[0]
+        return int(nz[0]) if len(nz) else -1
+
+
 def _service_membership(pod: Pod, services: List[Service]) -> np.ndarray:
-    """Multi-hot f32[S]: which same-namespace service selectors match
-    the pod's labels. The pending pod spreads against its FIRST match
-    (GetPodServices / spreading.go:44-56), but as an *existing* pod it
-    is counted by every service whose selector matches it
-    (pod_lister.list(selector) in CalculateSpreadPriority)."""
-    out = np.zeros(max(len(services), 1), dtype=np.float32)
-    labels = pod.metadata.labels or {}
-    for i, svc in enumerate(services):
-        sel = svc.spec.selector
-        if not sel:
-            continue
-        if svc.metadata.namespace != pod.metadata.namespace:
-            continue
-        if all(labels.get(k) == v for k, v in sel.items()):
-            out[i] = 1.0
-    return out
-
-
-def _first_matching_service(pod: Pod, services: List[Service]) -> int:
-    member = _service_membership(pod, services)
-    nz = np.nonzero(member[: len(services)])[0]
-    return int(nz[0]) if len(nz) else -1
+    """One-shot convenience wrapper (tests); bulk callers build one
+    ServiceMatcher and reuse it."""
+    return ServiceMatcher(services).membership(pod)
 
 
 def build_snapshot(
@@ -282,6 +318,7 @@ def build_snapshot(
     ]
     node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
     N, P, S = len(nodes), len(pending_pods), len(services)
+    matcher = ServiceMatcher(services)
 
     label_vocab, port_vocab, vol_vocab = Vocab(), Vocab(), Vocab()
 
@@ -309,30 +346,35 @@ def build_snapshot(
 
     LW, PW, VW = label_vocab.words, port_vocab.words, vol_vocab.words
 
-    # -- pod columns --
+    # -- pod columns -- (bitset packing batched through the native
+    # kernels, kubernetes_tpu.native; NumPy fallback inside)
+    from kubernetes_tpu import native
+
     cpu_req = np.zeros(P, dtype=np.float32)
     mem_req = np.zeros(P, dtype=np.float32)
     zero_req = np.zeros(P, dtype=bool)
-    port_bits = np.zeros((P, PW), dtype=np.uint32)
-    vol_any = np.zeros((P, VW), dtype=np.uint32)
-    vol_rw = np.zeros((P, VW), dtype=np.uint32)
     pinned = np.full(P, -1, dtype=np.int32)
     service_id = np.full(P, -1, dtype=np.int32)
     svc_member = np.zeros((P, max(S, 1)), dtype=np.float32)
+    port_id_lists: List[List[int]] = []
+    vol_any_lists: List[List[int]] = []
+    vol_rw_lists: List[List[int]] = []
     for i, p in enumerate(pending_pods):
         cpu, mem = pod_resource_limits(p)
         cpu_req[i] = cpu
         mem_req[i] = mem_to_mib_ceil(mem)
         zero_req[i] = cpu == 0 and mem == 0
-        port_bits[i] = bitset([port_vocab.id(str(x)) for x in pod_host_ports(p)], PW)
+        port_id_lists.append([port_vocab.id(str(x)) for x in pod_host_ports(p)])
         vols = pod_volumes(p)
-        vol_any[i] = bitset([vol_vocab.id(v) for v, _ in vols], VW)
-        vol_rw[i] = bitset([vol_vocab.id(v) for v, rw in vols if rw], VW)
+        vol_any_lists.append([vol_vocab.id(v) for v, _ in vols])
+        vol_rw_lists.append([vol_vocab.id(v) for v, rw in vols if rw])
         if p.spec.node_name:
             pinned[i] = node_index.get(p.spec.node_name, -2)
-        svc_member[i] = _service_membership(p, services)
-        nz = np.nonzero(svc_member[i][:S])[0]
-        service_id[i] = int(nz[0]) if len(nz) else -1
+        svc_member[i] = matcher.membership(p)
+        service_id[i] = matcher.first_match(svc_member[i])
+    port_bits = native.pack_bitsets(port_id_lists, PW)
+    vol_any = native.pack_bitsets(vol_any_lists, VW)
+    vol_rw = native.pack_bitsets(vol_rw_lists, VW)
 
     sel_bits = np.zeros((len(sel_keys), LW), dtype=np.uint32)
     for sel, row in sel_keys.items():
@@ -370,30 +412,39 @@ def build_snapshot(
         )
         schedulable[j] = node_is_ready(n)
 
-    for p in assigned_pods:
+    # Assigned-pod occupancy sweep through the native kernels
+    # (MapPodsToMachines greedy order = list order).
+    A = len(assigned_pods)
+    a_idx = np.full(A, -1, dtype=np.int32)
+    a_cpu = np.zeros(A, dtype=np.float32)
+    a_mem = np.zeros(A, dtype=np.float32)
+    a_port_lists: List[List[int]] = []
+    a_vol_any_lists: List[List[int]] = []
+    a_vol_rw_lists: List[List[int]] = []
+    for i, p in enumerate(assigned_pods):
         j = node_index.get(p.spec.node_name)
-        if j is None:
-            continue
+        a_idx[i] = -1 if j is None else j
         cpu, mem = pod_resource_limits(p)
-        mem_mib = mem_to_mib_ceil(mem)
-        # Scoring-side: full sums + pod count.
-        cpu_used[j] += cpu
-        mem_used[j] += mem_mib
-        pods_used[j] += 1
-        # Feasibility-side: greedy simulation in list order.
-        fits_cpu = cpu_cap[j] == 0 or cpu_fit_used[j] + cpu <= cpu_cap[j]
-        fits_mem = mem_cap[j] == 0 or mem_fit_used[j] + mem_mib <= mem_cap[j]
-        if fits_cpu and fits_mem:
-            cpu_fit_used[j] += cpu
-            mem_fit_used[j] += mem_mib
-        else:
-            overcommitted[j] = True
-        used_port_bits[j] |= bitset(
-            [port_vocab.id(str(x)) for x in pod_host_ports(p)], PW
-        )
+        a_cpu[i] = cpu
+        a_mem[i] = mem_to_mib_ceil(mem)
+        a_port_lists.append([port_vocab.id(str(x)) for x in pod_host_ports(p)])
         vols = pod_volumes(p)
-        used_vol_any[j] |= bitset([vol_vocab.id(v) for v, _ in vols], VW)
-        used_vol_rw[j] |= bitset([vol_vocab.id(v) for v, rw in vols if rw], VW)
+        a_vol_any_lists.append([vol_vocab.id(v) for v, _ in vols])
+        a_vol_rw_lists.append([vol_vocab.id(v) for v, rw in vols if rw])
+    native.greedy_fit(
+        a_idx, a_cpu, a_mem, cpu_cap, mem_cap,
+        cpu_fit_used, mem_fit_used, overcommitted, cpu_used, mem_used,
+        pods_used,
+    )
+    native.or_rows_by_index(
+        a_idx, native.pack_bitsets(a_port_lists, PW), used_port_bits
+    )
+    native.or_rows_by_index(
+        a_idx, native.pack_bitsets(a_vol_any_lists, VW), used_vol_any
+    )
+    native.or_rows_by_index(
+        a_idx, native.pack_bitsets(a_vol_rw_lists, VW), used_vol_rw
+    )
 
     # Spreading counts: every pod (phase-unfiltered) contributes to
     # every service whose selector matches its labels.
@@ -401,7 +452,7 @@ def build_snapshot(
         j = node_index.get(p.spec.node_name)
         if j is None:
             continue
-        service_counts[j] += _service_membership(p, services)
+        service_counts[j] += matcher.membership(p)
 
     return Snapshot(
         pods=PodColumns(

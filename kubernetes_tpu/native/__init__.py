@@ -1,0 +1,169 @@
+"""ctypes binding for the native columnar kernels (native/columnar.cc).
+
+Loads native/build/libkubetpu.so when present (built via `make -C
+native`); every entry point has a NumPy fallback so the framework is
+fully functional without the native build — the lib just makes 50k-pod
+host lowering cheaper.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libkubetpu.so")
+_PAUSE_PATH = os.path.join(_REPO_ROOT, "native", "build", "pause")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_i64 = ctypes.c_int64
+_p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_p_u32 = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.pack_bitsets.argtypes = [_i64, _i64, _p_i64, _p_i32, _p_u32]
+        lib.or_rows_by_index.argtypes = [_i64, _i64, _p_i32, _p_u32, _p_u32]
+        lib.greedy_fit.argtypes = [
+            _i64, _p_i32, _p_f32, _p_f32, _p_f32, _p_f32,
+            _p_f32, _p_f32, _p_u8, _p_f32, _p_f32, _p_f32,
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build the native lib if the toolchain is around (best-effort)."""
+    if available():
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.join(_REPO_ROOT, "native"), "lib"],
+            check=True, capture_output=quiet,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    global _load_attempted
+    _load_attempted = False
+    return available()
+
+
+def pause_binary() -> Optional[str]:
+    """Path to the pod-anchor binary (None if not built)."""
+    return _PAUSE_PATH if os.path.exists(_PAUSE_PATH) else None
+
+
+# ---------------------------------------------------------------------------
+# Kernels (native with NumPy fallback)
+# ---------------------------------------------------------------------------
+
+
+def pack_bitsets(
+    id_lists: Sequence[Sequence[int]], words: int
+) -> np.ndarray:
+    """Rows of ids -> u32[n_rows, words] bitsets."""
+    n = len(id_lists)
+    out = np.zeros((n, words), dtype=np.uint32)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is not None:
+        counts = np.fromiter(
+            (len(ids) for ids in id_lists), dtype=np.int64, count=n
+        )
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        flat = np.fromiter(
+            (i for ids in id_lists for i in ids),
+            dtype=np.int32,
+            count=int(offsets[-1]),
+        )
+        lib.pack_bitsets(n, words, offsets, flat, out)
+        return out
+    for i, ids in enumerate(id_lists):
+        row = out[i]
+        for j in ids:
+            row[j >> 5] |= np.uint32(1 << (j & 31))
+    return out
+
+
+def or_rows_by_index(
+    node_idx: np.ndarray, pod_rows: np.ndarray, node_rows: np.ndarray
+) -> None:
+    """node_rows[node_idx[i]] |= pod_rows[i] in place (node_idx<0 skipped)."""
+    lib = _load()
+    node_idx = np.ascontiguousarray(node_idx, dtype=np.int32)
+    pod_rows = np.ascontiguousarray(pod_rows, dtype=np.uint32)
+    if lib is not None and node_rows.flags["C_CONTIGUOUS"]:
+        lib.or_rows_by_index(
+            len(node_idx), pod_rows.shape[1], node_idx, pod_rows, node_rows
+        )
+        return
+    for i, j in enumerate(node_idx):
+        if j >= 0:
+            node_rows[j] |= pod_rows[i]
+
+
+def greedy_fit(
+    node_idx: np.ndarray,
+    cpu: np.ndarray,
+    mem: np.ndarray,
+    cpu_cap: np.ndarray,
+    mem_cap: np.ndarray,
+    cpu_fit: np.ndarray,
+    mem_fit: np.ndarray,
+    over: np.ndarray,
+    cpu_used: np.ndarray,
+    mem_used: np.ndarray,
+    pods_used: np.ndarray,
+) -> None:
+    """Assigned-pod occupancy sweep, in place (reference
+    MapPodsToMachines greedy order; see native/columnar.cc)."""
+    lib = _load()
+    node_idx = np.ascontiguousarray(node_idx, dtype=np.int32)
+    cpu = np.ascontiguousarray(cpu, dtype=np.float32)
+    mem = np.ascontiguousarray(mem, dtype=np.float32)
+    if lib is not None and over.dtype == np.bool_ and over.flags["C_CONTIGUOUS"]:
+        lib.greedy_fit(
+            len(node_idx), node_idx, cpu, mem, cpu_cap, mem_cap,
+            cpu_fit, mem_fit, over.view(np.uint8), cpu_used, mem_used,
+            pods_used,
+        )
+        return
+    for i, j in enumerate(node_idx):
+        if j < 0:
+            continue
+        c, m = cpu[i], mem[i]
+        cpu_used[j] += c
+        mem_used[j] += m
+        pods_used[j] += 1
+        fits_cpu = cpu_cap[j] == 0 or cpu_fit[j] + c <= cpu_cap[j]
+        fits_mem = mem_cap[j] == 0 or mem_fit[j] + m <= mem_cap[j]
+        if fits_cpu and fits_mem:
+            cpu_fit[j] += c
+            mem_fit[j] += m
+        else:
+            over[j] = True

@@ -33,6 +33,7 @@ import numpy as np
 
 from kubernetes_tpu.models.columnar import (
     MIB,
+    ServiceMatcher,
     Vocab,
     bitset,
     mem_to_mib_ceil,
@@ -50,6 +51,7 @@ from kubernetes_tpu.models.objects import (
     Pod,
     Service,
 )
+from kubernetes_tpu.ops.matrices import SVC_K
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
 
 
@@ -112,6 +114,7 @@ class SolverSession:
         self.mesh = mesh
         self.LW, self.PW, self.VW = label_words, port_words, vol_words
         self.S = max(1, len(self.services))
+        self._matcher = ServiceMatcher(self.services)
         self.N_cap = _bucket(max(node_capacity, len(nodes), 1))
         self.label_vocab, self.port_vocab, self.vol_vocab = Vocab(), Vocab(), Vocab()
 
@@ -163,17 +166,8 @@ class SolverSession:
         vols = pod_volumes(pod)
         vol_any = [self._vocab_id(self.vol_vocab, self.VW, v) for v, _ in vols]
         vol_rw = [self._vocab_id(self.vol_vocab, self.VW, v) for v, rw in vols if rw]
-        member = np.zeros(self.S, dtype=np.float32)
-        labels = pod.metadata.labels or {}
-        first = -1
-        for s, svc in enumerate(self.services):
-            sel = svc.spec.selector
-            if not sel or svc.metadata.namespace != pod.metadata.namespace:
-                continue
-            if all(labels.get(k) == v for k, v in sel.items()):
-                member[s] = 1.0
-                if first < 0:
-                    first = s
+        member = self._matcher.membership(pod)
+        first = self._matcher.first_match(member)
         return _LoweredPod(
             key=pod_key(pod),
             cpu=float(cpu),
@@ -379,7 +373,7 @@ class SolverSession:
             # Padding slots pinned to -2: never placeable.
             "pinned": np.full(PP, -2, np.int32),
             "svc": np.full(PP, -1, np.int32),
-            "svc_member": np.zeros((PP, self.S), np.float32),
+            "svc_ids": np.full((PP, SVC_K), -1, np.int32),
         }
         for i, lp in enumerate(pending):
             arr["cpu"][i] = lp.cpu
@@ -394,7 +388,8 @@ class SolverSession:
             else:
                 arr["pinned"][i] = -1
             arr["svc"][i] = lp.svc
-            arr["svc_member"][i] = lp.svc_member
+            nz = np.nonzero(lp.svc_member)[0][:SVC_K]
+            arr["svc_ids"][i, : len(nz)] = nz
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 

@@ -23,6 +23,28 @@ import numpy as np
 
 from kubernetes_tpu.models.columnar import Snapshot
 
+# Services a single pod can belong to on device (top-K id list; the
+# dense membership row stays host-side). Pods matching more than
+# SVC_K services contribute only their first SVC_K — far beyond any
+# realistic overlap.
+SVC_K = 8
+
+
+def member_rows_to_ids(member: np.ndarray, k: int = SVC_K) -> np.ndarray:
+    """Dense multi-hot f32[P, S] -> first-k indices i32[P, k], -1 pad."""
+    P = member.shape[0]
+    ids = np.full((P, k), -1, dtype=np.int32)
+    if P == 0:
+        return ids
+    rows, cols = np.nonzero(member)
+    if len(rows) == 0:
+        return ids
+    first = np.searchsorted(rows, np.arange(P), side="left")
+    pos = np.arange(len(rows)) - first[rows]
+    keep = pos < k
+    ids[rows[keep], pos[keep]] = cols[keep]
+    return ids
+
 
 def _pad(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
     """Pad axis 0 to length n."""
@@ -84,7 +106,7 @@ def device_snapshot(
         # always come back unassigned.
         "pinned": _pad(p.pinned_node, PP, fill=-2),
         "svc": _pad(p.service_id, PP, fill=-1),
-        "svc_member": _pad(p.svc_member, PP),
+        "svc_ids": _pad(member_rows_to_ids(p.svc_member), PP, fill=-1),
     }
     n = snap.nodes
     nodes = {

@@ -139,10 +139,14 @@ def _commit(nodes: Dict, pod: Dict, choice: jnp.ndarray, N: int) -> Dict:
         mask, nodes["uvol_rw"] | pod["vol_rw"][None, :], nodes["uvol_rw"]
     )
     # As an existing pod, the placement counts toward EVERY service
-    # whose selector matches it (multi-hot membership row).
-    new["svc_counts"] = nodes["svc_counts"] + (
-        fonehot[:, None] * pod["svc_member"][None, :]
-    )
+    # whose selector matches it. Membership travels as a top-K id list
+    # (i32[K], -1 padded) instead of a dense f32[S] row: at 50k pods x
+    # 500 services the dense rows were 100 MB of upload per solve.
+    S = nodes["svc_counts"].shape[1]
+    ids = pod["svc_ids"]
+    valid = (ids >= 0).astype(jnp.float32)
+    delta = jnp.zeros((S,), jnp.float32).at[jnp.maximum(ids, 0)].add(valid)
+    new["svc_counts"] = nodes["svc_counts"] + fonehot[:, None] * delta[None, :]
     return new
 
 

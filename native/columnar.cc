@@ -1,0 +1,69 @@
+// Host-side columnar lowering kernels (C ABI, loaded via ctypes).
+//
+// The reference is pure Go (SURVEY.md §2.14); this framework's native
+// runtime layer accelerates the host half of the TPU pipeline: turning
+// tens of thousands of API objects into the dense column arrays the
+// solver consumes (kubernetes_tpu/models/columnar.py). Python prepares
+// flat CSR-style id streams (cheap list appends); these kernels do the
+// tight per-row packing/accumulation loops that dominate at 50k pods.
+//
+// Build: `make lib` -> build/libkubetpu.so. Python binding + fallback:
+// kubernetes_tpu/native/__init__.py.
+
+#include <cstdint>
+
+extern "C" {
+
+// Pack per-row id lists (CSR: counts[i] ids starting at offsets[i])
+// into uint32 bitset rows: out[n_rows][words].
+void pack_bitsets(int64_t n_rows, int64_t words, const int64_t* offsets,
+                  const int32_t* ids, uint32_t* out) {
+    for (int64_t i = 0; i < n_rows; ++i) {
+        uint32_t* row = out + i * words;
+        for (int64_t k = offsets[i]; k < offsets[i + 1]; ++k) {
+            const int32_t id = ids[k];
+            row[id >> 5] |= (uint32_t)1 << (id & 31);
+        }
+    }
+}
+
+// OR per-pod bitset rows into their node's row:
+// node_rows[node_idx[i]] |= pod_rows[i] (skips node_idx < 0).
+void or_rows_by_index(int64_t n_pods, int64_t words, const int32_t* node_idx,
+                      const uint32_t* pod_rows, uint32_t* node_rows) {
+    for (int64_t i = 0; i < n_pods; ++i) {
+        const int32_t j = node_idx[i];
+        if (j < 0) continue;
+        const uint32_t* src = pod_rows + i * words;
+        uint32_t* dst = node_rows + (int64_t)j * words;
+        for (int64_t w = 0; w < words; ++w) dst[w] |= src[w];
+    }
+}
+
+// The assigned-pod occupancy sweep (reference MapPodsToMachines /
+// CheckPodsExceedingCapacity semantics, predicates.go:116-136 +
+// calculateOccupancy, priorities.go:44-58): greedy feasibility sums in
+// list order with an overcommit flag, plus full scoring sums.
+void greedy_fit(int64_t n_pods, const int32_t* node_idx, const float* cpu,
+                const float* mem, const float* cpu_cap, const float* mem_cap,
+                float* cpu_fit, float* mem_fit, uint8_t* over, float* cpu_used,
+                float* mem_used, float* pods_used) {
+    for (int64_t i = 0; i < n_pods; ++i) {
+        const int32_t j = node_idx[i];
+        if (j < 0) continue;
+        const float c = cpu[i], m = mem[i];
+        cpu_used[j] += c;
+        mem_used[j] += m;
+        pods_used[j] += 1.0f;
+        const bool fits_cpu = cpu_cap[j] == 0.0f || cpu_fit[j] + c <= cpu_cap[j];
+        const bool fits_mem = mem_cap[j] == 0.0f || mem_fit[j] + m <= mem_cap[j];
+        if (fits_cpu && fits_mem) {
+            cpu_fit[j] += c;
+            mem_fit[j] += m;
+        } else {
+            over[j] = 1;
+        }
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,29 @@
+/* Pod anchor ("pause") process.
+ *
+ * Equivalent of the reference's third_party/pause/pause.asm (57-line
+ * x86-64 NASM, built into a 127-byte static ELF): the infra container
+ * every pod starts first, holding the pod's namespaces/cgroup alive
+ * while real containers come and go (invoked from
+ * pkg/kubelet/dockertools/manager.go:1201-1202).
+ *
+ * Behavior: block forever in pause(2); exit cleanly on SIGINT/SIGTERM
+ * so pod teardown is prompt. Build: `make pause` (static, -Os).
+ */
+
+#include <signal.h>
+#include <unistd.h>
+
+static void on_signal(int sig) {
+    (void)sig;
+    _exit(0);
+}
+
+int main(void) {
+    struct sigaction sa = {0};
+    sa.sa_handler = on_signal;
+    sigaction(SIGINT, &sa, 0);
+    sigaction(SIGTERM, &sa, 0);
+    for (;;) {
+        pause();
+    }
+}

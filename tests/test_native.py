@@ -1,0 +1,140 @@
+"""Native kernel tests: C++ kernels vs NumPy fallback parity, and the
+pause anchor binary (§2.14 deliverables)."""
+
+import os
+import signal
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.ensure_built():
+        pytest.skip("native toolchain unavailable")
+
+
+def _fallback_pack(id_lists, words):
+    out = np.zeros((len(id_lists), words), dtype=np.uint32)
+    for i, ids in enumerate(id_lists):
+        for j in ids:
+            out[i, j >> 5] |= np.uint32(1 << (j & 31))
+    return out
+
+
+class TestPackBitsets:
+    def test_matches_fallback(self):
+        rng = np.random.default_rng(0)
+        id_lists = [
+            list(rng.choice(96, size=rng.integers(0, 6), replace=False))
+            for _ in range(500)
+        ]
+        got = native.pack_bitsets(id_lists, 3)
+        want = _fallback_pack(id_lists, 3)
+        assert np.array_equal(got, want)
+
+    def test_empty(self):
+        assert native.pack_bitsets([], 2).shape == (0, 2)
+
+
+class TestGreedyFit:
+    def test_matches_python_semantics(self):
+        rng = np.random.default_rng(1)
+        A, N = 2000, 50
+        node_idx = rng.integers(-1, N, size=A).astype(np.int32)
+        cpu = rng.choice([100, 500, 1000], size=A).astype(np.float32)
+        mem = rng.choice([64, 256, 1024], size=A).astype(np.float32)
+        cpu_cap = rng.choice([0, 4000, 8000], size=N).astype(np.float32)
+        mem_cap = rng.choice([0, 8192, 16384], size=N).astype(np.float32)
+
+        def run(use_native):
+            cpu_fit = np.zeros(N, np.float32)
+            mem_fit = np.zeros(N, np.float32)
+            over = np.zeros(N, bool)
+            cpu_used = np.zeros(N, np.float32)
+            mem_used = np.zeros(N, np.float32)
+            pods_used = np.zeros(N, np.float32)
+            if use_native:
+                native.greedy_fit(node_idx, cpu, mem, cpu_cap, mem_cap,
+                                  cpu_fit, mem_fit, over, cpu_used,
+                                  mem_used, pods_used)
+            else:
+                for i, j in enumerate(node_idx):
+                    if j < 0:
+                        continue
+                    cpu_used[j] += cpu[i]
+                    mem_used[j] += mem[i]
+                    pods_used[j] += 1
+                    fc = cpu_cap[j] == 0 or cpu_fit[j] + cpu[i] <= cpu_cap[j]
+                    fm = mem_cap[j] == 0 or mem_fit[j] + mem[i] <= mem_cap[j]
+                    if fc and fm:
+                        cpu_fit[j] += cpu[i]
+                        mem_fit[j] += mem[i]
+                    else:
+                        over[j] = True
+            return cpu_fit, mem_fit, over, cpu_used, mem_used, pods_used
+
+        for a, b in zip(run(True), run(False)):
+            assert np.array_equal(a, b)
+
+
+class TestOrRows:
+    def test_matches_fallback(self):
+        rng = np.random.default_rng(2)
+        A, N, W = 300, 20, 2
+        node_idx = rng.integers(-1, N, size=A).astype(np.int32)
+        pod_rows = rng.integers(0, 2**32, size=(A, W), dtype=np.uint32)
+        got = np.zeros((N, W), np.uint32)
+        native.or_rows_by_index(node_idx, pod_rows, got)
+        want = np.zeros((N, W), np.uint32)
+        for i, j in enumerate(node_idx):
+            if j >= 0:
+                want[j] |= pod_rows[i]
+        assert np.array_equal(got, want)
+
+
+class TestPause:
+    def test_runs_and_terminates_cleanly(self):
+        subprocess.run(
+            ["make", "-C", os.path.join(os.path.dirname(native.__file__),
+                                        "..", "..", "native"), "pause"],
+            check=True, capture_output=True,
+        )
+        path = native.pause_binary()
+        assert path is not None
+        proc = subprocess.Popen([path])
+        time.sleep(0.2)
+        assert proc.poll() is None  # parked in pause(2)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=5) == 0  # clean exit on TERM
+
+
+class TestSnapshotUsesNative:
+    def test_build_snapshot_parity_native_vs_fallback(self, monkeypatch):
+        """build_snapshot must produce identical columns with and
+        without the native lib."""
+        from __graft_entry__ import _synthetic_objects
+        from kubernetes_tpu.models.columnar import build_snapshot
+
+        pods, nodes, services = _synthetic_objects(300, 40, seed=5)
+        for p in pods[:150]:  # make some assigned
+            p.spec.node_name = nodes[hash(p.metadata.name) % 40].metadata.name
+        assigned, pending = pods[:150], pods[150:]
+        with_native = build_snapshot(pending, nodes, assigned, services)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_attempted", True)
+        without = build_snapshot(pending, nodes, assigned, services)
+        for field in ("cpu_cap", "cpu_fit_used", "mem_fit_used", "overcommitted",
+                      "cpu_used", "mem_used", "pods_used", "used_port_bits",
+                      "used_vol_any_bits", "used_vol_rw_bits"):
+            assert np.array_equal(
+                getattr(with_native.nodes, field), getattr(without.nodes, field)
+            ), field
+        for field in ("port_bits", "vol_any_bits", "vol_rw_bits"):
+            assert np.array_equal(
+                getattr(with_native.pods, field), getattr(without.pods, field)
+            ), field
