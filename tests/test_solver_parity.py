@@ -207,53 +207,62 @@ class TestExactParity:
         assert_parity(pods, nodes, assigned=assigned, services=[svc])
 
 
+def random_cluster(seed):
+    """Shared fuzz-cluster generator: (pending, nodes, assigned,
+    services). Used by both the scalar-parity fuzz here and the
+    sharded-mesh parity fuzz in test_multichip.py, so both suites
+    always sample the same input space."""
+    rng = random.Random(seed)
+    n_nodes = rng.randint(1, 12)
+    n_pods = rng.randint(1, 40)
+    zones = ["a", "b", "c"]
+    nodes = [
+        mk_node(
+            f"n{j}",
+            cpu=rng.choice([1000, 2000, 4000, 8000]),
+            mem_mib=rng.choice([1024, 4096, 8192]),
+            pods=rng.choice([3, 10, 40]),
+            labels={"zone": rng.choice(zones)} if rng.random() < 0.7 else {},
+            ready=rng.random() > 0.1,
+        )
+        for j in range(n_nodes)
+    ]
+    svc = Service(
+        metadata=ObjectMeta(name="web", namespace="default"),
+        spec=ServiceSpec(selector={"app": "web"}),
+    )
+    assigned = []
+    for i in range(rng.randint(0, 10)):
+        a = mk_pod(
+            f"a{i}",
+            cpu=rng.choice([0, 100, 500, 1000]),
+            mem_mib=rng.choice([0, 64, 512, 1024]),
+            labels={"app": "web"} if rng.random() < 0.5 else {},
+        )
+        a.spec.node_name = rng.choice(nodes).metadata.name
+        assigned.append(a)
+    pods = [
+        mk_pod(
+            f"p{i}",
+            cpu=rng.choice([0, 50, 100, 500, 1500]),
+            mem_mib=rng.choice([0, 16, 128, 1024]),
+            selector={"zone": rng.choice(zones)} if rng.random() < 0.3 else None,
+            host_port=rng.choice([0, 0, 0, 8080, 9090]),
+            labels={"app": "web"} if rng.random() < 0.4 else {},
+        )
+        for i in range(n_pods)
+    ]
+    return pods, nodes, assigned, [svc]
+
+
 class TestRandomizedParity:
     """Fuzz parity across random clusters. The sequential-parity solver
     should match the oracle exactly on Mi-granular inputs."""
 
     @pytest.mark.parametrize("seed", range(6))
     def test_random_cluster(self, seed):
-        rng = random.Random(seed)
-        n_nodes = rng.randint(1, 12)
-        n_pods = rng.randint(1, 40)
-        zones = ["a", "b", "c"]
-        nodes = [
-            mk_node(
-                f"n{j}",
-                cpu=rng.choice([1000, 2000, 4000, 8000]),
-                mem_mib=rng.choice([1024, 4096, 8192]),
-                pods=rng.choice([3, 10, 40]),
-                labels={"zone": rng.choice(zones)} if rng.random() < 0.7 else {},
-                ready=rng.random() > 0.1,
-            )
-            for j in range(n_nodes)
-        ]
-        svc = Service(
-            metadata=ObjectMeta(name="web", namespace="default"),
-            spec=ServiceSpec(selector={"app": "web"}),
-        )
-        assigned = []
-        for i in range(rng.randint(0, 10)):
-            a = mk_pod(
-                f"a{i}",
-                cpu=rng.choice([0, 100, 500, 1000]),
-                mem_mib=rng.choice([0, 64, 512, 1024]),
-                labels={"app": "web"} if rng.random() < 0.5 else {},
-            )
-            a.spec.node_name = rng.choice(nodes).metadata.name
-            assigned.append(a)
-        pods = [
-            mk_pod(
-                f"p{i}",
-                cpu=rng.choice([0, 50, 100, 500, 1500]),
-                mem_mib=rng.choice([0, 16, 128, 1024]),
-                selector={"zone": rng.choice(zones)} if rng.random() < 0.3 else None,
-                host_port=rng.choice([0, 0, 0, 8080, 9090]),
-                labels={"app": "web"} if rng.random() < 0.4 else {},
-            )
-            for i in range(n_pods)
-        ]
-        assert_parity(pods, nodes, assigned=assigned, services=[svc])
+        pods, nodes, assigned, services = random_cluster(seed)
+        assert_parity(pods, nodes, assigned=assigned, services=services)
 
 
 class TestSpreadingParityRegressions:
