@@ -38,7 +38,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -232,6 +232,9 @@ def node_is_ready(node: Node) -> bool:
     return True
 
 
+_EMPTY_IDS = np.zeros(0, dtype=np.int64)
+
+
 class ServiceMatcher:
     """Inverted index over service selectors: pod -> multi-hot
     membership in O(pod labels), not O(services).
@@ -252,6 +255,11 @@ class ServiceMatcher:
         # namespace -> ((k,v) -> np.array of service indices)
         self._pair_index: Dict[str, Dict[Tuple[str, str], np.ndarray]] = {}
         self._sel_size = np.zeros(max(self.S, 1), dtype=np.int32)
+        # Pods from one RC share an identical label set, so membership
+        # is memoized by (namespace, labels) signature: a 50k-pod
+        # backlog with a few hundred distinct templates costs a few
+        # hundred matches, not 50k.
+        self._id_cache: Dict[Tuple, Tuple[np.ndarray, int]] = {}
         by_ns: Dict[str, Dict[Tuple[str, str], List[int]]] = {}
         for i, svc in enumerate(services):
             sel = svc.spec.selector
@@ -286,6 +294,28 @@ class ServiceMatcher:
         nz = np.nonzero(member[: self.S])[0]
         return int(nz[0]) if len(nz) else -1
 
+    def membership_ids(self, pod: Pod) -> Tuple[np.ndarray, int]:
+        """(sorted matching service indices i64[k], first index or -1),
+        memoized by (namespace, labels) signature."""
+        labels = pod.metadata.labels
+        ns = pod.metadata.namespace
+        if not labels or ns not in self._pair_index:
+            return _EMPTY_IDS, -1
+        key = (ns, frozenset(labels.items()))
+        hit = self._id_cache.get(key)
+        if hit is not None:
+            return hit
+        idx = self._pair_index[ns]
+        counts = np.zeros(self.out_width, dtype=np.int32)
+        for pair in labels.items():
+            ids = idx.get(pair)
+            if ids is not None:
+                counts[ids] += 1
+        matched = np.nonzero((counts == self._sel_size) & (self._sel_size > 0))[0]
+        hit = (matched, int(matched[0]) if len(matched) else -1)
+        self._id_cache[key] = hit
+        return hit
+
 
 def _service_membership(pod: Pod, services: List[Service]) -> np.ndarray:
     """One-shot convenience wrapper (tests); bulk callers build one
@@ -293,183 +323,221 @@ def _service_membership(pod: Pod, services: List[Service]) -> np.ndarray:
     return ServiceMatcher(services).membership(pod)
 
 
-def build_snapshot(
-    pending_pods: Sequence[Pod],
-    nodes: Sequence[Node],
-    assigned_pods: Sequence[Pod] = (),
-    services: Sequence[Service] = (),
-) -> Snapshot:
-    """Lower API objects into a dense scheduling snapshot.
+class SnapshotBuilder:
+    """Two-phase lowering: a cheap vocabulary pass over ALL objects,
+    then column fills that may be CHUNKED over the pending backlog.
 
-    `assigned_pods` are pods already bound to nodes; they contribute to
-    occupancy the way MapPodsToMachines does (predicates.go:379-392),
-    with terminal-phase pods filtered out.
+    Chunking exists so the host->device pipeline can overlap: lower
+    chunk k+1 on the host while the device solves chunk k (the solver
+    carry chains placements across chunks, so decisions are identical
+    to one monolithic solve). build_snapshot() is the one-shot wrapper.
     """
-    nodes = list(nodes)
-    pending_pods = list(pending_pods)
-    services = list(services)
-    # Terminal-phase filtering applies to OCCUPANCY (MapPodsToMachines /
-    # filterNonRunningPods, predicates.go:361-377) but NOT to service
-    # spreading counts — CalculateSpreadPriority lists pods by selector
-    # with no phase filter (spreading.go:44-57).
-    all_assigned = list(assigned_pods)
-    assigned_pods = [
-        p for p in all_assigned if p.status.phase not in ("Succeeded", "Failed")
-    ]
-    node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
-    N, P, S = len(nodes), len(pending_pods), len(services)
-    matcher = ServiceMatcher(services)
 
-    label_vocab, port_vocab, vol_vocab = Vocab(), Vocab(), Vocab()
-
-    # -- vocabulary passes (host-side, one sweep each) --
-    for n in nodes:
-        for k, v in (n.metadata.labels or {}).items():
-            label_vocab.id(f"{k}={v}")
-    sel_keys: Dict[Tuple[Tuple[str, str], ...], int] = {(): 0}
-    pod_sel_rows = np.zeros(P, dtype=np.int32)
-    for i, p in enumerate(pending_pods):
-        sel = tuple(sorted((p.spec.node_selector or {}).items()))
-        for k, v in sel:
-            label_vocab.id(f"{k}={v}")
-        row = sel_keys.setdefault(sel, len(sel_keys))
-        pod_sel_rows[i] = row
-        for port in pod_host_ports(p):
-            port_vocab.id(str(port))
-        for vol, _rw in pod_volumes(p):
-            vol_vocab.id(vol)
-    for p in assigned_pods:
-        for port in pod_host_ports(p):
-            port_vocab.id(str(port))
-        for vol, _rw in pod_volumes(p):
-            vol_vocab.id(vol)
-
-    LW, PW, VW = label_vocab.words, port_vocab.words, vol_vocab.words
-
-    # -- pod columns -- (bitset packing batched through the native
-    # kernels, kubernetes_tpu.native; NumPy fallback inside)
-    from kubernetes_tpu import native
-
-    cpu_req = np.zeros(P, dtype=np.float32)
-    mem_req = np.zeros(P, dtype=np.float32)
-    zero_req = np.zeros(P, dtype=bool)
-    pinned = np.full(P, -1, dtype=np.int32)
-    service_id = np.full(P, -1, dtype=np.int32)
-    svc_member = np.zeros((P, max(S, 1)), dtype=np.float32)
-    port_id_lists: List[List[int]] = []
-    vol_any_lists: List[List[int]] = []
-    vol_rw_lists: List[List[int]] = []
-    for i, p in enumerate(pending_pods):
-        cpu, mem = pod_resource_limits(p)
-        cpu_req[i] = cpu
-        mem_req[i] = mem_to_mib_ceil(mem)
-        zero_req[i] = cpu == 0 and mem == 0
-        port_id_lists.append([port_vocab.id(str(x)) for x in pod_host_ports(p)])
-        vols = pod_volumes(p)
-        vol_any_lists.append([vol_vocab.id(v) for v, _ in vols])
-        vol_rw_lists.append([vol_vocab.id(v) for v, rw in vols if rw])
-        if p.spec.node_name:
-            pinned[i] = node_index.get(p.spec.node_name, -2)
-        svc_member[i] = matcher.membership(p)
-        service_id[i] = matcher.first_match(svc_member[i])
-    port_bits = native.pack_bitsets(port_id_lists, PW)
-    vol_any = native.pack_bitsets(vol_any_lists, VW)
-    vol_rw = native.pack_bitsets(vol_rw_lists, VW)
-
-    sel_bits = np.zeros((len(sel_keys), LW), dtype=np.uint32)
-    for sel, row in sel_keys.items():
-        sel_bits[row] = bitset([label_vocab.id(f"{k}={v}") for k, v in sel], LW)
-
-    # -- node columns --
-    cpu_cap = np.zeros(N, dtype=np.float32)
-    mem_cap = np.zeros(N, dtype=np.float32)
-    pods_cap = np.zeros(N, dtype=np.float32)
-    cpu_fit_used = np.zeros(N, dtype=np.float32)
-    mem_fit_used = np.zeros(N, dtype=np.float32)
-    overcommitted = np.zeros(N, dtype=bool)
-    cpu_used = np.zeros(N, dtype=np.float32)
-    mem_used = np.zeros(N, dtype=np.float32)
-    pods_used = np.zeros(N, dtype=np.float32)
-    label_bits = np.zeros((N, LW), dtype=np.uint32)
-    used_port_bits = np.zeros((N, PW), dtype=np.uint32)
-    used_vol_any = np.zeros((N, VW), dtype=np.uint32)
-    used_vol_rw = np.zeros((N, VW), dtype=np.uint32)
-    service_counts = np.zeros((N, max(S, 1)), dtype=np.float32)
-    schedulable = np.zeros(N, dtype=bool)
-    for j, n in enumerate(nodes):
-        cap = n.status.capacity or {}
-        if RESOURCE_CPU in cap:
-            cpu_cap[j] = cap[RESOURCE_CPU].milli_value()
-        if RESOURCE_MEMORY in cap:
-            # Capacity rounds DOWN (requests round up) so lowering can
-            # only under-promise, never overcommit a node.
-            mem_cap[j] = cap[RESOURCE_MEMORY].value() // MIB
-        if RESOURCE_PODS in cap:
-            pods_cap[j] = cap[RESOURCE_PODS].value()
-        label_bits[j] = bitset(
-            [label_vocab.id(f"{k}={v}") for k, v in (n.metadata.labels or {}).items()],
-            LW,
+    def __init__(
+        self,
+        pending_pods: Sequence[Pod],
+        nodes: Sequence[Node],
+        assigned_pods: Sequence[Pod] = (),
+        services: Sequence[Service] = (),
+    ):
+        self.nodes = list(nodes)
+        self.pending = list(pending_pods)
+        self.services = list(services)
+        # Terminal-phase filtering applies to OCCUPANCY
+        # (MapPodsToMachines / filterNonRunningPods,
+        # predicates.go:361-377) but NOT to service spreading counts —
+        # CalculateSpreadPriority lists pods by selector with no phase
+        # filter (spreading.go:44-57).
+        self.all_assigned = list(assigned_pods)
+        self.assigned = [
+            p
+            for p in self.all_assigned
+            if p.status.phase not in ("Succeeded", "Failed")
+        ]
+        self.node_index = {n.metadata.name: i for i, n in enumerate(self.nodes)}
+        self.S = len(self.services)
+        self.matcher = ServiceMatcher(self.services)
+        self.label_vocab, self.port_vocab, self.vol_vocab = (
+            Vocab(),
+            Vocab(),
+            Vocab(),
         )
-        schedulable[j] = node_is_ready(n)
 
-    # Assigned-pod occupancy sweep through the native kernels
-    # (MapPodsToMachines greedy order = list order).
-    A = len(assigned_pods)
-    a_idx = np.full(A, -1, dtype=np.int32)
-    a_cpu = np.zeros(A, dtype=np.float32)
-    a_mem = np.zeros(A, dtype=np.float32)
-    a_port_lists: List[List[int]] = []
-    a_vol_any_lists: List[List[int]] = []
-    a_vol_rw_lists: List[List[int]] = []
-    for i, p in enumerate(assigned_pods):
-        j = node_index.get(p.spec.node_name)
-        a_idx[i] = -1 if j is None else j
-        cpu, mem = pod_resource_limits(p)
-        a_cpu[i] = cpu
-        a_mem[i] = mem_to_mib_ceil(mem)
-        a_port_lists.append([port_vocab.id(str(x)) for x in pod_host_ports(p)])
-        vols = pod_volumes(p)
-        a_vol_any_lists.append([vol_vocab.id(v) for v, _ in vols])
-        a_vol_rw_lists.append([vol_vocab.id(v) for v, rw in vols if rw])
-    native.greedy_fit(
-        a_idx, a_cpu, a_mem, cpu_cap, mem_cap,
-        cpu_fit_used, mem_fit_used, overcommitted, cpu_used, mem_used,
-        pods_used,
-    )
-    native.or_rows_by_index(
-        a_idx, native.pack_bitsets(a_port_lists, PW), used_port_bits
-    )
-    native.or_rows_by_index(
-        a_idx, native.pack_bitsets(a_vol_any_lists, VW), used_vol_any
-    )
-    native.or_rows_by_index(
-        a_idx, native.pack_bitsets(a_vol_rw_lists, VW), used_vol_rw
-    )
+        # -- vocabulary passes (one sweep each; selector table dedup) --
+        for n in self.nodes:
+            for k, v in (n.metadata.labels or {}).items():
+                self.label_vocab.id(f"{k}={v}")
+        self.sel_keys: Dict[Tuple[Tuple[str, str], ...], int] = {(): 0}
+        self._pod_sel_rows = np.zeros(len(self.pending), dtype=np.int32)
+        for i, p in enumerate(self.pending):
+            sel = tuple(sorted((p.spec.node_selector or {}).items()))
+            for k, v in sel:
+                self.label_vocab.id(f"{k}={v}")
+            self._pod_sel_rows[i] = self.sel_keys.setdefault(
+                sel, len(self.sel_keys)
+            )
+            for port in pod_host_ports(p):
+                self.port_vocab.id(str(port))
+            for vol, _rw in pod_volumes(p):
+                self.vol_vocab.id(vol)
+        for p in self.assigned:
+            for port in pod_host_ports(p):
+                self.port_vocab.id(str(port))
+            for vol, _rw in pod_volumes(p):
+                self.vol_vocab.id(vol)
+        self.LW = self.label_vocab.words
+        self.PW = self.port_vocab.words
+        self.VW = self.vol_vocab.words
+        self._sel_bits: Optional[np.ndarray] = None
 
-    # Spreading counts: every pod (phase-unfiltered) contributes to
-    # every service whose selector matches its labels.
-    for p in all_assigned:
-        j = node_index.get(p.spec.node_name)
-        if j is None:
-            continue
-        service_counts[j] += matcher.membership(p)
+    @property
+    def sel_bits(self) -> np.ndarray:
+        if self._sel_bits is None:
+            out = np.zeros((len(self.sel_keys), self.LW), dtype=np.uint32)
+            for sel, row in self.sel_keys.items():
+                out[row] = bitset(
+                    [self.label_vocab.id(f"{k}={v}") for k, v in sel], self.LW
+                )
+            self._sel_bits = out
+        return self._sel_bits
 
-    return Snapshot(
-        pods=PodColumns(
-            names=[pod_key(p) for p in pending_pods],
+    def pod_columns(self, start: int = 0, stop: Optional[int] = None) -> PodColumns:
+        """Lower pending pods [start:stop) (the whole backlog by
+        default). Chunks share the global vocabularies/selector table."""
+        from kubernetes_tpu import native
+
+        stop = len(self.pending) if stop is None else stop
+        chunk = self.pending[start:stop]
+        P = len(chunk)
+        cpu_req = np.zeros(P, dtype=np.float32)
+        mem_req = np.zeros(P, dtype=np.float32)
+        zero_req = np.zeros(P, dtype=bool)
+        pinned = np.full(P, -1, dtype=np.int32)
+        service_id = np.full(P, -1, dtype=np.int32)
+        svc_member = np.zeros((P, max(self.S, 1)), dtype=np.float32)
+        port_id_lists: List[List[int]] = []
+        vol_any_lists: List[List[int]] = []
+        vol_rw_lists: List[List[int]] = []
+        for i, p in enumerate(chunk):
+            cpu, mem = pod_resource_limits(p)
+            cpu_req[i] = cpu
+            mem_req[i] = mem_to_mib_ceil(mem)
+            zero_req[i] = cpu == 0 and mem == 0
+            port_id_lists.append(
+                [self.port_vocab.id(str(x)) for x in pod_host_ports(p)]
+            )
+            vols = pod_volumes(p)
+            vol_any_lists.append([self.vol_vocab.id(v) for v, _ in vols])
+            vol_rw_lists.append([self.vol_vocab.id(v) for v, rw in vols if rw])
+            if p.spec.node_name:
+                pinned[i] = self.node_index.get(p.spec.node_name, -2)
+            ids, first = self.matcher.membership_ids(p)
+            if len(ids):
+                svc_member[i, ids] = 1.0
+            service_id[i] = first
+        return PodColumns(
+            names=[pod_key(p) for p in chunk],
             cpu_milli=cpu_req,
             mem_mib=mem_req,
             zero_req=zero_req,
-            selector_id=pod_sel_rows,
-            port_bits=port_bits,
-            vol_any_bits=vol_any,
-            vol_rw_bits=vol_rw,
+            selector_id=self._pod_sel_rows[start:stop],
+            port_bits=native.pack_bitsets(port_id_lists, self.PW),
+            vol_any_bits=native.pack_bitsets(vol_any_lists, self.VW),
+            vol_rw_bits=native.pack_bitsets(vol_rw_lists, self.VW),
             pinned_node=pinned,
             service_id=service_id,
             svc_member=svc_member,
-            sel_bits=sel_bits,
-        ),
-        nodes=NodeColumns(
+            sel_bits=self.sel_bits,
+        )
+
+    def node_columns(self) -> NodeColumns:
+        from kubernetes_tpu import native
+
+        nodes, N = self.nodes, len(self.nodes)
+        LW, PW, VW = self.LW, self.PW, self.VW
+        cpu_cap = np.zeros(N, dtype=np.float32)
+        mem_cap = np.zeros(N, dtype=np.float32)
+        pods_cap = np.zeros(N, dtype=np.float32)
+        cpu_fit_used = np.zeros(N, dtype=np.float32)
+        mem_fit_used = np.zeros(N, dtype=np.float32)
+        overcommitted = np.zeros(N, dtype=bool)
+        cpu_used = np.zeros(N, dtype=np.float32)
+        mem_used = np.zeros(N, dtype=np.float32)
+        pods_used = np.zeros(N, dtype=np.float32)
+        label_bits = np.zeros((N, LW), dtype=np.uint32)
+        used_port_bits = np.zeros((N, PW), dtype=np.uint32)
+        used_vol_any = np.zeros((N, VW), dtype=np.uint32)
+        used_vol_rw = np.zeros((N, VW), dtype=np.uint32)
+        service_counts = np.zeros((N, max(self.S, 1)), dtype=np.float32)
+        schedulable = np.zeros(N, dtype=bool)
+        for j, n in enumerate(nodes):
+            cap = n.status.capacity or {}
+            if RESOURCE_CPU in cap:
+                cpu_cap[j] = cap[RESOURCE_CPU].milli_value()
+            if RESOURCE_MEMORY in cap:
+                # Capacity rounds DOWN (requests round up) so lowering
+                # can only under-promise, never overcommit a node.
+                mem_cap[j] = cap[RESOURCE_MEMORY].value() // MIB
+            if RESOURCE_PODS in cap:
+                pods_cap[j] = cap[RESOURCE_PODS].value()
+            label_bits[j] = bitset(
+                [
+                    self.label_vocab.id(f"{k}={v}")
+                    for k, v in (n.metadata.labels or {}).items()
+                ],
+                LW,
+            )
+            schedulable[j] = node_is_ready(n)
+
+        # Assigned-pod occupancy sweep through the native kernels
+        # (MapPodsToMachines greedy order = list order).
+        A = len(self.assigned)
+        a_idx = np.full(A, -1, dtype=np.int32)
+        a_cpu = np.zeros(A, dtype=np.float32)
+        a_mem = np.zeros(A, dtype=np.float32)
+        a_port_lists: List[List[int]] = []
+        a_vol_any_lists: List[List[int]] = []
+        a_vol_rw_lists: List[List[int]] = []
+        for i, p in enumerate(self.assigned):
+            j = self.node_index.get(p.spec.node_name)
+            a_idx[i] = -1 if j is None else j
+            cpu, mem = pod_resource_limits(p)
+            a_cpu[i] = cpu
+            a_mem[i] = mem_to_mib_ceil(mem)
+            a_port_lists.append(
+                [self.port_vocab.id(str(x)) for x in pod_host_ports(p)]
+            )
+            vols = pod_volumes(p)
+            a_vol_any_lists.append([self.vol_vocab.id(v) for v, _ in vols])
+            a_vol_rw_lists.append(
+                [self.vol_vocab.id(v) for v, rw in vols if rw]
+            )
+        native.greedy_fit(
+            a_idx, a_cpu, a_mem, cpu_cap, mem_cap,
+            cpu_fit_used, mem_fit_used, overcommitted, cpu_used, mem_used,
+            pods_used,
+        )
+        native.or_rows_by_index(
+            a_idx, native.pack_bitsets(a_port_lists, PW), used_port_bits
+        )
+        native.or_rows_by_index(
+            a_idx, native.pack_bitsets(a_vol_any_lists, VW), used_vol_any
+        )
+        native.or_rows_by_index(
+            a_idx, native.pack_bitsets(a_vol_rw_lists, VW), used_vol_rw
+        )
+
+        # Spreading counts: every pod (phase-unfiltered) contributes to
+        # every service whose selector matches its labels.
+        for p in self.all_assigned:
+            j = self.node_index.get(p.spec.node_name)
+            if j is None:
+                continue
+            ids, _ = self.matcher.membership_ids(p)
+            if len(ids):
+                service_counts[j, ids] += 1.0
+
+        return NodeColumns(
             names=[n.metadata.name for n in nodes],
             cpu_cap=cpu_cap,
             mem_cap=mem_cap,
@@ -486,9 +554,32 @@ def build_snapshot(
             used_vol_rw_bits=used_vol_rw,
             service_counts=service_counts,
             schedulable=schedulable,
-        ),
-        label_vocab=label_vocab,
-        port_vocab=port_vocab,
-        vol_vocab=vol_vocab,
-        service_names=[f"{s.metadata.namespace}/{s.metadata.name}" for s in services],
-    )
+        )
+
+    def snapshot(self) -> Snapshot:
+        return Snapshot(
+            pods=self.pod_columns(),
+            nodes=self.node_columns(),
+            label_vocab=self.label_vocab,
+            port_vocab=self.port_vocab,
+            vol_vocab=self.vol_vocab,
+            service_names=[
+                f"{s.metadata.namespace}/{s.metadata.name}"
+                for s in self.services
+            ],
+        )
+
+
+def build_snapshot(
+    pending_pods: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned_pods: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+) -> Snapshot:
+    """Lower API objects into a dense scheduling snapshot.
+
+    `assigned_pods` are pods already bound to nodes; they contribute to
+    occupancy the way MapPodsToMachines does (predicates.go:379-392),
+    with terminal-phase pods filtered out.
+    """
+    return SnapshotBuilder(pending_pods, nodes, assigned_pods, services).snapshot()

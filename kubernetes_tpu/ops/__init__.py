@@ -8,6 +8,7 @@ jax.sharding.Mesh for multi-chip scale-out.
 """
 
 from kubernetes_tpu.ops.matrices import DeviceSnapshot, device_snapshot
+from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
 from kubernetes_tpu.ops.solver import solve, solve_assignments, solve_with_state
 from kubernetes_tpu.ops.incremental import RebuildRequired, SolverSession
 
@@ -18,5 +19,6 @@ __all__ = [
     "device_snapshot",
     "solve",
     "solve_assignments",
+    "solve_backlog_pipelined",
     "solve_with_state",
 ]

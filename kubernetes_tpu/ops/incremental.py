@@ -89,8 +89,12 @@ class _LoweredPod:
     # solve() time — slot indices are recycled across node churn, so an
     # index resolved at add time could point at a different node.
     pinned_name: str
-    svc_member: np.ndarray  # f32[S_cap]
     svc: int
+    # Top-SVC_K matching service ids — the exact set the device commit
+    # scatters (solver._commit). Host mirrors MUST use this truncated
+    # set, not the dense membership row: a pod matching > SVC_K
+    # services would otherwise diverge host vs device (advisor r1).
+    svc_topk: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
 
 
 class SolverSession:
@@ -166,9 +170,9 @@ class SolverSession:
         vols = pod_volumes(pod)
         vol_any = [self._vocab_id(self.vol_vocab, self.VW, v) for v, _ in vols]
         vol_rw = [self._vocab_id(self.vol_vocab, self.VW, v) for v, rw in vols if rw]
-        member = self._matcher.membership(pod)
-        first = self._matcher.first_match(member)
+        ids, first = self._matcher.membership_ids(pod)
         return _LoweredPod(
+            svc_topk=ids[:SVC_K],
             key=pod_key(pod),
             cpu=float(cpu),
             mem_mib=float(mem_to_mib_ceil(mem)),
@@ -178,7 +182,6 @@ class SolverSession:
             vol_any_ids=vol_any,
             vol_rw_ids=vol_rw,
             pinned_name=pod.spec.node_name or "",
-            svc_member=member,
             svc=first,
         )
 
@@ -261,7 +264,8 @@ class SolverSession:
             h["uport"][j] |= bitset(lp.port_ids, self.PW)
             h["uvol_any"][j] |= bitset(lp.vol_any_ids, self.VW)
             h["uvol_rw"][j] |= bitset(lp.vol_rw_ids, self.VW)
-            h["svc_counts"][j] += lp.svc_member
+            if len(lp.svc_topk):
+                h["svc_counts"][j, lp.svc_topk] += 1.0
 
     def _apply_commit_host(self, j: int, lp: _LoweredPod) -> None:
         """Mirror of solver._commit — keeps host state bit-identical to
@@ -275,7 +279,8 @@ class SolverSession:
         h["uport"][j] |= bitset(lp.port_ids, self.PW)
         h["uvol_any"][j] |= bitset(lp.vol_any_ids, self.VW)
         h["uvol_rw"][j] |= bitset(lp.vol_rw_ids, self.VW)
-        h["svc_counts"][j] += lp.svc_member
+        if len(lp.svc_topk):
+            h["svc_counts"][j, lp.svc_topk] += 1.0
 
     # -- device transfer ----------------------------------------------
 
@@ -388,8 +393,7 @@ class SolverSession:
             else:
                 arr["pinned"][i] = -1
             arr["svc"][i] = lp.svc
-            nz = np.nonzero(lp.svc_member)[0][:SVC_K]
-            arr["svc_ids"][i, : len(nz)] = nz
+            arr["svc_ids"][i, : len(lp.svc_topk)] = lp.svc_topk
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 

@@ -58,6 +58,27 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m if x > 0 else m
 
 
+def _pad_cols(arr: np.ndarray, m: int) -> np.ndarray:
+    """Pad axis 1 up to a multiple of m (shape-bucketing for the minor
+    dims: bitset word counts and the service axis drift with snapshot
+    vocabularies, and every distinct shape is a fresh XLA executable)."""
+    cols = arr.shape[1]
+    target = _round_up(cols, m)
+    if cols == target:
+        return arr
+    return np.pad(arr, [(0, 0), (0, target - cols)])
+
+
+def _put(arr: np.ndarray, sharding):
+    """Upload one array, creating all-zero arrays directly on device:
+    a fresh backlog's occupancy matrices (svc_counts alone is N x S f32
+    ~10 MB at 5k x 500) are zeros, and shipping zeros through the
+    host->device tunnel is pure waste."""
+    if arr.size > 4096 and not arr.any():
+        return jnp.zeros(arr.shape, dtype=arr.dtype, device=sharding)
+    return jax.device_put(arr, sharding)
+
+
 @dataclass
 class DeviceSnapshot:
     """Device-resident scheduling problem. `pods`/`nodes` are dicts of
@@ -78,37 +99,52 @@ class DeviceSnapshot:
         return int(self.nodes["cpu_cap"].shape[0])
 
 
-def device_snapshot(
-    snap: Snapshot,
-    mesh: Optional[jax.sharding.Mesh] = None,
-    node_axis: str = "nodes",
-    pad_to: int = 128,
-) -> DeviceSnapshot:
-    P, N = snap.pods.count, snap.nodes.count
-    PP = _round_up(P, pad_to)
-    # The node axis must divide evenly across mesh shards.
-    node_mult = pad_to
-    if mesh is not None:
-        node_mult = max(pad_to, int(np.prod([mesh.shape[a] for a in mesh.axis_names])))
-    NP = _round_up(N, node_mult)
+# Bucket minor dims: bitset widths to pairs of u32 words, the service
+# axis to 128 — so vocab drift between snapshots reuses the compiled
+# executable instead of triggering a fresh XLA compile.
+WORD_BUCKET, SVC_BUCKET = 2, 128
 
-    p = snap.pods
-    sel_rows = p.sel_bits[p.selector_id] if P else np.zeros((0, p.sel_bits.shape[1]), np.uint32)
+
+def device_pods(
+    p,
+    sharding,
+    pad_to: int = 128,
+) -> Dict[str, jnp.ndarray]:
+    """PodColumns -> device dict (padded axis 0 to a pad_to multiple)."""
+    P = p.count
+    PP = _round_up(P, pad_to)
+    sel_rows = (
+        p.sel_bits[p.selector_id]
+        if P
+        else np.zeros((0, p.sel_bits.shape[1]), np.uint32)
+    )
     pods = {
         "cpu": _pad(p.cpu_milli, PP),
         "mem": _pad(p.mem_mib, PP),
         "zero_req": _pad(p.zero_req, PP, fill=False),
-        "sel": _pad(sel_rows, PP),
-        "port": _pad(p.port_bits, PP),
-        "vol_any": _pad(p.vol_any_bits, PP),
-        "vol_rw": _pad(p.vol_rw_bits, PP),
+        "sel": _pad(_pad_cols(sel_rows, WORD_BUCKET), PP),
+        "port": _pad(_pad_cols(p.port_bits, WORD_BUCKET), PP),
+        "vol_any": _pad(_pad_cols(p.vol_any_bits, WORD_BUCKET), PP),
+        "vol_rw": _pad(_pad_cols(p.vol_rw_bits, WORD_BUCKET), PP),
         # Padding pods are pinned to -2 (an impossible node) so they
         # always come back unassigned.
         "pinned": _pad(p.pinned_node, PP, fill=-2),
         "svc": _pad(p.service_id, PP, fill=-1),
         "svc_ids": _pad(member_rows_to_ids(p.svc_member), PP, fill=-1),
     }
-    n = snap.nodes
+    return {k: _put(v, sharding) for k, v in pods.items()}
+
+
+def device_nodes(
+    n,
+    sharding,
+    pad_to: int = 128,
+    node_mult: Optional[int] = None,
+) -> Dict[str, jnp.ndarray]:
+    """NodeColumns -> device dict (padded so the node axis divides
+    evenly across mesh shards)."""
+    N = n.count
+    NP = _round_up(N, node_mult or pad_to)
     nodes = {
         "cpu_cap": _pad(n.cpu_cap, NP),
         "mem_cap": _pad(n.mem_cap, NP),
@@ -119,26 +155,49 @@ def device_snapshot(
         "cpu_used": _pad(n.cpu_used, NP),
         "mem_used": _pad(n.mem_used, NP),
         "pods_used": _pad(n.pods_used, NP),
-        "labels": _pad(n.label_bits, NP),
-        "uport": _pad(n.used_port_bits, NP),
-        "uvol_any": _pad(n.used_vol_any_bits, NP),
-        "uvol_rw": _pad(n.used_vol_rw_bits, NP),
-        "svc_counts": _pad(n.service_counts, NP),
+        "labels": _pad(_pad_cols(n.label_bits, WORD_BUCKET), NP),
+        "uport": _pad(_pad_cols(n.used_port_bits, WORD_BUCKET), NP),
+        "uvol_any": _pad(_pad_cols(n.used_vol_any_bits, WORD_BUCKET), NP),
+        "uvol_rw": _pad(_pad_cols(n.used_vol_rw_bits, WORD_BUCKET), NP),
+        "svc_counts": _pad(_pad_cols(n.service_counts, SVC_BUCKET), NP),
         # Padding nodes are unschedulable -> never chosen.
         "sched": _pad(n.schedulable, NP, fill=False),
     }
+    return {k: _put(v, sharding) for k, v in nodes.items()}
 
+
+def node_axis_multiple(
+    mesh: Optional[jax.sharding.Mesh], pad_to: int = 128
+) -> int:
+    """Node-axis padding multiple: must divide evenly across mesh shards."""
+    if mesh is None:
+        return pad_to
+    return max(pad_to, int(np.prod([mesh.shape[a] for a in mesh.axis_names])))
+
+
+def shardings_for(mesh: Optional[jax.sharding.Mesh], node_axis: str = "nodes"):
+    """(node_sharding, pod_sharding) for a mesh (or the default device)."""
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
-        node_sharding = NamedSharding(mesh, PS(node_axis))
-        repl = NamedSharding(mesh, PS())
-        nodes = {
-            k: jax.device_put(v, node_sharding) for k, v in nodes.items()
-        }
-        pods = {k: jax.device_put(v, repl) for k, v in pods.items()}
-    else:
-        nodes = {k: jnp.asarray(v) for k, v in nodes.items()}
-        pods = {k: jnp.asarray(v) for k, v in pods.items()}
+        return NamedSharding(mesh, PS(node_axis)), NamedSharding(mesh, PS())
+    device = jax.devices()[0]
+    return device, device
 
-    return DeviceSnapshot(pods=pods, nodes=nodes, n_pods=P, n_nodes=N)
+
+def device_snapshot(
+    snap: Snapshot,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    node_axis: str = "nodes",
+    pad_to: int = 128,
+) -> DeviceSnapshot:
+    node_mult = node_axis_multiple(mesh, pad_to)
+    node_sharding, pod_sharding = shardings_for(mesh, node_axis)
+    return DeviceSnapshot(
+        pods=device_pods(snap.pods, pod_sharding, pad_to=pad_to),
+        nodes=device_nodes(
+            snap.nodes, node_sharding, pad_to=pad_to, node_mult=node_mult
+        ),
+        n_pods=snap.pods.count,
+        n_nodes=snap.nodes.count,
+    )

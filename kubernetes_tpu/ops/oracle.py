@@ -1,0 +1,136 @@
+"""Sequential NumPy oracle: the reference's scheduleOne semantics
+replayed pod-at-a-time in exact host arithmetic (int64 / float64).
+
+Role in the parity chain (BASELINE.md >=99% target):
+- The scalar object-graph oracle (scheduler.batch.schedule_backlog_scalar)
+  IS the reference semantics (plugin/pkg/scheduler/generic_scheduler.go:
+  60-171), but it is O(P^2 * N) Python — unusable beyond ~1k pods.
+- This oracle replays the same decisions over the columnar Snapshot with
+  one batch of NumPy N-vector ops per pod, so parity can be MEASURED at
+  the full 50k x 5k scale instead of asserted from toy runs.
+- Equivalence scalar-oracle == numpy-oracle is itself tested at fuzz
+  scale and at BASELINE config 2 (tests/test_solver_parity.py), so
+  device-vs-numpy parity at 50k is evidence about the device scan, and
+  scalar-vs-device parity at 1k is evidence about the lowering.
+
+Arithmetic notes: LeastRequested uses int64 // (Go int64 truncation,
+priorities.go:31-40); BalancedResourceAllocation and ServiceSpreading
+use float64 then int-truncate exactly like the scalar path
+(priorities.go:146-205, spreading.go:38-87). This intentionally does
+NOT reproduce the device's f32-reciprocal epsilon hack — divergence
+there is precisely what the parity number is meant to expose.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetes_tpu.models.columnar import Snapshot
+from kubernetes_tpu.ops.matrices import SVC_K, member_rows_to_ids
+
+
+def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
+    """i32[P] node indices (-1 = unschedulable), in pod order."""
+    p, n = snap.pods, snap.nodes
+    P, N = p.count, n.count
+    out = np.full(P, -1, dtype=np.int32)
+    if P == 0 or N == 0:
+        return out
+
+    cpu_cap = n.cpu_cap.astype(np.int64)
+    mem_cap = n.mem_cap.astype(np.int64)
+    pods_cap = n.pods_cap.astype(np.int64)
+    cpu_fit = n.cpu_fit_used.astype(np.int64).copy()
+    mem_fit = n.mem_fit_used.astype(np.int64).copy()
+    over = n.overcommitted.copy()
+    cpu_used = n.cpu_used.astype(np.int64).copy()
+    mem_used = n.mem_used.astype(np.int64).copy()
+    pods_used = n.pods_used.astype(np.int64).copy()
+    labels = n.label_bits
+    uport = n.used_port_bits.copy()
+    uvol_any = n.used_vol_any_bits.copy()
+    uvol_rw = n.used_vol_rw_bits.copy()
+    svc_counts = n.service_counts.astype(np.int64).copy()
+    sched = n.schedulable
+    idx = np.arange(N, dtype=np.int64)
+
+    pod_cpu = p.cpu_milli.astype(np.int64)
+    pod_mem = p.mem_mib.astype(np.int64)
+    sel_rows = p.sel_bits[p.selector_id]
+    # Same top-K membership truncation the device path commits with.
+    svc_ids = member_rows_to_ids(p.svc_member, SVC_K)
+
+    for i in range(P):
+        # -- predicates (solver.py _feasible formulas) --
+        fits_cpu = (cpu_cap == 0) | (cpu_fit + pod_cpu[i] <= cpu_cap)
+        fits_mem = (mem_cap == 0) | (mem_fit + pod_mem[i] <= mem_cap)
+        fits_count = pods_used + 1 <= pods_cap
+        if p.zero_req[i]:
+            res_ok = pods_used < pods_cap
+        else:
+            res_ok = (~over) & fits_cpu & fits_mem & fits_count
+        sel = sel_rows[i]
+        sel_ok = ((sel[None, :] & labels) == sel[None, :]).all(axis=1)
+        port_ok = ~(p.port_bits[i][None, :] & uport).any(axis=1)
+        vol_bad = (
+            (p.vol_rw_bits[i][None, :] & uvol_any)
+            | (p.vol_any_bits[i][None, :] & uvol_rw)
+        ).any(axis=1)
+        pin = int(p.pinned_node[i])
+        host_ok = True if pin == -1 else (idx == pin)
+        feas = res_ok & sel_ok & port_ok & ~vol_bad & host_ok & sched
+
+        # -- priorities (exact host arithmetic) --
+        creq = cpu_used + pod_cpu[i]
+        mreq = mem_used + pod_mem[i]
+        lr_c = np.where(
+            (cpu_cap == 0) | (creq > cpu_cap),
+            0,
+            ((cpu_cap - creq) * 10) // np.maximum(cpu_cap, 1),
+        )
+        lr_m = np.where(
+            (mem_cap == 0) | (mreq > mem_cap),
+            0,
+            ((mem_cap - mreq) * 10) // np.maximum(mem_cap, 1),
+        )
+        lr = (lr_c + lr_m) // 2
+        cfrac = np.where(cpu_cap == 0, 1.0, creq / np.maximum(cpu_cap, 1))
+        mfrac = np.where(mem_cap == 0, 1.0, mreq / np.maximum(mem_cap, 1))
+        bra = np.where(
+            (cfrac >= 1) | (mfrac >= 1),
+            0,
+            (10.0 - np.abs(cfrac - mfrac) * 10.0).astype(np.int64),
+        )
+        svc = int(p.service_id[i])
+        if svc < 0:
+            spread = np.full(N, 10, dtype=np.int64)
+        else:
+            counts = svc_counts[:, svc]
+            maxc = int(counts.max())
+            if maxc == 0:
+                spread = np.full(N, 10, dtype=np.int64)
+            else:
+                spread = (10.0 * ((maxc - counts) / maxc)).astype(np.int64)
+        score = lr + bra + spread
+
+        masked = np.where(feas, score, -1)
+        best = int(np.argmax(masked))  # first max = lowest node index
+        if masked[best] < 0:
+            continue
+        out[i] = best
+
+        # -- commit (AssumePod analog) --
+        cpu_fit[best] += pod_cpu[i]
+        mem_fit[best] += pod_mem[i]
+        cpu_used[best] += pod_cpu[i]
+        mem_used[best] += pod_mem[i]
+        pods_used[best] += 1
+        uport[best] |= p.port_bits[i]
+        uvol_any[best] |= p.vol_any_bits[i]
+        uvol_rw[best] |= p.vol_rw_bits[i]
+        ids = svc_ids[i]
+        ids = ids[ids >= 0]
+        if len(ids):
+            svc_counts[best, ids] += 1
+
+    return out
