@@ -13,6 +13,7 @@ too, after retries).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -20,6 +21,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.models.objects import now_iso
+
+# Process-wide event-name uniquifier (itertools.count is GIL-atomic).
+_event_seq = itertools.count()
 
 # Aggregation cache: the reference uses an LRU of 4096 with no TTL; a
 # TTL keeps long-lived daemons from resurrecting week-old counts.
@@ -105,7 +109,14 @@ class EventRecorder:
                 "kind": "Event",
                 "apiVersion": "v1",
                 "metadata": {
-                    "name": f"{meta.get('name', 'unknown')}.{int(time.time() * 1e6):x}",
+                    # Timestamp + per-process monotonic counter: two
+                    # distinct events in the same microsecond must not
+                    # collide, or the second create 409s and the event
+                    # is silently lost (advisor finding r1).
+                    "name": (
+                        f"{meta.get('name', 'unknown')}"
+                        f".{int(time.time() * 1e6):x}.{next(_event_seq):x}"
+                    ),
                     "namespace": ns,
                 },
                 "involvedObject": {

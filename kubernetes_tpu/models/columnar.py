@@ -53,6 +53,13 @@ from kubernetes_tpu.models.objects import (
 
 MIB = 1024 * 1024
 
+# Services a single pod can belong to on device (top-K id list; pods
+# matching more than SVC_K services contribute only their first SVC_K —
+# far beyond any realistic overlap). Shared by the device path
+# (ops.matrices), the sequential oracle, and the incremental session so
+# truncation is identical everywhere.
+SVC_K = 8
+
 
 # ---------------------------------------------------------------------------
 # Vocabularies
@@ -166,7 +173,7 @@ class PodColumns:
     vol_rw_bits: np.ndarray  # u32[P, VW] — read-write mounts only
     pinned_node: np.ndarray  # i32[P] — node index, -1 unpinned, -2 unknown
     service_id: np.ndarray  # i32[P] — first matching service, -1 if none
-    svc_member: np.ndarray  # f32[P, S] — 1.0 per service whose selector matches
+    svc_topk: np.ndarray  # i32[P, SVC_K] — matching service ids, -1 pad
     sel_bits: np.ndarray  # u32[U, LW] — deduped selector table
 
     @property
@@ -258,8 +265,13 @@ class ServiceMatcher:
         # Pods from one RC share an identical label set, so membership
         # is memoized by (namespace, labels) signature: a 50k-pod
         # backlog with a few hundred distinct templates costs a few
-        # hundred matches, not 50k.
+        # hundred matches, not 50k. Bounded: long-lived sessions
+        # (incremental.SolverSession holds one matcher for its life)
+        # feeding per-pod-unique labels must not grow host memory
+        # without limit — on overflow the cache resets wholesale
+        # (recomputing a membership is cheap; unbounded growth is not).
         self._id_cache: Dict[Tuple, Tuple[np.ndarray, int]] = {}
+        self._cache_limit = 65536
         by_ns: Dict[str, Dict[Tuple[str, str], List[int]]] = {}
         for i, svc in enumerate(services):
             sel = svc.spec.selector
@@ -313,6 +325,8 @@ class ServiceMatcher:
                 counts[ids] += 1
         matched = np.nonzero((counts == self._sel_size) & (self._sel_size > 0))[0]
         hit = (matched, int(matched[0]) if len(matched) else -1)
+        if len(self._id_cache) >= self._cache_limit:
+            self._id_cache.clear()
         self._id_cache[key] = hit
         return hit
 
@@ -414,7 +428,7 @@ class SnapshotBuilder:
         zero_req = np.zeros(P, dtype=bool)
         pinned = np.full(P, -1, dtype=np.int32)
         service_id = np.full(P, -1, dtype=np.int32)
-        svc_member = np.zeros((P, max(self.S, 1)), dtype=np.float32)
+        svc_topk = np.full((P, SVC_K), -1, dtype=np.int32)
         port_id_lists: List[List[int]] = []
         vol_any_lists: List[List[int]] = []
         vol_rw_lists: List[List[int]] = []
@@ -433,7 +447,8 @@ class SnapshotBuilder:
                 pinned[i] = self.node_index.get(p.spec.node_name, -2)
             ids, first = self.matcher.membership_ids(p)
             if len(ids):
-                svc_member[i, ids] = 1.0
+                k = min(len(ids), SVC_K)
+                svc_topk[i, :k] = ids[:k]
             service_id[i] = first
         return PodColumns(
             names=[pod_key(p) for p in chunk],
@@ -446,7 +461,7 @@ class SnapshotBuilder:
             vol_rw_bits=native.pack_bitsets(vol_rw_lists, self.VW),
             pinned_node=pinned,
             service_id=service_id,
-            svc_member=svc_member,
+            svc_topk=svc_topk,
             sel_bits=self.sel_bits,
         )
 

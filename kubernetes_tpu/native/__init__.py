@@ -30,12 +30,31 @@ _p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 
+_SOURCES = (
+    os.path.join(_REPO_ROOT, "native", "columnar.cc"),
+    os.path.join(_REPO_ROOT, "native", "Makefile"),
+)
+
+
+def _stale() -> bool:
+    """True when a native source is newer than the built .so — loading
+    a stale kernel silently runs old semantics (advisor finding r1)."""
+    try:
+        built = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+    return any(
+        os.path.exists(src) and os.path.getmtime(src) > built
+        for src in _SOURCES
+    )
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(_LIB_PATH) or _stale():
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
@@ -56,8 +75,9 @@ def available() -> bool:
 
 
 def ensure_built(quiet: bool = True) -> bool:
-    """Build the native lib if the toolchain is around (best-effort)."""
-    if available():
+    """Build the native lib if the toolchain is around (best-effort);
+    rebuilds when sources are newer than the .so."""
+    if available() and not _stale():
         return True
     try:
         subprocess.run(
@@ -66,7 +86,8 @@ def ensure_built(quiet: bool = True) -> bool:
         )
     except (OSError, subprocess.CalledProcessError):
         return False
-    global _load_attempted
+    global _lib, _load_attempted
+    _lib = None
     _load_attempted = False
     return available()
 
@@ -101,6 +122,15 @@ def pack_bitsets(
             dtype=np.int32,
             count=int(offsets[-1]),
         )
+        # The C kernel does no bounds checking (it would be heap
+        # corruption); match the NumPy fallback's IndexError instead.
+        if len(flat) and (
+            int(flat.max()) >= words * 32 or int(flat.min()) < 0
+        ):
+            raise IndexError(
+                f"bitset id out of range for {words} words "
+                f"(max {int(flat.max())}, min {int(flat.min())})"
+            )
         lib.pack_bitsets(n, words, offsets, flat, out)
         return out
     for i, ids in enumerate(id_lists):
@@ -118,6 +148,12 @@ def or_rows_by_index(
     node_idx = np.ascontiguousarray(node_idx, dtype=np.int32)
     pod_rows = np.ascontiguousarray(pod_rows, dtype=np.uint32)
     if lib is not None and node_rows.flags["C_CONTIGUOUS"]:
+        # Match the NumPy fallback's IndexError; the C kernel would
+        # write out of bounds (negative indices are skipped by design).
+        if len(node_idx) and int(node_idx.max()) >= node_rows.shape[0]:
+            raise IndexError(
+                f"node index {int(node_idx.max())} >= {node_rows.shape[0]}"
+            )
         lib.or_rows_by_index(
             len(node_idx), pod_rows.shape[1], node_idx, pod_rows, node_rows
         )
@@ -147,6 +183,10 @@ def greedy_fit(
     cpu = np.ascontiguousarray(cpu, dtype=np.float32)
     mem = np.ascontiguousarray(mem, dtype=np.float32)
     if lib is not None and over.dtype == np.bool_ and over.flags["C_CONTIGUOUS"]:
+        if len(node_idx) and int(node_idx.max()) >= len(cpu_cap):
+            raise IndexError(
+                f"node index {int(node_idx.max())} >= {len(cpu_cap)}"
+            )
         lib.greedy_fit(
             len(node_idx), node_idx, cpu, mem, cpu_cap, mem_cap,
             cpu_fit, mem_fit, over.view(np.uint8), cpu_used, mem_used,

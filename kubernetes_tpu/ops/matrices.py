@@ -21,29 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubernetes_tpu.models.columnar import Snapshot
-
-# Services a single pod can belong to on device (top-K id list; the
-# dense membership row stays host-side). Pods matching more than
-# SVC_K services contribute only their first SVC_K — far beyond any
-# realistic overlap.
-SVC_K = 8
-
-
-def member_rows_to_ids(member: np.ndarray, k: int = SVC_K) -> np.ndarray:
-    """Dense multi-hot f32[P, S] -> first-k indices i32[P, k], -1 pad."""
-    P = member.shape[0]
-    ids = np.full((P, k), -1, dtype=np.int32)
-    if P == 0:
-        return ids
-    rows, cols = np.nonzero(member)
-    if len(rows) == 0:
-        return ids
-    first = np.searchsorted(rows, np.arange(P), side="left")
-    pos = np.arange(len(rows)) - first[rows]
-    keep = pos < k
-    ids[rows[keep], pos[keep]] = cols[keep]
-    return ids
+from kubernetes_tpu.models.columnar import SVC_K, Snapshot  # noqa: F401
+# (SVC_K re-exported: device consumers import it from here.)
 
 
 def _pad(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
@@ -130,7 +109,7 @@ def device_pods(
         # always come back unassigned.
         "pinned": _pad(p.pinned_node, PP, fill=-2),
         "svc": _pad(p.service_id, PP, fill=-1),
-        "svc_ids": _pad(member_rows_to_ids(p.svc_member), PP, fill=-1),
+        "svc_ids": _pad(p.svc_topk, PP, fill=-1),
     }
     return {k: _put(v, sharding) for k, v in pods.items()}
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from kubernetes_tpu.models.columnar import Snapshot
-from kubernetes_tpu.ops.matrices import SVC_K, member_rows_to_ids
+from kubernetes_tpu.models.columnar import SVC_K  # noqa: F401
 
 
 def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
@@ -58,7 +58,7 @@ def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
     pod_mem = p.mem_mib.astype(np.int64)
     sel_rows = p.sel_bits[p.selector_id]
     # Same top-K membership truncation the device path commits with.
-    svc_ids = member_rows_to_ids(p.svc_member, SVC_K)
+    svc_ids = p.svc_topk
 
     for i in range(P):
         # -- predicates (solver.py _feasible formulas) --

@@ -105,18 +105,32 @@ class Proxier:
             return self._services.get(name)
 
     def _ensure_service(self, name: ServicePortName, svc, port) -> None:
+        # The whole create/reconfigure path runs under the lock:
+        # check-then-act with the lock released in between let two
+        # threads (informer handler + ProxyServer.start priming) both
+        # open a listener for the same service, leaking the loser's
+        # socket and accept thread (advisor finding r1). Creation is
+        # rare and cheap (local bind); the data path doesn't take this
+        # lock.
         with self._lock:
-            info = self._services.get(name)
-            if info is not None:
-                if (
-                    info.portal_ip == svc.spec.cluster_ip
-                    and info.portal_port == port.port
-                    and info.protocol == port.protocol.upper()
-                    and info.session_affinity == (svc.spec.session_affinity or "None")
-                    and info.node_port == getattr(port, "node_port", 0)
-                ):
-                    return  # unchanged
+            self._ensure_service_locked(name, svc, port)
+
+    def _ensure_service_locked(self, name: ServicePortName, svc, port) -> None:
+        if self._stopped:
+            # stop() may have run between on_update's check and this
+            # acquisition; creating a portal now would leak its socket
+            # and accept thread past shutdown.
+            return
+        info = self._services.get(name)
         if info is not None:
+            if (
+                info.portal_ip == svc.spec.cluster_ip
+                and info.portal_port == port.port
+                and info.protocol == port.protocol.upper()
+                and info.session_affinity == (svc.spec.session_affinity or "None")
+                and info.node_port == getattr(port, "node_port", 0)
+            ):
+                return  # unchanged
             # Reconfiguration: tear down the portal but KEEP the load
             # balancer's endpoint list — endpoints didn't change, and a
             # fresh empty entry would blackhole until the next
@@ -164,8 +178,7 @@ class Proxier:
             daemon=True,
         )
         info.threads.append(accept)
-        with self._lock:
-            self._services[name] = info
+        self._services[name] = info
         accept.start()
 
     def _open_socket(self, proto: str):
