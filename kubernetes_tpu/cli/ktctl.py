@@ -387,6 +387,37 @@ def cmd_run(client: Client, args) -> int:
     return 0
 
 
+def cmd_logs(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/log.go — fetch container logs via the
+    apiserver's pod log subresource."""
+    out = client.pod_logs(
+        args.name,
+        namespace=args.namespace,
+        container=args.container or "",
+        tail=args.tail,
+    )
+    sys.stdout.write(out)
+    if out and not out.endswith("\n"):
+        sys.stdout.write("\n")
+    return 0
+
+
+def cmd_exec(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/exec.go — run a command in a
+    container (JSON run-exec; no tty streaming)."""
+    result = client.pod_exec(
+        args.name,
+        args.cmd,
+        namespace=args.namespace,
+        container=args.container or "",
+    )
+    output = result.get("output", "")
+    sys.stdout.write(output)
+    if output and not output.endswith("\n"):
+        sys.stdout.write("\n")
+    return int(result.get("exitCode", 0))
+
+
 def cmd_api_resources(client: Client, args) -> int:
     seen = set()
     print(f"{'NAME':32}{'NAMESPACED':12}KIND")
@@ -466,6 +497,18 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--cpu", default="100m")
     rn.add_argument("--memory", default="64Mi")
     rn.set_defaults(fn=cmd_run)
+
+    lg = sub.add_parser("logs", parents=[common])
+    lg.add_argument("name")
+    lg.add_argument("--container", "-c", default="")
+    lg.add_argument("--tail", type=int, default=None)
+    lg.set_defaults(fn=cmd_logs)
+
+    ee = sub.add_parser("exec", parents=[common])
+    ee.add_argument("name")
+    ee.add_argument("--container", "-c", default="")
+    ee.add_argument("cmd", nargs="+")
+    ee.set_defaults(fn=cmd_exec)
 
     ar = sub.add_parser("api-resources", parents=[common])
     ar.set_defaults(fn=cmd_api_resources)

@@ -151,7 +151,16 @@ class HTTPTransport(Transport):
             return f"/api/v1/namespaces/{namespace}/{info.name}"
         return f"/api/v1/{info.name}"
 
-    def _do(self, verb: str, path: str, query: dict = None, body: dict = None):
+    def _do(
+        self,
+        verb: str,
+        path: str,
+        query: dict = None,
+        body: dict = None,
+        raw: bool = False,
+    ):
+        """One request. raw=True returns the response text verbatim
+        (pod logs); otherwise the JSON-decoded body."""
         conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
         try:
             if query:
@@ -160,14 +169,20 @@ class HTTPTransport(Transport):
             headers = {"Content-Type": "application/json"} if payload else {}
             conn.request(verb, path, body=payload, headers=headers)
             resp = conn.getresponse()
-            data = json.loads(resp.read() or b"{}")
+            raw_body = resp.read()
             if resp.status >= 400:
+                try:
+                    data = json.loads(raw_body or b"{}")
+                except json.JSONDecodeError:
+                    data = {}
                 raise APIError(
                     data.get("code", resp.status),
                     data.get("reason", "Unknown"),
                     data.get("message", f"HTTP {resp.status}"),
                 )
-            return data
+            if raw:
+                return raw_body.decode(errors="replace")
+            return json.loads(raw_body or b"{}")
         finally:
             conn.close()
 
@@ -217,6 +232,25 @@ class HTTPTransport(Transport):
         if op == "finalize_namespace":
             (name,) = args
             return self._do("PUT", f"/api/v1/namespaces/{name}/finalize", body=body)
+        if op == "pod_log":
+            namespace, name, container, tail = args
+            return self._do(
+                "GET",
+                f"/api/v1/namespaces/{namespace or 'default'}/pods/{name}/log",
+                query={
+                    "container": container,
+                    "tailLines": str(tail) if tail is not None else "",
+                },
+                raw=True,
+            )
+        if op == "pod_exec":
+            namespace, name, container = args
+            return self._do(
+                "POST",
+                f"/api/v1/namespaces/{namespace or 'default'}/pods/{name}/exec",
+                query={"container": container},
+                body=body,
+            )
         raise ValueError(f"unknown op {op!r}")
 
     def watch(self, resource, namespace, since, lsel, fsel):
@@ -321,6 +355,31 @@ class Client:
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         self._throttle()
         self.t.request("DELETE", "delete", (resource, namespace, name))
+
+    def pod_logs(
+        self,
+        name: str,
+        namespace: str = "default",
+        container: str = "",
+        tail: Optional[int] = None,
+    ) -> str:
+        """GET /pods/{name}/log (relayed through the apiserver from the
+        pod's kubelet)."""
+        self._throttle()
+        return self.t.request("GET", "pod_log", (namespace, name, container, tail))
+
+    def pod_exec(
+        self,
+        name: str,
+        command: List[str],
+        namespace: str = "default",
+        container: str = "",
+    ) -> dict:
+        """POST /pods/{name}/exec — returns {"exitCode", "output"}."""
+        self._throttle()
+        return self.t.request(
+            "POST", "pod_exec", (namespace, name, container), {"command": command}
+        )
 
     def finalize_namespace(self, name: str, finalizers) -> None:
         """PUT the namespace 'finalize' subresource

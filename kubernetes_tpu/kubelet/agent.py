@@ -25,6 +25,7 @@ from kubernetes_tpu.models import serde
 from kubernetes_tpu.models.objects import (
     ContainerStatus,
     Node,
+    NodeAddress,
     NodeCondition,
     Pod,
     PodCondition,
@@ -45,6 +46,15 @@ _PODS_RUNNING = metrics.DEFAULT.gauge(
 
 def _decode_pod(wire: dict) -> Pod:
     return serde.from_wire(Pod, wire)
+
+
+def _proc_rss(pid: str) -> int:
+    """Resident set bytes from /proc (cadvisor-stats analog)."""
+    try:
+        with open(f"/proc/{int(pid)}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
 
 
 class _PodWorker:
@@ -93,10 +103,16 @@ class Kubelet:
         manifest_dir: Optional[str] = None,
         root_dir: Optional[str] = None,
         mounter=None,
+        serve_http: bool = False,
+        http_port: int = 0,
     ):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime or FakeRuntime()
+        # HTTP API (reference kubelet port 10250, pkg/kubelet/server.go).
+        self.http: Optional[object] = None
+        self._serve_http = serve_http
+        self._http_port = http_port
         # Volume subsystem: active when a root dir is configured
         # (reference: kubelet --root-dir, default /var/lib/kubelet).
         self.volumes = None
@@ -137,6 +153,10 @@ class Kubelet:
     # -- lifecycle ----------------------------------------------------
 
     def start(self) -> "Kubelet":
+        if self._serve_http:
+            from kubernetes_tpu.kubelet.server import KubeletServer
+
+            self.http = KubeletServer(self, port=self._http_port).start()
         self.register_node()
         self.pods.start()
         self.pods.wait_for_sync()
@@ -153,21 +173,31 @@ class Kubelet:
     def stop(self) -> None:
         self._stop.set()
         self.pods.stop()
+        if self.http is not None:
+            self.http.stop()
         for t in self._threads:
             t.join(timeout=2)
 
     # -- node registration + heartbeat (NodeStatus) -------------------
 
-    def register_node(self) -> None:
-        node = Node()
-        node.metadata.name = self.node_name
-        node.metadata.labels = dict(self.labels)
+    def _fill_status(self, node: Node) -> None:
+        node.status.conditions = [self._ready_condition()]
         node.status.capacity = {
             "cpu": parse_quantity(self.cpu),
             "memory": parse_quantity(self.memory),
             "pods": parse_quantity(str(self.max_pods)),
         }
-        node.status.conditions = [self._ready_condition()]
+        node.status.addresses = [
+            NodeAddress(type="InternalIP", address="127.0.0.1")
+        ]
+        if self.http is not None:
+            node.status.daemon_endpoints.kubelet_endpoint.port = self.http.port
+
+    def register_node(self) -> None:
+        node = Node()
+        node.metadata.name = self.node_name
+        node.metadata.labels = dict(self.labels)
+        self._fill_status(node)
         try:
             self.client.create("nodes", node)
         except APIError as e:
@@ -190,12 +220,7 @@ class Kubelet:
         except APIError:
             self.register_node()
             return
-        node.status.conditions = [self._ready_condition()]
-        node.status.capacity = {
-            "cpu": parse_quantity(self.cpu),
-            "memory": parse_quantity(self.memory),
-            "pods": parse_quantity(str(self.max_pods)),
-        }
+        self._fill_status(node)
         try:
             self.client.update_status("nodes", node)
         except APIError:
@@ -207,6 +232,41 @@ class Kubelet:
                 self._heartbeat()
             except Exception:
                 pass
+
+    # -- HTTP API data (reference /spec + /stats, cadvisor-backed) ----
+
+    def node_spec(self) -> dict:
+        """Machine spec (reference GET /spec/, cadvisor MachineInfo)."""
+        return {
+            "nodeName": self.node_name,
+            "capacity": {
+                "cpu": self.cpu,
+                "memory": self.memory,
+                "pods": str(self.max_pods),
+            },
+            "labels": dict(self.labels),
+        }
+
+    def node_stats(self) -> dict:
+        """Node + per-pod container stats (reference GET /stats/...;
+        process runtimes report real RSS from /proc)."""
+        pods = {}
+        for uid, containers in self.runtime.list_pods().items():
+            stats = []
+            for c in containers:
+                entry = {
+                    "name": c.name,
+                    "state": c.state,
+                    "restartCount": c.restart_count,
+                    "uptimeSeconds": round(
+                        max(0.0, time.monotonic() - c.started_at), 3
+                    ),
+                }
+                if c.container_id.startswith("proc://"):
+                    entry["rssBytes"] = _proc_rss(c.container_id[7:])
+                stats.append(entry)
+            pods[uid] = stats
+        return {"nodeName": self.node_name, "pods": pods}
 
     # -- pod sync -----------------------------------------------------
 

@@ -1,0 +1,189 @@
+"""Kubelet HTTP API.
+
+Reference: pkg/kubelet/server.go:130-144 — the read/exec surface every
+node agent serves on port 10250: /pods, /healthz, /stats, /spec,
+/run/..., /exec/..., and (apiserver-proxied) container logs. The
+apiserver's pod subresources (GET /pods/{p}/log, POST /pods/{p}/exec —
+pkg/registry/pod/etcd/etcd.go:42-50) proxy here after resolving the
+pod's node.
+
+Deviation from the reference: /exec speaks plain JSON request/response
+instead of an SPDY stream upgrade (pkg/util/httpstream) — the v0.19
+/run endpoint (non-streaming exec) is the semantic this implements for
+both paths.
+
+Routes:
+  GET  /healthz
+  GET  /pods
+  GET  /spec
+  GET  /stats                         node + per-pod container stats
+  GET  /logs/{ns}/{pod}/{container}?tail=N
+  POST /run/{ns}/{pod}/{container}    body {"command": [...]}
+  POST /exec/{ns}/{pod}/{container}   alias of /run (JSON, not SPDY)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.models import serde
+
+
+class _KubeletHandler(BaseHTTPRequestHandler):
+    kubelet = None  # bound by KubeletServer
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- helpers ------------------------------------------------------
+
+    def _send(self, code: int, body, content_type="application/json") -> None:
+        data = (
+            body.encode()
+            if isinstance(body, str)
+            else json.dumps(body).encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _pod_and_uid(self, ns: str, name: str):
+        for pod in self.kubelet.pods.store.list():
+            if (
+                pod.metadata.name == name
+                and (pod.metadata.namespace or "default") == ns
+            ):
+                return pod, pod.metadata.uid or pod.metadata.name
+        return None, None
+
+    # -- GET ----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if url.path == "/healthz":
+                self._send(200, "ok", "text/plain")
+            elif url.path == "/pods":
+                items = [
+                    serde.to_wire(p) for p in self.kubelet.pods.store.list()
+                ]
+                self._send(200, {"kind": "PodList", "items": items})
+            elif url.path == "/spec":
+                self._send(200, self.kubelet.node_spec())
+            elif url.path == "/stats":
+                self._send(200, self.kubelet.node_stats())
+            elif len(parts) == 4 and parts[0] == "logs":
+                self._get_logs(parts[1], parts[2], parts[3], url)
+            else:
+                self._send(404, {"error": f"no route {url.path}"})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # crash containment per request
+            try:
+                self._send(500, {"error": str(e)})
+            except Exception:
+                pass
+
+    def _get_logs(self, ns: str, name: str, container: str, url) -> None:
+        pod, uid = self._pod_and_uid(ns, name)
+        if pod is None:
+            self._send(404, {"error": f"pod {ns}/{name} not on this node"})
+            return
+        rt = self.kubelet.runtime
+        if not hasattr(rt, "read_logs"):
+            self._send(501, {"error": "runtime does not expose logs"})
+            return
+        q = parse_qs(url.query)
+        tail = None
+        if "tail" in q or "tailLines" in q:
+            try:
+                tail = int((q.get("tail") or q.get("tailLines"))[0])
+            except (ValueError, TypeError):
+                tail = None
+        self._send(200, rt.read_logs(uid, container, tail), "text/plain")
+
+    # -- POST (run / exec) --------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if len(parts) == 4 and parts[0] in ("run", "exec"):
+                self._run(parts[1], parts[2], parts[3], url)
+            else:
+                self._send(404, {"error": f"no route {url.path}"})
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            try:
+                self._send(500, {"error": str(e)})
+            except Exception:
+                pass
+
+    def _run(self, ns: str, name: str, container: str, url) -> None:
+        pod, uid = self._pod_and_uid(ns, name)
+        if pod is None:
+            self._send(404, {"error": f"pod {ns}/{name} not on this node"})
+            return
+        rt = self.kubelet.runtime
+        if not hasattr(rt, "exec_in_container"):
+            self._send(501, {"error": "runtime does not support exec"})
+            return
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        command = []
+        if length:
+            try:
+                body = json.loads(self.rfile.read(length))
+                command = body.get("command", [])
+            except (json.JSONDecodeError, AttributeError):
+                pass
+        if not command:
+            # Reference /run also accepts cmd via query params.
+            command = parse_qs(url.query).get("cmd", [])
+        if not command:
+            self._send(400, {"error": "no command"})
+            return
+        rc, output = rt.exec_in_container(uid, container, command, pod=pod)
+        self._send(200, {"exitCode": rc, "output": output})
+
+
+class KubeletServer:
+    """Owns the kubelet's HTTP listener (reference port 10250; here an
+    ephemeral port published via the Node's daemon endpoints)."""
+
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundKubeletHandler", (_KubeletHandler,), {"kubelet": kubelet})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "KubeletServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

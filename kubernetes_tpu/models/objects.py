@@ -320,6 +320,21 @@ class NodeAddress:
 
 
 @dataclass
+class DaemonEndpoint:
+    port: int = 0
+
+
+@dataclass
+class NodeDaemonEndpoints:
+    """Where this node's kubelet API listens. The reference hard-codes
+    port 10250 and dials node addresses (pkg/master/master.go:497-520);
+    publishing the endpoint in NodeStatus is the discovery seam our
+    apiserver uses to proxy pod log/exec subresources."""
+
+    kubelet_endpoint: DaemonEndpoint = field(default_factory=DaemonEndpoint)
+
+
+@dataclass
 class NodeStatus:
     """Reference: pkg/api/types.go NodeStatus (capacity drives scheduling)."""
 
@@ -327,6 +342,9 @@ class NodeStatus:
     phase: str = ""
     conditions: List[NodeCondition] = field(default_factory=list)
     addresses: List[NodeAddress] = field(default_factory=list)
+    daemon_endpoints: NodeDaemonEndpoints = field(
+        default_factory=NodeDaemonEndpoints
+    )
     node_info: Dict[str, str] = field(default_factory=dict)
 
 

@@ -57,7 +57,23 @@ def _load() -> Optional[ctypes.CDLL]:
     if not os.path.exists(_LIB_PATH) or _stale():
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        # Load through a unique temp copy: dlopen caches by pathname,
+        # so re-loading _LIB_PATH after an in-process rebuild would
+        # silently return the OLD mapping. The copy is unlinked right
+        # after load (the mapping survives the unlink on Linux).
+        import shutil
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=".so", prefix="kubetpu-")
+        os.close(fd)
+        shutil.copyfile(_LIB_PATH, tmp)
+        try:
+            lib = ctypes.CDLL(tmp)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         lib.pack_bitsets.argtypes = [_i64, _i64, _p_i64, _p_i32, _p_u32]
         lib.or_rows_by_index.argtypes = [_i64, _i64, _p_i32, _p_u32, _p_u32]
         lib.greedy_fit.argtypes = [

@@ -20,6 +20,7 @@ callers use the client layer.
 from __future__ import annotations
 
 import contextlib
+import json
 import random
 import string
 import threading
@@ -420,6 +421,98 @@ class APIServer:
             )
         except AdmissionError as e:
             raise APIError(e.code, e.reason, e.message)
+
+    def kubelet_location(self, namespace: str, name: str) -> Tuple[str, dict]:
+        """Resolve the kubelet API base URL serving a pod — the routing
+        half of the log/exec subresources (reference: LogLocation /
+        ExecLocation in pkg/registry/pod/rest.go resolve node host +
+        port 10250; we read the port from NodeStatus daemon endpoints).
+        Returns (base_url, pod_wire)."""
+        pod = self.get("pods", namespace, name)
+        node_name = pod.get("spec", {}).get("nodeName", "")
+        if not node_name:
+            raise APIError(
+                409, "Conflict", f"pod {name!r} is not scheduled to a node yet"
+            )
+        node = self.get("nodes", "", node_name)
+        status = node.get("status", {})
+        port = (
+            status.get("daemonEndpoints", {})
+            .get("kubeletEndpoint", {})
+            .get("port", 0)
+        )
+        if not port:
+            raise APIError(
+                501,
+                "NotImplemented",
+                f"node {node_name!r} does not publish a kubelet API endpoint",
+            )
+        ip = next(
+            (
+                a.get("address")
+                for a in status.get("addresses", [])
+                if a.get("type") == "InternalIP"
+            ),
+            "127.0.0.1",
+        )
+        return f"http://{ip}:{port}", pod
+
+    def _pod_container(self, pod: dict, container: str) -> str:
+        if container:
+            return container
+        containers = pod.get("spec", {}).get("containers", [])
+        return containers[0].get("name", "") if containers else ""
+
+    def pod_log(
+        self,
+        namespace: str,
+        name: str,
+        container: str = "",
+        tail: Optional[int] = None,
+    ) -> str:
+        """GET /pods/{name}/log — relayed from the pod's kubelet
+        (reference: LogREST, pkg/registry/pod/etcd/etcd.go:45)."""
+        import urllib.error
+        import urllib.request
+
+        base, pod = self.kubelet_location(namespace, name)
+        container = self._pod_container(pod, container)
+        url = f"{base}/logs/{namespace or 'default'}/{name}/{container}"
+        if tail is not None:
+            url += f"?tail={int(tail)}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.read().decode(errors="replace")
+        except urllib.error.URLError as e:
+            raise APIError(502, "BadGateway", f"kubelet log fetch failed: {e}")
+
+    def pod_exec(
+        self, namespace: str, name: str, container: str, body: dict
+    ) -> dict:
+        """POST /pods/{name}/exec — admission-gated, then relayed to the
+        pod's kubelet as JSON run-style exec (reference: ExecLocation +
+        pkg/kubelet/server.go /exec/)."""
+        import urllib.error
+        import urllib.request
+
+        self.connect("pods", namespace, name, "exec")
+        command = (body or {}).get("command", [])
+        if not command:
+            raise _bad_request("exec requires a command")
+        base, pod = self.kubelet_location(namespace, name)
+        container = self._pod_container(pod, container)
+        url = f"{base}/exec/{namespace or 'default'}/{name}/{container}"
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({"command": command}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.URLError as e:
+            raise APIError(502, "BadGateway", f"kubelet exec failed: {e}")
 
     def update_status(self, resource: str, namespace: str, name: str, obj: dict) -> dict:
         """Status subresource: replace only .status (pkg/registry/pod/etcd

@@ -262,17 +262,31 @@ class _Handler(BaseHTTPRequestHandler):
                 out = api.update_status(resource, ns, name, self._read_body())
                 self._send_json(200, out)
                 return resource, 200
-            if len(rest) == 5 and rest[4] in ("exec", "attach") and verb == "POST":
+            if (
+                len(rest) == 5
+                and rest[4] == "log"
+                and resource == "pods"
+                and verb == "GET"
+            ):
+                # GET /pods/{name}/log (pkg/registry/pod/etcd/etcd.go:45
+                # LogREST): resolve the pod's kubelet and relay.
+                return self._pod_log(ns, name)
+            if len(rest) == 5 and rest[4] in ("exec", "attach", "run") and verb == "POST":
                 # CONNECT subresources (pkg/apiserver/api_installer.go
-                # CONNECT routes). Admission (DenyExecOnPrivileged) runs;
-                # the stream itself is served by the node agent's API
-                # (pkg/kubelet/server.go /exec/), not the apiserver.
-                api.connect(resource, ns, name, rest[4])
-                raise APIError(
-                    501,
-                    "NotImplemented",
-                    f"{rest[4]} streaming is served by the node agent API",
-                )
+                # CONNECT routes). Admission (DenyExecOnPrivileged) runs
+                # inside pod_exec; the call relays to the node agent's
+                # API (pkg/kubelet/server.go /exec/) as JSON run-exec.
+                if resource != "pods":
+                    raise APIError(
+                        404, "NotFound", f"{resource} has no {rest[4]} subresource"
+                    )
+                body = self._read_body()
+                container = self.query.get("container") or body.get("container", "")
+                if "command" not in body and "command" in self.query:
+                    body["command"] = [self.query["command"]]
+                out = api.pod_exec(ns, name, container, body)
+                self._send_json(200, out)
+                return "pods/exec", 200
             if len(rest) == 4:
                 return self._item(verb, resource, ns, name)
             raise APIError(404, "NotFound", f"unknown path {self.path!r}")
@@ -291,6 +305,32 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             return self._item(verb, resource, "", rest[1])
         raise APIError(404, "NotFound", f"unknown path {self.path!r}")
+
+    # -- pod subresources proxied to the kubelet API ------------------
+
+    def _pod_log(self, ns: str, name: str) -> Tuple[str, int]:
+        tail_raw = self.query.get("tailLines") or self.query.get("tail")
+        tail = None
+        if tail_raw:
+            try:
+                tail = int(tail_raw)
+            except ValueError:
+                raise APIError(
+                    400, "BadRequest", f"invalid tailLines {tail_raw!r}"
+                )
+        text = self.api.pod_log(
+            ns,
+            name,
+            container=self.query.get("container", ""),
+            tail=tail,
+        )
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return "pods/log", 200
 
     def _collection(self, verb, resource, ns, lsel, fsel) -> Tuple[str, int]:
         api = self.api

@@ -1,0 +1,318 @@
+"""Process runtime + kubelet HTTP API + pod log/exec subresources.
+
+The pods here are REAL OS processes anchored by the native pause binary
+(reference: dockertools/manager.go SyncPod + third_party/pause;
+pkg/kubelet/server.go:130-144 for the HTTP surface)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.kubelet.agent import Kubelet
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+from kubernetes_tpu.models.objects import (
+    Container,
+    EnvVar,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+
+
+def mk_pod(name, command, uid="", containers=None, ns="default"):
+    specs = containers or [Container(name="main", image="app", command=command)]
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, uid=uid or name),
+        spec=PodSpec(containers=specs),
+    )
+    return pod
+
+
+def wait_for(cond, timeout=5.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+@pytest.fixture
+def runtime(tmp_path):
+    rt = ProcessRuntime(str(tmp_path / "kubelet"), node_name="n1")
+    yield rt
+    for uid in list(rt.list_pods()):
+        rt.kill_pod(uid)
+
+
+class TestProcessRuntime:
+    def test_pod_runs_real_processes_with_anchor(self, runtime):
+        pod = mk_pod("web", ["/bin/sh", "-c", "sleep 30"])
+        containers = runtime.sync_pod(pod)
+        assert len(containers) == 1
+        assert containers[0].state == "running"
+        pid = int(containers[0].container_id.split("//")[1])
+        os.kill(pid, 0)  # real process exists
+        anchor = runtime.anchor_pid("web")
+        assert anchor is not None
+        os.kill(anchor, 0)  # pause anchor is alive too
+        runtime.kill_pod("web")
+        assert wait_for(lambda: not _alive(pid))
+        assert not _alive(anchor)
+
+    def test_exited_container_reports_exit_code(self, runtime):
+        pod = mk_pod("oneshot", ["/bin/sh", "-c", "exit 3"])
+        runtime.sync_pod(pod)
+        assert wait_for(
+            lambda: runtime.sync_pod(pod)[0].state == "exited"
+        )
+        assert runtime.sync_pod(pod)[0].exit_code == 3
+
+    def test_spec_change_recreates_with_restart_count(self, runtime):
+        pod = mk_pod("app", ["/bin/sh", "-c", "sleep 30"])
+        first = runtime.sync_pod(pod)[0]
+        pod.spec.containers[0].command = ["/bin/sh", "-c", "sleep 60"]
+        second = runtime.sync_pod(pod)[0]
+        assert second.restart_count == first.restart_count + 1
+        assert second.container_id != first.container_id
+
+    def test_logs_capture_stdout(self, runtime):
+        pod = mk_pod("logger", ["/bin/sh", "-c", "echo hello-from-pod; sleep 30"])
+        runtime.sync_pod(pod)
+        assert wait_for(
+            lambda: "hello-from-pod" in runtime.read_logs("logger", "main")
+        )
+
+    def test_logs_tail(self, runtime):
+        pod = mk_pod(
+            "tailer", ["/bin/sh", "-c", "for i in 1 2 3 4 5; do echo line$i; done; sleep 30"]
+        )
+        runtime.sync_pod(pod)
+        assert wait_for(lambda: "line5" in runtime.read_logs("tailer", "main"))
+        tail = runtime.read_logs("tailer", "main", tail_lines=2)
+        assert tail.splitlines() == ["line4", "line5"]
+
+    def test_exec_in_container(self, runtime):
+        pod = mk_pod("target", ["/bin/sh", "-c", "sleep 30"])
+        runtime.sync_pod(pod)
+        rc, out = runtime.exec_in_container(
+            "target", "main", ["/bin/sh", "-c", "echo $KUBERNETES_CONTAINER_NAME"],
+            pod=pod,
+        )
+        assert rc == 0
+        assert "main" in out
+
+    def test_exec_probe_success_and_failure(self, runtime):
+        pod = mk_pod("probed", ["/bin/sh", "-c", "sleep 30"])
+        runtime.sync_pod(pod)
+        assert runtime.exec_probe(pod, "main", ["/bin/true"])
+        assert not runtime.exec_probe(pod, "main", ["/bin/false"])
+
+    def test_env_vars_reach_container(self, runtime):
+        pod = mk_pod(
+            "envy",
+            None,
+            containers=[
+                Container(
+                    name="main",
+                    image="app",
+                    command=["/bin/sh", "-c", "echo VAL=$MYVAR; sleep 30"],
+                    env=[EnvVar(name="MYVAR", value="tpu42")],
+                )
+            ],
+        )
+        runtime.sync_pod(pod)
+        assert wait_for(lambda: "VAL=tpu42" in runtime.read_logs("envy", "main"))
+
+    def test_adoption_across_restart(self, runtime, tmp_path):
+        """A new runtime instance (kubelet restart) adopts recorded live
+        processes instead of orphaning them (kubelet.go:1154-1160)."""
+        pod = mk_pod("survivor", ["/bin/sh", "-c", "sleep 30"])
+        first = runtime.sync_pod(pod)[0]
+        pid = int(first.container_id.split("//")[1])
+
+        reborn = ProcessRuntime(str(tmp_path / "kubelet"), node_name="n1")
+        pods = reborn.list_pods()
+        assert "survivor" in pods
+        adopted = {c.name: c for c in pods["survivor"]}["main"]
+        assert int(adopted.container_id.split("//")[1]) == pid
+        assert adopted.state == "running"
+        # Same spec -> no restart (hash match); adopted process kept.
+        resynced = reborn.sync_pod(pod)[0]
+        assert int(resynced.container_id.split("//")[1]) == pid
+        reborn.kill_pod("survivor")
+        assert wait_for(lambda: not _alive(pid))
+
+    def test_image_only_container_uses_anchor_command(self, runtime):
+        """Reference manifests (image: nginx, no command) must run."""
+        pod = mk_pod("imageonly", None, containers=[Container(name="main", image="nginx")])
+        containers = runtime.sync_pod(pod)
+        assert containers[0].state == "running"
+        runtime.kill_pod("imageonly")
+
+
+def _alive(pid: int) -> bool:
+    """True if pid is a live (non-zombie) process. In-test adoption
+    leaves zombies: the original runtime's Popen in THIS process still
+    owns the child, so os.kill(pid, 0) succeeds after death. In real
+    adoption the old kubelet process is gone and init reaps."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split(") ")[1].split()[0] != "Z"
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Kubelet HTTP API + apiserver subresources, end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from kubernetes_tpu.client.rest import Client, LocalTransport
+    from kubernetes_tpu.server.api import APIServer
+
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    runtime = ProcessRuntime(str(tmp_path / "kubelet"), node_name="node-1")
+    kubelet = Kubelet(
+        Client(LocalTransport(api)),
+        node_name="node-1",
+        runtime=runtime,
+        heartbeat_period=0.5,
+        sync_period=0.3,
+        serve_http=True,
+    ).start()
+    yield api, client, kubelet, runtime
+    kubelet.stop()
+    for uid in list(runtime.list_pods()):
+        runtime.kill_pod(uid)
+
+
+def _pod_running(client, runtime, name, ns="default"):
+    """True once the pod's (apiserver-assigned) uid shows up in the
+    runtime with a running container."""
+    try:
+        pod = client.get("pods", name, namespace=ns)
+    except Exception:
+        return False
+    uid = pod.metadata.uid or name
+    containers = runtime.list_pods().get(uid, [])
+    return any(c.state == "running" for c in containers)
+
+
+def _schedule(client, name, command, ns="default"):
+    """Create a pod pinned to node-1 (no scheduler in this fixture)."""
+    client.create(
+        "pods",
+        {
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [
+                    {"name": "main", "image": "app", "command": command}
+                ],
+            },
+        },
+        namespace=ns,
+    )
+
+
+class TestKubeletHTTPAPI:
+    def test_healthz_and_pods(self, cluster):
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "p1", ["/bin/sh", "-c", "sleep 30"])
+        base = kubelet.http.address
+        assert (
+            urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+        )
+        assert wait_for(
+            lambda: any(
+                p["metadata"]["name"] == "p1"
+                for p in json.loads(
+                    urllib.request.urlopen(f"{base}/pods").read()
+                )["items"]
+            )
+        )
+
+    def test_stats_and_spec(self, cluster):
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "p2", ["/bin/sh", "-c", "sleep 30"])
+        base = kubelet.http.address
+        assert wait_for(lambda: _pod_running(client, runtime, "p2"))
+        spec = json.loads(urllib.request.urlopen(f"{base}/spec").read())
+        assert spec["nodeName"] == "node-1"
+        stats = json.loads(urllib.request.urlopen(f"{base}/stats").read())
+        uid = client.get("pods", "p2").metadata.uid
+        assert uid in stats["pods"]
+        entry = {c["name"]: c for c in stats["pods"][uid]}["main"]
+        assert entry["state"] == "running"
+        assert entry["rssBytes"] > 0
+
+    def test_node_publishes_daemon_endpoint(self, cluster):
+        api, client, kubelet, runtime = cluster
+        node = client.get("nodes", "node-1")
+        assert node.status.daemon_endpoints.kubelet_endpoint.port == kubelet.http.port
+
+    def test_pod_log_subresource_through_apiserver(self, cluster):
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "weblog", ["/bin/sh", "-c", "echo api-visible-log; sleep 30"])
+        assert wait_for(lambda: _pod_running(client, runtime, "weblog"))
+        assert wait_for(
+            lambda: "api-visible-log" in client.pod_logs("weblog"), timeout=5
+        )
+
+    def test_pod_exec_subresource_through_apiserver(self, cluster):
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "execme", ["/bin/sh", "-c", "sleep 30"])
+        assert wait_for(lambda: _pod_running(client, runtime, "execme"))
+        result = client.pod_exec("execme", ["/bin/echo", "exec-through-stack"])
+        assert result["exitCode"] == 0
+        assert "exec-through-stack" in result["output"]
+
+    def test_unscheduled_pod_log_409(self, cluster):
+        from kubernetes_tpu.server.api import APIError
+
+        api, client, kubelet, runtime = cluster
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "floating", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            },
+            namespace="default",
+        )
+        with pytest.raises(APIError) as e:
+            client.pod_logs("floating")
+        assert e.value.code == 409
+
+
+class TestKtctlLogsExec:
+    def test_ktctl_logs_and_exec_over_http(self, cluster, capsys):
+        from kubernetes_tpu.cli.ktctl import main as ktctl_main
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api, client, kubelet, runtime = cluster
+        srv = APIHTTPServer(api).start()
+        try:
+            _schedule(client, "cli1", ["/bin/sh", "-c", "echo cli-log-line; sleep 30"])
+            assert wait_for(lambda: _pod_running(client, runtime, "cli1"))
+            assert wait_for(
+                lambda: "cli-log-line" in client.pod_logs("cli1"), timeout=5
+            )
+            rc = ktctl_main(["logs", "cli1", "--server", srv.address])
+            assert rc == 0
+            assert "cli-log-line" in capsys.readouterr().out
+            rc = ktctl_main(
+                ["exec", "cli1", "--server", srv.address, "--", "/bin/echo", "via-cli"]
+            )
+            assert rc == 0
+            assert "via-cli" in capsys.readouterr().out
+        finally:
+            srv.stop()
