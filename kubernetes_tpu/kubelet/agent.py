@@ -139,7 +139,9 @@ class Kubelet:
         self._workers: Dict[str, _PodWorker] = {}
         self._workers_lock = threading.Lock()
         self._volumes_mounted: set = set()
-        self._probe_failures: Dict[str, int] = {}
+        from kubernetes_tpu.kubelet.probes import ProbeTracker
+
+        self._probes = ProbeTracker()
         self.pods = Informer(
             client,
             "pods",
@@ -291,6 +293,7 @@ class Kubelet:
             except Exception:
                 pass
         self._volumes_mounted.discard(uid)
+        self._probes.forget(uid + "/")
         with self._workers_lock:
             self._workers.pop(self._key(pod), None)
 
@@ -348,6 +351,8 @@ class Kubelet:
         self._run_probes(pod, uid)
 
         containers = self.runtime.sync_pod(pod)
+        for c in containers:
+            self._probes.note_started(f"{uid}/{c.name}", c.started_at)
 
         # Restart policy (dockertools/manager.go:1287+), decided PER
         # CONTAINER: Always restarts any exited container; OnFailure
@@ -369,7 +374,7 @@ class Kubelet:
             ContainerStatus(
                 name=c.name,
                 state={c.state: {}},
-                ready=c.state == "running",
+                ready=self._container_ready(uid, c.name, c.state),
                 restart_count=c.restart_count,
                 image=c.image,
                 container_id=c.container_id,
@@ -422,28 +427,44 @@ class Kubelet:
     # -- probes -------------------------------------------------------
 
     def _run_probes(self, pod: Pod, uid: str) -> None:
-        """Liveness probes kill unhealthy containers so the restart
-        policy path brings them back (prober/prober.go)."""
+        """Liveness + readiness probes, all three transports
+        (exec/HTTP/TCP — pkg/probe/, prober/prober.go). Liveness
+        failures past the threshold kill the container so restart
+        policy brings it back; readiness failures only flip the
+        container un-ready (and thus the pod out of Endpoints)."""
+        from kubernetes_tpu.kubelet.probes import run_probe
+
         for c in pod.spec.containers:
-            probe = c.liveness_probe
-            if probe is None or probe.exec is None:
-                continue
-            healthy = self.runtime.exec_probe(pod, c.name, probe.exec.command)
             key = f"{uid}/{c.name}"
-            if healthy:
-                self._probe_failures.pop(key, None)
-                continue
-            failures = self._probe_failures.get(key, 0) + 1
-            self._probe_failures[key] = failures
-            if failures >= 3:  # failureThreshold default
-                if hasattr(self.runtime, "fail_container"):
-                    self.runtime.fail_container(uid, c.name, exit_code=137)
-                self._probe_failures[key] = 0
-                self.client.record_event(
-                    pod, "Unhealthy",
-                    f"Liveness probe failed for {c.name}; restarting",
-                    source=f"kubelet/{self.node_name}",
-                )
+            live = c.liveness_probe
+            if live is not None and not self._probes.in_initial_delay(key, live):
+                healthy = run_probe(live, pod, c.name, self.runtime)
+                if self._probes.liveness(key, healthy):
+                    if hasattr(self.runtime, "fail_container"):
+                        self.runtime.fail_container(uid, c.name, exit_code=137)
+                    self.client.record_event(
+                        pod, "Unhealthy",
+                        f"Liveness probe failed for {c.name}; restarting",
+                        source=f"kubelet/{self.node_name}",
+                    )
+            readiness = c.readiness_probe
+            if readiness is not None:
+                if self._probes.in_initial_delay(key, readiness):
+                    # Not probed yet -> not ready (readiness defaults
+                    # to failure until the first success).
+                    if self._probes.ready(key) is None:
+                        self._probes.set_ready(key, False)
+                else:
+                    self._probes.set_ready(
+                        key, run_probe(readiness, pod, c.name, self.runtime)
+                    )
+
+    def _container_ready(self, uid: str, name: str, state: str) -> bool:
+        """running AND (no readiness probe, or latest verdict true)."""
+        if state != "running":
+            return False
+        verdict = self._probes.ready(f"{uid}/{name}")
+        return True if verdict is None else verdict
 
     # -- static pods (file source, config/file.go) --------------------
 

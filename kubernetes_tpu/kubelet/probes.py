@@ -1,0 +1,123 @@
+"""Probe executors + per-container probe state.
+
+Reference: pkg/probe/{exec,http,tcp}/ (the three probe transports) and
+pkg/kubelet/prober/prober.go (readiness vs liveness semantics):
+- liveness failure (after the failure threshold) kills the container so
+  restart policy brings it back;
+- readiness failure only flips the container un-ready — the pod stays
+  running but drops out of service Endpoints (readiness_manager.go).
+
+HTTP probes treat any 2xx/3xx as healthy (pkg/probe/http/http.go:96);
+TCP probes succeed when the connect() does (pkg/probe/tcp/tcp.go:40).
+A process runtime has host networking, so probes dial 127.0.0.1 unless
+the probe names a host.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+from kubernetes_tpu.models.objects import Pod, Probe
+
+
+def probe_http(host: str, port: int, path: str, timeout: float) -> bool:
+    if not path.startswith("/"):
+        path = "/" + path
+    url = f"http://{host or '127.0.0.1'}:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return 200 <= resp.status < 400
+    except urllib.error.HTTPError as e:
+        return 200 <= e.code < 400
+    except (urllib.error.URLError, OSError, ValueError):
+        return False
+
+
+def probe_tcp(host: str, port: int, timeout: float) -> bool:
+    try:
+        with socket.create_connection((host or "127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def run_probe(probe: Probe, pod: Pod, container: str, runtime) -> bool:
+    """Execute one probe of whatever transport it declares. A probe
+    with no action configured is treated as success (prober.go runProbe
+    default)."""
+    timeout = float(probe.timeout_seconds or 1)
+    if probe.exec is not None:
+        try:
+            return runtime.exec_probe(
+                pod, container, probe.exec.command, timeout=timeout
+            )
+        except TypeError:
+            # Runtimes predating the timeout parameter.
+            return runtime.exec_probe(pod, container, probe.exec.command)
+    if probe.http_get is not None:
+        return probe_http(
+            probe.http_get.host, probe.http_get.port, probe.http_get.path, timeout
+        )
+    if probe.tcp_socket is not None:
+        return probe_tcp("", probe.tcp_socket.port, timeout)
+    return True
+
+
+class ProbeTracker:
+    """Per-container probe bookkeeping: initial delay, liveness failure
+    threshold, and the latest readiness verdict."""
+
+    FAILURE_THRESHOLD = 3  # v0.19 hard-codes 3 consecutive failures
+
+    def __init__(self):
+        self._liveness_failures: Dict[str, int] = {}
+        self._readiness: Dict[str, bool] = {}
+        self._started: Dict[str, float] = {}
+
+    def note_started(self, key: str, started_at: float) -> None:
+        prev = self._started.get(key)
+        self._started[key] = started_at
+        if prev is not None and started_at > prev:
+            # Container restarted: a stale ready=True from the previous
+            # incarnation must not keep the pod in Endpoints while the
+            # new process is still inside its initial delay.
+            self._readiness.pop(key, None)
+            self._liveness_failures.pop(key, None)
+
+    def in_initial_delay(self, key: str, probe: Probe) -> bool:
+        started = self._started.get(key)
+        if started is None:
+            # No recorded start: the container hasn't been synced yet;
+            # probing now would count failures against a process that
+            # doesn't exist.
+            return True
+        delay = probe.initial_delay_seconds or 0
+        return delay > 0 and (time.monotonic() - started) < delay
+
+    def liveness(self, key: str, healthy: bool) -> bool:
+        """Record one liveness result; True = threshold crossed (kill)."""
+        if healthy:
+            self._liveness_failures.pop(key, None)
+            return False
+        failures = self._liveness_failures.get(key, 0) + 1
+        self._liveness_failures[key] = failures
+        if failures >= self.FAILURE_THRESHOLD:
+            self._liveness_failures[key] = 0
+            return True
+        return False
+
+    def set_ready(self, key: str, ready: bool) -> None:
+        self._readiness[key] = ready
+
+    def ready(self, key: str) -> Optional[bool]:
+        """Latest readiness verdict (None = no probe has run)."""
+        return self._readiness.get(key)
+
+    def forget(self, key_prefix: str) -> None:
+        for d in (self._liveness_failures, self._readiness, self._started):
+            for k in [k for k in d if k.startswith(key_prefix)]:
+                del d[k]

@@ -368,18 +368,23 @@ class ProcessRuntime(ContainerRuntime):
             self._restart_counts[f"{pod_uid}/{name}"] = cur.restart_count + 1
 
     def kill_pod(self, pod_uid: str) -> None:
+        # Detach under the lock, kill OUTSIDE it: _kill_proc waits up
+        # to the grace period per process, and holding the runtime-wide
+        # lock through that would stall every other pod's sync and the
+        # kubelet HTTP endpoints.
         with self._lock:
-            for proc in self._pods.pop(pod_uid, {}).values():
-                self._kill_proc(proc)
+            doomed = list(self._pods.pop(pod_uid, {}).values())
             anchor = self._anchors.pop(pod_uid, None)
             if anchor is not None:
-                self._kill_proc(anchor)
+                doomed.append(anchor)
             # Drop queued restart counts: a later pod reusing this key
             # (manifest pods key by name) must start from 0.
             prefix = pod_uid + "/"
             for key in [k for k in self._restart_counts if k.startswith(prefix)]:
                 del self._restart_counts[key]
-            shutil.rmtree(self._pod_dir(pod_uid), ignore_errors=True)
+        for proc in doomed:
+            self._kill_proc(proc)
+        shutil.rmtree(self._pod_dir(pod_uid), ignore_errors=True)
 
     def list_pods(self) -> Dict[str, List[RuntimeContainer]]:
         with self._lock:
@@ -391,10 +396,12 @@ class ProcessRuntime(ContainerRuntime):
                 out.setdefault(uid, [])
             return out
 
-    def exec_probe(self, pod: Pod, container: str, command: List[str]) -> bool:
+    def exec_probe(
+        self, pod: Pod, container: str, command: List[str], timeout: float = 1.0
+    ) -> bool:
         rc, _ = self.exec_in_container(
             pod.metadata.uid or pod.metadata.name, container, command,
-            pod=pod, timeout=2.0,
+            pod=pod, timeout=timeout,
         )
         return rc == 0
 

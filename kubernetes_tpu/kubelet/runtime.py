@@ -46,8 +46,11 @@ class ContainerRuntime:
         """pod uid -> containers (for orphan GC)."""
         raise NotImplementedError
 
-    def exec_probe(self, pod: Pod, container: str, command: List[str]) -> bool:
-        """Run a probe; True = healthy."""
+    def exec_probe(
+        self, pod: Pod, container: str, command: List[str], timeout: float = 1.0
+    ) -> bool:
+        """Run a probe; True = healthy. `timeout` is the probe's
+        timeoutSeconds (pkg/probe/exec honors it per run)."""
         raise NotImplementedError
 
 
@@ -115,7 +118,9 @@ class FakeRuntime(ContainerRuntime):
         with self._lock:
             return {uid: list(cs.values()) for uid, cs in self._pods.items()}
 
-    def exec_probe(self, pod: Pod, container: str, command: List[str]) -> bool:
+    def exec_probe(
+        self, pod: Pod, container: str, command: List[str], timeout: float = 1.0
+    ) -> bool:
         uid = pod.metadata.uid or pod.metadata.name
         with self._lock:
             return self._probe_results.get(f"{uid}/{container}", True)

@@ -59,12 +59,15 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         # Load through a unique temp copy: dlopen caches by pathname,
         # so re-loading _LIB_PATH after an in-process rebuild would
-        # silently return the OLD mapping. The copy is unlinked right
-        # after load (the mapping survives the unlink on Linux).
+        # silently return the OLD mapping. The copy lives NEXT TO the
+        # real .so (the system temp dir may be mounted noexec) and is
+        # unlinked right after load (the mapping survives the unlink).
         import shutil
         import tempfile
 
-        fd, tmp = tempfile.mkstemp(suffix=".so", prefix="kubetpu-")
+        fd, tmp = tempfile.mkstemp(
+            suffix=".so", prefix="kubetpu-", dir=os.path.dirname(_LIB_PATH)
+        )
         os.close(fd)
         shutil.copyfile(_LIB_PATH, tmp)
         try:

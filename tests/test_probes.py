@@ -1,0 +1,232 @@
+"""Probe transports (exec/HTTP/TCP) + readiness gating Endpoints.
+
+Reference: pkg/probe/{exec,http,tcp}/, pkg/kubelet/prober/prober.go,
+readiness feeding the endpoints controller (VERDICT r1 #9: a failing
+readiness probe must remove the pod from Endpoints WITHOUT restarting
+it)."""
+
+import http.server
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client.cache import Informer
+from kubernetes_tpu.client.rest import Client, LocalTransport
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.kubelet.agent import Kubelet
+from kubernetes_tpu.kubelet.probes import (
+    ProbeTracker,
+    probe_http,
+    probe_tcp,
+    run_probe,
+)
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+from kubernetes_tpu.models.objects import (
+    Container,
+    HTTPGetAction,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Probe,
+    TCPSocketAction,
+)
+from kubernetes_tpu.server.api import APIServer
+
+
+def wait_for(cond, timeout=6.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+@pytest.fixture
+def http_server():
+    class Handler(http.server.BaseHTTPRequestHandler):
+        healthy = True
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            code = 200 if (Handler.healthy or self.path != "/healthz") else 503
+            body = b"ok" if code == 200 else b"sick"
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv, Handler
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestProbeTransports:
+    def test_http_probe_2xx_healthy(self, http_server):
+        srv, handler = http_server
+        assert probe_http("127.0.0.1", srv.server_address[1], "/healthz", 1.0)
+
+    def test_http_probe_5xx_unhealthy(self, http_server):
+        srv, handler = http_server
+        handler.healthy = False
+        assert not probe_http("127.0.0.1", srv.server_address[1], "/healthz", 1.0)
+        handler.healthy = True
+
+    def test_http_probe_connection_refused(self):
+        # Grab a port and close it -> nothing listens there.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        assert not probe_http("127.0.0.1", port, "/", 0.5)
+
+    def test_tcp_probe(self, http_server):
+        srv, _ = http_server
+        assert probe_tcp("127.0.0.1", srv.server_address[1], 1.0)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        assert not probe_tcp("127.0.0.1", port, 0.5)
+
+    def test_run_probe_dispatch(self, http_server):
+        srv, _ = http_server
+        pod = Pod(metadata=ObjectMeta(name="p", uid="p"))
+        rt = FakeRuntime()
+        http_probe = Probe(
+            http_get=HTTPGetAction(port=srv.server_address[1], path="/")
+        )
+        tcp_probe = Probe(tcp_socket=TCPSocketAction(port=srv.server_address[1]))
+        assert run_probe(http_probe, pod, "c", rt)
+        assert run_probe(tcp_probe, pod, "c", rt)
+        assert run_probe(Probe(), pod, "c", rt)  # no action = success
+
+
+class TestProbeTracker:
+    def test_liveness_threshold(self):
+        t = ProbeTracker()
+        assert not t.liveness("k", False)
+        assert not t.liveness("k", False)
+        assert t.liveness("k", False)  # third consecutive failure
+        assert not t.liveness("k", False)  # counter reset after kill
+
+    def test_liveness_resets_on_success(self):
+        t = ProbeTracker()
+        t.liveness("k", False)
+        t.liveness("k", False)
+        t.liveness("k", True)
+        assert not t.liveness("k", False)
+        assert not t.liveness("k", False)
+
+    def test_initial_delay(self):
+        t = ProbeTracker()
+        t.note_started("k", time.monotonic())
+        assert t.in_initial_delay("k", Probe(initial_delay_seconds=60))
+        assert not t.in_initial_delay("k", Probe(initial_delay_seconds=0))
+        t.note_started("k", time.monotonic() - 120)
+        assert not t.in_initial_delay("k", Probe(initial_delay_seconds=60))
+
+
+# ---------------------------------------------------------------------------
+# Readiness gates Endpoints without restarting the pod
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster():
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    runtime = FakeRuntime()
+    kubelet = Kubelet(
+        Client(LocalTransport(api)),
+        node_name="node-1",
+        runtime=runtime,
+        heartbeat_period=0.5,
+        sync_period=0.2,
+    ).start()
+    endpoints = EndpointsController(
+        Client(LocalTransport(api)), sync_period=0.2
+    ).start()
+    yield api, client, kubelet, runtime
+    endpoints.stop()
+    kubelet.stop()
+
+
+class TestReadinessGatesEndpoints:
+    def test_failing_readiness_removes_from_endpoints_without_restart(
+        self, cluster
+    ):
+        api, client, kubelet, runtime = cluster
+        client.create(
+            "services",
+            {
+                "kind": "Service",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {
+                    "selector": {"app": "web"},
+                    "ports": [{"name": "http", "port": 80}],
+                    "clusterIP": "10.0.0.10",
+                },
+            },
+            namespace="default",
+        )
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": "w1",
+                    "namespace": "default",
+                    "labels": {"app": "web"},
+                },
+                "spec": {
+                    "nodeName": "node-1",
+                    "containers": [
+                        {
+                            "name": "main",
+                            "image": "web",
+                            "readinessProbe": {
+                                "exec": {"command": ["/bin/check"]}
+                            },
+                        }
+                    ],
+                },
+            },
+            namespace="default",
+        )
+
+        def endpoint_count():
+            try:
+                ep = client.get("endpoints", "web", namespace="default")
+            except Exception:
+                return -1
+            return sum(
+                len(s.addresses) for s in ep.subsets
+            ) if ep.subsets else 0
+
+        # Probe passes (FakeRuntime default) -> pod becomes ready and
+        # lands in Endpoints.
+        assert wait_for(lambda: endpoint_count() == 1)
+        pod = client.get("pods", "w1", namespace="default")
+        uid = pod.metadata.uid
+        restarts_before = runtime.list_pods()[uid][0].restart_count
+
+        # Readiness starts failing: pod leaves Endpoints but is NOT
+        # restarted (readiness never kills; prober.go).
+        runtime.set_probe_result(uid, "main", False)
+        assert wait_for(lambda: endpoint_count() == 0)
+        pod = client.get("pods", "w1", namespace="default")
+        assert pod.status.phase == "Running"
+        assert runtime.list_pods()[uid][0].restart_count == restarts_before
+        assert runtime.list_pods()[uid][0].state == "running"
+
+        # Recovers: back into Endpoints.
+        runtime.set_probe_result(uid, "main", True)
+        assert wait_for(lambda: endpoint_count() == 1)
