@@ -308,14 +308,85 @@ def cmd_describe(client: Client, args) -> int:
 
 
 def cmd_scale(client: Client, args) -> int:
-    """reference: scale.go."""
+    """reference: scale.go (conflict-retrying Scaler)."""
+    from kubernetes_tpu.cli.updater import Scaler
+
     resource = resolve_resource(args.resource)
     if resource != "replicationcontrollers":
         raise SystemExit("error: scale only supports replicationcontrollers")
-    rc = client.get(resource, args.name, namespace=args.namespace)
-    rc.spec.replicas = args.replicas
-    client.update(resource, rc, namespace=args.namespace)
+    Scaler(client).scale(args.name, args.replicas, namespace=args.namespace)
     print(f"replicationcontroller/{args.name} scaled to {args.replicas}")
+    return 0
+
+
+def cmd_rolling_update(client: Client, args) -> int:
+    """reference: pkg/kubectl/cmd/rollingupdate.go + rolling_updater.go.
+
+    Two modes, like the reference: `-f new-rc.json` (explicit new RC
+    with a different selector) or `--image` (derive the new RC from the
+    old one, adding a deployment-key label to keep selectors disjoint).
+    """
+    import hashlib
+
+    from kubernetes_tpu.cli.updater import RollingUpdater, UpdateTimeout
+    from kubernetes_tpu.models.objects import ReplicationController
+
+    if bool(args.filename) == bool(args.image):
+        raise SystemExit("error: exactly one of -f or --image is required")
+    if args.filename:
+        manifests = load_manifests(args.filename)
+        if len(manifests) != 1 or manifests[0].get("kind") != "ReplicationController":
+            raise SystemExit("error: -f must contain exactly one ReplicationController")
+        new_rc = serde.from_wire(ReplicationController, manifests[0])
+    else:
+        old = client.get(
+            "replicationcontrollers", args.name, namespace=args.namespace
+        )
+        new_rc = serde.from_wire(ReplicationController, serde.to_wire(old))
+        new_rc.metadata.resource_version = ""
+        new_rc.metadata.uid = ""
+        if new_rc.spec.template is None or not new_rc.spec.template.spec.containers:
+            raise SystemExit("error: old RC has no pod template containers")
+        new_rc.spec.template.spec.containers[0].image = args.image
+        key = hashlib.sha1(args.image.encode()).hexdigest()[:8]
+        new_rc.metadata.name = f"{args.name}-{key}"
+        # Deployment-key label keeps the two selectors disjoint
+        # (rolling_updater.go AddDeploymentKeyToReplicationController).
+        new_rc.spec.selector = dict(new_rc.spec.selector or {})
+        new_rc.spec.selector["deployment"] = key
+        new_rc.spec.template.metadata.labels = dict(
+            new_rc.spec.template.metadata.labels or {}
+        )
+        new_rc.spec.template.metadata.labels["deployment"] = key
+    updater = RollingUpdater(
+        client,
+        poll_interval=args.poll_interval,
+        timeout=args.timeout,
+        progress=lambda msg: print(msg),
+    )
+    try:
+        survivor = updater.update(args.name, new_rc, namespace=args.namespace)
+    except UpdateTimeout as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"replicationcontroller/{survivor} rolling updated")
+    return 0
+
+
+def cmd_stop(client: Client, args) -> int:
+    """reference: pkg/kubectl/cmd/stop.go (reapers drain before
+    deleting)."""
+    from kubernetes_tpu.cli.updater import Reaper, UpdateTimeout
+
+    resource = resolve_resource(args.resource)
+    try:
+        Reaper(client, timeout=args.timeout).stop(
+            resource, args.name, namespace=args.namespace
+        )
+    except UpdateTimeout as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"{resource}/{args.name} stopped")
     return 0
 
 
@@ -436,8 +507,10 @@ def build_parser() -> argparse.ArgumentParser:
     # Global flags live on a parent parser attached to every
     # subcommand, so `ktctl get pods -o yaml` parses naturally.
     common = argparse.ArgumentParser(add_help=False)
-    common.add_argument("--server", "-s", default="http://127.0.0.1:8080")
-    common.add_argument("--namespace", "-n", default="default")
+    common.add_argument("--server", "-s", default=None)
+    common.add_argument("--namespace", "-n", default=None)
+    common.add_argument("--kubeconfig", default=None)
+    common.add_argument("--context", default=None)
     common.add_argument("--output", "-o", default="table",
                         choices=["table", "json", "yaml", "name"])
     p = argparse.ArgumentParser(prog="ktctl", description="kubernetes-tpu CLI")
@@ -498,6 +571,20 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--memory", default="64Mi")
     rn.set_defaults(fn=cmd_run)
 
+    ru = sub.add_parser("rolling-update", parents=[common])
+    ru.add_argument("name")
+    ru.add_argument("--filename", "-f", default=None)
+    ru.add_argument("--image", default=None)
+    ru.add_argument("--poll-interval", type=float, default=0.2)
+    ru.add_argument("--timeout", type=float, default=60.0)
+    ru.set_defaults(fn=cmd_rolling_update)
+
+    st = sub.add_parser("stop", parents=[common])
+    st.add_argument("resource")
+    st.add_argument("name")
+    st.add_argument("--timeout", type=float, default=30.0)
+    st.set_defaults(fn=cmd_stop)
+
     lg = sub.add_parser("logs", parents=[common])
     lg.add_argument("name")
     lg.add_argument("--container", "-c", default="")
@@ -518,7 +605,27 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None, client: Optional[Client] = None) -> int:
     args = build_parser().parse_args(argv)
     if client is None:
-        client = Client(HTTPTransport(args.server))
+        # kubeconfig resolution (pkg/client/clientcmd): explicit flags
+        # win, then the file's current-context, then local defaults.
+        # Skipped entirely for injected clients (tests/embedding must
+        # not pick up the operator's personal config).
+        from kubernetes_tpu.client.kubeconfig import (
+            KubeconfigError,
+            load_kubeconfig,
+        )
+
+        try:
+            cfg = load_kubeconfig(args.kubeconfig, context=args.context)
+        except KubeconfigError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.server is None:
+            args.server = cfg.server
+        if args.namespace is None:
+            args.namespace = cfg.namespace or "default"
+        client = Client(HTTPTransport(args.server, headers=cfg.auth_headers()))
+    if args.namespace is None:
+        args.namespace = "default"
     try:
         return args.fn(client, args)
     except APIError as e:

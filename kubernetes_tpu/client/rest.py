@@ -17,7 +17,7 @@ import http.client
 import json
 import threading
 import time
-from typing import Any, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import urlencode, urlparse
 
 from kubernetes_tpu.models import serde
@@ -136,11 +136,18 @@ class _HTTPWatchStream:
 
 
 class HTTPTransport(Transport):
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        headers: Optional[Dict[str, str]] = None,
+    ):
         u = urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
         self.port = u.port or 80
         self.timeout = timeout
+        # Static per-request headers (kubeconfig bearer/basic auth).
+        self.headers = dict(headers or {})
 
     # -- path construction mirroring the server's router --------------
 
@@ -166,7 +173,9 @@ class HTTPTransport(Transport):
             if query:
                 path = path + "?" + urlencode({k: v for k, v in query.items() if v})
             payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
+            headers = dict(self.headers)
+            if payload:
+                headers["Content-Type"] = "application/json"
             conn.request(verb, path, body=payload, headers=headers)
             resp = conn.getresponse()
             raw_body = resp.read()
@@ -273,7 +282,7 @@ class HTTPTransport(Transport):
         if query:
             path += "?" + query
         conn = http.client.HTTPConnection(self.host, self.port)
-        conn.request("GET", path)
+        conn.request("GET", path, headers=self.headers)
         resp = conn.getresponse()
         if resp.status >= 400:
             data = json.loads(resp.read() or b"{}")

@@ -51,13 +51,10 @@ def run_probe(probe: Probe, pod: Pod, container: str, runtime) -> bool:
     default)."""
     timeout = float(probe.timeout_seconds or 1)
     if probe.exec is not None:
-        try:
-            return runtime.exec_probe(
-                pod, container, probe.exec.command, timeout=timeout
-            )
-        except TypeError:
-            # Runtimes predating the timeout parameter.
-            return runtime.exec_probe(pod, container, probe.exec.command)
+        # The ContainerRuntime seam takes timeout (probe timeoutSeconds).
+        return runtime.exec_probe(
+            pod, container, probe.exec.command, timeout=timeout
+        )
     if probe.http_get is not None:
         return probe_http(
             probe.http_get.host, probe.http_get.port, probe.http_get.path, timeout
@@ -84,8 +81,12 @@ class ProbeTracker:
         if prev is not None and started_at > prev:
             # Container restarted: a stale ready=True from the previous
             # incarnation must not keep the pod in Endpoints while the
-            # new process is still inside its initial delay.
-            self._readiness.pop(key, None)
+            # new process is still inside its initial delay. The verdict
+            # flips to False (not None: agent's default for "no probe"
+            # is ready, which would defeat this) — only containers that
+            # HAVE a readiness probe carry a verdict here.
+            if key in self._readiness:
+                self._readiness[key] = False
             self._liveness_failures.pop(key, None)
 
     def in_initial_delay(self, key: str, probe: Probe) -> bool:

@@ -65,9 +65,14 @@ def _load() -> Optional[ctypes.CDLL]:
         import shutil
         import tempfile
 
-        fd, tmp = tempfile.mkstemp(
-            suffix=".so", prefix="kubetpu-", dir=os.path.dirname(_LIB_PATH)
-        )
+        try:
+            # Prefer a sibling of the real .so (system temp may be
+            # noexec); fall back to the temp dir for read-only installs.
+            fd, tmp = tempfile.mkstemp(
+                suffix=".so", prefix="kubetpu-", dir=os.path.dirname(_LIB_PATH)
+            )
+        except OSError:
+            fd, tmp = tempfile.mkstemp(suffix=".so", prefix="kubetpu-")
         os.close(fd)
         shutil.copyfile(_LIB_PATH, tmp)
         try:

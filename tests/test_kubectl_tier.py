@@ -1,0 +1,284 @@
+"""kubectl operational tier: rolling update, reapers, scaler retry,
+kubeconfig loading.
+
+Reference: pkg/kubectl/rolling_updater.go, stop.go, scale.go,
+pkg/client/clientcmd/ (VERDICT r1 #7)."""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.cli.updater import Reaper, RollingUpdater, Scaler
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.client.kubeconfig import (
+    KubeconfigError,
+    load_kubeconfig,
+)
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import ReplicationController
+from kubernetes_tpu.scheduler.daemon import Scheduler, SchedulerConfig
+from kubernetes_tpu.server import APIServer
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def rc_wire(name, replicas, labels, image="app:v1"):
+    return {
+        "kind": "ReplicationController",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": dict(labels),
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "main",
+                            "image": image,
+                            "resources": {
+                                "limits": {"cpu": "100m", "memory": "64Mi"}
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+@pytest.fixture
+def cluster():
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    kubelets = [
+        Kubelet(
+            Client(LocalTransport(api)),
+            node_name=name,
+            runtime=FakeRuntime(),
+            heartbeat_period=0.5,
+            sync_period=0.2,
+        ).start()
+        for name in ("node-1", "node-2")
+    ]
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    assert cfg.wait_for_sync()
+    scheduler = Scheduler(cfg).start()
+    manager = ControllerManager(Client(LocalTransport(api))).start()
+    yield api, client
+    manager.stop()
+    scheduler.stop()
+    for k in kubelets:
+        k.stop()
+
+
+def running_pods(client, selector):
+    pods, _ = client.list("pods", namespace="default", label_selector=selector)
+    return [p for p in pods if p.status.phase == "Running"]
+
+
+class TestRollingUpdate:
+    def test_replaces_rc_pod_by_pod(self, cluster):
+        api, client = cluster
+        client.create(
+            "replicationcontrollers",
+            rc_wire("web", 3, {"app": "web"}, image="app:v1"),
+        )
+        assert wait_until(lambda: len(running_pods(client, "app=web")) == 3)
+
+        new_rc = serde.from_wire(
+            ReplicationController,
+            rc_wire(
+                "web-v2", 3, {"app": "web", "deployment": "v2"}, image="app:v2"
+            ),
+        )
+        updater = RollingUpdater(client, poll_interval=0.05, timeout=30.0)
+        survivor = updater.update("web", new_rc, namespace="default")
+        # Renamed back to the old identity (rolling_updater.go Rename).
+        assert survivor == "web"
+        rc = client.get("replicationcontrollers", "web", namespace="default")
+        assert rc.spec.template.spec.containers[0].image == "app:v2"
+        assert rc.spec.replicas == 3
+        with pytest.raises(Exception):
+            client.get("replicationcontrollers", "web-v2", namespace="default")
+        assert wait_until(
+            lambda: len(running_pods(client, "deployment=v2")) == 3
+        )
+        # Old pods are gone (RC deleted scales its pods away via the
+        # reaper-less path: old RC was scaled to 0 first).
+        assert wait_until(
+            lambda: not [
+                p
+                for p in running_pods(client, "app=web")
+                if "deployment" not in p.metadata.labels
+            ]
+        )
+
+    def test_rejects_identical_selector(self, cluster):
+        api, client = cluster
+        client.create(
+            "replicationcontrollers", rc_wire("same", 1, {"app": "same"})
+        )
+        new_rc = serde.from_wire(
+            ReplicationController, rc_wire("same-v2", 1, {"app": "same"})
+        )
+        with pytest.raises(ValueError):
+            RollingUpdater(client).update("same", new_rc, namespace="default")
+
+
+class TestReaper:
+    def test_rc_stop_drains_then_deletes(self, cluster):
+        api, client = cluster
+        client.create(
+            "replicationcontrollers", rc_wire("doomed", 2, {"app": "doomed"})
+        )
+        assert wait_until(lambda: len(running_pods(client, "app=doomed")) == 2)
+        Reaper(client, timeout=20.0).stop(
+            "replicationcontrollers", "doomed", namespace="default"
+        )
+        with pytest.raises(Exception):
+            client.get("replicationcontrollers", "doomed", namespace="default")
+        # Pods drained BEFORE deletion -> nothing recreates them.
+        assert wait_until(
+            lambda: not running_pods(client, "app=doomed"), timeout=5
+        )
+
+    def test_scaler_waits_for_observed_replicas(self, cluster):
+        api, client = cluster
+        client.create(
+            "replicationcontrollers", rc_wire("sized", 1, {"app": "sized"})
+        )
+        assert wait_until(lambda: len(running_pods(client, "app=sized")) == 1)
+        Scaler(client).scale("sized", 3, namespace="default", wait=True, timeout=20.0)
+        assert len(running_pods(client, "app=sized")) >= 1
+        assert (
+            client.get(
+                "replicationcontrollers", "sized", namespace="default"
+            ).spec.replicas
+            == 3
+        )
+
+
+class TestKubeconfig:
+    def _write(self, tmp_path, data):
+        path = tmp_path / "config"
+        path.write_text(json.dumps(data))
+        return str(path)
+
+    def test_resolves_current_context(self, tmp_path):
+        path = self._write(
+            tmp_path,
+            {
+                "current-context": "prod",
+                "contexts": [
+                    {
+                        "name": "prod",
+                        "context": {
+                            "cluster": "c1",
+                            "user": "u1",
+                            "namespace": "team-a",
+                        },
+                    }
+                ],
+                "clusters": [
+                    {"name": "c1", "cluster": {"server": "http://10.0.0.1:8080"}}
+                ],
+                "users": [
+                    {"name": "u1", "user": {"token": "sekret"}}
+                ],
+            },
+        )
+        cfg = load_kubeconfig(path)
+        assert cfg.server == "http://10.0.0.1:8080"
+        assert cfg.namespace == "team-a"
+        assert cfg.auth_headers() == {"Authorization": "Bearer sekret"}
+
+    def test_context_override_and_basic_auth(self, tmp_path):
+        path = self._write(
+            tmp_path,
+            {
+                "current-context": "a",
+                "contexts": [
+                    {"name": "a", "context": {"cluster": "ca", "user": "ua"}},
+                    {"name": "b", "context": {"cluster": "cb", "user": "ub"}},
+                ],
+                "clusters": [
+                    {"name": "ca", "cluster": {"server": "http://a:1"}},
+                    {"name": "cb", "cluster": {"server": "http://b:2"}},
+                ],
+                "users": [
+                    {"name": "ua", "user": {}},
+                    {
+                        "name": "ub",
+                        "user": {"username": "bob", "password": "pw"},
+                    },
+                ],
+            },
+        )
+        cfg = load_kubeconfig(path, context="b")
+        assert cfg.server == "http://b:2"
+        assert cfg.auth_headers()["Authorization"].startswith("Basic ")
+
+    def test_yaml_format(self, tmp_path):
+        path = tmp_path / "config"
+        path.write_text(
+            "current-context: dev\n"
+            "contexts:\n"
+            "- name: dev\n"
+            "  context: {cluster: c, user: u}\n"
+            "clusters:\n"
+            "- name: c\n"
+            "  cluster: {server: 'http://yaml:9'}\n"
+            "users:\n"
+            "- name: u\n"
+            "  user: {}\n"
+        )
+        cfg = load_kubeconfig(str(path))
+        assert cfg.server == "http://yaml:9"
+
+    def test_missing_explicit_path_raises(self):
+        with pytest.raises(KubeconfigError):
+            load_kubeconfig("/nonexistent/kubeconfig")
+
+    def test_missing_default_gives_local_defaults(self, monkeypatch):
+        monkeypatch.delenv("KTCONFIG", raising=False)
+        monkeypatch.delenv("KUBECONFIG", raising=False)
+        cfg = load_kubeconfig()
+        assert cfg.server == "http://127.0.0.1:8080"
+        assert cfg.auth_headers() == {}
+
+    def test_ktctl_uses_kubeconfig_server(self, cluster, tmp_path, capsys):
+        from kubernetes_tpu.cli.ktctl import main as ktctl_main
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api, client = cluster
+        srv = APIHTTPServer(api).start()
+        try:
+            path = self._write(
+                tmp_path,
+                {
+                    "current-context": "test",
+                    "contexts": [
+                        {"name": "test", "context": {"cluster": "c", "user": "u"}}
+                    ],
+                    "clusters": [
+                        {"name": "c", "cluster": {"server": srv.address}}
+                    ],
+                    "users": [{"name": "u", "user": {}}],
+                },
+            )
+            rc = ktctl_main(["get", "nodes", "--kubeconfig", path])
+            assert rc == 0
+            assert "node-1" in capsys.readouterr().out
+        finally:
+            srv.stop()
