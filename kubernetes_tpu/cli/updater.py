@@ -24,6 +24,11 @@ class UpdateTimeout(Exception):
     pass
 
 
+def selector_string(selector) -> str:
+    """Canonical label-selector string for a selector dict."""
+    return ",".join(f"{k}={v}" for k, v in sorted((selector or {}).items()))
+
+
 def _wait(cond: Callable[[], bool], timeout: float, interval: float, what: str):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -84,7 +89,7 @@ class Scaler:
 
     def _selector(self, name: str, namespace: str) -> str:
         rc = self.client.get("replicationcontrollers", name, namespace=namespace)
-        return ",".join(f"{k}={v}" for k, v in sorted(rc.spec.selector.items()))
+        return selector_string(rc.spec.selector)
 
 
 class RollingUpdater:
@@ -107,9 +112,7 @@ class RollingUpdater:
     # -- helpers ------------------------------------------------------
 
     def _ready_count(self, rc, namespace: str) -> int:
-        selector = ",".join(
-            f"{k}={v}" for k, v in sorted(rc.spec.selector.items())
-        )
+        selector = selector_string(rc.spec.selector)
         pods, _ = self.client.list(
             "pods", namespace=namespace, label_selector=selector
         )
@@ -149,9 +152,8 @@ class RollingUpdater:
         key = hashlib.sha1(
             _json.dumps(serde.to_wire(old.spec.template), sort_keys=True).encode()
         ).hexdigest()[:8]
-        selector = ",".join(f"{k}={v}" for k, v in sorted(old_sel.items()))
         pods, _ = self.client.list(
-            "pods", namespace=namespace, label_selector=selector
+            "pods", namespace=namespace, label_selector=selector_string(old_sel)
         )
         for pod in pods:
             if pod.metadata.labels.get("deployment") == key:
@@ -196,6 +198,22 @@ class RollingUpdater:
         if dict(new_rc.spec.selector) == dict(old.spec.selector):
             raise ValueError(
                 "new RC must use a different selector than the old RC"
+            )
+        # Reverse-adoption guard: if the NEW selector matches the OLD
+        # template's labels, the new RC would instantly adopt (and its
+        # waits would count) the old pods — and no retrofit can fix the
+        # new RC's identity for the user. Refuse up front.
+        old_labels = dict(
+            (old.spec.template.metadata.labels or {})
+            if old.spec.template is not None
+            else {}
+        )
+        new_sel = dict(new_rc.spec.selector or {})
+        if new_sel and all(old_labels.get(k) == v for k, v in new_sel.items()):
+            raise ValueError(
+                "new RC's selector matches the old RC's pods; add a "
+                "distinguishing label (e.g. a deployment key) to the new "
+                "selector and template"
             )
         old = self._ensure_disjoint(old, new_rc, namespace)
 

@@ -76,18 +76,22 @@ def load_kubeconfig(
     reference loader: explicit path, $KTCONFIG / $KUBECONFIG, then the
     default home locations; a missing file yields defaults (local
     cluster), a malformed one raises."""
-    candidates = []
     if path:
-        candidates.append(path)
-    for var in ("KTCONFIG", "KUBECONFIG"):
-        if os.environ.get(var):
-            candidates.append(os.environ[var])
-    candidates.extend(DEFAULT_PATHS)
-    chosen = next((c for c in candidates if os.path.exists(c)), None)
-    if chosen is None:
-        if path:
+        # An EXPLICIT path must exist — falling back to the operator's
+        # personal config would silently point writes elsewhere.
+        if not os.path.exists(path):
             raise KubeconfigError(f"kubeconfig {path!r} not found")
-        return ClientConfig()
+        chosen = path
+    else:
+        candidates = [
+            os.environ[var]
+            for var in ("KTCONFIG", "KUBECONFIG")
+            if os.environ.get(var)
+        ]
+        candidates.extend(DEFAULT_PATHS)
+        chosen = next((c for c in candidates if os.path.exists(c)), None)
+        if chosen is None:
+            return ClientConfig()
     with open(chosen) as f:
         data = _parse(f.read())
 

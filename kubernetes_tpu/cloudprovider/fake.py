@@ -46,6 +46,23 @@ class FakeCloudProvider(CloudProvider):
         self.calls.append("routes")
         return list(self._routes)
 
+    def create_route(
+        self, name: str, target_instance: str, destination_cidr: str
+    ) -> None:
+        self.calls.append(f"create_route:{name}")
+        self._routes = [r for r in self._routes if r.name != name]
+        self._routes.append(
+            Route(
+                name=name,
+                target_instance=target_instance,
+                destination_cidr=destination_cidr,
+            )
+        )
+
+    def delete_route(self, name: str) -> None:
+        self.calls.append(f"delete_route:{name}")
+        self._routes = [r for r in self._routes if r.name != name]
+
     def load_balancer(self) -> Optional[LoadBalancerStub]:
         return self._lb
 

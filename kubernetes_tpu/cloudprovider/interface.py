@@ -82,6 +82,16 @@ class CloudProvider:
     def routes(self) -> Optional[List[Route]]:
         return None
 
+    def create_route(
+        self, name: str, target_instance: str, destination_cidr: str
+    ) -> None:
+        """Program one route (reference: Routes.CreateRoute). Providers
+        without a mutable route table raise."""
+        raise NotImplementedError(f"{self.name}: routes are read-only")
+
+    def delete_route(self, name: str) -> None:
+        raise NotImplementedError(f"{self.name}: routes are read-only")
+
     def load_balancer(self) -> Optional[LoadBalancerStub]:
         return None
 

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from kubernetes_tpu.cloudprovider.interface import (
     CloudProvider,
     Instance,
+    LoadBalancerStub,
     Route,
     Zone,
     register_provider,
@@ -43,6 +44,11 @@ class TPUCloudProvider(CloudProvider):
             devices = jax.devices()
         self.devices = list(devices)
         self.slice_name = slice_name
+        # Managed routes (RouteController's pod-CIDR routes) layered on
+        # top of the discovered ICI base ring.
+        self._managed_routes: Dict[str, Route] = {}
+        # Fabric ingress surface: portal rules at the slice edge.
+        self._lb = LoadBalancerStub()
 
     # -- host grouping ------------------------------------------------
 
@@ -102,7 +108,7 @@ class TPUCloudProvider(CloudProvider):
                 )
         return None
 
-    def routes(self) -> List[Route]:
+    def _base_routes(self) -> List[Route]:
         """ICI connectivity between hosts, modeled as a ring over host
         indices — the wraparound links every host has on real torus
         slices. (Finer-grained coords-based adjacency would refine
@@ -121,6 +127,24 @@ class TPUCloudProvider(CloudProvider):
                 )
             )
         return out
+
+    def routes(self) -> List[Route]:
+        return self._base_routes() + list(self._managed_routes.values())
+
+    def create_route(
+        self, name: str, target_instance: str, destination_cidr: str
+    ) -> None:
+        self._managed_routes[name] = Route(
+            name=name,
+            target_instance=target_instance,
+            destination_cidr=destination_cidr,
+        )
+
+    def delete_route(self, name: str) -> None:
+        self._managed_routes.pop(name, None)
+
+    def load_balancer(self) -> LoadBalancerStub:
+        return self._lb
 
     def cluster_names(self) -> List[str]:
         return [self.slice_name]

@@ -40,9 +40,17 @@ class ControllerManager:
         self.controllers: List = []
         if cloud_provider is not None:
             from kubernetes_tpu.controllers.cloudnodes import CloudNodeController
+            from kubernetes_tpu.controllers.routes import RouteController
+            from kubernetes_tpu.controllers.servicelb import ServiceController
 
             self.cloud_nodes = CloudNodeController(client, cloud_provider)
             self.controllers.append(self.cloud_nodes)
+            if cloud_provider.load_balancer() is not None:
+                self.service_lb = ServiceController(client, cloud_provider)
+                self.controllers.append(self.service_lb)
+            if cloud_provider.routes() is not None:
+                self.route_controller = RouteController(client, cloud_provider)
+                self.controllers.append(self.route_controller)
         if enable_replication:
             self.replication = ReplicationManager(client)
             self.controllers.append(self.replication)

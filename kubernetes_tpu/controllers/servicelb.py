@@ -1,0 +1,152 @@
+"""ServiceController: drives the cloud provider's load-balancer surface
+for Services of type LoadBalancer.
+
+Reference: pkg/cloudprovider/servicecontroller/servicecontroller.go —
+watch services; for type=LoadBalancer ensure a provider LB pointing at
+the cluster's (ready) hosts and publish the allocated ingress in
+service.status; keep the host list in sync as nodes come and go; tear
+the LB down when the service is deleted or changes type.
+
+TPU analog: the provider's "load balancer" is a fabric ingress — portal
+rules programmed at the slice edge (cloudprovider/tpu.py) — but the
+control loop is provider-agnostic through LoadBalancerStub.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.client.cache import Informer
+from kubernetes_tpu.models import serde
+from kubernetes_tpu.models.objects import Node, Service
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+
+_SYNCS = metrics.DEFAULT.counter(
+    "service_lb_syncs_total", "service LB sync outcomes", ("action",)
+)
+
+
+def _decode_service(wire: dict) -> Service:
+    return serde.from_wire(Service, wire)
+
+
+def _decode_node(wire: dict) -> Node:
+    return serde.from_wire(Node, wire)
+
+
+def _node_ready(node: Node) -> bool:
+    for c in node.status.conditions:
+        if c.type == "Ready":
+            return c.status == "True"
+    return False
+
+
+class ServiceController:
+    def __init__(self, client, provider, sync_period: float = 1.0):
+        self.client = client
+        self.lb = provider.load_balancer()
+        self.sync_period = sync_period
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        mark = lambda o: self._dirty.set()  # noqa: E731
+        self.services = Informer(
+            client, "services", decode=_decode_service,
+            on_add=mark, on_update=mark, on_delete=mark,
+        )
+        self.nodes = Informer(
+            client, "nodes", decode=_decode_node,
+            on_add=mark, on_update=mark, on_delete=mark,
+        )
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "ServiceController":
+        if self.lb is None:
+            raise ValueError("cloud provider has no load balancer surface")
+        self.services.start()
+        self.nodes.start()
+        self.services.wait_for_sync()
+        self.nodes.wait_for_sync()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._dirty.set()
+        self.services.stop()
+        self.nodes.stop()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- reconcile ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._dirty.wait(self.sync_period)
+            self._dirty.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.sync()
+                _SYNCS.inc(action="ok")
+            except Exception:
+                # Crash containment, but visibly: a permanently failing
+                # reconcile must show up in /metrics.
+                _SYNCS.inc(action="error")
+
+    def _hosts(self) -> List[str]:
+        return sorted(
+            n.metadata.name
+            for n in self.nodes.store.list()
+            if _node_ready(n)
+        )
+
+    @staticmethod
+    def _key(svc: Service) -> str:
+        return f"{svc.metadata.namespace or 'default'}/{svc.metadata.name}"
+
+    @staticmethod
+    def _lb_name(svc: Service) -> str:
+        return f"{svc.metadata.namespace or 'default'}-{svc.metadata.name}"
+
+    def sync(self) -> None:
+        import copy
+
+        hosts = self._hosts()
+        wanted_names = set()
+        for svc in self.services.store.list():
+            if svc.spec.type != "LoadBalancer":
+                continue
+            name = self._lb_name(svc)
+            wanted_names.add(name)
+            ingress = self.lb.ensure(name, hosts)
+            wanted = [{"ip": ingress}]
+            current = (svc.status or {}).get("loadBalancer", {}).get("ingress")
+            if current != wanted:
+                # Copy before mutating: the informer cache's object is
+                # shared — mutating it in place would make a FAILED
+                # status write look already-applied next tick.
+                patched = copy.deepcopy(svc)
+                patched.status = dict(patched.status or {})
+                patched.status["loadBalancer"] = {"ingress": wanted}
+                try:
+                    self.client.update_status(
+                        "services", patched,
+                        namespace=svc.metadata.namespace or "default",
+                    )
+                except APIError:
+                    pass  # retried next tick (cache stays unmodified)
+        # Reconcile teardown against the PROVIDER's state, not an
+        # in-memory map: a controller restart must still collect LBs
+        # whose service vanished while it was down. This controller
+        # owns the provider's whole LB surface (reference
+        # servicecontroller owns cloud LBs matching its naming).
+        for name in list(self.lb.balancers):
+            if name not in wanted_names:
+                self.lb.delete(name)
+            elif self.lb.balancers.get(name) != hosts:
+                self.lb.update_hosts(name, hosts)

@@ -1,0 +1,252 @@
+"""ServiceController (provider LBs) + RouteController (pod CIDRs).
+
+Reference: pkg/cloudprovider/servicecontroller/servicecontroller.go and
+routecontroller/routecontroller.go (VERDICT r1 #8)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.cloudprovider.fake import FakeCloudProvider
+from kubernetes_tpu.cloudprovider.tpu import TPUCloudProvider
+from kubernetes_tpu.controllers.routes import RouteController
+from kubernetes_tpu.controllers.servicelb import ServiceController
+from kubernetes_tpu.server import APIServer
+
+
+def wait_until(cond, timeout=6.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def node_wire(name, ready=True, pod_cidr=""):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {"podCIDR": pod_cidr},
+        "status": {
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ]
+        },
+    }
+
+
+def lb_service_wire(name, svc_type="LoadBalancer"):
+    return {
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "selector": {"app": name},
+            "ports": [{"name": "http", "port": 80}],
+            "clusterIP": "10.0.0.50",
+            "type": svc_type,
+        },
+    }
+
+
+@pytest.fixture
+def api_client():
+    api = APIServer()
+    return api, Client(LocalTransport(api))
+
+
+class TestServiceController:
+    def test_loadbalancer_service_gets_provider_ingress(self, api_client):
+        api, client = api_client
+        provider = FakeCloudProvider()
+        client.create("nodes", node_wire("n1"))
+        client.create("nodes", node_wire("n2"))
+        client.create("nodes", node_wire("sick", ready=False))
+        ctrl = ServiceController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            client.create(
+                "services", lb_service_wire("web"), namespace="default"
+            )
+            assert wait_until(
+                lambda: (
+                    client.get("services", "web", namespace="default").status
+                    or {}
+                )
+                .get("loadBalancer", {})
+                .get("ingress")
+            )
+            svc = client.get("services", "web", namespace="default")
+            assert svc.status["loadBalancer"]["ingress"] == [
+                {"ip": "lb-default-web"}
+            ]
+            # Only READY nodes back the LB.
+            assert provider.load_balancer().balancers["default-web"] == [
+                "n1",
+                "n2",
+            ]
+        finally:
+            ctrl.stop()
+
+    def test_node_churn_updates_lb_hosts(self, api_client):
+        api, client = api_client
+        provider = FakeCloudProvider()
+        client.create("nodes", node_wire("n1"))
+        ctrl = ServiceController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            client.create(
+                "services", lb_service_wire("web"), namespace="default"
+            )
+            assert wait_until(
+                lambda: provider.load_balancer().balancers.get("default-web")
+                == ["n1"]
+            )
+            client.create("nodes", node_wire("n2"))
+            assert wait_until(
+                lambda: provider.load_balancer().balancers.get("default-web")
+                == ["n1", "n2"]
+            )
+        finally:
+            ctrl.stop()
+
+    def test_clusterip_service_ignored_and_teardown_on_delete(self, api_client):
+        api, client = api_client
+        provider = FakeCloudProvider()
+        ctrl = ServiceController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            client.create(
+                "services",
+                lb_service_wire("plain", svc_type="ClusterIP"),
+                namespace="default",
+            )
+            client.create(
+                "services", lb_service_wire("lb"), namespace="default"
+            )
+            assert wait_until(
+                lambda: "default-lb" in provider.load_balancer().balancers
+            )
+            assert "default-plain" not in provider.load_balancer().balancers
+            client.delete("services", "lb", namespace="default")
+            assert wait_until(
+                lambda: "default-lb" not in provider.load_balancer().balancers
+            )
+        finally:
+            ctrl.stop()
+
+    def test_tpu_provider_fabric_ingress(self, api_client):
+        """The TPU fabric provider's LB surface: a LoadBalancer service
+        gets a slice-edge ingress backed by TPU hosts."""
+        api, client = api_client
+
+        class Dev:
+            process_index = 0
+            device_kind = "tpu-v5e"
+            platform = "tpu"
+            coords = (0, 0, 0)
+
+        provider = TPUCloudProvider(devices=[Dev()])
+        client.create("nodes", node_wire("tpu-host-0"))
+        ctrl = ServiceController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            client.create(
+                "services", lb_service_wire("inference"), namespace="default"
+            )
+            assert wait_until(
+                lambda: provider.load_balancer().balancers.get(
+                    "default-inference"
+                )
+                == ["tpu-host-0"]
+            )
+        finally:
+            ctrl.stop()
+
+
+class TestRouteController:
+    def test_routes_follow_pod_cidrs(self, api_client):
+        api, client = api_client
+        provider = FakeCloudProvider()
+        client.create("nodes", node_wire("n1", pod_cidr="10.244.1.0/24"))
+        client.create("nodes", node_wire("n2", pod_cidr="10.244.2.0/24"))
+        client.create("nodes", node_wire("nocidr"))
+        ctrl = RouteController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            assert wait_until(
+                lambda: {r.name for r in provider.routes()}
+                == {"podcidr-n1", "podcidr-n2"}
+            )
+            by_name = {r.name: r for r in provider.routes()}
+            assert by_name["podcidr-n1"].destination_cidr == "10.244.1.0/24"
+            assert by_name["podcidr-n1"].target_instance == "n1"
+            # Node deletion removes its route.
+            client.delete("nodes", "n2")
+            assert wait_until(
+                lambda: {r.name for r in provider.routes()} == {"podcidr-n1"}
+            )
+        finally:
+            ctrl.stop()
+
+    def test_cidr_move_recreates_route(self, api_client):
+        api, client = api_client
+        provider = FakeCloudProvider()
+        client.create("nodes", node_wire("n1", pod_cidr="10.244.1.0/24"))
+        ctrl = RouteController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            assert wait_until(
+                lambda: any(
+                    r.destination_cidr == "10.244.1.0/24"
+                    for r in provider.routes()
+                )
+            )
+            node = client.get("nodes", "n1")
+            node.spec.pod_cidr = "10.244.9.0/24"
+            client.update("nodes", node)
+            assert wait_until(
+                lambda: any(
+                    r.destination_cidr == "10.244.9.0/24"
+                    for r in provider.routes()
+                )
+            )
+        finally:
+            ctrl.stop()
+
+    def test_ici_base_routes_untouched(self, api_client):
+        """The TPU provider's discovered ICI ring is not managed state:
+        the controller must never delete it."""
+        api, client = api_client
+
+        class Dev:
+            def __init__(self, pid):
+                self.process_index = pid
+                self.device_kind = "tpu-v5e"
+                self.platform = "tpu"
+                self.coords = (pid, 0, 0)
+
+        provider = TPUCloudProvider(devices=[Dev(0), Dev(1)])
+        base = {r.name for r in provider.routes()}
+        assert base  # ici ring exists
+        ctrl = RouteController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            client.create(
+                "nodes", node_wire("tpu-host-0", pod_cidr="10.244.0.0/24")
+            )
+            assert wait_until(
+                lambda: "podcidr-tpu-host-0"
+                in {r.name for r in provider.routes()}
+            )
+            assert base <= {r.name for r in provider.routes()}
+        finally:
+            ctrl.stop()
