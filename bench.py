@@ -236,6 +236,33 @@ def main() -> None:
         "serial_total": round(t4 - t0, 3),
     }
 
+    # Wave-vs-scan comparison (VERDICT r1 #6): the batched wave solver
+    # against the sequential-parity scan on the same device problem.
+    from kubernetes_tpu.ops.wave import solve_waves
+
+    pods, nodes, services = _synthetic_objects(n_pods, n_nodes, seed=2)
+    snap = build_snapshot(pods, nodes, services=services)
+    d = device_snapshot(snap)
+    out, waves = solve_waves(d.pods, d.nodes)
+    np.asarray(out)  # warm
+    gc.collect()
+    t0 = time.perf_counter()
+    out, waves = solve_waves(d.pods, d.nodes)
+    wave_assign = np.asarray(out)[: d.n_pods]
+    t_wave = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(solve(d.pods, d.nodes))
+    t_scan = time.perf_counter() - t0
+    wave_placed = int((wave_assign >= 0).sum())
+    wave_stats = {
+        "wave_solve_s": round(t_wave, 3),
+        "scan_solve_s": round(t_scan, 3),
+        "wave_speedup": round(t_scan / max(t_wave, 1e-9), 2),
+        "wave_count": int(waves),
+        "pods_per_wave": round(wave_placed / max(int(waves), 1), 1),
+        "wave_placed": wave_placed,
+    }
+
     parity = _parity_figures()
     best = min(times)
     pods_per_sec = n_pods / best
@@ -248,6 +275,7 @@ def main() -> None:
         "phases_serial_s": phases,
         "placed": placed,
     }
+    record.update(wave_stats)
     record.update(parity)
     print(json.dumps(record))
     print(

@@ -57,6 +57,10 @@ class LoadBalancerStub:
 
     def ensure(self, name: str, hosts: List[str]) -> str:
         self.balancers[name] = list(hosts)
+        return self.address(name)
+
+    def address(self, name: str) -> str:
+        """Ingress address of an already-provisioned balancer."""
         return f"lb-{name}"
 
     def update_hosts(self, name: str, hosts: List[str]) -> None:

@@ -106,40 +106,60 @@ class ServiceController:
         )
 
     @staticmethod
-    def _key(svc: Service) -> str:
-        return f"{svc.metadata.namespace or 'default'}/{svc.metadata.name}"
-
-    @staticmethod
     def _lb_name(svc: Service) -> str:
-        return f"{svc.metadata.namespace or 'default'}-{svc.metadata.name}"
+        """Unique, DNS-safe provider LB name. The namespace/name pair
+        is disambiguated with a short hash: a plain '-' join collides
+        ('team-a'/'api' vs 'team'/'a-api'), and the reference derives
+        LB names from the service UID for the same reason."""
+        import hashlib
 
-    def sync(self) -> None:
+        key = f"{svc.metadata.namespace or 'default'}/{svc.metadata.name}"
+        suffix = hashlib.sha1(key.encode()).hexdigest()[:6]
+        return f"{key.replace('/', '-')}-{suffix}"
+
+    def _publish_status(self, svc: Service, ingress) -> None:
+        """Write status.loadBalancer.ingress if it differs (copy first:
+        the informer cache's object is shared — mutating it in place
+        would make a FAILED status write look already-applied)."""
         import copy
 
+        wanted = {"ingress": ingress} if ingress else {}
+        current = (svc.status or {}).get("loadBalancer") or {}
+        if current == wanted:
+            return
+        patched = copy.deepcopy(svc)
+        patched.status = dict(patched.status or {})
+        patched.status["loadBalancer"] = wanted
+        try:
+            self.client.update_status(
+                "services", patched,
+                namespace=svc.metadata.namespace or "default",
+            )
+        except APIError:
+            pass  # retried next tick (cache stays unmodified)
+
+    def sync(self) -> None:
         hosts = self._hosts()
         wanted_names = set()
         for svc in self.services.store.list():
             if svc.spec.type != "LoadBalancer":
+                # Type changed away from LoadBalancer: the provider LB
+                # is collected below, and the published ingress must go
+                # with it (a live-looking ingress pointing at a deleted
+                # LB is worse than none).
+                self._publish_status(svc, None)
                 continue
             name = self._lb_name(svc)
             wanted_names.add(name)
-            ingress = self.lb.ensure(name, hosts)
-            wanted = [{"ip": ingress}]
-            current = (svc.status or {}).get("loadBalancer", {}).get("ingress")
-            if current != wanted:
-                # Copy before mutating: the informer cache's object is
-                # shared — mutating it in place would make a FAILED
-                # status write look already-applied next tick.
-                patched = copy.deepcopy(svc)
-                patched.status = dict(patched.status or {})
-                patched.status["loadBalancer"] = {"ingress": wanted}
-                try:
-                    self.client.update_status(
-                        "services", patched,
-                        namespace=svc.metadata.namespace or "default",
-                    )
-                except APIError:
-                    pass  # retried next tick (cache stays unmodified)
+            if name not in self.lb.balancers:
+                ingress = self.lb.ensure(name, hosts)
+            else:
+                # Already provisioned: only reprogram on host drift
+                # (a real provider call per service per tick is waste).
+                if self.lb.balancers.get(name) != hosts:
+                    self.lb.update_hosts(name, hosts)
+                ingress = self.lb.address(name)
+            self._publish_status(svc, [{"ip": ingress}])
         # Reconcile teardown against the PROVIDER's state, not an
         # in-memory map: a controller restart must still collect LBs
         # whose service vanished while it was down. This controller
@@ -148,5 +168,3 @@ class ServiceController:
         for name in list(self.lb.balancers):
             if name not in wanted_names:
                 self.lb.delete(name)
-            elif self.lb.balancers.get(name) != hosts:
-                self.lb.update_hosts(name, hosts)

@@ -11,6 +11,7 @@ from kubernetes_tpu.ops.matrices import DeviceSnapshot, device_snapshot
 from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
 from kubernetes_tpu.ops.solver import solve, solve_assignments, solve_with_state
 from kubernetes_tpu.ops.incremental import RebuildRequired, SolverSession
+from kubernetes_tpu.ops.wave import solve_waves
 
 __all__ = [
     "DeviceSnapshot",
@@ -20,5 +21,6 @@ __all__ = [
     "solve",
     "solve_assignments",
     "solve_backlog_pipelined",
+    "solve_waves",
     "solve_with_state",
 ]

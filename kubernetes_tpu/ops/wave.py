@@ -1,0 +1,271 @@
+"""Wave-commit solver: many pods per device step.
+
+The sequential-parity scan (ops.solver) replicates the reference's
+pod-at-a-time semantics exactly, but its 50k dependent steps are
+latency-bound on a single chip and latency-DOMINATED over a mesh
+(every step is an argmax + tiny all-reduce over ICI). This solver
+trades exact decision-order parity for wave-level batching:
+
+  each wave:
+    1. evaluate feasibility + scores for a WINDOW of undecided pods
+       against the current cluster state — one batched W x N block of
+       vector ops (shards cleanly over the node axis; per-wave
+       collectives instead of per-pod);
+    2. every pod picks its argmax node (same masking + lowest-index
+       tie-break as the scan);
+    3. pods that picked the same node are packed capacity-aware in
+       FIFO order — a segmented prefix-sum over the sorted (node, pod)
+       pairs accepts the prefix that fits (CPU, memory, pod count);
+       pods carrying hostPort/volume bits only commit one-per-node-
+       per-wave (conservative: within-wave conflicts are impossible);
+    4. accepted pods commit in bulk (scatter-adds); pods infeasible on
+       every node are finalized unschedulable (occupancy only grows,
+       so infeasible-now is infeasible-forever); conflict losers retry
+       next wave.
+
+Decision parity vs the sequential oracle is deliberately APPROXIMATE:
+pods in one wave don't see each other's spreading/balance effects.
+The scan remains the >=99%-parity headline path and the referee;
+bench.py publishes the wave solver's measured parity and speedup next
+to it. Reference framing: BASELINE.json north star (assignment-solver
+scheduling); no reference code corresponds — kubernetes schedules one
+pod per loop iteration (plugin/pkg/scheduler/scheduler.go:113-158).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, _feasible, _scores
+
+UNDECIDED = -2  # assignment sentinel: not yet finalized
+
+FMAX = jnp.float32(3.4e38)
+
+
+def _window_rows(pods: Dict, idx: jnp.ndarray) -> Dict:
+    """Gather the window's pod rows (idx may contain P = padding)."""
+    safe = jnp.minimum(idx, pods["cpu"].shape[0] - 1)
+    return {k: v[safe] for k, v in pods.items()}
+
+
+def _batched_eval(wpods: Dict, nodes: Dict, weights, N: int):
+    feas = jax.vmap(lambda p: _feasible(p, nodes, N))(wpods)
+    score = jax.vmap(lambda p: _scores(p, nodes, weights))(wpods)
+    return feas, score
+
+
+def _pack_window(
+    choice: jnp.ndarray,  # i32[W] chosen node (-1 = none feasible)
+    wcpu: jnp.ndarray,
+    wmem: jnp.ndarray,
+    wzero: jnp.ndarray,  # bool[W] zero-request pod (count-only fit)
+    has_bits: jnp.ndarray,  # bool[W] pod carries port/volume bits
+    nodes: Dict,
+    N: int,
+    W: int,
+    per_node_limit: int = 1,
+) -> jnp.ndarray:
+    """bool[W]: which window pods commit this wave (capacity-aware
+    FIFO packing per node)."""
+    pos = jnp.arange(W, dtype=jnp.int32)
+    contending = choice >= 0
+    # Sort by (node, window position); losers/finalized group last
+    # under sentinel node N. Key fits int32: (N+1) * W < 2^31 for any
+    # realistic padded shapes (5k nodes x 4k window ~ 2^25).
+    key = jnp.where(contending, choice, jnp.int32(N)) * jnp.int32(W) + pos
+    perm = jnp.argsort(key)
+    s_choice = choice[perm]
+    s_cpu = wcpu[perm]
+    s_mem = wmem[perm]
+    s_zero = wzero[perm]
+    s_bits = has_bits[perm].astype(jnp.float32)
+    s_contending = contending[perm]
+
+    start = jnp.concatenate(
+        [jnp.ones(1, bool), s_choice[1:] != s_choice[:-1]]
+    )
+
+    def seg_prefix_before(x):
+        """Per-element sum of EARLIER same-segment elements."""
+        cs = jnp.cumsum(x)
+        seg_base = jnp.where(start, cs - x, -FMAX)
+        base = jax.lax.cummax(seg_base)  # cs is nondecreasing (x >= 0)
+        return cs - x - base
+
+    cpu_before = seg_prefix_before(s_cpu)
+    mem_before = seg_prefix_before(s_mem)
+    rank = seg_prefix_before(jnp.ones(W, jnp.float32))
+    bits_before = seg_prefix_before(s_bits)
+
+    node = jnp.maximum(s_choice, 0)
+    cap_cpu = nodes["cpu_cap"][node]
+    cap_mem = nodes["mem_cap"][node]
+    rem_cpu = jnp.where(cap_cpu > 0, cap_cpu - nodes["cpu_fit"][node], FMAX)
+    rem_mem = jnp.where(cap_mem > 0, cap_mem - nodes["mem_fit"][node], FMAX)
+    rem_count = nodes["pods_cap"][node] - nodes["pods_used"][node]
+
+    # Zero-request pods fit by pod count alone (predicates.go:146);
+    # subjecting them to the cpu/mem prefix check could wedge them
+    # forever on a node whose greedy-fit sums already exceed capacity.
+    resources_ok = s_zero | (
+        (cpu_before + s_cpu <= rem_cpu) & (mem_before + s_mem <= rem_mem)
+    )
+    ok = (
+        s_contending
+        & resources_ok
+        & (rank + 1 <= rem_count)
+        # Per-node-per-wave acceptance limit: committing a whole
+        # capacity prefix onto one node in a single wave tramples the
+        # spreading/balance scores the losers would have reacted to.
+        # Limiting acceptances keeps each wave close to one "round" of
+        # the sequential cascade (measured: parity 0.05 -> ~0.9+ on
+        # mixed workloads at limit=1).
+        & (rank < per_node_limit)
+        # Port/volume carriers: only the group's first carrier commits
+        # this wave, so within-wave port/disk conflicts can't happen.
+        & ((s_bits == 0) | (bits_before == 0))
+    )
+    # Unsort back to window order.
+    accepted = jnp.zeros(W, bool).at[perm].set(ok)
+    return accepted
+
+
+def _commit_wave(
+    nodes: Dict,
+    wpods: Dict,
+    choice: jnp.ndarray,
+    accepted: jnp.ndarray,
+    W: int,
+) -> Dict:
+    """Bulk commit of every accepted (pod -> node) pair."""
+    j = jnp.where(accepted, choice, 0)
+    f = accepted.astype(jnp.float32)
+    new = dict(nodes)
+    new["cpu_fit"] = nodes["cpu_fit"].at[j].add(f * wpods["cpu"], mode="drop")
+    new["mem_fit"] = nodes["mem_fit"].at[j].add(f * wpods["mem"], mode="drop")
+    new["cpu_used"] = nodes["cpu_used"].at[j].add(f * wpods["cpu"], mode="drop")
+    new["mem_used"] = nodes["mem_used"].at[j].add(f * wpods["mem"], mode="drop")
+    new["pods_used"] = nodes["pods_used"].at[j].add(f, mode="drop")
+    # Bit rows: at most ONE accepted carrier per node per wave (packing
+    # guarantee), so gather-OR-scatter over unique rows is exact.
+    carrier = accepted & (
+        jnp.any(wpods["port"] != 0, axis=1)
+        | jnp.any(wpods["vol_any"] != 0, axis=1)
+        | jnp.any(wpods["vol_rw"] != 0, axis=1)
+    )
+    cmask = carrier[:, None]
+    N = nodes["cpu_cap"].shape[0]
+    # Non-carriers scatter OUT OF BOUNDS (dropped): routing them to a
+    # shared dummy row would create duplicate-index scatters whose
+    # no-op lanes can clobber a real carrier's update to that row.
+    crow = jnp.where(carrier, choice, N)
+    grow = jnp.minimum(crow, N - 1)  # clamped gather (values unused)
+    for field, pkey in (
+        ("uport", "port"),
+        ("uvol_any", "vol_any"),
+        ("uvol_rw", "vol_rw"),
+    ):
+        add_bits = jnp.where(cmask, wpods[pkey], 0)
+        gathered = new[field][grow] | add_bits
+        new[field] = new[field].at[crow].set(gathered, mode="drop")
+    # Service membership counts (duplicates accumulate correctly).
+    ids = wpods["svc_ids"]  # i32[W, K]
+    valid = (ids >= 0) & accepted[:, None]
+    rows = jnp.where(accepted, choice, 0)[:, None].repeat(ids.shape[1], axis=1)
+    new["svc_counts"] = nodes["svc_counts"].at[
+        rows, jnp.maximum(ids, 0)
+    ].add(valid.astype(jnp.float32), mode="drop")
+    return new
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights", "window", "per_node_limit")
+)
+def solve_waves(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+    window: int = 4096,
+    per_node_limit: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(assignment i32[P] with -1 = unschedulable, wave count). Every
+    wave finalizes at least one pod, so the loop terminates."""
+    P = pods["cpu"].shape[0]
+    N = nodes["cpu_cap"].shape[0]
+    W = min(window, P)
+    assignment0 = jnp.full(P, UNDECIDED, jnp.int32)
+    # Padding pods (pinned == -2) can never place: finalize them now so
+    # the loop condition sees only real pods.
+    assignment0 = jnp.where(pods["pinned"] == -2, -1, assignment0)
+
+    def cond(state):
+        assignment, _, waves = state
+        return jnp.any(assignment == UNDECIDED) & (waves < P)
+
+    def body(state):
+        assignment, carry, waves = state
+        undecided = assignment == UNDECIDED
+        idx = jnp.nonzero(undecided, size=W, fill_value=P)[0].astype(jnp.int32)
+        valid = idx < P
+        wpods = _window_rows(pods, idx)
+        feas, score = _batched_eval(wpods, carry, weights, N)
+        masked = jnp.where(feas, score, -1)
+        # Randomized tie-break (the reference also randomizes:
+        # generic_scheduler.go:90-102 picks random.Int() % len(ties)).
+        # The scan uses lowest-index for oracle parity; a wave MUST
+        # scatter ties or every pod in the window piles onto the same
+        # few low-index nodes and per-wave throughput collapses
+        # (measured: 14 pods/wave with lowest-index, ~window with
+        # hashed ties on a 5k-node cluster).
+        h = (
+            (idx[:, None].astype(jnp.uint32) * jnp.uint32(2654435761))
+            ^ (jnp.arange(N, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
+        ) & jnp.uint32(0xFFFF)
+        combined = (masked << 16) | h.astype(jnp.int32)
+        best = jnp.argmax(combined, axis=1).astype(jnp.int32)
+        feasible = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] >= 0
+        choice = jnp.where(valid & feasible, best, -1)
+
+        has_bits = (
+            jnp.any(wpods["port"] != 0, axis=1)
+            | jnp.any(wpods["vol_any"] != 0, axis=1)
+            | jnp.any(wpods["vol_rw"] != 0, axis=1)
+        )
+        accepted = _pack_window(
+            choice,
+            wpods["cpu"],
+            wpods["mem"],
+            wpods["zero_req"],
+            has_bits,
+            carry,
+            N,
+            W,
+            per_node_limit,
+        )
+        carry = _commit_wave(carry, wpods, choice, accepted, W)
+        # One combined scatter: accepted pods get their node; pods with
+        # no feasible node finalize -1 (occupancy only grows, so
+        # infeasible-now is infeasible-forever); conflict losers stay
+        # UNDECIDED and retry next wave.
+        newly_unschedulable = valid & ~feasible
+        value = jnp.where(
+            accepted,
+            choice,
+            jnp.where(newly_unschedulable, -1, UNDECIDED),
+        )
+        assignment = assignment.at[idx].set(value, mode="drop")
+        return assignment, carry, waves + 1
+
+    assignment, _, waves = jax.lax.while_loop(
+        cond, body, (assignment0, dict(nodes), jnp.int32(0))
+    )
+    # Safety valve: the wave cap (P) cannot be hit given the
+    # first-undecided-pod-always-finalizes invariant, but an UNDECIDED
+    # sentinel must never leak to callers.
+    assignment = jnp.where(assignment == UNDECIDED, -1, assignment)
+    return assignment, waves

@@ -7,6 +7,8 @@ import time
 
 import pytest
 
+from kubernetes_tpu.controllers.servicelb import ServiceController as _SC
+
 from kubernetes_tpu.client import Client, LocalTransport
 from kubernetes_tpu.cloudprovider.fake import FakeCloudProvider
 from kubernetes_tpu.cloudprovider.tpu import TPUCloudProvider
@@ -35,6 +37,16 @@ def node_wire(name, ready=True, pod_cidr=""):
             ]
         },
     }
+
+
+def lb_name(name, ns="default"):
+    class _Svc:
+        class metadata:
+            pass
+
+    svc = _Svc()
+    svc.metadata = type("M", (), {"namespace": ns, "name": name})()
+    return _SC._lb_name(svc)
 
 
 def lb_service_wire(name, svc_type="LoadBalancer"):
@@ -80,10 +92,10 @@ class TestServiceController:
             )
             svc = client.get("services", "web", namespace="default")
             assert svc.status["loadBalancer"]["ingress"] == [
-                {"ip": "lb-default-web"}
+                {"ip": f"lb-{lb_name('web')}"}
             ]
             # Only READY nodes back the LB.
-            assert provider.load_balancer().balancers["default-web"] == [
+            assert provider.load_balancer().balancers[lb_name("web")] == [
                 "n1",
                 "n2",
             ]
@@ -102,12 +114,12 @@ class TestServiceController:
                 "services", lb_service_wire("web"), namespace="default"
             )
             assert wait_until(
-                lambda: provider.load_balancer().balancers.get("default-web")
+                lambda: provider.load_balancer().balancers.get(lb_name("web"))
                 == ["n1"]
             )
             client.create("nodes", node_wire("n2"))
             assert wait_until(
-                lambda: provider.load_balancer().balancers.get("default-web")
+                lambda: provider.load_balancer().balancers.get(lb_name("web"))
                 == ["n1", "n2"]
             )
         finally:
@@ -129,12 +141,43 @@ class TestServiceController:
                 "services", lb_service_wire("lb"), namespace="default"
             )
             assert wait_until(
-                lambda: "default-lb" in provider.load_balancer().balancers
+                lambda: lb_name("lb") in provider.load_balancer().balancers
             )
-            assert "default-plain" not in provider.load_balancer().balancers
+            assert lb_name("plain") not in provider.load_balancer().balancers
             client.delete("services", "lb", namespace="default")
             assert wait_until(
-                lambda: "default-lb" not in provider.load_balancer().balancers
+                lambda: lb_name("lb") not in provider.load_balancer().balancers
+            )
+        finally:
+            ctrl.stop()
+
+    def test_type_change_clears_ingress_and_lb(self, api_client):
+        """Switching type LoadBalancer -> ClusterIP must tear down the
+        provider LB AND clear the published ingress."""
+        api, client = api_client
+        provider = FakeCloudProvider()
+        ctrl = ServiceController(
+            Client(LocalTransport(api)), provider, sync_period=0.1
+        ).start()
+        try:
+            client.create(
+                "services", lb_service_wire("flip"), namespace="default"
+            )
+            assert wait_until(
+                lambda: lb_name("flip") in provider.load_balancer().balancers
+            )
+            svc = client.get("services", "flip", namespace="default")
+            svc.spec.type = "ClusterIP"
+            client.update("services", svc, namespace="default")
+            assert wait_until(
+                lambda: lb_name("flip")
+                not in provider.load_balancer().balancers
+            )
+            assert wait_until(
+                lambda: not (
+                    client.get("services", "flip", namespace="default").status
+                    or {}
+                ).get("loadBalancer", {})
             )
         finally:
             ctrl.stop()
@@ -161,7 +204,7 @@ class TestServiceController:
             )
             assert wait_until(
                 lambda: provider.load_balancer().balancers.get(
-                    "default-inference"
+                    lb_name("inference")
                 )
                 == ["tpu-host-0"]
             )

@@ -1,0 +1,161 @@
+"""Wave-commit solver: validity, throughput (>1 pod per device step),
+determinism, and sharded-mesh execution.
+
+The wave solver trades decision-order parity for batching (VERDICT r1
+#6); what it must NEVER trade is placement VALIDITY — every assignment
+is checked here against the snapshot's own predicate semantics."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from kubernetes_tpu.models.columnar import build_snapshot
+from kubernetes_tpu.ops import device_snapshot
+from kubernetes_tpu.ops.solver import solve_assignments
+from kubernetes_tpu.ops.wave import solve_waves
+from test_solver_parity import mk_node, mk_pod, random_cluster
+
+
+def wave_assignments(dsnap, **kw):
+    out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
+    a = np.asarray(out)[: dsnap.n_pods]
+    return np.where(a >= dsnap.n_nodes, -1, a), int(waves)
+
+
+def check_validity(snap, assignment):
+    """Replay every placement against the columnar predicates; raises
+    on any capacity/selector/port/volume violation."""
+    n = snap.nodes
+    cpu_fit = n.cpu_fit_used.copy()
+    mem_fit = n.mem_fit_used.copy()
+    pods_used = n.pods_used.copy()
+    uport = n.used_port_bits.copy()
+    uvol_any = n.used_vol_any_bits.copy()
+    uvol_rw = n.used_vol_rw_bits.copy()
+    p = snap.pods
+    sel_rows = p.sel_bits[p.selector_id]
+    for i, j in enumerate(assignment):
+        if j < 0:
+            continue
+        assert n.schedulable[j], f"pod {i} on unschedulable node {j}"
+        assert not n.overcommitted[j], f"pod {i} on overcommitted node {j}"
+        if p.zero_req[i]:
+            assert pods_used[j] < n.pods_cap[j], f"pod {i}: count overflow"
+        else:
+            if n.cpu_cap[j] > 0:
+                assert cpu_fit[j] + p.cpu_milli[i] <= n.cpu_cap[j], (
+                    f"pod {i}: cpu overflow on node {j}"
+                )
+            if n.mem_cap[j] > 0:
+                assert mem_fit[j] + p.mem_mib[i] <= n.mem_cap[j], (
+                    f"pod {i}: mem overflow on node {j}"
+                )
+            assert pods_used[j] + 1 <= n.pods_cap[j], f"pod {i}: count"
+        sel = sel_rows[i]
+        assert ((sel & n.label_bits[j]) == sel).all(), f"pod {i}: selector"
+        assert not (p.port_bits[i] & uport[j]).any(), f"pod {i}: port clash"
+        assert not (
+            (p.vol_rw_bits[i] & uvol_any[j]) | (p.vol_any_bits[i] & uvol_rw[j])
+        ).any(), f"pod {i}: volume clash"
+        pin = p.pinned_node[i]
+        assert pin in (-1, j), f"pod {i}: pinned to {pin}, placed on {j}"
+        cpu_fit[j] += p.cpu_milli[i]
+        mem_fit[j] += p.mem_mib[i]
+        pods_used[j] += 1
+        uport[j] |= p.port_bits[i]
+        uvol_any[j] |= p.vol_any_bits[i]
+        uvol_rw[j] |= p.vol_rw_bits[i]
+
+
+class TestWaveValidity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_placements_valid_and_count_matches_scan(self, seed):
+        pods, nodes, assigned, services = random_cluster(seed)
+        snap = build_snapshot(pods, nodes, assigned, services)
+        d = device_snapshot(snap)
+        scan = solve_assignments(d)
+        wave, _ = wave_assignments(d, window=32)
+        check_validity(snap, wave)
+        # Placement counts track the sequential policy closely. Exact
+        # equality is NOT guaranteed on capacity-tight instances:
+        # commit order changes which pods fit, in either direction
+        # (the wave's randomized ties sometimes pack MORE pods than
+        # sequential lowest-index does).
+        placed_scan = int((scan >= 0).sum())
+        placed_wave = int((wave >= 0).sum())
+        slack = max(2, placed_scan // 10)
+        assert abs(placed_wave - placed_scan) <= slack, (wave, scan)
+
+    def test_capacity_stress_places_exactly_what_fits(self):
+        pods = [mk_pod(f"p{i}", cpu=600, mem_mib=64) for i in range(10)]
+        nodes = [mk_node(f"n{j}", cpu=1000) for j in range(3)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        wave, _ = wave_assignments(d, window=8)
+        check_validity(snap, wave)
+        assert (wave >= 0).sum() == 3  # one 600m pod per 1000m node
+
+    def test_zero_request_pods_fit_by_count(self):
+        pods = [mk_pod(f"z{i}", cpu=0, mem_mib=0) for i in range(5)]
+        nodes = [mk_node("n0", pods=2), mk_node("n1", pods=2)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        wave, _ = wave_assignments(d, window=8)
+        check_validity(snap, wave)
+        assert (wave >= 0).sum() == 4
+
+    def test_host_port_conflicts_respected(self):
+        pods = [mk_pod(f"hp{i}", host_port=8080) for i in range(4)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        wave, _ = wave_assignments(d, window=4)
+        check_validity(snap, wave)
+        assert (wave >= 0).sum() == 2  # one per node, port exclusivity
+
+    def test_deterministic(self):
+        pods, nodes, assigned, services = random_cluster(3)
+        snap = build_snapshot(pods, nodes, assigned, services)
+        d = device_snapshot(snap)
+        a1, _ = wave_assignments(d, window=16)
+        a2, _ = wave_assignments(d, window=16)
+        assert (a1 == a2).all()
+
+
+class TestWaveThroughput:
+    def test_many_pods_per_wave(self):
+        """VERDICT r1 #6 'done' criterion: per-step commit count > 1."""
+        pods = [
+            mk_pod(f"p{i}", cpu=100 + 50 * (i % 4), mem_mib=64)
+            for i in range(96)
+        ]
+        nodes = [mk_node(f"n{j}", cpu=8000, mem_mib=8192) for j in range(24)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        wave, waves = wave_assignments(d, window=96)
+        check_validity(snap, wave)
+        assert (wave >= 0).sum() == 96
+        assert waves < 96 / 2, waves  # strictly batching, not scanning
+        assert 96 / waves > 1.0
+
+
+class TestWaveOnMesh:
+    def test_sharded_matches_single_device(self):
+        """8-way node-sharded wave solve must produce the identical
+        assignment (integer math + deterministic tie hash)."""
+        pods, nodes, assigned, services = random_cluster(5)
+        snap = build_snapshot(pods, nodes, assigned, services)
+        single = device_snapshot(snap)
+        base, _ = wave_assignments(single, window=16)
+
+        devices = np.array(jax.devices()[:8])
+        mesh = Mesh(devices, axis_names=("nodes",))
+        sharded = device_snapshot(snap, mesh=mesh, pad_to=8)
+        with mesh:
+            out, _ = solve_waves(sharded.pods, sharded.nodes, window=16)
+            out.block_until_ready()
+        a = np.asarray(out)[: sharded.n_pods]
+        a = np.where(a >= sharded.n_nodes, -1, a)
+        assert (a == base).all()
