@@ -29,6 +29,7 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu import __version__
+from kubernetes_tpu.models import conversion
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.server.registry import RESOURCES
 from kubernetes_tpu.utils import metrics
@@ -55,6 +56,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing -----------------------------------------------------
 
     def _send_json(self, code: int, obj: dict) -> None:
+        version = getattr(self, "wire_version", "v1")
+        if version != "v1":
+            obj = conversion.from_internal(obj, version)
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -62,15 +66,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _read_body(self) -> dict:
+    def _read_body(self, kind_hint: str = "") -> dict:
+        """Parse (and version-convert) the request body. `kind_hint` is
+        the kind implied by the route: the API accepts kind-less bodies
+        (api.create setdefaults kind from the path), but conversion
+        dispatches ON kind — a kind-less v1beta3 body would silently
+        skip conversion and store legacy field names internally."""
         length = int(self.headers.get("Content-Length", 0) or 0)
         if length == 0:
             return {}
         raw = self.rfile.read(length)
         try:
-            return json.loads(raw)
+            body = json.loads(raw)
         except json.JSONDecodeError as e:
             raise APIError(400, "BadRequest", f"invalid JSON body: {e}")
+        version = getattr(self, "wire_version", "v1")
+        if version != "v1" and isinstance(body, dict):
+            if kind_hint and not body.get("kind"):
+                body["kind"] = kind_hint
+            body = conversion.to_internal(body, version)
+        return body
+
+    def _kind_of(self, resource: str) -> str:
+        info = RESOURCES.get(resource)
+        return info.kind if info is not None else ""
 
     def _route(self) -> Tuple[str, ...]:
         parsed = urlparse(self.path)
@@ -95,6 +114,9 @@ class _Handler(BaseHTTPRequestHandler):
         start = time.monotonic()
         resource = ""
         code = 200
+        # Reset per request: keep-alive connections reuse this handler
+        # instance, and a prior request's version must not leak.
+        self.wire_version = "v1"
         try:
             parts = self._route()
             if parts == ("healthz",):
@@ -117,10 +139,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {"gitVersion": __version__, "platform": "tpu"})
                 return
             if parts == ("api",):
-                self._send_json(200, {"kind": "APIVersions", "versions": ["v1"]})
+                self._send_json(
+                    200,
+                    {"kind": "APIVersions", "versions": list(conversion.VERSIONS)},
+                )
                 return
-            if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+            if (
+                len(parts) < 2
+                or parts[0] != "api"
+                or parts[1] not in conversion.VERSIONS
+            ):
                 raise APIError(404, "NotFound", f"unknown path {self.path!r}")
+            # Multi-version negotiation (pkg/api/latest/latest.go:32-78):
+            # bodies decode from — and responses encode to — the path's
+            # version; the registry/store speak internal (v1) only.
+            self.wire_version = parts[1]
             rest = parts[2:]
             self._check_auth(verb, rest)
             resource, code = self._api_v1(verb, rest)
@@ -259,7 +292,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(201, out)
                 return "bindings", 201
             if len(rest) == 5 and rest[4] == "status" and verb == "PUT":
-                out = api.update_status(resource, ns, name, self._read_body())
+                out = api.update_status(
+                    resource, ns, name, self._read_body(self._kind_of(resource))
+                )
                 self._send_json(200, out)
                 return resource, 200
             if (
@@ -341,7 +376,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, api.list(resource, ns, lsel, fsel))
             return resource, 200
         if verb == "POST":
-            out = api.create(resource, ns, self._read_body())
+            out = api.create(resource, ns, self._read_body(self._kind_of(resource)))
             self._send_json(201, out)
             return resource, 201
         raise APIError(405, "MethodNotAllowed", f"{verb} not allowed on collection")
@@ -351,7 +386,9 @@ class _Handler(BaseHTTPRequestHandler):
         if verb == "GET":
             self._send_json(200, api.get(resource, ns, name))
         elif verb == "PUT":
-            self._send_json(200, api.update(resource, ns, name, self._read_body()))
+            self._send_json(
+                200, api.update(resource, ns, name, self._read_body(self._kind_of(resource)))
+            )
         elif verb == "DELETE":
             self._send_json(200, api.delete(resource, ns, name))
         else:
@@ -387,7 +424,11 @@ class _Handler(BaseHTTPRequestHandler):
                     if stream.closed:
                         break
                     continue
-                frame = json.dumps({"type": ev.type, "object": ev.object}).encode()
+                obj = ev.object
+                version = getattr(self, "wire_version", "v1")
+                if version != "v1" and isinstance(obj, dict):
+                    obj = conversion.from_internal(obj, version)
+                frame = json.dumps({"type": ev.type, "object": obj}).encode()
                 frame += b"\n"
                 self.wfile.write(b"%x\r\n" % len(frame) + frame + b"\r\n")
                 self.wfile.flush()

@@ -4,10 +4,9 @@ Reference: pkg/cloudprovider/servicecontroller/servicecontroller.go and
 routecontroller/routecontroller.go (VERDICT r1 #8)."""
 
 import time
+from types import SimpleNamespace
 
 import pytest
-
-from kubernetes_tpu.controllers.servicelb import ServiceController as _SC
 
 from kubernetes_tpu.client import Client, LocalTransport
 from kubernetes_tpu.cloudprovider.fake import FakeCloudProvider
@@ -40,13 +39,8 @@ def node_wire(name, ready=True, pod_cidr=""):
 
 
 def lb_name(name, ns="default"):
-    class _Svc:
-        class metadata:
-            pass
-
-    svc = _Svc()
-    svc.metadata = type("M", (), {"namespace": ns, "name": name})()
-    return _SC._lb_name(svc)
+    svc = SimpleNamespace(metadata=SimpleNamespace(namespace=ns, name=name))
+    return ServiceController._lb_name(svc)
 
 
 def lb_service_wire(name, svc_type="LoadBalancer"):

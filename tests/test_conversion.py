@@ -1,0 +1,186 @@
+"""Multi-version API: v1beta3 <-> v1 conversion at the HTTP boundary.
+
+Reference: pkg/api/latest/latest.go:32-78 (version negotiation),
+pkg/api/v1beta3/conversion.go (host/nodeName, portalIP/clusterIP,
+createExternalLoadBalancer/type)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.models import conversion
+from kubernetes_tpu.server.api import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+class TestWireConversion:
+    def test_pod_host_to_nodename(self):
+        wire = {
+            "kind": "Pod",
+            "apiVersion": "v1beta3",
+            "spec": {"host": "n1", "containers": []},
+        }
+        out = conversion.to_internal(wire, "v1beta3")
+        assert out["spec"]["nodeName"] == "n1"
+        assert "host" not in out["spec"]
+        assert out["apiVersion"] == "v1"
+        back = conversion.from_internal(out, "v1beta3")
+        assert back["spec"]["host"] == "n1"
+        assert "nodeName" not in back["spec"]
+
+    def test_service_portal_ip_and_lb_bool(self):
+        wire = {
+            "kind": "Service",
+            "apiVersion": "v1beta3",
+            "spec": {
+                "portalIP": "10.0.0.1",
+                "createExternalLoadBalancer": True,
+                "publicIPs": ["1.2.3.4"],
+            },
+        }
+        out = conversion.to_internal(wire, "v1beta3")
+        assert out["spec"]["clusterIP"] == "10.0.0.1"
+        assert out["spec"]["type"] == "LoadBalancer"
+        assert out["spec"]["externalIPs"] == ["1.2.3.4"]
+        back = conversion.from_internal(out, "v1beta3")
+        assert back["spec"]["portalIP"] == "10.0.0.1"
+        assert back["spec"]["createExternalLoadBalancer"] is True
+        assert back["spec"]["publicIPs"] == ["1.2.3.4"]
+
+    def test_rc_template_host_converts(self):
+        wire = {
+            "kind": "ReplicationController",
+            "spec": {
+                "replicas": 1,
+                "template": {"spec": {"host": "n2", "containers": []}},
+            },
+        }
+        out = conversion.to_internal(wire, "v1beta3")
+        assert out["spec"]["template"]["spec"]["nodeName"] == "n2"
+
+    def test_list_items_convert(self):
+        wire = {
+            "kind": "PodList",
+            "items": [
+                {"kind": "Pod", "spec": {"nodeName": "n1"}},
+                {"kind": "Pod", "spec": {"nodeName": "n2"}},
+            ],
+        }
+        out = conversion.from_internal(wire, "v1beta3")
+        assert [i["spec"]["host"] for i in out["items"]] == ["n1", "n2"]
+
+    def test_unknown_version_rejected(self):
+        with pytest.raises(ValueError):
+            conversion.to_internal({}, "v1beta9")
+
+    def test_v1_is_identity(self):
+        wire = {"kind": "Pod", "spec": {"nodeName": "n1"}}
+        assert conversion.to_internal(wire, "v1") is wire
+        assert conversion.from_internal(wire, "v1") is wire
+
+
+class TestHTTPVersionNegotiation:
+    @pytest.fixture
+    def server(self):
+        srv = APIHTTPServer(APIServer()).start()
+        yield srv
+        srv.stop()
+
+    def _req(self, base, method, path, body=None):
+        req = urllib.request.Request(
+            base + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        return json.loads(urllib.request.urlopen(req).read())
+
+    def test_api_lists_both_versions(self, server):
+        out = self._req(server.address, "GET", "/api")
+        assert out["versions"] == ["v1", "v1beta3"]
+
+    def test_create_v1beta3_read_v1(self, server):
+        """A legacy client POSTs v1beta3 (spec.host); a modern client
+        reads the same pod as v1 (spec.nodeName)."""
+        self._req(
+            server.address,
+            "POST",
+            "/api/v1beta3/namespaces/default/pods",
+            {
+                "kind": "Pod",
+                "apiVersion": "v1beta3",
+                "metadata": {"name": "legacy"},
+                "spec": {"host": "n1", "containers": [{"name": "c", "image": "x"}]},
+            },
+        )
+        v1 = self._req(
+            server.address, "GET", "/api/v1/namespaces/default/pods/legacy"
+        )
+        assert v1["spec"]["nodeName"] == "n1"
+        assert "host" not in v1["spec"]
+
+    def test_kindless_v1beta3_body_still_converts(self, server):
+        """The API accepts kind-less bodies (kind defaults from the
+        path); conversion must still fire via the route's kind hint."""
+        self._req(
+            server.address,
+            "POST",
+            "/api/v1beta3/namespaces/default/pods",
+            {
+                "metadata": {"name": "kindless"},
+                "spec": {"host": "n9", "containers": [{"name": "c", "image": "x"}]},
+            },
+        )
+        v1 = self._req(
+            server.address, "GET", "/api/v1/namespaces/default/pods/kindless"
+        )
+        assert v1["spec"]["nodeName"] == "n9"
+        assert "host" not in v1["spec"]
+
+    def test_read_v1beta3_of_v1_object(self, server):
+        self._req(
+            server.address,
+            "POST",
+            "/api/v1/namespaces/default/services",
+            {
+                "kind": "Service",
+                "metadata": {"name": "svc"},
+                "spec": {
+                    "clusterIP": "10.0.0.3",
+                    "type": "LoadBalancer",
+                    "selector": {"a": "b"},
+                    "ports": [{"name": "p", "port": 80}],
+                },
+            },
+        )
+        beta = self._req(
+            server.address, "GET", "/api/v1beta3/namespaces/default/services/svc"
+        )
+        assert beta["spec"]["portalIP"] == "10.0.0.3"
+        assert beta["spec"]["createExternalLoadBalancer"] is True
+        assert beta["apiVersion"] == "v1beta3"
+
+    def test_v1beta3_list(self, server):
+        self._req(
+            server.address,
+            "POST",
+            "/api/v1/namespaces/default/pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "p1"},
+                "spec": {
+                    "nodeName": "nx",
+                    "containers": [{"name": "c", "image": "x"}],
+                },
+            },
+        )
+        out = self._req(
+            server.address, "GET", "/api/v1beta3/namespaces/default/pods"
+        )
+        assert out["items"][0]["spec"]["host"] == "nx"
+
+    def test_unknown_version_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._req(server.address, "GET", "/api/v2/pods")
+        assert e.value.code == 404
