@@ -69,14 +69,18 @@ def _convert_service_spec_to_v1beta3(spec: dict) -> None:
         spec["createExternalLoadBalancer"] = True
 
 
-def _walk(wire: dict, to_v1: bool) -> None:
+def _walk(wire: dict, to_v1: bool, version: str) -> None:
     """Apply kind-specific conversions in place (recursing into lists
     and pod templates)."""
     kind = wire.get("kind", "")
     if kind.endswith("List"):
         for item in wire.get("items", []):
             if isinstance(item, dict):
-                _walk(item, to_v1)
+                _walk(item, to_v1, version)
+                # Items self-describe their version; converted fields
+                # must carry the matching apiVersion.
+                if "apiVersion" in item:
+                    item["apiVersion"] = "v1" if to_v1 else version
         return
     if kind == "Pod":
         spec = wire.get("spec")
@@ -111,7 +115,7 @@ def to_internal(wire: dict, version: str) -> dict:
     if version not in VERSIONS:
         raise ValueError(f"unknown API version {version!r}")
     out = copy.deepcopy(wire)
-    _walk(out, to_v1=True)
+    _walk(out, to_v1=True, version=version)
     if out.get("apiVersion") == version:
         out["apiVersion"] = "v1"
     return out
@@ -124,7 +128,7 @@ def from_internal(wire: dict, version: str) -> dict:
     if version not in VERSIONS:
         raise ValueError(f"unknown API version {version!r}")
     out = copy.deepcopy(wire)
-    _walk(out, to_v1=False)
+    _walk(out, to_v1=False, version=version)
     if out.get("apiVersion") == "v1":
         out["apiVersion"] = version
     return out

@@ -404,13 +404,30 @@ class _Handler(BaseHTTPRequestHandler):
                 400, "BadRequest",
                 "resourceVersion/timeoutSeconds must be numeric",
             )
+        # Both transports the reference serves (pkg/apiserver/watch.go:
+        # 45-102): websocket when the client asks to upgrade, chunked
+        # newline-JSON otherwise. Frame payloads are identical.
+        websocket = (
+            self.headers.get("Upgrade", "").lower() == "websocket"
+            and self.headers.get("Sec-WebSocket-Key")
+        )
         stream = self.api.watch(
             resource, ns, since=since, label_selector=lsel, field_selector=fsel
         )
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
+        from kubernetes_tpu.utils import websocket as ws
+
+        if websocket:
+            self.send_response(101, "Switching Protocols")
+            for name, value in ws.handshake_headers(
+                self.headers["Sec-WebSocket-Key"]
+            ):
+                self.send_header(name, value)
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
         deadline = None if timeout is None else time.monotonic() + timeout
         try:
             while True:
@@ -429,15 +446,21 @@ class _Handler(BaseHTTPRequestHandler):
                 if version != "v1" and isinstance(obj, dict):
                     obj = conversion.from_internal(obj, version)
                 frame = json.dumps({"type": ev.type, "object": obj}).encode()
-                frame += b"\n"
-                self.wfile.write(b"%x\r\n" % len(frame) + frame + b"\r\n")
+                if websocket:
+                    self.wfile.write(ws.encode_frame(frame))
+                else:
+                    frame += b"\n"
+                    self.wfile.write(b"%x\r\n" % len(frame) + frame + b"\r\n")
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
         finally:
             stream.close()
             try:
-                self.wfile.write(b"0\r\n\r\n")
+                if websocket:
+                    self.wfile.write(ws.encode_frame(b"", ws.OP_CLOSE))
+                else:
+                    self.wfile.write(b"0\r\n\r\n")
             except Exception:
                 pass
             self.close_connection = True

@@ -1,0 +1,138 @@
+"""Minimal RFC 6455 websocket framing (server + client, text frames).
+
+Reference: pkg/apiserver/watch.go:45-102 serves watches over BOTH
+chunked JSON and websocket (golang.org/x/net/websocket); this is the
+stdlib-only equivalent for the same wire role. Scope is deliberately
+the watch protocol's needs: handshake, unfragmented text/close frames,
+client-side masking (clients MUST mask; servers MUST NOT).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import struct
+from typing import Optional, Tuple
+
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def handshake_headers(client_key: str) -> list:
+    return [
+        ("Upgrade", "websocket"),
+        ("Connection", "Upgrade"),
+        ("Sec-WebSocket-Accept", accept_key(client_key)),
+    ]
+
+
+def encode_frame(payload: bytes, opcode: int = OP_TEXT, mask: bool = False) -> bytes:
+    """One unfragmented frame (FIN set). Clients mask, servers don't."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head += bytes([mask_bit | n])
+    elif n < 65536:
+        head += bytes([mask_bit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
+    if mask:
+        key = os.urandom(4)
+        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        return head + key + masked
+    return head + payload
+
+
+def read_exact(stream, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            raise ConnectionError("websocket stream closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def decode_frame(stream) -> Tuple[int, bytes]:
+    """Read one frame -> (opcode, payload). Raises ConnectionError on
+    EOF."""
+    b0, b1 = read_exact(stream, 2)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        (n,) = struct.unpack(">H", read_exact(stream, 2))
+    elif n == 127:
+        (n,) = struct.unpack(">Q", read_exact(stream, 8))
+    key = read_exact(stream, 4) if masked else None
+    payload = read_exact(stream, n)
+    if key:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
+
+
+class WebSocketClient:
+    """Tiny client for tests + in-repo consumers: connect, iterate text
+    payloads."""
+
+    def __init__(self, host: str, port: int, path: str, timeout: float = 30.0):
+        import socket as socketlib
+
+        self.sock = socketlib.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET {path} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self.sock.sendall(req.encode())
+        self.rfile = self.sock.makefile("rb")
+        status = self.rfile.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"websocket handshake refused: {status!r}")
+        expect = accept_key(key)
+        got = ""
+        while True:
+            line = self.rfile.readline().strip()
+            if not line:
+                break
+            name, _, value = line.decode().partition(":")
+            if name.strip().lower() == "sec-websocket-accept":
+                got = value.strip()
+        if got != expect:
+            raise ConnectionError("websocket accept key mismatch")
+
+    def recv_text(self) -> Optional[str]:
+        """Next text payload; None on clean close."""
+        while True:
+            op, payload = decode_frame(self.rfile)
+            if op == OP_TEXT:
+                return payload.decode()
+            if op == OP_CLOSE:
+                return None
+            if op == OP_PING:
+                self.sock.sendall(encode_frame(payload, OP_PONG, mask=True))
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(encode_frame(b"", OP_CLOSE, mask=True))
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
