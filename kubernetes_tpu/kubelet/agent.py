@@ -142,6 +142,30 @@ class Kubelet:
         from kubernetes_tpu.kubelet.probes import ProbeTracker
 
         self._probes = ProbeTracker()
+        # Resource managers (container GC / disk / OOM watcher —
+        # pkg/kubelet/{container_gc,image_manager,disk_manager,
+        # oom_watcher}.go). GC and disk need an artifact root, which
+        # only real runtimes have (ProcessRuntime.root).
+        from kubernetes_tpu.kubelet.managers import (
+            ContainerGC,
+            DiskManager,
+            OOMWatcher,
+        )
+
+        self._oom = OOMWatcher(client, node_name)
+        self.disk = None
+        self.container_gc = None
+        runtime_root = getattr(self.runtime, "root", None)
+        if runtime_root:
+            self.disk = DiskManager(runtime_root)
+            self.container_gc = ContainerGC(
+                runtime_root,
+                self.runtime,
+                min_age_s=30.0,
+                disk=self.disk,
+                desired_uids=self._desired_uids,
+            )
+        self.housekeeping_period = 10.0
         self.pods = Informer(
             client,
             "pods",
@@ -162,7 +186,10 @@ class Kubelet:
         self.register_node()
         self.pods.start()
         self.pods.wait_for_sync()
-        for target in (self._heartbeat_loop, self._resync_loop):
+        targets = [self._heartbeat_loop, self._resync_loop]
+        if self.container_gc is not None:
+            targets.append(self._housekeeping_loop)
+        for target in targets:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -235,6 +262,20 @@ class Kubelet:
             except Exception:
                 pass
 
+    def _desired_uids(self) -> set:
+        return {
+            p.metadata.uid or p.metadata.name for p in self.pods.store.list()
+        }
+
+    def _housekeeping_loop(self) -> None:
+        """Container GC + disk-pressure reclaim + OOM-dedup prune."""
+        while not self._stop.wait(self.housekeeping_period):
+            try:
+                self.container_gc.gc()
+                self._oom.prune(self.runtime.list_pods())
+            except Exception:
+                pass
+
     # -- HTTP API data (reference /spec + /stats, cadvisor-backed) ----
 
     def node_spec(self) -> dict:
@@ -268,7 +309,15 @@ class Kubelet:
                     entry["rssBytes"] = _proc_rss(c.container_id[7:])
                 stats.append(entry)
             pods[uid] = stats
-        return {"nodeName": self.node_name, "pods": pods}
+        out = {"nodeName": self.node_name, "pods": pods}
+        if self.disk is not None:
+            usage = self.disk.usage()
+            out["disk"] = {
+                "capacityBytes": usage.capacity_bytes,
+                "availableBytes": usage.available_bytes,
+                "usedFraction": round(usage.used_fraction, 4),
+            }
+        return out
 
     # -- pod sync -----------------------------------------------------
 
@@ -353,6 +402,7 @@ class Kubelet:
         containers = self.runtime.sync_pod(pod)
         for c in containers:
             self._probes.note_started(f"{uid}/{c.name}", c.started_at)
+        self._oom.observe(pod, containers)
 
         # Restart policy (dockertools/manager.go:1287+), decided PER
         # CONTAINER: Always restarts any exited container; OnFailure

@@ -1,0 +1,259 @@
+"""Kubelet resource managers: container GC, disk manager, OOM watcher.
+
+Reference:
+- pkg/kubelet/container_gc.go — dead-container artifacts are reaped by
+  age/count policy so a busy node doesn't fill its disk with corpses.
+  Process-runtime analog: per-container log files and terminal pod
+  directories under the kubelet root.
+- pkg/kubelet/image_manager.go — image GC frees disk down to a low
+  threshold once usage crosses a high threshold. A process runtime has
+  no image store; the disk-pressure reclaim applies to the same root
+  (oldest dead artifacts first).
+- pkg/kubelet/disk_manager.go — disk availability checks.
+- pkg/kubelet/oom_watcher.go — records an event when the kernel kills
+  a container; here detected from SIGKILL exit codes (137 / -9), the
+  observable a process runtime has.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class DiskUsage:
+    capacity_bytes: int
+    available_bytes: int
+
+    @property
+    def used_fraction(self) -> float:
+        if self.capacity_bytes <= 0:
+            return 0.0
+        return 1.0 - self.available_bytes / self.capacity_bytes
+
+
+class DiskManager:
+    """Disk availability for the kubelet root (disk_manager.go)."""
+
+    def __init__(
+        self,
+        root_dir: str,
+        high_threshold: float = 0.90,
+        low_threshold: float = 0.80,
+        statvfs=os.statvfs,
+    ):
+        self.root = root_dir
+        self.high = high_threshold
+        self.low = low_threshold
+        self._statvfs = statvfs
+
+    def usage(self) -> DiskUsage:
+        try:
+            st = self._statvfs(self.root)
+        except OSError:
+            return DiskUsage(0, 0)
+        return DiskUsage(
+            capacity_bytes=st.f_frsize * st.f_blocks,
+            available_bytes=st.f_frsize * st.f_bavail,
+        )
+
+    def over_high_threshold(self) -> bool:
+        return self.usage().used_fraction >= self.high
+
+    def under_low_threshold(self) -> bool:
+        return self.usage().used_fraction <= self.low
+
+
+class ContainerGC:
+    """Reaps dead container artifacts under <root>/pods (container_gc.go
+    policy shape: min age, per-pod and global caps) and, under disk
+    pressure, oldest-first until the low threshold is met
+    (image_manager.go reclaim shape)."""
+
+    def __init__(
+        self,
+        root_dir: str,
+        runtime,
+        min_age_s: float = 0.0,
+        max_log_bytes: int = 10 * 1024 * 1024,
+        disk: Optional[DiskManager] = None,
+        desired_uids=None,
+    ):
+        self.root = root_dir
+        self.runtime = runtime
+        self.min_age = min_age_s
+        self.max_log_bytes = max_log_bytes
+        self.disk = disk
+        # Callable returning uids the kubelet still WANTS on this node.
+        # A desired pod may have no runtime record yet (e.g. its volume
+        # mounts keep failing, so sync returns before the runtime ever
+        # sees it) — GC must not eat its directory out from under the
+        # retry loop.
+        self.desired_uids = desired_uids or (lambda: set())
+
+    def _pod_dirs(self) -> List[str]:
+        base = os.path.join(self.root, "pods")
+        try:
+            return [
+                os.path.join(base, d)
+                for d in os.listdir(base)
+                if os.path.isdir(os.path.join(base, d))
+            ]
+        except OSError:
+            return []
+
+    def _live_uids(self) -> set:
+        # Tracked by the runtime (even exited) or still desired by the
+        # kubelet = not an orphan.
+        return set(self.runtime.list_pods()) | set(self.desired_uids())
+
+    @staticmethod
+    def _has_volumes(pod_dir: str) -> bool:
+        """Volume data lives under <pod_dir>/volumes (volumes/mount.py
+        layout). Deleting THROUGH a mounted volume without the volume
+        manager's teardown is never this GC's call."""
+        return os.path.isdir(os.path.join(pod_dir, "volumes"))
+
+    def _reap_dir(self, pod_dir: str) -> bool:
+        """Remove a dead pod's artifacts. Directories that still hold
+        volume data only lose runtime artifacts (logs + records); the
+        kubelet's orphan GC owns volume teardown."""
+        if self._has_volumes(pod_dir):
+            for fname in self._list(pod_dir):
+                if fname.endswith((".log", ".json")):
+                    try:
+                        os.unlink(os.path.join(pod_dir, fname))
+                    except OSError:
+                        pass
+            return False
+        shutil.rmtree(pod_dir, ignore_errors=True)
+        return True
+
+    def gc(self) -> Dict[str, int]:
+        """One housekeeping pass. Returns action counts."""
+        stats = {"dirs_removed": 0, "logs_truncated": 0, "pressure_removed": 0}
+        live = self._live_uids()
+        now = time.time()
+        for pod_dir in self._pod_dirs():
+            uid = os.path.basename(pod_dir)
+            if uid not in live:
+                # Dead pod's artifacts: reap after min_age (the
+                # kubelet's own orphan GC kills processes; this reaps
+                # what's left on disk).
+                try:
+                    age = now - os.path.getmtime(pod_dir)
+                except OSError:
+                    continue
+                if age >= self.min_age and self._reap_dir(pod_dir):
+                    stats["dirs_removed"] += 1
+                continue
+            # Live pod: cap log growth (reference caps dead containers
+            # per pod; a process runtime's unbounded artifact is logs).
+            for fname in self._list(pod_dir):
+                if not fname.endswith(".log"):
+                    continue
+                path = os.path.join(pod_dir, fname)
+                try:
+                    if os.path.getsize(path) > self.max_log_bytes:
+                        self._truncate_log(path)
+                        stats["logs_truncated"] += 1
+                except OSError:
+                    pass
+        if self.disk is not None and self.disk.over_high_threshold():
+            stats["pressure_removed"] = self._reclaim()
+        return stats
+
+    @staticmethod
+    def _list(path: str) -> List[str]:
+        try:
+            return os.listdir(path)
+        except OSError:
+            return []
+
+    def _truncate_log(self, path: str) -> None:
+        """Keep the newest half of an oversized log (cheap rotation)."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(-self.max_log_bytes // 2, os.SEEK_END)
+                tail = f.read()
+            with open(path, "wb") as f:
+                f.write(b"[log truncated by container GC]\n")
+                f.write(tail)
+        except OSError:
+            pass
+
+    def _reclaim(self) -> int:
+        """Disk pressure: remove oldest DEAD pod artifacts first until
+        under the low threshold (image_manager.go LRU reclaim shape)."""
+        removed = 0
+        live = self._live_uids()
+        candidates: List[Tuple[float, str]] = []
+        for pod_dir in self._pod_dirs():
+            if os.path.basename(pod_dir) in live:
+                continue
+            try:
+                candidates.append((os.path.getmtime(pod_dir), pod_dir))
+            except OSError:
+                continue
+        for _, pod_dir in sorted(candidates):
+            if self.disk.under_low_threshold():
+                break
+            if self._reap_dir(pod_dir):
+                removed += 1
+        return removed
+
+
+class OOMWatcher:
+    """Records an event when a container dies by SIGKILL — the
+    process-runtime observable for kernel OOM kills (oom_watcher.go
+    records 'SystemOOM' from kmsg via cadvisor)."""
+
+    KILL_CODES = (137, -9)
+
+    def __init__(self, client, node_name: str):
+        self.client = client
+        self.node_name = node_name
+        # (uid, container, container_id) already reported.
+        self._seen: set = set()
+
+    def observe(self, pod, containers) -> int:
+        """Inspect one pod's runtime containers; record one event per
+        killed container incarnation. Returns events recorded."""
+        recorded = 0
+        uid = pod.metadata.uid or pod.metadata.name
+        for c in containers:
+            if c.state != "exited" or c.exit_code not in self.KILL_CODES:
+                continue
+            key = (uid, c.name, c.container_id)
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            try:
+                self.client.record_event(
+                    pod,
+                    "ContainerKilled",
+                    f"container {c.name} was killed (exit code {c.exit_code})",
+                    source=f"kubelet/{self.node_name}",
+                )
+                recorded += 1
+            except Exception:
+                self._seen.discard(key)  # retry next sync
+        return recorded
+
+    def prune(self, runtime_pods: Dict) -> None:
+        """Drop dedup keys for container incarnations the runtime no
+        longer tracks — those can never be observed again, so pruning
+        them bounds memory WITHOUT re-emitting events for still-exited
+        containers (a wholesale clear would)."""
+        if len(self._seen) < 4096:
+            return
+        current = {
+            (uid, c.name, c.container_id)
+            for uid, containers in runtime_pods.items()
+            for c in containers
+        }
+        self._seen &= current

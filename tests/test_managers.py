@@ -1,0 +1,187 @@
+"""Kubelet resource managers: container GC, disk manager, OOM watcher.
+
+Reference: pkg/kubelet/{container_gc,image_manager,disk_manager,
+oom_watcher}.go (VERDICT r1 missing #6)."""
+
+import os
+import time
+from collections import namedtuple
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.kubelet.managers import ContainerGC, DiskManager, OOMWatcher
+from kubernetes_tpu.kubelet.runtime import FakeRuntime, RuntimeContainer
+from kubernetes_tpu.models.objects import ObjectMeta, Pod
+from kubernetes_tpu.server.api import APIServer
+
+FakeStat = namedtuple("FakeStat", "f_frsize f_blocks f_bavail")
+
+
+class FakeDiskRuntime:
+    """Runtime stub exposing only what ContainerGC needs."""
+
+    def __init__(self, live_uids=()):
+        self._live = set(live_uids)
+
+    def list_pods(self):
+        return {
+            uid: [RuntimeContainer(name="c", image="x", container_id="p")]
+            for uid in self._live
+        }
+
+
+def make_pod_dir(root, uid, log_bytes=0, age_s=0.0):
+    d = os.path.join(root, "pods", uid)
+    os.makedirs(d, exist_ok=True)
+    if log_bytes:
+        with open(os.path.join(d, "main.log"), "wb") as f:
+            f.write(b"x" * log_bytes)
+    if age_s:
+        past = time.time() - age_s
+        os.utime(d, (past, past))
+    return d
+
+
+class TestDiskManager:
+    def test_usage_and_thresholds(self, tmp_path):
+        full = DiskManager(
+            str(tmp_path),
+            statvfs=lambda p: FakeStat(4096, 1000, 50),  # 95% used
+        )
+        assert full.usage().used_fraction == pytest.approx(0.95)
+        assert full.over_high_threshold()
+        assert not full.under_low_threshold()
+        empty = DiskManager(
+            str(tmp_path), statvfs=lambda p: FakeStat(4096, 1000, 900)
+        )
+        assert not empty.over_high_threshold()
+        assert empty.under_low_threshold()
+
+    def test_statvfs_failure_is_safe(self):
+        def boom(p):
+            raise OSError("nope")
+
+        dm = DiskManager("/nonexistent", statvfs=boom)
+        assert dm.usage().capacity_bytes == 0
+        assert not dm.over_high_threshold()
+
+
+class TestContainerGC:
+    def test_dead_pod_dirs_reaped_after_min_age(self, tmp_path):
+        root = str(tmp_path)
+        make_pod_dir(root, "dead-old", age_s=120)
+        make_pod_dir(root, "dead-new")
+        live_dir = make_pod_dir(root, "alive", age_s=120)
+        gc = ContainerGC(root, FakeDiskRuntime({"alive"}), min_age_s=60)
+        stats = gc.gc()
+        assert stats["dirs_removed"] == 1
+        assert not os.path.exists(os.path.join(root, "pods", "dead-old"))
+        assert os.path.exists(os.path.join(root, "pods", "dead-new"))
+        assert os.path.exists(live_dir)
+
+    def test_oversized_live_logs_truncated(self, tmp_path):
+        root = str(tmp_path)
+        d = make_pod_dir(root, "alive", log_bytes=4096)
+        gc = ContainerGC(
+            root, FakeDiskRuntime({"alive"}), max_log_bytes=1024
+        )
+        stats = gc.gc()
+        assert stats["logs_truncated"] == 1
+        size = os.path.getsize(os.path.join(d, "main.log"))
+        assert size <= 1024
+        with open(os.path.join(d, "main.log"), "rb") as f:
+            assert f.read().startswith(b"[log truncated")
+
+    def test_disk_pressure_reclaims_oldest_dead_first(self, tmp_path):
+        root = str(tmp_path)
+        make_pod_dir(root, "oldest", age_s=300)
+        make_pod_dir(root, "newer", age_s=100)
+        calls = {"n": 0}
+
+        def statvfs(p):
+            # Over high threshold until one dir is removed.
+            calls["n"] += 1
+            removed = not os.path.exists(os.path.join(root, "pods", "oldest"))
+            return FakeStat(4096, 1000, 500 if removed else 20)
+
+        disk = DiskManager(root, statvfs=statvfs)
+        gc = ContainerGC(root, FakeDiskRuntime(), min_age_s=1e9, disk=disk)
+        stats = gc.gc()
+        assert stats["pressure_removed"] == 1
+        assert not os.path.exists(os.path.join(root, "pods", "oldest"))
+        assert os.path.exists(os.path.join(root, "pods", "newer"))
+
+
+class TestOOMWatcher:
+    def _pod(self, name="victim"):
+        return Pod(metadata=ObjectMeta(name=name, namespace="default", uid=name))
+
+    def _killed(self, cid="proc://1"):
+        return RuntimeContainer(
+            name="main", image="x", container_id=cid,
+            state="exited", exit_code=137,
+        )
+
+    def test_records_event_once_per_incarnation(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        watcher = OOMWatcher(client, "n1")
+        pod = self._pod()
+        assert watcher.observe(pod, [self._killed()]) == 1
+        assert watcher.observe(pod, [self._killed()]) == 0  # same incarnation
+        assert watcher.observe(pod, [self._killed(cid="proc://2")]) == 1
+        client.flush_events()
+        events, _ = client.list("events", namespace="default")
+        kills = [e for e in events if e.reason == "ContainerKilled"]
+        assert len(kills) >= 1
+        assert "killed" in kills[0].message
+
+    def test_prune_keeps_current_incarnations(self):
+        api = APIServer()
+        watcher = OOMWatcher(Client(LocalTransport(api)), "n1")
+        pod = self._pod()
+        killed = self._killed()
+        watcher.observe(pod, [killed])
+        # Force overflow, then prune against a runtime still tracking
+        # the killed incarnation: its key must SURVIVE (no dup events).
+        watcher._seen |= {("ghost", f"c{i}", f"id{i}") for i in range(5000)}
+        watcher.prune({"victim": [killed]})
+        assert ("victim", "main", killed.container_id) in watcher._seen
+        assert len(watcher._seen) == 1
+        assert watcher.observe(pod, [killed]) == 0  # still deduped
+
+    def test_gc_spares_desired_and_volume_dirs(self, tmp_path):
+        root = str(tmp_path)
+        make_pod_dir(root, "wanted", age_s=120)
+        vol_dir = make_pod_dir(root, "voly")
+        os.makedirs(os.path.join(vol_dir, "volumes", "v1"), exist_ok=True)
+        with open(os.path.join(vol_dir, "main.log"), "w") as f:
+            f.write("x")
+        past = time.time() - 120  # age AFTER content creation
+        os.utime(vol_dir, (past, past))
+        gc = ContainerGC(
+            root,
+            FakeDiskRuntime(),
+            min_age_s=60,
+            desired_uids=lambda: {"wanted"},
+        )
+        stats = gc.gc()
+        # Desired pod untouched even with no runtime record (mount
+        # retry case); volume-holding dir keeps its volumes, loses only
+        # runtime artifacts.
+        assert os.path.exists(os.path.join(root, "pods", "wanted"))
+        assert os.path.exists(os.path.join(vol_dir, "volumes", "v1"))
+        assert not os.path.exists(os.path.join(vol_dir, "main.log"))
+        assert stats["dirs_removed"] == 0
+
+    def test_normal_exits_ignored(self):
+        api = APIServer()
+        watcher = OOMWatcher(Client(LocalTransport(api)), "n1")
+        ok = RuntimeContainer(
+            name="main", image="x", container_id="p", state="exited", exit_code=0
+        )
+        running = RuntimeContainer(
+            name="side", image="x", container_id="q", state="running"
+        )
+        assert watcher.observe(self._pod(), [ok, running]) == 0
