@@ -1,0 +1,6 @@
+"""Per-daemon launchers + the hyperkube multiplexer.
+
+Reference: cmd/ (kube-apiserver, kube-scheduler, kube-controller-
+manager, kubelet, kube-proxy — each a flag struct + Run()) and
+cmd/hyperkube/main.go:34-38 (one binary that dispatches on argv[1]).
+"""

@@ -1,0 +1,283 @@
+"""Daemon entry points with flag surfaces.
+
+Reference: each cmd/*/app/server.go defines a <X>Server struct whose
+fields are flags and a Run() that assembles the daemon
+(cmd/kube-apiserver/app/server.go:82-185, cmd/kubelet/app/
+server.go:252, plugin/cmd/kube-scheduler/app/server.go:49-161,
+cmd/kube-proxy/app/server.go:91-132). Here each daemon is a
+`main(argv) -> rc` plus a `start_*(args)` assembler the composition
+layer (hyperkube / local-up-cluster) reuses in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+from typing import List, Optional
+
+from kubernetes_tpu.client import Client, HTTPTransport
+
+
+def _server_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--server", "-s", default="http://127.0.0.1:8080",
+        help="apiserver base URL",
+    )
+
+
+def _wait_forever() -> None:
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    stop.wait()
+
+
+# -- apiserver --------------------------------------------------------
+
+
+def apiserver_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-apiserver")
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument(
+        "--admission-control", default="",
+        help="comma-separated admission plugin names (default chain "
+        "when empty)",
+    )
+    p.add_argument("--basic-auth-file", default="")
+    p.add_argument("--token-auth-file", default="")
+    p.add_argument("--authorization-policy-file", default="")
+    return p
+
+
+def start_apiserver(args):
+    """Returns the running APIHTTPServer."""
+    from kubernetes_tpu.server.api import APIServer
+    from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+    api = APIServer()
+    if args.admission_control:
+        from kubernetes_tpu.server import admission as adm
+
+        api.admission = adm.new_from_plugins(
+            api, [n for n in args.admission_control.split(",") if n]
+        )
+    authenticator = authorizer = None
+    if args.basic_auth_file or args.token_auth_file:
+        from kubernetes_tpu.server import auth
+
+        parts = []
+        if args.basic_auth_file:
+            parts.append(auth.PasswordAuthenticator.from_file(args.basic_auth_file))
+        if args.token_auth_file:
+            parts.append(auth.TokenAuthenticator.from_file(args.token_auth_file))
+        authenticator = auth.UnionAuthenticator(parts)
+    if args.authorization_policy_file:
+        from kubernetes_tpu.server import auth
+
+        authorizer = auth.ABACAuthorizer.from_file(args.authorization_policy_file)
+    return APIHTTPServer(
+        api,
+        host=args.address,
+        port=args.port,
+        authenticator=authenticator,
+        authorizer=authorizer,
+    ).start()
+
+
+def apiserver_main(argv: Optional[List[str]] = None) -> int:
+    args = apiserver_parser().parse_args(argv)
+    srv = start_apiserver(args)
+    print(f"apiserver listening on {srv.address}")
+    try:
+        _wait_forever()
+    finally:
+        srv.stop()
+    return 0
+
+
+# -- scheduler --------------------------------------------------------
+
+
+def scheduler_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-scheduler")
+    _server_flag(p)
+    p.add_argument("--algorithm-provider", default="DefaultProvider")
+    p.add_argument(
+        "--policy-config-file", default="",
+        help="JSON scheduler policy (plugin/pkg/scheduler/api)",
+    )
+    p.add_argument(
+        "--batch", action="store_true",
+        help="TPU batch mode: solve pending backlogs on-device",
+    )
+    return p
+
+
+def start_scheduler(args, client=None):
+    import json
+
+    from kubernetes_tpu.scheduler.daemon import (
+        BatchScheduler,
+        Scheduler,
+        SchedulerConfig,
+    )
+
+    client = client or Client(HTTPTransport(args.server))
+    policy = None
+    if args.policy_config_file:
+        with open(args.policy_config_file) as f:
+            policy = json.load(f)
+    config = SchedulerConfig(
+        client, provider_name=args.algorithm_provider, policy=policy
+    ).start()
+    config.wait_for_sync()
+    if args.batch:
+        return BatchScheduler(config).start()
+    return Scheduler(config).start()
+
+
+def scheduler_main(argv: Optional[List[str]] = None) -> int:
+    args = scheduler_parser().parse_args(argv)
+    daemon = start_scheduler(args)
+    print(f"scheduler running against {args.server}")
+    try:
+        _wait_forever()
+    finally:
+        daemon.stop()
+    return 0
+
+
+# -- controller manager ----------------------------------------------
+
+
+def controller_manager_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-controller-manager")
+    _server_flag(p)
+    p.add_argument(
+        "--cloud-provider", default="",
+        help="cloud provider name (e.g. 'tpu', 'fake')",
+    )
+    p.add_argument("--node-grace-period", type=float, default=40.0)
+    p.add_argument("--node-eviction-timeout", type=float, default=20.0)
+    return p
+
+
+def start_controller_manager(args, client=None):
+    from kubernetes_tpu.controllers import ControllerManager
+
+    client = client or Client(HTTPTransport(args.server))
+    provider = None
+    if args.cloud_provider:
+        from kubernetes_tpu import cloudprovider
+
+        provider = cloudprovider.get_provider(args.cloud_provider)
+    return ControllerManager(
+        client,
+        cloud_provider=provider,
+        node_grace_period=args.node_grace_period,
+        node_eviction_timeout=args.node_eviction_timeout,
+    ).start()
+
+
+def controller_manager_main(argv: Optional[List[str]] = None) -> int:
+    args = controller_manager_parser().parse_args(argv)
+    mgr = start_controller_manager(args)
+    print(f"controller-manager running against {args.server}")
+    try:
+        _wait_forever()
+    finally:
+        mgr.stop()
+    return 0
+
+
+# -- kubelet ----------------------------------------------------------
+
+
+def kubelet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-kubelet")
+    _server_flag(p)
+    p.add_argument("--node-name", required=True)
+    p.add_argument("--root-dir", default="")
+    p.add_argument("--manifest-dir", default="")
+    p.add_argument("--cpu", default="4")
+    p.add_argument("--memory", default="8Gi")
+    p.add_argument("--max-pods", type=int, default=110)
+    p.add_argument(
+        "--fake-runtime", action="store_true",
+        help="in-memory runtime (integration testing); default is the "
+        "process runtime when --root-dir is set",
+    )
+    p.add_argument("--http-port", type=int, default=0)
+    return p
+
+
+def start_kubelet(args, client=None):
+    from kubernetes_tpu.kubelet.agent import Kubelet
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+    client = client or Client(HTTPTransport(args.server))
+    runtime = None
+    if args.fake_runtime or not args.root_dir:
+        runtime = FakeRuntime()
+    else:
+        from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+
+        runtime = ProcessRuntime(args.root_dir, node_name=args.node_name)
+    return Kubelet(
+        client,
+        node_name=args.node_name,
+        runtime=runtime,
+        cpu=args.cpu,
+        memory=args.memory,
+        max_pods=args.max_pods,
+        manifest_dir=args.manifest_dir or None,
+        root_dir=args.root_dir or None,
+        serve_http=True,
+        http_port=args.http_port,
+    ).start()
+
+
+def kubelet_main(argv: Optional[List[str]] = None) -> int:
+    args = kubelet_parser().parse_args(argv)
+    kubelet = start_kubelet(args)
+    port = kubelet.http.port if kubelet.http else "-"
+    print(f"kubelet {args.node_name} running (http port {port})")
+    try:
+        _wait_forever()
+    finally:
+        kubelet.stop()
+    return 0
+
+
+# -- proxy ------------------------------------------------------------
+
+
+def proxy_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-proxy")
+    _server_flag(p)
+    p.add_argument("--bind-address", default="127.0.0.1")
+    return p
+
+
+def start_proxy(args, client=None):
+    from kubernetes_tpu.proxy.config import ProxyServer
+
+    client = client or Client(HTTPTransport(args.server))
+    return ProxyServer(client, listen_ip=args.bind_address).start()
+
+
+def proxy_main(argv: Optional[List[str]] = None) -> int:
+    args = proxy_parser().parse_args(argv)
+    proxy = start_proxy(args)
+    print(f"proxy running against {args.server}")
+    try:
+        _wait_forever()
+    finally:
+        proxy.stop()
+    return 0

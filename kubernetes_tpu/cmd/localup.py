@@ -1,0 +1,133 @@
+"""local-up-cluster: a whole cluster in one process.
+
+Reference: hack/local-up-cluster.sh — start etcd + apiserver +
+controller-manager + scheduler + kubelet + proxy locally and print how
+to talk to it. Here the store is in-process, daemons share it over
+LocalTransport, and the apiserver speaks real HTTP for ktctl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+from typing import List, Optional
+
+from kubernetes_tpu.client import Client, LocalTransport
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-local-up-cluster")
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--nodes", type=int, default=2)
+    p.add_argument(
+        "--process-runtime", action="store_true",
+        help="pods become real OS processes (default: fake runtime)",
+    )
+    p.add_argument(
+        "--cloud-provider", default="",
+        help="register nodes from a cloud provider (e.g. 'tpu')",
+    )
+    p.add_argument("--batch-scheduler", action="store_true")
+    return p
+
+
+class LocalCluster:
+    """Assembled cluster; start() everything, stop() tears down."""
+
+    def __init__(self, args):
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.kubelet.agent import Kubelet
+        from kubernetes_tpu.kubelet.runtime import FakeRuntime
+        from kubernetes_tpu.scheduler.daemon import (
+            BatchScheduler,
+            Scheduler,
+            SchedulerConfig,
+        )
+        from kubernetes_tpu.server.api import APIServer
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        self.api = APIServer()
+        self.http = APIHTTPServer(self.api, host=args.address, port=args.port)
+        self.kubelets = []
+        self._tmp_roots = []
+        for i in range(args.nodes):
+            if args.process_runtime:
+                from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+
+                root = tempfile.mkdtemp(prefix=f"ktpu-node-{i}-")
+                self._tmp_roots.append(root)
+                runtime = ProcessRuntime(root, node_name=f"node-{i}")
+            else:
+                runtime = FakeRuntime()
+                root = None
+            self.kubelets.append(
+                Kubelet(
+                    self._client(),
+                    node_name=f"node-{i}",
+                    runtime=runtime,
+                    root_dir=root,
+                    serve_http=True,
+                )
+            )
+        self.scheduler_config = SchedulerConfig(self._client())
+        self.scheduler_cls = BatchScheduler if args.batch_scheduler else Scheduler
+        self.scheduler = None
+        provider = None
+        if args.cloud_provider:
+            from kubernetes_tpu import cloudprovider
+
+            provider = cloudprovider.get_provider(args.cloud_provider)
+        self.manager = ControllerManager(self._client(), cloud_provider=provider)
+
+    def _client(self) -> Client:
+        return Client(LocalTransport(self.api))
+
+    def start(self) -> "LocalCluster":
+        self.http.start()
+        for kubelet in self.kubelets:
+            kubelet.start()
+        self.scheduler_config.start()
+        self.scheduler_config.wait_for_sync()
+        self.scheduler = self.scheduler_cls(self.scheduler_config).start()
+        self.manager.start()
+        return self
+
+    def stop(self) -> None:
+        import shutil
+
+        self.manager.stop()
+        if self.scheduler is not None:
+            self.scheduler.stop()
+        for kubelet in self.kubelets:
+            kubelet.stop()
+            # Kill remaining pod processes before removing their roots.
+            for uid in list(kubelet.runtime.list_pods()):
+                try:
+                    kubelet.runtime.kill_pod(uid)
+                except Exception:
+                    pass
+        self.http.stop()
+        for root in self._tmp_roots:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cluster = LocalCluster(args).start()
+    print(f"cluster up: apiserver at {cluster.http.address}")
+    print(f"  ktctl --server {cluster.http.address} get nodes")
+    try:
+        from kubernetes_tpu.cmd.daemons import _wait_forever
+
+        _wait_forever()
+    finally:
+        cluster.stop()
+        print("cluster stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -91,6 +91,35 @@ class _Handler(BaseHTTPRequestHandler):
         info = RESOURCES.get(resource)
         return info.kind if info is not None else ""
 
+    def _serve_ui(self) -> None:
+        """Minimal live dashboard (reference: pkg/ui serves the www/
+        AngularJS app at /ui/; ours is server-rendered from the store)."""
+        from kubernetes_tpu.server.registry import unique_resources
+
+        rows = []
+        for info in unique_resources():
+            try:
+                out = self.api.list(info.name, "")
+                count = len(out.get("items", []))
+            except Exception:
+                count = 0
+            path = (
+                f"/api/v1/{info.name}"
+                if not info.namespaced
+                else f"/api/v1/namespaces/default/{info.name}"
+            )
+            rows.append(
+                f"<tr><td>{info.name}</td><td>{count}</td>"
+                f'<td><a href="{path}">json</a></td></tr>'
+            )
+        page = _UI_PAGE.format(version=__version__, rows="\n".join(rows))
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _route(self) -> Tuple[str, ...]:
         parsed = urlparse(self.path)
         self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
@@ -144,6 +173,21 @@ class _Handler(BaseHTTPRequestHandler):
                     {"kind": "APIVersions", "versions": list(conversion.VERSIONS)},
                 )
                 return
+            if parts == ("swagger.json",) or parts == ("swaggerapi",):
+                # API discovery document (reference serves swagger 1.2
+                # from api/swagger-spec/ via pkg/apiserver; ours is
+                # generated from the live resource registry). Behind
+                # the same auth chain as the API (master.go wraps the
+                # FULL mux, UI included).
+                self._check_auth(verb, parts)
+                self._send_json(200, _swagger_doc())
+                return
+            if parts == ("ui",):
+                self._check_auth(verb, parts)
+                self._serve_ui()
+                return
+            if parts and parts[0] == "ui":
+                raise APIError(404, "NotFound", f"unknown path {self.path!r}")
             if (
                 len(parts) < 2
                 or parts[0] != "api"
@@ -464,6 +508,63 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
             self.close_connection = True
+
+
+def _swagger_doc() -> dict:
+    """OpenAPI-style discovery doc generated from the resource registry
+    (reference ships a static api/swagger-spec/v1.json; generating from
+    RESOURCES means the doc can't drift from the router)."""
+    from kubernetes_tpu.server.registry import unique_resources
+
+    paths = {}
+    for info in unique_resources():
+        base = (
+            f"/api/v1/namespaces/{{namespace}}/{info.name}"
+            if info.namespaced
+            else f"/api/v1/{info.name}"
+        )
+        paths[base] = {
+            "get": {"summary": f"list {info.kind} objects"},
+            "post": {"summary": f"create a {info.kind}"},
+        }
+        paths[base + "/{name}"] = {
+            "get": {"summary": f"read a {info.kind}"},
+            "put": {"summary": f"replace a {info.kind}"},
+            "delete": {"summary": f"delete a {info.kind}"},
+        }
+        paths[f"/api/v1/watch/{info.name}"] = {
+            "get": {"summary": f"watch {info.kind} objects (chunked or websocket)"}
+        }
+    paths["/api/v1/namespaces/{namespace}/pods/{name}/log"] = {
+        "get": {"summary": "read container logs (kubelet relay)"}
+    }
+    paths["/api/v1/namespaces/{namespace}/pods/{name}/exec"] = {
+        "post": {"summary": "run a command in a container (kubelet relay)"}
+    }
+    paths["/api/v1/namespaces/{namespace}/bindings"] = {
+        "post": {"summary": "bind a pod to a node"}
+    }
+    return {
+        "openapi": "3.0.0",
+        "info": {"title": "kubernetes-tpu", "version": __version__},
+        "paths": paths,
+    }
+
+
+_UI_PAGE = """<!doctype html>
+<html><head><title>kubernetes-tpu</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #fafafa; }}
+ h1 {{ font-size: 1.3em; }} table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left; }}
+ a {{ color: #06c; text-decoration: none; }}
+</style></head>
+<body><h1>kubernetes-tpu dashboard</h1>
+<p>apiserver {version} &middot; <a href="/swagger.json">swagger</a>
+ &middot; <a href="/metrics">metrics</a> &middot; <a href="/healthz">healthz</a></p>
+<table><tr><th>resource</th><th>objects</th><th>raw</th></tr>
+{rows}
+</table></body></html>"""
 
 
 class APIHTTPServer:

@@ -115,6 +115,19 @@ _register(
 
 # Field extractors for field selectors (reference: pkg/registry/pod/strategy
 # PodToSelectableFields etc.). Values must be strings.
+def unique_resources():
+    """ResourceInfos deduped across aliases, sorted by name (the
+    registry maps each info under its name PLUS aliases)."""
+    seen = set()
+    out = []
+    for info in sorted(RESOURCES.values(), key=lambda i: i.name):
+        if info.name in seen:
+            continue
+        seen.add(info.name)
+        out.append(info)
+    return out
+
+
 def pod_fields(obj: dict) -> Dict[str, str]:
     return {
         "metadata.name": obj.get("metadata", {}).get("name", ""),

@@ -1,0 +1,132 @@
+"""Daemon launchers, hyperkube dispatch, local-up-cluster, swagger, UI.
+
+Reference: cmd/*/app/server.go flag surfaces, cmd/hyperkube/main.go,
+hack/local-up-cluster.sh, pkg/ui + api/swagger-spec."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.cmd import daemons, hyperkube
+from kubernetes_tpu.cmd.localup import LocalCluster, build_parser
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestHyperkube:
+    def test_help_lists_servers(self, capsys):
+        assert hyperkube.main([]) == 1
+        out = capsys.readouterr().out
+        for name in ("apiserver", "scheduler", "kubelet", "proxy", "ktctl"):
+            assert name in out
+
+    def test_unknown_server(self, capsys):
+        assert hyperkube.main(["no-such-daemon"]) == 1
+
+    def test_ktctl_dispatch(self, capsys):
+        # Errors cleanly (no server running on a bogus port) but proves
+        # dispatch reached ktctl.
+        rc = hyperkube.main(
+            ["ktctl", "get", "pods", "--server", "http://127.0.0.1:1"]
+        )
+        assert rc == 1
+
+
+class TestDaemonFlagParsers:
+    def test_all_parsers_have_defaults(self):
+        assert daemons.apiserver_parser().parse_args([]).port == 8080
+        assert (
+            daemons.scheduler_parser().parse_args([]).algorithm_provider
+            == "DefaultProvider"
+        )
+        assert daemons.controller_manager_parser().parse_args([]).server
+        args = daemons.kubelet_parser().parse_args(["--node-name", "n1"])
+        assert args.node_name == "n1"
+        assert daemons.proxy_parser().parse_args([]).bind_address == "127.0.0.1"
+
+
+class TestLocalUpCluster:
+    def test_full_cluster_schedules_pods_over_http(self):
+        """hack/local-up-cluster.sh analog: one call brings up the
+        whole control plane; a pod created over real HTTP gets
+        scheduled and runs."""
+        args = build_parser().parse_args(["--port", "0", "--nodes", "2"])
+        cluster = LocalCluster(args).start()
+        try:
+            client = Client(HTTPTransport(cluster.http.address))
+            client.create(
+                "pods",
+                {
+                    "kind": "Pod",
+                    "metadata": {"name": "up1", "namespace": "default"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "x",
+                                "resources": {
+                                    "limits": {"cpu": "100m", "memory": "64Mi"}
+                                },
+                            }
+                        ]
+                    },
+                },
+                namespace="default",
+            )
+
+            def running():
+                pod = client.get("pods", "up1", namespace="default")
+                return pod.status.phase == "Running" and pod.spec.node_name
+
+            assert wait_until(running)
+            nodes, _ = client.list("nodes")
+            assert len(nodes) == 2
+        finally:
+            cluster.stop()
+
+
+class TestSwaggerAndUI:
+    @pytest.fixture
+    def server(self):
+        from kubernetes_tpu.server.api import APIServer
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        srv = APIHTTPServer(APIServer()).start()
+        yield srv
+        srv.stop()
+
+    def test_swagger_covers_registry(self, server):
+        doc = json.loads(
+            urllib.request.urlopen(server.address + "/swagger.json").read()
+        )
+        assert doc["info"]["title"] == "kubernetes-tpu"
+        paths = doc["paths"]
+        assert "/api/v1/namespaces/{namespace}/pods" in paths
+        assert "/api/v1/nodes" in paths
+        assert "/api/v1/namespaces/{namespace}/pods/{name}/log" in paths
+        assert "/api/v1/watch/pods" in paths
+
+    def test_ui_renders_with_counts(self, server):
+        Client(HTTPTransport(server.address)).create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "uipod", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            },
+            namespace="default",
+        )
+        html = urllib.request.urlopen(server.address + "/ui/").read().decode()
+        assert "kubernetes-tpu dashboard" in html
+        assert "pods" in html
+        assert "swagger" in html
