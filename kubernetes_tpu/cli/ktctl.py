@@ -489,6 +489,85 @@ def cmd_exec(client: Client, args) -> int:
     return int(result.get("exitCode", 0))
 
 
+def forward_port(
+    server: str,
+    pod: str,
+    local_port: int,
+    remote_port: int,
+    namespace: str = "default",
+    ready_event=None,
+    stop_event=None,
+    headers: Optional[Dict[str, str]] = None,
+):
+    """Listen on local_port; tunnel each connection through the
+    apiserver's pod portforward subresource (websocket) to the pod.
+    Reference: pkg/kubectl/cmd/portforward.go + pkg/client/portforward.
+    Runs until stop_event is set (or forever). `headers` carry the
+    kubeconfig's auth to the handshake."""
+    import socket
+    import threading
+    import urllib.parse as _up
+
+    from kubernetes_tpu.utils import websocket as ws
+
+    parsed = _up.urlparse(server)
+    if parsed.scheme == "https":
+        raise SystemExit("error: port-forward does not support https servers")
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", local_port))
+    listener.listen(16)
+    listener.settimeout(0.2)
+    bound_port = listener.getsockname()[1]
+    if ready_event is not None:
+        ready_event.port = bound_port
+        ready_event.set()
+
+    def tunnel(conn):
+        try:
+            upstream = ws.WebSocketClient(
+                parsed.hostname,
+                parsed.port or 80,
+                f"/api/v1/namespaces/{namespace}/pods/{pod}/portforward"
+                f"?port={remote_port}",
+                headers=headers,
+            )
+        except (ConnectionError, OSError):
+            conn.close()
+            return
+        upstream.clear_timeout()
+        ws.relay_ws_tcp(upstream, conn)
+
+    try:
+        while stop_event is None or not stop_event.is_set():
+            try:
+                conn, _ = listener.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(target=tunnel, args=(conn,), daemon=True).start()
+    finally:
+        listener.close()
+
+
+def cmd_port_forward(client: Client, args) -> int:
+    local_s, _, remote_s = args.ports.partition(":")
+    if not remote_s:
+        remote_s = local_s
+    print(
+        f"Forwarding 127.0.0.1:{local_s} -> {args.name}:{remote_s} "
+        "(Ctrl-C to stop)"
+    )
+    try:
+        forward_port(
+            args.server, args.name, int(local_s), int(remote_s),
+            namespace=args.namespace,
+            headers=getattr(args, "_auth_headers", None),
+        )
+    except KeyboardInterrupt:
+        print("stopped")
+    return 0
+
+
 def cmd_api_resources(client: Client, args) -> int:
     seen = set()
     print(f"{'NAME':32}{'NAMESPACED':12}KIND")
@@ -597,6 +676,11 @@ def build_parser() -> argparse.ArgumentParser:
     ee.add_argument("cmd", nargs="+")
     ee.set_defaults(fn=cmd_exec)
 
+    pf = sub.add_parser("port-forward", parents=[common])
+    pf.add_argument("name")
+    pf.add_argument("ports", help="LOCAL:REMOTE (or one port for both)")
+    pf.set_defaults(fn=cmd_port_forward)
+
     ar = sub.add_parser("api-resources", parents=[common])
     ar.set_defaults(fn=cmd_api_resources)
     return p
@@ -623,7 +707,8 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None) -> i
             args.server = cfg.server
         if args.namespace is None:
             args.namespace = cfg.namespace or "default"
-        client = Client(HTTPTransport(args.server, headers=cfg.auth_headers()))
+        args._auth_headers = cfg.auth_headers()
+        client = Client(HTTPTransport(args.server, headers=args._auth_headers))
     if args.namespace is None:
         args.namespace = "default"
     try:

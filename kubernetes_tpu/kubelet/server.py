@@ -68,7 +68,9 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         try:
-            if url.path == "/healthz":
+            if len(parts) == 4 and parts[0] == "portForward":
+                self._port_forward(parts[1], parts[2], parts[3])
+            elif url.path == "/healthz":
                 self._send(200, "ok", "text/plain")
             elif url.path == "/pods":
                 items = [
@@ -108,6 +110,42 @@ class _KubeletHandler(BaseHTTPRequestHandler):
             except (ValueError, TypeError):
                 tail = None
         self._send(200, rt.read_logs(uid, container, tail), "text/plain")
+
+    def _port_forward(self, ns: str, name: str, port_s: str) -> None:
+        """Websocket tunnel to a container port (reference:
+        /portForward on the kubelet, pkg/kubelet/server.go:142, via
+        SPDY; here binary websocket frames <-> TCP bytes). A process
+        runtime is host-network, so the container's port listens on
+        the node's loopback."""
+        import socket
+        import threading
+
+        from kubernetes_tpu.utils import websocket as ws
+
+        pod, _uid = self._pod_and_uid(ns, name)
+        if pod is None:
+            self._send(404, {"error": f"pod {ns}/{name} not on this node"})
+            return
+        key = self.headers.get("Sec-WebSocket-Key")
+        if self.headers.get("Upgrade", "").lower() != "websocket" or not key:
+            self._send(400, {"error": "port-forward requires websocket upgrade"})
+            return
+        try:
+            port = int(port_s)
+        except ValueError:
+            self._send(400, {"error": f"invalid port {port_s!r}"})
+            return
+        try:
+            backend = socket.create_connection(("127.0.0.1", port), timeout=5)
+        except OSError as e:
+            self._send(502, {"error": f"dial container port {port}: {e}"})
+            return
+        self.send_response(101, "Switching Protocols")
+        for hname, value in ws.handshake_headers(key):
+            self.send_header(hname, value)
+        self.end_headers()
+        ws.relay_ws_tcp(ws.ServerEndpoint(self.rfile, self.wfile), backend)
+        self.close_connection = True
 
     # -- POST (run / exec) --------------------------------------------
 

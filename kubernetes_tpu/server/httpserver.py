@@ -350,6 +350,29 @@ class _Handler(BaseHTTPRequestHandler):
                 # GET /pods/{name}/log (pkg/registry/pod/etcd/etcd.go:45
                 # LogREST): resolve the pod's kubelet and relay.
                 return self._pod_log(ns, name)
+            if (
+                len(rest) == 5
+                and rest[4] == "portforward"
+                and resource == "pods"
+                and verb == "GET"
+            ):
+                # Websocket tunnel relayed through to the pod's kubelet
+                # (pkg/registry/pod/etcd/etcd.go:49 PortForwardREST +
+                # pkg/client/portforward; SPDY there, websocket here).
+                self.api.connect(resource, ns, name, "portforward")
+                self._pod_portforward(ns, name)
+                return "pods/portforward", 101
+            if (
+                len(rest) >= 5
+                and rest[4] == "proxy"
+                and resource == "pods"
+                and verb in ("GET", "POST")
+            ):
+                # Pod proxy subresource (etcd.go:47 ProxyREST): relay
+                # an HTTP request to the pod's port. Name may carry
+                # ":port" (reference's pods/name:port/proxy form).
+                self.api.connect(resource, ns, name.split(":")[0], "proxy")
+                return self._pod_proxy(verb, ns, name, rest[5:])
             if len(rest) == 5 and rest[4] in ("exec", "attach", "run") and verb == "POST":
                 # CONNECT subresources (pkg/apiserver/api_installer.go
                 # CONNECT routes). Admission (DenyExecOnPrivileged) runs
@@ -410,6 +433,98 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
         return "pods/log", 200
+
+    def _pod_portforward(self, ns: str, name: str) -> None:
+        """Relay a websocket tunnel: client <-> apiserver <-> kubelet."""
+        import urllib.parse as _up
+
+        from kubernetes_tpu.utils import websocket as ws
+
+        key = self.headers.get("Sec-WebSocket-Key")
+        if self.headers.get("Upgrade", "").lower() != "websocket" or not key:
+            raise APIError(
+                400, "BadRequest", "port-forward requires websocket upgrade"
+            )
+        port = self.query.get("port", "")
+        if not port.isdigit():
+            raise APIError(400, "BadRequest", f"invalid ?port={port!r}")
+        base, _pod = self.api.kubelet_location(ns, name)
+        parsed = _up.urlparse(base)
+        upstream = ws.WebSocketClient(
+            parsed.hostname,
+            parsed.port,
+            f"/portForward/{ns or 'default'}/{name}/{port}",
+        )
+        upstream.clear_timeout()
+        self.send_response(101, "Switching Protocols")
+        for hname, value in ws.handshake_headers(key):
+            self.send_header(hname, value)
+        self.end_headers()
+        ws.relay_ws_ws(ws.ServerEndpoint(self.rfile, self.wfile), upstream)
+        self.close_connection = True
+
+    def _pod_proxy(
+        self, verb: str, ns: str, name: str, subpath: Tuple[str, ...]
+    ) -> Tuple[str, int]:
+        """Relay one HTTP request to the pod's port (host network:
+        the pod's host IP + the named or first container port)."""
+        import urllib.error
+        import urllib.request
+
+        port = 0
+        if ":" in name:
+            name, _, port_s = name.partition(":")
+            if port_s.isdigit():
+                port = int(port_s)
+        base, pod = self.api.kubelet_location(ns, name)
+        if not port:
+            containers = pod.get("spec", {}).get("containers", [])
+            for c in containers:
+                for p in c.get("ports", []):
+                    port = p.get("containerPort", 0)
+                    break
+                if port:
+                    break
+        if not port:
+            raise APIError(
+                400, "BadRequest",
+                f"pod {name!r} declares no container port; use {name}:<port>",
+            )
+        import urllib.parse as _up
+
+        host = _up.urlparse(base).hostname or "127.0.0.1"
+        url = f"http://{host}:{port}/" + "/".join(subpath)
+        # Preserve the client's query string verbatim.
+        raw_query = _up.urlparse(self.path).query
+        if raw_query:
+            url += "?" + raw_query
+        data = None
+        headers = {}
+        if verb == "POST":
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            data = self.rfile.read(length) if length else b""
+            if self.headers.get("Content-Type"):
+                headers["Content-Type"] = self.headers["Content-Type"]
+        if self.headers.get("Accept"):
+            headers["Accept"] = self.headers["Accept"]
+        req = urllib.request.Request(url, data=data, headers=headers, method=verb)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = resp.read()
+                ctype = resp.headers.get("Content-Type", "text/plain")
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            ctype = e.headers.get("Content-Type", "text/plain")
+            code = e.code
+        except urllib.error.URLError as e:
+            raise APIError(502, "BadGateway", f"pod proxy dial failed: {e}")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return "pods/proxy", code
 
     def _collection(self, verb, resource, ns, lsel, fsel) -> Tuple[str, int]:
         api = self.api

@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 OP_TEXT = 0x1
+OP_BINARY = 0x2
 OP_CLOSE = 0x8
 OP_PING = 0x9
 OP_PONG = 0xA
@@ -84,20 +85,33 @@ def decode_frame(stream) -> Tuple[int, bytes]:
 
 class WebSocketClient:
     """Tiny client for tests + in-repo consumers: connect, iterate text
-    payloads."""
+    payloads. `headers` are extra handshake headers (auth)."""
 
-    def __init__(self, host: str, port: int, path: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        path: str,
+        timeout: float = 30.0,
+        headers: Optional[dict] = None,
+    ):
         import socket as socketlib
+        import threading
 
+        self._wlock = threading.Lock()
         self.sock = socketlib.create_connection((host, port), timeout=timeout)
         key = base64.b64encode(os.urandom(16)).decode()
+        extra = "".join(
+            f"{name}: {value}\r\n" for name, value in (headers or {}).items()
+        )
         req = (
             f"GET {path} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
             "Upgrade: websocket\r\n"
             "Connection: Upgrade\r\n"
             f"Sec-WebSocket-Key: {key}\r\n"
-            "Sec-WebSocket-Version: 13\r\n\r\n"
+            "Sec-WebSocket-Version: 13\r\n"
+            f"{extra}\r\n"
         )
         self.sock.sendall(req.encode())
         self.rfile = self.sock.makefile("rb")
@@ -119,20 +133,138 @@ class WebSocketClient:
     def recv_text(self) -> Optional[str]:
         """Next text payload; None on clean close."""
         while True:
-            op, payload = decode_frame(self.rfile)
+            op, payload = self.recv()
             if op == OP_TEXT:
                 return payload.decode()
             if op == OP_CLOSE:
                 return None
+
+    def recv(self) -> Tuple[int, bytes]:
+        """Next (opcode, payload), answering pings transparently."""
+        while True:
+            op, payload = decode_frame(self.rfile)
             if op == OP_PING:
-                self.sock.sendall(encode_frame(payload, OP_PONG, mask=True))
+                self.send(payload, OP_PONG)
+                continue
+            return op, payload
+
+    def send(self, payload: bytes, opcode: int = OP_BINARY) -> None:
+        # Lock: concurrent senders (relay pumps answer PINGs while the
+        # other direction streams data) must not interleave mid-frame.
+        with self._wlock:
+            self.sock.sendall(encode_frame(payload, opcode, mask=True))
+
+    def clear_timeout(self) -> None:
+        """Remove the connect-time socket timeout: long-lived tunnels
+        must survive idle periods."""
+        self.sock.settimeout(None)
 
     def close(self) -> None:
         try:
-            self.sock.sendall(encode_frame(b"", OP_CLOSE, mask=True))
+            self.send(b"", OP_CLOSE)
         except OSError:
             pass
         try:
             self.sock.close()
         except OSError:
             pass
+
+
+class ServerEndpoint:
+    """Server-side websocket endpoint over a handler's rfile/wfile
+    (post-handshake), with the same recv/send surface as the client —
+    so relay helpers work with either end."""
+
+    def __init__(self, rfile, wfile):
+        import threading
+
+        self.rfile = rfile
+        self.wfile = wfile
+        self._wlock = threading.Lock()
+
+    def recv(self) -> Tuple[int, bytes]:
+        while True:
+            op, payload = decode_frame(self.rfile)
+            if op == OP_PING:
+                self.send(payload, OP_PONG)
+                continue
+            return op, payload
+
+    def send(self, payload: bytes, opcode: int = OP_BINARY) -> None:
+        with self._wlock:
+            self.wfile.write(encode_frame(payload, opcode))  # servers don't mask
+            self.wfile.flush()
+
+    def close(self) -> None:
+        try:
+            self.send(b"", OP_CLOSE)
+        except OSError:
+            pass
+
+
+def relay_ws_tcp(ws_end, sock) -> None:
+    """Bidirectional pump: websocket endpoint <-> TCP socket. Blocks
+    until either side closes. Clears the socket's timeout first (idle
+    tunnels must not be torn down by a connect-time timeout)."""
+    import socket as socketlib
+    import threading
+
+    sock.settimeout(None)
+    done = threading.Event()
+
+    def tcp_to_ws():
+        try:
+            while not done.is_set():
+                data = sock.recv(65536)
+                if not data:
+                    break
+                ws_end.send(data, OP_BINARY)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=tcp_to_ws, daemon=True)
+    t.start()
+    try:
+        while not done.is_set():
+            op, payload = ws_end.recv()
+            if op == OP_CLOSE:
+                break
+            if payload:
+                sock.sendall(payload)
+    except (ConnectionError, OSError):
+        pass
+    finally:
+        done.set()
+        try:
+            sock.shutdown(socketlib.SHUT_RDWR)
+        except OSError:
+            pass
+        sock.close()
+        ws_end.close()
+
+
+def relay_ws_ws(a, b) -> None:
+    """Bidirectional pump between two websocket endpoints."""
+    import threading
+
+    done = threading.Event()
+
+    def pump(src, dst):
+        try:
+            while not done.is_set():
+                op, payload = src.recv()
+                if op == OP_CLOSE:
+                    break
+                dst.send(payload, op)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=pump, args=(b, a), daemon=True)
+    t.start()
+    pump(a, b)
+    a.close()
+    b.close()

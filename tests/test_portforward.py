@@ -1,0 +1,179 @@
+"""Port-forward tunnel + pod proxy subresource, end to end.
+
+Reference: pkg/kubelet/server.go /portForward, pkg/registry/pod/etcd/
+etcd.go:47-49 (proxy + portForward subresources), pkg/client/
+portforward + pkg/kubectl/cmd/portforward.go. The streams here are
+websocket tunnels: ktctl <-> apiserver <-> kubelet <-> container TCP."""
+
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.client.rest import Client, LocalTransport
+from kubernetes_tpu.kubelet.agent import Kubelet
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+from kubernetes_tpu.server.api import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+def wait_for(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    api = APIServer()
+    srv = APIHTTPServer(api).start()
+    client = Client(LocalTransport(api))
+    runtime = ProcessRuntime(str(tmp_path / "kubelet"), node_name="node-1")
+    kubelet = Kubelet(
+        Client(LocalTransport(api)),
+        node_name="node-1",
+        runtime=runtime,
+        heartbeat_period=0.5,
+        sync_period=0.2,
+        serve_http=True,
+    ).start()
+    yield api, srv, client, runtime
+    kubelet.stop()
+    for uid in list(runtime.list_pods()):
+        runtime.kill_pod(uid)
+    srv.stop()
+
+
+def start_web_pod(client, runtime, name, port):
+    client.create(
+        "pods",
+        {
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "nodeName": "node-1",
+                "containers": [
+                    {
+                        "name": "web",
+                        "image": "httpd",
+                        "command": [
+                            "python3", "-m", "http.server", str(port),
+                            "--bind", "127.0.0.1",
+                        ],
+                        "ports": [{"containerPort": port}],
+                    }
+                ],
+            },
+        },
+        namespace="default",
+    )
+
+    def serving():
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                return True
+        except OSError:
+            return False
+
+    assert wait_for(serving), "web pod never started serving"
+
+
+class TestPortForward:
+    def test_tunnel_through_apiserver(self, cluster):
+        from kubernetes_tpu.cli.ktctl import forward_port
+
+        api, srv, client, runtime = cluster
+        backend_port = free_port()
+        start_web_pod(client, runtime, "webpf", backend_port)
+
+        ready = threading.Event()
+        stop = threading.Event()
+        t = threading.Thread(
+            target=forward_port,
+            args=(srv.address, "webpf", 0, backend_port),
+            kwargs={"ready_event": ready, "stop_event": stop},
+            daemon=True,
+        )
+        t.start()
+        assert ready.wait(5)
+        local = ready.port
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{local}/", timeout=10
+            ).read()
+            # http.server directory listing always mentions itself.
+            assert b"Directory listing" in body or b"<html" in body.lower()
+            # Second connection through the same forwarder.
+            body2 = urllib.request.urlopen(
+                f"http://127.0.0.1:{local}/", timeout=10
+            ).read()
+            assert body2 == body
+        finally:
+            stop.set()
+            t.join(timeout=3)
+
+    def test_forward_to_dead_port_fails_cleanly(self, cluster):
+        from kubernetes_tpu.utils import websocket as ws
+
+        api, srv, client, runtime = cluster
+        backend_port = free_port()
+        start_web_pod(client, runtime, "deadpf", backend_port)
+        dead = free_port()
+        import urllib.parse as up
+
+        parsed = up.urlparse(srv.address)
+        with pytest.raises(ConnectionError):
+            ws.WebSocketClient(
+                parsed.hostname,
+                parsed.port,
+                f"/api/v1/namespaces/default/pods/deadpf/portforward"
+                f"?port={dead}",
+            )
+
+
+class TestPodProxy:
+    def test_proxy_get_through_apiserver(self, cluster):
+        api, srv, client, runtime = cluster
+        backend_port = free_port()
+        start_web_pod(client, runtime, "webproxy", backend_port)
+        body = urllib.request.urlopen(
+            f"{srv.address}/api/v1/namespaces/default/pods/webproxy/proxy/",
+            timeout=10,
+        ).read()
+        assert b"Directory listing" in body or b"<html" in body.lower()
+
+    def test_proxy_with_explicit_port(self, cluster):
+        api, srv, client, runtime = cluster
+        backend_port = free_port()
+        start_web_pod(client, runtime, "webport", backend_port)
+        body = urllib.request.urlopen(
+            f"{srv.address}/api/v1/namespaces/default/pods/"
+            f"webport:{backend_port}/proxy/",
+            timeout=10,
+        ).read()
+        assert b"Directory listing" in body or b"<html" in body.lower()
+
+    def test_proxy_404_passthrough(self, cluster):
+        api, srv, client, runtime = cluster
+        backend_port = free_port()
+        start_web_pod(client, runtime, "web404", backend_port)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{srv.address}/api/v1/namespaces/default/pods/web404/"
+                "proxy/no-such-file",
+                timeout=10,
+            )
+        assert e.value.code == 404
