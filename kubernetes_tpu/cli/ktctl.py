@@ -510,6 +510,9 @@ def forward_port(
 
     from kubernetes_tpu.utils import websocket as ws
 
+    if "//" not in server:
+        # Same scheme-less tolerance HTTPTransport has ("localhost:8001").
+        server = "http://" + server
     parsed = _up.urlparse(server)
     if parsed.scheme == "https":
         raise SystemExit("error: port-forward does not support https servers")

@@ -116,6 +116,7 @@ def scheduler_parser() -> argparse.ArgumentParser:
         "--batch", action="store_true",
         help="TPU batch mode: solve pending backlogs on-device",
     )
+    _leader_flags(p)
     return p
 
 
@@ -133,13 +134,17 @@ def start_scheduler(args, client=None):
     if args.policy_config_file:
         with open(args.policy_config_file) as f:
             policy = json.load(f)
-    config = SchedulerConfig(
-        client, provider_name=args.algorithm_provider, policy=policy
-    ).start()
-    config.wait_for_sync()
-    if args.batch:
-        return BatchScheduler(config).start()
-    return Scheduler(config).start()
+
+    def factory():
+        config = SchedulerConfig(
+            client, provider_name=args.algorithm_provider, policy=policy
+        ).start()
+        config.wait_for_sync()
+        if args.batch:
+            return BatchScheduler(config).start()
+        return Scheduler(config).start()
+
+    return _maybe_ha(args, client, "kube-scheduler", factory)
 
 
 def scheduler_main(argv: Optional[List[str]] = None) -> int:
@@ -165,7 +170,30 @@ def controller_manager_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--node-grace-period", type=float, default=40.0)
     p.add_argument("--node-eviction-timeout", type=float, default=20.0)
+    _leader_flags(p)
     return p
+
+
+def _leader_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--leader-elect", action="store_true",
+        help="run hot-standby: only the lease holder is active "
+        "(contrib/pod-master analog)",
+    )
+    p.add_argument("--leader-elect-identity", default="")
+
+
+def _maybe_ha(args, client, lock_name: str, factory):
+    """Wrap a daemon factory in leader election when asked."""
+    if not getattr(args, "leader_elect", False):
+        return factory()
+    import os
+    import socket
+
+    from kubernetes_tpu.utils.leaderelect import HAHotStandby
+
+    identity = args.leader_elect_identity or f"{socket.gethostname()}-{os.getpid()}"
+    return HAHotStandby(client, lock_name, identity, factory).start()
 
 
 def start_controller_manager(args, client=None):
@@ -177,12 +205,16 @@ def start_controller_manager(args, client=None):
         from kubernetes_tpu import cloudprovider
 
         provider = cloudprovider.get_provider(args.cloud_provider)
-    return ControllerManager(
-        client,
-        cloud_provider=provider,
-        node_grace_period=args.node_grace_period,
-        node_eviction_timeout=args.node_eviction_timeout,
-    ).start()
+
+    def factory():
+        return ControllerManager(
+            client,
+            cloud_provider=provider,
+            node_grace_period=args.node_grace_period,
+            node_eviction_timeout=args.node_eviction_timeout,
+        ).start()
+
+    return _maybe_ha(args, client, "kube-controller-manager", factory)
 
 
 def controller_manager_main(argv: Optional[List[str]] = None) -> int:

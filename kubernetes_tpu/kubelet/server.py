@@ -144,7 +144,10 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         for hname, value in ws.handshake_headers(key):
             self.send_header(hname, value)
         self.end_headers()
-        ws.relay_ws_tcp(ws.ServerEndpoint(self.rfile, self.wfile), backend)
+        ws.relay_ws_tcp(
+            ws.ServerEndpoint(self.rfile, self.wfile, raw_socket=self.connection),
+            backend,
+        )
         self.close_connection = True
 
     # -- POST (run / exec) --------------------------------------------

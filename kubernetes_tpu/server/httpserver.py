@@ -370,9 +370,16 @@ class _Handler(BaseHTTPRequestHandler):
             ):
                 # Pod proxy subresource (etcd.go:47 ProxyREST): relay
                 # an HTTP request to the pod's port. Name may carry
-                # ":port" (reference's pods/name:port/proxy form).
-                self.api.connect(resource, ns, name.split(":")[0], "proxy")
-                return self._pod_proxy(verb, ns, name, rest[5:])
+                # ":port" (reference's pods/name:port/proxy form) —
+                # parsed ONCE here so admission and the relay can't
+                # disagree on the pod name.
+                pod_name, _, port_s = name.partition(":")
+                self.api.connect(resource, ns, pod_name, "proxy")
+                return self._pod_proxy(
+                    verb, ns, pod_name,
+                    int(port_s) if port_s.isdigit() else 0,
+                    rest[5:],
+                )
             if len(rest) == 5 and rest[4] in ("exec", "attach", "run") and verb == "POST":
                 # CONNECT subresources (pkg/apiserver/api_installer.go
                 # CONNECT routes). Admission (DenyExecOnPrivileged) runs
@@ -436,8 +443,6 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _pod_portforward(self, ns: str, name: str) -> None:
         """Relay a websocket tunnel: client <-> apiserver <-> kubelet."""
-        import urllib.parse as _up
-
         from kubernetes_tpu.utils import websocket as ws
 
         key = self.headers.get("Sec-WebSocket-Key")
@@ -449,7 +454,7 @@ class _Handler(BaseHTTPRequestHandler):
         if not port.isdigit():
             raise APIError(400, "BadRequest", f"invalid ?port={port!r}")
         base, _pod = self.api.kubelet_location(ns, name)
-        parsed = _up.urlparse(base)
+        parsed = urlparse(base)
         upstream = ws.WebSocketClient(
             parsed.hostname,
             parsed.port,
@@ -460,22 +465,26 @@ class _Handler(BaseHTTPRequestHandler):
         for hname, value in ws.handshake_headers(key):
             self.send_header(hname, value)
         self.end_headers()
-        ws.relay_ws_ws(ws.ServerEndpoint(self.rfile, self.wfile), upstream)
+        ws.relay_ws_ws(
+            ws.ServerEndpoint(self.rfile, self.wfile, raw_socket=self.connection),
+            upstream,
+        )
         self.close_connection = True
 
     def _pod_proxy(
-        self, verb: str, ns: str, name: str, subpath: Tuple[str, ...]
+        self,
+        verb: str,
+        ns: str,
+        name: str,
+        port: int,
+        subpath: Tuple[str, ...],
     ) -> Tuple[str, int]:
         """Relay one HTTP request to the pod's port (host network:
-        the pod's host IP + the named or first container port)."""
+        the pod's host IP + the explicit, or first declared, container
+        port)."""
         import urllib.error
         import urllib.request
 
-        port = 0
-        if ":" in name:
-            name, _, port_s = name.partition(":")
-            if port_s.isdigit():
-                port = int(port_s)
         base, pod = self.api.kubelet_location(ns, name)
         if not port:
             containers = pod.get("spec", {}).get("containers", [])
@@ -490,12 +499,10 @@ class _Handler(BaseHTTPRequestHandler):
                 400, "BadRequest",
                 f"pod {name!r} declares no container port; use {name}:<port>",
             )
-        import urllib.parse as _up
-
-        host = _up.urlparse(base).hostname or "127.0.0.1"
+        host = urlparse(base).hostname or "127.0.0.1"
         url = f"http://{host}:{port}/" + "/".join(subpath)
         # Preserve the client's query string verbatim.
-        raw_query = _up.urlparse(self.path).query
+        raw_query = urlparse(self.path).query
         if raw_query:
             url += "?" + raw_query
         data = None

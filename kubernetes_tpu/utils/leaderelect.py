@@ -1,0 +1,237 @@
+"""Leader election over the API store + HA hot-standby wrapper.
+
+Reference: contrib/pod-master/podmaster.go — an etcd lock (atomic
+create with TTL; the holder renews, standbys take over when the lease
+expires) keeping exactly one scheduler/controller-manager active.
+Here the lock is an annotated Endpoints object in kube-system, CAS'd
+through the apiserver's resourceVersion semantics — the same recipe
+later Kubernetes standardized as the Endpoints resource lock.
+
+Clock caveat (same as the reference): holders and standbys must share
+a clock within lease_duration tolerances.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.server.api import APIError
+
+LOCK_NAMESPACE = "kube-system"
+HOLDER_KEY = "leaderelection.kubernetes-tpu.io/holder"
+RENEW_KEY = "leaderelection.kubernetes-tpu.io/renew-time"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client,
+        name: str,
+        identity: str,
+        lease_duration: float = 5.0,
+        renew_period: float = 1.0,
+        retry_period: float = 1.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.client = client
+        self.name = name
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.on_started = on_started_leading or (lambda: None)
+        self.on_stopped = on_stopped_leading or (lambda: None)
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lock record --------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        try:
+            obj = self.client.get(
+                "endpoints", self.name, namespace=LOCK_NAMESPACE
+            )
+        except APIError as e:
+            if e.code != 404:
+                raise
+            # No lock yet: atomic create (loser gets 409).
+            try:
+                self.client.create(
+                    "endpoints",
+                    {
+                        "kind": "Endpoints",
+                        "metadata": {
+                            "name": self.name,
+                            "namespace": LOCK_NAMESPACE,
+                            "annotations": {
+                                HOLDER_KEY: self.identity,
+                                RENEW_KEY: str(now),
+                            },
+                        },
+                    },
+                    namespace=LOCK_NAMESPACE,
+                )
+                return True
+            except APIError as ce:
+                if ce.code == 409:
+                    return False
+                raise
+        annotations = obj.metadata.annotations or {}
+        holder = annotations.get(HOLDER_KEY, "")
+        try:
+            renewed = float(annotations.get(RENEW_KEY, "0") or "0")
+        except ValueError:
+            renewed = 0.0
+        if holder != self.identity and now - renewed < self.lease_duration:
+            return False  # someone else holds a live lease
+        # Ours to take/renew: CAS via resourceVersion (update conflicts
+        # mean another standby won the race).
+        obj.metadata.annotations = dict(annotations)
+        obj.metadata.annotations[HOLDER_KEY] = self.identity
+        obj.metadata.annotations[RENEW_KEY] = str(now)
+        try:
+            self.client.update("endpoints", obj, namespace=LOCK_NAMESPACE)
+            return True
+        except APIError as e:
+            if e.code == 409:
+                return False
+            raise
+
+    # -- loop ---------------------------------------------------------
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self.is_leader:
+            self.is_leader = False
+            self.on_stopped()
+
+    def _run(self) -> None:
+        last_renew = 0.0
+        while not self._stop.is_set():
+            now = time.time()
+            try:
+                acquired = self._try_acquire_or_renew()
+                if acquired:
+                    last_renew = now
+            except Exception:
+                # Transient API failure: hold leadership ONLY within the
+                # lease window. A leader partitioned from the apiserver
+                # must abdicate once its lease could have expired —
+                # otherwise a standby takes over and two leaders run
+                # (split brain).
+                acquired = (
+                    self.is_leader
+                    and (now - last_renew) < self.lease_duration
+                )
+            if acquired:
+                self.is_leader = True
+                # Called on EVERY renewal, not just the transition:
+                # consumers (HAHotStandby) use it to retry failed or
+                # still-pending startups; they must be idempotent.
+                try:
+                    self.on_started()
+                except Exception:
+                    pass
+            elif self.is_leader:
+                # Lost the lease (CAS'd past, or renewals failed too long).
+                self.is_leader = False
+                try:
+                    self.on_stopped()
+                except Exception:
+                    pass
+            self._stop.wait(
+                self.renew_period if self.is_leader else self.retry_period
+            )
+
+
+class HAHotStandby:
+    """Runs a daemon only while holding leadership (podmaster.go's
+    whole job: the standby process is alive but idle until the lease
+    falls to it).
+
+    `factory` builds and STARTS the daemon, returning an object with
+    stop(); called on every leadership acquisition (daemons here are
+    not restartable in place)."""
+
+    def __init__(
+        self,
+        client,
+        lock_name: str,
+        identity: str,
+        factory: Callable[[], object],
+        **elector_kwargs,
+    ):
+        self.factory = factory
+        self.daemon: Optional[object] = None
+        self._lock = threading.Lock()
+        self._want = False
+        self._starting = False
+        self.elector = LeaderElector(
+            client,
+            lock_name,
+            identity,
+            on_started_leading=self._up,
+            on_stopped_leading=self._down,
+            **elector_kwargs,
+        )
+
+    def _up(self) -> None:
+        """Idempotent; called on every lease renewal. The build runs on
+        its OWN thread: a slow daemon startup (informer sync) on the
+        elector thread would block renewals past the lease and hand
+        leadership to a standby mid-startup. Failed builds retry on the
+        next renewal."""
+        with self._lock:
+            self._want = True
+            if self.daemon is not None or self._starting:
+                return
+            self._starting = True
+        threading.Thread(target=self._build, daemon=True).start()
+
+    def _build(self) -> None:
+        try:
+            daemon = self.factory()
+        except Exception:
+            with self._lock:
+                self._starting = False  # retried on the next renewal
+            return
+        stale = None
+        with self._lock:
+            self._starting = False
+            if self._want:
+                self.daemon = daemon
+            else:
+                stale = daemon  # leadership lost while starting
+        if stale is not None:
+            stale.stop()
+
+    def _down(self) -> None:
+        with self._lock:
+            self._want = False
+            daemon, self.daemon = self.daemon, None
+        if daemon is not None:
+            daemon.stop()
+
+    def start(self) -> "HAHotStandby":
+        self.elector.start()
+        return self
+
+    def stop(self) -> None:
+        self.elector.stop()
+        self._down()
+
+    @property
+    def active(self) -> bool:
+        return self.daemon is not None

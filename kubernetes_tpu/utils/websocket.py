@@ -164,6 +164,11 @@ class WebSocketClient:
             self.send(b"", OP_CLOSE)
         except OSError:
             pass
+        self.abort()
+
+    def abort(self) -> None:
+        """Hard-close the transport (unblocks a reader on another
+        thread)."""
         try:
             self.sock.close()
         except OSError:
@@ -173,13 +178,15 @@ class WebSocketClient:
 class ServerEndpoint:
     """Server-side websocket endpoint over a handler's rfile/wfile
     (post-handshake), with the same recv/send surface as the client —
-    so relay helpers work with either end."""
+    so relay helpers work with either end. `raw_socket` (the handler's
+    connection) enables abort()."""
 
-    def __init__(self, rfile, wfile):
+    def __init__(self, rfile, wfile, raw_socket=None):
         import threading
 
         self.rfile = rfile
         self.wfile = wfile
+        self.raw_socket = raw_socket
         self._wlock = threading.Lock()
 
     def recv(self) -> Tuple[int, bytes]:
@@ -200,6 +207,15 @@ class ServerEndpoint:
             self.send(b"", OP_CLOSE)
         except OSError:
             pass
+
+    def abort(self) -> None:
+        if self.raw_socket is not None:
+            import socket as socketlib
+
+            try:
+                self.raw_socket.shutdown(socketlib.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 def relay_ws_tcp(ws_end, sock) -> None:
@@ -223,6 +239,13 @@ def relay_ws_tcp(ws_end, sock) -> None:
             pass
         finally:
             done.set()
+            # Graceful first: the CLOSE frame propagates shutdown
+            # through relay chains WITHOUT discarding in-flight bytes
+            # (a hard abort RSTs kernel-buffered data). The delayed
+            # abort is only the backstop that unblocks OUR reader if
+            # the peer never answers the CLOSE.
+            ws_end.close()
+            threading.Timer(3.0, ws_end.abort).start()
 
     t = threading.Thread(target=tcp_to_ws, daemon=True)
     t.start()
@@ -243,6 +266,7 @@ def relay_ws_tcp(ws_end, sock) -> None:
             pass
         sock.close()
         ws_end.close()
+        ws_end.abort()  # peer already finished; safe to hard-close
 
 
 def relay_ws_ws(a, b) -> None:
@@ -262,9 +286,12 @@ def relay_ws_ws(a, b) -> None:
             pass
         finally:
             done.set()
+            for end in (src, dst):
+                end.close()  # graceful: CLOSE frames propagate
+                # Delayed hard-close backstop (see relay_ws_tcp).
+                threading.Timer(3.0, end.abort).start()
 
     t = threading.Thread(target=pump, args=(b, a), daemon=True)
     t.start()
     pump(a, b)
-    a.close()
-    b.close()
+    t.join(timeout=4)
