@@ -160,6 +160,80 @@ def _parity_figures() -> dict:
     return {k: round(v, 4) for k, v in out.items()}
 
 
+def crud_main() -> None:
+    """Master pod-CRUD throughput over real HTTP (reference:
+    test/integration/master_benchmark_test.go:38-93 — -bench-pods /
+    -bench-workers against a local master)."""
+    import threading
+
+    from kubernetes_tpu.client import Client, HTTPTransport
+    from kubernetes_tpu.server.api import APIServer
+    from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+    n_workers = int(os.environ.get("BENCH_CRUD_WORKERS", "4"))
+    n_tasks = int(os.environ.get("BENCH_CRUD_TASKS", "200"))  # per worker
+
+    srv = APIHTTPServer(APIServer()).start()
+    try:
+        def pod_wire(name):
+            return {
+                "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            }
+
+        errors = []
+        ops = 4  # create + get + update(label) + delete
+
+        def worker(wid, tasks=n_tasks):
+            client = Client(HTTPTransport(srv.address))
+            try:
+                for i in range(tasks):
+                    name = f"crud-{wid}-{i}"
+                    client.create("pods", pod_wire(name), namespace="default")
+                    pod = client.get("pods", name, namespace="default")
+                    pod.metadata.labels["touched"] = "true"
+                    client.update("pods", pod, namespace="default")
+                    client.delete("pods", name, namespace="default")
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        # Short warmup (primes connections/threads); a failure here
+        # means the server is broken — don't run the timed section.
+        worker("warm", tasks=10)
+        if errors:
+            raise errors[0]
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(w,)) for w in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        total_ops = n_workers * n_tasks * ops
+        print(
+            json.dumps(
+                {
+                    "metric": f"pod_crud_ops_per_sec_{n_workers}w",
+                    "value": round(total_ops / elapsed, 1),
+                    "unit": "ops/s",
+                    "vs_baseline": 0,  # reference publishes no number
+                }
+            )
+        )
+        print(
+            f"# crud: {n_workers} workers x {n_tasks} pods x {ops} ops "
+            f"in {elapsed:.2f}s over HTTP",
+            file=sys.stderr,
+        )
+    finally:
+        srv.stop()
+
+
 def main() -> None:
     n_pods = int(os.environ.get("BENCH_PODS", "50000"))
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
@@ -293,7 +367,10 @@ if __name__ == "__main__":
         _native.ensure_built()  # best-effort; NumPy fallback otherwise
     except Exception:
         pass
-    if os.environ.get("BENCH_MODE", "backlog") == "churn":
+    mode = os.environ.get("BENCH_MODE", "backlog")
+    if mode == "churn":
         churn_main()
+    elif mode == "crud":
+        crud_main()
     else:
         main()

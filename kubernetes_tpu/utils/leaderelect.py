@@ -135,6 +135,11 @@ class LeaderElector:
                     self.is_leader
                     and (now - last_renew) < self.lease_duration
                 )
+            if self._stop.is_set():
+                # stop() may have completed while the API call above
+                # was stalled; acting on a late `acquired` here would
+                # resurrect a daemon nothing will ever stop.
+                return
             if acquired:
                 self.is_leader = True
                 # Called on EVERY renewal, not just the transition:

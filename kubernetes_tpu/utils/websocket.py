@@ -160,11 +160,13 @@ class WebSocketClient:
         self.sock.settimeout(None)
 
     def close(self) -> None:
+        """GRACEFUL close: send a CLOSE frame only. The socket stays
+        open so in-flight inbound frames still deliver; call abort()
+        (relays do, after a grace period) to release the transport."""
         try:
             self.send(b"", OP_CLOSE)
         except OSError:
             pass
-        self.abort()
 
     def abort(self) -> None:
         """Hard-close the transport (unblocks a reader on another
@@ -218,6 +220,17 @@ class ServerEndpoint:
                 pass
 
 
+def _abort_later(end, delay: float = 3.0) -> None:
+    """Daemon timer backstop: hard-close an endpoint if the graceful
+    CLOSE didn't finish the job. Daemonized so lingering timers can't
+    hold the process open after a tunnel ends."""
+    import threading
+
+    timer = threading.Timer(delay, end.abort)
+    timer.daemon = True
+    timer.start()
+
+
 def relay_ws_tcp(ws_end, sock) -> None:
     """Bidirectional pump: websocket endpoint <-> TCP socket. Blocks
     until either side closes. Clears the socket's timeout first (idle
@@ -245,7 +258,7 @@ def relay_ws_tcp(ws_end, sock) -> None:
             # abort is only the backstop that unblocks OUR reader if
             # the peer never answers the CLOSE.
             ws_end.close()
-            threading.Timer(3.0, ws_end.abort).start()
+            _abort_later(ws_end)
 
     t = threading.Thread(target=tcp_to_ws, daemon=True)
     t.start()
@@ -288,8 +301,7 @@ def relay_ws_ws(a, b) -> None:
             done.set()
             for end in (src, dst):
                 end.close()  # graceful: CLOSE frames propagate
-                # Delayed hard-close backstop (see relay_ws_tcp).
-                threading.Timer(3.0, end.abort).start()
+                _abort_later(end)  # delayed hard-close backstop
 
     t = threading.Thread(target=pump, args=(b, a), daemon=True)
     t.start()

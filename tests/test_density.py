@@ -1,0 +1,156 @@
+"""Density + load e2e: the reference's cluster-scale pass criteria.
+
+Reference: test/e2e/density.go:108-129 (all pods Running, <=1%
+abnormal pod events, gated at 30 pods/node) and test/e2e/load.go
+(create/scale/delete many RCs and converge). Run against the full
+in-process cluster (LocalCluster — the hack/local-up-cluster analog)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.cmd.localup import LocalCluster, build_parser
+
+
+def wait_until(cond, timeout=60.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def rc_wire(name, replicas, app):
+    return {
+        "kind": "ReplicationController",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"app": app},
+            "template": {
+                "metadata": {"labels": {"app": app}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "pause",
+                            # Large enough that LeastRequested's
+                            # integer score moves as nodes fill —
+                            # sub-10m pods don't shift the score and
+                            # legitimately pile onto the tie-break
+                            # node, same as the reference scheduler.
+                            "resources": {
+                                "limits": {"cpu": "100m", "memory": "64Mi"}
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+@pytest.fixture
+def cluster():
+    args = build_parser().parse_args(["--port", "0", "--nodes", "4"])
+    c = LocalCluster(args).start()
+    yield c
+    c.stop()
+
+
+def running_count(client, selector=""):
+    pods, _ = client.list("pods", namespace="default", label_selector=selector)
+    return sum(1 for p in pods if p.status.phase == "Running")
+
+
+def abnormal_event_fraction(client, total_pods):
+    """density.go:188 pass bar: abnormal (non-routine) pod events must
+    stay under 1% of pods."""
+    events, _ = client.list("events", namespace="default")
+    abnormal = [
+        e
+        for e in events
+        if e.reason
+        in ("Failed", "FailedScheduling", "Unhealthy", "ContainerKilled")
+    ]
+    return len(abnormal) / max(1, total_pods)
+
+
+class TestDensity:
+    def test_density_30_pods_per_node(self, cluster):
+        """4 nodes x 30 pods/node = 120 pods, all Running, <=1%
+        abnormal events (density.go pass criteria at the gate level)."""
+        client = Client(LocalTransport(cluster.api))
+        total = 4 * 30
+        client.create("replicationcontrollers", rc_wire("dense", total, "dense"))
+        assert wait_until(
+            lambda: running_count(client, "app=dense") == total, timeout=90
+        ), f"only {running_count(client, 'app=dense')}/{total} Running"
+        # Spread respected node capacity: no node above its max-pods.
+        pods, _ = client.list(
+            "pods", namespace="default", label_selector="app=dense"
+        )
+        per_node = {}
+        for p in pods:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 110 for v in per_node.values())
+        assert len(per_node) == 4  # every node carries load
+        client.flush_events()
+        assert abnormal_event_fraction(client, total) <= 0.01
+
+    def test_density_over_http(self, cluster):
+        """Same criteria with the pods created over the real HTTP
+        apiserver (the driver surface users touch)."""
+        client = Client(HTTPTransport(cluster.http.address))
+        client.create("replicationcontrollers", rc_wire("htt", 40, "htt"))
+        assert wait_until(
+            lambda: running_count(client, "app=htt") == 40, timeout=60
+        )
+
+
+class TestLoad:
+    def test_rc_churn_converges(self, cluster):
+        """load.go shape: several RCs created, scaled up, scaled down,
+        deleted — the system converges to exactly the desired state."""
+        client = Client(LocalTransport(cluster.api))
+        for i in range(5):
+            client.create(
+                "replicationcontrollers", rc_wire(f"load-{i}", 4, f"load-{i}")
+            )
+        assert wait_until(
+            lambda: all(
+                running_count(client, f"app=load-{i}") == 4 for i in range(5)
+            ),
+            timeout=60,
+        )
+        # Scale up evens, scale down odds.
+        for i in range(5):
+            rc = client.get(
+                "replicationcontrollers", f"load-{i}", namespace="default"
+            )
+            rc.spec.replicas = 8 if i % 2 == 0 else 1
+            client.update("replicationcontrollers", rc, namespace="default")
+        assert wait_until(
+            lambda: all(
+                running_count(client, f"app=load-{i}")
+                == (8 if i % 2 == 0 else 1)
+                for i in range(5)
+            ),
+            timeout=60,
+        )
+        # Delete everything; pods drain.
+        from kubernetes_tpu.cli.updater import Reaper
+
+        for i in range(5):
+            Reaper(client, timeout=30).stop(
+                "replicationcontrollers", f"load-{i}", namespace="default"
+            )
+        assert wait_until(
+            lambda: sum(
+                running_count(client, f"app=load-{i}") for i in range(5)
+            )
+            == 0,
+            timeout=30,
+        )
