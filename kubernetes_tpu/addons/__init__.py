@@ -1,0 +1,5 @@
+"""Cluster addons (reference: cluster/addons/ — DNS, monitoring, ...)."""
+
+from kubernetes_tpu.addons.dns import ClusterDNS
+
+__all__ = ["ClusterDNS"]

@@ -66,6 +66,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(
+        self, code: int, body, content_type: str = "text/plain"
+    ) -> None:
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _read_body(self, kind_hint: str = "") -> dict:
         """Parse (and version-convert) the request body. `kind_hint` is
         the kind implied by the route: the API accepts kind-less bodies
@@ -113,12 +123,27 @@ class _Handler(BaseHTTPRequestHandler):
                 f'<td><a href="{path}">json</a></td></tr>'
             )
         page = _UI_PAGE.format(version=__version__, rows="\n".join(rows))
-        body = page.encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/html; charset=utf-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._send_text(200, page, "text/html; charset=utf-8")
+
+    def _serve_debug(self, rest: Tuple[str, ...]) -> None:
+        from kubernetes_tpu.utils import debug
+
+        if rest == ("requests",):
+            body = debug.DEFAULT_REQUEST_LOG.render()
+        elif rest == ("stacks",):
+            body = debug.dump_stacks()
+        elif rest == ("profile",):
+            try:
+                seconds = float(self.query.get("seconds", "2"))
+            except ValueError:
+                raise APIError(400, "BadRequest", "seconds must be numeric")
+            body = debug.sample_profile(seconds=seconds)
+        else:
+            raise APIError(
+                404, "NotFound",
+                "debug endpoints: /debug/requests /debug/stacks /debug/profile",
+            )
+        self._send_text(200, body, "text/plain; charset=utf-8")
 
     def _route(self) -> Tuple[str, ...]:
         parsed = urlparse(self.path)
@@ -149,20 +174,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             parts = self._route()
             if parts == ("healthz",):
-                body = b"ok"
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_text(200, b"ok")
                 return
             if parts == ("metrics",):
-                body = metrics.DEFAULT.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_text(
+                    200, metrics.DEFAULT.render(), "text/plain; version=0.0.4"
+                )
                 return
             if parts == ("version",):
                 self._send_json(200, {"gitVersion": __version__, "platform": "tpu"})
@@ -172,6 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     {"kind": "APIVersions", "versions": list(conversion.VERSIONS)},
                 )
+                return
+            if parts and parts[0] == "debug":
+                # Debug surfaces (pkg/httplog + net/http/pprof analogs),
+                # behind the same auth chain as the API.
+                self._check_auth(verb, parts)
+                self._serve_debug(parts[1:])
                 return
             if parts == ("swagger.json",) or parts == ("swaggerapi",):
                 # API discovery document (reference serves swagger 1.2
@@ -222,8 +245,12 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
         finally:
+            duration = time.monotonic() - start
             _REQS.inc(verb=verb, resource=resource, code=str(code))
-            _LATENCY.observe(time.monotonic() - start, verb=verb, resource=resource)
+            _LATENCY.observe(duration, verb=verb, resource=resource)
+            from kubernetes_tpu.utils import debug
+
+            debug.DEFAULT_REQUEST_LOG.record(verb, self.path, code, duration)
 
     def _check_auth(self, verb: str, rest: Tuple[str, ...]) -> None:
         """Authenticate + authorize an /api request. Reference:
@@ -433,12 +460,7 @@ class _Handler(BaseHTTPRequestHandler):
             container=self.query.get("container", ""),
             tail=tail,
         )
-        body = text.encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/plain")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._send_text(200, text)
         return "pods/log", 200
 
     def _pod_portforward(self, ns: str, name: str) -> None:

@@ -1,0 +1,100 @@
+"""Debug/observability surfaces: request log, stack dump, profiler.
+
+Reference analogs:
+- pkg/httplog/ (request logging with verbosity) -> an in-memory ring of
+  recent requests served at /debug/requests.
+- net/http/pprof goroutine dump -> /debug/stacks renders every Python
+  thread's current stack (the goroutine-dump equivalent for a threaded
+  runtime).
+- pprof CPU profile -> /debug/profile?seconds=N runs an in-process
+  wall-clock sampling profiler over sys._current_frames() (py-spy
+  style) and renders the hottest stacks.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+from typing import Deque, Dict, Tuple
+
+
+class RequestLog:
+    """Fixed-size ring of recent HTTP requests (httplog analog)."""
+
+    def __init__(self, size: int = 256):
+        self._ring: Deque[Tuple[float, str, str, int, float]] = (
+            collections.deque(maxlen=size)
+        )
+        self._lock = threading.Lock()
+
+    def record(
+        self, verb: str, path: str, code: int, duration_s: float
+    ) -> None:
+        with self._lock:
+            self._ring.append((time.time(), verb, path, code, duration_s))
+
+    def render(self) -> str:
+        with self._lock:
+            entries = list(self._ring)
+        lines = [f"{'TIME':23} {'CODE':5} {'MS':>8}  VERB PATH"]
+        for ts, verb, path, code, dur in reversed(entries):
+            stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+            lines.append(
+                f"{stamp:23} {code:<5} {dur * 1000:8.1f}  {verb} {path}"
+            )
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT_REQUEST_LOG = RequestLog()
+
+
+def dump_stacks() -> str:
+    """Every thread's current stack (goroutine-dump analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} (id {tid}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def sample_profile(seconds: float = 2.0, interval: float = 0.01) -> str:
+    """Wall-clock sampling profiler: periodically snapshot every
+    thread's stack and report the hottest ones. No instrumentation, no
+    tracing overhead on the profiled code — the same trade py-spy and
+    pprof's CPU profile make."""
+    if seconds != seconds:  # NaN slips through min/max clamps
+        seconds = 2.0
+    seconds = min(max(seconds, 0.1), 30.0)
+    me = threading.get_ident()
+    counts: Dict[Tuple[str, ...], int] = collections.defaultdict(int)
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # don't profile the profiler
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 24:
+                code = f.f_code
+                stack.append(f"{code.co_filename}:{f.f_lineno} {code.co_name}")
+                f = f.f_back
+            counts[tuple(reversed(stack))] += 1
+        samples += 1
+        time.sleep(interval)
+    top = sorted(counts.items(), key=lambda kv: -kv[1])[:20]
+    lines = [
+        f"sampling profile: {samples} samples over {seconds:.1f}s "
+        f"({len(counts)} distinct stacks)",
+        "",
+    ]
+    for stack, n in top:
+        lines.append(f"=== {n} samples ({100.0 * n / max(samples, 1):.1f}%) ===")
+        lines.extend(f"  {frame}" for frame in stack[-12:])
+        lines.append("")
+    return "\n".join(lines) + "\n"
