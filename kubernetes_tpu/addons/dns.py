@@ -98,8 +98,10 @@ class ClusterDNS:
         domain: str = DEFAULT_DOMAIN,
         bind: str = "127.0.0.1",
         port: int = 0,
+        resync_period: float = 5.0,
     ):
         self.domain = domain.strip(".")
+        self.resync_period = resync_period
         self._table: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -152,12 +154,12 @@ class ClusterDNS:
     def start(self) -> "ClusterDNS":
         self.services.start()
         self.services.wait_for_sync()
-        # Prime directly from the synced store: the reflector signals
-        # sync BEFORE its ADDED callbacks drain, so relying on the
-        # callbacks alone can briefly answer NXDOMAIN for pre-existing
-        # services.
-        for svc in self.services.store.list():
-            self._upsert(svc)
+        # Prime by full rebuild from the synced store: the reflector
+        # signals sync BEFORE its ADDED callbacks drain, so relying on
+        # the callbacks alone can briefly answer NXDOMAIN for
+        # pre-existing services. (Event callbacks then keep the table
+        # hot; the serve loop's periodic rebuild heals re-list gaps.)
+        self._rebuild()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
         return self
@@ -169,8 +171,28 @@ class ClusterDNS:
             self._thread.join(timeout=2)
         self.sock.close()
 
+    def _rebuild(self) -> None:
+        """Reconcile the table against the informer store. Event
+        callbacks alone are not enough: a watch drop + re-list REPLACES
+        the store without firing DELETED for objects that vanished in
+        the gap, and the start()-time prime races concurrent deletes —
+        either would leave a deleted service resolving forever."""
+        fresh: Dict[str, str] = {}
+        for svc in self.services.store.list():
+            ip = svc.spec.cluster_ip
+            if ip and ip != "None":
+                fresh[self._key(svc)] = ip
+        with self._lock:
+            self._table = fresh
+
     def _serve(self) -> None:
+        import time
+
+        last_sync = time.monotonic()
         while not self._stop.is_set():
+            if time.monotonic() - last_sync > self.resync_period:
+                self._rebuild()
+                last_sync = time.monotonic()
             try:
                 data, addr = self.sock.recvfrom(512)
             except socket.timeout:

@@ -197,8 +197,15 @@ def print_objs(resource: str, objs: List[Any], fmt: str, out=None) -> None:
 
 
 def load_manifests(filename: str) -> List[Dict]:
+    """Files, stdin ('-'), or URLs — the reference resource builder's
+    input surface (pkg/kubectl/resource/builder.go:77-126)."""
     if filename == "-":
         text = sys.stdin.read()
+    elif filename.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(filename, timeout=30) as resp:
+            text = resp.read().decode()
     else:
         with open(filename) as f:
             text = f.read()

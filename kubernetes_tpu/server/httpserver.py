@@ -432,6 +432,16 @@ class _Handler(BaseHTTPRequestHandler):
         info = RESOURCES.get(resource)
         if info is None:
             raise APIError(404, "NotFound", f"unknown resource {resource!r}")
+        if (
+            len(rest) >= 3
+            and resource == "nodes"
+            and rest[2] == "proxy"
+            and verb == "GET"
+        ):
+            # Node proxy subresource: relay to the node's kubelet API
+            # (reference: pkg/master/master.go:497-520 dials node:10250
+            # for logs/stats/spec through the apiserver).
+            return self._node_proxy(rest[1], rest[3:])
         if len(rest) == 1:
             return self._collection(verb, resource, "", lsel, fsel)
         if len(rest) == 2:
@@ -493,37 +503,13 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self.close_connection = True
 
-    def _pod_proxy(
-        self,
-        verb: str,
-        ns: str,
-        name: str,
-        port: int,
-        subpath: Tuple[str, ...],
-    ) -> Tuple[str, int]:
-        """Relay one HTTP request to the pod's port (host network:
-        the pod's host IP + the explicit, or first declared, container
-        port)."""
+    def _relay_http(self, url: str, verb: str, what: str) -> int:
+        """Relay one HTTP request (with query string, body, and salient
+        headers) to `url`, passing the upstream's status/body through.
+        Shared by the pod and node proxy subresources."""
         import urllib.error
         import urllib.request
 
-        base, pod = self.api.kubelet_location(ns, name)
-        if not port:
-            containers = pod.get("spec", {}).get("containers", [])
-            for c in containers:
-                for p in c.get("ports", []):
-                    port = p.get("containerPort", 0)
-                    break
-                if port:
-                    break
-        if not port:
-            raise APIError(
-                400, "BadRequest",
-                f"pod {name!r} declares no container port; use {name}:<port>",
-            )
-        host = urlparse(base).hostname or "127.0.0.1"
-        url = f"http://{host}:{port}/" + "/".join(subpath)
-        # Preserve the client's query string verbatim.
         raw_query = urlparse(self.path).query
         if raw_query:
             url += "?" + raw_query
@@ -547,13 +533,67 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = e.headers.get("Content-Type", "text/plain")
             code = e.code
         except urllib.error.URLError as e:
-            raise APIError(502, "BadGateway", f"pod proxy dial failed: {e}")
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+            raise APIError(502, "BadGateway", f"{what} dial failed: {e}")
+        self._send_text(code, body, ctype)
+        return code
+
+    def _pod_proxy(
+        self,
+        verb: str,
+        ns: str,
+        name: str,
+        port: int,
+        subpath: Tuple[str, ...],
+    ) -> Tuple[str, int]:
+        """Relay one HTTP request to the pod's port (host network:
+        the pod's host IP + the explicit, or first declared, container
+        port)."""
+        base, pod = self.api.kubelet_location(ns, name)
+        if not port:
+            containers = pod.get("spec", {}).get("containers", [])
+            for c in containers:
+                for p in c.get("ports", []):
+                    port = p.get("containerPort", 0)
+                    break
+                if port:
+                    break
+        if not port:
+            raise APIError(
+                400, "BadRequest",
+                f"pod {name!r} declares no container port; use {name}:<port>",
+            )
+        host = urlparse(base).hostname or "127.0.0.1"
+        url = f"http://{host}:{port}/" + "/".join(subpath)
+        code = self._relay_http(url, verb, "pod proxy")
         return "pods/proxy", code
+
+    def _node_proxy(
+        self, node_name: str, subpath: Tuple[str, ...]
+    ) -> Tuple[str, int]:
+        """GET /nodes/{name}/proxy/{path} -> the node's kubelet API."""
+        node = self.api.get("nodes", "", node_name)
+        status = node.get("status", {})
+        port = (
+            status.get("daemonEndpoints", {})
+            .get("kubeletEndpoint", {})
+            .get("port", 0)
+        )
+        if not port:
+            raise APIError(
+                501, "NotImplemented",
+                f"node {node_name!r} does not publish a kubelet API endpoint",
+            )
+        ip = next(
+            (
+                a.get("address")
+                for a in status.get("addresses", [])
+                if a.get("type") == "InternalIP"
+            ),
+            "127.0.0.1",
+        )
+        url = f"http://{ip}:{port}/" + "/".join(subpath)
+        code = self._relay_http(url, "GET", "kubelet proxy")
+        return "nodes/proxy", code
 
     def _collection(self, verb, resource, ns, lsel, fsel) -> Tuple[str, int]:
         api = self.api

@@ -144,6 +144,26 @@ class TestPortForward:
             )
 
 
+class TestNodeProxy:
+    def test_node_proxy_reaches_kubelet_api(self, cluster):
+        """GET /nodes/{n}/proxy/stats relays to the node's kubelet
+        (reference: apiserver dials node:10250, master.go:497-520)."""
+        import json as _json
+
+        api, srv, client, runtime = cluster
+        backend_port = free_port()
+        start_web_pod(client, runtime, "statpod", backend_port)
+        body = urllib.request.urlopen(
+            f"{srv.address}/api/v1/nodes/node-1/proxy/stats", timeout=10
+        ).read()
+        stats = _json.loads(body)
+        assert stats["nodeName"] == "node-1"
+        healthz = urllib.request.urlopen(
+            f"{srv.address}/api/v1/nodes/node-1/proxy/healthz", timeout=10
+        ).read()
+        assert healthz == b"ok"
+
+
 class TestPodProxy:
     def test_proxy_get_through_apiserver(self, cluster):
         api, srv, client, runtime = cluster
