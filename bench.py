@@ -79,15 +79,23 @@ def churn_main() -> None:
         )
 
     session = SolverSession(nodes)
-    # Warm-up tick compiles the solve + scatter executables.
+    # Warm-up must compile EVERY executable the timed ticks hit: the
+    # solve itself AND the delete-path row scatter at the same dirty-
+    # row bucket width the ticks produce (a cold scatter compile was
+    # costing ~2.4s on the first timed tick).
     counter = 0
     live = []  # O(1) deletes via swap-with-last (don't time bookkeeping)
-    for _ in range(rate):
-        counter += 1
-        session.add_pending(mkpod(counter))
-    for key, dest in session.solve():
-        if dest is not None:
-            live.append(key)
+    for warm_tick in range(2):
+        for _ in range(rate):
+            counter += 1
+            session.add_pending(mkpod(counter))
+        for _ in range(min(rate, len(live))):
+            i = rng.randrange(len(live))
+            live[i], live[-1] = live[-1], live[i]
+            session.delete_assigned(live.pop())
+        for key, dest in session.solve():
+            if dest is not None:
+                live.append(key)
 
     t0 = time.perf_counter()
     scheduled = 0
