@@ -116,6 +116,11 @@ def scheduler_parser() -> argparse.ArgumentParser:
         "--batch", action="store_true",
         help="TPU batch mode: solve pending backlogs on-device",
     )
+    p.add_argument(
+        "--batch-mode", default="scan", choices=["scan", "wave"],
+        help="scan = sequential-parity solver; wave = wave-commit "
+        "solver (~3x throughput, approximate decision-order parity)",
+    )
     _leader_flags(p)
     return p
 
@@ -140,8 +145,10 @@ def start_scheduler(args, client=None):
             client, provider_name=args.algorithm_provider, policy=policy
         ).start()
         config.wait_for_sync()
-        if args.batch:
-            return BatchScheduler(config).start()
+        # --batch-mode implies --batch: silently dropping an explicit
+        # wave request onto the scalar per-pod path would be a footgun.
+        if args.batch or args.batch_mode != "scan":
+            return BatchScheduler(config, mode=args.batch_mode).start()
         return Scheduler(config).start()
 
     return _maybe_ha(args, client, "kube-scheduler", factory)

@@ -44,6 +44,17 @@ from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, _feasible, _scores
 
 UNDECIDED = -2  # assignment sentinel: not yet finalized
 
+
+def wave_assignments(dsnap, **kw):
+    """Run the wave solver and strip padding (the one authority for the
+    padding/sentinel convention, mirroring solver.solve_assignments):
+    returns (i32[n_pods] with -1 = unschedulable, wave count)."""
+    import numpy as np
+
+    out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
+    a = np.asarray(out)[: dsnap.n_pods]
+    return np.where(a >= dsnap.n_nodes, -1, a), int(waves)
+
 FMAX = jnp.float32(3.4e38)
 
 

@@ -120,6 +120,42 @@ def test_batch_unschedulable_and_mixed():
     cfg.stop()
 
 
+def test_wave_mode_schedules_backlog():
+    """The wave-commit mode places a whole backlog with valid bindings
+    through the same daemon plumbing (bulk bindings, events)."""
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    for j in range(4):
+        client.create("nodes", node_wire(f"n{j}"))
+    for i in range(24):
+        client.create("pods", pod_wire(f"w{i}"))
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    assert cfg.wait_for_sync()
+    sched = BatchScheduler(cfg, mode="wave")
+    try:
+        processed = 0
+        deadline = time.monotonic() + 60
+        while processed < 24 and time.monotonic() < deadline:
+            processed += sched.schedule_batch(timeout=0.5)
+        pods, _ = client.list("pods", namespace="default")
+        assert len(pods) == 24
+        assert all(p.spec.node_name for p in pods)
+        # Valid bindings: every target exists.
+        names = {f"n{j}" for j in range(4)}
+        assert all(p.spec.node_name in names for p in pods)
+    finally:
+        cfg.stop()
+
+
+def test_batch_mode_validation():
+    api = APIServer()
+    cfg = SchedulerConfig(Client(LocalTransport(api)))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        BatchScheduler(cfg, mode="warp")
+
+
 def test_batch_respects_assumed_capacity_across_batches():
     """Two sequential batches: the second must see the first's
     assumed placements before the watch confirms them."""

@@ -14,14 +14,8 @@ from jax.sharding import Mesh
 from kubernetes_tpu.models.columnar import build_snapshot
 from kubernetes_tpu.ops import device_snapshot
 from kubernetes_tpu.ops.solver import solve_assignments
-from kubernetes_tpu.ops.wave import solve_waves
+from kubernetes_tpu.ops.wave import solve_waves, wave_assignments
 from test_solver_parity import mk_node, mk_pod, random_cluster
-
-
-def wave_assignments(dsnap, **kw):
-    out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
-    a = np.asarray(out)[: dsnap.n_pods]
-    return np.where(a >= dsnap.n_nodes, -1, a), int(waves)
 
 
 def check_validity(snap, assignment):
