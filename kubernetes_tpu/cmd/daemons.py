@@ -121,6 +121,13 @@ def scheduler_parser() -> argparse.ArgumentParser:
         help="scan = sequential-parity solver; wave = wave-commit "
         "solver (~3x throughput, approximate decision-order parity)",
     )
+    p.add_argument(
+        "--solver-sidecar", default="",
+        help="unix socket of a solver sidecar process "
+        "(python -m kubernetes_tpu.ops.sidecar <socket>); the control "
+        "plane then never touches the accelerator, and sidecar failure "
+        "falls back to the scalar path",
+    )
     _leader_flags(p)
     return p
 
@@ -145,10 +152,14 @@ def start_scheduler(args, client=None):
             client, provider_name=args.algorithm_provider, policy=policy
         ).start()
         config.wait_for_sync()
-        # --batch-mode implies --batch: silently dropping an explicit
-        # wave request onto the scalar per-pod path would be a footgun.
-        if args.batch or args.batch_mode != "scan":
-            return BatchScheduler(config, mode=args.batch_mode).start()
+        # --batch-mode/--solver-sidecar imply --batch: silently dropping
+        # an explicit request onto the scalar per-pod path is a footgun.
+        if args.batch or args.batch_mode != "scan" or args.solver_sidecar:
+            return BatchScheduler(
+                config,
+                mode=args.batch_mode,
+                sidecar_path=args.solver_sidecar or None,
+            ).start()
         return Scheduler(config).start()
 
     return _maybe_ha(args, client, "kube-scheduler", factory)

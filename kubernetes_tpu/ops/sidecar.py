@@ -1,0 +1,269 @@
+"""Solver sidecar: the device solver as an isolated process.
+
+The north-star architecture (SURVEY §2.15/§5) keeps the control plane
+and the accelerator in SEPARATE processes: the reference-shaped control
+plane never touches JAX, the sidecar owns the TPU, and a sidecar crash
+degrades to the stock scalar path instead of taking the scheduler down.
+This module is that boundary: a length-prefixed pickle protocol over a
+unix socket (numpy arrays serialize near-zero-copy with protocol 5),
+a client that lowers API objects to the columnar snapshot host-side and
+ships only arrays, and a `python -m kubernetes_tpu.ops.sidecar` server
+entry point.
+
+Failure contract: any transport/sidecar error raises SidecarError; the
+BatchScheduler's existing fallback seam (scheduler/daemon.py
+schedule_batch) then runs the scalar oracle — the degradation story the
+reference's stock-FitPredicate fallback implies, now process-real.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+from typing import List, Optional, Sequence
+
+from kubernetes_tpu.models.columnar import Snapshot, build_snapshot
+
+
+class SidecarError(Exception):
+    pass
+
+
+# -- framing ----------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=5)
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    head = _recv_exact(sock, 8)
+    (n,) = struct.unpack(">Q", head)
+    if n > 1 << 31:
+        raise SidecarError(f"oversized frame ({n} bytes)")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise SidecarError("sidecar connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _snapshot_payload(snap: Snapshot) -> dict:
+    p, n = snap.pods, snap.nodes
+    return {
+        "pods": {
+            "cpu_milli": p.cpu_milli,
+            "mem_mib": p.mem_mib,
+            "zero_req": p.zero_req,
+            "selector_id": p.selector_id,
+            "port_bits": p.port_bits,
+            "vol_any_bits": p.vol_any_bits,
+            "vol_rw_bits": p.vol_rw_bits,
+            "pinned_node": p.pinned_node,
+            "service_id": p.service_id,
+            "svc_topk": p.svc_topk,
+            "sel_bits": p.sel_bits,
+        },
+        "nodes": {
+            "cpu_cap": n.cpu_cap,
+            "mem_cap": n.mem_cap,
+            "pods_cap": n.pods_cap,
+            "cpu_fit_used": n.cpu_fit_used,
+            "mem_fit_used": n.mem_fit_used,
+            "overcommitted": n.overcommitted,
+            "cpu_used": n.cpu_used,
+            "mem_used": n.mem_used,
+            "pods_used": n.pods_used,
+            "label_bits": n.label_bits,
+            "used_port_bits": n.used_port_bits,
+            "used_vol_any_bits": n.used_vol_any_bits,
+            "used_vol_rw_bits": n.used_vol_rw_bits,
+            "service_counts": n.service_counts,
+            "schedulable": n.schedulable,
+        },
+    }
+
+
+def _snapshot_from_payload(payload: dict) -> Snapshot:
+    from kubernetes_tpu.models.columnar import (
+        NodeColumns,
+        PodColumns,
+        Vocab,
+    )
+
+    p = payload["pods"]
+    n = payload["nodes"]
+    P = len(p["cpu_milli"])
+    N = len(n["cpu_cap"])
+    pods = PodColumns(names=[str(i) for i in range(P)], **p)
+    nodes = NodeColumns(names=[str(j) for j in range(N)], **n)
+    return Snapshot(
+        pods=pods,
+        nodes=nodes,
+        label_vocab=Vocab(),
+        port_vocab=Vocab(),
+        vol_vocab=Vocab(),
+        service_names=[],
+    )
+
+
+# -- client -----------------------------------------------------------
+
+
+class SidecarSolver:
+    """Client half: lowers API objects host-side, ships arrays to the
+    sidecar, returns node names. Raises SidecarError on ANY failure so
+    the caller's fallback seam engages.
+
+    Trust model: the frames are pickle, so the socket is a PRIVILEGE
+    BOUNDARY — only a same-user sidecar may serve it. The server chmods
+    its socket 0600 and the client refuses sockets owned by another
+    uid; point --solver-sidecar only at paths this user controls.
+
+    The default timeout is deliberately short: a HUNG (not crashed)
+    sidecar would otherwise stall every batch for the full timeout
+    before the scalar fallback engages."""
+
+    def __init__(self, sock_path: str, timeout: float = 15.0):
+        self.sock_path = sock_path
+        self.timeout = timeout
+
+    def _request(self, obj, timeout: float) -> dict:
+        try:
+            st = os.stat(self.sock_path)
+            if st.st_uid != os.geteuid():
+                raise SidecarError(
+                    f"sidecar socket {self.sock_path!r} owned by uid "
+                    f"{st.st_uid}, not us — refusing (pickle boundary)"
+                )
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(timeout)
+            sock.connect(self.sock_path)
+            try:
+                _send_msg(sock, obj)
+                return _recv_msg(sock)
+            finally:
+                sock.close()
+        except (OSError, pickle.PickleError, EOFError) as e:
+            raise SidecarError(f"sidecar transport failure: {e}")
+
+    def solve(
+        self,
+        pending,
+        nodes,
+        assigned: Sequence = (),
+        services: Sequence = (),
+        mode: str = "scan",
+    ) -> List[Optional[str]]:
+        snap = build_snapshot(pending, nodes, assigned, services)
+        reply = self._request(
+            {"op": "solve", "mode": mode, **_snapshot_payload(snap)},
+            self.timeout,
+        )
+        if reply.get("error"):
+            raise SidecarError(f"sidecar solve failed: {reply['error']}")
+        assignment = reply["assignment"]
+        names = snap.nodes.names
+        return [
+            names[i] if 0 <= i < len(names) else None for i in assignment
+        ]
+
+    def ping(self) -> bool:
+        try:
+            return self._request({"op": "ping"}, 5.0).get("ok", False)
+        except SidecarError:
+            return False
+
+
+def spawn_sidecar(
+    sock_path: Optional[str] = None, wait: float = 60.0, env=None
+) -> tuple:
+    """Launch the sidecar subprocess; returns (Popen, sock_path)."""
+    if sock_path is None:
+        sock_path = os.path.join(
+            tempfile.mkdtemp(prefix="ktpu-sidecar-"), "solver.sock"
+        )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.ops.sidecar", sock_path],
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        env=env,
+    )
+    client = SidecarSolver(sock_path)
+    deadline = time.monotonic() + wait
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise SidecarError(
+                f"sidecar exited rc={proc.returncode} before serving"
+            )
+        if os.path.exists(sock_path) and client.ping():
+            return proc, sock_path
+        time.sleep(0.1)
+    proc.terminate()
+    raise SidecarError("sidecar never became ready")
+
+
+# -- server -----------------------------------------------------------
+
+
+def serve(sock_path: str) -> None:
+    """Sidecar main loop: owns the accelerator; solves snapshots.
+
+    Per-connection containment is absolute: a garbage frame, a client
+    that times out and hangs up mid-reply (BrokenPipe), or a solve
+    crash must never exit this loop — a dead sidecar silently demotes
+    every future batch to the scalar fallback."""
+    from kubernetes_tpu.ops import device_snapshot
+    from kubernetes_tpu.ops.solver import solve_assignments
+    from kubernetes_tpu.ops.wave import wave_assignments
+
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.unlink(sock_path)
+    except OSError:
+        pass
+    server.bind(sock_path)
+    os.chmod(sock_path, 0o600)  # pickle boundary: same-user only
+    server.listen(4)
+    while True:
+        conn, _ = server.accept()
+        try:
+            req = _recv_msg(conn)
+            if not isinstance(req, dict):
+                _send_msg(conn, {"error": "request must be a dict"})
+                continue
+            if req.get("op") == "ping":
+                _send_msg(conn, {"ok": True})
+                continue
+            try:
+                snap = _snapshot_from_payload(req)
+                dsnap = device_snapshot(snap)
+                if req.get("mode") == "wave":
+                    assignment, _waves = wave_assignments(dsnap)
+                else:
+                    assignment = solve_assignments(dsnap)
+                _send_msg(conn, {"assignment": assignment.tolist()})
+            except Exception as e:  # solve failure -> structured error
+                _send_msg(conn, {"error": f"{type(e).__name__}: {e}"})
+        except Exception:
+            pass  # bad frame / client hung up mid-reply; next client
+        finally:
+            conn.close()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        sys.exit("usage: python -m kubernetes_tpu.ops.sidecar <socket-path>")
+    serve(sys.argv[1])

@@ -348,6 +348,7 @@ class BatchScheduler(Scheduler):
         max_batch: int = 65536,
         batch_window: float = 0.02,
         mode: str = "scan",
+        sidecar_path: Optional[str] = None,
     ):
         super().__init__(config)
         self.max_batch = max_batch
@@ -358,6 +359,15 @@ class BatchScheduler(Scheduler):
         if mode not in ("scan", "wave"):
             raise ValueError(f"unknown batch mode {mode!r}")
         self.mode = mode
+        # Optional process isolation: solve through a solver sidecar
+        # (ops/sidecar.py) — the control plane never touches the
+        # accelerator, and sidecar failure degrades to the scalar
+        # fallback below instead of taking the scheduler down.
+        self.sidecar = None
+        if sidecar_path:
+            from kubernetes_tpu.ops.sidecar import SidecarSolver
+
+            self.sidecar = SidecarSolver(sidecar_path)
         self.fallback_count = 0
 
     def _step(self) -> None:
@@ -395,9 +405,18 @@ class BatchScheduler(Scheduler):
         nodes = cfg.nodes.store.list()  # unfiltered; snapshot encodes readiness
         assigned = cfg.pod_lister.list()
         services = cfg.service_lister.list()
-        solver = (
-            schedule_backlog_wave if self.mode == "wave" else schedule_backlog_tpu
-        )
+        if self.sidecar is not None:
+            # The sidecar honors the batch mode too (the request
+            # carries it), so wave + sidecar compose instead of the
+            # sidecar silently downgrading an explicit wave request.
+            def solver(pending, nodes, assigned, services):
+                return self.sidecar.solve(
+                    pending, nodes, assigned, services, mode=self.mode
+                )
+        elif self.mode == "wave":
+            solver = schedule_backlog_wave
+        else:
+            solver = schedule_backlog_tpu
         try:
             t0 = time.monotonic()
             destinations = solver(pending, nodes, assigned, services)

@@ -1,0 +1,168 @@
+"""Solver sidecar: process isolation + the crash-fallback story.
+
+Reference framing: SURVEY §2.15/§5 — the north star's control plane
+and accelerator live in separate processes; a solver failure degrades
+to the stock scalar path (VERDICT r1 A8 flagged this as untested)."""
+
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.ops.sidecar import SidecarError, SidecarSolver, spawn_sidecar
+from kubernetes_tpu.scheduler.batch import parity_report, schedule_backlog_tpu
+from kubernetes_tpu.scheduler.daemon import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.server.api import APIServer
+from test_solver_parity import random_cluster
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # the subprocess owns its own backend
+    proc, sock_path = spawn_sidecar(env=env)
+    yield sock_path
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+class TestSidecarSolve:
+    def test_matches_in_process_solver(self, sidecar):
+        pods, nodes, assigned, services = random_cluster(4)
+        local = schedule_backlog_tpu(pods, nodes, assigned, services)
+        remote = SidecarSolver(sidecar).solve(pods, nodes, assigned, services)
+        parity, mismatches = parity_report(local, remote)
+        assert parity == 1.0, mismatches
+
+    def test_ping(self, sidecar):
+        assert SidecarSolver(sidecar).ping()
+
+    def test_wave_mode_travels_to_sidecar(self, sidecar):
+        """mode='wave' must run the wave solver inside the sidecar —
+        valid placements for the whole backlog."""
+        pods, nodes, assigned, services = random_cluster(2)
+        remote = SidecarSolver(sidecar).solve(
+            pods, nodes, assigned, services, mode="wave"
+        )
+        assert len(remote) == len(pods)
+        names = {n.metadata.name for n in nodes}
+        assert all(dest is None or dest in names for dest in remote)
+
+    def test_garbage_frame_does_not_kill_sidecar(self, sidecar):
+        """Per-connection containment: a junk frame must not exit the
+        serve loop."""
+        import socket as socketlib
+        import struct
+
+        s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+        s.connect(sidecar)
+        s.sendall(struct.pack(">Q", 7) + b"garbage")
+        s.close()
+        assert SidecarSolver(sidecar).ping()  # still alive
+
+    def test_dead_socket_raises_sidecar_error(self):
+        pods, nodes, assigned, services = random_cluster(1)
+        dead = SidecarSolver("/nonexistent/solver.sock", timeout=2)
+        assert not dead.ping()
+        with pytest.raises(SidecarError):
+            dead.solve(pods, nodes, assigned, services)
+
+
+def node_wire(name):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "status": {
+            "capacity": {"cpu": "4", "memory": "8Gi", "pods": "40"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def pod_wire(name):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "x",
+                    "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
+                }
+            ]
+        },
+    }
+
+
+class TestCrashFallback:
+    def test_scheduler_survives_dead_sidecar_via_scalar_fallback(self):
+        """Sidecar gone -> the batch scheduler's fallback seam runs the
+        scalar oracle and the backlog still schedules."""
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        for j in range(3):
+            client.create("nodes", node_wire(f"n{j}"))
+        for i in range(9):
+            client.create("pods", pod_wire(f"p{i}"))
+        cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+        assert cfg.wait_for_sync()
+        sched = BatchScheduler(
+            cfg, sidecar_path="/nonexistent/solver.sock"
+        )
+        sched.sidecar.timeout = 2  # fail fast in the test
+        try:
+            processed = 0
+            deadline = time.monotonic() + 60
+            while processed < 9 and time.monotonic() < deadline:
+                processed += sched.schedule_batch(timeout=0.5)
+            pods, _ = client.list("pods", namespace="default")
+            assert all(p.spec.node_name for p in pods)
+            assert sched.fallback_count > 0  # the fallback actually ran
+        finally:
+            cfg.stop()
+
+    def test_live_sidecar_then_killed_mid_run(self, tmp_path):
+        """Scheduler uses a live sidecar, the sidecar dies, scheduling
+        continues through the fallback."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc, sock_path = spawn_sidecar(env=env)
+        try:
+            api = APIServer()
+            client = Client(LocalTransport(api))
+            for j in range(3):
+                client.create("nodes", node_wire(f"n{j}"))
+            cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+            assert cfg.wait_for_sync()
+            sched = BatchScheduler(cfg, sidecar_path=sock_path)
+            try:
+                client.create("pods", pod_wire("before"))
+                deadline = time.monotonic() + 60
+                done = 0
+                while done < 1 and time.monotonic() < deadline:
+                    done += sched.schedule_batch(timeout=0.5)
+                assert client.get(
+                    "pods", "before", namespace="default"
+                ).spec.node_name
+                assert sched.fallback_count == 0  # sidecar did the work
+
+                proc.terminate()
+                proc.wait(timeout=10)
+                sched.sidecar.timeout = 2
+                client.create("pods", pod_wire("after"))
+                done = 0
+                deadline = time.monotonic() + 60
+                while done < 1 and time.monotonic() < deadline:
+                    done += sched.schedule_batch(timeout=0.5)
+                assert client.get(
+                    "pods", "after", namespace="default"
+                ).spec.node_name
+                assert sched.fallback_count > 0
+            finally:
+                cfg.stop()
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
