@@ -578,6 +578,58 @@ def cmd_port_forward(client: Client, args) -> int:
     return 0
 
 
+def cmd_top(client: Client, args) -> int:
+    """Live resource usage, heapster-era style: scrape every node's
+    kubelet /stats THROUGH the apiserver node proxy (reference:
+    cluster/addons/cluster-monitoring pulls cadvisor stats via the
+    master; kubectl top arrived with that pipeline)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    nodes, _ = client.list("nodes")
+    if args.what == "nodes":
+        print(f"{'NAME':20}{'PODS':6}{'RSS':>12}{'DISK-USED':>11}")
+    else:
+        print(f"{'POD-UID':38}{'CONTAINER':14}{'STATE':10}{'RSS':>12}{'RESTARTS':>9}")
+    for node in nodes:
+        url = f"{args.server}/api/v1/nodes/{node.metadata.name}/proxy/stats"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                stats = _json.loads(resp.read())
+        except (urllib.error.URLError, OSError) as e:
+            print(f"# {node.metadata.name}: unreachable ({e})", file=sys.stderr)
+            continue
+        pods = stats.get("pods", {})
+        if args.what == "nodes":
+            rss = sum(
+                c.get("rssBytes", 0) for cs in pods.values() for c in cs
+            )
+            disk = stats.get("disk", {}).get("usedFraction", 0)
+            print(
+                f"{node.metadata.name:20}{len(pods):<6}"
+                f"{_human_bytes(rss):>12}{disk:>10.0%}"
+            )
+        else:
+            for uid, containers in sorted(pods.items()):
+                for c in containers:
+                    print(
+                        f"{uid:38}{c.get('name', ''):14}"
+                        f"{c.get('state', ''):10}"
+                        f"{_human_bytes(c.get('rssBytes', 0)):>12}"
+                        f"{c.get('restartCount', 0):>9}"
+                    )
+    return 0
+
+
+def _human_bytes(n: int) -> str:
+    for unit in ("B", "Ki", "Mi", "Gi"):
+        if n < 1024 or unit == "Gi":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n}B"
+
+
 def cmd_api_resources(client: Client, args) -> int:
     seen = set()
     print(f"{'NAME':32}{'NAMESPACED':12}KIND")
@@ -685,6 +737,10 @@ def build_parser() -> argparse.ArgumentParser:
     ee.add_argument("--container", "-c", default="")
     ee.add_argument("cmd", nargs="+")
     ee.set_defaults(fn=cmd_exec)
+
+    tp = sub.add_parser("top", parents=[common])
+    tp.add_argument("what", choices=["nodes", "pods"])
+    tp.set_defaults(fn=cmd_top)
 
     pf = sub.add_parser("port-forward", parents=[common])
     pf.add_argument("name")

@@ -95,6 +95,56 @@ class TestLocalUpCluster:
             cluster.stop()
 
 
+class TestExamplesAndTop:
+    def test_examples_deploy_and_top_reports(self, capsys):
+        """The shipped example manifests deploy through ktctl against a
+        live cluster, and `ktctl top nodes` reports real usage."""
+        import os
+
+        from kubernetes_tpu.cli.ktctl import main as ktctl_main
+
+        args = build_parser().parse_args(["--port", "0", "--nodes", "2"])
+        cluster = LocalCluster(args).start()
+        try:
+            base = os.path.join(os.path.dirname(__file__), "..", "examples")
+            for manifest in ("web-rc.json", "web-service.json"):
+                rc = ktctl_main(
+                    [
+                        "create",
+                        "-f",
+                        os.path.join(base, manifest),
+                        "--server",
+                        cluster.http.address,
+                    ]
+                )
+                assert rc == 0
+            client = Client(HTTPTransport(cluster.http.address))
+            assert wait_until(
+                lambda: sum(
+                    1
+                    for p in client.list(
+                        "pods", namespace="default",
+                        label_selector="app=web",
+                    )[0]
+                    if p.status.phase == "Running"
+                )
+                == 3
+            )
+            capsys.readouterr()
+            rc = ktctl_main(
+                ["top", "nodes", "--server", cluster.http.address]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "node-0" in out and "node-1" in out
+            rc = ktctl_main(
+                ["top", "pods", "--server", cluster.http.address]
+            )
+            assert rc == 0
+        finally:
+            cluster.stop()
+
+
 class TestSwaggerAndUI:
     @pytest.fixture
     def server(self):
