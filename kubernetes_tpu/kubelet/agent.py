@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional
@@ -175,6 +176,22 @@ class Kubelet:
             on_update=self._dispatch,
             on_delete=self._handle_delete,
         )
+        # Service informer feeding service-discovery env vars into
+        # containers (reference: kubelet.go makeEnvironmentVariables +
+        # pkg/kubelet/envvars). Only runtimes that inject env carry the
+        # attribute (ProcessRuntime.service_env).
+        self.services: Optional[Informer] = None
+        if hasattr(self.runtime, "service_env"):
+            from kubernetes_tpu.models.objects import Service
+
+            self.services = Informer(
+                client,
+                "services",
+                decode=lambda w: serde.from_wire(Service, w),
+                on_add=self._services_changed,
+                on_update=self._services_changed,
+                on_delete=self._services_changed,
+            )
 
     # -- lifecycle ----------------------------------------------------
 
@@ -184,6 +201,10 @@ class Kubelet:
 
             self.http = KubeletServer(self, port=self._http_port).start()
         self.register_node()
+        if self.services is not None:
+            self.services.start()
+            self.services.wait_for_sync()
+            self._services_changed(None)
         self.pods.start()
         self.pods.wait_for_sync()
         targets = [self._heartbeat_loop, self._resync_loop]
@@ -202,6 +223,8 @@ class Kubelet:
     def stop(self) -> None:
         self._stop.set()
         self.pods.stop()
+        if self.services is not None:
+            self.services.stop()
         if self.http is not None:
             self.http.stop()
         for t in self._threads:
@@ -261,6 +284,35 @@ class Kubelet:
                 self._heartbeat()
             except Exception:
                 pass
+
+    def _services_changed(self, _obj) -> None:
+        """Recompute the runtime's PER-NAMESPACE service env maps
+        (captured by containers at START; churn never restarts running
+        ones). Namespaced like the reference (getServiceEnvVarMap
+        filters to the pod's namespace) — one global map would leak
+        env vars across namespaces and let same-named services in
+        different namespaces clobber each other."""
+        from kubernetes_tpu.kubelet.envvars import from_services
+
+        try:
+            by_ns: Dict[str, list] = {}
+            for svc in self.services.store.list():
+                by_ns.setdefault(
+                    svc.metadata.namespace or "default", []
+                ).append(svc)
+            self.runtime.service_env = {
+                ns: from_services(svcs) for ns, svcs in by_ns.items()
+            }
+        except Exception:
+            # No retry can fix a deterministic recompute bug — at least
+            # make it visible instead of freezing env at a stale value.
+            import traceback
+
+            print(
+                f"kubelet {self.node_name}: service env recompute failed:",
+                file=sys.stderr,
+            )
+            traceback.print_exc()
 
     def _desired_uids(self) -> set:
         return {

@@ -1,0 +1,45 @@
+"""Service environment variables — pre-DNS service discovery.
+
+Reference: pkg/kubelet/envvars/envvars.go (FromServices) — every
+container gets `{SVC}_SERVICE_HOST`, `{SVC}_SERVICE_PORT`, named-port
+variants, and the docker-link-compatible `{SVC}_PORT_*` family for each
+service with a cluster IP. Naming matches the reference exactly
+(upper-case, '-' -> '_').
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def _env_name(name: str) -> str:
+    return name.upper().replace("-", "_")
+
+
+def from_services(services: List) -> Dict[str, str]:
+    """Service env map in reference order (later services override on
+    name collision, like repeated docker -e flags)."""
+    out: Dict[str, str] = {}
+    for svc in services:
+        ip = svc.spec.cluster_ip
+        if not ip or ip == "None" or not svc.spec.ports:
+            continue
+        prefix = _env_name(svc.metadata.name)
+        first = svc.spec.ports[0]
+        out[f"{prefix}_SERVICE_HOST"] = ip
+        out[f"{prefix}_SERVICE_PORT"] = str(first.port)
+        for sp in svc.spec.ports:
+            if sp.name:
+                out[f"{prefix}_SERVICE_PORT_{_env_name(sp.name)}"] = str(sp.port)
+        # Docker-compatible link variables (makeLinkVariables).
+        for i, sp in enumerate(svc.spec.ports):
+            protocol = (sp.protocol or "TCP").upper()
+            url = f"{protocol.lower()}://{ip}:{sp.port}"
+            if i == 0:
+                out[f"{prefix}_PORT"] = url
+            pp = f"{prefix}_PORT_{sp.port}_{protocol}"
+            out[pp] = url
+            out[f"{pp}_PROTO"] = protocol.lower()
+            out[f"{pp}_PORT"] = str(sp.port)
+            out[f"{pp}_ADDR"] = ip
+    return out

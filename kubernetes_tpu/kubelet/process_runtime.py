@@ -95,6 +95,13 @@ class ProcessRuntime(ContainerRuntime):
         self._lock = threading.RLock()
         self._pods: Dict[str, Dict[str, _Proc]] = {}
         self._anchors: Dict[str, _Proc] = {}
+        # Per-NAMESPACE service env injected into containers (the
+        # kubelet keeps this current from its service informer;
+        # reference: pkg/kubelet/envvars FromServices, filtered to the
+        # pod's namespace by getServiceEnvVarMap). Captured at
+        # container START, like the reference — service churn does not
+        # restart running containers.
+        self.service_env: Dict[str, Dict[str, str]] = {}
         # "uid/name" -> restart count to apply at next (re)start; set
         # by restart_container, consumed by sync_pod.
         self._restart_counts: Dict[str, int] = {}
@@ -225,11 +232,24 @@ class ProcessRuntime(ContainerRuntime):
 
     def _env_for(self, pod: Pod, spec) -> Dict[str, str]:
         env = dict(os.environ)
+        # Service discovery env first (envvars.go FromServices; the
+        # POD'S NAMESPACE only), then pod identity, then the
+        # container's OWN env — user-declared variables win.
+        env.update(
+            self.service_env.get(pod.metadata.namespace or "default", {})
+        )
         env["KUBERNETES_POD_NAME"] = pod.metadata.name
         env["KUBERNETES_POD_NAMESPACE"] = pod.metadata.namespace or "default"
         env["KUBERNETES_CONTAINER_NAME"] = spec.name
         if self.node_name:
             env["KUBERNETES_NODE_NAME"] = self.node_name
+        # Where this pod's mounted volumes live (host-network process
+        # runtime: volumes are directories under the kubelet root,
+        # <volumes-dir>/<escaped-plugin>/<volume-name>).
+        uid = pod.metadata.uid or pod.metadata.name
+        env["KUBERNETES_VOLUMES_DIR"] = os.path.join(
+            self.root, "pods", uid, "volumes"
+        )
         for e in spec.env:
             env[e.name] = e.value
         return env

@@ -293,6 +293,92 @@ class TestKubeletHTTPAPI:
         assert e.value.code == 409
 
 
+class TestServiceEnv:
+    def test_from_services_reference_format(self):
+        from kubernetes_tpu.kubelet.envvars import from_services
+        from kubernetes_tpu.models.objects import (
+            ObjectMeta,
+            Service,
+            ServicePort,
+            ServiceSpec,
+        )
+
+        svc = Service(
+            metadata=ObjectMeta(name="redis-master", namespace="default"),
+            spec=ServiceSpec(
+                cluster_ip="10.0.0.11",
+                ports=[ServicePort(name="redis", port=6379, protocol="TCP")],
+            ),
+        )
+        env = from_services([svc])
+        # Exact reference names (envvars_test.go shapes).
+        assert env["REDIS_MASTER_SERVICE_HOST"] == "10.0.0.11"
+        assert env["REDIS_MASTER_SERVICE_PORT"] == "6379"
+        assert env["REDIS_MASTER_SERVICE_PORT_REDIS"] == "6379"
+        assert env["REDIS_MASTER_PORT"] == "tcp://10.0.0.11:6379"
+        assert env["REDIS_MASTER_PORT_6379_TCP"] == "tcp://10.0.0.11:6379"
+        assert env["REDIS_MASTER_PORT_6379_TCP_PROTO"] == "tcp"
+        assert env["REDIS_MASTER_PORT_6379_TCP_PORT"] == "6379"
+        assert env["REDIS_MASTER_PORT_6379_TCP_ADDR"] == "10.0.0.11"
+
+    def test_headless_services_excluded(self):
+        from kubernetes_tpu.kubelet.envvars import from_services
+        from kubernetes_tpu.models.objects import (
+            ObjectMeta,
+            Service,
+            ServiceSpec,
+        )
+
+        headless = Service(
+            metadata=ObjectMeta(name="hl", namespace="default"),
+            spec=ServiceSpec(cluster_ip="None"),
+        )
+        assert from_services([headless]) == {}
+
+    def test_containers_see_service_env(self, cluster):
+        """End to end: a real process container observes the service
+        discovery variables (kubelet.go makeEnvironmentVariables)."""
+        api, client, kubelet, runtime = cluster
+        client.create(
+            "services",
+            {
+                "kind": "Service",
+                "metadata": {"name": "backend", "namespace": "default"},
+                "spec": {
+                    "selector": {"app": "backend"},
+                    "ports": [{"name": "http", "port": 8080}],
+                    "clusterIP": "10.0.0.55",
+                },
+            },
+            namespace="default",
+        )
+        assert wait_for(
+            lambda: runtime.service_env.get("default", {}).get(
+                "BACKEND_SERVICE_HOST"
+            )
+            == "10.0.0.55"
+        )
+        # Namespaced: a pod in another namespace must NOT see it.
+        assert "BACKEND_SERVICE_HOST" not in runtime.service_env.get(
+            "other", {}
+        )
+        _schedule(
+            client,
+            "envpod",
+            ["/bin/sh", "-c", "echo HOST=$BACKEND_SERVICE_HOST "
+             "PORT=$BACKEND_SERVICE_PORT VOLS=$KUBERNETES_VOLUMES_DIR; sleep 30"],
+        )
+        assert wait_for(lambda: _pod_running(client, runtime, "envpod"))
+        pod = client.get("pods", "envpod", namespace="default")
+        uid = pod.metadata.uid
+        assert wait_for(
+            lambda: "HOST=10.0.0.55" in runtime.read_logs(uid, "main")
+        )
+        log = runtime.read_logs(uid, "main")
+        assert "PORT=8080" in log
+        assert f"pods/{uid}/volumes" in log
+
+
 class TestKtctlLogsExec:
     def test_ktctl_logs_and_exec_over_http(self, cluster, capsys):
         from kubernetes_tpu.cli.ktctl import main as ktctl_main
