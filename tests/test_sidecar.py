@@ -17,14 +17,26 @@ from kubernetes_tpu.server.api import APIServer
 from test_solver_parity import random_cluster
 
 
+def _stop_proc(proc):
+    """terminate, then kill: SIGTERM can't interrupt a native XLA
+    compile, and a hung wait() here flakes the whole module."""
+    if proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except Exception:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
 @pytest.fixture(scope="module")
 def sidecar():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # the subprocess owns its own backend
-    proc, sock_path = spawn_sidecar(env=env)
+    proc, sock_path = spawn_sidecar(env=env, wait=120)
     yield sock_path
-    proc.terminate()
-    proc.wait(timeout=10)
+    _stop_proc(proc)
 
 
 class TestSidecarSolve:
@@ -128,7 +140,7 @@ class TestCrashFallback:
         continues through the fallback."""
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
-        proc, sock_path = spawn_sidecar(env=env)
+        proc, sock_path = spawn_sidecar(env=env, wait=120)
         try:
             api = APIServer()
             client = Client(LocalTransport(api))
@@ -148,8 +160,7 @@ class TestCrashFallback:
                 ).spec.node_name
                 assert sched.fallback_count == 0  # sidecar did the work
 
-                proc.terminate()
-                proc.wait(timeout=10)
+                _stop_proc(proc)
                 sched.sidecar.timeout = 2
                 client.create("pods", pod_wire("after"))
                 done = 0
@@ -163,6 +174,4 @@ class TestCrashFallback:
             finally:
                 cfg.stop()
         finally:
-            if proc.poll() is None:
-                proc.terminate()
-                proc.wait(timeout=10)
+            _stop_proc(proc)
