@@ -91,7 +91,30 @@ class LocalCluster:
         self.scheduler_config.wait_for_sync()
         self.scheduler = self.scheduler_cls(self.scheduler_config).start()
         self.manager.start()
+        # Live component health (componentstatuses; the reference
+        # master registers etcd + scheduler + controller-manager,
+        # pkg/master/master.go getServersToValidate).
+        self.api.register_component(
+            "etcd-0", lambda: (True, "store serving")
+        )
+        self.api.register_component("scheduler", self._scheduler_health)
+        self.api.register_component(
+            "controller-manager", self._manager_health
+        )
         return self
+
+    def _scheduler_health(self):
+        sched = self.scheduler
+        alive = (
+            sched is not None
+            and sched._thread is not None
+            and sched._thread.is_alive()
+        )
+        return alive, "ok" if alive else "scheduler loop not running"
+
+    def _manager_health(self):
+        n = len(self.manager.controllers)
+        return n > 0, f"{n} controllers running" if n else "no controllers"
 
     def stop(self) -> None:
         import shutil

@@ -138,6 +138,9 @@ class APIServer:
         # means admit everything (reference default --admission-control
         # AlwaysAdmit, cmd/kube-apiserver/app/server.go:117).
         self.admission = admission
+        # Live component health checks (componentstatuses probes on
+        # read; pkg/registry/componentstatus/rest.go).
+        self._component_checks: Dict[str, object] = {}
         # Ensure the default namespace exists (reference auto-creates).
         try:
             self.store.create(
@@ -276,8 +279,38 @@ class APIServer:
     def _ns(self, info: ResourceInfo, namespace: str) -> str:
         return (namespace or "default") if info.namespaced else ""
 
+    # -- component statuses (live health probes) ----------------------
+
+    def register_component(self, name: str, check) -> None:
+        """Register a component health check (callable -> (ok, msg)).
+        Reference: pkg/registry/componentstatus/rest.go — the resource
+        is a LIVE view probing registered servers on every read, not
+        stored objects."""
+        self._component_checks[name] = check
+
+    def _component_status(self, name: str) -> dict:
+        check = self._component_checks[name]
+        try:
+            ok, msg = check()
+        except Exception as e:
+            ok, msg = False, f"{type(e).__name__}: {e}"
+        return {
+            "kind": "ComponentStatus",
+            "apiVersion": "v1",
+            "metadata": {"name": name},
+            "conditions": [
+                {
+                    "type": "Healthy",
+                    "status": "True" if ok else "False",
+                    "message": msg,
+                }
+            ],
+        }
+
     def get(self, resource: str, namespace: str, name: str) -> dict:
         info = self._info(resource)
+        if info.name == "componentstatuses" and name in self._component_checks:
+            return self._component_status(name)
         try:
             return self.store.get(info.key(self._ns(info, namespace), name))
         except NotFoundError:
@@ -294,6 +327,19 @@ class APIServer:
         items, version = self.store.list(info.prefix(namespace))
         pred = self._selector_pred(resource, label_selector, field_selector)
         items = [o for o in items if pred(o)]
+        if info.name == "componentstatuses" and self._component_checks:
+            # Live probes first (the reference ignores selectors here
+            # entirely, rest.go:52; we at least apply them uniformly);
+            # stored objects only fill names no live check covers.
+            live = [
+                o
+                for n in sorted(self._component_checks)
+                if pred(o := self._component_status(n))
+            ]
+            covered = set(self._component_checks)
+            items = live + [
+                o for o in items if o.get("metadata", {}).get("name") not in covered
+            ]
         return {
             "kind": info.kind + "List",
             "apiVersion": "v1",

@@ -91,8 +91,19 @@ class TestLocalUpCluster:
             assert wait_until(running)
             nodes, _ = client.list("nodes")
             assert len(nodes) == 2
+            # Live componentstatuses (reference: master probes its
+            # registered servers on every read).
+            comps, _ = client.list("componentstatuses")
+            by_name = {c.metadata.name: c for c in comps}
+            assert {"etcd-0", "scheduler", "controller-manager"} <= set(by_name)
+            for c in by_name.values():
+                healthy = [x for x in c.conditions if x.type == "Healthy"]
+                assert healthy and healthy[0].status == "True", c.metadata.name
         finally:
             cluster.stop()
+        # After stop, the scheduler reports unhealthy (live probe).
+        ok, _msg = cluster._scheduler_health()
+        assert not ok
 
 
 class TestExamplesAndTop:
