@@ -255,6 +255,10 @@ def kubelet_parser() -> argparse.ArgumentParser:
     p.add_argument("--node-name", required=True)
     p.add_argument("--root-dir", default="")
     p.add_argument("--manifest-dir", default="")
+    p.add_argument(
+        "--manifest-url", default="",
+        help="poll this URL for static pod manifests (config/http.go)",
+    )
     p.add_argument("--cpu", default="4")
     p.add_argument("--memory", default="8Gi")
     p.add_argument("--max-pods", type=int, default=110)
@@ -287,6 +291,7 @@ def start_kubelet(args, client=None):
         memory=args.memory,
         max_pods=args.max_pods,
         manifest_dir=args.manifest_dir or None,
+        manifest_url=args.manifest_url or None,
         root_dir=args.root_dir or None,
         serve_http=True,
         http_port=args.http_port,

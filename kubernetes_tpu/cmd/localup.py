@@ -113,7 +113,10 @@ class LocalCluster:
         return alive, "ok" if alive else "scheduler loop not running"
 
     def _manager_health(self):
+        running = getattr(self.manager, "running", False)
         n = len(self.manager.controllers)
+        if not running:
+            return False, "controller manager stopped"
         return n > 0, f"{n} controllers running" if n else "no controllers"
 
     def stop(self) -> None:

@@ -38,6 +38,7 @@ class ControllerManager:
         cloud_provider=None,
     ):
         self.controllers: List = []
+        self.running = False  # live health signal (componentstatuses)
         if cloud_provider is not None:
             from kubernetes_tpu.controllers.cloudnodes import CloudNodeController
             from kubernetes_tpu.controllers.routes import RouteController
@@ -83,8 +84,10 @@ class ControllerManager:
     def start(self) -> "ControllerManager":
         for c in self.controllers:
             c.start()
+        self.running = True
         return self
 
     def stop(self) -> None:
+        self.running = False
         for c in self.controllers:
             c.stop()

@@ -102,6 +102,7 @@ class Kubelet:
         heartbeat_period: float = 5.0,
         sync_period: float = 3.0,
         manifest_dir: Optional[str] = None,
+        manifest_url: Optional[str] = None,
         root_dir: Optional[str] = None,
         mounter=None,
         serve_http: bool = False,
@@ -135,6 +136,7 @@ class Kubelet:
         self.heartbeat_period = heartbeat_period
         self.sync_period = sync_period
         self.manifest_dir = manifest_dir
+        self.manifest_url = manifest_url
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._workers: Dict[str, _PodWorker] = {}
@@ -216,6 +218,10 @@ class Kubelet:
             self._threads.append(t)
         if self.manifest_dir:
             t = threading.Thread(target=self._manifest_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.manifest_url:
+            t = threading.Thread(target=self._manifest_url_loop, daemon=True)
             t.start()
             self._threads.append(t)
         return self
@@ -570,11 +576,68 @@ class Kubelet:
 
     # -- static pods (file source, config/file.go) --------------------
 
+    _STATIC_SOURCE_ANNOTATION = "kubernetes-tpu.io/static-source"
+
+    def _apply_static(
+        self, applied: Dict[str, tuple], key: str, content: str, source: str
+    ) -> None:
+        """Apply one static-pod manifest (by source key) as a mirror
+        pod; edits replace, unchanged content no-ops, failures retry
+        next tick (reference: config/{file,http}.go + mirror pods).
+
+        Mirrors are annotated with their SOURCE: with both a manifest
+        dir and a manifest URL configured, a same-named pod must not be
+        cross-claimed through the 409 branch, or one source's removal
+        would delete a mirror the other source then never recreates."""
+        try:
+            wire = json.loads(content)
+        except json.JSONDecodeError:
+            return
+        name = wire.get("metadata", {}).get("name", "")
+        if not name:
+            return
+        prev = applied.get(key)
+        if prev is not None and prev[0] == content:
+            return  # unchanged
+        mirror = f"{name}-{self.node_name}"
+        ns = wire.get("metadata", {}).get("namespace", "default")
+        wire["metadata"]["name"] = mirror
+        wire["metadata"].setdefault("annotations", {})[
+            self._STATIC_SOURCE_ANNOTATION
+        ] = source
+        wire.setdefault("spec", {})["nodeName"] = self.node_name
+        try:
+            if prev is not None:
+                # Edited: replace the mirror pod.
+                try:
+                    self.client.delete("pods", prev[1], namespace=prev[2])
+                except APIError:
+                    pass
+            self.client.create("pods", wire, namespace=ns)
+            applied[key] = (content, mirror, ns)
+        except APIError as e:
+            if e.code == 409:
+                # Adopt only OUR OWN previous mirror (kubelet restart);
+                # a same-named pod from another source stays theirs.
+                try:
+                    existing = self.client.get("pods", mirror, namespace=ns)
+                    owner = (existing.metadata.annotations or {}).get(
+                        self._STATIC_SOURCE_ANNOTATION
+                    )
+                except APIError:
+                    return
+                if owner == source:
+                    applied[key] = (content, mirror, ns)
+
+    def _remove_static(self, applied: Dict[str, tuple], key: str) -> None:
+        _, mirror, ns = applied.pop(key)
+        try:
+            self.client.delete("pods", mirror, namespace=ns)
+        except APIError:
+            pass
+
     def _manifest_loop(self) -> None:
-        """Static-pod file source: applies manifest adds/edits/removals
-        as mirror pods (reference: config/file.go + mirror pods)."""
-        # fname -> (content, mirror_name, namespace); only successful
-        # applies are recorded so failures retry next tick.
+        """Static-pod file source (reference: config/file.go)."""
         applied: Dict[str, tuple] = {}
         while not self._stop.wait(2.0):
             try:
@@ -586,38 +649,59 @@ class Kubelet:
             # Removed manifests: delete their mirror pods.
             for fname in list(applied):
                 if fname not in files:
-                    _, mirror, ns = applied.pop(fname)
-                    try:
-                        self.client.delete("pods", mirror, namespace=ns)
-                    except APIError:
-                        pass
+                    self._remove_static(applied, fname)
             for fname in sorted(files):
                 path = os.path.join(self.manifest_dir, fname)
                 try:
                     with open(path) as f:
                         content = f.read()
-                    wire = json.loads(content)
-                except (OSError, json.JSONDecodeError):
+                except OSError:
                     continue
-                name = wire.get("metadata", {}).get("name", "")
+                self._apply_static(applied, fname, content, source="file")
+
+    def _manifest_url_loop(self) -> None:
+        """Static-pod URL source (reference: config/http.go — the
+        kubelet polls --manifest-url for a pod manifest or a list)."""
+        import urllib.error
+        import urllib.request
+
+        applied: Dict[str, tuple] = {}
+        while not self._stop.wait(2.0):
+            try:
+                with urllib.request.urlopen(self.manifest_url, timeout=10) as r:
+                    body = r.read().decode(errors="replace")
+            except (urllib.error.URLError, OSError):
+                continue  # unreachable: keep the last applied state
+            try:
+                wire = json.loads(body)
+            except json.JSONDecodeError:
+                continue
+            # Shape-validate before acting: a parseable-but-wrong body
+            # ({}, null, an error JSON) must KEEP the last good config
+            # like a fetch failure does — only a well-formed Pod or
+            # PodList may add/remove static pods. An explicit empty
+            # PodList legitimately clears them.
+            if not isinstance(wire, dict):
+                continue
+            if wire.get("kind", "").endswith("List"):
+                docs = [d for d in wire.get("items", []) if isinstance(d, dict)]
+            elif wire.get("kind") == "Pod":
+                docs = [wire]
+            else:
+                continue
+            keys = set()
+            for doc in docs:
+                meta = doc.get("metadata", {})
+                name = meta.get("name", "")
                 if not name:
                     continue
-                prev = applied.get(fname)
-                if prev is not None and prev[0] == content:
-                    continue  # unchanged
-                mirror = f"{name}-{self.node_name}"
-                ns = wire.get("metadata", {}).get("namespace", "default")
-                wire["metadata"]["name"] = mirror
-                wire.setdefault("spec", {})["nodeName"] = self.node_name
-                try:
-                    if prev is not None:
-                        # Edited: replace the mirror pod.
-                        try:
-                            self.client.delete("pods", prev[1], namespace=prev[2])
-                        except APIError:
-                            pass
-                    self.client.create("pods", wire, namespace=ns)
-                    applied[fname] = (content, mirror, ns)
-                except APIError as e:
-                    if e.code == 409:  # already mirrored (restart case)
-                        applied[fname] = (content, mirror, ns)
+                # Namespace in the key: same-named pods in different
+                # namespaces are distinct and must not thrash.
+                key = f"url:{meta.get('namespace', 'default')}/{name}"
+                keys.add(key)
+                self._apply_static(
+                    applied, key, json.dumps(doc, sort_keys=True), source="url"
+                )
+            for key in list(applied):
+                if key not in keys:
+                    self._remove_static(applied, key)

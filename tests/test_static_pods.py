@@ -1,0 +1,184 @@
+"""Static pod sources: manifest dir (file) and manifest URL (http).
+
+Reference: pkg/kubelet/config/{file,http}.go — the kubelet's three pod
+sources are the apiserver watch, a manifest directory, and a polled
+manifest URL; file/URL pods are mirrored to the apiserver as
+"<name>-<node>" pods."""
+
+import http.server
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.kubelet.agent import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+from kubernetes_tpu.server.api import APIServer
+
+
+def wait_until(cond, timeout=15.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def manifest(name, image="static"):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": image}]},
+    }
+
+
+class _ManifestHandler(http.server.BaseHTTPRequestHandler):
+    payload = b"{}"
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(self.payload)))
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+
+@pytest.fixture
+def manifest_server():
+    handler = type("H", (_ManifestHandler,), {})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv, handler
+    srv.shutdown()
+    srv.server_close()
+
+
+def pod_names(client):
+    pods, _ = client.list("pods", namespace="default")
+    return {p.metadata.name for p in pods}
+
+
+class TestManifestURL:
+    def test_url_pods_mirror_update_and_remove(self, manifest_server):
+        srv, handler = manifest_server
+        handler.payload = json.dumps(manifest("web")).encode()
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        kubelet = Kubelet(
+            Client(LocalTransport(api)),
+            node_name="n1",
+            runtime=FakeRuntime(),
+            heartbeat_period=0.5,
+            sync_period=0.3,
+            manifest_url=f"http://127.0.0.1:{srv.server_address[1]}/",
+        ).start()
+        try:
+            assert wait_until(lambda: "web-n1" in pod_names(client))
+            pod = client.get("pods", "web-n1", namespace="default")
+            assert pod.spec.node_name == "n1"  # pinned to this node
+
+            # List payloads work; removing an entry deletes its mirror.
+            handler.payload = json.dumps(
+                {
+                    "kind": "PodList",
+                    "items": [manifest("web"), manifest("extra")],
+                }
+            ).encode()
+            assert wait_until(lambda: "extra-n1" in pod_names(client))
+            handler.payload = json.dumps(manifest("web")).encode()
+            assert wait_until(lambda: "extra-n1" not in pod_names(client))
+
+            # Edited manifest replaces the mirror pod.
+            handler.payload = json.dumps(manifest("web", image="v2")).encode()
+            assert wait_until(
+                lambda: "web-n1" in pod_names(client)
+                and client.get("pods", "web-n1", namespace="default")
+                .spec.containers[0]
+                .image
+                == "v2"
+            )
+        finally:
+            kubelet.stop()
+
+    def test_malformed_but_parseable_payload_keeps_state(
+        self, manifest_server
+    ):
+        """{} / error JSON with HTTP 200 must not tear down static pods
+        (only a well-formed Pod/PodList may add or remove)."""
+        srv, handler = manifest_server
+        handler.payload = json.dumps(manifest("keepme")).encode()
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        kubelet = Kubelet(
+            Client(LocalTransport(api)),
+            node_name="n1",
+            runtime=FakeRuntime(),
+            heartbeat_period=0.5,
+            sync_period=0.3,
+            manifest_url=f"http://127.0.0.1:{srv.server_address[1]}/",
+        ).start()
+        try:
+            assert wait_until(lambda: "keepme-n1" in pod_names(client))
+            for bad in (b"{}", b"null", b'{"error": "busy"}'):
+                handler.payload = bad
+                time.sleep(2.5)
+                assert "keepme-n1" in pod_names(client), bad
+            # But an explicit empty PodList DOES clear them.
+            handler.payload = json.dumps(
+                {"kind": "PodList", "items": []}
+            ).encode()
+            assert wait_until(lambda: "keepme-n1" not in pod_names(client))
+        finally:
+            kubelet.stop()
+
+    def test_unreachable_url_keeps_state(self, manifest_server):
+        """A fetch failure must NOT tear down running static pods
+        (config/http.go keeps the last good config)."""
+        srv, handler = manifest_server
+        handler.payload = json.dumps(manifest("stay")).encode()
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        kubelet = Kubelet(
+            Client(LocalTransport(api)),
+            node_name="n1",
+            runtime=FakeRuntime(),
+            heartbeat_period=0.5,
+            sync_period=0.3,
+            manifest_url=f"http://127.0.0.1:{srv.server_address[1]}/",
+        ).start()
+        try:
+            assert wait_until(lambda: "stay-n1" in pod_names(client))
+            srv.shutdown()
+            srv.server_close()
+            time.sleep(3)  # a few failed polls
+            assert "stay-n1" in pod_names(client)
+        finally:
+            kubelet.stop()
+
+
+class TestManifestDir:
+    def test_dir_pods_mirror_and_remove(self, tmp_path):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        path = tmp_path / "static.json"
+        path.write_text(json.dumps(manifest("disk")))
+        kubelet = Kubelet(
+            Client(LocalTransport(api)),
+            node_name="n1",
+            runtime=FakeRuntime(),
+            heartbeat_period=0.5,
+            sync_period=0.3,
+            manifest_dir=str(tmp_path),
+        ).start()
+        try:
+            assert wait_until(lambda: "disk-n1" in pod_names(client))
+            os.unlink(path)
+            assert wait_until(lambda: "disk-n1" not in pod_names(client))
+        finally:
+            kubelet.stop()
