@@ -226,6 +226,13 @@ class HTTPTransport(Transport):
             return self._do(
                 "DELETE", self._collection_path(resource, namespace) + f"/{name}"
             )
+        if op == "patch":
+            resource, namespace, name = args
+            return self._do(
+                "PATCH",
+                self._collection_path(resource, namespace) + f"/{name}",
+                body=body,
+            )
         if op == "bind":
             (namespace,) = args
             return self._do(
@@ -364,6 +371,13 @@ class Client:
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         self._throttle()
         self.t.request("DELETE", "delete", (resource, namespace, name))
+
+    def patch(self, resource: str, name: str, patch: dict, namespace: str = ""):
+        """JSON merge patch (RFC 7386): null deletes keys, dicts merge,
+        scalars/lists replace."""
+        self._throttle()
+        out = self.t.request("PATCH", "patch", (resource, namespace, name), patch)
+        return self._typed(resource, out)
 
     def pod_logs(
         self,

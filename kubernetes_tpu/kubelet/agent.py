@@ -608,7 +608,11 @@ class Kubelet:
         wire.setdefault("spec", {})["nodeName"] = self.node_name
         try:
             if prev is not None:
-                # Edited: replace the mirror pod.
+                # Edited: replace the mirror pod. The old applied entry
+                # is dropped FIRST — if the new create then fails, a
+                # revert to the previous content must not hit the
+                # 'unchanged' early-return and strand the pod.
+                applied.pop(key, None)
                 try:
                     self.client.delete("pods", prev[1], namespace=prev[2])
                 except APIError:
@@ -617,8 +621,9 @@ class Kubelet:
             applied[key] = (content, mirror, ns)
         except APIError as e:
             if e.code == 409:
-                # Adopt only OUR OWN previous mirror (kubelet restart);
-                # a same-named pod from another source stays theirs.
+                # Adopt our OWN previous mirror (kubelet restart) —
+                # including pre-annotation mirrors (owner None); a
+                # same-named pod from ANOTHER source stays theirs.
                 try:
                     existing = self.client.get("pods", mirror, namespace=ns)
                     owner = (existing.metadata.annotations or {}).get(
@@ -626,7 +631,7 @@ class Kubelet:
                     )
                 except APIError:
                     return
-                if owner == source:
+                if owner in (source, None):
                     applied[key] = (content, mirror, ns)
 
     def _remove_static(self, applied: Dict[str, tuple], key: str) -> None:
@@ -698,6 +703,8 @@ class Kubelet:
                 # Namespace in the key: same-named pods in different
                 # namespaces are distinct and must not thrash.
                 key = f"url:{meta.get('namespace', 'default')}/{name}"
+                if key in keys:
+                    continue  # duplicate entry in one payload: first wins
                 keys.add(key)
                 self._apply_static(
                     applied, key, json.dumps(doc, sort_keys=True), source="url"

@@ -71,6 +71,25 @@ def _invalid(msg: str) -> APIError:
     return APIError(422, "Invalid", msg)
 
 
+def _json_merge(target: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch: null deletes, dicts merge
+    recursively, everything else replaces."""
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict):
+            # Merge into the existing dict, or into {} when the target
+            # key is absent/non-dict — RFC 7386 strips nulls either way
+            # (storing a literal null would make the key 'exist' and
+            # break later null-delete semantics).
+            base = out.get(k)
+            out[k] = _json_merge(base if isinstance(base, dict) else {}, v)
+        else:
+            out[k] = v
+    return out
+
+
 def _bad_request(msg: str) -> APIError:
     return APIError(400, "BadRequest", msg)
 
@@ -467,6 +486,43 @@ class APIServer:
             )
         except AdmissionError as e:
             raise APIError(e.code, e.reason, e.message)
+
+    def patch(self, resource: str, namespace: str, name: str, patch: dict) -> dict:
+        """JSON merge patch (RFC 7386) over a CAS retry — the PATCH
+        verb from pkg/apiserver/resthandler.go:446 (the reference's
+        default patch type of this era is merge-style). Admission runs
+        on the MERGED object like any other update — a patch must not
+        be a side door around quota/policy."""
+        import copy as _copy
+
+        info = self._info(resource)
+        ns = self._ns(info, namespace)
+        # Deep copy: the sanitizer below edits nested dicts, and
+        # in-process (LocalTransport) callers must get their patch
+        # object back untouched.
+        patch = _copy.deepcopy(patch)
+        # Identity/shape fields never come from a patch body.
+        for forbidden in ("kind", "apiVersion"):
+            patch.pop(forbidden, None)
+        meta_patch = patch.get("metadata")
+        if isinstance(meta_patch, dict):
+            for forbidden in ("name", "namespace", "resourceVersion", "uid"):
+                meta_patch.pop(forbidden, None)
+
+        def apply(cur: dict) -> dict:
+            merged = _json_merge(cur, patch)
+            self._admit("UPDATE", info, ns, name, merged)
+            self._validate(info, merged)
+            return merged
+
+        key = info.key(ns, name)
+        with self._write_guard():
+            try:
+                out = self.store.guaranteed_update(key, apply)
+            except NotFoundError:
+                raise _not_found(info.name, name)
+            self._commit("UPDATE", info, ns, name, out)
+        return out
 
     def kubelet_location(self, namespace: str, name: str) -> Tuple[str, dict]:
         """Resolve the kubelet API base URL serving a pod — the routing

@@ -164,6 +164,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):  # noqa: N802
         self._dispatch("DELETE")
 
+    def do_PATCH(self):  # noqa: N802
+        self._dispatch("PATCH")
+
     def _dispatch(self, verb: str) -> None:
         start = time.monotonic()
         resource = ""
@@ -616,6 +619,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif verb == "PUT":
             self._send_json(
                 200, api.update(resource, ns, name, self._read_body(self._kind_of(resource)))
+            )
+        elif verb == "PATCH":
+            # JSON merge patch (resthandler.go:446). The body is a
+            # partial document, not a full object — no kind hint.
+            self._send_json(
+                200, api.patch(resource, ns, name, self._read_body())
             )
         elif verb == "DELETE":
             self._send_json(200, api.delete(resource, ns, name))

@@ -135,6 +135,29 @@ class TestLimitRanger:
             self.api.create("pods", "default", pod_with_resources(cpu="50m"))
         assert "minimum cpu" in ei.value.message
 
+    def test_patch_cannot_evade_limits(self):
+        """PATCH runs the admission chain on the MERGED object — a
+        merge patch must not be a side door around LimitRanger."""
+        self.api.create("pods", "default", pod_with_resources(cpu="500m"))
+        with pytest.raises(APIError) as ei:
+            self.api.patch(
+                "pods",
+                "default",
+                "p1",
+                {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "nginx",
+                                "resources": {"limits": {"cpu": "4"}},
+                            }
+                        ]
+                    }
+                },
+            )
+        assert "maximum cpu" in ei.value.message
+
 
 class TestResourceQuota:
     def setup_method(self):

@@ -264,3 +264,92 @@ class TestRegressionsFromReview:
         )
         snap = build_snapshot([], [node])
         assert snap.nodes.mem_cap[0] == 100  # floor, not ceil
+
+
+class TestPatch:
+    """PATCH verb: JSON merge patch (resthandler.go:446, RFC 7386)."""
+
+    def _setup(self):
+        from kubernetes_tpu.client import Client, LocalTransport
+        from kubernetes_tpu.server.api import APIServer
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": "p1",
+                    "namespace": "default",
+                    "labels": {"app": "web", "tier": "fe"},
+                },
+                "spec": {"containers": [{"name": "c", "image": "v1"}]},
+            },
+            namespace="default",
+        )
+        return api, client
+
+    def test_merge_labels_and_null_delete(self):
+        api, client = self._setup()
+        out = client.patch(
+            "pods",
+            "p1",
+            {"metadata": {"labels": {"app": "web2", "tier": None, "x": "1"}}},
+            namespace="default",
+        )
+        assert out.metadata.labels == {"app": "web2", "x": "1"}
+        assert out.spec.containers[0].image == "v1"  # untouched
+
+    def test_lists_replace_not_merge(self):
+        api, client = self._setup()
+        out = client.patch(
+            "pods",
+            "p1",
+            {"spec": {"containers": [{"name": "c", "image": "v2"}]}},
+            namespace="default",
+        )
+        assert out.spec.containers[0].image == "v2"
+
+    def test_identity_fields_ignored(self):
+        api, client = self._setup()
+        out = client.patch(
+            "pods",
+            "p1",
+            {"metadata": {"name": "evil", "labels": {"y": "2"}}},
+            namespace="default",
+        )
+        assert out.metadata.name == "p1"
+        assert out.metadata.labels["y"] == "2"
+
+    def test_patch_missing_object_404(self):
+        import pytest as _pytest
+
+        from kubernetes_tpu.server.api import APIError
+
+        api, client = self._setup()
+        with _pytest.raises(APIError) as e:
+            client.patch("pods", "ghost", {"metadata": {}}, namespace="default")
+        assert e.value.code == 404
+
+    def test_patch_over_http(self):
+        import json as _json
+        import urllib.request
+
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api, client = self._setup()
+        srv = APIHTTPServer(api).start()
+        try:
+            req = urllib.request.Request(
+                srv.address + "/api/v1/namespaces/default/pods/p1",
+                method="PATCH",
+                data=_json.dumps(
+                    {"metadata": {"labels": {"patched": "yes"}}}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            out = _json.loads(urllib.request.urlopen(req).read())
+            assert out["metadata"]["labels"]["patched"] == "yes"
+        finally:
+            srv.stop()
