@@ -641,6 +641,289 @@ def cmd_api_resources(client: Client, args) -> int:
     return 0
 
 
+def _server_get_json(args, path: str) -> Dict:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{args.server}{path}", headers=getattr(args, "_auth_headers", {}) or {}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def cmd_version(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/version.go — client and server
+    versions."""
+    from kubernetes_tpu import __version__
+
+    print(f"Client Version: {__version__}")
+    if not args.server:
+        # Injected in-process transport: the "server" is this process.
+        print(f"Server Version: {__version__} (tpu)")
+        return 0
+    try:
+        info = _server_get_json(args, "/version")
+    except Exception as e:
+        print(f"error: couldn't read version from server: {e}", file=sys.stderr)
+        return 1
+    print(f"Server Version: {info.get('gitVersion', '?')} ({info.get('platform', '')})")
+    return 0
+
+
+def cmd_api_versions(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/apiversions.go."""
+    if not args.server:
+        from kubernetes_tpu.models import conversion
+
+        print("Available Server Api Versions:", ",".join(conversion.VERSIONS))
+        return 0
+    try:
+        info = _server_get_json(args, "/api")
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print("Available Server Api Versions:", ",".join(info.get("versions", [])))
+    return 0
+
+
+def cmd_cluster_info(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/clusterinfo.go — master address plus
+    any services labeled kubernetes.io/cluster-service=true."""
+    print(f"Kubernetes master is running at {args.server}")
+    services, _ = client.list(
+        "services", namespace="", label_selector="kubernetes.io/cluster-service=true"
+    )
+    for svc in services:
+        ns, name = svc.metadata.namespace, svc.metadata.name
+        print(
+            f"{name} is running at {args.server}"
+            f"/api/v1/namespaces/{ns}/services/{name}/proxy"
+        )
+    return 0
+
+
+def cmd_namespace(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/namespace.go — show or set the
+    default namespace recorded in the kubeconfig's current context."""
+    from kubernetes_tpu.client import kubeconfig as kc
+
+    path = kc.config_path(args.kubeconfig)
+    data = kc.load_raw(path)
+    current = args.context or data.get("current-context", "")
+    if not args.ns:
+        ctx = kc._by_name(data.get("contexts"), current) or {}
+        print(ctx.get("context", {}).get("namespace") or "default")
+        return 0
+    if not current:
+        print("error: no current context to set the namespace on", file=sys.stderr)
+        return 1
+    kc.set_entry(data, "contexts", current, "context", {"namespace": args.ns})
+    kc.save_raw(path, data)
+    print(f'Set default namespace to "{args.ns}" in context "{current}"')
+    return 0
+
+
+def cmd_update(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/update.go — full replace from -f, or
+    a merge patch with --patch."""
+    if bool(args.filename) == bool(args.patch):
+        raise SystemExit("error: exactly one of -f or --patch is required")
+    if args.patch:
+        if not (args.resource and args.name):
+            raise SystemExit("error: --patch requires RESOURCE NAME")
+        resource = resolve_resource(args.resource)
+        try:
+            patch = json.loads(args.patch)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"error: --patch is not valid JSON: {e}")
+        client.patch(resource, args.name, patch, namespace=args.namespace)
+        print(f"{resource}/{args.name} updated")
+        return 0
+    for wire in load_manifests(args.filename):
+        resource = resource_for_kind(wire.get("kind", ""))
+        name = wire.get("metadata", {}).get("name", "")
+        client.update(resource, wire, namespace=args.namespace)
+        print(f"{resource}/{name} updated")
+    return 0
+
+
+class _ProxyServer:
+    """`ktctl proxy` — a local HTTP relay to the apiserver carrying the
+    kubeconfig's credentials (pkg/kubectl/proxy.go, cmd/proxy.go):
+    lets credential-less local tools browse the API."""
+
+    def __init__(self, server: str, headers: Dict[str, str],
+                 host: str = "127.0.0.1", port: int = 8001,
+                 api_prefix: str = "/api"):
+        import http.server
+        import socketserver
+        import urllib.error
+        import urllib.request
+
+        upstream = server.rstrip("/")
+        prefix = api_prefix.rstrip("/") or "/api"
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):  # noqa: N802
+                pass
+
+            def _relay(self, verb: str) -> None:
+                if not (self.path.startswith(prefix + "/") or self.path == prefix
+                        or self.path.startswith(("/version", "/healthz", "/swagger"))):
+                    self.send_error(404, "not proxied")
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                req = urllib.request.Request(
+                    upstream + self.path, data=body, method=verb,
+                    headers={**headers, "Content-Type": "application/json"},
+                )
+                try:
+                    resp = urllib.request.urlopen(req, timeout=30)
+                    code, payload = resp.status, resp.read()
+                except urllib.error.HTTPError as e:
+                    code, payload = e.code, e.read()
+                except (urllib.error.URLError, OSError) as e:
+                    # Apiserver unreachable: answer 502 instead of
+                    # resetting the client's connection.
+                    code = 502
+                    payload = json.dumps(
+                        {
+                            "kind": "Status",
+                            "status": "Failure",
+                            "reason": "BadGateway",
+                            "message": f"apiserver unreachable: {e}",
+                        }
+                    ).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                self._relay("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._relay("POST")
+
+            def do_PUT(self):  # noqa: N802
+                self._relay("PUT")
+
+            def do_DELETE(self):  # noqa: N802
+                self._relay("DELETE")
+
+            def do_PATCH(self):  # noqa: N802
+                self._relay("PATCH")
+
+        class Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+
+        self.httpd = Server((host, port), Handler)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_background(self):
+        import threading
+
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def cmd_proxy(client: Client, args) -> int:
+    srv = _ProxyServer(
+        args.server,
+        getattr(args, "_auth_headers", {}) or {},
+        port=args.port,
+        api_prefix=args.api_prefix,
+    )
+    print(f"Starting to serve on 127.0.0.1:{srv.port}")
+    try:
+        srv.httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+def cmd_config(client: Client, args) -> int:
+    """Reference: pkg/kubectl/cmd/config/ — view / set-cluster /
+    set-credentials / set-context / use-context / set / unset over the
+    kubeconfig file."""
+    from kubernetes_tpu.client import kubeconfig as kc
+
+    path = kc.config_path(args.kubeconfig)
+    data = kc.load_raw(path)
+    sub = args.config_cmd
+    if sub == "view":
+        print(json.dumps(data, indent=2, sort_keys=True))
+        return 0
+    if sub == "use-context":
+        if kc._by_name(data.get("contexts"), args.cname) is None:
+            print(f'error: no context exists with the name: "{args.cname}"',
+                  file=sys.stderr)
+            return 1
+        data["current-context"] = args.cname
+        kc.save_raw(path, data)
+        print(f'Switched to context "{args.cname}"')
+        return 0
+    if sub == "set-cluster":
+        body = {}
+        if args.server_url:
+            body["server"] = args.server_url
+        kc.set_entry(data, "clusters", args.cname, "cluster", body)
+        kc.save_raw(path, data)
+        print(f'Cluster "{args.cname}" set')
+        return 0
+    if sub == "set-credentials":
+        body = {}
+        if args.username:
+            body["username"] = args.username
+        if args.password:
+            body["password"] = args.password
+        if args.token:
+            body["token"] = args.token
+        kc.set_entry(data, "users", args.cname, "user", body)
+        kc.save_raw(path, data)
+        print(f'User "{args.cname}" set')
+        return 0
+    if sub == "set-context":
+        body = {}
+        if args.cluster:
+            body["cluster"] = args.cluster
+        if args.user:
+            body["user"] = args.user
+        if args.ctx_namespace:
+            body["namespace"] = args.ctx_namespace
+        kc.set_entry(data, "contexts", args.cname, "context", body)
+        kc.save_raw(path, data)
+        print(f'Context "{args.cname}" set')
+        return 0
+    if sub in ("set", "unset"):
+        # Dotted-path property access (config/set.go navigation steps);
+        # the useful subset: top-level keys like current-context.
+        if "." in args.prop:
+            print(f"error: only top-level properties supported: {args.prop!r}",
+                  file=sys.stderr)
+            return 1
+        if sub == "set":
+            data[args.prop] = args.value
+        else:
+            data.pop(args.prop, None)
+        kc.save_raw(path, data)
+        print(f'Property "{args.prop}" {sub}')
+        return 0
+    raise SystemExit(f"unknown config subcommand {sub!r}")
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -749,11 +1032,71 @@ def build_parser() -> argparse.ArgumentParser:
 
     ar = sub.add_parser("api-resources", parents=[common])
     ar.set_defaults(fn=cmd_api_resources)
+
+    vs = sub.add_parser("version", parents=[common])
+    vs.set_defaults(fn=cmd_version)
+
+    av = sub.add_parser("api-versions", parents=[common])
+    av.set_defaults(fn=cmd_api_versions)
+
+    ci = sub.add_parser("cluster-info", parents=[common])
+    ci.set_defaults(fn=cmd_cluster_info)
+
+    nsp = sub.add_parser("namespace", parents=[common])
+    nsp.add_argument("ns", nargs="?")
+    nsp.set_defaults(fn=cmd_namespace)
+
+    up = sub.add_parser("update", parents=[common])
+    up.add_argument("resource", nargs="?")
+    up.add_argument("name", nargs="?")
+    up.add_argument("--filename", "-f", default=None)
+    up.add_argument("--patch", default=None, help="JSON merge patch")
+    up.set_defaults(fn=cmd_update)
+
+    px = sub.add_parser("proxy", parents=[common])
+    px.add_argument("--port", "-p", type=int, default=8001)
+    px.add_argument("--api-prefix", default="/api")
+    px.set_defaults(fn=cmd_proxy)
+
+    cf = sub.add_parser("config", parents=[common])
+    cfs = cf.add_subparsers(dest="config_cmd", required=True)
+    cfs.add_parser("view")
+    for name in ("set-cluster", "set-credentials", "set-context", "use-context"):
+        cp = cfs.add_parser(name)
+        cp.add_argument("cname")
+        if name == "set-cluster":
+            cp.add_argument("--server-url", "--cluster-server", dest="server_url")
+        elif name == "set-credentials":
+            cp.add_argument("--username")
+            cp.add_argument("--password")
+            cp.add_argument("--token")
+        elif name == "set-context":
+            cp.add_argument("--cluster")
+            cp.add_argument("--user")
+            cp.add_argument("--ctx-namespace", "--set-namespace",
+                            dest="ctx_namespace")
+    for name in ("set", "unset"):
+        cp = cfs.add_parser(name)
+        cp.add_argument("prop")
+        if name == "set":
+            cp.add_argument("value")
+    cf.set_defaults(fn=cmd_config, local_only=True)
+    nsp.set_defaults(local_only=True)
     return p
 
 
 def main(argv: Optional[List[str]] = None, client: Optional[Client] = None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "local_only", False):
+        # config/namespace operate on the kubeconfig file only — no
+        # server connection (and no requirement that one exists).
+        from kubernetes_tpu.client.kubeconfig import KubeconfigError
+
+        try:
+            return args.fn(client, args)
+        except (OSError, KubeconfigError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
     if client is None:
         # kubeconfig resolution (pkg/client/clientcmd): explicit flags
         # win, then the file's current-context, then local defaults.

@@ -69,6 +69,61 @@ def _by_name(entries, name: str) -> Optional[dict]:
     return None
 
 
+def config_path(path: Optional[str] = None) -> str:
+    """The file `ktctl config` subcommands read and write: explicit
+    path, $KTCONFIG/$KUBECONFIG, an existing default, else the first
+    default location (created on first write) — mirroring clientcmd's
+    ModifyConfig destination rules."""
+    if path:
+        return path
+    for var in ("KTCONFIG", "KUBECONFIG"):
+        if os.environ.get(var):
+            return os.environ[var]
+    for p in DEFAULT_PATHS:
+        if os.path.exists(p):
+            return p
+    return DEFAULT_PATHS[0]
+
+
+def load_raw(path: str) -> dict:
+    """The kubeconfig file as a plain dict (empty skeleton when the
+    file doesn't exist yet)."""
+    if not os.path.exists(path):
+        return {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "clusters": [],
+            "users": [],
+            "contexts": [],
+            "current-context": "",
+        }
+    with open(path) as f:
+        data = _parse(f.read())
+    for section in ("clusters", "users", "contexts"):
+        data.setdefault(section, [])
+    return data
+
+
+def save_raw(path: str, data: dict) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def set_entry(data: dict, section: str, name: str, body_key: str, body: dict) -> None:
+    """Create-or-merge a named clusters/users/contexts entry (clientcmd
+    set-cluster/set-credentials/set-context semantics: existing keys
+    not mentioned are kept)."""
+    entry = _by_name(data.get(section), name)
+    if entry is None:
+        entry = {"name": name, body_key: {}}
+        data.setdefault(section, []).append(entry)
+    entry.setdefault(body_key, {}).update(body)
+
+
 def load_kubeconfig(
     path: Optional[str] = None, context: Optional[str] = None
 ) -> ClientConfig:

@@ -87,6 +87,7 @@ def start_apiserver(args):
         port=args.port,
         authenticator=authenticator,
         authorizer=authorizer,
+        publish_master=True,
     ).start()
 
 

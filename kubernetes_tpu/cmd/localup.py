@@ -48,7 +48,9 @@ class LocalCluster:
         from kubernetes_tpu.server.httpserver import APIHTTPServer
 
         self.api = APIServer()
-        self.http = APIHTTPServer(self.api, host=args.address, port=args.port)
+        self.http = APIHTTPServer(
+            self.api, host=args.address, port=args.port, publish_master=True
+        )
         self.kubelets = []
         self._tmp_roots = []
         for i in range(args.nodes):

@@ -134,8 +134,12 @@ class EndpointsController:
         if not svc.spec.selector:
             return  # headless/external services manage their own endpoints
         sel = labelpkg.selector_from_set(svc.spec.selector)
-        addresses: List[EndpointAddress] = []
-        first_pod: Optional[Pod] = None
+        # Named targetPorts resolve PER POD (two pods can expose the
+        # same port name on different container ports), so addresses
+        # group by their resolved port tuple — one subset per distinct
+        # tuple, the reference's endpoints.RepackSubsets shape
+        # (endpoints_controller.go:255 + pkg/api/endpoints/util.go).
+        groups: dict = {}
         for pod in self.pods.store.list():
             if pod.metadata.namespace != svc.metadata.namespace:
                 continue
@@ -143,9 +147,11 @@ class EndpointsController:
                 continue
             if not _pod_ready(pod):
                 continue
-            if first_pod is None:
-                first_pod = pod
-            addresses.append(
+            ports = tuple(
+                (p.name, self._resolve_target_port(p, pod), p.protocol)
+                for p in svc.spec.ports
+            )
+            groups.setdefault(ports, []).append(
                 EndpointAddress(
                     ip=pod.status.pod_ip,
                     target_ref={
@@ -156,22 +162,18 @@ class EndpointsController:
                     },
                 )
             )
-        addresses.sort(key=lambda a: a.ip)
         subsets = []
-        if addresses:
-            subsets = [
+        for ports, addresses in sorted(groups.items()):
+            addresses.sort(key=lambda a: (a.ip, (a.target_ref or {}).get("uid", "")))
+            subsets.append(
                 EndpointSubset(
                     addresses=addresses,
                     ports=[
-                        EndpointPort(
-                            name=p.name,
-                            port=self._resolve_target_port(p, first_pod),
-                            protocol=p.protocol,
-                        )
-                        for p in svc.spec.ports
+                        EndpointPort(name=n, port=num, protocol=proto)
+                        for (n, num, proto) in ports
                     ],
                 )
-            ]
+            )
         ep = Endpoints()
         ep.metadata.name = svc.metadata.name
         ep.metadata.namespace = svc.metadata.namespace

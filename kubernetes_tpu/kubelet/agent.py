@@ -493,7 +493,13 @@ class Kubelet:
         old_wire = serde.to_wire(pod.status)
         pod.status.phase = phase
         pod.status.host_ip = "127.0.0.1"
-        pod.status.pod_ip = self._pod_ip(uid)
+        # Host-network runtimes (ProcessRuntime) expose containers on
+        # the host's own address, so that IS the pod IP — the reference
+        # kubelet reports the node IP for HostNetwork pods. Sandboxed
+        # fakes keep the deterministic synthetic IP.
+        pod.status.pod_ip = (
+            getattr(self.runtime, "host_network_ip", "") or self._pod_ip(uid)
+        )
         if not pod.status.start_time:
             pod.status.start_time = now_iso()
         pod.status.conditions = [
@@ -621,9 +627,11 @@ class Kubelet:
             applied[key] = (content, mirror, ns)
         except APIError as e:
             if e.code == 409:
-                # Adopt our OWN previous mirror (kubelet restart) —
-                # including pre-annotation mirrors (owner None); a
-                # same-named pod from ANOTHER source stays theirs.
+                # Adopt our OWN previous mirror (kubelet restart).
+                # Anything else — another source's mirror, or an
+                # annotation-less user pod that happens to collide with
+                # the mirror name — stays theirs: adopting it would let
+                # a later manifest edit DELETE a pod we never created.
                 try:
                     existing = self.client.get("pods", mirror, namespace=ns)
                     owner = (existing.metadata.annotations or {}).get(
@@ -631,7 +639,7 @@ class Kubelet:
                     )
                 except APIError:
                     return
-                if owner in (source, None):
+                if owner == source:
                     applied[key] = (content, mirror, ns)
 
     def _remove_static(self, applied: Dict[str, tuple], key: str) -> None:

@@ -88,6 +88,11 @@ class _Proc:
 class ProcessRuntime(ContainerRuntime):
     """Real-process runtime rooted at `root_dir` (logs + pod records)."""
 
+    # Containers run with host networking: servers they start listen on
+    # the host's loopback, so the kubelet reports that as the pod IP
+    # (reference HostNetwork semantics).
+    host_network_ip = "127.0.0.1"
+
     def __init__(self, root_dir: str, node_name: str = ""):
         self.root = root_dir
         self.node_name = node_name
@@ -254,6 +259,22 @@ class ProcessRuntime(ContainerRuntime):
             env[e.name] = e.value
         return env
 
+    @staticmethod
+    def _run_as(spec) -> Dict[str, int]:
+        """SecurityContext -> Popen credential kwargs (the reference's
+        securitycontext provider maps the same field onto the docker
+        HostConfig User, pkg/securitycontext/provider.go). Privileged
+        and capabilities have no process-level analog here; the
+        SecurityContextDeny admission plugin polices them upstream."""
+        ctx = getattr(spec, "security_context", None)
+        if ctx is None or ctx.run_as_user is None:
+            return {}
+        return {
+            "user": int(ctx.run_as_user),
+            "group": int(ctx.run_as_user),
+            "extra_groups": [],
+        }
+
     def _start_container(
         self, pod: Pod, uid: str, spec, restart_count: int
     ) -> _Proc:
@@ -269,6 +290,7 @@ class ProcessRuntime(ContainerRuntime):
                     env=self._env_for(pod, spec),
                     cwd=spec.working_dir or None,
                     start_new_session=True,
+                    **self._run_as(spec),
                 )
             except OSError as e:
                 # Start failure = immediately-exited container (the

@@ -419,6 +419,10 @@ class ServiceAccountAdmission(Interface):
     def handles(self, operation: str) -> bool:
         return operation == CREATE
 
+    # Where every container sees its API credential (the reference's
+    # DefaultAPITokenMountPath, plugin/pkg/admission/serviceaccount).
+    TOKEN_MOUNT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount"
+
     def admit(self, attrs: Attributes) -> None:
         if attrs.resource != "pods" or attrs.obj is None:
             return
@@ -435,6 +439,59 @@ class ServiceAccountAdmission(Interface):
                     f"service account {attrs.namespace}/{spec['serviceAccount']} "
                     "does not exist"
                 )
+        self._mount_api_token(attrs.namespace, spec)
+
+    def _mount_api_token(self, namespace: str, spec: dict) -> None:
+        """Mount the account's token Secret (minted by the Token
+        controller) into every container at the well-known path —
+        reference admission.go mountServiceAccountToken. Soft-fails
+        when the account or its token doesn't exist yet: the plugin
+        must not block pods during controller warm-up."""
+        from kubernetes_tpu.server.api import APIError
+
+        try:
+            sa = self.api.get("serviceaccounts", namespace, spec["serviceAccount"])
+        except APIError:
+            return
+        token_secret = None
+        for ref in sa.get("secrets") or []:
+            name = ref.get("name", "")
+            try:
+                sec = self.api.get("secrets", namespace, name)
+            except APIError:
+                continue
+            if sec.get("type") == "kubernetes.io/service-account-token":
+                token_secret = name
+                break
+        if token_secret is None:
+            return
+        volumes = spec.setdefault("volumes", [])
+        vol_name = next(
+            (
+                v["name"]
+                for v in volumes
+                if (v.get("secret") or {}).get("secretName") == token_secret
+            ),
+            None,
+        )
+        if vol_name is None:
+            vol_name = token_secret
+            if any(v.get("name") == vol_name for v in volumes):
+                vol_name = f"{token_secret}-sa"
+            volumes.append(
+                {"name": vol_name, "secret": {"secretName": token_secret}}
+            )
+        for c in spec.get("containers") or []:
+            mounts = c.setdefault("volumeMounts", [])
+            if any(m.get("mountPath") == self.TOKEN_MOUNT_PATH for m in mounts):
+                continue
+            mounts.append(
+                {
+                    "name": vol_name,
+                    "mountPath": self.TOKEN_MOUNT_PATH,
+                    "readOnly": True,
+                }
+            )
 
 
 class SecurityContextDeny(Interface):

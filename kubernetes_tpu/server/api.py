@@ -31,6 +31,13 @@ from kubernetes_tpu.models import labels as labelpkg
 from kubernetes_tpu.models import serde
 from kubernetes_tpu.models.objects import now_iso, new_uid
 from kubernetes_tpu.models.validation import ValidationError
+from kubernetes_tpu.server.allocators import (
+    AllocationError,
+    IPAllocator,
+    PortAllocator,
+    service_ips_in_use,
+    service_node_ports_in_use,
+)
 from kubernetes_tpu.server.registry import RESOURCES, ResourceInfo, fields_for
 from kubernetes_tpu.store import (
     AlreadyExistsError,
@@ -147,7 +154,13 @@ class _FilteredStream:
 class APIServer:
     """The master: storage-backed REST resources (pkg/master/master.go)."""
 
-    def __init__(self, store: Optional[KVStore] = None, admission=None):
+    def __init__(
+        self,
+        store: Optional[KVStore] = None,
+        admission=None,
+        service_cidr: str = "10.0.0.0/24",
+        node_port_range: Tuple[int, int] = (30000, 32767),
+    ):
         self.store = store or KVStore()
         # Reentrant: admission plugins may issue writes of their own
         # (NamespaceAutoprovision creates the namespace mid-admission).
@@ -160,6 +173,17 @@ class APIServer:
         # Live component health checks (componentstatuses probes on
         # read; pkg/registry/componentstatus/rest.go).
         self._component_checks: Dict[str, object] = {}
+        # Service allocation pools (pkg/master/master.go:440-455) with
+        # the reference's restart repair pass: rebuild the bitmaps from
+        # whatever services the (possibly pre-existing) store holds
+        # (ipallocator/controller/repair.go).
+        self.service_ips = IPAllocator(service_cidr)
+        self.service_node_ports = PortAllocator(*node_port_range)
+        stored_services, _ = self.store.list("/registry/services/")
+        for ip in service_ips_in_use(stored_services):
+            self.service_ips.mark(ip)
+        for port in service_node_ports_in_use(stored_services):
+            self.service_node_ports.mark(port)
         # Ensure the default namespace exists (reference auto-creates).
         try:
             self.store.create(
@@ -226,11 +250,16 @@ class APIServer:
         with self._write_guard():
             self._admit("CREATE", info, ns, meta["name"], obj)
             self._validate(info, obj)
+            release = (
+                self._allocate_service(obj) if info.name == "services" else None
+            )
             try:
                 out = self.store.create(
                     info.key(ns, meta["name"]), obj, ttl=info.ttl
                 )
             except AlreadyExistsError:
+                if release:
+                    release()
                 raise _conflict(f'{info.name} "{meta["name"]}" already exists')
             self._commit("CREATE", info, ns, meta["name"], obj)
             return out
@@ -297,6 +326,173 @@ class APIServer:
 
     def _ns(self, info: ResourceInfo, namespace: str) -> str:
         return (namespace or "default") if info.namespaced else ""
+
+    # -- service allocation (pkg/registry/service/rest.go:68-131) ------
+
+    def _allocate_service(self, obj: dict):
+        """Fill spec.clusterIP / spec.ports[].nodePort from the pools.
+        Returns a rollback closure releasing everything granted, for
+        the store-create-failed path (rest.go's releaseServiceIP defer
+        + portallocator operation)."""
+        spec = obj.setdefault("spec", {})
+        granted_ip: Optional[str] = None
+        granted_ports: List[int] = []
+
+        def rollback():
+            if granted_ip:
+                self.service_ips.release(granted_ip)
+            for p in granted_ports:
+                self.service_node_ports.release(p)
+
+        try:
+            ip = spec.get("clusterIP") or ""
+            if not ip:
+                spec["clusterIP"] = granted_ip = self.service_ips.allocate_next()
+            elif ip != "None":
+                self.service_ips.allocate(ip)
+                granted_ip = ip
+            assign = spec.get("type") in ("NodePort", "LoadBalancer")
+            for port in spec.get("ports") or []:
+                requested = port.get("nodePort") or 0
+                if requested:
+                    self.service_node_ports.allocate(requested)
+                    granted_ports.append(requested)
+                elif assign:
+                    port["nodePort"] = self.service_node_ports.allocate_next()
+                    granted_ports.append(port["nodePort"])
+        except AllocationError as e:
+            rollback()
+            raise _invalid(f"spec.clusterIP/nodePort: {e}")
+        return rollback
+
+    @staticmethod
+    def _carry_node_ports(cur_spec: dict, new_spec: dict) -> None:
+        """Fill missing nodePort fields on an updated/patched spec from
+        the current object, matching ports by name (or by port number
+        when unnamed) — the reference's update path carries the
+        existing allocation over rather than churning the externally
+        advertised port on every full replace."""
+        by_key = {}
+        for p in cur_spec.get("ports") or []:
+            if p.get("nodePort"):
+                by_key[p.get("name") or ("#", p.get("port"))] = p["nodePort"]
+        claimed = {
+            p.get("nodePort") for p in new_spec.get("ports") or [] if p.get("nodePort")
+        }
+        for p in new_spec.get("ports") or []:
+            if p.get("nodePort"):
+                continue
+            prev = by_key.get(p.get("name") or ("#", p.get("port")))
+            if prev and prev not in claimed:
+                p["nodePort"] = prev
+                claimed.add(prev)
+
+    def _update_service_allocations(self, current: dict, obj: dict):
+        """Update-path allocation semantics: clusterIP is immutable
+        (carried over when omitted, rejected when changed — reference
+        validation.ValidateServiceUpdate); existing node ports carry
+        over, newly requested ones allocate, dropped ones release only
+        after the write commits. Returns (rollback, commit) closures."""
+        cur_spec = current.get("spec") or {}
+        spec = obj.setdefault("spec", {})
+        cur_ip = cur_spec.get("clusterIP") or ""
+        new_ip = spec.get("clusterIP") or ""
+        if not new_ip and cur_ip:
+            spec["clusterIP"] = cur_ip
+        elif cur_ip and new_ip != cur_ip:
+            raise _invalid("spec.clusterIP: field is immutable")
+        cur_ports = {
+            p.get("nodePort") for p in cur_spec.get("ports") or [] if p.get("nodePort")
+        }
+        self._carry_node_ports(cur_spec, spec)
+        granted: List[int] = []
+        assign = spec.get("type") in ("NodePort", "LoadBalancer")
+        try:
+            new_ports = set()
+            for port in spec.get("ports") or []:
+                requested = port.get("nodePort") or 0
+                if not requested and assign:
+                    port["nodePort"] = requested = (
+                        self.service_node_ports.allocate_next()
+                    )
+                    granted.append(requested)
+                elif requested and requested not in cur_ports:
+                    self.service_node_ports.allocate(requested)
+                    granted.append(requested)
+                if requested:
+                    new_ports.add(requested)
+        except AllocationError as e:
+            for p in granted:
+                self.service_node_ports.release(p)
+            raise _invalid(f"spec.ports.nodePort: {e}")
+
+        def rollback():
+            for p in granted:
+                self.service_node_ports.release(p)
+
+        def commit():
+            for p in cur_ports - new_ports:
+                self.service_node_ports.release(p)
+
+        return rollback, commit
+
+    def publish_master_service(self, host: str, port: int) -> dict:
+        """Publish the 'kubernetes' service + endpoints addressing this
+        master (pkg/master/publish.go). Selector-less, so the endpoints
+        controller leaves the manually-set endpoints alone; reconciled
+        on every (re)start so a moved master updates its address."""
+        try:
+            svc = self.get("services", "default", "kubernetes")
+            if (svc.get("spec") or {}).get("ports") != [
+                {"name": "http", "port": port, "protocol": "TCP"}
+            ]:
+                # Master restarted on a different port over a persisted
+                # store: the advertised service port must follow.
+                svc["spec"]["ports"] = [
+                    {"name": "http", "port": port, "protocol": "TCP"}
+                ]
+                svc = self.update("services", "default", "kubernetes", svc)
+        except APIError:
+            svc = self.create(
+                "services",
+                "default",
+                {
+                    "kind": "Service",
+                    "apiVersion": "v1",
+                    "metadata": {"name": "kubernetes", "namespace": "default"},
+                    "spec": {
+                        "ports": [{"name": "http", "port": port, "protocol": "TCP"}],
+                        "sessionAffinity": "None",
+                    },
+                },
+            )
+        endpoints = {
+            "kind": "Endpoints",
+            "apiVersion": "v1",
+            "metadata": {"name": "kubernetes", "namespace": "default"},
+            "subsets": [
+                {
+                    "addresses": [{"ip": host}],
+                    "ports": [{"name": "http", "port": port, "protocol": "TCP"}],
+                }
+            ],
+        }
+        try:
+            self.update("endpoints", "default", "kubernetes", endpoints)
+        except APIError as e:
+            if e.code != 404:
+                raise
+            self.create("endpoints", "default", endpoints)
+        return svc
+
+    def _release_service(self, obj: dict) -> None:
+        spec = obj.get("spec") or {}
+        ip = spec.get("clusterIP") or ""
+        if ip and ip != "None":
+            self.service_ips.release(ip)
+        for port in spec.get("ports") or []:
+            if port.get("nodePort"):
+                self.service_node_ports.release(port["nodePort"])
 
     # -- component statuses (live health probes) ----------------------
 
@@ -411,12 +607,21 @@ class APIServer:
         with self._write_guard():
             self._admit("UPDATE", info, namespace, name, obj)
             self._validate(info, obj)
+            rollback = commit = None
+            if info.name == "services":
+                rollback, commit = self._update_service_allocations(current, obj)
             try:
                 out = self.store.set(key, obj, expected_version=expected)
             except ConflictError as e:
+                if rollback:
+                    rollback()
                 raise _conflict(str(e))
             except NotFoundError:
+                if rollback:
+                    rollback()
                 raise _not_found(info.name, name)
+            if commit:
+                commit()
             self._commit("UPDATE", info, namespace, name, obj)
             return out
 
@@ -509,8 +714,45 @@ class APIServer:
             for forbidden in ("name", "namespace", "resourceVersion", "uid"):
                 meta_patch.pop(forbidden, None)
 
+        pre: List[Optional[dict]] = [None]
+
         def apply(cur: dict) -> dict:
+            pre[0] = _copy.deepcopy(cur)
             merged = _json_merge(cur, patch)
+            if info.name == "services":
+                # PATCH must not be a side door around the allocator
+                # invariants create/update enforce: clusterIP stays
+                # immutable; existing nodePorts carry over when the
+                # patch replaces spec.ports; a patched-in nodePort must
+                # be in range and free; a NodePort service port cannot
+                # be left without one.
+                cur_spec, new_spec = cur.get("spec") or {}, merged.get("spec") or {}
+                cur_ip = cur_spec.get("clusterIP") or ""
+                new_ip = new_spec.get("clusterIP") or ""
+                if cur_ip and new_ip != cur_ip:
+                    raise _invalid("spec.clusterIP: field is immutable")
+                self._carry_node_ports(cur_spec, new_spec)
+                held = {
+                    p.get("nodePort")
+                    for p in cur_spec.get("ports") or []
+                    if p.get("nodePort")
+                }
+                assign = new_spec.get("type") in ("NodePort", "LoadBalancer")
+                lo, hi = self.service_node_ports.lo, self.service_node_ports.hi
+                for p in new_spec.get("ports") or []:
+                    np = p.get("nodePort") or 0
+                    if np and not (lo <= np <= hi):
+                        raise _invalid(
+                            f"spec.ports.nodePort: port {np} is not in the "
+                            f"node port range {lo}-{hi}"
+                        )
+                    if np and np not in held and self.service_node_ports.is_allocated(np):
+                        raise _invalid(f"spec.ports.nodePort: port {np} is already allocated")
+                    if not np and assign:
+                        raise _invalid(
+                            "spec.ports.nodePort: a NodePort service port "
+                            "needs an explicit nodePort when patched"
+                        )
             self._admit("UPDATE", info, ns, name, merged)
             self._validate(info, merged)
             return merged
@@ -521,8 +763,67 @@ class APIServer:
                 out = self.store.guaranteed_update(key, apply)
             except NotFoundError:
                 raise _not_found(info.name, name)
+            if info.name == "services":
+                # Reconcile the port pool with what actually committed.
+                def _ports(o):
+                    return {
+                        p.get("nodePort")
+                        for p in (o.get("spec") or {}).get("ports") or []
+                        if p.get("nodePort")
+                    }
+
+                old_ports, new_ports = _ports(pre[0] or {}), _ports(out)
+                for p in new_ports - old_ports:
+                    self.service_node_ports.mark(p)
+                for p in old_ports - new_ports:
+                    self.service_node_ports.release(p)
             self._commit("UPDATE", info, ns, name, out)
         return out
+
+    def service_location(
+        self, namespace: str, name: str, port_hint: str = ""
+    ) -> Tuple[str, int]:
+        """Pick a backend (ip, port) for a service — the routing half
+        of the services proxy subresource (reference:
+        pkg/registry/service/rest.go ResourceLocation: resolve the
+        service's endpoints, pick a random one). `port_hint` from the
+        'name:port' form selects by endpoint port name (or number);
+        empty takes the first port."""
+        try:
+            eps = self.get("endpoints", namespace, name)
+        except APIError as e:
+            if e.code != 404:
+                raise
+            # Distinguish "service doesn't exist" (404) from "exists
+            # but has no endpoints yet" (503).
+            self.get("services", namespace, name)
+            eps = {}
+        candidates: List[Tuple[str, int]] = []
+        for subset in eps.get("subsets") or []:
+            ports = subset.get("ports") or []
+            chosen = None
+            if not port_hint:
+                chosen = ports[0]["port"] if ports else None
+            elif port_hint.isdigit():
+                if any(p.get("port") == int(port_hint) for p in ports):
+                    chosen = int(port_hint)
+            else:
+                for p in ports:
+                    if p.get("name") == port_hint:
+                        chosen = p["port"]
+                        break
+            if chosen is None:
+                continue
+            for addr in subset.get("addresses") or []:
+                if addr.get("ip"):
+                    candidates.append((addr["ip"], chosen))
+        if not candidates:
+            raise APIError(
+                503,
+                "ServiceUnavailable",
+                f"no endpoints available for service {name!r}",
+            )
+        return candidates[self._rand.randrange(len(candidates))]
 
     def kubelet_location(self, namespace: str, name: str) -> Tuple[str, dict]:
         """Resolve the kubelet API base URL serving a pod — the routing
@@ -641,9 +942,11 @@ class APIServer:
         with self._write_guard():
             self._admit("DELETE", info, self._ns(info, namespace), name, None)
             try:
-                self.store.delete(info.key(self._ns(info, namespace), name))
+                deleted = self.store.delete(info.key(self._ns(info, namespace), name))
             except NotFoundError:
                 raise _not_found(info.name, name)
+            if info.name == "services":
+                self._release_service(deleted)
             self._commit("DELETE", info, self._ns(info, namespace), name, None)
         return {
             "kind": "Status",

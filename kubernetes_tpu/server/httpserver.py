@@ -187,6 +187,25 @@ class _Handler(BaseHTTPRequestHandler):
             if parts == ("version",):
                 self._send_json(200, {"gitVersion": __version__, "platform": "tpu"})
                 return
+            if parts == ("validate",):
+                # Component validation report (pkg/apiserver/validator.go):
+                # probe every registered component; 500 when any fails.
+                statuses = self.api.list("componentstatuses")["items"]
+                report = []
+                all_healthy = bool(statuses)
+                for cs in statuses:
+                    cond = (cs.get("conditions") or [{}])[0]
+                    healthy = cond.get("status") == "True"
+                    all_healthy = all_healthy and healthy
+                    report.append(
+                        {
+                            "component": cs["metadata"]["name"],
+                            "health": "ok" if healthy else "unhealthy",
+                            "msg": cond.get("message", ""),
+                        }
+                    )
+                self._send_json(200 if all_healthy else 500, {"validate": report})
+                return
             if parts == ("api",):
                 self._send_json(
                     200,
@@ -410,6 +429,23 @@ class _Handler(BaseHTTPRequestHandler):
                     int(port_s) if port_s.isdigit() else 0,
                     rest[5:],
                 )
+            if (
+                len(rest) >= 5
+                and rest[4] == "proxy"
+                and resource == "services"
+                and verb in ("GET", "POST")
+            ):
+                # Services proxy subresource (pkg/registry/service/
+                # rest.go ResourceLocation + pkg/apiserver/proxy.go):
+                # relay to a randomly-chosen ready endpoint. Name may
+                # carry ":port" selecting an endpoint port by name or
+                # number.
+                svc_name, _, port_s = name.partition(":")
+                self.api.connect(resource, ns, svc_name, "proxy")
+                ip, port = self.api.service_location(ns, svc_name, port_s)
+                url = f"http://{ip}:{port}/" + "/".join(rest[5:])
+                code = self._relay_http(url, verb, "service proxy")
+                return "services/proxy", code
             if len(rest) == 5 and rest[4] in ("exec", "attach", "run") and verb == "POST":
                 # CONNECT subresources (pkg/apiserver/api_installer.go
                 # CONNECT routes). Admission (DenyExecOnPrivileged) runs
@@ -621,10 +657,10 @@ class _Handler(BaseHTTPRequestHandler):
                 200, api.update(resource, ns, name, self._read_body(self._kind_of(resource)))
             )
         elif verb == "PATCH":
-            # JSON merge patch (resthandler.go:446). The body is a
-            # partial document, not a full object — no kind hint.
+            # JSON merge patch (resthandler.go:446). The kind hint lets
+            # a kind-less partial v1beta3 body still version-convert.
             self._send_json(
-                200, api.patch(resource, ns, name, self._read_body())
+                200, api.patch(resource, ns, name, self._read_body(self._kind_of(resource)))
             )
         elif verb == "DELETE":
             self._send_json(200, api.delete(resource, ns, name))
@@ -770,7 +806,13 @@ class APIHTTPServer:
         port: int = 0,
         authenticator=None,
         authorizer=None,
+        publish_master: bool = False,
     ):
+        # publish_master: create/reconcile the "kubernetes" service +
+        # endpoints on start (pkg/master/publish.go). Off by default so
+        # unit fixtures see only the objects they create; the daemon
+        # launchers turn it on.
+        self._publish_master = publish_master
         handler = type(
             "BoundHandler",
             (_Handler,),
@@ -791,6 +833,23 @@ class APIHTTPServer:
             target=self.httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
         )
         self._thread.start()
+        if self._publish_master:
+            host, port = self.httpd.server_address[:2]
+            if host in ("0.0.0.0", "::", ""):
+                # A wildcard bind is not a routable endpoint address;
+                # publish a real interface IP (the reference resolves a
+                # public address the same way before publishing).
+                import socket as _socket
+
+                try:
+                    with _socket.socket(
+                        _socket.AF_INET, _socket.SOCK_DGRAM
+                    ) as probe:
+                        probe.connect(("10.255.255.255", 1))
+                        host = probe.getsockname()[0]
+                except OSError:
+                    host = "127.0.0.1"
+            self.api.publish_master_service(host, port)
         return self
 
     def stop(self) -> None:

@@ -198,6 +198,51 @@ class TestServiceAccountAndSecurityContext:
         created = api.create("pods", "default", json.loads(json.dumps(POD)))
         assert created["spec"]["serviceAccount"] == "default"
 
+    def test_api_token_mounted(self):
+        """The account's token Secret is mounted into every container
+        at the well-known path (plugin/pkg/admission/serviceaccount
+        mountServiceAccountToken)."""
+        api = make_api("ServiceAccount")
+        api.create(
+            "secrets",
+            "default",
+            {
+                "kind": "Secret",
+                "metadata": {"name": "default-token"},
+                "type": "kubernetes.io/service-account-token",
+                "data": {"token": "eyJ..."},
+            },
+        )
+        api.create(
+            "serviceaccounts",
+            "default",
+            {
+                "kind": "ServiceAccount",
+                "metadata": {"name": "default"},
+                "secrets": [{"kind": "Secret", "name": "default-token"}],
+            },
+        )
+        created = api.create("pods", "default", json.loads(json.dumps(POD)))
+        vols = created["spec"]["volumes"]
+        assert any(
+            (v.get("secret") or {}).get("secretName") == "default-token"
+            for v in vols
+        )
+        mounts = created["spec"]["containers"][0]["volumeMounts"]
+        sa_mount = next(
+            m
+            for m in mounts
+            if m["mountPath"] == "/var/run/secrets/kubernetes.io/serviceaccount"
+        )
+        assert sa_mount["readOnly"] is True
+
+    def test_no_token_secret_is_soft(self):
+        """No SA / no token secret yet: pod admits untouched (the
+        plugin must not block during controller warm-up)."""
+        api = make_api("ServiceAccount")
+        created = api.create("pods", "default", json.loads(json.dumps(POD)))
+        assert not created["spec"].get("volumes")
+
     def test_privileged_denied(self):
         api = make_api("SecurityContextDeny")
         pod = json.loads(json.dumps(POD))

@@ -1,0 +1,385 @@
+"""Service cluster-IP / node-port allocation at the apiserver.
+
+Reference semantics: pkg/registry/service/rest.go:68-131 (allocate at
+create, respect explicit requests, release on delete), validation's
+clusterIP immutability on update, and the restart repair pass
+(pkg/registry/service/ipallocator/controller/repair.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.server import APIError, APIServer
+from kubernetes_tpu.server.allocators import (
+    AllocationError,
+    IPAllocator,
+    PortAllocator,
+)
+from kubernetes_tpu.store import KVStore
+
+
+def svc_wire(name, cluster_ip=None, svc_type=None, ports=None, ns="default"):
+    spec = {"selector": {"app": name}, "ports": ports or [{"port": 80}]}
+    if cluster_ip is not None:
+        spec["clusterIP"] = cluster_ip
+    if svc_type is not None:
+        spec["type"] = svc_type
+    return {
+        "kind": "Service",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+class TestIPAllocatorUnit:
+    def test_next_excludes_network_and_broadcast(self):
+        alloc = IPAllocator("192.168.1.0/30")  # usable: .1, .2
+        assert alloc.allocate_next() == "192.168.1.1"
+        assert alloc.allocate_next() == "192.168.1.2"
+        with pytest.raises(AllocationError):
+            alloc.allocate_next()
+
+    def test_explicit_and_release(self):
+        alloc = IPAllocator("10.1.0.0/24")
+        alloc.allocate("10.1.0.7")
+        with pytest.raises(AllocationError):
+            alloc.allocate("10.1.0.7")
+        alloc.release("10.1.0.7")
+        alloc.allocate("10.1.0.7")
+
+    def test_out_of_range_rejected(self):
+        alloc = IPAllocator("10.1.0.0/24")
+        with pytest.raises(AllocationError):
+            alloc.allocate("10.2.0.7")
+        with pytest.raises(AllocationError):
+            alloc.allocate("not-an-ip")
+
+    def test_port_range(self):
+        alloc = PortAllocator(30000, 30001)
+        assert alloc.allocate_next() == 30000
+        assert alloc.allocate_next() == 30001
+        with pytest.raises(AllocationError):
+            alloc.allocate_next()
+        alloc.release(30000)
+        assert alloc.allocate_next() == 30000
+        with pytest.raises(AllocationError):
+            alloc.allocate(29999)
+
+
+class TestServiceCreate:
+    def test_auto_assigns_distinct_cluster_ips(self):
+        api = APIServer()
+        a = api.create("services", "default", svc_wire("a"))
+        b = api.create("services", "default", svc_wire("b"))
+        ips = {a["spec"]["clusterIP"], b["spec"]["clusterIP"]}
+        assert len(ips) == 2
+        assert all(ip.startswith("10.0.0.") for ip in ips)
+
+    def test_explicit_ip_respected_and_conflicts(self):
+        api = APIServer()
+        a = api.create("services", "default", svc_wire("a", cluster_ip="10.0.0.42"))
+        assert a["spec"]["clusterIP"] == "10.0.0.42"
+        with pytest.raises(APIError) as e:
+            api.create("services", "default", svc_wire("b", cluster_ip="10.0.0.42"))
+        assert e.value.code == 422
+
+    def test_out_of_range_ip_invalid(self):
+        api = APIServer()
+        with pytest.raises(APIError) as e:
+            api.create("services", "default", svc_wire("a", cluster_ip="172.16.0.1"))
+        assert e.value.code == 422
+
+    def test_headless_skips_allocation(self):
+        api = APIServer()
+        a = api.create("services", "default", svc_wire("a", cluster_ip="None"))
+        assert a["spec"]["clusterIP"] == "None"
+        # Pool untouched: first auto-assign still gets the first IP.
+        b = api.create("services", "default", svc_wire("b"))
+        assert b["spec"]["clusterIP"] == "10.0.0.1"
+
+    def test_delete_releases_ip(self):
+        api = APIServer()
+        api.create("services", "default", svc_wire("a", cluster_ip="10.0.0.42"))
+        api.delete("services", "default", "a")
+        b = api.create("services", "default", svc_wire("b", cluster_ip="10.0.0.42"))
+        assert b["spec"]["clusterIP"] == "10.0.0.42"
+
+    def test_duplicate_name_rolls_back_allocation(self):
+        api = APIServer()
+        api.create("services", "default", svc_wire("a"))
+        before = api.service_ips.free
+        with pytest.raises(APIError):
+            api.create("services", "default", svc_wire("a"))
+        assert api.service_ips.free == before
+
+    def test_node_ports_assigned_for_nodeport_type(self):
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"port": 80}, {"port": 443}]),
+        )
+        nps = [p["nodePort"] for p in svc["spec"]["ports"]]
+        assert all(30000 <= p <= 32767 for p in nps)
+        assert len(set(nps)) == 2
+
+    def test_explicit_node_port_conflict(self):
+        api = APIServer()
+        api.create(
+            "services",
+            "default",
+            svc_wire(
+                "a", svc_type="NodePort", ports=[{"port": 80, "nodePort": 30080}]
+            ),
+        )
+        with pytest.raises(APIError) as e:
+            api.create(
+                "services",
+                "default",
+                svc_wire(
+                    "b", svc_type="NodePort", ports=[{"port": 80, "nodePort": 30080}]
+                ),
+            )
+        assert e.value.code == 422
+
+    def test_clusterip_type_does_not_get_node_ports(self):
+        api = APIServer()
+        svc = api.create("services", "default", svc_wire("a"))
+        assert not any(p.get("nodePort") for p in svc["spec"]["ports"])
+
+
+class TestServiceUpdate:
+    def test_cluster_ip_immutable(self):
+        api = APIServer()
+        svc = api.create("services", "default", svc_wire("a"))
+        svc["spec"]["clusterIP"] = "10.0.0.99"
+        with pytest.raises(APIError) as e:
+            api.update("services", "default", "a", svc)
+        assert e.value.code == 422
+
+    def test_omitted_cluster_ip_carries_over(self):
+        api = APIServer()
+        svc = api.create("services", "default", svc_wire("a"))
+        ip = svc["spec"]["clusterIP"]
+        svc["spec"].pop("clusterIP")
+        out = api.update("services", "default", "a", svc)
+        assert out["spec"]["clusterIP"] == ip
+
+    def test_update_without_node_port_carries_allocation_over(self):
+        """Re-applying the original manifest (no nodePort field) must
+        keep the externally advertised port, not churn it."""
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"port": 80}]),
+        )
+        np = svc["spec"]["ports"][0]["nodePort"]
+        again = svc_wire("a", svc_type="NodePort", ports=[{"port": 80}])
+        out = api.update("services", "default", "a", again)
+        assert out["spec"]["ports"][0]["nodePort"] == np
+
+    def test_update_carries_by_port_name(self):
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire(
+                "a",
+                svc_type="NodePort",
+                ports=[{"name": "web", "port": 80}, {"name": "tls", "port": 443}],
+            ),
+        )
+        by_name = {p["name"]: p["nodePort"] for p in svc["spec"]["ports"]}
+        # Reordered, still no explicit nodePorts: each keeps its own.
+        again = svc_wire(
+            "a",
+            svc_type="NodePort",
+            ports=[{"name": "tls", "port": 443}, {"name": "web", "port": 80}],
+        )
+        out = api.update("services", "default", "a", again)
+        got = {p["name"]: p["nodePort"] for p in out["spec"]["ports"]}
+        assert got == by_name
+
+    def test_node_port_diff_allocates_and_releases(self):
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire(
+                "a", svc_type="NodePort", ports=[{"port": 80, "nodePort": 30080}]
+            ),
+        )
+        # Swap the node port: 30080 released, 30090 allocated.
+        svc["spec"]["ports"] = [{"port": 80, "nodePort": 30090}]
+        api.update("services", "default", "a", svc)
+        api.create(
+            "services",
+            "default",
+            svc_wire(
+                "b", svc_type="NodePort", ports=[{"port": 80, "nodePort": 30080}]
+            ),
+        )
+        with pytest.raises(APIError):
+            api.create(
+                "services",
+                "default",
+                svc_wire(
+                    "c", svc_type="NodePort", ports=[{"port": 80, "nodePort": 30090}]
+                ),
+            )
+
+
+class TestServicePatch:
+    """PATCH must honor the same allocator invariants as update
+    (it is not a side door around immutability or the port pool)."""
+
+    def test_patch_cluster_ip_rejected(self):
+        api = APIServer()
+        api.create("services", "default", svc_wire("a"))
+        with pytest.raises(APIError) as e:
+            api.patch(
+                "services", "default", "a", {"spec": {"clusterIP": "10.0.0.99"}}
+            )
+        assert e.value.code == 422
+
+    def test_patch_conflicting_node_port_rejected(self):
+        api = APIServer()
+        api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"port": 80, "nodePort": 30080}]),
+        )
+        api.create("services", "default", svc_wire("b"))
+        with pytest.raises(APIError) as e:
+            api.patch(
+                "services",
+                "default",
+                "b",
+                {"spec": {"type": "NodePort",
+                          "ports": [{"port": 80, "nodePort": 30080}]}},
+            )
+        assert e.value.code == 422
+
+    def test_patch_out_of_range_node_port_rejected(self):
+        api = APIServer()
+        api.create("services", "default", svc_wire("a"))
+        with pytest.raises(APIError) as e:
+            api.patch(
+                "services",
+                "default",
+                "a",
+                {"spec": {"type": "NodePort",
+                          "ports": [{"port": 80, "nodePort": 80}]}},
+            )
+        assert e.value.code == 422
+
+    def test_patch_replacing_ports_carries_node_port(self):
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"name": "web", "port": 80}]),
+        )
+        np = svc["spec"]["ports"][0]["nodePort"]
+        out = api.patch(
+            "services",
+            "default",
+            "a",
+            {"spec": {"ports": [{"name": "web", "port": 8080}]}},
+        )
+        assert out["spec"]["ports"][0]["nodePort"] == np
+
+    def test_patch_cannot_strand_nodeport_service_portless(self):
+        api = APIServer()
+        api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"name": "web", "port": 80}]),
+        )
+        with pytest.raises(APIError) as e:
+            api.patch(
+                "services",
+                "default",
+                "a",
+                {"spec": {"ports": [{"name": "other", "port": 9090}]}},
+            )
+        assert e.value.code == 422
+
+    def test_patched_in_node_port_is_tracked(self):
+        api = APIServer()
+        api.create("services", "default", svc_wire("a"))
+        api.patch(
+            "services",
+            "default",
+            "a",
+            {"spec": {"type": "NodePort",
+                      "ports": [{"port": 80, "nodePort": 30099}]}},
+        )
+        with pytest.raises(APIError):
+            api.create(
+                "services",
+                "default",
+                svc_wire("c", svc_type="NodePort",
+                         ports=[{"port": 80, "nodePort": 30099}]),
+            )
+
+
+class TestMasterService:
+    def test_publish_creates_service_and_endpoints(self):
+        api = APIServer()
+        svc = api.publish_master_service("127.0.0.1", 6443)
+        assert svc["spec"]["clusterIP"].startswith("10.0.0.")
+        assert not svc["spec"].get("selector")
+        eps = api.get("endpoints", "default", "kubernetes")
+        assert eps["subsets"][0]["addresses"][0]["ip"] == "127.0.0.1"
+        assert eps["subsets"][0]["ports"][0]["port"] == 6443
+
+    def test_publish_is_idempotent_and_reconciles(self):
+        api = APIServer()
+        api.publish_master_service("127.0.0.1", 6443)
+        api.publish_master_service("10.9.9.9", 7443)  # master moved
+        eps = api.get("endpoints", "default", "kubernetes")
+        assert eps["subsets"][0]["addresses"][0]["ip"] == "10.9.9.9"
+        assert len(api.list("services", "default")["items"]) == 1
+        # The advertised service port follows the master, not just the
+        # endpoints.
+        svc = api.get("services", "default", "kubernetes")
+        assert svc["spec"]["ports"][0]["port"] == 7443
+
+    def test_http_server_publishes_when_enabled(self):
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api = APIServer()
+        srv = APIHTTPServer(api, publish_master=True).start()
+        try:
+            svc = api.get("services", "default", "kubernetes")
+            port = int(srv.address.rsplit(":", 1)[1])
+            assert svc["spec"]["ports"][0]["port"] == port
+        finally:
+            srv.stop()
+
+
+class TestRepair:
+    def test_restart_rebuilds_pools_from_store(self):
+        store = KVStore()
+        api = APIServer(store=store)
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"port": 80}]),
+        )
+        ip = svc["spec"]["clusterIP"]
+        np = svc["spec"]["ports"][0]["nodePort"]
+        # New apiserver over the same store: pools must reflect "a".
+        api2 = APIServer(store=store)
+        with pytest.raises(APIError):
+            api2.create("services", "default", svc_wire("b", cluster_ip=ip))
+        with pytest.raises(APIError):
+            api2.create(
+                "services",
+                "default",
+                svc_wire(
+                    "c", svc_type="NodePort", ports=[{"port": 80, "nodePort": np}]
+                ),
+            )

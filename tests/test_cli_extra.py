@@ -1,0 +1,209 @@
+"""ktctl parity-tier commands added after the operational tier:
+version, api-versions, cluster-info, namespace, update, proxy, config.
+
+Reference: pkg/kubectl/cmd/{version,apiversions,clusterinfo,namespace,
+update,proxy}.go and pkg/kubectl/cmd/config/.
+"""
+
+import io
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.cli.ktctl import main
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.client.kubeconfig import load_kubeconfig
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+def run_main(*argv, client=None, expect=0):
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = main(list(argv), client=client)
+    finally:
+        sys.stdout = old
+    assert rc == expect, out.getvalue()
+    return out.getvalue()
+
+
+@pytest.fixture
+def http_env():
+    api = APIServer()
+    srv = APIHTTPServer(api).start()
+    client = Client(HTTPTransport(srv.address))
+    yield api, srv, client
+    srv.stop()
+
+
+class TestConfigCommands:
+    def test_build_and_use_config(self, tmp_path):
+        cfg = str(tmp_path / "config")
+        run_main("config", "--kubeconfig", cfg, "set-cluster", "prod",
+                 "--server-url", "http://10.1.2.3:8080")
+        run_main("config", "--kubeconfig", cfg, "set-credentials", "alice",
+                 "--token", "sekrit")
+        run_main("config", "--kubeconfig", cfg, "set-context", "prod-ctx",
+                 "--cluster", "prod", "--user", "alice",
+                 "--ctx-namespace", "team1")
+        run_main("config", "--kubeconfig", cfg, "use-context", "prod-ctx")
+        resolved = load_kubeconfig(cfg)
+        assert resolved.server == "http://10.1.2.3:8080"
+        assert resolved.token == "sekrit"
+        assert resolved.namespace == "team1"
+        assert resolved.context == "prod-ctx"
+
+    def test_use_context_unknown_fails(self, tmp_path):
+        cfg = str(tmp_path / "config")
+        out = io.StringIO()
+        old = sys.stderr
+        sys.stderr = out
+        try:
+            rc = main(["config", "--kubeconfig", cfg, "use-context", "nope"])
+        finally:
+            sys.stderr = old
+        assert rc == 1
+        assert "no context exists" in out.getvalue()
+
+    def test_view_and_set_unset(self, tmp_path):
+        cfg = str(tmp_path / "config")
+        run_main("config", "--kubeconfig", cfg, "set", "current-context", "x")
+        view = run_main("config", "--kubeconfig", cfg, "view")
+        assert json.loads(view)["current-context"] == "x"
+        run_main("config", "--kubeconfig", cfg, "unset", "current-context")
+        view = run_main("config", "--kubeconfig", cfg, "view")
+        assert "current-context" not in json.loads(view)
+
+    def test_set_cluster_merges(self, tmp_path):
+        cfg = str(tmp_path / "config")
+        run_main("config", "--kubeconfig", cfg, "set-cluster", "prod",
+                 "--server-url", "http://a:1")
+        run_main("config", "--kubeconfig", cfg, "set-cluster", "prod",
+                 "--server-url", "http://b:2")
+        view = json.loads(run_main("config", "--kubeconfig", cfg, "view"))
+        assert len(view["clusters"]) == 1
+        assert view["clusters"][0]["cluster"]["server"] == "http://b:2"
+
+
+class TestNamespaceCommand:
+    def test_get_and_set(self, tmp_path):
+        cfg = str(tmp_path / "config")
+        run_main("config", "--kubeconfig", cfg, "set-context", "ctx", "--cluster", "c")
+        run_main("config", "--kubeconfig", cfg, "use-context", "ctx")
+        out = run_main("namespace", "--kubeconfig", cfg)
+        assert out.strip() == "default"
+        run_main("namespace", "--kubeconfig", cfg, "team2")
+        out = run_main("namespace", "--kubeconfig", cfg)
+        assert out.strip() == "team2"
+        assert load_kubeconfig(cfg).namespace == "team2"
+
+
+class TestUpdateCommand:
+    RC = {
+        "kind": "ReplicationController",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {
+            "replicas": 2,
+            "selector": {"app": "web"},
+            "template": {
+                "metadata": {"labels": {"app": "web"}},
+                "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+            },
+        },
+    }
+
+    def test_replace_from_file(self, tmp_path):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create("replicationcontrollers", self.RC, namespace="default")
+        changed = json.loads(json.dumps(self.RC))
+        changed["spec"]["replicas"] = 5
+        f = tmp_path / "rc.json"
+        f.write_text(json.dumps(changed))
+        out = run_main("update", "-f", str(f), client=client)
+        assert "updated" in out
+        got = client.get("replicationcontrollers", "web", namespace="default")
+        assert got.spec.replicas == 5
+
+    def test_merge_patch(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create("replicationcontrollers", self.RC, namespace="default")
+        run_main(
+            "update", "rc", "web", "--patch",
+            json.dumps({"spec": {"replicas": 7}}), client=client,
+        )
+        got = client.get("replicationcontrollers", "web", namespace="default")
+        assert got.spec.replicas == 7
+
+    def test_requires_exactly_one_mode(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        with pytest.raises(SystemExit):
+            main(["update", "rc", "web"], client=client)
+
+
+class TestServerInfoCommands:
+    def test_version(self, http_env):
+        api, srv, client = http_env
+        out = run_main("version", "--server", srv.address, client=client)
+        assert "Client Version:" in out and "Server Version:" in out
+
+    def test_api_versions(self, http_env):
+        api, srv, client = http_env
+        out = run_main("api-versions", "--server", srv.address, client=client)
+        assert "v1" in out
+
+    def test_cluster_info(self, http_env):
+        api, srv, client = http_env
+        api.create(
+            "services",
+            "default",
+            {
+                "kind": "Service",
+                "metadata": {
+                    "name": "dns",
+                    "labels": {"kubernetes.io/cluster-service": "true"},
+                },
+                "spec": {"selector": {"k": "v"}, "ports": [{"port": 53}]},
+            },
+        )
+        out = run_main("cluster-info", "--server", srv.address, client=client)
+        assert f"Kubernetes master is running at {srv.address}" in out
+        assert "dns is running at" in out
+
+
+class TestProxyCommand:
+    def test_relays_api_requests_with_credentials(self, http_env):
+        from kubernetes_tpu.cli.ktctl import _ProxyServer
+
+        api, srv, client = http_env
+        api.create(
+            "pods",
+            "default",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "p1"},
+                "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+            },
+        )
+        proxy = _ProxyServer(srv.address, {}, port=0).serve_background()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{proxy.port}/api/v1/namespaces/default/pods/p1",
+                timeout=5,
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["metadata"]["name"] == "p1"
+            # Non-API paths are refused.
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{proxy.port}/etc/passwd", timeout=5
+                )
+            assert e.value.code == 404
+        finally:
+            proxy.stop()

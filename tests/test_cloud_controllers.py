@@ -50,7 +50,6 @@ def lb_service_wire(name, svc_type="LoadBalancer"):
         "spec": {
             "selector": {"app": name},
             "ports": [{"name": "http", "port": 80}],
-            "clusterIP": "10.0.0.50",
             "type": svc_type,
         },
     }

@@ -130,6 +130,35 @@ def test_podtemplate_and_componentstatus(api):
     assert got["conditions"][0]["status"] == "True"
 
 
+def test_validate_endpoint(api):
+    """GET /validate probes every registered component and reports
+    per-component health, 500 when any is down (pkg/apiserver/
+    validator.go)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+    api.register_component("scheduler", lambda: (True, "ok"))
+    api.register_component("controller-manager", lambda: (False, "dead"))
+    srv = APIHTTPServer(api).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{srv.address}/validate", timeout=5)
+        assert e.value.code == 500
+        report = json.load(e.value)["validate"]
+        byname = {r["component"]: r for r in report}
+        assert byname["scheduler"]["health"] == "ok"
+        assert byname["controller-manager"]["health"] == "unhealthy"
+
+        api.register_component("controller-manager", lambda: (True, "ok"))
+        with urllib.request.urlopen(f"{srv.address}/validate", timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        srv.stop()
+
+
 def test_watch_new_resources(api):
     stream = api.watch("resourcequotas", "default")
     api.create(

@@ -48,6 +48,35 @@ def runtime(tmp_path):
         rt.kill_pod(uid)
 
 
+class TestSecurityContext:
+    @pytest.mark.skipif(os.geteuid() != 0, reason="needs root to setuid")
+    def test_run_as_user_drops_privileges(self, runtime):
+        from kubernetes_tpu.models.objects import SecurityContext
+
+        pod = mk_pod(
+            "sec",
+            None,
+            containers=[
+                Container(
+                    name="main",
+                    image="app",
+                    command=["/bin/sh", "-c", "id -u; id -g"],
+                    security_context=SecurityContext(run_as_user=65534),
+                )
+            ],
+        )
+        runtime.sync_pod(pod)
+        assert wait_for(lambda: "65534" in runtime.read_logs("sec", "main"))
+        lines = runtime.read_logs("sec", "main").split()
+        assert lines[:2] == ["65534", "65534"]
+
+    def test_no_security_context_inherits_kubelet_user(self, runtime):
+        pod = mk_pod("plain", ["/bin/sh", "-c", "id -u"])
+        runtime.sync_pod(pod)
+        assert wait_for(lambda: runtime.read_logs("plain", "main").strip())
+        assert runtime.read_logs("plain", "main").strip() == str(os.geteuid())
+
+
 class TestProcessRuntime:
     def test_pod_runs_real_processes_with_anchor(self, runtime):
         pod = mk_pod("web", ["/bin/sh", "-c", "sleep 30"])

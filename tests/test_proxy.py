@@ -297,7 +297,7 @@ class TestProxyServer:
         client = Client(LocalTransport(api))
         server = ProxyServer(client).start()
         try:
-            svc = _service("web", "10.1.0.1", 80)
+            svc = _service("web", "10.0.0.201", 80)
             client.create("services", serde.to_wire(svc))
             eps = _endpoints(
                 "web",
@@ -307,7 +307,7 @@ class TestProxyServer:
             deadline = time.monotonic() + 5
             target = None
             while time.monotonic() < deadline:
-                target = server.resolve_portal("10.1.0.1", 80)
+                target = server.resolve_portal("10.0.0.201", 80)
                 if target and server.lb.endpoints_for(("default", "web", "")):
                     break
                 time.sleep(0.05)
@@ -318,9 +318,9 @@ class TestProxyServer:
             client.delete("services", "web", namespace="default")
             deadline = time.monotonic() + 5
             while time.monotonic() < deadline:
-                if server.resolve_portal("10.1.0.1", 80) is None:
+                if server.resolve_portal("10.0.0.201", 80) is None:
                     break
                 time.sleep(0.05)
-            assert server.resolve_portal("10.1.0.1", 80) is None
+            assert server.resolve_portal("10.0.0.201", 80) is None
         finally:
             server.stop()

@@ -1,0 +1,135 @@
+"""Services proxy subresource through the apiserver.
+
+Reference: pkg/registry/service/rest.go ResourceLocation (random ready
+endpoint, ':port' selects by endpoint port name) + pkg/apiserver/
+proxy.go relays. Completes the proxy/redirect trio (pods, nodes,
+services) — the URLs `ktctl cluster-info` prints are exactly these.
+"""
+
+import http.server
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.server import APIError, APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+@pytest.fixture
+def backend():
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({"path": self.path, "who": "backend"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture
+def cluster(backend):
+    api = APIServer()
+    srv = APIHTTPServer(api).start()
+    ip, port = backend
+    api.create(
+        "services",
+        "default",
+        {
+            "kind": "Service",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"}, "ports": [{"name": "http", "port": 80}]},
+        },
+    )
+    api.create(
+        "endpoints",
+        "default",
+        {
+            "kind": "Endpoints",
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [
+                {
+                    "addresses": [{"ip": ip}],
+                    "ports": [{"name": "http", "port": port}],
+                }
+            ],
+        },
+    )
+    yield api, srv, port
+    srv.stop()
+
+
+class TestServiceProxy:
+    def test_relays_to_endpoint(self, cluster):
+        api, srv, port = cluster
+        url = f"{srv.address}/api/v1/namespaces/default/services/web/proxy/some/path"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["who"] == "backend"
+        assert body["path"] == "/some/path"
+
+    def test_named_port_selector(self, cluster):
+        api, srv, port = cluster
+        url = f"{srv.address}/api/v1/namespaces/default/services/web:http/proxy/"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert json.loads(resp.read())["who"] == "backend"
+        # Unknown port name -> no candidates -> 503.
+        bad = f"{srv.address}/api/v1/namespaces/default/services/web:nope/proxy/"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=5)
+        assert e.value.code == 503
+
+    def test_no_endpoints_503(self, cluster):
+        api, srv, port = cluster
+        api.create(
+            "services",
+            "default",
+            {
+                "kind": "Service",
+                "metadata": {"name": "lonely", "namespace": "default"},
+                "spec": {"selector": {"app": "x"}, "ports": [{"port": 80}]},
+            },
+        )
+        url = f"{srv.address}/api/v1/namespaces/default/services/lonely/proxy/"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url, timeout=5)
+        assert e.value.code == 503
+
+    def test_location_is_random_across_endpoints(self):
+        api = APIServer()
+        api.create(
+            "services",
+            "default",
+            {
+                "kind": "Service",
+                "metadata": {"name": "multi", "namespace": "default"},
+                "spec": {"selector": {"app": "m"}, "ports": [{"port": 80}]},
+            },
+        )
+        api.create(
+            "endpoints",
+            "default",
+            {
+                "kind": "Endpoints",
+                "metadata": {"name": "multi", "namespace": "default"},
+                "subsets": [
+                    {
+                        "addresses": [{"ip": "10.5.0.1"}, {"ip": "10.5.0.2"}],
+                        "ports": [{"port": 9000}],
+                    }
+                ],
+            },
+        )
+        picks = {api.service_location("default", "multi")[0] for _ in range(50)}
+        assert picks == {"10.5.0.1", "10.5.0.2"}
