@@ -320,17 +320,15 @@ def main() -> None:
 
     # Wave-vs-scan comparison (VERDICT r1 #6): the batched wave solver
     # against the sequential-parity scan on the same device problem.
-    from kubernetes_tpu.ops.wave import solve_waves
+    from kubernetes_tpu.ops.wave import wave_assignments
 
     pods, nodes, services = _synthetic_objects(n_pods, n_nodes, seed=2)
     snap = build_snapshot(pods, nodes, services=services)
     d = device_snapshot(snap)
-    out, waves = solve_waves(d.pods, d.nodes)
-    np.asarray(out)  # warm
+    wave_assignments(d)  # warm
     gc.collect()
     t0 = time.perf_counter()
-    out, waves = solve_waves(d.pods, d.nodes)
-    wave_assign = np.asarray(out)[: d.n_pods]
+    wave_assign, waves = wave_assignments(d)
     t_wave = time.perf_counter() - t0
     t0 = time.perf_counter()
     np.asarray(solve(d.pods, d.nodes))
@@ -345,6 +343,46 @@ def main() -> None:
         "wave_placed": wave_placed,
     }
 
+    # Sinkhorn-matched mode (the north star's "Hungarian/Sinkhorn"
+    # framing): congestion-priced waves; published next to the plain
+    # wave so the step-count and balance win is measurable.
+    from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments
+
+    sinkhorn_assignments(d)  # warm
+    gc.collect()
+    t0 = time.perf_counter()
+    sk_assign, sk_waves = sinkhorn_assignments(d)
+    t_sk = time.perf_counter() - t0
+    sk_placed = int((sk_assign >= 0).sum())
+    per_node = np.bincount(
+        sk_assign[sk_assign >= 0], minlength=d.n_nodes
+    )[: d.n_nodes]
+    wave_per_node = np.bincount(
+        wave_assign[wave_assign >= 0].astype(int), minlength=d.n_nodes
+    )[: d.n_nodes]
+    wave_stats.update(
+        {
+            "sinkhorn_solve_s": round(t_sk, 3),
+            "sinkhorn_waves": int(sk_waves),
+            "sinkhorn_placed": sk_placed,
+            "sinkhorn_load_stddev": round(float(per_node.std()), 2),
+            "wave_load_stddev": round(float(wave_per_node.std()), 2),
+        }
+    )
+
+    # BASELINE configs 1-3 (100x10, 1k x 100, 10k x 1k): the small and
+    # mid configurations through the same full pipeline — published so
+    # every BASELINE row has a measured number, not just the headline.
+    small_walls = {}
+    for cp, cn in ((100, 10), (1000, 100), (10000, 1000)):
+        pods_s, nodes_s, svcs_s = _synthetic_objects(cp, cn, seed=7)
+        solve_backlog_pipelined(pods_s, nodes_s, services=svcs_s)  # warm
+        pods_s, nodes_s, svcs_s = _synthetic_objects(cp, cn, seed=8)
+        gc.collect()
+        t0 = time.perf_counter()
+        solve_backlog_pipelined(pods_s, nodes_s, services=svcs_s)
+        small_walls[f"{cp}x{cn}"] = round(time.perf_counter() - t0, 4)
+
     parity = _parity_figures()
     best = min(times)
     pods_per_sec = n_pods / best
@@ -357,6 +395,7 @@ def main() -> None:
         "phases_serial_s": phases,
         "placed": placed,
     }
+    record["config_walls_s"] = small_walls
     record.update(wave_stats)
     record.update(parity)
     print(json.dumps(record))

@@ -1,5 +1,7 @@
-"""Cluster addons (reference: cluster/addons/ — DNS, monitoring, ...)."""
+"""Cluster addons (reference: cluster/addons/ — DNS, logging,
+monitoring)."""
 
 from kubernetes_tpu.addons.dns import ClusterDNS
+from kubernetes_tpu.addons.logging import ClusterLogAggregator
 
-__all__ = ["ClusterDNS"]
+__all__ = ["ClusterDNS", "ClusterLogAggregator"]

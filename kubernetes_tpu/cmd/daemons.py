@@ -26,6 +26,108 @@ def _server_flag(p: argparse.ArgumentParser) -> None:
     )
 
 
+class HealthServer:
+    """Per-daemon /healthz + /metrics listener (reference: every
+    daemon mounts healthz and prometheus handlers on its own port —
+    scheduler plugin/cmd/kube-scheduler/app/server.go:105-109,
+    controller-manager :10252, proxy --healthz-port 10249). `checks`
+    are callables returning (ok, msg); /healthz is 200 only when all
+    pass."""
+
+    def __init__(self, port: int, checks=None, host: str = "127.0.0.1"):
+        import http.server
+
+        from kubernetes_tpu.utils import metrics as metricspkg
+
+        checks = checks or []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):  # noqa: N802
+                pass
+
+            def _send(self, code, payload, ctype="text/plain"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    problems = []
+                    for check in checks:
+                        try:
+                            ok, msg = check()
+                        except Exception as e:
+                            ok, msg = False, f"{type(e).__name__}: {e}"
+                        if not ok:
+                            problems.append(msg)
+                    if problems:
+                        self._send(500, ("; ".join(problems)).encode())
+                    else:
+                        self._send(200, b"ok")
+                elif self.path == "/metrics":
+                    payload = metricspkg.DEFAULT.render()
+                    if isinstance(payload, str):
+                        payload = payload.encode()
+                    self._send(200, payload, "text/plain; version=0.0.4")
+                else:
+                    self._send(404, b"not found")
+
+        import http.server as hs
+
+        self.httpd = hs.ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True,
+        )
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "HealthServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _loop_alive_check(daemon):
+    """Healthy while the daemon's loop thread is alive (the HA standby
+    wrapper has no loop thread of its own — report ok)."""
+
+    def check():
+        t = getattr(daemon, "_thread", None)
+        if t is None:
+            return True, "ok"
+        return t.is_alive(), "ok" if t.is_alive() else "loop not running"
+
+    return check
+
+
+def _start_health(args, checks) -> Optional[HealthServer]:
+    """Bind the daemon's healthz port if enabled (<0 disables). Bind
+    failure is non-fatal — a daemon must not die because its health
+    port is taken."""
+    port = getattr(args, "healthz_port", -1)
+    if port is None or port < 0:
+        return None
+    try:
+        srv = HealthServer(port, checks).start()
+    except OSError as e:
+        import sys
+
+        print(f"warning: healthz port {port} unavailable: {e}", file=sys.stderr)
+        return None
+    print(f"healthz serving on 127.0.0.1:{srv.port}")
+    return srv
+
+
 def _wait_forever() -> None:
     stop = threading.Event()
 
@@ -118,8 +220,10 @@ def scheduler_parser() -> argparse.ArgumentParser:
         help="TPU batch mode: solve pending backlogs on-device",
     )
     p.add_argument(
-        "--batch-mode", default="scan", choices=["scan", "wave"],
-        help="scan = sequential-parity solver; wave = wave-commit "
+        "--batch-mode", default="scan", choices=["scan", "wave", "sinkhorn"],
+        help="scan = sequential-parity solver; sinkhorn = congestion-"
+             "priced assignment waves (fastest, approximate parity); "
+             "wave = wave-commit "
         "solver (~3x throughput, approximate decision-order parity)",
     )
     p.add_argument(
@@ -129,8 +233,18 @@ def scheduler_parser() -> argparse.ArgumentParser:
         "plane then never touches the accelerator, and sidecar failure "
         "falls back to the scalar path",
     )
+    _healthz_flag(p, 10251)
     _leader_flags(p)
     return p
+
+
+def _healthz_flag(p: argparse.ArgumentParser, default: int) -> None:
+    p.add_argument(
+        "--healthz-port", type=int, default=default,
+        help="own /healthz + /metrics port (reference per-daemon "
+        "defaults: scheduler 10251, controller-manager 10252, proxy "
+        "10249); negative disables",
+    )
 
 
 def start_scheduler(args, client=None):
@@ -169,11 +283,14 @@ def start_scheduler(args, client=None):
 def scheduler_main(argv: Optional[List[str]] = None) -> int:
     args = scheduler_parser().parse_args(argv)
     daemon = start_scheduler(args)
+    health = _start_health(args, [_loop_alive_check(daemon)])
     print(f"scheduler running against {args.server}")
     try:
         _wait_forever()
     finally:
         daemon.stop()
+        if health:
+            health.stop()
     return 0
 
 
@@ -189,6 +306,7 @@ def controller_manager_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--node-grace-period", type=float, default=40.0)
     p.add_argument("--node-eviction-timeout", type=float, default=20.0)
+    _healthz_flag(p, 10252)
     _leader_flags(p)
     return p
 
@@ -236,14 +354,32 @@ def start_controller_manager(args, client=None):
     return _maybe_ha(args, client, "kube-controller-manager", factory)
 
 
+def _manager_health_check(mgr):
+    def check():
+        if not hasattr(mgr, "controllers"):
+            # HA hot-standby wrapper (no controllers of its own while
+            # standby; the live manager is inside it when leading).
+            return True, "ok"
+        running = getattr(mgr, "running", True)
+        n = len(mgr.controllers or [])
+        if not running:
+            return False, "controller manager stopped"
+        return n > 0, f"{n} controllers running" if n else "no controllers"
+
+    return check
+
+
 def controller_manager_main(argv: Optional[List[str]] = None) -> int:
     args = controller_manager_parser().parse_args(argv)
     mgr = start_controller_manager(args)
+    health = _start_health(args, [_manager_health_check(mgr)])
     print(f"controller-manager running against {args.server}")
     try:
         _wait_forever()
     finally:
         mgr.stop()
+        if health:
+            health.stop()
     return 0
 
 
@@ -318,6 +454,7 @@ def proxy_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu-proxy")
     _server_flag(p)
     p.add_argument("--bind-address", default="127.0.0.1")
+    _healthz_flag(p, 10249)
     return p
 
 
@@ -331,9 +468,12 @@ def start_proxy(args, client=None):
 def proxy_main(argv: Optional[List[str]] = None) -> int:
     args = proxy_parser().parse_args(argv)
     proxy = start_proxy(args)
+    health = _start_health(args, [lambda: (True, "ok")])
     print(f"proxy running against {args.server}")
     try:
         _wait_forever()
     finally:
         proxy.stop()
+        if health:
+            health.stop()
     return 0

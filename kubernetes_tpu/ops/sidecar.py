@@ -252,6 +252,10 @@ def serve(sock_path: str) -> None:
                 dsnap = device_snapshot(snap)
                 if req.get("mode") == "wave":
                     assignment, _waves = wave_assignments(dsnap)
+                elif req.get("mode") == "sinkhorn":
+                    from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments
+
+                    assignment, _waves = sinkhorn_assignments(dsnap)
                 else:
                     assignment = solve_assignments(dsnap)
                 _send_msg(conn, {"assignment": assignment.tolist()})

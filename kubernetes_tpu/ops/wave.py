@@ -45,15 +45,21 @@ from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, _feasible, _scores
 UNDECIDED = -2  # assignment sentinel: not yet finalized
 
 
-def wave_assignments(dsnap, **kw):
-    """Run the wave solver and strip padding (the one authority for the
-    padding/sentinel convention, mirroring solver.solve_assignments):
-    returns (i32[n_pods] with -1 = unschedulable, wave count)."""
+def strip_assignments(dsnap, out):
+    """THE authority for the padding/sentinel convention: slice off
+    padding pods, fold padded-node indices to -1. Every windowed-solver
+    wrapper (wave, sinkhorn) and bench must come through here."""
     import numpy as np
 
-    out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
     a = np.asarray(out)[: dsnap.n_pods]
-    return np.where(a >= dsnap.n_nodes, -1, a), int(waves)
+    return np.where(a >= dsnap.n_nodes, -1, a)
+
+
+def wave_assignments(dsnap, **kw):
+    """Run the wave solver and strip padding: returns (i32[n_pods]
+    with -1 = unschedulable, wave count)."""
+    out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
+    return strip_assignments(dsnap, out), int(waves)
 
 FMAX = jnp.float32(3.4e38)
 
@@ -194,18 +200,43 @@ def _commit_wave(
     return new
 
 
-@functools.partial(
-    jax.jit, static_argnames=("weights", "window", "per_node_limit")
-)
-def solve_waves(
+def _tie_hash(idx: jnp.ndarray, N: int) -> jnp.ndarray:
+    """u16 pod x node hash for randomized tie-breaks (the reference
+    also randomizes: generic_scheduler.go:90-102 picks
+    random.Int() % len(ties)). The scan uses lowest-index for oracle
+    parity; a wave MUST scatter ties or every pod in the window piles
+    onto the same few low-index nodes and per-wave throughput
+    collapses (measured: 14 pods/wave with lowest-index, ~window with
+    hashed ties on a 5k-node cluster)."""
+    return (
+        (idx[:, None].astype(jnp.uint32) * jnp.uint32(2654435761))
+        ^ (jnp.arange(N, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
+    ) & jnp.uint32(0xFFFF)
+
+
+def _argmax_choose(masked, idx, valid, carry, N):
+    """Plain wave choice: per-pod argmax with hashed tie-break packed
+    into the low bits (scores are small ints, so << 16 is lossless)."""
+    h = _tie_hash(idx, N)
+    combined = (masked << 16) | h.astype(jnp.int32)
+    return jnp.argmax(combined, axis=1).astype(jnp.int32)
+
+
+def run_windowed(
     pods: Dict[str, jnp.ndarray],
     nodes: Dict[str, jnp.ndarray],
-    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
-    window: int = 4096,
-    per_node_limit: int = 1,
+    weights: Tuple[int, int, int],
+    window: int,
+    per_node_limit: int,
+    choose,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(assignment i32[P] with -1 = unschedulable, wave count). Every
-    wave finalizes at least one pod, so the loop terminates."""
+    """The shared windowed-commit loop (trace-time function — callers
+    jit it). `choose(masked, idx, valid, carry, N) -> i32[W]` picks
+    each window pod's candidate node; everything else — windowing,
+    capacity-aware packing, bulk commit, finalization — is common to
+    every wave-family solver (plain argmax, Sinkhorn-priced, ...), so
+    invariants live exactly once. Every wave finalizes at least one
+    pod, so the loop terminates."""
     P = pods["cpu"].shape[0]
     N = nodes["cpu_cap"].shape[0]
     W = min(window, P)
@@ -226,19 +257,7 @@ def solve_waves(
         wpods = _window_rows(pods, idx)
         feas, score = _batched_eval(wpods, carry, weights, N)
         masked = jnp.where(feas, score, -1)
-        # Randomized tie-break (the reference also randomizes:
-        # generic_scheduler.go:90-102 picks random.Int() % len(ties)).
-        # The scan uses lowest-index for oracle parity; a wave MUST
-        # scatter ties or every pod in the window piles onto the same
-        # few low-index nodes and per-wave throughput collapses
-        # (measured: 14 pods/wave with lowest-index, ~window with
-        # hashed ties on a 5k-node cluster).
-        h = (
-            (idx[:, None].astype(jnp.uint32) * jnp.uint32(2654435761))
-            ^ (jnp.arange(N, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
-        ) & jnp.uint32(0xFFFF)
-        combined = (masked << 16) | h.astype(jnp.int32)
-        best = jnp.argmax(combined, axis=1).astype(jnp.int32)
+        best = choose(masked, idx, valid, carry, N)
         feasible = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] >= 0
         choice = jnp.where(valid & feasible, best, -1)
 
@@ -280,3 +299,19 @@ def solve_waves(
     # sentinel must never leak to callers.
     assignment = jnp.where(assignment == UNDECIDED, -1, assignment)
     return assignment, waves
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights", "window", "per_node_limit")
+)
+def solve_waves(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+    window: int = 4096,
+    per_node_limit: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(assignment i32[P] with -1 = unschedulable, wave count)."""
+    return run_windowed(
+        pods, nodes, weights, window, per_node_limit, _argmax_choose
+    )

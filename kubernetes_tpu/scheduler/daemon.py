@@ -355,8 +355,10 @@ class BatchScheduler(Scheduler):
         self.batch_window = batch_window
         # "scan" = sequential-parity solver (the >=99%-parity default);
         # "wave" = wave-commit solver (~3x throughput, valid placements,
-        # approximate decision-order parity — ops/wave.py).
-        if mode not in ("scan", "wave"):
+        # approximate decision-order parity — ops/wave.py);
+        # "sinkhorn" = Sinkhorn-matched waves (congestion-priced
+        # assignment, fewest device steps — ops/sinkhorn.py).
+        if mode not in ("scan", "wave", "sinkhorn"):
             raise ValueError(f"unknown batch mode {mode!r}")
         self.mode = mode
         # Optional process isolation: solve through a solver sidecar
@@ -393,6 +395,7 @@ class BatchScheduler(Scheduler):
         """One drain+solve+commit cycle; returns pods processed."""
         from kubernetes_tpu.scheduler.batch import (
             schedule_backlog_scalar,
+            schedule_backlog_sinkhorn,
             schedule_backlog_tpu,
             schedule_backlog_wave,
         )
@@ -415,6 +418,8 @@ class BatchScheduler(Scheduler):
                 )
         elif self.mode == "wave":
             solver = schedule_backlog_wave
+        elif self.mode == "sinkhorn":
+            solver = schedule_backlog_sinkhorn
         else:
             solver = schedule_backlog_tpu
         try:

@@ -404,9 +404,13 @@ class APIServer:
         cur_ports = {
             p.get("nodePort") for p in cur_spec.get("ports") or [] if p.get("nodePort")
         }
-        self._carry_node_ports(cur_spec, spec)
         granted: List[int] = []
         assign = spec.get("type") in ("NodePort", "LoadBalancer")
+        if assign:
+            # Only a type that still wants node ports carries them over;
+            # NodePort -> ClusterIP must shed its ports (commit()
+            # releases them) instead of pinning them forever.
+            self._carry_node_ports(cur_spec, spec)
         try:
             new_ports = set()
             for port in spec.get("ports") or []:
@@ -731,13 +735,14 @@ class APIServer:
                 new_ip = new_spec.get("clusterIP") or ""
                 if cur_ip and new_ip != cur_ip:
                     raise _invalid("spec.clusterIP: field is immutable")
-                self._carry_node_ports(cur_spec, new_spec)
+                assign = new_spec.get("type") in ("NodePort", "LoadBalancer")
+                if assign:
+                    self._carry_node_ports(cur_spec, new_spec)
                 held = {
                     p.get("nodePort")
                     for p in cur_spec.get("ports") or []
                     if p.get("nodePort")
                 }
-                assign = new_spec.get("type") in ("NodePort", "LoadBalancer")
                 lo, hi = self.service_node_ports.lo, self.service_node_ports.hi
                 for p in new_spec.get("ports") or []:
                     np = p.get("nodePort") or 0

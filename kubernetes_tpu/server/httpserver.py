@@ -44,6 +44,33 @@ _LATENCY = metrics.DEFAULT.summary(
 )
 
 
+#: Subresource suffixes whose requests are long-running by design —
+#: exempt from the latency SLO exactly like the reference's ignored
+#: verbs/resources (test/e2e/util.go:1286-1301 skips WATCHLIST/PROXY).
+_LONG_RUNNING = ("watch", "proxy", "portforward", "exec", "run", "log")
+
+
+def high_latency_requests(threshold: float = 1.0, summary=None):
+    """The HighLatencyRequests SLO gate (reference: test/e2e/
+    util.go:1286 scrapes apiserver request-latency summaries and fails
+    e2e when p99 exceeds the roadmap's 1 s bar, docs/roadmap.md:69).
+    Returns [(verb, resource, p99_seconds)] violations. `summary`
+    defaults to the live apiserver latency series; tests pass their
+    own so suites sharing the process-global registry can't pollute
+    each other's gates."""
+    summary = summary if summary is not None else _LATENCY
+    with summary._lock:
+        keys = list(summary._stats.keys())
+    out = []
+    for verb, resource in keys:
+        if resource.rsplit("/", 1)[-1] in _LONG_RUNNING:
+            continue
+        p99 = summary.quantile(0.99, verb=verb, resource=resource)
+        if p99 == p99 and p99 > threshold:  # NaN-safe
+            out.append((verb, resource, p99))
+    return sorted(out)
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubernetes-tpu-apiserver"
@@ -639,7 +666,12 @@ class _Handler(BaseHTTPRequestHandler):
         if verb == "GET":
             if self.query.get("watch") in ("true", "1"):
                 self._serve_watch(resource, ns, lsel, fsel, self.query)
-                return resource, 200
+                # Distinct metrics label: a watch holds its connection
+                # for its whole lifetime — folding that duration into
+                # the plain-GET latency series would wreck the p99 SLO
+                # signal (the reference uses verb WATCHLIST the same
+                # way, pkg/apiserver/metrics.go).
+                return resource + "/watch", 200
             self._send_json(200, api.list(resource, ns, lsel, fsel))
             return resource, 200
         if verb == "POST":

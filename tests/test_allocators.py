@@ -201,6 +201,30 @@ class TestServiceUpdate:
         got = {p["name"]: p["nodePort"] for p in out["spec"]["ports"]}
         assert got == by_name
 
+    def test_type_change_to_clusterip_sheds_node_ports(self):
+        """NodePort -> ClusterIP releases the port back to the pool and
+        the stored service carries no nodePort."""
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"port": 80}]),
+        )
+        np = svc["spec"]["ports"][0]["nodePort"]
+        out = api.update(
+            "services",
+            "default",
+            "a",
+            svc_wire("a", svc_type="ClusterIP", ports=[{"port": 80}]),
+        )
+        assert not out["spec"]["ports"][0].get("nodePort")
+        # Pool released: another service can take the exact port.
+        api.create(
+            "services",
+            "default",
+            svc_wire("b", svc_type="NodePort", ports=[{"port": 80, "nodePort": np}]),
+        )
+
     def test_node_port_diff_allocates_and_releases(self):
         api = APIServer()
         svc = api.create(

@@ -353,3 +353,25 @@ class TestPatch:
             assert out["metadata"]["labels"]["patched"] == "yes"
         finally:
             srv.stop()
+
+
+class TestHighLatencyGate:
+    def test_detects_and_exempts(self):
+        """HighLatencyRequests analog (test/e2e/util.go:1286): slow
+        plain verbs are reported; long-running subresources (watch,
+        proxy, exec, log) are exempt. Uses a private summary so the
+        process-global registry (asserted clean by the density SLO
+        gate) stays unpolluted."""
+        from kubernetes_tpu.server.httpserver import high_latency_requests
+        from kubernetes_tpu.utils import metrics
+
+        summary = metrics.Summary(
+            "test_latency_gate_seconds", "test", ("verb", "resource")
+        )
+        for _ in range(5):
+            summary.observe(3.0, verb="GET", resource="slowthings")
+            summary.observe(30.0, verb="GET", resource="slowthings/watch")
+            summary.observe(30.0, verb="GET", resource="slowthings/proxy")
+            summary.observe(0.01, verb="GET", resource="fastthings")
+        slow = high_latency_requests(threshold=1.0, summary=summary)
+        assert slow == [("GET", "slowthings", 3.0)]

@@ -147,6 +147,30 @@ def test_wave_mode_schedules_backlog():
         cfg.stop()
 
 
+def test_sinkhorn_mode_schedules_backlog():
+    """The Sinkhorn-matched mode drives the same daemon plumbing."""
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    for j in range(4):
+        client.create("nodes", node_wire(f"n{j}"))
+    for i in range(24):
+        client.create("pods", pod_wire(f"s{i}"))
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    assert cfg.wait_for_sync()
+    sched = BatchScheduler(cfg, mode="sinkhorn")
+    try:
+        processed = 0
+        deadline = time.monotonic() + 60
+        while processed < 24 and time.monotonic() < deadline:
+            processed += sched.schedule_batch(timeout=0.5)
+        pods, _ = client.list("pods", namespace="default")
+        assert len(pods) == 24
+        names = {f"n{j}" for j in range(4)}
+        assert all(p.spec.node_name in names for p in pods)
+    finally:
+        cfg.stop()
+
+
 def test_batch_mode_validation():
     api = APIServer()
     cfg = SchedulerConfig(Client(LocalTransport(api)))

@@ -55,6 +55,55 @@ class TestDaemonFlagParsers:
         assert daemons.proxy_parser().parse_args([]).bind_address == "127.0.0.1"
 
 
+class TestHealthServer:
+    def test_reference_default_ports(self):
+        assert daemons.scheduler_parser().parse_args([]).healthz_port == 10251
+        assert (
+            daemons.controller_manager_parser().parse_args([]).healthz_port
+            == 10252
+        )
+        assert daemons.proxy_parser().parse_args([]).healthz_port == 10249
+
+    def test_healthz_and_metrics(self):
+        """Every daemon mounts /healthz + /metrics on its own port
+        (scheduler server.go:105-109); unhealthy checks turn the
+        endpoint 500."""
+        state = {"ok": True}
+        srv = daemons.HealthServer(
+            0, checks=[lambda: (state["ok"], "ok" if state["ok"] else "down")]
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+                assert r.read() == b"ok"
+            with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+                body = r.read()
+                assert b"# HELP" in body and b"# TYPE" in body
+            state["ok"] = False
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/healthz", timeout=5)
+            assert e.value.code == 500
+        finally:
+            srv.stop()
+
+    def test_disabled_and_conflict_are_nonfatal(self):
+        import argparse
+
+        assert daemons._start_health(argparse.Namespace(healthz_port=-1), []) is None
+        # Occupy a port, then ask a "daemon" to bind it: warns, returns None.
+        srv = daemons.HealthServer(0).start()
+        try:
+            taken = srv.port
+            assert (
+                daemons._start_health(
+                    argparse.Namespace(healthz_port=taken), []
+                )
+                is None
+            )
+        finally:
+            srv.stop()
+
+
 class TestLocalUpCluster:
     def test_full_cluster_schedules_pods_over_http(self):
         """hack/local-up-cluster.sh analog: one call brings up the

@@ -102,12 +102,20 @@ class TestDensity:
 
     def test_density_over_http(self, cluster):
         """Same criteria with the pods created over the real HTTP
-        apiserver (the driver surface users touch)."""
+        apiserver (the driver surface users touch), then the
+        HighLatencyRequests SLO gate: 99% of API calls < 1 s
+        (docs/roadmap.md:69, enforced exactly like test/e2e/
+        util.go:1286 — from the apiserver's own latency summaries,
+        long-running verbs exempt)."""
+        from kubernetes_tpu.server.httpserver import high_latency_requests
+
         client = Client(HTTPTransport(cluster.http.address))
         client.create("replicationcontrollers", rc_wire("htt", 40, "htt"))
         assert wait_until(
             lambda: running_count(client, "app=htt") == 40, timeout=60
         )
+        slow = high_latency_requests(threshold=1.0)
+        assert not slow, f"API p99 SLO violations: {slow}"
 
 
 class TestLoad:

@@ -431,3 +431,54 @@ class TestKtctlLogsExec:
             assert "via-cli" in capsys.readouterr().out
         finally:
             srv.stop()
+
+
+class TestClusterLogAggregator:
+    """Logging addon (cluster/addons/fluentd-elasticsearch analog):
+    cluster-wide collection through the apiserver log relay, retention
+    past pod deletion, substring search."""
+
+    def test_collects_and_searches_across_pods(self, cluster):
+        from kubernetes_tpu.addons import ClusterLogAggregator
+
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "talker-a", ["/bin/sh", "-c",
+                                       "echo uniq-line-alpha; sleep 30"])
+        _schedule(client, "talker-b", ["/bin/sh", "-c",
+                                       "echo uniq-line-beta; sleep 30"])
+        assert wait_for(lambda: _pod_running(client, runtime, "talker-a"))
+        assert wait_for(lambda: _pod_running(client, runtime, "talker-b"))
+        agg = ClusterLogAggregator(client, poll_interval=0.2).start()
+        try:
+            assert wait_for(lambda: agg.search("uniq-line-alpha"), timeout=10)
+            assert wait_for(lambda: agg.search("uniq-line-beta"), timeout=10)
+            hit = agg.search("uniq-line-alpha")[0]
+            assert (hit.pod, hit.container) == ("talker-a", "main")
+            # Scoped search.
+            assert not agg.search("uniq-line-alpha", pod="talker-b")
+            # Retention: lines survive the pod's deletion (the whole
+            # point of shipping logs off the node).
+            client.delete("pods", "talker-a", namespace="default")
+            assert agg.search("uniq-line-alpha")
+        finally:
+            agg.stop()
+
+    def test_incremental_no_duplicates(self, cluster):
+        from kubernetes_tpu.addons import ClusterLogAggregator
+
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "stepper", ["/bin/sh", "-c",
+                                      "echo s1; sleep 0.5; echo s2; sleep 30"])
+        assert wait_for(lambda: _pod_running(client, runtime, "stepper"))
+        agg = ClusterLogAggregator(client, poll_interval=0.1).start()
+        try:
+            assert wait_for(
+                lambda: agg.search("s2", pod="stepper"), timeout=10
+            )
+            import time as _t
+
+            _t.sleep(0.5)  # several more polls: offsets must hold
+            assert len(agg.search("s1", pod="stepper")) == 1
+            assert len(agg.search("s2", pod="stepper")) == 1
+        finally:
+            agg.stop()

@@ -119,6 +119,49 @@ class TestGenericScheduler:
         assert priorities[0].weight == 2
 
 
+class TestCustomAlgorithmSeam:
+    """The algorithm seam is pluggable: any object with
+    .schedule(pod, minion_lister) -> host slots into SchedulerConfig,
+    exactly how contrib/mesos swaps its own ScheduleAlgorithm into
+    scheduler.Config (reference: contrib/mesos/pkg/scheduler/
+    scheduler.go:19-20 comment + plugin/pkg/scheduler/algorithm/
+    scheduler_interface.go)."""
+
+    def test_custom_algorithm_drives_placement(self):
+        class StickyAlgorithm:
+            """Places every pod on the lexicographically-last node —
+            nothing like the default provider, which proves the daemon
+            takes the seam's word for it."""
+
+            def schedule(self, pod, minion_lister):
+                nodes = sorted(n.metadata.name for n in minion_lister.list())
+                if not nodes:
+                    raise RuntimeError("no nodes")
+                return nodes[-1]
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        cfg = SchedulerConfig(client).start()
+        try:
+            assert cfg.wait_for_sync()
+            cfg.algorithm = StickyAlgorithm()
+            sched = Scheduler(cfg)
+            client.create("nodes", node_wire("a-node", cpu="8"))
+            client.create("nodes", node_wire("z-node", cpu="1"))
+            for i in range(3):
+                client.create("pods", pod_wire(f"p{i}"))
+            assert wait_until(lambda: len(cfg.pod_queue) >= 3)
+            # The node informer is a separate watch thread from the pod
+            # reflector: wait for both before scheduling.
+            assert wait_until(lambda: len(cfg.node_lister.list()) == 2)
+            for _ in range(3):
+                assert sched.schedule_one(timeout=1)
+            items, _ = client.list("pods", namespace="default")
+            assert {p.spec.node_name for p in items} == {"z-node"}
+        finally:
+            cfg.stop()
+
+
 class TestSchedulerDaemon:
     def _start(self, api=None, **cfg_kw):
         api = api or APIServer()

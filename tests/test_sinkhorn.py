@@ -1,0 +1,125 @@
+"""Sinkhorn-matched wave solver: validity, congestion-priced batching
+(fewer waves than the plain wave solver), determinism, mesh execution.
+
+The mode exists for the north star's "Hungarian/Sinkhorn matching"
+framing (BASELINE.json): entropic assignment with capacity-capped
+column prices steering each wave's choices. Placement VALIDITY is
+non-negotiable and checked with the same replay as the wave tests."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from kubernetes_tpu.models.columnar import build_snapshot
+from kubernetes_tpu.ops import device_snapshot
+from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments, solve_sinkhorn
+from kubernetes_tpu.ops.wave import wave_assignments
+from test_solver_parity import mk_node, mk_pod, random_cluster
+from test_wave import check_validity
+
+
+class TestSinkhornValidity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_placements_valid(self, seed):
+        pods, nodes, assigned, services = random_cluster(seed)
+        snap = build_snapshot(pods, nodes, assigned, services)
+        d = device_snapshot(snap)
+        assign, _ = sinkhorn_assignments(d, window=32)
+        check_validity(snap, assign)
+
+    def test_capacity_stress_places_exactly_what_fits(self):
+        pods = [mk_pod(f"p{i}", cpu=600, mem_mib=64) for i in range(10)]
+        nodes = [mk_node(f"n{j}", cpu=1000) for j in range(3)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        assign, _ = sinkhorn_assignments(d, window=8)
+        check_validity(snap, assign)
+        assert (assign >= 0).sum() == 3
+
+    def test_host_port_conflicts_respected(self):
+        pods = [mk_pod(f"hp{i}", host_port=8080) for i in range(4)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        assign, _ = sinkhorn_assignments(d, window=4)
+        check_validity(snap, assign)
+        assert (assign >= 0).sum() == 2
+
+    def test_places_everything_when_capacity_allows(self):
+        pods = [mk_pod(f"p{i}", cpu=100, mem_mib=64) for i in range(64)]
+        nodes = [mk_node(f"n{j}", cpu=8000, mem_mib=8192) for j in range(8)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        assign, _ = sinkhorn_assignments(d, window=64)
+        check_validity(snap, assign)
+        assert (assign >= 0).sum() == 64
+
+    def test_deterministic(self):
+        pods, nodes, assigned, services = random_cluster(3)
+        snap = build_snapshot(pods, nodes, assigned, services)
+        d = device_snapshot(snap)
+        a1, _ = sinkhorn_assignments(d, window=16)
+        a2, _ = sinkhorn_assignments(d, window=16)
+        assert (a1 == a2).all()
+
+
+class TestCongestionPricing:
+    def test_fewer_waves_than_plain_wave(self):
+        """The mode's reason to exist: prices meter demand to capacity,
+        so one wave lands many more pods than argmax + per-node-limit
+        packing. Uniform fleet, everything fits."""
+        pods = [
+            mk_pod(f"p{i}", cpu=100 + 50 * (i % 4), mem_mib=64)
+            for i in range(128)
+        ]
+        nodes = [mk_node(f"n{j}", cpu=8000, mem_mib=8192) for j in range(8)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        wave_a, wave_count = wave_assignments(d, window=128)
+        sk_a, sk_count = sinkhorn_assignments(d, window=128)
+        check_validity(snap, sk_a)
+        assert (sk_a >= 0).sum() == 128
+        assert (wave_a >= 0).sum() == 128
+        assert sk_count < wave_count, (sk_count, wave_count)
+
+    def test_prices_spread_load_across_equal_nodes(self):
+        """With identical nodes and small pods, the settled placement
+        should not pile onto a few nodes (balance, not just speed)."""
+        pods = [mk_pod(f"p{i}", cpu=100, mem_mib=64) for i in range(64)]
+        nodes = [mk_node(f"n{j}", cpu=16000, mem_mib=16384) for j in range(8)]
+        snap = build_snapshot(pods, nodes)
+        d = device_snapshot(snap)
+        assign, _ = sinkhorn_assignments(d, window=64)
+        counts = np.bincount(assign[assign >= 0], minlength=8)
+        # Perfect balance is 8 per node; demand no node exceeds 2x it.
+        assert counts.max() <= 16, counts
+
+    def test_zero_capacity_nodes_priced_out(self):
+        full = mk_node("full", pods=0)
+        open_ = mk_node("open", pods=10)
+        pods = [mk_pod(f"p{i}", cpu=10, mem_mib=8) for i in range(4)]
+        snap = build_snapshot(pods, [full, open_])
+        d = device_snapshot(snap)
+        assign, _ = sinkhorn_assignments(d, window=4)
+        check_validity(snap, assign)
+        assert set(assign[assign >= 0]) == {1}
+
+
+class TestSinkhornOnMesh:
+    def test_sharded_matches_single_device(self):
+        pods, nodes, assigned, services = random_cluster(5)
+        snap = build_snapshot(pods, nodes, assigned, services)
+        single = device_snapshot(snap)
+        base, _ = sinkhorn_assignments(single, window=16)
+
+        devices = np.array(jax.devices()[:8])
+        mesh = Mesh(devices, axis_names=("nodes",))
+        sharded = device_snapshot(snap, mesh=mesh, pad_to=8)
+        with mesh:
+            out, _ = solve_sinkhorn(sharded.pods, sharded.nodes, window=16)
+            out.block_until_ready()
+        a = np.asarray(out)[: sharded.n_pods]
+        a = np.where(a >= sharded.n_nodes, -1, a)
+        assert (a == base).all()
