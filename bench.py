@@ -163,8 +163,15 @@ def _parity_figures() -> dict:
     pods, nodes, services = _synthetic_objects(10000, 1000, seed=12)
     snap = build_snapshot(pods, nodes, services=services)
     seq = solve_sequential_numpy(snap)
-    dev = np.asarray(solve_assignments(device_snapshot(snap)))
+    d = device_snapshot(snap)
+    dev = np.asarray(solve_assignments(d))
     out["parity_seq_oracle_10kx1k"] = float((seq == dev).mean())
+    # NOTE: decision-identity parity is only meaningful for the scan
+    # (which replicates the oracle's lowest-index tie-break). The
+    # approximate modes (wave/sinkhorn) hash their ties, so on fleets
+    # full of equal-score nodes their decisions rarely coincide with
+    # the oracle's pick even at equal quality — their published
+    # quality figures are placed counts and load stddev instead.
     return {k: round(v, 4) for k, v in out.items()}
 
 

@@ -94,6 +94,11 @@ class ClusterLogAggregator:
                 except Exception:
                     continue  # transport hiccup; retry next sweep
                 lines = text.splitlines()
+                if text and not text.endswith("\n") and lines:
+                    # Trailing unterminated fragment: leave it for the
+                    # sweep after its newline arrives — counting it now
+                    # would pin the offset past the completed line.
+                    lines = lines[:-1]
                 seen = self._offsets.get(key, 0)
                 if len(lines) < seen:
                     seen = 0  # log rotated/truncated: re-ingest

@@ -234,16 +234,50 @@ def resource_for_kind(kind: str) -> str:
 
 def cmd_get(client: Client, args) -> int:
     resource = resolve_resource(args.resource)
-    if args.name:
+    watching = getattr(args, "watch", False) or getattr(args, "watch_only", False)
+    ns = "" if args.all_namespaces else args.namespace
+    version = 0
+    if args.name and not watching:
         obj = client.get(resource, args.name, namespace=args.namespace)
         print_objs(resource, [obj], args.output)
-    else:
-        objs, _ = client.list(
-            resource,
-            namespace="" if args.all_namespaces else args.namespace,
-            label_selector=args.selector or "",
+        return 0
+    if not getattr(args, "watch_only", False):
+        objs, version = client.list(
+            resource, namespace=ns, label_selector=args.selector or ""
         )
+        if args.name:
+            objs = [o for o in objs if o.metadata.name == args.name]
         print_objs(resource, objs, args.output)
+    if not watching:
+        return 0
+    # --watch / --watch-only (reference: get.go:79-143 WatchLoop):
+    # stream changes after the listed resourceVersion, one row per
+    # event. Ctrl-C ends the loop.
+    stream = client.watch(
+        resource,
+        namespace=ns,
+        since=int(version or 0),
+        label_selector=args.selector or "",
+    )
+    limit = getattr(args, "watch_events", None)  # test hook
+    seen = 0
+    try:
+        for event in stream:
+            wire = event.object
+            if not isinstance(wire, dict) or event.type == "ERROR":
+                continue
+            obj = serde.from_wire(RESOURCES[resource].cls, wire)
+            if args.name and obj.metadata.name != args.name:
+                continue
+            print_objs(resource, [obj], args.output)
+            sys.stdout.flush()
+            seen += 1
+            if limit is not None and seen >= limit:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stream.close()
     return 0
 
 
@@ -945,6 +979,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("name", nargs="?")
     g.add_argument("--selector", "-l")
     g.add_argument("--all-namespaces", "-A", action="store_true")
+    g.add_argument("--watch", "-w", action="store_true",
+                   help="after listing, watch for changes")
+    g.add_argument("--watch-only", action="store_true",
+                   help="watch without the initial list")
+    g.add_argument("--watch-events", type=int, default=None,
+                   help=argparse.SUPPRESS)  # exit after N events (tests)
     g.set_defaults(fn=cmd_get)
 
     c = sub.add_parser("create", parents=[common])

@@ -411,6 +411,12 @@ class APIServer:
             # NodePort -> ClusterIP must shed its ports (commit()
             # releases them) instead of pinning them forever.
             self._carry_node_ports(cur_spec, spec)
+        else:
+            # Shed explicitly-submitted stale ports too: a ClusterIP
+            # spec has no business carrying nodePort fields, and
+            # leaving them would keep the pool allocation forever.
+            for p in spec.get("ports") or []:
+                p.pop("nodePort", None)
         try:
             new_ports = set()
             for port in spec.get("ports") or []:
@@ -738,6 +744,12 @@ class APIServer:
                 assign = new_spec.get("type") in ("NodePort", "LoadBalancer")
                 if assign:
                     self._carry_node_ports(cur_spec, new_spec)
+                else:
+                    # Type patched away from NodePort: the merge kept
+                    # the old ports (with nodePorts) — shed them so the
+                    # post-commit reconcile releases the pool slots.
+                    for p in new_spec.get("ports") or []:
+                        p.pop("nodePort", None)
                 held = {
                     p.get("nodePort")
                     for p in cur_spec.get("ports") or []

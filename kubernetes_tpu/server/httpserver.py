@@ -44,6 +44,19 @@ _LATENCY = metrics.DEFAULT.summary(
 )
 
 
+def _first_container_port(pod: dict, name: str) -> int:
+    """The pod's first declared container port — the default target for
+    the proxy and redirect verbs when the client gives no ':port'."""
+    for c in pod.get("spec", {}).get("containers", []):
+        for p in c.get("ports", []):
+            if p.get("containerPort", 0):
+                return p["containerPort"]
+    raise APIError(
+        400, "BadRequest",
+        f"pod {name!r} declares no container port; use {name}:<port>",
+    )
+
+
 #: Subresource suffixes whose requests are long-running by design —
 #: exempt from the latency SLO exactly like the reference's ignored
 #: verbs/resources (test/e2e/util.go:1286-1301 skips WATCHLIST/PROXY).
@@ -321,9 +334,11 @@ class _Handler(BaseHTTPRequestHandler):
             except authpkg.AuthenticationError as e:
                 raise APIError(401, "Unauthorized", str(e))
         if authorizer is not None:
-            # Derive (resource, namespace) from the path shape.
+            # Derive (resource, namespace) from the path shape. The
+            # watch/redirect prefixes are verbs, not resources — policy
+            # is written against the underlying resource.
             resource, ns = "", ""
-            if rest and rest[0] == "watch":
+            if rest and rest[0] in ("watch", "redirect"):
                 rest = rest[1:]
             if len(rest) == 3 and rest[0] == "namespaces" and rest[2] == "finalize":
                 resource = "namespaces"  # cluster-scoped subresource path
@@ -373,7 +388,18 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 raise APIError(404, "NotFound", f"bad watch path {self.path!r}")
             self._serve_watch(resource, ns, lsel, fsel, q)
-            return resource, 200
+            # Same long-running metrics label as ?watch=true — a watch
+            # holds its connection for its lifetime and must not feed
+            # the plain-GET p99 series the SLO gate reads.
+            return resource + "/watch", 200
+
+        # Legacy REDIRECT verb (pkg/apiserver/redirect.go:57-100 +
+        # api_installer.go:280): GET /redirect/... answers 307 with the
+        # resource's backend Location — pods (pod IP:port), services
+        # (a ready endpoint), nodes (the kubelet API) — instead of
+        # relaying like /proxy does.
+        if rest[0] == "redirect" and verb == "GET":
+            return self._redirect(rest[1:])
 
         # Namespace finalize subresource (not a namespaced collection
         # path): PUT /api/v1/namespaces/{name}/finalize.
@@ -615,23 +641,68 @@ class _Handler(BaseHTTPRequestHandler):
         the pod's host IP + the explicit, or first declared, container
         port)."""
         base, pod = self.api.kubelet_location(ns, name)
-        if not port:
-            containers = pod.get("spec", {}).get("containers", [])
-            for c in containers:
-                for p in c.get("ports", []):
-                    port = p.get("containerPort", 0)
-                    break
-                if port:
-                    break
-        if not port:
-            raise APIError(
-                400, "BadRequest",
-                f"pod {name!r} declares no container port; use {name}:<port>",
-            )
+        port = port or _first_container_port(pod, name)
         host = urlparse(base).hostname or "127.0.0.1"
         url = f"http://{host}:{port}/" + "/".join(subpath)
         code = self._relay_http(url, verb, "pod proxy")
         return "pods/proxy", code
+
+    def _redirect(self, rest: Tuple[str, ...]) -> Tuple[str, int]:
+        """Resolve a resource's backend location and answer 307
+        (RedirectHandler: ResourceLocation per storage kind)."""
+        if len(rest) == 4 and rest[0] == "namespaces":
+            ns, resource, name = rest[1], rest[2], rest[3]
+        elif len(rest) == 2:
+            ns, resource, name = "", rest[0], rest[1]
+        else:
+            raise APIError(404, "NotFound", f"bad redirect path {self.path!r}")
+        base, _, port_s = name.partition(":")
+        if resource == "services":
+            ip, port = self.api.service_location(ns, base, port_s)
+            location = f"http://{ip}:{port}/"
+        elif resource == "pods":
+            pod = self.api.get("pods", ns, base)
+            ip = pod.get("status", {}).get("podIP", "")
+            if not ip:
+                raise APIError(
+                    409, "Conflict", f"pod {base!r} has no pod IP yet"
+                )
+            port = int(port_s) if port_s.isdigit() else 0
+            port = port or _first_container_port(pod, base)
+            location = f"http://{ip}:{port}/"
+        elif resource == "nodes":
+            # kubelet_location resolves via a pod normally; nodes
+            # resolve directly from their status.
+            node = self.api.get("nodes", "", base)
+            status = node.get("status", {})
+            port = (
+                status.get("daemonEndpoints", {})
+                .get("kubeletEndpoint", {})
+                .get("port", 0)
+            )
+            if not port:
+                raise APIError(
+                    501, "NotImplemented",
+                    f"node {base!r} does not publish a kubelet API endpoint",
+                )
+            ip = next(
+                (
+                    a.get("address")
+                    for a in status.get("addresses", [])
+                    if a.get("type") == "InternalIP"
+                ),
+                "127.0.0.1",
+            )
+            location = f"http://{ip}:{port}/"
+        else:
+            raise APIError(
+                405, "MethodNotAllowed", f"{resource} is not a redirector"
+            )
+        self.send_response(307)
+        self.send_header("Location", location)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return f"{resource}/redirect", 307
 
     def _node_proxy(
         self, node_name: str, subpath: Tuple[str, ...]

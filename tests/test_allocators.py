@@ -330,6 +330,28 @@ class TestServicePatch:
             )
         assert e.value.code == 422
 
+    def test_patch_type_to_clusterip_sheds_node_ports(self):
+        """PATCH {'spec':{'type':'ClusterIP'}} on a NodePort service:
+        the merge keeps the old ports array, but the committed object
+        must carry no nodePort and the pool slot must free up."""
+        api = APIServer()
+        svc = api.create(
+            "services",
+            "default",
+            svc_wire("a", svc_type="NodePort", ports=[{"port": 80}]),
+        )
+        np = svc["spec"]["ports"][0]["nodePort"]
+        out = api.patch(
+            "services", "default", "a", {"spec": {"type": "ClusterIP"}}
+        )
+        assert not out["spec"]["ports"][0].get("nodePort")
+        api.create(
+            "services",
+            "default",
+            svc_wire("b", svc_type="NodePort",
+                     ports=[{"port": 80, "nodePort": np}]),
+        )
+
     def test_patched_in_node_port_is_tracked(self):
         api = APIServer()
         api.create("services", "default", svc_wire("a"))

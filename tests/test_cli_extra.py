@@ -207,3 +207,87 @@ class TestProxyCommand:
             assert e.value.code == 404
         finally:
             proxy.stop()
+
+
+class TestGetWatch:
+    """`ktctl get -w` (reference get.go:79-143 WatchLoop)."""
+
+    def test_watch_streams_changes(self):
+        import threading
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "w0"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            },
+            namespace="default",
+        )
+
+        def later():
+            import time
+
+            time.sleep(0.3)
+            for name in ("w1", "w2"):
+                client.create(
+                    "pods",
+                    {
+                        "kind": "Pod",
+                        "metadata": {"name": name},
+                        "spec": {"containers": [{"name": "c", "image": "x"}]},
+                    },
+                    namespace="default",
+                )
+
+        t = threading.Thread(target=later)
+        t.start()
+        out = run_main(
+            "get", "pods", "-w", "--watch-events", "2", "-o", "name",
+            client=client,
+        )
+        t.join()
+        # Initial list (w0) + the two watched creations.
+        assert "pods/w0" in out
+        assert "pods/w1" in out and "pods/w2" in out
+
+    def test_watch_only_skips_initial_list(self):
+        import threading
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "pre"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            },
+            namespace="default",
+        )
+
+        def later():
+            import time
+
+            time.sleep(0.3)
+            client.create(
+                "pods",
+                {
+                    "kind": "Pod",
+                    "metadata": {"name": "post"},
+                    "spec": {"containers": [{"name": "c", "image": "x"}]},
+                },
+                namespace="default",
+            )
+
+        t = threading.Thread(target=later)
+        t.start()
+        out = run_main(
+            "get", "pods", "--watch-only", "--watch-events", "1",
+            "-o", "name", client=client,
+        )
+        t.join()
+        assert "pods/post" in out
+        assert "pods/pre" not in out

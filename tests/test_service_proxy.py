@@ -133,3 +133,69 @@ class TestServiceProxy:
         )
         picks = {api.service_location("default", "multi")[0] for _ in range(50)}
         assert picks == {"10.5.0.1", "10.5.0.2"}
+
+
+class TestRedirect:
+    """Legacy REDIRECT verb (pkg/apiserver/redirect.go): 307 with the
+    backend Location instead of relaying."""
+
+    def _get_redirect(self, url):
+        import urllib.request
+
+        class NoFollow(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **k):
+                return None
+
+        opener = urllib.request.build_opener(NoFollow)
+        try:
+            opener.open(url, timeout=5)
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Location", "")
+        raise AssertionError("expected a redirect status")
+
+    def test_service_redirect(self, cluster):
+        api, srv, port = cluster
+        code, loc = self._get_redirect(
+            f"{srv.address}/api/v1/redirect/namespaces/default/services/web"
+        )
+        assert code == 307
+        assert loc == f"http://127.0.0.1:{port}/"
+
+    def test_pod_redirect_uses_pod_ip_and_port(self, cluster):
+        api, srv, port = cluster
+        api.create(
+            "pods",
+            "default",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "rp"},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "image": "x",
+                         "ports": [{"containerPort": 8080}]}
+                    ]
+                },
+            },
+        )
+        api.update_status(
+            "pods", "default", "rp",
+            {"status": {"podIP": "10.9.8.7", "phase": "Running"}},
+        )
+        code, loc = self._get_redirect(
+            f"{srv.address}/api/v1/redirect/namespaces/default/pods/rp"
+        )
+        assert code == 307
+        assert loc == "http://10.9.8.7:8080/"
+
+    def test_non_redirector_405(self, cluster):
+        import urllib.error
+        import urllib.request
+
+        api, srv, port = cluster
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{srv.address}/api/v1/redirect/namespaces/default/"
+                "secrets/whatever",
+                timeout=5,
+            )
+        assert e.value.code == 405
