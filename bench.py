@@ -78,7 +78,8 @@ def churn_main() -> None:
             ),
         )
 
-    session = SolverSession(nodes)
+    mode = os.environ.get("BENCH_CHURN_MODE", "scan")
+    session = SolverSession(nodes, mode=mode)
     # Warm-up must compile EVERY executable the timed ticks hit: the
     # solve itself AND the delete-path row scatter at the same dirty-
     # row bucket width the ticks produce (a cold scatter compile was
@@ -120,6 +121,7 @@ def churn_main() -> None:
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 1),
+                "tick_mode": mode,
             }
         )
     )

@@ -166,12 +166,14 @@ def _generic_row(o) -> List[str]:
     return [o.metadata.name, _age(o.metadata.creation_timestamp)]
 
 
-def print_table(resource: str, objs: List[Any], out=None) -> None:
+def print_table(
+    resource: str, objs: List[Any], out=None, header: bool = True
+) -> None:
     out = out or sys.stdout
     headers, row_fn = TABLE_COLUMNS.get(resource, (["NAME", "AGE"], _generic_row))
     rows = [headers] + [row_fn(o) for o in objs]
     widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
-    for r in rows:
+    for r in rows if header else rows[1:]:
         out.write("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
 
 
@@ -236,18 +238,23 @@ def cmd_get(client: Client, args) -> int:
     resource = resolve_resource(args.resource)
     watching = getattr(args, "watch", False) or getattr(args, "watch_only", False)
     ns = "" if args.all_namespaces else args.namespace
+    # A named get narrows both the list and the watch server-side.
+    fsel = f"metadata.name={args.name}" if args.name else ""
     version = 0
+    printed_header = False
     if args.name and not watching:
         obj = client.get(resource, args.name, namespace=args.namespace)
         print_objs(resource, [obj], args.output)
         return 0
     if not getattr(args, "watch_only", False):
         objs, version = client.list(
-            resource, namespace=ns, label_selector=args.selector or ""
+            resource,
+            namespace=ns,
+            label_selector=args.selector or "",
+            field_selector=fsel,
         )
-        if args.name:
-            objs = [o for o in objs if o.metadata.name == args.name]
         print_objs(resource, objs, args.output)
+        printed_header = bool(objs)
     if not watching:
         return 0
     # --watch / --watch-only (reference: get.go:79-143 WatchLoop):
@@ -258,6 +265,7 @@ def cmd_get(client: Client, args) -> int:
         namespace=ns,
         since=int(version or 0),
         label_selector=args.selector or "",
+        field_selector=fsel,
     )
     limit = getattr(args, "watch_events", None)  # test hook
     seen = 0
@@ -267,9 +275,13 @@ def cmd_get(client: Client, args) -> int:
             if not isinstance(wire, dict) or event.type == "ERROR":
                 continue
             obj = serde.from_wire(RESOURCES[resource].cls, wire)
-            if args.name and obj.metadata.name != args.name:
-                continue
-            print_objs(resource, [obj], args.output)
+            if args.output == "table":
+                # One header for the whole stream (kubectl appends
+                # rows, it doesn't reprint the header per event).
+                print_table(resource, [obj], header=not printed_header)
+                printed_header = True
+            else:
+                print_objs(resource, [obj], args.output)
             sys.stdout.flush()
             seen += 1
             if limit is not None and seen >= limit:

@@ -111,11 +111,18 @@ class SolverSession:
         node_capacity: int = 0,
         weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
         mesh=None,
+        mode: str = "scan",
     ):
         nodes = list(nodes)
         self.services = list(services)
         self.weights = tuple(weights)
         self.mesh = mesh
+        # Tick solver: "scan" replays the sequential-parity policy;
+        # "wave"/"sinkhorn" batch each tick's backlog (same windowed
+        # commit machinery as the batch modes — ops.wave/ops.sinkhorn).
+        if mode not in ("scan", "wave", "sinkhorn"):
+            raise ValueError(f"unknown session mode {mode!r}")
+        self.mode = mode
         self.LW, self.PW, self.VW = label_words, port_words, vol_words
         self.S = max(1, len(self.services))
         self._matcher = ServiceMatcher(self.services)
@@ -351,7 +358,20 @@ class SolverSession:
             return []
         self._flush_dirty()
         pods = self._pod_arrays(pending)
-        assignment, self.dev = solve_with_state(pods, self.dev, self.weights)
+        if self.mode == "wave":
+            from kubernetes_tpu.ops.wave import solve_waves_with_state
+
+            assignment, self.dev, _ = solve_waves_with_state(
+                pods, self.dev, self.weights
+            )
+        elif self.mode == "sinkhorn":
+            from kubernetes_tpu.ops.sinkhorn import solve_sinkhorn_with_state
+
+            assignment, self.dev, _ = solve_sinkhorn_with_state(
+                pods, self.dev, self.weights
+            )
+        else:
+            assignment, self.dev = solve_with_state(pods, self.dev, self.weights)
         out: List[Tuple[str, Optional[str]]] = []
         picks = np.asarray(assignment)[: len(pending)]
         for lp, j in zip(pending, picks.tolist()):

@@ -124,4 +124,27 @@ def solve_sinkhorn(
     acceptance limit can be far looser (prices already meter demand to
     capacity) — that is where the wave-count win comes from."""
     choose = functools.partial(_priced_choose, eps=eps, iters=iters)
+    assignment, _, waves = run_windowed(
+        pods, nodes, weights, window, per_node_limit, choose
+    )
+    return assignment, waves
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("weights", "window", "per_node_limit", "eps", "iters"),
+    donate_argnames=("nodes",),
+)
+def solve_sinkhorn_with_state(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+    window: int = 4096,
+    per_node_limit: int = 64,
+    eps: float = 2.0,
+    iters: int = 8,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Like solve_sinkhorn, but also returns the post-commit occupancy
+    carry; `nodes` is DONATED (the incremental-churn substrate)."""
+    choose = functools.partial(_priced_choose, eps=eps, iters=iters)
     return run_windowed(pods, nodes, weights, window, per_node_limit, choose)

@@ -229,9 +229,10 @@ def run_windowed(
     window: int,
     per_node_limit: int,
     choose,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
     """The shared windowed-commit loop (trace-time function — callers
-    jit it). `choose(masked, idx, valid, carry, N) -> i32[W]` picks
+    jit it). Returns (assignment, post-commit occupancy carry, wave
+    count). `choose(masked, idx, valid, carry, N) -> i32[W]` picks
     each window pod's candidate node; everything else — windowing,
     capacity-aware packing, bulk commit, finalization — is common to
     every wave-family solver (plain argmax, Sinkhorn-priced, ...), so
@@ -291,14 +292,14 @@ def run_windowed(
         assignment = assignment.at[idx].set(value, mode="drop")
         return assignment, carry, waves + 1
 
-    assignment, _, waves = jax.lax.while_loop(
+    assignment, carry, waves = jax.lax.while_loop(
         cond, body, (assignment0, dict(nodes), jnp.int32(0))
     )
     # Safety valve: the wave cap (P) cannot be hit given the
     # first-undecided-pod-always-finalizes invariant, but an UNDECIDED
     # sentinel must never leak to callers.
     assignment = jnp.where(assignment == UNDECIDED, -1, assignment)
-    return assignment, waves
+    return assignment, carry, waves
 
 
 @functools.partial(
@@ -312,6 +313,27 @@ def solve_waves(
     per_node_limit: int = 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(assignment i32[P] with -1 = unschedulable, wave count)."""
+    assignment, _, waves = run_windowed(
+        pods, nodes, weights, window, per_node_limit, _argmax_choose
+    )
+    return assignment, waves
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("weights", "window", "per_node_limit"),
+    donate_argnames=("nodes",),
+)
+def solve_waves_with_state(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+    window: int = 4096,
+    per_node_limit: int = 1,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Like solve_waves, but also returns the post-commit occupancy
+    carry; `nodes` is DONATED — the incremental-churn substrate, same
+    contract as solver.solve_with_state."""
     return run_windowed(
         pods, nodes, weights, window, per_node_limit, _argmax_choose
     )

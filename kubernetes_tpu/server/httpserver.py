@@ -398,7 +398,11 @@ class _Handler(BaseHTTPRequestHandler):
         # resource's backend Location — pods (pod IP:port), services
         # (a ready endpoint), nodes (the kubelet API) — instead of
         # relaying like /proxy does.
-        if rest[0] == "redirect" and verb == "GET":
+        if rest[0] == "redirect":
+            if verb != "GET":
+                raise APIError(
+                    405, "MethodNotAllowed", "redirect supports GET only"
+                )
             return self._redirect(rest[1:])
 
         # Namespace finalize subresource (not a namespaced collection
@@ -667,8 +671,27 @@ class _Handler(BaseHTTPRequestHandler):
                 raise APIError(
                     409, "Conflict", f"pod {base!r} has no pod IP yet"
                 )
-            port = int(port_s) if port_s.isdigit() else 0
-            port = port or _first_container_port(pod, base)
+            if not port_s:
+                port = _first_container_port(pod, base)
+            elif port_s.isdigit():
+                port = int(port_s)
+            else:
+                # Named container port, like the service form resolves
+                # endpoint port names.
+                port = next(
+                    (
+                        p["containerPort"]
+                        for c in pod.get("spec", {}).get("containers", [])
+                        for p in c.get("ports", [])
+                        if p.get("name") == port_s and p.get("containerPort")
+                    ),
+                    0,
+                )
+                if not port:
+                    raise APIError(
+                        400, "BadRequest",
+                        f"pod {base!r} has no container port named {port_s!r}",
+                    )
             location = f"http://{ip}:{port}/"
         elif resource == "nodes":
             # kubelet_location resolves via a pod normally; nodes

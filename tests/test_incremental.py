@@ -203,3 +203,53 @@ class TestChurnParity:
                 )
                 if results[key] is not None:
                     live[key] = (pod, results[key])
+
+
+class TestSessionModes:
+    """Wave/Sinkhorn tick solvers over the device-resident session:
+    same validity and carry semantics as the scan ticks."""
+
+    @pytest.mark.parametrize("mode", ["wave", "sinkhorn"])
+    def test_occupancy_carries_across_ticks(self, mode):
+        session = SolverSession([mknode("n0", cpu_milli=1000)], mode=mode)
+        session.add_pending(mkpod("a", cpu=600))
+        assert dict(session.solve()) == {"default/a": "n0"}
+        session.add_pending(mkpod("b", cpu=600))
+        assert dict(session.solve()) == {"default/b": None}
+
+    @pytest.mark.parametrize("mode", ["wave", "sinkhorn"])
+    def test_delete_then_reuse(self, mode):
+        session = SolverSession([mknode("n0", cpu_milli=1000)], mode=mode)
+        session.add_pending(mkpod("a", cpu=600))
+        session.solve()
+        assert session.delete_assigned("default/a")
+        session.add_pending(mkpod("b", cpu=600))
+        assert dict(session.solve()) == {"default/b": "n0"}
+
+    @pytest.mark.parametrize("mode", ["wave", "sinkhorn"])
+    def test_batch_tick_places_everything_that_fits(self, mode):
+        nodes = [mknode(f"n{j}", cpu_milli=8000) for j in range(4)]
+        session = SolverSession(nodes, mode=mode)
+        for i in range(32):
+            session.add_pending(mkpod(f"p{i}", cpu=250))
+        out = dict(session.solve())
+        assert all(v is not None for v in out.values())
+        # Host mirror consistent: deleting every pod frees everything.
+        for key in list(out):
+            assert session.delete_assigned(key)
+        session.add_pending(mkpod("post", cpu=7900))
+        assert dict(session.solve())["default/post"] is not None
+
+    def test_mode_validation(self):
+        with pytest.raises(ValueError):
+            SolverSession([mknode("n0")], mode="warp")
+
+    @pytest.mark.parametrize("mode", ["wave", "sinkhorn"])
+    def test_host_port_exclusivity_across_ticks(self, mode):
+        session = SolverSession([mknode("n0"), mknode("n1")], mode=mode)
+        session.add_pending(mkpod("hp1", host_port=8080))
+        session.add_pending(mkpod("hp2", host_port=8080))
+        session.add_pending(mkpod("hp3", host_port=8080))
+        out = dict(session.solve())
+        placed = [v for v in out.values() if v is not None]
+        assert len(placed) == 2 and len(set(placed)) == 2
