@@ -454,6 +454,15 @@ def proxy_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu-proxy")
     _server_flag(p)
     p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument(
+        "--real-portals", action="store_true", default=True,
+        help="install service VIPs on loopback and bind listeners at "
+        "clusterIP:port (the openPortal/iptables analog; needs root, "
+        "falls back to rule-table portals otherwise)",
+    )
+    p.add_argument(
+        "--no-real-portals", dest="real_portals", action="store_false"
+    )
     _healthz_flag(p, 10249)
     return p
 
@@ -462,7 +471,11 @@ def start_proxy(args, client=None):
     from kubernetes_tpu.proxy.config import ProxyServer
 
     client = client or Client(HTTPTransport(args.server))
-    return ProxyServer(client, listen_ip=args.bind_address).start()
+    return ProxyServer(
+        client,
+        listen_ip=args.bind_address,
+        real_portals=getattr(args, "real_portals", False),
+    ).start()
 
 
 def proxy_main(argv: Optional[List[str]] = None) -> int:

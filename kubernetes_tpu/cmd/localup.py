@@ -29,6 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="register nodes from a cloud provider (e.g. 'tpu')",
     )
     p.add_argument("--batch-scheduler", action="store_true")
+    p.add_argument(
+        "--no-kube-proxy", dest="kube_proxy", action="store_false",
+        default=True, help="skip the in-process kube-proxy",
+    )
     return p
 
 
@@ -47,6 +51,7 @@ class LocalCluster:
         from kubernetes_tpu.server.api import APIServer
         from kubernetes_tpu.server.httpserver import APIHTTPServer
 
+        self.args = args
         self.api = APIServer()
         self.http = APIHTTPServer(
             self.api, host=args.address, port=args.port, publish_master=True
@@ -93,6 +98,17 @@ class LocalCluster:
         self.scheduler_config.wait_for_sync()
         self.scheduler = self.scheduler_cls(self.scheduler_config).start()
         self.manager.start()
+        # kube-proxy (hack/local-up-cluster.sh starts one too). Real
+        # portals when we can install VIPs on loopback (root), so
+        # service cluster IPs are actually dialable by any process —
+        # e.g. the guestbook frontend using REDIS_MASTER_SERVICE_HOST.
+        self.proxy = None
+        if getattr(self.args, "kube_proxy", True):
+            from kubernetes_tpu.proxy.config import ProxyServer
+
+            self.proxy = ProxyServer(
+                self._client(), real_portals=True
+            ).start()
         # Live component health (componentstatuses; the reference
         # master registers etcd + scheduler + controller-manager,
         # pkg/master/master.go getServersToValidate).
@@ -124,6 +140,8 @@ class LocalCluster:
     def stop(self) -> None:
         import shutil
 
+        if getattr(self, "proxy", None) is not None:
+            self.proxy.stop()
         self.manager.stop()
         if self.scheduler is not None:
             self.scheduler.stop()

@@ -79,11 +79,18 @@ class ProxyServer:
     service/endpoints watches (reference: cmd/kube-proxy/app/
     server.go:91-132)."""
 
-    def __init__(self, client, listen_ip: str = "127.0.0.1"):
+    def __init__(
+        self,
+        client,
+        listen_ip: str = "127.0.0.1",
+        real_portals: bool = False,
+    ):
         self.client = client
         self.lb = LoadBalancerRR()
         self.rules = PortalRuleTable()
-        self.proxier = Proxier(self.lb, self.rules, listen_ip=listen_ip)
+        self.proxier = Proxier(
+            self.lb, self.rules, listen_ip=listen_ip, real_portals=real_portals
+        )
         self.service_config = ServiceConfig(client)
         self.endpoints_config = EndpointsConfig(client)
         self.service_config.register_handler(self.proxier.on_update)

@@ -1,0 +1,105 @@
+"""Real service portals: cluster VIPs installed on the loopback device.
+
+The reference's openPortal (pkg/proxy/proxier.go:376) installs
+iptables DNAT rules so a connection to clusterIP:port lands on the
+proxier's socket. The userspace analog here goes one step simpler and
+just as real: add the service's cluster IP as a /32 address on `lo`
+(root / CAP_NET_ADMIN), then bind the proxier's listener DIRECTLY to
+(clusterIP, port). Any process on the host can then dial the VIP — the
+guestbook frontend's REDIS_MASTER_SERVICE_HOST works verbatim — with
+no NAT hop at all.
+
+Addresses are refcounted per IP (many service ports can share one
+cluster IP) and removed when the last user releases them or the
+proxier stops.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, Optional
+
+
+def _ip(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["ip", *args], capture_output=True, text=True, timeout=10
+    )
+
+
+class LoopbackPortals:
+    """Refcounted /32 loopback addresses for service VIPs."""
+
+    _supported: Optional[bool] = None
+    _probe_lock = threading.Lock()
+
+    def __init__(self):
+        self._refs: Dict[str, int] = {}
+        # Whether WE installed the address (vs adopting a pre-existing
+        # one): only ours get deleted on release — tearing down an
+        # address some other process installed would cut its live
+        # listeners off the VIP.
+        self._owned: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def supported(cls) -> bool:
+        """One probe per process: can we add/remove lo addresses?"""
+        with cls._probe_lock:
+            if cls._supported is None:
+                probe = "10.255.254.253"
+                try:
+                    add = _ip("addr", "add", f"{probe}/32", "dev", "lo")
+                    ok = add.returncode == 0 or "File exists" in add.stderr
+                    if add.returncode == 0:
+                        _ip("addr", "del", f"{probe}/32", "dev", "lo")
+                    cls._supported = ok
+                except (OSError, subprocess.TimeoutExpired):
+                    cls._supported = False
+            return cls._supported
+
+    def acquire(self, ip: str) -> bool:
+        """Ensure `ip` exists on lo; returns success."""
+        with self._lock:
+            if self._refs.get(ip, 0) > 0:
+                self._refs[ip] += 1
+                return True
+            try:
+                out = _ip("addr", "add", f"{ip}/32", "dev", "lo")
+            except (OSError, subprocess.TimeoutExpired):
+                return False
+            if out.returncode == 0:
+                owned = True
+            elif "File exists" in out.stderr:
+                owned = False  # pre-existing: usable but not ours
+            else:
+                return False
+            self._refs[ip] = 1
+            self._owned[ip] = owned
+            return True
+
+    def _del_if_owned(self, ip: str, owned: bool) -> None:
+        if not owned:
+            return
+        try:
+            _ip("addr", "del", f"{ip}/32", "dev", "lo")
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def release(self, ip: str) -> None:
+        with self._lock:
+            n = self._refs.get(ip, 0)
+            if n > 1:
+                self._refs[ip] = n - 1
+                return
+            self._refs.pop(ip, None)
+            owned = self._owned.pop(ip, False)
+        self._del_if_owned(ip, owned)
+
+    def release_all(self) -> None:
+        with self._lock:
+            pairs = [(ip, self._owned.get(ip, False)) for ip in self._refs]
+            self._refs.clear()
+            self._owned.clear()
+        for ip, owned in pairs:
+            self._del_if_owned(ip, owned)

@@ -42,6 +42,7 @@ class ServiceInfo:
     session_affinity: str = "None"
     node_port: int = 0
     is_alive: bool = True
+    real: bool = False  # listener bound at the VIP itself (portal.py)
     threads: List[threading.Thread] = field(default_factory=list)
 
 
@@ -53,11 +54,23 @@ class Proxier:
         load_balancer: Optional[LoadBalancerRR] = None,
         rule_table: Optional[PortalRuleTable] = None,
         listen_ip: str = "127.0.0.1",
+        real_portals: bool = False,
     ):
         # `is None` checks: an empty PortalRuleTable is falsy (__len__).
         self.lb = load_balancer if load_balancer is not None else LoadBalancerRR()
         self.rules = rule_table if rule_table is not None else PortalRuleTable()
         self.listen_ip = listen_ip
+        # Real portals (portal.py): install each service VIP on lo and
+        # bind the listener AT clusterIP:port, so clients dial the VIP
+        # directly (the openPortal/iptables analog made literal).
+        # Per-service fallback to the ephemeral-listener + rule-table
+        # mode when the address can't be installed or bound.
+        self._portals = None
+        if real_portals:
+            from kubernetes_tpu.proxy.portal import LoopbackPortals
+
+            if LoopbackPortals.supported():
+                self._portals = LoopbackPortals()
         self._lock = threading.Lock()
         self._services: Dict[ServicePortName, ServiceInfo] = {}
         self._stopped = False
@@ -71,6 +84,8 @@ class Proxier:
             self._services.clear()
         for name, info in infos:
             self._close_service(name, info)
+        if self._portals is not None:
+            self._portals.release_all()
 
     # -- desired state ------------------------------------------------
 
@@ -137,7 +152,10 @@ class Proxier:
             # endpoints event.
             self._close_service(name, info, drop_lb=False)
         proto = port.protocol.upper()
-        sock = self._open_socket(proto)
+        sock, real = self._open_portal_socket(
+            proto, svc.spec.cluster_ip, port.port
+        )
+        proxy_ip = svc.spec.cluster_ip if real else self.listen_ip
         proxy_port = sock.getsockname()[1]
         info = ServiceInfo(
             portal_ip=svc.spec.cluster_ip,
@@ -147,6 +165,7 @@ class Proxier:
             socket=sock,
             session_affinity=svc.spec.session_affinity or "None",
             node_port=getattr(port, "node_port", 0),
+            real=real,
         )
         self.lb.new_service(name, affinity_type=info.session_affinity)
         self.rules.ensure_rule(
@@ -154,7 +173,7 @@ class Proxier:
                 portal_ip=info.portal_ip,
                 portal_port=info.portal_port,
                 protocol=proto,
-                proxy_ip=self.listen_ip,
+                proxy_ip=proxy_ip,
                 proxy_port=proxy_port,
                 service=f"{name[0]}/{name[1]}:{name[2]}",
             )
@@ -167,7 +186,9 @@ class Proxier:
                     portal_ip="0.0.0.0",
                     portal_port=info.node_port,
                     protocol=proto,
-                    proxy_ip=self.listen_ip,
+                    # Must point where the listener actually is — with
+                    # a real portal that is the VIP itself.
+                    proxy_ip=proxy_ip,
                     proxy_port=proxy_port,
                     service=f"{name[0]}/{name[1]}:{name[2]}",
                 )
@@ -190,6 +211,24 @@ class Proxier:
             sock.listen(64)
         return sock
 
+    def _open_portal_socket(self, proto: str, cluster_ip: str, port: int):
+        """(socket, real): bind AT the VIP when real portals are on and
+        the address can be installed; otherwise the classic ephemeral
+        listener on listen_ip with the rule table carrying the DNAT."""
+        if self._portals is not None and self._portals.acquire(cluster_ip):
+            kind = socket.SOCK_STREAM if proto == "TCP" else socket.SOCK_DGRAM
+            sock = socket.socket(socket.AF_INET, kind)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                sock.bind((cluster_ip, port))
+                if proto == "TCP":
+                    sock.listen(64)
+                return sock, True
+            except OSError:
+                sock.close()
+                self._portals.release(cluster_ip)
+        return self._open_socket(proto), False
+
     def _close_service(
         self, name: ServicePortName, info: ServiceInfo, drop_lb: bool = True
     ) -> None:
@@ -203,6 +242,8 @@ class Proxier:
             info.socket.close()
         except OSError:
             pass
+        if info.real and self._portals is not None:
+            self._portals.release(info.portal_ip)
 
     # -- TCP path (reference: proxysocket.go ProxyLoop + proxyTCP) ----
 

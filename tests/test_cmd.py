@@ -68,6 +68,13 @@ class TestHealthServer:
         """Every daemon mounts /healthz + /metrics on its own port
         (scheduler server.go:105-109); unhealthy checks turn the
         endpoint 500."""
+        from kubernetes_tpu.utils import metrics
+
+        # The shared registry may be empty when this file runs alone;
+        # give /metrics something real to render.
+        metrics.DEFAULT.counter(
+            "healthserver_test_total", "health server test counter"
+        ).inc()
         state = {"ok": True}
         srv = daemons.HealthServer(
             0, checks=[lambda: (state["ok"], "ok" if state["ok"] else "down")]
