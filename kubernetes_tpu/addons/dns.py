@@ -122,6 +122,53 @@ class ClusterDNS:
     def port(self) -> int:
         return self.sock.getsockname()[1]
 
+    def publish(self, client, cluster_ip: str = "10.0.0.10",
+                namespace: str = "default") -> None:
+        """Register the kube-dns Service + Endpoints (the reference's
+        skydns-svc.yaml pins the well-known 10.0.0.10). A real-portal
+        kube-proxy then serves DNS at VIP:53/UDP for every process on
+        the host. Selector-less, so the endpoints controller leaves
+        the manual endpoints alone. Idempotent across restarts."""
+        from kubernetes_tpu.server.api import APIError
+
+        svc = {
+            "kind": "Service",
+            "apiVersion": "v1",
+            "metadata": {
+                "name": "kube-dns",
+                "namespace": namespace,
+                "labels": {"kubernetes.io/cluster-service": "true"},
+            },
+            "spec": {
+                "clusterIP": cluster_ip,
+                "ports": [{"name": "dns", "port": 53, "protocol": "UDP"}],
+            },
+        }
+        try:
+            client.get("services", "kube-dns", namespace=namespace)
+        except APIError as e:
+            if e.code != 404:
+                raise
+            client.create("services", svc, namespace=namespace)
+        endpoints = {
+            "kind": "Endpoints",
+            "apiVersion": "v1",
+            "metadata": {"name": "kube-dns", "namespace": namespace},
+            "subsets": [
+                {
+                    "addresses": [{"ip": "127.0.0.1"}],
+                    "ports": [{"name": "dns", "port": self.port,
+                               "protocol": "UDP"}],
+                }
+            ],
+        }
+        try:
+            client.create("endpoints", endpoints, namespace=namespace)
+        except APIError as e:
+            if e.code != 409:
+                raise
+            client.update("endpoints", endpoints, namespace=namespace)
+
     # -- service table (the kube2sky half) ----------------------------
 
     def _key(self, svc: Service) -> str:

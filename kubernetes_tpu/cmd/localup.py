@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-kube-proxy", dest="kube_proxy", action="store_false",
         default=True, help="skip the in-process kube-proxy",
     )
+    p.add_argument(
+        "--cluster-dns", action="store_true",
+        help="start the DNS addon and publish it as the kube-dns "
+        "service at 10.0.0.10 (cluster/addons/dns analog)",
+    )
     return p
 
 
@@ -109,6 +114,30 @@ class LocalCluster:
             self.proxy = ProxyServer(
                 self._client(), real_portals=True
             ).start()
+        self.dns = None
+        if getattr(self.args, "cluster_dns", False):
+            from kubernetes_tpu.addons import ClusterDNS
+
+            client = self._client()
+            self.dns = ClusterDNS(client).start()
+            # Only advertise the well-known VIP when something will
+            # actually listen there: a kube-proxy with real portals.
+            # Otherwise the addon still serves on its own bound port,
+            # but a dead kube-dns service must not be published.
+            if (
+                self.proxy is not None
+                and self.proxy.proxier._portals is not None
+            ):
+                self.dns.publish(client)
+            else:
+                import sys
+
+                print(
+                    "warning: --cluster-dns without real portals; "
+                    f"DNS serves on 127.0.0.1:{self.dns.port} only "
+                    "(kube-dns service not published)",
+                    file=sys.stderr,
+                )
         # Live component health (componentstatuses; the reference
         # master registers etcd + scheduler + controller-manager,
         # pkg/master/master.go getServersToValidate).
@@ -140,6 +169,8 @@ class LocalCluster:
     def stop(self) -> None:
         import shutil
 
+        if getattr(self, "dns", None) is not None:
+            self.dns.stop()
         if getattr(self, "proxy", None) is not None:
             self.proxy.stop()
         self.manager.stop()

@@ -87,6 +87,11 @@ class LoopbackPortals:
             pass
 
     def release(self, ip: str) -> None:
+        # The `ip addr del` runs UNDER the lock: dropping it first
+        # would let a concurrent acquire() adopt the still-present
+        # address ('File exists', owned=False) and bind a listener the
+        # delete then cuts off the VIP forever. Releases are rare and
+        # the subprocess is milliseconds.
         with self._lock:
             n = self._refs.get(ip, 0)
             if n > 1:
@@ -94,12 +99,12 @@ class LoopbackPortals:
                 return
             self._refs.pop(ip, None)
             owned = self._owned.pop(ip, False)
-        self._del_if_owned(ip, owned)
+            self._del_if_owned(ip, owned)
 
     def release_all(self) -> None:
         with self._lock:
             pairs = [(ip, self._owned.get(ip, False)) for ip in self._refs]
             self._refs.clear()
             self._owned.clear()
-        for ip, owned in pairs:
-            self._del_if_owned(ip, owned)
+            for ip, owned in pairs:
+                self._del_if_owned(ip, owned)

@@ -202,11 +202,11 @@ class Proxier:
         self._services[name] = info
         accept.start()
 
-    def _open_socket(self, proto: str):
+    def _open_socket(self, proto: str, ip: str = "", port: int = 0):
         kind = socket.SOCK_STREAM if proto == "TCP" else socket.SOCK_DGRAM
         sock = socket.socket(socket.AF_INET, kind)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.listen_ip, 0))
+        sock.bind((ip or self.listen_ip, port))
         if proto == "TCP":
             sock.listen(64)
         return sock
@@ -216,16 +216,9 @@ class Proxier:
         the address can be installed; otherwise the classic ephemeral
         listener on listen_ip with the rule table carrying the DNAT."""
         if self._portals is not None and self._portals.acquire(cluster_ip):
-            kind = socket.SOCK_STREAM if proto == "TCP" else socket.SOCK_DGRAM
-            sock = socket.socket(socket.AF_INET, kind)
-            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             try:
-                sock.bind((cluster_ip, port))
-                if proto == "TCP":
-                    sock.listen(64)
-                return sock, True
+                return self._open_socket(proto, cluster_ip, port), True
             except OSError:
-                sock.close()
                 self._portals.release(cluster_ip)
         return self._open_socket(proto), False
 

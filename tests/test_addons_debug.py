@@ -16,7 +16,7 @@ from kubernetes_tpu.server.api import APIServer
 from kubernetes_tpu.server.httpserver import APIHTTPServer
 
 
-def dns_query(port, name, timeout=2.0):
+def dns_query(port, name, timeout=2.0, host="127.0.0.1"):
     """Send one A query with the stdlib only; return resolved IP or
     None (NXDOMAIN)."""
     qname = b"".join(
@@ -27,7 +27,7 @@ def dns_query(port, name, timeout=2.0):
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     s.settimeout(timeout)
     try:
-        s.sendto(q, ("127.0.0.1", port))
+        s.sendto(q, (host, port))
         data, _ = s.recvfrom(512)
     finally:
         s.close()
@@ -138,3 +138,67 @@ class TestDebugEndpoints:
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(server.address + "/debug/nope")
         assert e.value.code == 404
+
+
+class TestKubeDNSService:
+    """The DNS addon published as the well-known kube-dns service
+    (cluster/addons/dns skydns-svc.yaml pins 10.0.0.10): with a
+    real-portal kube-proxy, VIP:53/UDP actually answers queries."""
+
+    def test_dns_reachable_at_the_well_known_vip(self):
+        from kubernetes_tpu.addons import ClusterDNS
+        from kubernetes_tpu.proxy.config import ProxyServer
+        from kubernetes_tpu.proxy.portal import LoopbackPortals
+
+        if not LoopbackPortals.supported():
+            pytest.skip("needs CAP_NET_ADMIN for real portals")
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        dns = ClusterDNS(client).start()
+        proxy = None
+        try:
+            dns.publish(client)
+            svc = api.get("services", "default", "kube-dns")
+            assert svc["spec"]["clusterIP"] == "10.0.0.10"
+            client.create(
+                "services", service_wire("web", "10.0.0.77"),
+                namespace="default",
+            )
+            proxy = ProxyServer(client, real_portals=True).start()
+
+            def resolves():
+                try:
+                    return (
+                        dns_query(
+                            53, "web.default.svc.cluster.local",
+                            host="10.0.0.10",
+                        )
+                        == "10.0.0.77"
+                    )
+                except (OSError, AssertionError):
+                    return False
+
+            deadline = time.monotonic() + 10
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                ok = resolves()
+                time.sleep(0.2)
+            assert ok, "kube-dns VIP never answered"
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            dns.stop()
+
+    def test_publish_idempotent(self):
+        from kubernetes_tpu.addons import ClusterDNS
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        dns = ClusterDNS(client).start()
+        try:
+            dns.publish(client)
+            dns.publish(client)  # restart: must not conflict
+            eps = api.get("endpoints", "default", "kube-dns")
+            assert eps["subsets"][0]["ports"][0]["port"] == dns.port
+        finally:
+            dns.stop()
