@@ -199,26 +199,39 @@ def print_objs(resource: str, objs: List[Any], fmt: str, out=None) -> None:
 
 
 def load_manifests(filename: str) -> List[Dict]:
-    """Files, stdin ('-'), or URLs — the reference resource builder's
-    input surface (pkg/kubectl/resource/builder.go:77-126)."""
+    """Files, directories, stdin ('-'), or URLs — the reference
+    resource builder's input surface (builder.go:77-126; directories
+    visit every .json/.yaml/.yml inside, sorted)."""
+    import os
+
     if filename == "-":
-        text = sys.stdin.read()
+        texts = [sys.stdin.read()]
     elif filename.startswith(("http://", "https://")):
         import urllib.request
 
         with urllib.request.urlopen(filename, timeout=30) as resp:
-            text = resp.read().decode()
+            texts = [resp.read().decode()]
+    elif os.path.isdir(filename):
+        texts = []
+        for entry in sorted(os.listdir(filename)):
+            if not entry.endswith((".json", ".yaml", ".yml")):
+                continue
+            with open(os.path.join(filename, entry)) as f:
+                texts.append(f.read())
+        if not texts:
+            raise SystemExit(f"error: no manifests in directory {filename!r}")
     else:
         with open(filename) as f:
-            text = f.read()
+            texts = [f.read()]
     docs: List[Dict] = []
-    for doc in yaml.safe_load_all(text):
-        if not doc:
-            continue
-        if doc.get("kind") == "List":
-            docs.extend(doc.get("items", []))
-        else:
-            docs.append(doc)
+    for text in texts:
+        for doc in yaml.safe_load_all(text):
+            if not doc:
+                continue
+            if doc.get("kind") == "List":
+                docs.extend(doc.get("items", []))
+            else:
+                docs.append(doc)
     return docs
 
 
@@ -331,8 +344,29 @@ def cmd_delete(client: Client, args) -> int:
             client.delete(resource, name, namespace=args.namespace)
             print(f"{resource}/{name} deleted")
         return 0
+    if args.resource and args.name and getattr(args, "selector", None):
+        # kubectl errors on NAME + -l: a selector meant as a safety
+        # scope must never be silently ignored.
+        raise SystemExit("error: delete takes a NAME or -l SELECTOR, not both")
+    if args.resource and not args.name and getattr(args, "selector", None):
+        # Selector-based delete (reference: delete.go over the
+        # builder's selector path).
+        resource = resolve_resource(args.resource)
+        objs, _ = client.list(
+            resource, namespace=args.namespace, label_selector=args.selector
+        )
+        if not objs:
+            print(f"No resources found matching -l {args.selector}")
+            return 0
+        for o in objs:
+            client.delete(resource, o.metadata.name, namespace=args.namespace)
+            print(f"{resource}/{o.metadata.name} deleted")
+        return 0
     if not args.resource or not args.name:
-        raise SystemExit("error: delete requires RESOURCE NAME or -f FILE")
+        raise SystemExit(
+            "error: delete requires RESOURCE NAME, RESOURCE -l SELECTOR, "
+            "or -f FILE"
+        )
     resource = resolve_resource(args.resource)
     client.delete(resource, args.name, namespace=args.namespace)
     print(f"{resource}/{args.name} deleted")
@@ -1011,6 +1045,7 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("resource", nargs="?")
     d.add_argument("name", nargs="?")
     d.add_argument("--filename", "-f")
+    d.add_argument("--selector", "-l")
     d.set_defaults(fn=cmd_delete)
 
     ds = sub.add_parser("describe", parents=[common])

@@ -405,6 +405,13 @@ def kubelet_parser() -> argparse.ArgumentParser:
         "process runtime when --root-dir is set",
     )
     p.add_argument("--http-port", type=int, default=0)
+    p.add_argument(
+        "--cluster-dns", default="",
+        help="DNS VIP injected into containers as "
+        "KUBERNETES_CLUSTER_DNS (reference: kubelet --cluster-dns "
+        "writes pod resolv.conf)",
+    )
+    p.add_argument("--cluster-domain", default="cluster.local")
     return p
 
 
@@ -420,6 +427,13 @@ def start_kubelet(args, client=None):
         from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
 
         runtime = ProcessRuntime(args.root_dir, node_name=args.node_name)
+    if getattr(args, "cluster_dns", ""):
+        # Reference: --cluster-dns/--cluster-domain flow into every
+        # container's resolv.conf (cmd/kubelet/app/server.go); the
+        # process-runtime analog is env injection — apps dial the DNS
+        # VIP directly (it is really routable under real portals).
+        runtime.cluster_dns = args.cluster_dns
+        runtime.cluster_domain = getattr(args, "cluster_domain", "cluster.local")
     return Kubelet(
         client,
         node_name=args.node_name,

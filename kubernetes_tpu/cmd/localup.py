@@ -121,14 +121,21 @@ class LocalCluster:
             client = self._client()
             self.dns = ClusterDNS(client).start()
             # Only advertise the well-known VIP when something will
-            # actually listen there: a kube-proxy with real portals.
-            # Otherwise the addon still serves on its own bound port,
-            # but a dead kube-dns service must not be published.
+            # actually listen there: a real-portal kube-proxy AND a
+            # bindable 10.0.0.10:53 (CAP_NET_ADMIN alone doesn't imply
+            # low-port bind rights). Otherwise the addon still serves
+            # on its own bound port, but a dead kube-dns service must
+            # not be published.
             if (
                 self.proxy is not None
-                and self.proxy.proxier._portals is not None
+                and self.proxy.proxier.has_real_portals
+                and self._dns_vip_bindable("10.0.0.10", 53)
             ):
                 self.dns.publish(client)
+                # Containers get the resolver address the reference
+                # kubelet would write into resolv.conf.
+                for kubelet in self.kubelets:
+                    kubelet.runtime.cluster_dns = "10.0.0.10"
             else:
                 import sys
 
@@ -149,6 +156,29 @@ class LocalCluster:
             "controller-manager", self._manager_health
         )
         return self
+
+    @staticmethod
+    def _dns_vip_bindable(ip: str, port: int) -> bool:
+        """Probe that the kube-dns VIP:port can actually be bound (the
+        proxier will do exactly this once the service appears)."""
+        import socket
+
+        from kubernetes_tpu.proxy.portal import LoopbackPortals
+
+        portals = LoopbackPortals()
+        if not portals.acquire(ip):
+            return False
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.bind((ip, port))
+                return True
+            except OSError:
+                return False
+            finally:
+                s.close()
+        finally:
+            portals.release(ip)
 
     def _scheduler_health(self):
         sched = self.scheduler

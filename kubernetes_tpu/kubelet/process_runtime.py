@@ -107,6 +107,11 @@ class ProcessRuntime(ContainerRuntime):
         # container START, like the reference — service churn does not
         # restart running containers.
         self.service_env: Dict[str, Dict[str, str]] = {}
+        # Cluster DNS surface (kubelet --cluster-dns/--cluster-domain;
+        # the reference writes these into pod resolv.conf, here they
+        # reach apps as env).
+        self.cluster_dns: str = ""
+        self.cluster_domain: str = "cluster.local"
         # "uid/name" -> restart count to apply at next (re)start; set
         # by restart_container, consumed by sync_pod.
         self._restart_counts: Dict[str, int] = {}
@@ -248,6 +253,9 @@ class ProcessRuntime(ContainerRuntime):
         env["KUBERNETES_CONTAINER_NAME"] = spec.name
         if self.node_name:
             env["KUBERNETES_NODE_NAME"] = self.node_name
+        if self.cluster_dns:
+            env["KUBERNETES_CLUSTER_DNS"] = self.cluster_dns
+            env["KUBERNETES_CLUSTER_DOMAIN"] = self.cluster_domain
         # Where this pod's mounted volumes live (host-network process
         # runtime: volumes are directories under the kubelet root,
         # <volumes-dir>/<escaped-plugin>/<volume-name>).

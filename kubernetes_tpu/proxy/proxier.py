@@ -202,13 +202,22 @@ class Proxier:
         self._services[name] = info
         accept.start()
 
+    @property
+    def has_real_portals(self) -> bool:
+        """Whether VIP-bound portals are available in this proxier."""
+        return self._portals is not None
+
     def _open_socket(self, proto: str, ip: str = "", port: int = 0):
         kind = socket.SOCK_STREAM if proto == "TCP" else socket.SOCK_DGRAM
         sock = socket.socket(socket.AF_INET, kind)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((ip or self.listen_ip, port))
-        if proto == "TCP":
-            sock.listen(64)
+        try:
+            sock.bind((ip or self.listen_ip, port))
+            if proto == "TCP":
+                sock.listen(64)
+        except OSError:
+            sock.close()
+            raise
         return sock
 
     def _open_portal_socket(self, proto: str, cluster_ip: str, port: int):

@@ -291,3 +291,51 @@ class TestGetWatch:
         t.join()
         assert "pods/post" in out
         assert "pods/pre" not in out
+
+
+class TestBuilderInputs:
+    """Resource-builder surface: directories visit every manifest
+    (builder.go:77-126); selector-based delete (delete.go)."""
+
+    def test_create_from_directory(self, tmp_path):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        d = tmp_path / "manifests"
+        d.mkdir()
+        for i in range(2):
+            (d / f"pod{i}.json").write_text(json.dumps({
+                "kind": "Pod",
+                "metadata": {"name": f"dirpod{i}"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            }))
+        (d / "notes.txt").write_text("ignored")
+        out = run_main("create", "-f", str(d), client=client)
+        assert "pods/dirpod0 created" in out and "pods/dirpod1 created" in out
+
+    def test_empty_directory_errors(self, tmp_path):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        d = tmp_path / "empty"
+        d.mkdir()
+        with pytest.raises(SystemExit):
+            main(["create", "-f", str(d)], client=client)
+
+    def test_delete_by_selector(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        for i in range(3):
+            client.create("pods", {
+                "kind": "Pod",
+                "metadata": {"name": f"victim{i}",
+                             "labels": {"app": "doomed"}},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            }, namespace="default")
+        client.create("pods", {
+            "kind": "Pod",
+            "metadata": {"name": "keeper", "labels": {"app": "safe"}},
+            "spec": {"containers": [{"name": "c", "image": "x"}]},
+        }, namespace="default")
+        out = run_main("delete", "pods", "-l", "app=doomed", client=client)
+        assert out.count("deleted") == 3
+        pods, _ = client.list("pods", namespace="default")
+        assert [p.metadata.name for p in pods] == ["keeper"]

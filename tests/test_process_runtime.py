@@ -482,3 +482,19 @@ class TestClusterLogAggregator:
             assert len(agg.search("s2", pod="stepper")) == 1
         finally:
             agg.stop()
+
+
+class TestClusterDNSEnv:
+    def test_cluster_dns_env_injected(self, runtime):
+        """kubelet --cluster-dns surface: containers see the DNS VIP
+        (the reference writes resolv.conf; env is the process-runtime
+        analog)."""
+        runtime.cluster_dns = "10.0.0.10"
+        pod = mk_pod("dnsenv", ["/bin/sh", "-c",
+                                "echo DNS=$KUBERNETES_CLUSTER_DNS"
+                                " DOM=$KUBERNETES_CLUSTER_DOMAIN; sleep 30"])
+        runtime.sync_pod(pod)
+        assert wait_for(
+            lambda: "DNS=10.0.0.10 DOM=cluster.local"
+            in runtime.read_logs("dnsenv", "main")
+        )
