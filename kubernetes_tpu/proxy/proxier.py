@@ -43,6 +43,7 @@ class ServiceInfo:
     node_port: int = 0
     is_alive: bool = True
     real: bool = False  # listener bound at the VIP itself (portal.py)
+    node_socket: object = None  # extra listener at the node port itself
     threads: List[threading.Thread] = field(default_factory=list)
 
 
@@ -145,7 +146,29 @@ class Proxier:
                 and info.session_affinity == (svc.spec.session_affinity or "None")
                 and info.node_port == getattr(port, "node_port", 0)
             ):
-                return  # unchanged
+                # Unchanged spec — but a node-port bind that lost its
+                # port to a squatter retries on every sync, so the
+                # degradation heals once the port frees up.
+                if info.node_port and info.node_socket is None:
+                    try:
+                        info.node_socket = self._open_socket(
+                            info.protocol, self.listen_ip, info.node_port
+                        )
+                    except OSError:
+                        return
+                    serve = (
+                        self._tcp_accept_loop
+                        if info.protocol == "TCP"
+                        else self._udp_loop
+                    )
+                    t = threading.Thread(
+                        target=serve,
+                        args=(name, info, info.node_socket),
+                        daemon=True,
+                    )
+                    info.threads.append(t)
+                    t.start()
+                return
             # Reconfiguration: tear down the portal but KEEP the load
             # balancer's endpoint list — endpoints didn't change, and a
             # fresh empty entry would blackhole until the next
@@ -179,7 +202,10 @@ class Proxier:
             )
         )
         # NodePort: an extra rule on the node's own address (reference
-        # proxier.go openNodePort).
+        # proxier.go openNodePort) PLUS a real listener at the node
+        # port itself — the analog of the iptables redirect that makes
+        # nodeAddr:nodePort actually accept traffic. Bind failure
+        # (port squatted) degrades to the rule-only entry.
         if info.node_port:
             self.rules.ensure_rule(
                 PortalRule(
@@ -193,14 +219,22 @@ class Proxier:
                     service=f"{name[0]}/{name[1]}:{name[2]}",
                 )
             )
-        accept = threading.Thread(
-            target=self._tcp_accept_loop if proto == "TCP" else self._udp_loop,
-            args=(name, info),
-            daemon=True,
-        )
-        info.threads.append(accept)
+            try:
+                info.node_socket = self._open_socket(
+                    proto, self.listen_ip, info.node_port
+                )
+            except OSError:
+                info.node_socket = None
+        serve = self._tcp_accept_loop if proto == "TCP" else self._udp_loop
+        socks = [sock] + ([info.node_socket] if info.node_socket else [])
+        for s in socks:
+            accept = threading.Thread(
+                target=serve, args=(name, info, s), daemon=True
+            )
+            info.threads.append(accept)
         self._services[name] = info
-        accept.start()
+        for t in info.threads:
+            t.start()
 
     @property
     def has_real_portals(self) -> bool:
@@ -240,19 +274,25 @@ class Proxier:
             self.rules.delete_rule("0.0.0.0", info.node_port, info.protocol)
         if drop_lb:
             self.lb.delete_service(name)
-        try:
-            info.socket.close()
-        except OSError:
-            pass
+        for s in (info.socket, info.node_socket):
+            if s is None:
+                continue
+            try:
+                s.close()
+            except OSError:
+                pass
         if info.real and self._portals is not None:
             self._portals.release(info.portal_ip)
 
     # -- TCP path (reference: proxysocket.go ProxyLoop + proxyTCP) ----
 
-    def _tcp_accept_loop(self, name: ServicePortName, info: ServiceInfo) -> None:
+    def _tcp_accept_loop(
+        self, name: ServicePortName, info: ServiceInfo, sock=None
+    ) -> None:
+        sock = sock if sock is not None else info.socket
         while info.is_alive:
             try:
-                client, addr = info.socket.accept()
+                client, addr = sock.accept()
             except OSError:
                 return
             try:
@@ -306,9 +346,14 @@ class Proxier:
 
     # -- UDP path (reference: udp_server.go / proxysocket.go UDP) -----
 
-    def _udp_loop(self, name: ServicePortName, info: ServiceInfo) -> None:
+    def _udp_loop(
+        self, name: ServicePortName, info: ServiceInfo, sock=None
+    ) -> None:
         # client addr -> backend socket: UDP "sessions" keyed on the
-        # 5-tuple, as the reference's activeClients map does.
+        # 5-tuple, as the reference's activeClients map does. `sock` is
+        # the ingress socket this loop serves (portal or node port);
+        # replies must leave through the same one.
+        sock = sock if sock is not None else info.socket
         sessions: Dict[Tuple[str, int], socket.socket] = {}
 
         def reply_loop(client_addr, backend_sock):
@@ -318,7 +363,7 @@ class Proxier:
                     data = backend_sock.recv(_BUFSIZE)
                     if not data:
                         break
-                    info.socket.sendto(data, client_addr)
+                    sock.sendto(data, client_addr)
             except OSError:
                 pass
             finally:
@@ -330,7 +375,7 @@ class Proxier:
 
         while info.is_alive:
             try:
-                data, client_addr = info.socket.recvfrom(_BUFSIZE)
+                data, client_addr = sock.recvfrom(_BUFSIZE)
             except OSError:
                 return
             backend_sock = sessions.get(client_addr)
