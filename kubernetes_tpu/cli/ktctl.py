@@ -547,17 +547,58 @@ def cmd_run(client: Client, args) -> int:
 
 def cmd_logs(client: Client, args) -> int:
     """Reference: pkg/kubectl/cmd/log.go — fetch container logs via the
-    apiserver's pod log subresource."""
-    out = client.pod_logs(
-        args.name,
-        namespace=args.namespace,
-        container=args.container or "",
-        tail=args.tail,
-    )
-    sys.stdout.write(out)
-    if out and not out.endswith("\n"):
-        sys.stdout.write("\n")
-    return 0
+    apiserver's pod log subresource; -f polls for new lines until the
+    pod disappears or the user interrupts."""
+    if not getattr(args, "follow", False):
+        out = client.pod_logs(
+            args.name,
+            namespace=args.namespace,
+            container=args.container or "",
+            tail=args.tail,
+        )
+        sys.stdout.write(out)
+        if out and not out.endswith("\n"):
+            sys.stdout.write("\n")
+        return 0
+    import time as _time
+
+    # Char-offset diffing (not line counts): a poll that catches a
+    # partially-written last line must emit the rest on the next poll,
+    # not lose it. Each poll refetches the full log through the relay —
+    # the subresource has no offset parameter; acceptable for the dev
+    # clusters this CLI drives.
+    seen = 0
+    rounds = 0
+    fetched = False
+    limit = getattr(args, "follow_rounds", None)  # test hook
+    while True:
+        try:
+            text = client.pod_logs(
+                args.name, namespace=args.namespace,
+                container=args.container or "",
+            )
+            if not fetched:
+                fetched = True
+                if args.tail is not None:
+                    # Honor --tail on the first emission: skip
+                    # everything before the last N lines.
+                    cut = text.splitlines(keepends=True)[-args.tail:]
+                    seen = len(text) - sum(len(c) for c in cut)
+            if len(text) < seen:
+                seen = 0  # log truncated/rotated: re-emit
+            sys.stdout.write(text[seen:])
+            sys.stdout.flush()
+            seen = len(text)
+            rounds += 1
+            if limit is not None and rounds >= limit:
+                return 0
+            _time.sleep(0.5)
+        except APIError as e:
+            if e.code == 404 and fetched:
+                return 0  # pod gone mid-stream: clean end
+            raise  # never-seen pod: surface the error like plain logs
+        except KeyboardInterrupt:
+            return 0
 
 
 def cmd_exec(client: Client, args) -> int:
@@ -1100,6 +1141,10 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("name")
     lg.add_argument("--container", "-c", default="")
     lg.add_argument("--tail", type=int, default=None)
+    lg.add_argument("--follow", "-f", action="store_true",
+                    help="stream new lines until the pod goes away")
+    lg.add_argument("--follow-rounds", type=int, default=None,
+                    help=argparse.SUPPRESS)  # exit after N polls (tests)
     lg.set_defaults(fn=cmd_logs)
 
     ee = sub.add_parser("exec", parents=[common])
@@ -1212,6 +1257,13 @@ def main(argv: Optional[List[str]] = None, client: Optional[Client] = None) -> i
     except APIError as e:
         print(f"Error from server ({e.reason}): {e.message}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # stdout consumer went away (logs -f | head): end quietly.
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        return 0
     except (OSError, ConnectionError) as e:
         print(f"Unable to connect to server {args.server}: {e}", file=sys.stderr)
         return 1

@@ -244,7 +244,12 @@ class Proxier:
     def _open_socket(self, proto: str, ip: str = "", port: int = 0):
         kind = socket.SOCK_STREAM if proto == "TCP" else socket.SOCK_DGRAM
         sock = socket.socket(socket.AF_INET, kind)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # No SO_REUSEADDR on fixed-port UDP binds: two REUSEADDR UDP
+        # sockets can both bind the same addr:port with datagrams going
+        # to only one of them — the bind must FAIL (degrade to the
+        # rule-table entry) rather than silently steal or lose traffic.
+        if not (kind == socket.SOCK_DGRAM and port):
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
             sock.bind((ip or self.listen_ip, port))
             if proto == "TCP":

@@ -498,3 +498,68 @@ class TestClusterDNSEnv:
             lambda: "DNS=10.0.0.10 DOM=cluster.local"
             in runtime.read_logs("dnsenv", "main")
         )
+
+
+class TestLogsFollow:
+    def test_follow_streams_new_lines(self, cluster):
+        """ktctl logs -f polls the log subresource and emits only new
+        lines (log.go follow)."""
+        import io
+        import sys as _sys
+
+        from kubernetes_tpu.cli.ktctl import main as ktctl_main
+
+        api, client, kubelet, runtime = cluster
+        _schedule(
+            client, "flw",
+            ["/bin/sh", "-c", "echo first; sleep 1; echo second; sleep 30"],
+        )
+        assert wait_for(lambda: _pod_running(client, runtime, "flw"))
+        assert wait_for(lambda: "first" in client.pod_logs("flw"))
+        out = io.StringIO()
+        old = _sys.stdout
+        _sys.stdout = out
+        try:
+            rc = ktctl_main(
+                ["logs", "flw", "-f", "--follow-rounds", "6"], client=client
+            )
+        finally:
+            _sys.stdout = old
+        assert rc == 0
+        text = out.getvalue()
+        assert "first" in text and "second" in text
+        assert text.count("first") == 1  # no re-emission across polls
+
+    def test_follow_ends_when_pod_deleted(self, cluster):
+        import io
+        import sys as _sys
+        import threading
+
+        from kubernetes_tpu.cli.ktctl import main as ktctl_main
+
+        api, client, kubelet, runtime = cluster
+        _schedule(client, "gone", ["/bin/sh", "-c", "echo x; sleep 30"])
+        assert wait_for(lambda: _pod_running(client, runtime, "gone"))
+
+        def deleter():
+            time.sleep(1.0)
+            client.delete("pods", "gone", namespace="default")
+
+        t = threading.Thread(target=deleter)
+        t.start()
+        out = io.StringIO()
+        old = _sys.stdout
+        _sys.stdout = out
+        try:
+            rc = ktctl_main(["logs", "gone", "-f"], client=client)
+        finally:
+            _sys.stdout = old
+        t.join()
+        assert rc == 0
+
+    def test_follow_unknown_pod_errors(self, cluster):
+        from kubernetes_tpu.cli.ktctl import main as ktctl_main
+
+        api, client, kubelet, runtime = cluster
+        rc = ktctl_main(["logs", "nosuchpod", "-f"], client=client)
+        assert rc == 1  # surfaced like plain logs, not silent success
