@@ -154,6 +154,18 @@ def apiserver_parser() -> argparse.ArgumentParser:
     p.add_argument("--basic-auth-file", default="")
     p.add_argument("--token-auth-file", default="")
     p.add_argument("--authorization-policy-file", default="")
+    p.add_argument(
+        "--data-dir", default="",
+        help="directory for the durable store (WAL + snapshots); empty "
+        "keeps master state in memory only. Plays etcd's role in the "
+        "reference (hack/local-up-cluster.sh:152-153).",
+    )
+    p.add_argument(
+        "--data-fsync", action="store_true",
+        help="fsync every WAL append (power-loss durability; default "
+        "flushes to the OS, which survives process death but not "
+        "power loss)",
+    )
     return p
 
 
@@ -162,7 +174,14 @@ def start_apiserver(args):
     from kubernetes_tpu.server.api import APIServer
     from kubernetes_tpu.server.httpserver import APIHTTPServer
 
-    api = APIServer()
+    store = None
+    if getattr(args, "data_dir", ""):
+        from kubernetes_tpu.store.kvstore import KVStore
+
+        store = KVStore(
+            data_dir=args.data_dir, fsync=getattr(args, "data_fsync", False)
+        )
+    api = APIServer(store=store)
     if args.admission_control:
         from kubernetes_tpu.server import admission as adm
 

@@ -16,6 +16,14 @@ Semantics mirror the reference's etcd usage through EtcdHelper
 - Values are wire-form dicts (deep-copied on the way in and out), so
   storage is serialization-faithful like etcd's JSON payloads.
 - Optional per-key TTL (events registry uses it, reference: event TTL).
+- Optional durability (`data_dir=`): every mutation is appended to a
+  JSON-lines write-ahead log and the full state is periodically
+  snapshotted; construction replays snapshot + WAL so an apiserver
+  restarted on the same --data-dir recovers every object, binding and
+  allocator lease with the resourceVersion clock intact. This is the
+  role etcd plays for the reference (pkg/tools/etcd_helper.go:101,
+  hack/local-up-cluster.sh:152-153): master state must survive process
+  death. TTLs are wall-clock deadlines so they age across restarts.
 
 Thread-safe; many reader/writer threads, one lock (control-plane rates
 are tiny next to the TPU solver's work).
@@ -24,6 +32,8 @@ are tiny next to the TPU solver's work).
 from __future__ import annotations
 
 import copy
+import json
+import os
 import threading
 import time
 from collections import deque
@@ -53,15 +63,147 @@ class CompactedError(StoreError):
 
 
 class KVStore:
-    def __init__(self, history_limit: int = 10000):
+    def __init__(
+        self,
+        history_limit: int = 10000,
+        data_dir: Optional[str] = None,
+        fsync: bool = False,
+        snapshot_every: int = 4096,
+    ):
         self._lock = threading.RLock()
         self._data: Dict[str, Tuple[dict, int]] = {}  # key -> (wire obj, version)
-        self._ttl: Dict[str, float] = {}  # key -> expiry monotonic time
+        self._ttl: Dict[str, float] = {}  # key -> expiry wall-clock time
         self._version = 0
         # History ring for watch replay: (version, type, key, obj).
         self._history: deque = deque(maxlen=history_limit)
         self._oldest = 0  # lowest version NOT compacted out of history
         self._watchers: List[Tuple[str, WatchStream]] = []  # (prefix, stream)
+        # Durability (off when data_dir is None — tests/benches that
+        # want a pure in-memory store keep the old behavior).
+        # TTL clock: wall time for durable stores (deadlines must age
+        # across restarts), monotonic for in-memory ones (immune to
+        # NTP steps — the pre-durability behavior).
+        self._now = time.time if data_dir else time.monotonic
+        self._fsync = fsync
+        self._snapshot_every = snapshot_every
+        self._wal_file = None
+        self._wal_count = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._snap_path = os.path.join(data_dir, "snapshot.json")
+            self._wal_path = os.path.join(data_dir, "wal.log")
+            replayed = self._recover()
+            self._wal_file = open(self._wal_path, "a", encoding="utf-8")
+            if replayed:
+                # Compact on boot: fold the replayed tail into a fresh
+                # snapshot so the next recovery is O(snapshot).
+                self._snapshot_locked()
+            # Age out TTL'd keys that expired while we were down; goes
+            # through the normal delete path so the WAL records it.
+            self._expire_locked()
+
+    # -- durability ---------------------------------------------------
+
+    def _recover(self) -> int:
+        """Load snapshot then replay WAL records newer than it.
+
+        Tolerates a torn final WAL line (the process died mid-append;
+        that write was never acknowledged... the apiserver responds
+        only after create/set/delete return, which is after the append)
+        by truncating the file back to the last intact record, so the
+        next append never fuses onto torn bytes. Returns the number of
+        WAL records replayed.
+        """
+        snap_version = 0
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "r", encoding="utf-8") as f:
+                snap = json.load(f)
+            snap_version = snap["version"]
+            for key, obj, ver, exp in snap["items"]:
+                self._data[key] = (obj, ver)
+                if exp is not None:
+                    self._ttl[key] = exp
+            self._version = snap_version
+        replayed = 0
+        if os.path.exists(self._wal_path):
+            torn = False
+            with open(self._wal_path, "rb") as f:
+                good_offset = 0
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        torn = True  # mid-append crash, unacked
+                        break
+                    line = raw.strip()
+                    if line:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            torn = True
+                            break
+                        v = rec["v"]
+                        if v > snap_version:  # else folded into snapshot
+                            key = rec["k"]
+                            if rec["t"] == DELETED:
+                                self._data.pop(key, None)
+                                self._ttl.pop(key, None)
+                            else:
+                                self._data[key] = (rec["o"], v)
+                                if rec.get("e") is not None:
+                                    self._ttl[key] = rec["e"]
+                                else:
+                                    self._ttl.pop(key, None)
+                            self._version = max(self._version, v)
+                            replayed += 1
+                    good_offset += len(raw)
+            if torn:
+                with open(self._wal_path, "r+b") as f:
+                    f.truncate(good_offset)
+        return replayed
+
+    def _wal_append(self, version: int, etype: str, key: str, obj: dict) -> None:
+        if self._wal_file is None:
+            return
+        rec = {"v": version, "t": etype, "k": key}
+        if etype != DELETED:
+            rec["o"] = obj
+            exp = self._ttl.get(key)
+            if exp is not None:
+                rec["e"] = exp
+        self._wal_file.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal_file.flush()
+        if self._fsync:
+            os.fsync(self._wal_file.fileno())
+        self._wal_count += 1
+        if self._wal_count >= self._snapshot_every:
+            self._snapshot_locked()
+
+    def _snapshot_locked(self) -> None:
+        """Write the full state atomically, then truncate the WAL.
+
+        Crash-safe in both orders: a crash after the rename but before
+        the truncate leaves WAL records with v <= snapshot version,
+        which _recover skips.
+        """
+        items = [
+            [key, obj, ver, self._ttl.get(key)]
+            for key, (obj, ver) in sorted(self._data.items())
+        ]
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": self._version, "items": items}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._wal_file is not None:
+            self._wal_file.close()
+        self._wal_file = open(self._wal_path, "w", encoding="utf-8")
+        self._wal_count = 0
+
+    def snapshot(self) -> None:
+        """Force a snapshot + WAL truncation (no-op for in-memory stores)."""
+        with self._lock:
+            if self._wal_file is not None:
+                self._snapshot_locked()
 
     # -- version plumbing ---------------------------------------------
 
@@ -82,7 +224,7 @@ class KVStore:
     def _expire_locked(self) -> None:
         if not self._ttl:
             return
-        now = time.monotonic()
+        now = self._now()
         expired = [k for k, t in self._ttl.items() if t <= now]
         for k in expired:
             del self._ttl[k]
@@ -95,6 +237,7 @@ class KVStore:
         # History and watch consumers get their own copies: stored state
         # must never be reachable (hence mutable) through an event.
         obj = copy.deepcopy(obj)
+        self._wal_append(version, etype, key, obj)
         if not self._history:
             self._oldest = version
         self._history.append((version, etype, key, obj))
@@ -122,7 +265,7 @@ class KVStore:
             self._stamp(obj, v)
             self._data[key] = (obj, v)
             if ttl is not None:
-                self._ttl[key] = time.monotonic() + ttl
+                self._ttl[key] = self._now() + ttl
             self._record(v, ADDED, key, obj)
             return copy.deepcopy(obj)
 
@@ -216,10 +359,15 @@ class KVStore:
         """
         with self._lock:
             self._expire_locked()
-            if since and self._history and since + 1 < self._oldest:
-                raise CompactedError(
-                    f"version {since} compacted (oldest {self._oldest})"
-                )
+            # The replayable floor: with history, anything >= oldest-1;
+            # without (fresh boot / post-restart), only "now" — an older
+            # `since` has missed events that no longer exist, so 410.
+            if since and since < self._version:
+                if not self._history or since + 1 < self._oldest:
+                    raise CompactedError(
+                        f"version {since} compacted "
+                        f"(oldest {self._oldest if self._history else self._version})"
+                    )
             stream = WatchStream(maxsize=maxsize)
             self._watchers = [(p, s) for p, s in self._watchers if not s.closed]
             self._watchers.append((prefix, stream))
@@ -239,3 +387,6 @@ class KVStore:
             for _, s in self._watchers:
                 s.close()
             self._watchers = []
+            if self._wal_file is not None:
+                self._wal_file.close()
+                self._wal_file = None

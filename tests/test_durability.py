@@ -1,0 +1,338 @@
+"""Durable store: WAL + snapshot recovery.
+
+The reference keeps all master state in etcd, so an apiserver process
+death loses nothing (pkg/tools/etcd_helper.go:101, external daemon per
+hack/local-up-cluster.sh:152-153). Here the KVStore itself is durable
+when given a data_dir: these tests kill the apiserver with pods
+mid-churn, restart it on the same data-dir, and assert every object,
+binding, and allocator lease survives with version monotonicity intact
+(VERDICT round-2 item 1).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+from kubernetes_tpu.store.kvstore import (
+    CompactedError,
+    ConflictError,
+    KVStore,
+    NotFoundError,
+)
+
+
+def obj(name, **extra):
+    return {"kind": "Pod", "metadata": {"name": name}, **extra}
+
+
+class TestKVStoreRecovery:
+    def test_objects_survive_reopen(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        s.create("/registry/pods/default/a", obj("a"))
+        s.create("/registry/pods/default/b", obj("b"))
+        s.set("/registry/pods/default/a", obj("a", spec={"nodeName": "n1"}))
+        s.delete("/registry/pods/default/b")
+        v_before = s.version
+        s.close()
+
+        s2 = KVStore(data_dir=d)
+        got = s2.get("/registry/pods/default/a")
+        assert got["spec"] == {"nodeName": "n1"}
+        with pytest.raises(NotFoundError):
+            s2.get("/registry/pods/default/b")
+        # The logical clock never moves backwards across restarts.
+        assert s2.version >= v_before
+        nxt = s2.create("/registry/pods/default/c", obj("c"))
+        assert int(nxt["metadata"]["resourceVersion"]) > v_before
+
+    def test_per_key_versions_survive(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        created = s.create("/k/a", obj("a"))
+        rv = int(created["metadata"]["resourceVersion"])
+        s.close()
+        s2 = KVStore(data_dir=d)
+        assert int(s2.get("/k/a")["metadata"]["resourceVersion"]) == rv
+        # CAS against the recovered version works; stale version conflicts.
+        s2.set("/k/a", obj("a2"), expected_version=rv)
+        with pytest.raises(ConflictError):
+            s2.set("/k/a", obj("a3"), expected_version=rv)
+
+    def test_ttl_is_wall_clock_across_restart(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        s.create("/k/ephemeral", obj("e"), ttl=0.2)
+        s.create("/k/durable", obj("d"), ttl=60.0)
+        s.close()
+        time.sleep(0.25)
+        s2 = KVStore(data_dir=d)
+        with pytest.raises(NotFoundError):
+            s2.get("/k/ephemeral")
+        assert s2.get("/k/durable")["metadata"]["name"] == "d"
+
+    def test_snapshot_rollover_truncates_wal(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d, snapshot_every=10)
+        for i in range(35):
+            s.create(f"/k/{i:03d}", obj(str(i)))
+        s.close()
+        wal_lines = open(os.path.join(d, "wal.log")).read().splitlines()
+        assert len(wal_lines) < 10  # rolled over, not 35 records deep
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        s2 = KVStore(data_dir=d)
+        assert len(s2.keys("/k/")) == 35
+        assert s2.version >= 35
+
+    def test_torn_wal_tail_is_tolerated(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        s.create("/k/a", obj("a"))
+        s.create("/k/b", obj("b"))
+        s.close()
+        # Simulate a crash mid-append: truncate the last record in half.
+        wal = os.path.join(d, "wal.log")
+        raw = open(wal).read()
+        open(wal, "w").write(raw[: len(raw) - 20])
+        s2 = KVStore(data_dir=d)
+        assert s2.get("/k/a")["metadata"]["name"] == "a"
+        with pytest.raises(NotFoundError):
+            s2.get("/k/b")  # the torn write was never acknowledged
+        # Store still writable after recovering from a torn tail.
+        s2.create("/k/c", obj("c"))
+        s2.close()
+        s3 = KVStore(data_dir=d)
+        assert s3.keys("/k/") == ["/k/a", "/k/c"]
+
+    def test_torn_tail_truncated_before_new_appends(self, tmp_path):
+        """A torn line must be cut from the file on recovery: otherwise
+        the next acked write fuses onto the torn bytes and is itself
+        lost at the restart after that."""
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        s.create("/k/a", obj("a"))
+        s.snapshot()  # fold /k/a in; WAL now empty
+        s.create("/k/b", obj("b"))  # the only WAL record
+        s.close()
+        wal = os.path.join(d, "wal.log")
+        raw = open(wal, "rb").read()
+        open(wal, "wb").write(raw[:-5])  # tear it: zero replayable records
+
+        s2 = KVStore(data_dir=d)
+        s2.create("/k/c", obj("c"))  # acked post-recovery write
+        s2.close()
+        # Every line in the WAL must be intact JSON now.
+        for line in open(wal):
+            if line.strip():
+                json.loads(line)
+        s3 = KVStore(data_dir=d)
+        assert s3.keys("/k/") == ["/k/a", "/k/c"]
+
+    def test_watch_resume_after_restart_raises_410(self, tmp_path):
+        """History (watch replay buffer) is soft state: after a restart a
+        watcher at an old version must get CompactedError and re-list,
+        the same 410-Gone path etcd index clears trigger."""
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        s.create("/k/a", obj("a"))
+        old_version = s.version
+        for i in range(5):
+            s.create(f"/k/more{i}", obj(str(i)))
+        s.close()
+        s2 = KVStore(data_dir=d)
+        with pytest.raises(CompactedError):
+            s2.watch("/k/", since=old_version)
+        # From-now watches work immediately.
+        stream = s2.watch("/k/", since=0)
+        s2.create("/k/new", obj("new"))
+        ev = stream.next(timeout=2)
+        assert ev is not None and ev.object["metadata"]["name"] == "new"
+
+
+def pod_wire(name, node=""):
+    return {
+        "kind": "Pod",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [{"name": "c", "image": "nginx"}],
+            **({"nodeName": node} if node else {}),
+        },
+    }
+
+
+def svc_wire(name, port=80):
+    return {
+        "kind": "Service",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"ports": [{"port": port}], "selector": {"app": name}},
+    }
+
+
+class TestApiserverRestart:
+    """Kill the apiserver mid-churn; restart on the same data-dir."""
+
+    def test_cluster_survives_apiserver_death(self, tmp_path):
+        d = str(tmp_path / "data")
+        server = APIHTTPServer(APIServer(store=KVStore(data_dir=d))).start()
+        client = Client(HTTPTransport(server.address))
+
+        client.create(
+            "nodes",
+            {
+                "kind": "Node",
+                "apiVersion": "v1",
+                "metadata": {"name": "n1"},
+                "status": {"capacity": {"cpu": "4", "memory": "8Gi"}},
+            },
+        )
+        for i in range(10):
+            client.create("pods", pod_wire(f"pod-{i}"))
+        # Bind half of them (the guarded write the scheduler issues).
+        for i in range(5):
+            client.bind(f"pod-{i}", "n1", namespace="default")
+        svc = client.create("services", svc_wire("web"))
+        ip_before = svc.spec.cluster_ip
+        items, _ = client.list("pods", namespace="default")
+        pods_before = {p.metadata.name: p for p in items}
+        max_rv = max(
+            int(p.metadata.resource_version) for p in pods_before.values()
+        )
+
+        # Kill: stop HTTP, abandon the store object without closing it —
+        # durability must come from the WAL, not a graceful shutdown.
+        server.stop()
+
+        server2 = APIHTTPServer(APIServer(store=KVStore(data_dir=d))).start()
+        client2 = Client(HTTPTransport(server2.address))
+        try:
+            items2, _ = client2.list("pods", namespace="default")
+            pods_after = {p.metadata.name: p for p in items2}
+            assert set(pods_after) == set(pods_before)
+            for i in range(5):
+                assert pods_after[f"pod-{i}"].spec.node_name == "n1"
+            for i in range(5, 10):
+                assert not pods_after[f"pod-{i}"].spec.node_name
+            # The service kept its cluster IP...
+            svc_after = client2.get("services", "web", namespace="default")
+            assert svc_after.spec.cluster_ip == ip_before
+            # ...and the allocator lease survived: a new service must not
+            # be handed the recovered service's IP.
+            svc2 = client2.create("services", svc_wire("web2", port=81))
+            assert svc2.spec.cluster_ip != ip_before
+            # Version monotonicity: new writes are strictly newer than
+            # anything the first incarnation handed out.
+            p_new = client2.create("pods", pod_wire("post-restart"))
+            assert int(p_new.metadata.resource_version) > max_rv
+            # Binding a pre-death pod still enforces the guarded write.
+            client2.bind("pod-7", "n1", namespace="default")
+            assert (
+                client2.get("pods", "pod-7", namespace="default").spec.node_name
+                == "n1"
+            )
+        finally:
+            server2.stop()
+
+    def test_acked_writes_survive_kill_mid_churn(self, tmp_path):
+        """A writer hammers creates while the server dies underneath it.
+        Every create the client saw acknowledged must be present after
+        recovery (the WAL append happens before the response)."""
+        d = str(tmp_path / "data")
+        server = APIHTTPServer(APIServer(store=KVStore(data_dir=d))).start()
+        client = Client(HTTPTransport(server.address))
+
+        acked = []
+        errors = []
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                name = f"churn-{i:04d}"
+                try:
+                    client.create("pods", pod_wire(name))
+                    acked.append(name)
+                except Exception:
+                    errors.append(name)
+                    return
+                i += 1
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        time.sleep(0.5)  # let some churn through
+        server.stop()  # kill mid-churn
+        stop.set()
+        t.join(timeout=5)
+        assert len(acked) > 10, "churn thread never got going"
+
+        server2 = APIHTTPServer(APIServer(store=KVStore(data_dir=d))).start()
+        client2 = Client(HTTPTransport(server2.address))
+        try:
+            items, _ = client2.list("pods", namespace="default")
+            names = {p.metadata.name for p in items}
+            missing = [n for n in acked if n not in names]
+            assert not missing, f"acked writes lost across restart: {missing}"
+        finally:
+            server2.stop()
+
+
+@pytest.mark.slow
+class TestSubprocessKill:
+    """The real thing: a separate apiserver process, SIGKILL, restart."""
+
+    def test_kill_minus_9(self, tmp_path):
+        import re
+        import signal
+        import subprocess
+        import sys
+
+        d = str(tmp_path / "data")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def spawn():
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(repo, "bin", "hyperkube"),
+                    "apiserver",
+                    "--port", "0",
+                    "--data-dir", d,
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=repo,
+            )
+            line = proc.stdout.readline()
+            m = re.search(r"listening on .*?:(\d+)", line)
+            assert m, f"no listen line: {line!r}"
+            return proc, int(m.group(1))
+
+        proc, port = spawn()
+        try:
+            client = Client(HTTPTransport(f"http://127.0.0.1:{port}"))
+            for i in range(20):
+                client.create("pods", pod_wire(f"kp-{i}"))
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+            proc2, port2 = spawn()
+            try:
+                client2 = Client(HTTPTransport(f"http://127.0.0.1:{port2}"))
+                items, _ = client2.list("pods", namespace="default")
+                names = {p.metadata.name for p in items}
+                assert names >= {f"kp-{i}" for i in range(20)}
+            finally:
+                proc2.kill()
+                proc2.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
