@@ -42,6 +42,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from kubernetes_tpu.models.algspec import (
+    AlgorithmSpec,
+    LoweredSpec,
+    lower_spec,
+)
 from kubernetes_tpu.models.objects import (
     Node,
     Pod,
@@ -175,6 +180,10 @@ class PodColumns:
     service_id: np.ndarray  # i32[P] — first matching service, -1 if none
     svc_topk: np.ndarray  # i32[P, SVC_K] — matching service ids, -1 pad
     sel_bits: np.ndarray  # u32[U, LW] — deduped selector table
+    # Policy-spec columns (None unless a non-default spec is lowered):
+    # per ServiceAffinity label: the pod's pinned nodeSelector pair id
+    # (label vocab id of "l=v"), -1 when the pod doesn't pin it.
+    aff_pin: Optional[np.ndarray] = None  # i32[P, K]
 
     @property
     def count(self) -> int:
@@ -204,6 +213,11 @@ class NodeColumns:
     used_vol_rw_bits: np.ndarray  # u32[N, VW]
     service_counts: np.ndarray  # f32[N, S] — matching-pod count per service
     schedulable: np.ndarray  # bool[N] — Ready and not unschedulable
+    # Policy-spec columns (None unless a non-default spec is lowered):
+    policy_ok: Optional[np.ndarray] = None  # bool[N] — NodeLabelPresence AND
+    static_prio: Optional[np.ndarray] = None  # i32[N] — LabelPreference sum
+    aff_vid: Optional[np.ndarray] = None  # i32[N, K] — "l=value" pair ids
+    aa_zone: Optional[np.ndarray] = None  # i32[N, I] — anti-affinity zones
 
     @property
     def count(self) -> int:
@@ -220,6 +234,15 @@ class Snapshot:
     port_vocab: Vocab
     vol_vocab: Vocab
     service_names: List[str]
+    # Non-default policy lowering (None for the default pipeline):
+    lowered: Optional[LoweredSpec] = None
+    weights: Optional[Tuple[int, int, int]] = None
+    # ServiceAffinity / ServiceAntiAffinity carry seeds, one slot per
+    # service: index of the node hosting each service's FIRST listed
+    # peer (-1 none, -2 unknown node — the scalar's error case), and
+    # the phase-unfiltered peer count (numServicePods).
+    anchor_init: Optional[np.ndarray] = None  # i32[max(S,1)]
+    svc_total_init: Optional[np.ndarray] = None  # f32[max(S,1)]
 
 
 def pod_key(pod: Pod) -> str:
@@ -353,7 +376,14 @@ class SnapshotBuilder:
         nodes: Sequence[Node],
         assigned_pods: Sequence[Pod] = (),
         services: Sequence[Service] = (),
+        spec: Optional[AlgorithmSpec] = None,
     ):
+        # A non-default AlgorithmSpec adds policy columns (and may
+        # raise UnloweredPolicyError right here, before any lowering
+        # work — the batch daemon catches it and runs the scalar path).
+        self.spec = None if spec is None or spec.is_default() else spec
+        if self.spec is not None:
+            self._lowered_partial, self._weights = lower_spec(self.spec)
         self.nodes = list(nodes)
         self.pending = list(pending_pods)
         self.services = list(services)
@@ -450,6 +480,20 @@ class SnapshotBuilder:
                 k = min(len(ids), SVC_K)
                 svc_topk[i, :k] = ids[:k]
             service_id[i] = first
+        aff_pin = None
+        if self.spec is not None and self.spec.affinity_labels:
+            # ServiceAffinity: per affinity label, the pod's pinned
+            # "l=v" pair id from its nodeSelector (predicates.go:273-281
+            # — pinned values are never overridden by the anchor peer).
+            aff = self.spec.affinity_labels
+            aff_pin = np.full((P, len(aff)), -1, dtype=np.int32)
+            for i, p in enumerate(chunk):
+                nsel = p.spec.node_selector or {}
+                for k, label in enumerate(aff):
+                    if label in nsel:
+                        aff_pin[i, k] = self.label_vocab.id(
+                            f"{label}={nsel[label]}"
+                        )
         return PodColumns(
             names=[pod_key(p) for p in chunk],
             cpu_milli=cpu_req,
@@ -463,6 +507,7 @@ class SnapshotBuilder:
             service_id=service_id,
             svc_topk=svc_topk,
             sel_bits=self.sel_bits,
+            aff_pin=aff_pin,
         )
 
     def node_columns(self) -> NodeColumns:
@@ -552,6 +597,10 @@ class SnapshotBuilder:
             if len(ids):
                 service_counts[j, ids] += 1.0
 
+        policy_ok = static_prio = aff_vid = aa_zone = None
+        if self.spec is not None:
+            policy_ok, static_prio, aff_vid, aa_zone = self._policy_node_columns()
+
         return NodeColumns(
             names=[n.metadata.name for n in nodes],
             cpu_cap=cpu_cap,
@@ -569,12 +618,127 @@ class SnapshotBuilder:
             used_vol_rw_bits=used_vol_rw,
             service_counts=service_counts,
             schedulable=schedulable,
+            policy_ok=policy_ok,
+            static_prio=static_prio,
+            aff_vid=aff_vid,
+            aa_zone=aa_zone,
         )
 
+    # -- policy-spec lowering -----------------------------------------
+
+    def _policy_node_columns(self):
+        """Node-side columns for the configurable vocabulary. All are
+        pure node facts, so they lower host-side to static columns; the
+        order-dependent ServiceAffinity anchor state lives in the
+        solver carry instead (seeded by _service_seeds)."""
+        spec, N = self.spec, len(self.nodes)
+        node_labels = [n.metadata.labels or {} for n in self.nodes]
+        # CheckNodeLabelPresence (predicates.go:226-240): pod-independent
+        # — one AND-combined bool per node across all instances.
+        policy_ok = None
+        checkers = [p for p in spec.predicates if p.kind == "NodeLabelPresence"]
+        if checkers:
+            policy_ok = np.ones(N, dtype=bool)
+            for j, labels in enumerate(node_labels):
+                for c in checkers:
+                    for label in c.labels:
+                        exists = label in labels
+                        if (exists and not c.presence) or (
+                            not exists and c.presence
+                        ):
+                            policy_ok[j] = False
+                            break
+                    else:
+                        continue
+                    break
+        # CalculateNodeLabelPriority (priorities.go:113-138): static
+        # 10-or-0 per node, summed over instances with weights.
+        static_prio = None
+        prefs = [
+            p
+            for p in spec.priorities
+            if p.kind == "LabelPreference" and p.weight != 0
+        ]
+        if prefs:
+            static_prio = np.zeros(N, dtype=np.int32)
+            for j, labels in enumerate(node_labels):
+                for p in prefs:
+                    exists = p.label in labels
+                    if (exists and p.presence) or (not exists and not p.presence):
+                        static_prio[j] += 10 * p.weight
+        # ServiceAffinity: per node per affinity label, the "l=value"
+        # pair id (shared vocab with pod nodeSelector pins, so equality
+        # is one integer compare on device).
+        aff_vid = None
+        aff = spec.affinity_labels
+        if aff:
+            aff_vid = np.full((N, len(aff)), -1, dtype=np.int32)
+            for j, labels in enumerate(node_labels):
+                for k, label in enumerate(aff):
+                    if label in labels:
+                        aff_vid[j, k] = self.label_vocab.id(
+                            f"{label}={labels[label]}"
+                        )
+        # ServiceAntiAffinity (spreading.go:105-169): nodes partition
+        # into zones by the value of one label; -1 = unlabeled (scores
+        # a flat 0). Zone vocabularies are per instance and compact,
+        # bucketed to 16 so value churn reuses compiled executables.
+        aa_zone = None
+        self._aa_zones: Tuple[int, ...] = ()
+        # Filter EXACTLY like lower_spec filters aa_weights: columns
+        # here and weights there are zipped positionally in the solver.
+        antis = [
+            p
+            for p in spec.priorities
+            if p.kind == "ServiceAntiAffinity" and p.weight != 0
+        ]
+        if antis:
+            aa_zone = np.full((N, len(antis)), -1, dtype=np.int32)
+            zones = []
+            for i, p in enumerate(antis):
+                vocab: Dict[str, int] = {}
+                for j, labels in enumerate(node_labels):
+                    if p.label in labels:
+                        aa_zone[j, i] = vocab.setdefault(
+                            labels[p.label], len(vocab)
+                        )
+                zones.append(max(16, -(-len(vocab) // 16) * 16))
+            self._aa_zones = tuple(zones)
+        return policy_ok, static_prio, aff_vid, aa_zone
+
+    def _service_seeds(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Seed the ServiceAffinity/AntiAffinity carry from the
+        already-assigned pods: per service, the node index of the FIRST
+        listed peer (nsServicePods[0], predicates.go:301-313; -1 no
+        peers, -2 peer on an unknown node = the scalar's GetNodeInfo
+        error, which fails the pod everywhere) and the peer count
+        (numServicePods, spreading.go:150 — node-presence-unfiltered)."""
+        S1 = max(self.S, 1)
+        anchor = np.full(S1, -1, dtype=np.int32)
+        total = np.zeros(S1, dtype=np.float32)
+        for p in self.all_assigned:
+            ids, _ = self.matcher.membership_ids(p)
+            if not len(ids):
+                continue
+            total[ids] += 1.0
+            j = self.node_index.get(p.spec.node_name)
+            for sid in ids:
+                if anchor[sid] == -1:
+                    anchor[sid] = -2 if j is None else j
+        return anchor, total
+
     def snapshot(self) -> Snapshot:
+        pods = self.pod_columns()
+        nodes = self.node_columns()
+        lowered = weights = anchor = svc_total = None
+        if self.spec is not None:
+            lowered = self._lowered_partial._replace(aa_zones=self._aa_zones)
+            weights = self._weights
+            if lowered.service_affinity or lowered.aa_weights:
+                anchor, svc_total = self._service_seeds()
         return Snapshot(
-            pods=self.pod_columns(),
-            nodes=self.node_columns(),
+            pods=pods,
+            nodes=nodes,
             label_vocab=self.label_vocab,
             port_vocab=self.port_vocab,
             vol_vocab=self.vol_vocab,
@@ -582,6 +746,10 @@ class SnapshotBuilder:
                 f"{s.metadata.namespace}/{s.metadata.name}"
                 for s in self.services
             ],
+            lowered=lowered,
+            weights=weights,
+            anchor_init=anchor,
+            svc_total_init=svc_total,
         )
 
 
@@ -590,11 +758,16 @@ def build_snapshot(
     nodes: Sequence[Node],
     assigned_pods: Sequence[Pod] = (),
     services: Sequence[Service] = (),
+    spec: Optional[AlgorithmSpec] = None,
 ) -> Snapshot:
     """Lower API objects into a dense scheduling snapshot.
 
     `assigned_pods` are pods already bound to nodes; they contribute to
     occupancy the way MapPodsToMachines does (predicates.go:379-392),
-    with terminal-phase pods filtered out.
+    with terminal-phase pods filtered out. A non-default `spec` adds
+    the policy columns (raises UnloweredPolicyError for kinds with no
+    columnar encoding).
     """
-    return SnapshotBuilder(pending_pods, nodes, assigned_pods, services).snapshot()
+    return SnapshotBuilder(
+        pending_pods, nodes, assigned_pods, services, spec=spec
+    ).snapshot()

@@ -15,12 +15,13 @@ slightly different cluster sizes reuse the compiled executable
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.models.algspec import DEFAULT_LOWERED, LoweredSpec
 from kubernetes_tpu.models.columnar import SVC_K, Snapshot  # noqa: F401
 # (SVC_K re-exported: device consumers import it from here.)
 
@@ -68,6 +69,9 @@ class DeviceSnapshot:
     nodes: Dict[str, jnp.ndarray]
     n_pods: int  # real (unpadded) counts
     n_nodes: int
+    # Policy lowering riding along (defaults = the stock pipeline).
+    lowered: LoweredSpec = DEFAULT_LOWERED
+    weights: Tuple[int, int, int] = (1, 1, 1)
 
     @property
     def pod_count_padded(self) -> int:
@@ -111,6 +115,10 @@ def device_pods(
         "svc": _pad(p.service_id, PP, fill=-1),
         "svc_ids": _pad(p.svc_topk, PP, fill=-1),
     }
+    if p.aff_pin is not None:
+        # Padded pods are already pinned to -2 (never placed); -1 here
+        # just means "no pinned affinity value".
+        pods["aff_pin"] = _pad(p.aff_pin, PP, fill=-1)
     return {k: _put(v, sharding) for k, v in pods.items()}
 
 
@@ -142,6 +150,16 @@ def device_nodes(
         # Padding nodes are unschedulable -> never chosen.
         "sched": _pad(n.schedulable, NP, fill=False),
     }
+    # Policy-spec columns (padding nodes are unschedulable, so fills
+    # only need to be type-safe, not semantically meaningful).
+    if n.policy_ok is not None:
+        nodes["policy_ok"] = _pad(n.policy_ok, NP, fill=False)
+    if n.static_prio is not None:
+        nodes["static_prio"] = _pad(n.static_prio, NP)
+    if n.aff_vid is not None:
+        nodes["aff_vid"] = _pad(n.aff_vid, NP, fill=-1)
+    if n.aa_zone is not None:
+        nodes["aa_zone"] = _pad(n.aa_zone, NP, fill=-1)
     return {k: _put(v, sharding) for k, v in nodes.items()}
 
 
@@ -172,11 +190,26 @@ def device_snapshot(
 ) -> DeviceSnapshot:
     node_mult = node_axis_multiple(mesh, pad_to)
     node_sharding, pod_sharding = shardings_for(mesh, node_axis)
+    nodes = device_nodes(
+        snap.nodes, node_sharding, pad_to=pad_to, node_mult=node_mult
+    )
+    if snap.anchor_init is not None:
+        # ServiceAffinity/AntiAffinity carry seeds: service-axis state
+        # sized to the padded svc_counts column count PLUS one scratch
+        # slot (the last index), which absorbs -1-padded svc_ids
+        # scatters in the solver commit. Replicated, not node-sharded.
+        SP = _round_up(max(snap.anchor_init.shape[0], 1), SVC_BUCKET)
+        anchor = np.full(SP + 1, -1, dtype=np.int32)
+        anchor[: snap.anchor_init.shape[0]] = snap.anchor_init
+        total = np.zeros(SP + 1, dtype=np.float32)
+        total[: snap.svc_total_init.shape[0]] = snap.svc_total_init
+        nodes["anchor"] = jax.device_put(anchor, pod_sharding)
+        nodes["svc_total"] = jax.device_put(total, pod_sharding)
     return DeviceSnapshot(
         pods=device_pods(snap.pods, pod_sharding, pad_to=pad_to),
-        nodes=device_nodes(
-            snap.nodes, node_sharding, pad_to=pad_to, node_mult=node_mult
-        ),
+        nodes=nodes,
         n_pods=snap.pods.count,
         n_nodes=snap.nodes.count,
+        lowered=snap.lowered or DEFAULT_LOWERED,
+        weights=snap.weights or (1, 1, 1),
     )

@@ -76,6 +76,7 @@ def _snapshot_payload(snap: Snapshot) -> dict:
             "service_id": p.service_id,
             "svc_topk": p.svc_topk,
             "sel_bits": p.sel_bits,
+            "aff_pin": p.aff_pin,
         },
         "nodes": {
             "cpu_cap": n.cpu_cap,
@@ -93,7 +94,16 @@ def _snapshot_payload(snap: Snapshot) -> dict:
             "used_vol_rw_bits": n.used_vol_rw_bits,
             "service_counts": n.service_counts,
             "schedulable": n.schedulable,
+            "policy_ok": n.policy_ok,
+            "static_prio": n.static_prio,
+            "aff_vid": n.aff_vid,
+            "aa_zone": n.aa_zone,
         },
+        # Policy lowering (None/default for the stock pipeline).
+        "lowered": snap.lowered,
+        "weights": snap.weights,
+        "anchor_init": snap.anchor_init,
+        "svc_total_init": snap.svc_total_init,
     }
 
 
@@ -117,6 +127,10 @@ def _snapshot_from_payload(payload: dict) -> Snapshot:
         port_vocab=Vocab(),
         vol_vocab=Vocab(),
         service_names=[],
+        lowered=payload.get("lowered"),
+        weights=payload.get("weights"),
+        anchor_init=payload.get("anchor_init"),
+        svc_total_init=payload.get("svc_total_init"),
     )
 
 
@@ -167,8 +181,12 @@ class SidecarSolver:
         assigned: Sequence = (),
         services: Sequence = (),
         mode: str = "scan",
+        spec=None,
     ) -> List[Optional[str]]:
-        snap = build_snapshot(pending, nodes, assigned, services)
+        # Policy lowering happens client-side (UnloweredPolicyError
+        # surfaces here, pre-transport); the sidecar receives finished
+        # columns + the static LoweredSpec and just solves.
+        snap = build_snapshot(pending, nodes, assigned, services, spec=spec)
         reply = self._request(
             {"op": "solve", "mode": mode, **_snapshot_payload(snap)},
             self.timeout,

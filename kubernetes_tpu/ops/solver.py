@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.models.algspec import DEFAULT_LOWERED, LoweredSpec
 from kubernetes_tpu.ops.matrices import DeviceSnapshot
 
 # Weighted-sum weights for the default provider (defaults.go:51-60):
@@ -41,36 +42,85 @@ from kubernetes_tpu.ops.matrices import DeviceSnapshot
 DEFAULT_WEIGHTS = (1, 1, 1)
 
 
-def _feasible(pod: Dict, nodes: Dict, N: int) -> jnp.ndarray:
-    """All default predicates as one bool[N] mask."""
-    cpu_cap, mem_cap = nodes["cpu_cap"], nodes["mem_cap"]
-    # -- PodFitsResources --
-    fits_cpu = (cpu_cap == 0) | (nodes["cpu_fit"] + pod["cpu"] <= cpu_cap)
-    fits_mem = (mem_cap == 0) | (nodes["mem_fit"] + pod["mem"] <= mem_cap)
-    fits_count = nodes["pods_used"] + 1 <= nodes["pods_cap"]
-    nonzero_ok = (~nodes["over"]) & fits_cpu & fits_mem & fits_count
-    # Zero-request pods only check pod-count headroom (predicates.go:146).
-    zero_ok = nodes["pods_used"] < nodes["pods_cap"]
-    res_ok = jnp.where(pod["zero_req"], zero_ok, nonzero_ok)
-    # -- MatchNodeSelector: selector bits must be a subset of labels --
-    sel = pod["sel"][None, :]
-    sel_ok = jnp.all((sel & nodes["labels"]) == sel, axis=1)
-    # -- PodFitsPorts --
-    port_ok = ~jnp.any(pod["port"][None, :] & nodes["uport"], axis=1)
-    # -- NoDiskConflict: conflict when either side holds it read-write --
-    vol_conflict = jnp.any(
-        (pod["vol_rw"][None, :] & nodes["uvol_any"])
-        | (pod["vol_any"][None, :] & nodes["uvol_rw"]),
-        axis=1,
-    )
-    # -- HostName --
-    idx = jnp.arange(N, dtype=jnp.int32)
-    host_ok = (pod["pinned"] == -1) | (idx == pod["pinned"])
-    return res_ok & sel_ok & port_ok & (~vol_conflict) & host_ok & nodes["sched"]
+def _feasible(
+    pod: Dict, nodes: Dict, N: int, ls: LoweredSpec = DEFAULT_LOWERED
+) -> jnp.ndarray:
+    """The configured predicates as one bool[N] mask (defaults when no
+    policy is lowered — each term is gated by the static LoweredSpec,
+    so a policy that omits a predicate omits its ops entirely)."""
+    ok = nodes["sched"]
+    if ls.resources:
+        cpu_cap, mem_cap = nodes["cpu_cap"], nodes["mem_cap"]
+        # -- PodFitsResources --
+        fits_cpu = (cpu_cap == 0) | (nodes["cpu_fit"] + pod["cpu"] <= cpu_cap)
+        fits_mem = (mem_cap == 0) | (nodes["mem_fit"] + pod["mem"] <= mem_cap)
+        fits_count = nodes["pods_used"] + 1 <= nodes["pods_cap"]
+        nonzero_ok = (~nodes["over"]) & fits_cpu & fits_mem & fits_count
+        # Zero-request pods only check pod-count headroom (predicates.go:146).
+        zero_ok = nodes["pods_used"] < nodes["pods_cap"]
+        ok = ok & jnp.where(pod["zero_req"], zero_ok, nonzero_ok)
+    if ls.selector:
+        # -- MatchNodeSelector: selector bits must be a subset of labels --
+        sel = pod["sel"][None, :]
+        ok = ok & jnp.all((sel & nodes["labels"]) == sel, axis=1)
+    if ls.ports:
+        # -- PodFitsPorts --
+        ok = ok & ~jnp.any(pod["port"][None, :] & nodes["uport"], axis=1)
+    if ls.disk:
+        # -- NoDiskConflict: conflict when either side holds it read-write --
+        ok = ok & ~jnp.any(
+            (pod["vol_rw"][None, :] & nodes["uvol_any"])
+            | (pod["vol_any"][None, :] & nodes["uvol_rw"]),
+            axis=1,
+        )
+    if ls.hostname:
+        # -- HostName --
+        idx = jnp.arange(N, dtype=jnp.int32)
+        ok = ok & ((pod["pinned"] == -1) | (idx == pod["pinned"]))
+    if ls.node_label:
+        # -- CheckNodeLabelPresence: static node mask (predicates.go:226) --
+        ok = ok & nodes["policy_ok"]
+    if ls.service_affinity:
+        # -- CheckServiceAffinity (predicates.go:268-335) --
+        # Per affinity label k the pod needs "l_k = v" where v is its
+        # own pinned nodeSelector value, else the value on the node
+        # hosting the first service peer (the anchor); no requirement
+        # when neither exists. A peer on an unknown node is the
+        # scalar's GetNodeInfo error: the pod fits nowhere.
+        pin = pod["aff_pin"]  # i32[K]
+        s = pod["svc"]
+        scratch = nodes["anchor"].shape[0] - 1
+        slot = jnp.where(s >= 0, s, scratch)
+        anchor = nodes["anchor"][slot]
+        peers = nodes["svc_total"][slot] > 0
+        consults = jnp.any(pin < 0) & (s >= 0) & peers
+        anchor_err = consults & (anchor == -2)
+        anchor_ok = consults & (anchor >= 0)
+        a_vid = jnp.where(
+            anchor_ok, nodes["aff_vid"][jnp.maximum(anchor, 0)], -1
+        )  # i32[K]
+        need = jnp.where(pin >= 0, pin, a_vid)
+        ok = ok & jnp.all(
+            (need[None, :] < 0) | (nodes["aff_vid"] == need[None, :]), axis=1
+        )
+        ok = ok & ~anchor_err
+    return ok
 
 
-def _scores(pod: Dict, nodes: Dict, weights) -> jnp.ndarray:
-    """Weighted default priorities as one int32[N] score vector."""
+def _scores(
+    pod: Dict,
+    nodes: Dict,
+    weights,
+    ls: LoweredSpec = DEFAULT_LOWERED,
+    feas: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Weighted configured priorities as one int32[N] score vector.
+
+    `feas` is the pod's feasibility mask: the reference prioritizes
+    over the FILTERED node list (generic_scheduler.go:80-86), which
+    only matters for ServiceAntiAffinity — its per-zone peer counts
+    skip peers hosted on filtered-out nodes (spreading.go:133-147).
+    Every other priority's per-node score is filter-independent."""
     # Integer score math in int32: columns are integer-valued f32 with
     # magnitudes < 2^24, so the cast is exact and the Go int64 division
     # semantics (truncation of nonnegative quotients) are reproduced
@@ -79,43 +129,80 @@ def _scores(pod: Dict, nodes: Dict, weights) -> jnp.ndarray:
     mem_cap = nodes["mem_cap"].astype(jnp.int32)
     cpu_req = (nodes["cpu_used"] + pod["cpu"]).astype(jnp.int32)
     mem_req = (nodes["mem_used"] + pod["mem"]).astype(jnp.int32)
-
-    def calc_score(req, cap):
-        # priorities.go:31-40: 0 if cap == 0 or req > cap.
-        raw = jnp.where(cap > 0, ((cap - req) * 10) // jnp.maximum(cap, 1), 0)
-        return jnp.where((cap == 0) | (req > cap), 0, raw)
-
-    lr = (calc_score(cpu_req, cpu_cap) + calc_score(mem_req, mem_cap)) // 2
-
-    # BalancedResourceAllocation (priorities.go:146-205). TPU float
-    # division is reciprocal-based and NOT correctly rounded (~1 ulp
-    # low), which truncates scores one short at exact boundaries like
-    # |0.75-0.25|*10 == 5. The epsilon absorbs that device error; it is
-    # far below the smallest legitimate gap between distinct exact
-    # score values for realistic capacities.
-    cfrac = jnp.where(cpu_cap == 0, 1.0, cpu_req / jnp.maximum(cpu_cap, 1))
-    mfrac = jnp.where(mem_cap == 0, 1.0, mem_req / jnp.maximum(mem_cap, 1))
-    bra = jnp.where(
-        (cfrac >= 1) | (mfrac >= 1),
-        0,
-        (10 - jnp.abs(cfrac - mfrac) * 10 + 1e-5).astype(jnp.int32),
-    )
-
-    # ServiceSpreading (spreading.go:38-87) in exact integer math
-    # (counts are small integers): 10*(maxc-count) // maxc. Go truncates
-    # the float32 quotient; integer division agrees except where Go's
-    # f32 rounding lands exactly on an integer from below — rare and
-    # covered by the >=99% parity budget.
-    svc = pod["svc"]
-    counts = jax.lax.dynamic_index_in_dim(
-        nodes["svc_counts"], jnp.maximum(svc, 0), axis=1, keepdims=False
-    ).astype(jnp.int32)
-    maxc = jnp.max(counts)
-    spread_raw = (10 * (maxc - counts)) // jnp.maximum(maxc, 1)
-    spread = jnp.where((svc < 0) | (maxc == 0), 10, spread_raw)
-
     w_lr, w_bra, w_spread = weights
-    return lr * w_lr + bra * w_bra + spread * w_spread
+    total = jnp.zeros(cpu_cap.shape[0], dtype=jnp.int32)
+
+    if w_lr:
+        def calc_score(req, cap):
+            # priorities.go:31-40: 0 if cap == 0 or req > cap.
+            raw = jnp.where(cap > 0, ((cap - req) * 10) // jnp.maximum(cap, 1), 0)
+            return jnp.where((cap == 0) | (req > cap), 0, raw)
+
+        lr = (calc_score(cpu_req, cpu_cap) + calc_score(mem_req, mem_cap)) // 2
+        total = total + lr * w_lr
+
+    if w_bra:
+        # BalancedResourceAllocation (priorities.go:146-205). TPU float
+        # division is reciprocal-based and NOT correctly rounded (~1 ulp
+        # low), which truncates scores one short at exact boundaries like
+        # |0.75-0.25|*10 == 5. The epsilon absorbs that device error; it is
+        # far below the smallest legitimate gap between distinct exact
+        # score values for realistic capacities.
+        cfrac = jnp.where(cpu_cap == 0, 1.0, cpu_req / jnp.maximum(cpu_cap, 1))
+        mfrac = jnp.where(mem_cap == 0, 1.0, mem_req / jnp.maximum(mem_cap, 1))
+        bra = jnp.where(
+            (cfrac >= 1) | (mfrac >= 1),
+            0,
+            (10 - jnp.abs(cfrac - mfrac) * 10 + 1e-5).astype(jnp.int32),
+        )
+        total = total + bra * w_bra
+
+    svc = pod["svc"]
+    if w_spread or ls.aa_weights:
+        counts = jax.lax.dynamic_index_in_dim(
+            nodes["svc_counts"], jnp.maximum(svc, 0), axis=1, keepdims=False
+        ).astype(jnp.int32)
+
+    if w_spread:
+        # ServiceSpreading (spreading.go:38-87) in exact integer math
+        # (counts are small integers): 10*(maxc-count) // maxc. Go truncates
+        # the float32 quotient; integer division agrees except where Go's
+        # f32 rounding lands exactly on an integer from below — rare and
+        # covered by the >=99% parity budget.
+        maxc = jnp.max(counts)
+        spread_raw = (10 * (maxc - counts)) // jnp.maximum(maxc, 1)
+        spread = jnp.where((svc < 0) | (maxc == 0), 10, spread_raw)
+        total = total + spread * w_spread
+
+    if ls.static_prio:
+        # CalculateNodeLabelPriority: pod-independent, weights folded
+        # into the column host-side (priorities.go:113-138).
+        total = total + nodes["static_prio"]
+
+    if ls.aa_weights:
+        # ServiceAntiAffinity (spreading.go:105-169): spread the pod's
+        # first service across the values ("zones") of one node label.
+        # numServicePods counts peers regardless of node presence
+        # (svc_total); per-zone counts sum the per-node peer counts.
+        scratch = nodes["svc_total"].shape[0] - 1
+        slot = jnp.where(svc >= 0, svc, scratch)
+        num = jnp.where(svc >= 0, nodes["svc_total"][slot], 0.0).astype(jnp.int32)
+        for i, (w, nz) in enumerate(zip(ls.aa_weights, ls.aa_zones)):
+            zone = nodes["aa_zone"][:, i]
+            in_zone = zone >= 0
+            if feas is not None:
+                in_zone = in_zone & feas
+            zc = jnp.zeros(nz, dtype=jnp.int32).at[jnp.maximum(zone, 0)].add(
+                jnp.where(in_zone, counts, 0)
+            )
+            count_z = zc[jnp.maximum(zone, 0)]
+            score = jnp.where(
+                num > 0, (10 * (num - count_z)) // jnp.maximum(num, 1), 10
+            )
+            score = jnp.where(zone < 0, 0, score)
+            total = total + score * w
+
+    return total
 
 
 def _commit(nodes: Dict, pod: Dict, choice: jnp.ndarray, N: int) -> Dict:
@@ -152,15 +239,29 @@ def _commit(nodes: Dict, pod: Dict, choice: jnp.ndarray, N: int) -> Dict:
     new["svc_counts"] = nodes["svc_counts"].at[row, jnp.maximum(ids, 0)].add(
         valid, mode="drop"
     )
+    if "anchor" in nodes:
+        # ServiceAffinity/AntiAffinity carry: the placed pod becomes a
+        # peer of every service it matches; it becomes a service's
+        # anchor only when that service had no listed peer yet (the
+        # scalar's nsServicePods[0] is first-in-list-order, and the
+        # backlog commits in order). Invalid/padded ids route to the
+        # scratch slot (last index), which no real pod ever reads.
+        scratch = nodes["anchor"].shape[0] - 1
+        slot = jnp.where((ids >= 0) & assigned, ids, scratch)
+        new["svc_total"] = nodes["svc_total"].at[slot].add(1.0)
+        cur = nodes["anchor"][slot]
+        new["anchor"] = nodes["anchor"].at[slot].set(
+            jnp.where(cur == -1, choice, cur)
+        )
     return new
 
 
-def _scan_solve(pods, nodes, weights):
+def _scan_solve(pods, nodes, weights, lspec=DEFAULT_LOWERED):
     N = nodes["cpu_cap"].shape[0]
 
     def step(carry, pod):
-        feas = _feasible(pod, carry, N)
-        score = _scores(pod, carry, weights)
+        feas = _feasible(pod, carry, N, lspec)
+        score = _scores(pod, carry, weights, lspec, feas)
         masked = jnp.where(feas, score, -1)
         best = jnp.argmax(masked).astype(jnp.int32)  # first max = lowest index
         # Feasibility folds into the same reduction: infeasible nodes
@@ -177,40 +278,47 @@ def _scan_solve(pods, nodes, weights):
     return jax.lax.scan(step, nodes, pods, unroll=2)
 
 
-@functools.partial(jax.jit, static_argnames=("weights",))
+@functools.partial(jax.jit, static_argnames=("weights", "lspec"))
 def solve(
     pods: Dict[str, jnp.ndarray],
     nodes: Dict[str, jnp.ndarray],
     weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+    lspec: LoweredSpec = DEFAULT_LOWERED,
 ) -> jnp.ndarray:
     """Sequential-parity assignment: i32[P] of node indices (-1 =
-    unschedulable). The scan IS the reference's scheduleOne loop."""
-    _, assignment = _scan_solve(pods, nodes, weights)
+    unschedulable). The scan IS the reference's scheduleOne loop.
+    `lspec` selects the configured predicate/priority pipeline (static:
+    one compiled executable per distinct policy)."""
+    _, assignment = _scan_solve(pods, nodes, weights, lspec)
     return assignment
 
 
 @functools.partial(
-    jax.jit, static_argnames=("weights",), donate_argnames=("nodes",)
+    jax.jit, static_argnames=("weights", "lspec"), donate_argnames=("nodes",)
 )
 def solve_with_state(
     pods: Dict[str, jnp.ndarray],
     nodes: Dict[str, jnp.ndarray],
     weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+    lspec: LoweredSpec = DEFAULT_LOWERED,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Like solve, but also returns the post-commit occupancy carry.
     `nodes` is DONATED: the caller's buffers are consumed and the
     returned state aliases them — the substrate for incremental churn
     (SolverSession keeps this state device-resident across ticks)."""
-    final, assignment = _scan_solve(pods, nodes, weights)
+    final, assignment = _scan_solve(pods, nodes, weights, lspec)
     return assignment, final
 
 
 def solve_assignments(
-    dsnap: DeviceSnapshot, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS
+    dsnap: DeviceSnapshot, weights: Optional[Tuple[int, int, int]] = None
 ) -> np.ndarray:
     """Run the solver and strip padding: returns i32[n_pods] with real
-    node indices (-1 unschedulable)."""
-    out = np.asarray(solve(dsnap.pods, dsnap.nodes, weights))
+    node indices (-1 unschedulable). Policy lowering (lspec + weights)
+    rides on the DeviceSnapshot; an explicit `weights` overrides."""
+    if weights is None:
+        weights = dsnap.weights
+    out = np.asarray(solve(dsnap.pods, dsnap.nodes, weights, dsnap.lowered))
     out = out[: dsnap.n_pods]
     # Padding nodes can never be chosen (schedulable=False), but clamp
     # defensively so a bug can't leak a phantom index.

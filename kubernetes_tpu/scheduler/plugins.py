@@ -15,6 +15,11 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from kubernetes_tpu.models.algspec import (
+    AlgorithmSpec,
+    spec_from_keys,
+    spec_from_policy,
+)
 from kubernetes_tpu.scheduler import predicates as preds
 from kubernetes_tpu.scheduler import priorities as prios
 from kubernetes_tpu.scheduler.types import PriorityConfig
@@ -197,4 +202,65 @@ def build_from_policy(policy: dict, args: PluginFactoryArgs):
             priorities.append(PriorityConfig(function=fn, weight=weight))
         else:
             priorities.extend(get_priority_configs({name: weight}, args))
+    return predicates, priorities
+
+
+# ---------------------------------------------------------------------------
+# AlgorithmSpec bridge: the spec is the shared source of truth between
+# this scalar construction and the TPU lowering (models.algspec) —
+# the batch daemon consults it to pick device vs scalar execution.
+# ---------------------------------------------------------------------------
+
+
+def spec_for_provider(name: str) -> AlgorithmSpec:
+    provider = get_algorithm_provider(name)
+    return spec_from_keys(provider.predicate_keys, provider.priority_keys)
+
+
+def spec_for_policy(policy: dict) -> AlgorithmSpec:
+    return spec_from_policy(policy)
+
+
+def build_from_spec(spec: AlgorithmSpec, args: PluginFactoryArgs):
+    """Construct the scalar (predicates, priorities) from a spec.
+    Argumented kinds build their classes directly; plain kinds resolve
+    through the registry, so user-registered custom plugins still run
+    on the scalar path even though they can't lower to the device."""
+    predicates: Dict[str, Callable] = {}
+    for i, p in enumerate(spec.predicates):
+        if p.kind == "ServiceAffinity":
+            predicates[f"ServiceAffinity#{i}"] = preds.ServiceAffinity(
+                args.pod_lister,
+                args.service_lister,
+                args.node_lister,
+                list(p.labels),
+            )
+        elif p.kind == "NodeLabelPresence":
+            predicates[f"NodeLabelPresence#{i}"] = preds.NodeLabelChecker(
+                args.node_lister, list(p.labels), p.presence
+            )
+        else:
+            predicates.update(get_fit_predicates([p.kind], args))
+    priorities: List[PriorityConfig] = []
+    for p in spec.priorities:
+        if p.weight == 0:
+            continue
+        if p.kind == "ServiceAntiAffinity":
+            priorities.append(
+                PriorityConfig(
+                    function=prios.ServiceAntiAffinity(
+                        args.service_lister, p.label
+                    ),
+                    weight=p.weight,
+                )
+            )
+        elif p.kind == "LabelPreference":
+            priorities.append(
+                PriorityConfig(
+                    function=prios.NodeLabelPrioritizer(p.label, p.presence),
+                    weight=p.weight,
+                )
+            )
+        else:
+            priorities.extend(get_priority_configs({p.kind: p.weight}, args))
     return predicates, priorities

@@ -90,6 +90,7 @@ class KVStore:
         self._wal_count = 0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
+            self._data_dir = data_dir
             self._snap_path = os.path.join(data_dir, "snapshot.json")
             self._wal_path = os.path.join(data_dir, "wal.log")
             replayed = self._recover()
@@ -101,6 +102,8 @@ class KVStore:
             # Age out TTL'd keys that expired while we were down; goes
             # through the normal delete path so the WAL records it.
             self._expire_locked()
+            if self._fsync:
+                self._fsync_dir()  # make the WAL's dir entry durable
 
     # -- durability ---------------------------------------------------
 
@@ -198,6 +201,18 @@ class KVStore:
             self._wal_file.close()
         self._wal_file = open(self._wal_path, "w", encoding="utf-8")
         self._wal_count = 0
+        if self._fsync:
+            # Power-loss ordering: the snapshot rename's directory
+            # entry must be durable BEFORE new WAL appends land, or a
+            # crash could pair the old snapshot with a truncated WAL.
+            self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
+        fd = os.open(self._data_dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def snapshot(self) -> None:
         """Force a snapshot + WAL truncation (no-op for in-memory stores)."""

