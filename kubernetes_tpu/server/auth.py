@@ -165,6 +165,33 @@ class ServiceAccountTokenManager:
         )
 
 
+class X509Authenticator:
+    """Client-certificate authentication: a TLS peer certificate's
+    Subject CommonName is the username and its Organization values are
+    the groups — the reference's x509 request authenticator with the
+    CommonNameUserConversion (pkg/apiserver/authn.go:35,
+    plugin/pkg/auth/authenticator/request/x509/x509.go). Chain
+    verification against --client-ca-file happens in the TLS handshake
+    (ssl.CERT_OPTIONAL); by the time a peer cert reaches this class it
+    is already CA-verified."""
+
+    def authenticate_peer_cert(self, peercert: dict) -> UserInfo:
+        """`peercert` is ssl.SSLSocket.getpeercert()'s dict form."""
+        if not peercert:
+            raise AuthenticationError("no client certificate presented")
+        name = ""
+        groups: List[str] = []
+        for rdn in peercert.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName" and not name:
+                    name = value
+                elif key == "organizationName":
+                    groups.append(value)
+        if not name:
+            raise AuthenticationError("client certificate has no CommonName")
+        return UserInfo(name=name, groups=tuple(groups))
+
+
 class UnionAuthenticator:
     """Try each authenticator in order (union.go)."""
 

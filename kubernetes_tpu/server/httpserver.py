@@ -42,6 +42,10 @@ _LATENCY = metrics.DEFAULT.summary(
     "apiserver_request_latencies_seconds", "API request latency",
     ("verb", "resource"),
 )
+_INFLIGHT_REJECTS = metrics.DEFAULT.counter(
+    "apiserver_dropped_requests_total",
+    "Requests rejected by the max-in-flight limit",
+)
 
 
 def _first_container_port(pod: dict, name: str) -> int:
@@ -61,6 +65,23 @@ def _first_container_port(pod: dict, name: str) -> int:
 #: exempt from the latency SLO exactly like the reference's ignored
 #: verbs/resources (test/e2e/util.go:1286-1301 skips WATCHLIST/PROXY).
 _LONG_RUNNING = ("watch", "proxy", "portforward", "exec", "run", "log")
+
+
+def _request_is_long_running(parts, query) -> bool:
+    """Max-in-flight passthrough test (pkg/apiserver/handlers.go
+    MaxInFlightLimit: requests matching the long-running regex bypass
+    the limit — a hung watch or kubelet relay must not eat a slot
+    forever). Like the reference's regex, 'proxy' etc. match ANYWHERE
+    in the path: proxy requests carry subpaths after the verb."""
+    if query.get("watch") in ("true", "1"):
+        return True
+    if any(p in ("watch", "proxy", "portforward", "exec", "run") for p in parts):
+        return True
+    return (
+        bool(parts)
+        and parts[-1] == "log"
+        and query.get("follow") in ("true", "1")
+    )
 
 
 def high_latency_requests(threshold: float = 1.0, summary=None):
@@ -88,6 +109,11 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubernetes-tpu-apiserver"
     api: APIServer  # set by serve()
+    # Inbound protection (pkg/apiserver/handlers.go MaxInFlightLimit,
+    # wired at pkg/master/master.go): a BoundedSemaphore shared by all
+    # handler threads, or None for unlimited. Long-running requests
+    # (watch/exec/proxy/...) bypass it.
+    inflight = None
 
     # Silence default stderr logging; metrics carry the signal.
     def log_message(self, fmt, *args):  # noqa: N802
@@ -285,7 +311,20 @@ class _Handler(BaseHTTPRequestHandler):
             self.wire_version = parts[1]
             rest = parts[2:]
             self._check_auth(verb, rest)
-            resource, code = self._api_v1(verb, rest)
+            sem = self.inflight
+            if sem is None or _request_is_long_running(rest, self.query):
+                resource, code = self._api_v1(verb, rest)
+            elif sem.acquire(blocking=False):
+                try:
+                    resource, code = self._api_v1(verb, rest)
+                finally:
+                    sem.release()
+            else:
+                _INFLIGHT_REJECTS.inc()
+                raise APIError(
+                    429, "TooManyRequests",
+                    "too many requests in flight; retry",
+                )
         except APIError as e:
             code = e.code
             self._send_json(e.code, e.to_status())
@@ -326,7 +365,24 @@ class _Handler(BaseHTTPRequestHandler):
         from kubernetes_tpu.server import auth as authpkg
 
         user = authpkg.UserInfo(name="system:anonymous")
-        if authenticator is not None:
+        # x509 first, like the reference's request-authenticator union
+        # (authn.go:35): a CA-verified client cert IS the identity; the
+        # Authorization header is only consulted without one.
+        peercert = None
+        getpeercert = getattr(self.connection, "getpeercert", None)
+        if getpeercert is not None:
+            try:
+                peercert = getpeercert()
+            except ValueError:
+                peercert = None
+        if peercert:
+            try:
+                user = authpkg.X509Authenticator().authenticate_peer_cert(
+                    peercert
+                )
+            except authpkg.AuthenticationError as e:
+                raise APIError(401, "Unauthorized", str(e))
+        elif authenticator is not None:
             try:
                 user = authenticator.authenticate_request(
                     self.headers.get("Authorization", "")
@@ -783,10 +839,24 @@ class _Handler(BaseHTTPRequestHandler):
                 200, api.update(resource, ns, name, self._read_body(self._kind_of(resource)))
             )
         elif verb == "PATCH":
-            # JSON merge patch (resthandler.go:446). The kind hint lets
-            # a kind-less partial v1beta3 body still version-convert.
+            # All three reference patch types, selected by Content-Type
+            # (resthandler.go:446): json-patch / strategic-merge /
+            # merge (the default; plain application/json means merge).
+            # The kind hint lets a kind-less partial v1beta3 merge body
+            # still version-convert; json-patch op arrays pass through
+            # untouched and address internal (v1) field names.
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            ptype = {
+                "application/json-patch+json": "json",
+                "application/strategic-merge-patch+json": "strategic",
+            }.get(ctype, "merge")
             self._send_json(
-                200, api.patch(resource, ns, name, self._read_body(self._kind_of(resource)))
+                200,
+                api.patch(
+                    resource, ns, name,
+                    self._read_body(self._kind_of(resource)),
+                    patch_type=ptype,
+                ),
             )
         elif verb == "DELETE":
             self._send_json(200, api.delete(resource, ns, name))
@@ -922,6 +992,23 @@ _UI_PAGE = """<!doctype html>
 </table></body></html>"""
 
 
+class _TLSCapableServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that TLS-wraps each accepted connection with
+    do_handshake_on_connect=False: the handshake then happens on the
+    handler thread's first read, so a client that stalls mid-handshake
+    ties up one daemon thread instead of the accept loop."""
+
+    ssl_context = None
+
+    def get_request(self):
+        sock, addr = self.socket.accept()
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False
+            )
+        return sock, addr
+
+
 class APIHTTPServer:
     """Owns the listening socket + serving thread."""
 
@@ -933,26 +1020,63 @@ class APIHTTPServer:
         authenticator=None,
         authorizer=None,
         publish_master: bool = False,
+        max_in_flight: int = 0,
+        tls_cert_file: str = "",
+        tls_key_file: str = "",
+        client_ca_file: str = "",
     ):
         # publish_master: create/reconcile the "kubernetes" service +
         # endpoints on start (pkg/master/publish.go). Off by default so
         # unit fixtures see only the objects they create; the daemon
         # launchers turn it on.
+        # max_in_flight: cap on concurrently-served non-long-running
+        # API requests; excess get 429 (pkg/apiserver/handlers.go).
+        # 0 = unlimited (unit-test default; the daemon passes 400 like
+        # the reference's --max-requests-inflight).
         self._publish_master = publish_master
         handler = type(
             "BoundHandler",
             (_Handler,),
-            {"api": api, "authenticator": authenticator, "authorizer": authorizer},
+            {
+                "api": api,
+                "authenticator": authenticator,
+                "authorizer": authorizer,
+                "inflight": (
+                    threading.BoundedSemaphore(max_in_flight)
+                    if max_in_flight > 0
+                    else None
+                ),
+            },
         )
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = _TLSCapableServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.api = api
         self._thread: Optional[threading.Thread] = None
+        # TLS + x509 client-cert authn (--tls-cert-file /
+        # --tls-private-key-file / --client-ca-file; reference:
+        # cmd/kube-apiserver/app/server.go secure serving +
+        # pkg/apiserver/authn.go x509). CERT_OPTIONAL: clients without
+        # certs still reach basic/token auth; clients WITH certs must
+        # chain to the CA or the handshake fails. Sockets are wrapped
+        # PER CONNECTION with a deferred handshake so a stalled client
+        # blocks only its own handler thread, never the accept loop.
+        self._tls = False
+        if tls_cert_file and tls_key_file:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            if client_ca_file:
+                ctx.load_verify_locations(client_ca_file)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            self.httpd.ssl_context = ctx
+            self._tls = True
 
     @property
     def address(self) -> str:
         host, port = self.httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "APIHTTPServer":
         self._thread = threading.Thread(

@@ -1,0 +1,169 @@
+"""v1 <-> v1beta3 round-trip fuzz over EVERY registry kind (VERDICT r2
+item 8): the conversion layer claims "renames only, everything else is
+mechanical" — this property test backs the claim by generating random
+fully-populated objects from the typed model and asserting
+v1 -> v1beta3 -> v1 is lossless at the wire level (the analog of the
+reference's fuzz over generated converters,
+pkg/api/serialization_test.go / v1beta3/conversion.go:358-447).
+"""
+
+import dataclasses
+import random
+import string
+import typing
+
+import pytest
+
+from kubernetes_tpu.models import conversion, serde
+from kubernetes_tpu.models.objects import KINDS
+from kubernetes_tpu.models.quantity import Quantity, parse_quantity
+
+
+def _rand_str(rng):
+    return "".join(rng.choices(string.ascii_lowercase, k=rng.randint(1, 8)))
+
+
+def _rand_value(tp, rng, depth):
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is typing.Union:  # Optional[X]
+        inner = [a for a in args if a is not type(None)]
+        if rng.random() < 0.4 or depth > 5:
+            return None
+        return _rand_value(inner[0], rng, depth)
+    if origin in (list, typing.List):
+        if depth > 5:
+            return []
+        return [_rand_value(args[0], rng, depth + 1) for _ in range(rng.randint(0, 2))]
+    if origin in (dict, typing.Dict):
+        if depth > 5:
+            return {}
+        return {
+            _rand_str(rng): _rand_value(args[1], rng, depth + 1)
+            for _ in range(rng.randint(0, 2))
+        }
+    if tp is str:
+        return _rand_str(rng)
+    if tp is bool:
+        return rng.random() < 0.5
+    if tp is int:
+        return rng.randint(0, 9999)
+    if tp is float:
+        return float(rng.randint(0, 100))
+    if tp is Quantity:
+        return parse_quantity(rng.choice(["100m", "2", "64Mi", "1Gi", "500"]))
+    if dataclasses.is_dataclass(tp):
+        return _rand_instance(tp, rng, depth + 1)
+    if tp is typing.Any or tp is object:
+        return _rand_str(rng)
+    return None
+
+
+def _rand_instance(cls, rng, depth=0):
+    """Random instance of a typed API dataclass, fields filled by type
+    hint (bounded depth so recursive specs terminate)."""
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        if f.name in ("kind", "api_version"):
+            continue  # set by the caller / serde
+        if depth > 6:
+            break
+        v = _rand_value(hints[f.name], rng, depth)
+        if v is not None:
+            kwargs[f.name] = v
+    try:
+        return cls(**kwargs)
+    except TypeError:
+        return cls()
+
+
+# Kinds whose wire form the conversion layer must round-trip. Minion is
+# an alias of Node; DeleteOptions has no conversions and no metadata.
+ROUND_TRIP_KINDS = sorted(set(KINDS) - {"Minion"})
+
+
+class TestRoundTripFuzz:
+    @pytest.mark.parametrize("kind", ROUND_TRIP_KINDS)
+    def test_v1_to_v1beta3_to_v1_lossless(self, kind):
+        rng = random.Random(hash(kind) & 0xFFFF)
+        cls = KINDS[kind]
+        for trial in range(25):
+            obj = _rand_instance(cls, rng)
+            wire = serde.to_wire(obj)
+            if not isinstance(wire, dict):
+                continue
+            wire["kind"] = kind
+            wire["apiVersion"] = "v1"
+            beta = conversion.from_internal(wire, "v1beta3")
+            back = conversion.to_internal(beta, "v1beta3")
+            assert back == wire, (
+                f"{kind} trial {trial}: round-trip diverged\n"
+                f"v1:      {wire}\nv1beta3: {beta}\nback:    {back}"
+            )
+
+    @pytest.mark.parametrize("kind", ["Pod", "Service", "ReplicationController"])
+    def test_list_round_trip(self, kind):
+        rng = random.Random(42)
+        cls = KINDS[kind]
+        items = []
+        for _ in range(4):
+            wire = serde.to_wire(_rand_instance(cls, rng))
+            wire["kind"] = kind
+            wire["apiVersion"] = "v1"
+            items.append(wire)
+        lst = {"kind": f"{kind}List", "apiVersion": "v1", "items": items}
+        beta = conversion.from_internal(lst, "v1beta3")
+        back = conversion.to_internal(beta, "v1beta3")
+        assert back == lst
+
+
+class TestSemanticEdges:
+    """The named conversions keep their reference quirks."""
+
+    def test_service_type_wins_over_bool(self):
+        beta = {
+            "kind": "Service", "apiVersion": "v1beta3",
+            "spec": {"type": "ClusterIP", "createExternalLoadBalancer": True},
+        }
+        v1 = conversion.to_internal(beta, "v1beta3")
+        assert v1["spec"]["type"] == "ClusterIP"  # type present: bool ignored
+
+    def test_lb_bool_selects_loadbalancer(self):
+        beta = {
+            "kind": "Service", "apiVersion": "v1beta3",
+            "spec": {"createExternalLoadBalancer": True},
+        }
+        v1 = conversion.to_internal(beta, "v1beta3")
+        assert v1["spec"]["type"] == "LoadBalancer"
+
+    def test_legacy_container_capabilities_fold(self):
+        """v1beta3 top-level capabilities/privileged fold into
+        securityContext on decode (conversion.go:226-256); encode to
+        v1beta3 emits only securityContext."""
+        beta = {
+            "kind": "Pod", "apiVersion": "v1beta3",
+            "spec": {
+                "host": "n1",
+                "containers": [
+                    {"name": "c", "image": "x",
+                     "capabilities": {"add": ["NET_ADMIN"]},
+                     "privileged": True}
+                ],
+            },
+        }
+        v1 = conversion.to_internal(beta, "v1beta3")
+        c = v1["spec"]["containers"][0]
+        assert "capabilities" not in c and "privileged" not in c
+        assert c["securityContext"]["capabilities"] == {"add": ["NET_ADMIN"]}
+        assert c["securityContext"]["privileged"] is True
+        assert v1["spec"]["nodeName"] == "n1"
+
+    def test_status_details_id_name(self):
+        v1 = {
+            "kind": "Status", "apiVersion": "v1",
+            "details": {"name": "p1", "kind": "pods"},
+        }
+        beta = conversion.from_internal(v1, "v1beta3")
+        assert beta["details"]["id"] == "p1" and "name" not in beta["details"]
+        assert conversion.to_internal(beta, "v1beta3") == v1

@@ -22,7 +22,7 @@ def wait_until(cond, timeout=60.0, interval=0.1):
     return False
 
 
-def rc_wire(name, replicas, app):
+def rc_wire(name, replicas, app, cpu="100m", mem="64Mi"):
     return {
         "kind": "ReplicationController",
         "metadata": {"name": name, "namespace": "default"},
@@ -42,7 +42,7 @@ def rc_wire(name, replicas, app):
                             # legitimately pile onto the tie-break
                             # node, same as the reference scheduler.
                             "resources": {
-                                "limits": {"cpu": "100m", "memory": "64Mi"}
+                                "limits": {"cpu": cpu, "memory": mem}
                             },
                         }
                     ]
@@ -162,3 +162,134 @@ class TestLoad:
             == 0,
             timeout=30,
         )
+
+
+class TestMaxInFlight:
+    """Inbound protection (pkg/apiserver/handlers.go MaxInFlightLimit):
+    excess concurrent non-long-running requests get 429; long-running
+    requests (watch) bypass the limit entirely."""
+
+    def test_429_beyond_limit_watch_exempt(self):
+        import threading
+
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.server.api import APIError
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api = APIServer()
+        slow = threading.Event()
+        real_list = api.list
+
+        def slow_list(resource, *a, **kw):
+            if resource == "pods":
+                slow.wait(timeout=5)
+            return real_list(resource, *a, **kw)
+
+        api.list = slow_list
+        srv = APIHTTPServer(api, max_in_flight=2).start()
+        try:
+            client = Client(HTTPTransport(srv.address))
+            outcomes = []
+
+            def lister():
+                try:
+                    client.list("pods", namespace="default")
+                    outcomes.append("ok")
+                except APIError as e:
+                    outcomes.append(e.code)
+
+            threads = [threading.Thread(target=lister) for _ in range(6)]
+            for t in threads:
+                t.start()
+            time.sleep(0.5)  # both slots now held by slow lists
+            # Long-running passthrough: a watch opens fine while the
+            # server is saturated.
+            stream = client.watch("pods", namespace="default")
+            assert not stream.closed
+            stream.close()
+            slow.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert outcomes.count(429) >= 1, outcomes
+            assert outcomes.count("ok") >= 2, outcomes
+            # Slots were released: the server serves normally again.
+            client.list("pods", namespace="default")
+        finally:
+            api.list = real_list
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestDensityAtScale:
+    """The reference bar at reference scale (VERDICT r2 item 6):
+    >=1k pods over the real HTTP apiserver with >=12 kubelets (fake
+    runtime under a real control plane, exactly how cmd/integration
+    tests multi-node), batch scheduler, asserting the density.go
+    pass criteria: all Running, <=1% abnormal events, API p99 SLO
+    clean (test/e2e/density.go:108-129)."""
+
+    def test_density_1k_pods_12_nodes(self):
+        from kubernetes_tpu.server.httpserver import high_latency_requests
+        from kubernetes_tpu.utils import metrics as metricspkg
+
+        args = build_parser().parse_args(
+            ["--port", "0", "--nodes", "12", "--batch-scheduler"]
+        )
+        c = LocalCluster(args).start()
+        try:
+            client = Client(HTTPTransport(c.http.address))
+            total = 1200  # 100 pods/node — over the 30/node gate
+            n_rcs = 12
+            for i in range(n_rcs):
+                # 100 pods/node must FIT the kubelets' registered
+                # capacity (4 CPU): 25m each -> 2.5 of 4 cores.
+                client.create(
+                    "replicationcontrollers",
+                    rc_wire(
+                        f"dense-{i}", total // n_rcs, f"dense-{i}",
+                        cpu="25m", mem="16Mi",
+                    ),
+                )
+
+            def all_running():
+                pods, _ = client.list("pods", namespace="default")
+                return sum(1 for p in pods if p.status.phase == "Running")
+
+            assert wait_until(
+                lambda: all_running() >= total, timeout=420, interval=1.0
+            ), f"only {all_running()}/{total} Running"
+            pods, _ = client.list("pods", namespace="default")
+            per_node = {}
+            for p in pods:
+                per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+            assert len(per_node) == 12, "some kubelet carried no pods"
+            assert all(v <= 110 for v in per_node.values()), per_node
+            client.flush_events()
+            assert abnormal_event_fraction(client, total) <= 0.01
+            slow = high_latency_requests(threshold=1.0)
+            assert not slow, f"API p99 SLO violations: {slow}"
+        finally:
+            c.stop()
+
+
+def test_proxy_subpath_is_long_running_exempt():
+    """Proxy requests carry subpaths after the verb; they must bypass
+    the in-flight limit wherever 'proxy' sits in the path (review
+    regression — reference regex matches anywhere)."""
+    from kubernetes_tpu.server.httpserver import _request_is_long_running
+
+    assert _request_is_long_running(
+        ("nodes", "n1", "proxy", "healthz"), {}
+    )
+    assert _request_is_long_running(
+        ("namespaces", "ns", "pods", "p", "proxy", "metrics"), {}
+    )
+    assert _request_is_long_running(("watch", "pods"), {})
+    assert _request_is_long_running(("namespaces", "d", "pods"), {"watch": "true"})
+    assert _request_is_long_running(
+        ("namespaces", "d", "pods", "p", "log"), {"follow": "true"}
+    )
+    assert not _request_is_long_running(
+        ("namespaces", "d", "pods", "p", "log"), {}
+    )
+    assert not _request_is_long_running(("namespaces", "d", "pods"), {})

@@ -63,6 +63,31 @@ BASE_PREDS = [
 
 
 class TestSpecPlumbing:
+    def test_default_plus_argumented_priority_is_not_default(self):
+        """Adding ServiceAntiAffinity on top of the stock set must NOT
+        classify as default — the batch path would silently drop the
+        configured priority (review regression)."""
+        policy = {
+            "predicates": BASE_PREDS,
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 1},
+                {"name": "BalancedResourceAllocation", "weight": 1},
+                {"name": "ServiceSpreadingPriority", "weight": 1},
+                {"name": "aa", "weight": 2,
+                 "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+            ],
+        }
+        spec = spec_from_policy(policy)
+        assert not spec.is_default()
+        nodes = [
+            mk_node("n0", labels={"zone": "a"}),
+            mk_node("n1", labels={"zone": "b"}),
+        ]
+        pods = [mk_pod(f"p{i}", labels={"app": "w"}) for i in range(4)]
+        assert_policy_parity(
+            policy, pods, nodes, services=[mk_svc("w", {"app": "w"})]
+        )
+
     def test_default_policy_is_default_spec(self):
         policy = {
             "kind": "Policy",

@@ -1,0 +1,76 @@
+"""Decision-quality regression gates for the approximate solvers
+(VERDICT r2 item 4): wave/sinkhorn placements are scored against the
+greedy oracle via pod-order replay — a change that quietly starts
+placing pods on their 5th-best node fails here, not in production.
+
+Scores are a 0-30 scale (three 0-10 priorities). Measured values on
+this workload (2k x 200, two seeds): wave mean regret ~0.65-0.73 with
+p99 = 2; sinkhorn ~2.5-2.9 with p99 <= 14 (congestion pricing trades
+greed for balance — its load stddev is the flip side, benched). The
+bounds below carry ~2x headroom over measured, far below a systematic
+"always the 5th-best node" regression (which would push mean regret
+past 4-5 even for wave).
+"""
+
+import numpy as np
+import pytest
+
+from __graft_entry__ import _synthetic_objects
+from kubernetes_tpu.models.columnar import build_snapshot
+from kubernetes_tpu.ops import device_snapshot
+from kubernetes_tpu.ops.oracle import assignment_quality, solve_sequential_numpy
+from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments
+from kubernetes_tpu.ops.solver import solve_assignments
+from kubernetes_tpu.ops.wave import wave_assignments
+
+
+@pytest.fixture(scope="module")
+def problem():
+    pods, nodes, services = _synthetic_objects(2000, 200, seed=5)
+    snap = build_snapshot(pods, nodes, services=services)
+    return snap, device_snapshot(snap)
+
+
+class TestOracleReplay:
+    def test_scan_has_zero_regret(self, problem):
+        """The sequential scan IS the greedy policy: replaying its own
+        assignment must show zero regret and full greedy match — the
+        replay harness's self-test."""
+        snap, d = problem
+        scan = solve_assignments(d)
+        q = assignment_quality(snap, scan)
+        assert q["mean_regret"] == 0.0
+        assert q["greedy_match"] == 1.0
+        assert q["feasible_in_order"] == 1.0
+
+    def test_oracle_matches_device_scan(self, problem):
+        snap, d = problem
+        seq = solve_sequential_numpy(snap)
+        dev = np.asarray(solve_assignments(d))
+        assert float((seq == dev).mean()) >= 0.99
+
+
+class TestWaveQuality:
+    def test_regret_bounded(self, problem):
+        snap, d = problem
+        a, _ = wave_assignments(d)
+        a = np.asarray(a)[: d.n_pods]
+        q = assignment_quality(snap, a)
+        assert q["placed"] == d.n_pods, "wave left pods unplaced"
+        assert q["feasible_in_order"] >= 0.99
+        assert q["mean_regret"] <= 1.5, q
+        assert q["p99_regret"] <= 5, q
+        assert q["greedy_match"] >= 0.30, q
+
+
+class TestSinkhornQuality:
+    def test_regret_bounded(self, problem):
+        snap, d = problem
+        a, _ = sinkhorn_assignments(d)
+        a = np.asarray(a)[: d.n_pods]
+        q = assignment_quality(snap, a)
+        assert q["placed"] == d.n_pods, "sinkhorn left pods unplaced"
+        assert q["feasible_in_order"] >= 0.99
+        assert q["mean_regret"] <= 5.0, q
+        assert q["p99_regret"] <= 20, q
+        assert q["greedy_match"] >= 0.20, q
