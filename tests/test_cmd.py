@@ -244,6 +244,12 @@ class TestSwaggerAndUI:
             namespace="default",
         )
         html = urllib.request.urlopen(server.address + "/ui/").read().decode()
-        assert "kubernetes-tpu dashboard" in html
+        assert "kubernetes-tpu" in html
         assert "pods" in html
         assert "swagger" in html
+        # The SPA polls the live API and hash-routes per-resource views.
+        assert "setInterval(render" in html
+        assert "replicationcontrollers" in html
+        # Any /ui subpath serves the app shell (client-side routing).
+        sub = urllib.request.urlopen(server.address + "/ui/pods").read().decode()
+        assert "setInterval(render" in sub
