@@ -1,0 +1,10 @@
+#!/usr/bin/env python3
+"""Tear a kube-up cluster down (cluster/kube-down.sh analog)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from kubernetes_tpu.cmd.clusterup import down_main  # noqa: E402
+
+sys.exit(down_main())

@@ -1,0 +1,10 @@
+#!/usr/bin/env python3
+"""Bring a cluster up from an inventory (cluster/kube-up.sh analog)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from kubernetes_tpu.cmd.clusterup import up_main  # noqa: E402
+
+sys.exit(up_main())

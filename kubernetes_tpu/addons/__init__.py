@@ -3,5 +3,6 @@ monitoring)."""
 
 from kubernetes_tpu.addons.dns import ClusterDNS
 from kubernetes_tpu.addons.logging import ClusterLogAggregator
+from kubernetes_tpu.addons.monitoring import ClusterMonitor
 
-__all__ = ["ClusterDNS", "ClusterLogAggregator"]
+__all__ = ["ClusterDNS", "ClusterLogAggregator", "ClusterMonitor"]

@@ -1,0 +1,234 @@
+"""Multi-host cluster composition: the cluster/ (kube-up) analog.
+
+Reference: cluster/kube-up.sh + per-provider scripts provision a
+master and N nodes, start the daemons on each, and install addons
+(cluster/gce/util.sh, cluster/addons/). Here the same composition is
+an inventory-driven planner with two providers:
+
+- local:  every component runs as a hyperkube subprocess on THIS
+          machine (the testable profile; hosts in the inventory are
+          ignored). State (pids, ports) is recorded in the state dir
+          so kube-down can tear the cluster down.
+- ssh:    the same per-host command plan executed through `ssh <host>`
+          (or printed with --dry-run for inspection/automation). Hosts
+          must share the repo checkout at the same path.
+
+The plan a single inventory produces:
+  master host:  apiserver (--data-dir for durability) and, per
+                control_plane_replicas, controller-manager + scheduler
+                pairs with --leader-elect (hot standbys; the batch
+                scheduler when the inventory says so)
+  node hosts:   one kubelet each (process or fake runtime) + optional
+                kube-proxy
+  addons:       python -m kubernetes_tpu.addons (--dns/--monitoring)
+
+Inventory (JSON):
+  {"master": {"host": "10.0.0.1", "port": 8080, "data_dir": "/var/..."},
+   "control_plane_replicas": 2,
+   "batch_scheduler": true,
+   "nodes": [{"name": "node-0", "host": "10.0.0.2"}, ...],
+   "runtime": "fake" | "process",
+   "addons": ["dns", "monitoring"]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+HYPERKUBE = os.path.join(REPO, "bin", "hyperkube")
+
+
+def load_inventory(path: str) -> dict:
+    with open(path) as f:
+        inv = json.load(f)
+    inv.setdefault("master", {})
+    inv["master"].setdefault("host", "127.0.0.1")
+    inv["master"].setdefault("port", 8080)
+    inv.setdefault("control_plane_replicas", 1)
+    inv.setdefault("nodes", [])
+    inv.setdefault("runtime", "fake")
+    inv.setdefault("addons", [])
+    return inv
+
+
+def plan(inv: dict) -> List[Tuple[str, str, List[str]]]:
+    """-> [(host, role, argv)] in start order."""
+    m = inv["master"]
+    server = f"http://{m['host']}:{m['port']}"
+    out: List[Tuple[str, str, List[str]]] = []
+    apiserver = [
+        sys.executable, HYPERKUBE, "apiserver",
+        "--address", "0.0.0.0" if inv["nodes"] else "127.0.0.1",
+        "--port", str(m["port"]),
+    ]
+    if m.get("data_dir"):
+        apiserver += ["--data-dir", m["data_dir"]]
+    out.append((m["host"], "apiserver", apiserver))
+    for i in range(int(inv["control_plane_replicas"])):
+        out.append(
+            (m["host"], f"controller-manager-{i}", [
+                sys.executable, HYPERKUBE, "controller-manager",
+                "--server", server, "--leader-elect",
+                "--healthz-port", "-1",
+            ])
+        )
+        sched = [
+            sys.executable, HYPERKUBE, "scheduler",
+            "--server", server, "--leader-elect", "--healthz-port", "-1",
+        ]
+        if inv.get("batch_scheduler"):
+            sched.append("--batch")
+        out.append((m["host"], f"scheduler-{i}", sched))
+    for node in inv["nodes"]:
+        kubelet = [
+            sys.executable, HYPERKUBE, "kubelet",
+            "--server", server, "--node-name", node["name"],
+        ]
+        if inv["runtime"] == "process":
+            kubelet += ["--root-dir", node.get(
+                "root_dir", f"/tmp/ktpu-{node['name']}"
+            )]
+        else:
+            kubelet.append("--fake-runtime")
+        out.append((node.get("host", "127.0.0.1"), f"kubelet-{node['name']}", kubelet))
+    if inv["addons"]:
+        addons = [sys.executable, "-m", "kubernetes_tpu.addons",
+                  "--server", server, "--publish"]
+        for a in inv["addons"]:
+            addons.append(f"--{a}")
+        out.append((m["host"], "addons", addons))
+    return out
+
+
+def _wait_healthy(server: str, timeout: float = 30.0) -> bool:
+    import urllib.request
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(server + "/healthz", timeout=2) as r:
+                if r.status == 200:
+                    return True
+        except Exception:
+            pass
+        time.sleep(0.3)
+    return False
+
+
+def up(inv: dict, state_dir: str, provider: str = "local",
+       dry_run: bool = False) -> int:
+    steps = plan(inv)
+    if dry_run:
+        for host, role, argv in steps:
+            print(f"[{host}] {role}: {' '.join(argv)}")
+        return 0
+    os.makedirs(state_dir, exist_ok=True)
+    server = f"http://{inv['master']['host']}:{inv['master']['port']}"
+    state_path = os.path.join(state_dir, "cluster.json")
+    state: Dict[str, dict] = {}
+
+    def persist():
+        # After EVERY start, so a kube-up crash mid-bring-up still
+        # leaves kube-down something to tear down.
+        with open(state_path, "w") as f:
+            json.dump({"inventory": inv, "components": state}, f, indent=2)
+
+    try:
+        for host, role, argv in steps:
+            remote = provider == "ssh" and host not in ("127.0.0.1", "localhost")
+            info: Dict[str, object] = {"host": host, "remote": remote}
+            if remote:
+                # The remote side records its own pid so kube-down can
+                # SIGTERM the daemon itself, not just the ssh client.
+                pidfile = f"/tmp/ktpu-{role}.pid"
+                info["pidfile"] = pidfile
+                argv = [
+                    "ssh", host, "--", "sh", "-c",
+                    f"echo $$ > {shlex.quote(pidfile)} && "
+                    f"exec {shlex.join(argv)}",
+                ]
+            log = os.path.join(state_dir, f"{role}.log")
+            proc = subprocess.Popen(
+                argv,
+                stdout=open(log, "w"),
+                stderr=subprocess.STDOUT,
+                cwd=REPO,
+                start_new_session=True,
+            )
+            info["pid"] = proc.pid
+            info["log"] = log
+            state[role] = info
+            persist()
+            print(f"started {role} (pid {proc.pid}) on {host}")
+            if role == "apiserver" and not _wait_healthy(server):
+                raise RuntimeError("apiserver never became healthy")
+    except Exception as e:
+        print(f"bring-up failed ({e}); tearing down started components",
+              file=sys.stderr)
+        down(state_dir)
+        return 1
+    print(f"cluster up: {server} ({len(steps)} components; "
+          f"state in {state_dir})")
+    print(f"  try: bin/ktctl get nodes --server {server}")
+    return 0
+
+
+def _signal_component(info: dict, sig: int) -> None:
+    if info.get("remote"):
+        subprocess.run(
+            ["ssh", info["host"], "--",
+             f"kill -{sig} $(cat {shlex.quote(info['pidfile'])}) "
+             f"2>/dev/null || true"],
+            check=False,
+        )
+    try:
+        os.killpg(info["pid"], sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def down(state_dir: str) -> int:
+    path = os.path.join(state_dir, "cluster.json")
+    if not os.path.exists(path):
+        print(f"no cluster state at {path}", file=sys.stderr)
+        return 1
+    with open(path) as f:
+        state = json.load(f)
+    # Reverse order: kubelets/addons before the apiserver.
+    for role, info in reversed(list(state["components"].items())):
+        _signal_component(info, signal.SIGTERM)
+        print(f"stopped {role} (pid {info['pid']})")
+    time.sleep(0.5)
+    for role, info in state["components"].items():
+        _signal_component(info, signal.SIGKILL)
+    os.unlink(path)
+    return 0
+
+
+def up_main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-up")
+    p.add_argument("--inventory", "-i", required=True)
+    p.add_argument("--state-dir", default=".kube-cluster")
+    p.add_argument("--provider", choices=("local", "ssh"), default="local")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+    return up(
+        load_inventory(args.inventory), args.state_dir,
+        provider=args.provider, dry_run=args.dry_run,
+    )
+
+
+def down_main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-down")
+    p.add_argument("--state-dir", default=".kube-cluster")
+    args = p.parse_args(argv)
+    return down(args.state_dir)

@@ -1050,6 +1050,9 @@ const RESOURCES = {
    (((v.spec||{}).claimRef)||{}).name||'', age(v)]},
  persistentvolumeclaims: {cols: ['name','phase','volume','age'],
   row: c => [name(c), pill((c.status||{}).phase), (c.spec||{}).volumeName||'', age(c)]},
+ podtemplates: {cols: ['name','containers','age'],
+  row: t => [name(t), (((t.template||{}).spec||{}).containers||[])
+   .map(c=>c.name).join(', '), age(t)]},
  componentstatuses: {ns: false, cols: ['name','status','message'],
   row: c => {const cond=(c.conditions||[{}])[0];
    return [name(c), pill(cond.status==='True'?'Healthy':'Unhealthy',
@@ -1093,8 +1096,13 @@ async function refreshNamespaces(){
   const names=(d.items||[]).map(n=>name(n)).filter(Boolean);
   if(!names.includes(NS)) names.push(NS);
   const sel=document.getElementById('nsSel');
-  const want=names.map(n=>'<option'+(n===NS?' selected':'')+'>'+esc(n)+'</option>').join('');
-  if(sel.innerHTML!==want) sel.innerHTML=want;
+  // Compare the OPTION VALUES, not innerHTML (browsers normalize
+  // serialized markup, so a string compare would rebuild — and close
+  // an open dropdown — on every tick).
+  const have=[...sel.options].map(o=>o.value).join('\\u0000');
+  if(have!==names.join('\\u0000')){
+   sel.innerHTML=names.map(n=>'<option>'+esc(n)+'</option>').join('');}
+  sel.value=NS;
  }catch(e){}}
 async function renderOverview(){
  const lists=await Promise.all(Object.keys(RESOURCES).map(async r=>{
@@ -1112,9 +1120,16 @@ function tableFor(res, items){const def=RESOURCES[res];
  return '<table><tr>'+def.cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>'+
   items.map(o=>'<tr>'+def.row(o).map(v=>'<td>'+cell(v)+'</td>').join('')+'</tr>').join('')+
   '</table>';}
-let renderGen=0;
-async function render(){nav(); refreshNamespaces();
- const gen=++renderGen, cur=route();
+let renderGen=0, rendering=false, lastOverview=0;
+async function render(force){nav(); refreshNamespaces();
+ const cur=route();
+ // Be a polite API client: never overlap request rounds, and poll the
+ // request-heavy overview (one list per resource kind) at 6s instead
+ // of 2s so a parked tab can't crowd the max-in-flight budget.
+ if(rendering && !force) return;
+ if(cur==='overview' && !force && Date.now()-lastOverview < 5500) return;
+ rendering=true;
+ const gen=++renderGen;
  const main=document.getElementById('main');
  try{
   let html;
@@ -1127,17 +1142,19 @@ async function render(){nav(); refreshNamespaces();
     tableFor(cur, items);}
   else {html='unknown view '+esc(cur);}
   // A slower, earlier render must never paint over a newer one
-  // (hashchange + the 2s tick can overlap).
+  // (hashchange + the 2s tick can overlap via force).
   if(gen!==renderGen) return;
+  if(cur==='overview') lastOverview=Date.now();
   main.innerHTML=html;
   document.getElementById('status').textContent='live · '+new Date().toLocaleTimeString();
  }catch(e){if(gen===renderGen)
   document.getElementById('status').textContent='api error: '+e;}
+ finally{if(gen===renderGen) rendering=false;}
 }
 document.getElementById('nsSel').addEventListener('change', e=>{
- NS=e.target.value; render();});
-window.addEventListener('hashchange', render);
-render(); setInterval(render, 2000);
+ NS=e.target.value; render(true);});
+window.addEventListener('hashchange', ()=>render(true));
+render(true); setInterval(()=>render(false), 2000);
 </script>
 </body></html>"""
 

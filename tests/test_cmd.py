@@ -248,8 +248,8 @@ class TestSwaggerAndUI:
         assert "pods" in html
         assert "swagger" in html
         # The SPA polls the live API and hash-routes per-resource views.
-        assert "setInterval(render" in html
+        assert "setInterval(" in html and "render(" in html
         assert "replicationcontrollers" in html
         # Any /ui subpath serves the app shell (client-side routing).
         sub = urllib.request.urlopen(server.address + "/ui/pods").read().decode()
-        assert "setInterval(render" in sub
+        assert "setInterval(" in sub
