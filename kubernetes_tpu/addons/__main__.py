@@ -28,6 +28,12 @@ def main(argv=None) -> int:
         "--publish", action="store_true",
         help="register kube-dns / monitoring-heapster Services",
     )
+    p.add_argument(
+        "--endpoint-host", default="127.0.0.1",
+        help="the address OTHER hosts reach this addon process at "
+        "(published in the Services' Endpoints; loopback only works "
+        "on single-host clusters)",
+    )
     args = p.parse_args(argv)
 
     from kubernetes_tpu.client import Client, HTTPTransport
@@ -41,7 +47,9 @@ def main(argv=None) -> int:
 
         dns = ClusterDNS(client(), port=args.dns_port).start()
         if args.publish:
-            dns.publish(client(), cluster_ip=args.dns_ip)
+            dns.publish(
+                client(), cluster_ip=args.dns_ip, host=args.endpoint_host
+            )
         daemons.append(dns)
         print(f"dns serving on udp port {dns.port}")
     if args.monitoring:
@@ -51,7 +59,11 @@ def main(argv=None) -> int:
             client(), args.server, port=args.monitoring_port
         ).start()
         if args.publish:
-            mon.publish(client(), cluster_ip=args.monitoring_ip)
+            mon.publish(
+                client(),
+                cluster_ip=args.monitoring_ip,
+                host=args.endpoint_host,
+            )
         daemons.append(mon)
         print(f"monitoring model api on port {mon.port}")
     if not daemons:

@@ -123,7 +123,7 @@ class ClusterDNS:
         return self.sock.getsockname()[1]
 
     def publish(self, client, cluster_ip: str = "10.0.0.10",
-                namespace: str = "default") -> None:
+                namespace: str = "default", host: str = "127.0.0.1") -> None:
         """Register the kube-dns Service + Endpoints (the reference's
         skydns-svc.yaml pins the well-known 10.0.0.10). A real-portal
         kube-proxy then serves DNS at VIP:53/UDP for every process on
@@ -156,7 +156,11 @@ class ClusterDNS:
             "metadata": {"name": "kube-dns", "namespace": namespace},
             "subsets": [
                 {
-                    "addresses": [{"ip": "127.0.0.1"}],
+                    # The reachable address of the host running this
+                    # addon — loopback only works on single-host
+                    # clusters; multi-host composition passes the
+                    # master's address.
+                    "addresses": [{"ip": host}],
                     "ports": [{"name": "dns", "port": self.port,
                                "protocol": "UDP"}],
                 }

@@ -84,11 +84,21 @@ class ClusterMonitor:
         self.server_url = server_url.rstrip("/")
         self.resolution = resolution
         self.window = window
+        # Deletion hooks prune the series map: under pod churn every
+        # revision mints new names, and without pruning both memory
+        # and the model listings grow forever (heapster expires stale
+        # entries the same way).
         self.nodes = Informer(
-            client, "nodes", decode=lambda w: serde.from_wire(Node, w)
+            client, "nodes",
+            decode=lambda w: serde.from_wire(Node, w),
+            on_delete=lambda n: self._prune("node", n.metadata.name),
         )
         self.pods = Informer(
-            client, "pods", decode=lambda w: serde.from_wire(Pod, w)
+            client, "pods",
+            decode=lambda w: serde.from_wire(Pod, w),
+            on_delete=lambda p: self._prune(
+                "pod", f"{p.metadata.namespace}/{p.metadata.name}"
+            ),
         )
         self._lock = threading.Lock()
         # (scope, key, metric) -> _Series; scope "node" keys by node
@@ -159,6 +169,13 @@ class ClusterMonitor:
                 "pod", key, "uptime_seconds", now,
                 max((c.get("uptimeSeconds", 0) for c in cs), default=0),
             )
+
+    def _prune(self, scope: str, key: str) -> None:
+        with self._lock:
+            for k in [
+                k for k in self._series if k[0] == scope and k[1] == key
+            ]:
+                del self._series[k]
 
     def _add(self, scope: str, key: str, metric: str, ts: float, v: float):
         with self._lock:

@@ -101,8 +101,12 @@ def plan(inv: dict) -> List[Tuple[str, str, List[str]]]:
             kubelet.append("--fake-runtime")
         out.append((node.get("host", "127.0.0.1"), f"kubelet-{node['name']}", kubelet))
     if inv["addons"]:
+        # The addons run on the master host; other hosts reach them at
+        # the master's address, so that is what gets published in the
+        # Services' Endpoints (loopback would strand multi-host nodes).
         addons = [sys.executable, "-m", "kubernetes_tpu.addons",
-                  "--server", server, "--publish"]
+                  "--server", server, "--publish",
+                  "--endpoint-host", m["host"]]
         for a in inv["addons"]:
             addons.append(f"--{a}")
         out.append((m["host"], "addons", addons))
@@ -149,13 +153,17 @@ def up(inv: dict, state_dir: str, provider: str = "local",
             if remote:
                 # The remote side records its own pid so kube-down can
                 # SIGTERM the daemon itself, not just the ssh client.
+                # The script ships as ONE pre-quoted word: ssh joins its
+                # argv with spaces and the remote login shell re-parses
+                # the result, so an unquoted script would word-split
+                # (`sh -c echo` puts $$ in $0 and blanks the pidfile).
                 pidfile = f"/tmp/ktpu-{role}.pid"
                 info["pidfile"] = pidfile
-                argv = [
-                    "ssh", host, "--", "sh", "-c",
+                script = (
                     f"echo $$ > {shlex.quote(pidfile)} && "
-                    f"exec {shlex.join(argv)}",
-                ]
+                    f"exec {shlex.join(argv)}"
+                )
+                argv = ["ssh", host, "--", "sh", "-c", shlex.quote(script)]
             log = os.path.join(state_dir, f"{role}.log")
             proc = subprocess.Popen(
                 argv,

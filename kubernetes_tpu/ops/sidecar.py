@@ -4,11 +4,23 @@ The north-star architecture (SURVEY §2.15/§5) keeps the control plane
 and the accelerator in SEPARATE processes: the reference-shaped control
 plane never touches JAX, the sidecar owns the TPU, and a sidecar crash
 degrades to the stock scalar path instead of taking the scheduler down.
-This module is that boundary: a length-prefixed pickle protocol over a
-unix socket (numpy arrays serialize near-zero-copy with protocol 5),
-a client that lowers API objects to the columnar snapshot host-side and
-ships only arrays, and a `python -m kubernetes_tpu.ops.sidecar` server
-entry point.
+This module is that boundary: a versioned, SCHEMA'D array protocol over
+a unix socket, a client that lowers API objects to the columnar
+snapshot host-side and ships only arrays, and a
+`python -m kubernetes_tpu.ops.sidecar` server entry point.
+
+Wire format (one frame per message, either direction):
+
+    b"KTPU" | u16 version | u32 header_len | header JSON | array bytes
+
+The JSON header carries the structured message with ndarrays replaced
+by {"__nd__": i} placeholders into an arrays table of {dtype, shape};
+the raw buffers follow concatenated in table order (near-zero-copy
+both ways). Tuples and the solver's LoweredSpec round-trip via tagged
+objects. Version skew between control plane and sidecar — the process
+that exists precisely to be restarted independently — therefore fails
+with a CLEAN SidecarError instead of deserializing garbage, and no
+pickle means a malicious frame can name no code to run.
 
 Failure contract: any transport/sidecar error raises SidecarError; the
 BatchScheduler's existing fallback seam (scheduler/daemon.py
@@ -18,8 +30,8 @@ reference's stock-FitPredicate fallback implies, now process-real.
 
 from __future__ import annotations
 
+import json
 import os
-import pickle
 import socket
 import struct
 import subprocess
@@ -28,6 +40,9 @@ import tempfile
 import time
 from typing import List, Optional, Sequence
 
+import numpy as np
+
+from kubernetes_tpu.models.algspec import LoweredSpec
 from kubernetes_tpu.models.columnar import Snapshot, build_snapshot
 
 
@@ -37,18 +52,109 @@ class SidecarError(Exception):
 
 # -- framing ----------------------------------------------------------
 
+_MAGIC = b"KTPU"
+_VERSION = 2  # v1 was pickle; bumped with any schema change
+
+
+def _encode(obj):
+    """-> (header_bytes, [contiguous ndarrays])."""
+    arrays: List[np.ndarray] = []
+
+    def walk(x):
+        if isinstance(x, np.ndarray):
+            arrays.append(np.ascontiguousarray(x))
+            return {"__nd__": len(arrays) - 1}
+        if isinstance(x, LoweredSpec):
+            return {"__lowered__": walk(dict(x._asdict()))}
+        if isinstance(x, tuple):
+            return {"__tuple__": [walk(v) for v in x]}
+        if isinstance(x, dict):
+            return {str(k): walk(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, np.bool_):
+            return bool(x)
+        if x is None or isinstance(x, (str, int, float, bool)):
+            return x
+        raise SidecarError(f"unencodable field type {type(x).__name__}")
+
+    meta = walk(obj)
+    header = json.dumps(
+        {
+            "meta": meta,
+            "arrays": [
+                {"dtype": a.dtype.str, "shape": list(a.shape)} for a in arrays
+            ],
+        },
+        separators=(",", ":"),
+    ).encode()
+    return header, arrays
+
+
+def _decode(header: bytes, body: bytes):
+    try:
+        doc = json.loads(header)
+        specs = doc["arrays"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise SidecarError(f"malformed frame header: {e}")
+    views = []
+    mv = memoryview(body)  # slices of a memoryview are zero-copy
+    off = 0
+    for s in specs:
+        dt = np.dtype(s["dtype"])
+        n = int(np.prod(s["shape"])) * dt.itemsize
+        if off + n > len(body):
+            raise SidecarError("frame body shorter than its array table")
+        views.append(
+            np.frombuffer(mv[off:off + n], dtype=dt).reshape(s["shape"])
+        )
+        off += n
+
+    def walk(x):
+        if isinstance(x, dict):
+            if "__nd__" in x and len(x) == 1:
+                return views[x["__nd__"]]
+            if "__tuple__" in x and len(x) == 1:
+                return tuple(walk(v) for v in x["__tuple__"])
+            if "__lowered__" in x and len(x) == 1:
+                return LoweredSpec(**walk(x["__lowered__"]))
+            return {k: walk(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        return x
+
+    return walk(doc["meta"])
+
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=5)
-    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+    header, arrays = _encode(obj)
+    total = len(header) + sum(a.nbytes for a in arrays)
+    sock.sendall(
+        _MAGIC + struct.pack(">HQI", _VERSION, total, len(header)) + header
+    )
+    for a in arrays:
+        sock.sendall(a.data if a.nbytes else b"")
 
 
 def _recv_msg(sock: socket.socket):
-    head = _recv_exact(sock, 8)
-    (n,) = struct.unpack(">Q", head)
-    if n > 1 << 31:
-        raise SidecarError(f"oversized frame ({n} bytes)")
-    return pickle.loads(_recv_exact(sock, n))
+    head = _recv_exact(sock, 4 + 2 + 8 + 4)
+    if head[:4] != _MAGIC:
+        raise SidecarError("not a KTPU frame (magic mismatch)")
+    version, total, header_len = struct.unpack(">HQI", head[4:])
+    if version != _VERSION:
+        raise SidecarError(
+            f"sidecar protocol version skew: peer speaks v{version}, "
+            f"this build speaks v{_VERSION} — restart the older side"
+        )
+    if total > 1 << 31 or header_len > total:
+        raise SidecarError(f"oversized frame ({total} bytes)")
+    header = _recv_exact(sock, header_len)
+    body = _recv_exact(sock, total - header_len)
+    return _decode(header, body)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -142,10 +248,11 @@ class SidecarSolver:
     sidecar, returns node names. Raises SidecarError on ANY failure so
     the caller's fallback seam engages.
 
-    Trust model: the frames are pickle, so the socket is a PRIVILEGE
-    BOUNDARY — only a same-user sidecar may serve it. The server chmods
-    its socket 0600 and the client refuses sockets owned by another
-    uid; point --solver-sidecar only at paths this user controls.
+    Trust model: the schema'd protocol carries only JSON + raw
+    arrays (no code), but the socket remains same-user-only as defense
+    in depth: the server chmods it 0600 and the client refuses sockets
+    owned by another uid; point --solver-sidecar only at paths this
+    user controls.
 
     The default timeout is deliberately short: a HUNG (not crashed)
     sidecar would otherwise stall every batch for the full timeout
@@ -161,7 +268,7 @@ class SidecarSolver:
             if st.st_uid != os.geteuid():
                 raise SidecarError(
                     f"sidecar socket {self.sock_path!r} owned by uid "
-                    f"{st.st_uid}, not us — refusing (pickle boundary)"
+                    f"{st.st_uid}, not us — refusing (same-user boundary)"
                 )
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             sock.settimeout(timeout)
@@ -171,7 +278,7 @@ class SidecarSolver:
                 return _recv_msg(sock)
             finally:
                 sock.close()
-        except (OSError, pickle.PickleError, EOFError) as e:
+        except (OSError, EOFError) as e:
             raise SidecarError(f"sidecar transport failure: {e}")
 
     def solve(
@@ -253,7 +360,7 @@ def serve(sock_path: str) -> None:
     except OSError:
         pass
     server.bind(sock_path)
-    os.chmod(sock_path, 0o600)  # pickle boundary: same-user only
+    os.chmod(sock_path, 0o600)  # same-user boundary
     server.listen(4)
     while True:
         conn, _ = server.accept()

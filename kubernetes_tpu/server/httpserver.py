@@ -1082,7 +1082,11 @@ function nodeReady(n){const c=((n.status||{}).conditions||[])
  if(un) txt+=',Unschedulable';
  return pill(txt, txt==='Ready'?'ok':'bad');}
 let NS='default';
-async function getJSON(u){const r=await fetch(u); if(!r.ok) throw new Error(r.status);
+async function getJSON(u){
+ // Bounded: a blackholed request must fail fast, or the no-overlap
+ // render gate would freeze polling until the browser's own timeout.
+ const r=await fetch(u, {signal: AbortSignal.timeout(4000)});
+ if(!r.ok) throw new Error(r.status);
  return r.json();}
 const listPath=(res)=> (RESOURCES[res]&&RESOURCES[res].ns===false)
  ? '/api/v1/'+res : '/api/v1/namespaces/'+encodeURIComponent(NS)+'/'+res;
