@@ -104,6 +104,7 @@ class ClusterMonitor:
         # (scope, key, metric) -> _Series; scope "node" keys by node
         # name, scope "pod" keys by "namespace/name".
         self._series: Dict[Tuple[str, str, str], _Series] = {}
+        self._tombstones: Dict[Tuple[str, str], float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -176,9 +177,18 @@ class ClusterMonitor:
                 k for k in self._series if k[0] == scope and k[1] == key
             ]:
                 del self._series[k]
+            # Tombstone: an in-flight scrape that joined against the
+            # pre-delete pod cache must not resurrect the series after
+            # this one-and-only prune (the DELETE event never refires).
+            self._tombstones[(scope, key)] = time.time()
 
     def _add(self, scope: str, key: str, metric: str, ts: float, v: float):
         with self._lock:
+            dead = self._tombstones.get((scope, key))
+            if dead is not None:
+                if ts <= dead + 2 * self.resolution:
+                    return  # stale in-flight scrape of a deleted object
+                del self._tombstones[(scope, key)]  # genuinely reborn
             s = self._series.get((scope, key, metric))
             if s is None:
                 s = self._series[(scope, key, metric)] = _Series(

@@ -42,6 +42,8 @@ class HealthServer:
         checks = checks or []
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            disable_nagle_algorithm = True
+
             def log_message(self, fmt, *a):  # noqa: N802
                 pass
 

@@ -35,6 +35,7 @@ from kubernetes_tpu.models import serde
 
 class _KubeletHandler(BaseHTTPRequestHandler):
     kubelet = None  # bound by KubeletServer
+    disable_nagle_algorithm = True  # keep-alive without Nagle stalls
 
     def log_message(self, fmt, *args):  # quiet
         pass

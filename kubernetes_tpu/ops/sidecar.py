@@ -11,7 +11,8 @@ snapshot host-side and ships only arrays, and a
 
 Wire format (one frame per message, either direction):
 
-    b"KTPU" | u16 version | u32 header_len | header JSON | array bytes
+    b"KTPU" | u16 version | u64 total_len | u32 header_len |
+    header JSON | array bytes
 
 The JSON header carries the structured message with ndarrays replaced
 by {"__nd__": i} placeholders into an arrays table of {dtype, shape};
@@ -96,38 +97,44 @@ def _encode(obj):
 
 
 def _decode(header: bytes, body: bytes):
+    """Every malformed-frame failure surfaces as SidecarError — the
+    'any transport/sidecar error raises SidecarError' contract the
+    fallback seam and ping() rely on (a raw TypeError from a corrupt
+    dtype string would otherwise crash the readiness loop)."""
     try:
         doc = json.loads(header)
         specs = doc["arrays"]
-    except (ValueError, KeyError, TypeError) as e:
-        raise SidecarError(f"malformed frame header: {e}")
-    views = []
-    mv = memoryview(body)  # slices of a memoryview are zero-copy
-    off = 0
-    for s in specs:
-        dt = np.dtype(s["dtype"])
-        n = int(np.prod(s["shape"])) * dt.itemsize
-        if off + n > len(body):
-            raise SidecarError("frame body shorter than its array table")
-        views.append(
-            np.frombuffer(mv[off:off + n], dtype=dt).reshape(s["shape"])
-        )
-        off += n
+        views = []
+        mv = memoryview(body)  # slices of a memoryview are zero-copy
+        off = 0
+        for s in specs:
+            dt = np.dtype(s["dtype"])
+            n = int(np.prod(s["shape"])) * dt.itemsize
+            if n < 0 or off + n > len(body):
+                raise SidecarError("frame body shorter than its array table")
+            views.append(
+                np.frombuffer(mv[off:off + n], dtype=dt).reshape(s["shape"])
+            )
+            off += n
 
-    def walk(x):
-        if isinstance(x, dict):
-            if "__nd__" in x and len(x) == 1:
-                return views[x["__nd__"]]
-            if "__tuple__" in x and len(x) == 1:
-                return tuple(walk(v) for v in x["__tuple__"])
-            if "__lowered__" in x and len(x) == 1:
-                return LoweredSpec(**walk(x["__lowered__"]))
-            return {k: walk(v) for k, v in x.items()}
-        if isinstance(x, list):
-            return [walk(v) for v in x]
-        return x
+        def walk(x):
+            if isinstance(x, dict):
+                if "__nd__" in x and len(x) == 1:
+                    return views[x["__nd__"]]
+                if "__tuple__" in x and len(x) == 1:
+                    return tuple(walk(v) for v in x["__tuple__"])
+                if "__lowered__" in x and len(x) == 1:
+                    return LoweredSpec(**walk(x["__lowered__"]))
+                return {k: walk(v) for k, v in x.items()}
+            if isinstance(x, list):
+                return [walk(v) for v in x]
+            return x
 
-    return walk(doc["meta"])
+        return walk(doc["meta"])
+    except SidecarError:
+        raise
+    except Exception as e:
+        raise SidecarError(f"malformed frame: {type(e).__name__}: {e}")
 
 
 def _send_msg(sock: socket.socket, obj) -> None:

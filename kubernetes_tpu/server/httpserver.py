@@ -108,6 +108,10 @@ def high_latency_requests(threshold: float = 1.0, summary=None):
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubernetes-tpu-apiserver"
+    # Nagle + delayed-ACK interact catastrophically with keep-alive
+    # request/response traffic (~40ms stalls per request on loopback);
+    # the reference's Go net/http also runs with TCP_NODELAY.
+    disable_nagle_algorithm = True
     api: APIServer  # set by serve()
     # Inbound protection (pkg/apiserver/handlers.go MaxInFlightLimit,
     # wired at pkg/master/master.go): a BoundedSemaphore shared by all
