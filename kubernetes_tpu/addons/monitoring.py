@@ -180,7 +180,15 @@ class ClusterMonitor:
             # Tombstone: an in-flight scrape that joined against the
             # pre-delete pod cache must not resurrect the series after
             # this one-and-only prune (the DELETE event never refires).
-            self._tombstones[(scope, key)] = time.time()
+            now = time.time()
+            self._tombstones[(scope, key)] = now
+            # Sweep expired tombstones here (deletes are the only
+            # source of growth): under revision churn names never
+            # return, so _add's rebirth branch would never collect
+            # them and the map would grow forever.
+            horizon = now - 2 * self.resolution
+            for k in [k for k, t in self._tombstones.items() if t < horizon]:
+                del self._tombstones[k]
 
     def _add(self, scope: str, key: str, metric: str, ts: float, v: float):
         with self._lock:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import ssl
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -25,6 +26,16 @@ from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.server.registry import RESOURCES
 from kubernetes_tpu.store.watch import Event
 from kubernetes_tpu.utils.ratelimit import TokenBucket
+
+#: Failures that mean a pooled keep-alive connection went stale
+#: (server restart / idle close) rather than the request being bad.
+_STALE_ERRORS = (
+    http.client.BadStatusLine,
+    http.client.CannotSendRequest,
+    ConnectionError,
+    BrokenPipeError,
+    ssl.SSLError,
+)
 
 
 class Transport:
@@ -156,8 +167,6 @@ class HTTPTransport(Transport):
         # for x509 authentication against the apiserver.
         self.ssl_context = ssl_context
         if u.scheme == "https" and ssl_context is None:
-            import ssl
-
             self.ssl_context = ssl.create_default_context()
         # Keep-alive: one persistent connection per thread. A fresh
         # TCP connection per request cost ~10x on CRUD throughput
@@ -225,33 +234,28 @@ class HTTPTransport(Transport):
         raw=True returns the response text verbatim (pod logs);
         otherwise the JSON-decoded body.
 
-        Stale-keep-alive handling: a REUSED connection that fails while
-        SENDING (the server cannot have processed the request) retries
-        once on a fresh connection for any verb; a failure while
-        READING the response retries only GETs — the server may have
-        executed the request before dying, and replaying a create/bind
-        would double-apply. A fresh connection's failure propagates:
-        that is a real outage."""
-        import ssl as _ssl
-
+        Stale-keep-alive handling: a REUSED connection that fails
+        while SENDING retries once on a fresh connection for any verb
+        (bytes can land in the kernel buffer of a half-closed socket,
+        so most stale failures actually surface at the read). At the
+        READ, RemoteDisconnected (a clean close with zero response
+        bytes, the standard stale-keep-alive signal both Go net/http
+        and urllib3 retry) retries for any verb; other read failures
+        retry only GETs, since the server may have executed the
+        request before dying and replaying a create/bind would
+        double-apply. A fresh connection's failure propagates: that
+        is a real outage."""
         if query:
             path = path + "?" + urlencode({k: v for k, v in query.items() if v})
         payload = json.dumps(body).encode() if body is not None else None
         headers = dict(self.headers)
         if payload:
             headers["Content-Type"] = content_type
-        stale_errors = (
-            http.client.BadStatusLine,
-            http.client.CannotSendRequest,
-            ConnectionError,
-            BrokenPipeError,
-            _ssl.SSLError,
-        )
         while True:
             conn, reused = self._pooled()
             try:
                 conn.request(verb, path, body=payload, headers=headers)
-            except stale_errors:
+            except _STALE_ERRORS:
                 self._discard()
                 if reused:
                     continue  # request never left: safe for any verb
@@ -262,7 +266,12 @@ class HTTPTransport(Transport):
             try:
                 resp = conn.getresponse()
                 raw_body = resp.read()
-            except stale_errors:
+            except http.client.RemoteDisconnected:
+                self._discard()
+                if reused:
+                    continue  # clean close, nothing served: replay-safe
+                raise
+            except _STALE_ERRORS:
                 self._discard()
                 if reused and verb == "GET":
                     continue

@@ -271,11 +271,12 @@ def _scan_solve(pods, nodes, weights, lspec=DEFAULT_LOWERED):
         return _commit(carry, pod, choice, N), choice
 
     # The scan is latency-bound on TPU (per-iteration sequencing
-    # overhead ~30us dominates the ~500KB the body actually touches).
-    # unroll=2 halves that overhead — measured 1.6s -> 0.93s on the
-    # 50k x 5k backlog — while higher factors lose to register/VMEM
-    # pressure. Decisions are bit-identical for any unroll.
-    return jax.lax.scan(step, nodes, pods, unroll=2)
+    # overhead ~30us dominates the ~500KB the body actually touches),
+    # so unrolling amortizes it. Swept at 50k x 5k on v5e: unroll
+    # 2/8/16/32 solve in 1.27/1.16/1.15/1.12s with compile+first-run
+    # at 6.2/5.0/-/8.7s — 8 takes most of the runtime win at the
+    # LOWEST compile cost. Decisions are bit-identical for any unroll.
+    return jax.lax.scan(step, nodes, pods, unroll=8)
 
 
 @functools.partial(jax.jit, static_argnames=("weights", "lspec"))
