@@ -240,8 +240,23 @@ class ProcessRuntime(ContainerRuntime):
 
         return [sys.executable, "-c", "import signal;signal.pause()"]
 
+    #: Accelerator/runtime plumbing that must NOT leak into pods. A
+    #: workload process inheriting the node's TPU attachment env dials
+    #: the device tunnel at interpreter start (this box's sitecustomize
+    #: gates on PALLAS_AXON_POOL_IPS) and stalls ~30s contending with
+    #: the solver for the chip — the process-runtime analog of
+    #: containers not inheriting the kubelet's device handles.
+    _HOST_ONLY_ENV = (
+        "PALLAS_AXON_POOL_IPS",
+        "JAX_PLATFORMS",
+        "XLA_FLAGS",
+        "TPU_WORKER_HOSTNAMES",
+    )
+
     def _env_for(self, pod: Pod, spec) -> Dict[str, str]:
         env = dict(os.environ)
+        for k in self._HOST_ONLY_ENV:
+            env.pop(k, None)
         # Service discovery env first (envvars.go FromServices; the
         # POD'S NAMESPACE only), then pod identity, then the
         # container's OWN env — user-declared variables win.

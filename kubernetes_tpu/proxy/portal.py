@@ -27,6 +27,14 @@ def _ip(*args: str) -> subprocess.CompletedProcess:
     )
 
 
+def _addr_exists(stderr: str) -> bool:
+    """`ip addr add` duplicate-address message varies by iproute2
+    version: 'RTNETLINK answers: File exists' (classic) vs
+    'Error: ipv4: Address already assigned.' (newer). Both mean the
+    address is present and usable."""
+    return "File exists" in stderr or "already assigned" in stderr
+
+
 class LoopbackPortals:
     """Refcounted /32 loopback addresses for service VIPs."""
 
@@ -50,7 +58,7 @@ class LoopbackPortals:
                 probe = "10.255.254.253"
                 try:
                     add = _ip("addr", "add", f"{probe}/32", "dev", "lo")
-                    ok = add.returncode == 0 or "File exists" in add.stderr
+                    ok = add.returncode == 0 or _addr_exists(add.stderr)
                     if add.returncode == 0:
                         _ip("addr", "del", f"{probe}/32", "dev", "lo")
                     cls._supported = ok
@@ -70,7 +78,7 @@ class LoopbackPortals:
                 return False
             if out.returncode == 0:
                 owned = True
-            elif "File exists" in out.stderr:
+            elif _addr_exists(out.stderr):
                 owned = False  # pre-existing: usable but not ours
             else:
                 return False

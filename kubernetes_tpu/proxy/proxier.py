@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -300,16 +301,52 @@ class Proxier:
                 client, addr = sock.accept()
             except OSError:
                 return
+            # Backend dialing happens on a per-connection thread: the
+            # up-to-2s endpoint wait must never head-of-line block the
+            # accept loop (10 clients arriving during an endpoint gap
+            # would otherwise serialize ~2s each behind one accept).
+            threading.Thread(
+                target=self._serve_connection,
+                args=(name, info, client, addr),
+                daemon=True,
+            ).start()
+
+    def _serve_connection(
+        self, name: ServicePortName, info: ServiceInfo, client, addr
+    ) -> None:
+        try:
+            backend = self._connect_backend_wait(name, info, addr[0])
+        except (ErrMissingServiceEntry, ErrMissingEndpoints, OSError):
+            client.close()
+            return
+        for a, b in ((client, backend), (backend, client)):
+            threading.Thread(
+                target=self._copy_bytes, args=(a, b), daemon=True
+            ).start()
+
+    def _connect_backend_wait(
+        self,
+        name: ServicePortName,
+        info: ServiceInfo,
+        client_ip: str,
+        wait: float = 2.0,
+    ):
+        """_connect_backend, waiting out brief backend gaps: endpoints
+        repopulate milliseconds after a readiness flap, and a freshly
+        started pod's server may bind a beat after its endpoint is
+        published — in both windows dropping an accepted connection
+        loses requests a client already queued behind a successful
+        portal connect. The reference's tryConnect similarly retries
+        dialing with backoff instead of failing the session on the
+        first error (proxysocket.go endpointDialTimeout ladder)."""
+        deadline = time.monotonic() + wait
+        while True:
             try:
-                backend = self._connect_backend(name, addr[0])
+                return self._connect_backend(name, client_ip)
             except (ErrMissingServiceEntry, ErrMissingEndpoints, OSError):
-                client.close()
-                continue
-            for a, b in ((client, backend), (backend, client)):
-                t = threading.Thread(
-                    target=self._copy_bytes, args=(a, b), daemon=True
-                )
-                t.start()
+                if time.monotonic() >= deadline or not info.is_alive:
+                    raise
+                time.sleep(0.05)
 
     def _connect_backend(self, name: ServicePortName, client_ip: str):
         # Retry across endpoints like the reference's tryConnect

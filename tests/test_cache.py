@@ -122,3 +122,116 @@ class TestInformer:
             assert deletes == ["x"]
         finally:
             inf.stop()
+
+
+def obj(name, ns="default", **extra):
+    return {"kind": "Pod", "metadata": {"name": name, "namespace": ns}, **extra}
+
+
+class TestIndexer:
+    def test_by_index(self):
+        from kubernetes_tpu.client.cache import Indexer
+
+        by_node = lambda o: [o.get("spec", {}).get("nodeName", "")]
+        idx = Indexer({"node": by_node})
+        idx.add(obj("a", spec={"nodeName": "n1"}))
+        idx.add(obj("b", spec={"nodeName": "n1"}))
+        idx.add(obj("c", spec={"nodeName": "n2"}))
+        assert {o["metadata"]["name"] for o in idx.by_index("node", "n1")} == {"a", "b"}
+        assert idx.index_values("node") == ["n1", "n2"]
+        # Re-add moves the object between index buckets.
+        idx.add(obj("a", spec={"nodeName": "n2"}))
+        assert {o["metadata"]["name"] for o in idx.by_index("node", "n2")} == {"a", "c"}
+        idx.delete(obj("c"))
+        assert {o["metadata"]["name"] for o in idx.by_index("node", "n2")} == {"a"}
+        idx.replace([obj("z", spec={"nodeName": "n9"})])
+        assert idx.by_index("node", "n1") == []
+        assert len(idx.by_index("node", "n9")) == 1
+
+
+class TestExpirationCache:
+    def test_entries_age_out(self):
+        import time as _t
+
+        from kubernetes_tpu.client.cache import ExpirationCache
+
+        c = ExpirationCache(ttl=0.15)
+        c.add(obj("a"))
+        assert c.get("default/a") is not None
+        _t.sleep(0.2)
+        assert c.get("default/a") is None
+        assert c.list() == []
+
+    def test_readd_refreshes(self):
+        import time as _t
+
+        from kubernetes_tpu.client.cache import ExpirationCache
+
+        c = ExpirationCache(ttl=0.2)
+        c.add(obj("a"))
+        _t.sleep(0.12)
+        c.add(obj("a"))  # refresh
+        _t.sleep(0.12)
+        assert c.get("default/a") is not None
+
+
+class TestUndeltaStore:
+    def test_pushes_full_state(self):
+        from kubernetes_tpu.client.cache import UndeltaStore
+
+        snaps = []
+        s = UndeltaStore(lambda state: snaps.append(
+            sorted(o["metadata"]["name"] for o in state)))
+        s.add(obj("a"))
+        s.add(obj("b"))
+        s.delete(obj("a"))
+        s.replace([obj("x")])
+        assert snaps == [["a"], ["a", "b"], ["b"], ["x"]]
+
+
+class TestDeltaFIFO:
+    def test_deletions_survive_dedup(self):
+        """The whole point vs plain FIFO: an add+delete race yields
+        BOTH deltas on pop, so the consumer sees the deletion."""
+        from kubernetes_tpu.client.cache import DeltaFIFO
+
+        q = DeltaFIFO()
+        q.add(obj("a"))
+        q.delete(obj("a"))
+        deltas = q.pop(timeout=1)
+        assert [t for t, _o in deltas] == ["ADDED", "DELETED"]
+
+    def test_add_then_update_types(self):
+        from kubernetes_tpu.client.cache import DeltaFIFO
+
+        q = DeltaFIFO()
+        q.add(obj("a"))
+        assert [t for t, _ in q.pop(timeout=1)] == ["ADDED"]
+        q.add(obj("a", spec={"x": 1}))
+        assert [t for t, _ in q.pop(timeout=1)] == ["MODIFIED"]
+
+    def test_replace_syncs_and_synthesizes_deletes(self):
+        from kubernetes_tpu.client.cache import DeltaFIFO
+
+        q = DeltaFIFO()
+        q.add(obj("gone"))
+        q.pop(timeout=1)
+        q.replace([obj("kept")])
+        # Two keys queued: 'gone' (Deleted) and 'kept' (Sync).
+        batches = [q.pop(timeout=1), q.pop(timeout=1)]
+        types = {d[0][1]["metadata"]["name"]: [t for t, _ in d] for d in batches}
+        assert types["gone"] == ["DELETED"]
+        assert types["kept"] == ["SYNC"]
+
+    def test_close_unblocks_pop(self):
+        import threading as _th
+
+        from kubernetes_tpu.client.cache import DeltaFIFO
+
+        q = DeltaFIFO()
+        out = []
+        t = _th.Thread(target=lambda: out.append(q.pop()), daemon=True)
+        t.start()
+        q.close()
+        t.join(timeout=5)
+        assert out == [None]

@@ -87,13 +87,54 @@ def test_guestbook_end_to_end():
             except Exception:
                 return False
 
-        assert wait_until(frontend_answers, timeout=40), "frontend unreachable"
 
+        def cluster_diagnostics():
+            import subprocess
+
+            pods, _ = client.list("pods", namespace="default")
+            state = [
+                (p.metadata.name, p.spec.node_name, p.status.phase,
+                 [cs.restart_count for cs in p.status.container_statuses])
+                for p in pods
+            ]
+            listeners = subprocess.run(
+                "ss -tlnp | grep -E '16379|18080|6379'",
+                shell=True, capture_output=True, text=True,
+            ).stdout
+            return f"pods={state} listeners=[{listeners}]"
+
+        if not wait_until(frontend_answers, timeout=40):
+            try:
+                with urllib.request.urlopen(base + "/", timeout=3) as r:
+                    last = f"status={r.status}"
+            except Exception as e:
+                last = f"{type(e).__name__}: {e}"
+            raise AssertionError(
+                f"frontend unreachable; last={last} {cluster_diagnostics()}"
+            )
+
+        # A 200 from the frontend does NOT prove the redis leg is up
+        # yet (the example app answers 200 with an empty list while its
+        # backend is still binding — same capture-at-start reality the
+        # reference guestbook has). Retry the write+read round trip
+        # until the message survives, like test/e2e/kubectl.go's
+        # guestbook validation polls.
         msg = urllib.parse.quote("hello from the tpu cluster")
-        with urllib.request.urlopen(f"{base}/add?msg={msg}", timeout=10) as r:
-            assert r.status == 200
-        with urllib.request.urlopen(base + "/", timeout=10) as r:
-            body = r.read().decode()
-        assert "hello from the tpu cluster" in body
+
+        def message_persists():
+            try:
+                with urllib.request.urlopen(f"{base}/add?msg={msg}", timeout=5) as r:
+                    if r.status != 200:
+                        return False
+                with urllib.request.urlopen(base + "/", timeout=5) as r:
+                    return "hello from the tpu cluster" in r.read().decode()
+            except Exception:
+                return False
+
+        if not wait_until(message_persists, timeout=40):
+            raise AssertionError(
+                "guestbook entry never persisted through the service "
+                f"chain; {cluster_diagnostics()}"
+            )
     finally:
         cluster.stop()
