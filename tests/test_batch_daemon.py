@@ -55,7 +55,7 @@ def test_batch_schedules_backlog_config1():
     for i in range(100):
         client.create("pods", pod_wire(f"p{i}"))
     cfg = SchedulerConfig(Client(LocalTransport(api))).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg)
     # Watch from the current version to observe bindings flow out.
     _, version = client.list("pods", namespace="default")
@@ -87,7 +87,7 @@ def test_batch_daemon_thread_with_churn():
     for j in range(4):
         client.create("nodes", node_wire(f"n{j}"))
     cfg = SchedulerConfig(Client(LocalTransport(api))).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg).start()
     for i in range(40):
         client.create("pods", pod_wire(f"c{i}"))
@@ -109,7 +109,7 @@ def test_batch_unschedulable_and_mixed():
     client.create("pods", pod_wire("fits", cpu="500m"))
     client.create("pods", pod_wire("huge", cpu="64"))
     cfg = SchedulerConfig(Client(LocalTransport(api))).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg)
     assert wait_until(lambda: len(cfg.pod_queue) == 2)
     sched.schedule_batch(timeout=1)
@@ -130,7 +130,7 @@ def test_wave_mode_schedules_backlog():
     for i in range(24):
         client.create("pods", pod_wire(f"w{i}"))
     cfg = SchedulerConfig(Client(LocalTransport(api))).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg, mode="wave")
     try:
         processed = 0
@@ -156,7 +156,7 @@ def test_sinkhorn_mode_schedules_backlog():
     for i in range(24):
         client.create("pods", pod_wire(f"s{i}"))
     cfg = SchedulerConfig(Client(LocalTransport(api))).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg, mode="sinkhorn")
     try:
         processed = 0
@@ -188,7 +188,7 @@ def test_batch_respects_assumed_capacity_across_batches():
     client.create("nodes", node_wire("n0", cpu="1", pods="40"))
     client.create("nodes", node_wire("n1", cpu="1", pods="40"))
     cfg = SchedulerConfig(Client(LocalTransport(api))).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg)
     client.create("pods", pod_wire("a", cpu="600m"))
     assert wait_until(lambda: len(cfg.pod_queue) == 1)
@@ -241,7 +241,7 @@ def test_batch_honors_scheduler_policy():
                    "labels": {"tier": "fast", "fast-disk": "true"}}},
     )
     cfg = SchedulerConfig(Client(LocalTransport(api)), policy=policy).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg, mode="sinkhorn")  # must be overridden
     assert sched.mode == "scan", "non-default policy must force the scan solver"
     assert not sched.policy_scalar
@@ -278,7 +278,7 @@ def test_batch_unlowerable_policy_runs_scalar_with_policy():
         "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
     }
     cfg = SchedulerConfig(Client(LocalTransport(api)), policy=policy).start()
-    assert cfg.wait_for_sync()
+    assert cfg.wait_for_sync(timeout=60)
     sched = BatchScheduler(cfg)
     assert sched.policy_scalar, "unlowerable policy must pin the scalar path"
     total = 0
