@@ -180,11 +180,10 @@ def _parity_figures() -> dict:
         snap = build_snapshot(pods, nodes, services=services)
         seq = solve_sequential_numpy(snap)
         dev = np.asarray(solve_assignments(device_snapshot(snap)))
-        key = (
-            f"parity_seq_oracle_{n_pods // 1000}kx"
-            f"{n_nodes // 1000 if n_nodes >= 1000 else n_nodes}"
-            f"{'k' if n_nodes >= 1000 else ''}"
-        )
+        def _k(n: int) -> str:
+            return f"{n // 1000}k" if n >= 1000 else str(n)
+
+        key = f"parity_seq_oracle_{_k(n_pods)}x{_k(n_nodes)}"
         out[key] = float((seq == dev).mean())
     # NOTE: decision-identity parity is only meaningful for the scan
     # (which replicates the oracle's lowest-index tie-break). The

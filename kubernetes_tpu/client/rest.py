@@ -37,6 +37,32 @@ _STALE_ERRORS = (
     ssl.SSLError,
 )
 
+#: Verbs auto-replayed when a reused connection dies mid-read — the
+#: idempotent set Go net/http and urllib3 replay. NOTE idempotent ≠
+#: invisible: if the server applied the first attempt before dying, a
+#: replayed CAS PUT (stale resourceVersion) surfaces 409 and a
+#: replayed DELETE 404 — both on the caller's own success. Controllers
+#: already treat those as benign re-read signals, which IS the
+#: reconciliation. POST is excluded because its failure mode is worse:
+#: a double-applied create, or a 409 the caller can't distinguish from
+#: a genuine name collision.
+_IDEMPOTENT_VERBS = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
+
+class UnknownOutcomeError(ConnectionError):
+    """A non-idempotent request's connection died after send, before
+    any response byte: the server may or may not have applied it.
+    Callers should re-read the resource to reconcile rather than
+    blindly retry (re-creating could 409 on their own success)."""
+
+    def __init__(self, verb: str, path: str):
+        super().__init__(
+            f"{verb} {path}: connection lost before response; "
+            "outcome unknown — reconcile by reading current state"
+        )
+        self.verb = verb
+        self.path = path
+
 
 class Transport:
     def request(self, verb: str, path_parts: tuple, query: dict, body: Optional[dict]):
@@ -239,12 +265,15 @@ class HTTPTransport(Transport):
         (bytes can land in the kernel buffer of a half-closed socket,
         so most stale failures actually surface at the read). At the
         READ, RemoteDisconnected (a clean close with zero response
-        bytes, the standard stale-keep-alive signal both Go net/http
-        and urllib3 retry) retries for any verb; other read failures
-        retry only GETs, since the server may have executed the
-        request before dying and replaying a create/bind would
-        double-apply. A fresh connection's failure propagates: that
-        is a real outage."""
+        bytes, the standard stale-keep-alive signal) retries only
+        idempotent verbs (GET/HEAD/PUT/DELETE) — matching urllib3 and
+        Go net/http, which never auto-replay a POST here, because the
+        server may have executed the mutation and died before writing
+        the response; a silent replay would double-apply (a create
+        that actually succeeded would surface a spurious 409). POST
+        raises UnknownOutcomeError so callers can reconcile. Other
+        read failures retry only GETs. A fresh connection's failure
+        propagates: that is a real outage."""
         if query:
             path = path + "?" + urlencode({k: v for k, v in query.items() if v})
         payload = json.dumps(body).encode() if body is not None else None
@@ -266,10 +295,15 @@ class HTTPTransport(Transport):
             try:
                 resp = conn.getresponse()
                 raw_body = resp.read()
-            except http.client.RemoteDisconnected:
+            except http.client.RemoteDisconnected as e:
                 self._discard()
+                if reused and verb in _IDEMPOTENT_VERBS:
+                    continue  # clean close before any response bytes
                 if reused:
-                    continue  # clean close, nothing served: replay-safe
+                    # POST/PATCH on a stale connection: the server may
+                    # have applied the mutation before dying. Don't
+                    # replay; tell the caller the outcome is unknown.
+                    raise UnknownOutcomeError(verb, path) from e
                 raise
             except _STALE_ERRORS:
                 self._discard()

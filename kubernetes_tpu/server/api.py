@@ -117,8 +117,12 @@ def _json_patch(doc: dict, ops: list) -> dict:
 
     doc = _copy.deepcopy(doc)
 
-    def resolve(pointer, make_parents=False):
-        """-> (container, final_token). Container is a dict or list."""
+    def resolve(pointer):
+        """-> (container, final_token). Container is a dict or list.
+        RFC 6902 resolution never auto-creates intermediates: 'add'
+        (and move/copy targets) MUST fail when the parent container
+        does not exist — matching evanphx/json-patch, which the
+        reference vendors."""
         parts = _json_pointer_parts(pointer)
         if not parts:
             raise _bad_request("operations on the root document are not supported")
@@ -131,9 +135,7 @@ def _json_patch(doc: dict, ops: list) -> dict:
                     raise _bad_request(f"pointer {pointer!r}: bad index {p!r}")
             elif isinstance(cur, dict):
                 if p not in cur:
-                    if not make_parents:
-                        raise _bad_request(f"pointer {pointer!r}: missing {p!r}")
-                    cur[p] = {}
+                    raise _bad_request(f"pointer {pointer!r}: missing {p!r}")
                 cur = cur[p]
             else:
                 raise _bad_request(f"pointer {pointer!r}: {p!r} is a scalar")
@@ -151,7 +153,7 @@ def _json_patch(doc: dict, ops: list) -> dict:
         return cont[tok]
 
     def add_at(pointer, value):
-        cont, tok = resolve(pointer, make_parents=True)
+        cont, tok = resolve(pointer)
         if isinstance(cont, list):
             if tok == "-":
                 cont.append(value)
@@ -202,17 +204,35 @@ def _json_patch(doc: dict, ops: list) -> dict:
     return doc
 
 
-#: Strategic-merge list merge keys (reference: struct tags consumed by
-#: pkg/util/strategicpatch — containers/env/volumes merge by name,
-#: ports by containerPort/port, volumeMounts by mountPath). Candidates
-#: are tried in order against the list's elements.
+#: Strategic-merge list merge keys, keyed on the FIELD NAME the list
+#: lives under — mirroring the reference's per-field struct tags
+#: consumed by pkg/util/strategicpatch (`patchMergeKey`), not a global
+#: candidate order. Container ports must merge by containerPort even
+#: when every element also carries a name: a patch entry reusing a
+#: name with a new containerPort APPENDS in the reference (distinct
+#: merge-key value) rather than updating in place.
+_FIELD_MERGE_KEYS: Dict[str, Tuple[str, ...]] = {
+    "containers": ("name",),
+    "env": ("name",),
+    "volumes": ("name",),
+    "imagePullSecrets": ("name",),
+    "volumeMounts": ("mountPath",),
+    # Container ports merge by containerPort; Service ports (same
+    # field name, no containerPort on the elements) by port.
+    "ports": ("containerPort", "port"),
+    "addresses": ("ip",),
+    "conditions": ("type",),
+    "secrets": ("name",),
+}
+#: Fallback candidates for lists under fields with no registered tag.
 _STRATEGIC_MERGE_KEYS = ("name", "containerPort", "port", "mountPath", "type", "ip")
 
 
-def _strategic_key_for(items: list) -> Optional[str]:
+def _strategic_key_for(items: list, field: Optional[str] = None) -> Optional[str]:
     if not items or not all(isinstance(x, dict) for x in items):
         return None
-    for key in _STRATEGIC_MERGE_KEYS:
+    candidates = _FIELD_MERGE_KEYS.get(field) if field else None
+    for key in candidates if candidates else _STRATEGIC_MERGE_KEYS:
         if all(key in x for x in items):
             return key
     return None
@@ -236,8 +256,9 @@ def _strategic_merge(target: dict, patch: dict) -> dict:
         elif isinstance(v, list):
             base = out.get(k)
             key = _strategic_key_for(
-                [x for x in v if isinstance(x, dict) and x.get("$patch") != "delete"]
-            ) or _strategic_key_for(base if isinstance(base, list) else [])
+                [x for x in v if isinstance(x, dict) and x.get("$patch") != "delete"],
+                field=k,
+            ) or _strategic_key_for(base if isinstance(base, list) else [], field=k)
             if key is None or not isinstance(base, list):
                 out[k] = [
                     x for x in v
@@ -252,6 +273,15 @@ def _strategic_merge(target: dict, patch: dict) -> dict:
             }
             for item in v:
                 if not isinstance(item, dict) or key not in item:
+                    # A $patch directive MUST carry the list's merge
+                    # key (reference strategicpatch errors likewise);
+                    # appending it raw would persist the directive
+                    # into the stored object and skip the delete.
+                    if isinstance(item, dict) and "$patch" in item:
+                        raise _bad_request(
+                            f"strategic patch directive in {k!r} lacks "
+                            f"merge key {key!r}"
+                        )
                     merged.append(item)
                     continue
                 i = index.get(item[key])

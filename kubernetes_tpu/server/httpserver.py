@@ -1277,8 +1277,16 @@ class APIHTTPServer:
             self.api.publish_master_service(host, port)
         return self
 
-    def stop(self) -> None:
+    def stop(self, release_store: bool = True) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        # Release the store (WAL handle + data-dir flock): a stopped
+        # apiserver must let a successor open the same --data-dir.
+        # release_store=False keeps it live for callers that hand the
+        # SAME APIServer to a replacement front-end (HTTP-tier-only
+        # restart; the store outlives the listener like etcd outlives
+        # the reference apiserver).
+        if release_store:
+            self.api.store.close()

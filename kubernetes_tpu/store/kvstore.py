@@ -32,6 +32,7 @@ are tiny next to the TPU solver's work).
 from __future__ import annotations
 
 import copy
+import fcntl
 import json
 import os
 import threading
@@ -88,11 +89,33 @@ class KVStore:
         self._snapshot_every = snapshot_every
         self._wal_file = None
         self._wal_count = 0
+        self._closed = False
+        self._lockfd: Optional[int] = None
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._data_dir = data_dir
             self._snap_path = os.path.join(data_dir, "snapshot.json")
             self._wal_path = os.path.join(data_dir, "wal.log")
+            # Exclusive advisory lock on the data dir: two stores
+            # appending the same WAL / racing snapshot.json via
+            # os.replace would silently interleave state (etcd
+            # serializes this for the reference — one member owns the
+            # dir). Held for the process lifetime; the OS releases it
+            # on any death, so a kill -9'd owner never wedges restart.
+            self._lockfd = os.open(
+                os.path.join(data_dir, "LOCK"), os.O_CREAT | os.O_RDWR, 0o644
+            )
+            try:
+                fcntl.flock(self._lockfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(self._lockfd)
+                self._lockfd = None
+                raise StoreError(
+                    f"data dir {data_dir!r} is locked by another KVStore "
+                    "(apiserver already running against it?)"
+                )
+            os.ftruncate(self._lockfd, 0)  # clear any longer stale pid
+            os.write(self._lockfd, str(os.getpid()).encode())
             replayed = self._recover()
             self._wal_file = open(self._wal_path, "a", encoding="utf-8")
             if replayed:
@@ -228,6 +251,13 @@ class KVStore:
             return self._version
 
     def _bump(self) -> int:
+        # Every mutation funnels through here under self._lock. A
+        # closed store must REFUSE writes rather than ack them with
+        # the WAL handle already gone — an in-flight HTTP handler
+        # racing server shutdown would otherwise ack a write that no
+        # recovery will ever see.
+        if self._closed:
+            raise StoreError("store is closed")
         self._version += 1
         return self._version
 
@@ -399,9 +429,13 @@ class KVStore:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             for _, s in self._watchers:
                 s.close()
             self._watchers = []
             if self._wal_file is not None:
                 self._wal_file.close()
                 self._wal_file = None
+            if self._lockfd is not None:
+                os.close(self._lockfd)  # releases the flock
+                self._lockfd = None

@@ -375,3 +375,107 @@ class TestHighLatencyGate:
             summary.observe(0.01, verb="GET", resource="fastthings")
         slow = high_latency_requests(threshold=1.0, summary=summary)
         assert slow == [("GET", "slowthings", 3.0)]
+
+
+class TestStaleKeepAliveReplay:
+    """Replay policy on a reused keep-alive connection that dies at
+    the read (RemoteDisconnected): idempotent verbs retry on a fresh
+    connection; POST must NOT silently replay — the server may have
+    applied the create before dying, and a replay would double-apply
+    (surfacing a spurious 409 to a caller whose create succeeded).
+    Matches urllib3 / Go net/http, which only auto-retry idempotent
+    or body-less requests here."""
+
+    @staticmethod
+    def _flaky_server(die_after: int):
+        """Socket server: serves `die_after` keep-alive requests with
+        200s, then closes the connection after reading the next
+        request without responding. Subsequent connections serve
+        normally. Returns (port, served_list, stop)."""
+        import socket
+        import threading
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        served = []
+        stopped = threading.Event()
+
+        def read_request(conn) -> bytes:
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return b""
+                data += chunk
+            head, _, rest = data.partition(b"\r\n\r\n")
+            clen = 0
+            for line in head.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    clen = int(line.split(b":")[1])
+            while len(rest) < clen:
+                rest += conn.recv(65536)
+            return head
+
+        def handle(conn):
+            n = 0
+            with conn:
+                while not stopped.is_set():
+                    head = read_request(conn)
+                    if not head:
+                        return
+                    if n >= die_after:
+                        served.append(b"DIED " + head.split(b"\r\n")[0])
+                        conn.shutdown(socket.SHUT_RDWR)
+                        return  # clean close, zero response bytes
+                    served.append(head.split(b"\r\n")[0])
+                    body = b"{}"
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                        b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+                    )
+                    n += 1
+
+        def accept_loop():
+            while not stopped.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+
+        def stop():
+            stopped.set()
+            srv.close()
+
+        return port, served, stop
+
+    def test_get_replays_on_fresh_connection(self):
+        port, served, stop = self._flaky_server(die_after=1)
+        try:
+            t = HTTPTransport(f"http://127.0.0.1:{port}")
+            t._do("GET", "/api/v1beta1/pods")  # pooled
+            out = t._do("GET", "/api/v1beta1/pods")  # dies, replays
+            assert out == {}
+            assert sum(1 for s in served if s.startswith(b"DIED")) == 1
+        finally:
+            stop()
+
+    def test_post_raises_unknown_outcome(self):
+        from kubernetes_tpu.client.rest import UnknownOutcomeError
+
+        port, served, stop = self._flaky_server(die_after=1)
+        try:
+            t = HTTPTransport(f"http://127.0.0.1:{port}")
+            t._do("GET", "/api/v1beta1/pods")  # pooled
+            with pytest.raises(UnknownOutcomeError, match="outcome unknown"):
+                t._do("POST", "/api/v1beta1/pods", body={"kind": "Pod"})
+            # The mutation was sent exactly once — never replayed.
+            posts = [s for s in served if b"POST" in s]
+            assert len(posts) == 1
+        finally:
+            stop()

@@ -24,6 +24,7 @@ from kubernetes_tpu.store.kvstore import (
     ConflictError,
     KVStore,
     NotFoundError,
+    StoreError,
 )
 
 
@@ -174,6 +175,32 @@ def svc_wire(name, port=80):
         "metadata": {"name": name, "namespace": "default"},
         "spec": {"ports": [{"port": port}], "selector": {"app": name}},
     }
+
+
+class TestDataDirExclusion:
+    """Two stores on one data dir would interleave WAL appends and
+    race snapshot.json via os.replace — etcd serializes this for the
+    reference by having one member own the dir. We take an exclusive
+    flock at construction; the OS drops it on any death (kill -9
+    included), so a dead owner never wedges restart."""
+
+    def test_second_open_fails_fast(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        with pytest.raises(StoreError, match="locked"):
+            KVStore(data_dir=d)
+        s.close()
+        s2 = KVStore(data_dir=d)  # released on close
+        s2.close()
+
+    def test_closed_store_refuses_writes(self, tmp_path):
+        """A write racing shutdown must be refused, not acked with the
+        WAL handle already gone (an ack that recovery can't honor)."""
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d)
+        s.close()
+        with pytest.raises(StoreError, match="closed"):
+            s.create("/k/a", obj("a"))
 
 
 class TestApiserverRestart:

@@ -202,7 +202,7 @@ class TestConcurrencyStress:
         assert wait_until(lambda: len(informer.store.list()) == 1)
 
         host, port = urllib.parse.urlparse(srv.address).netloc.split(":")
-        srv.stop()
+        srv.stop(release_store=False)  # state survives the listener
         # New server, same API state, same port.
         srv2 = APIHTTPServer(api, host=host, port=int(port)).start()
         try:

@@ -109,6 +109,89 @@ class TestPatchTypes:
         )
         assert [c.name for c in out.spec.containers] == ["a"]
 
+    def test_json_patch_add_missing_parent_is_400(self, client):
+        """RFC 6902: 'add' fails when the parent container does not
+        exist (evanphx/json-patch, vendored by the reference) — it
+        must NOT auto-create intermediate objects."""
+        client.create("pods", pod_wire("ap"))
+        with pytest.raises(APIError) as e:
+            client.patch(
+                "pods", "ap",
+                [{"op": "add", "path": "/metadata/annotations/k", "value": "v"}],
+                namespace="default", patch_type="json",
+            )
+        assert e.value.code == 400
+        # move/copy targets resolve the same way.
+        with pytest.raises(APIError) as e:
+            client.patch(
+                "pods", "ap",
+                [{"op": "copy", "from": "/metadata/name",
+                  "path": "/metadata/annotations/k"}],
+                namespace="default", patch_type="json",
+            )
+        assert e.value.code == 400
+
+    def test_strategic_merge_ports_by_containerport(self, client):
+        """Container ports carry the reference's patchMergeKey
+        containerPort even when every element is named: reusing a
+        name with a NEW containerPort appends (distinct key value)
+        instead of updating the named entry in place."""
+        wire = pod_wire("pp")
+        wire["spec"]["containers"][0]["ports"] = [
+            {"name": "web", "containerPort": 80},
+        ]
+        client.create("pods", wire)
+        out = client.patch(
+            "pods", "pp",
+            {"spec": {"containers": [{
+                "name": "a",
+                "ports": [{"name": "web", "containerPort": 8080}],
+            }]}},
+            namespace="default", patch_type="strategic",
+        )
+        ports = [
+            (p.name, p.container_port)
+            for c in out.spec.containers if c.name == "a"
+            for p in c.ports
+        ]
+        assert ("web", 80) in ports and ("web", 8080) in ports
+
+    def test_strategic_delete_port_needs_merge_key(self, client):
+        """A $patch:delete directive must carry the list's merge key
+        (containerPort for container ports); one keyed only by name is
+        a 400 — never appended raw into the stored object. With the
+        key, the delete lands."""
+        wire = pod_wire("pd")
+        wire["spec"]["containers"][0]["ports"] = [
+            {"name": "web", "containerPort": 80},
+            {"name": "adm", "containerPort": 81},
+        ]
+        client.create("pods", wire)
+        with pytest.raises(APIError) as e:
+            client.patch(
+                "pods", "pd",
+                {"spec": {"containers": [{
+                    "name": "a",
+                    "ports": [{"$patch": "delete", "name": "web"}],
+                }]}},
+                namespace="default", patch_type="strategic",
+            )
+        assert e.value.code == 400
+        out = client.patch(
+            "pods", "pd",
+            {"spec": {"containers": [{
+                "name": "a",
+                "ports": [{"$patch": "delete", "containerPort": 80}],
+            }]}},
+            namespace="default", patch_type="strategic",
+        )
+        ports = [
+            p.container_port
+            for c in out.spec.containers if c.name == "a"
+            for p in c.ports
+        ]
+        assert ports == [81]
+
     def test_merge_patch_still_replaces_lists(self, client):
         client.create("pods", pod_wire("mp"))
         out = client.patch(
