@@ -7,8 +7,10 @@ printers), describe.go, scale.go, expose.go.
 Usage:
     ktctl [--server URL] [-n NAMESPACE] [-o table|json|yaml|name] CMD ...
 
-Commands: get, create, apply, delete, describe, scale, label, expose,
-run, logs(stub), version, api-resources.
+Commands: get (incl. -w watch), create, apply, update, delete,
+describe, scale, label, expose, run, rolling-update, stop (reaper),
+logs (incl. -f follow), exec, port-forward, proxy, top, namespace,
+config, api-resources, api-versions, cluster-info, version.
 """
 
 from __future__ import annotations

@@ -279,6 +279,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._check_auth(verb, parts)
                 self._send_json(200, _swagger_doc())
                 return
+            if parts and parts[0] == "swagger-ui":
+                # Interactive API browser over /swagger.json (the
+                # reference vendors third_party/swagger-ui/ and wires
+                # it in pkg/master/master.go; ours is a self-contained
+                # page — zero-egress box, no external assets).
+                self._check_auth(verb, parts)
+                self._send_text(
+                    200, _SWAGGER_UI_PAGE, "text/html; charset=utf-8"
+                )
+                return
             if parts and parts[0] == "ui":
                 # Any /ui/* path serves the SPA (it hash-routes
                 # client-side, like the reference's app shell).
@@ -962,6 +972,91 @@ def _swagger_doc() -> dict:
     }
 
 
+#: Interactive API browser (reference: third_party/swagger-ui/ wired
+#: at /swagger-ui/ by pkg/master/master.go). Self-contained: renders
+#: /swagger.json as expandable per-path operation cards with a
+#: "try it" runner for GET operations (path params become inputs).
+_SWAGGER_UI_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>kubernetes-tpu API</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1c2733}
+header{background:#1c2733;color:#fff;padding:14px 22px;font-size:18px}
+header a{color:#8fd0ff;text-decoration:none;margin-left:14px;font-size:13px}
+#paths{max-width:980px;margin:18px auto;padding:0 16px}
+.path{background:#fff;border:1px solid #dde3ea;border-radius:6px;margin:8px 0}
+.path>summary{padding:9px 14px;cursor:pointer;font-family:ui-monospace,monospace;
+  font-size:13px;display:flex;gap:10px;align-items:center}
+.verb{font-size:11px;font-weight:700;border-radius:3px;padding:2px 7px;color:#fff}
+.get{background:#2f81f7}.post{background:#2da44e}.put{background:#bf8700}
+.delete{background:#cf222e}
+.op{border-top:1px solid #eef1f5;padding:10px 16px;font-size:13px}
+.op .summary{color:#4a5766;margin-left:8px}
+.try{margin-top:8px}
+.try input{font-family:ui-monospace,monospace;font-size:12px;margin:0 6px 4px 0;
+  padding:3px 6px;border:1px solid #c6ccd4;border-radius:4px}
+.try button{padding:3px 12px;border:0;border-radius:4px;background:#2f81f7;
+  color:#fff;cursor:pointer;font-size:12px}
+pre.result{background:#0d1117;color:#d7e1ec;font-size:11px;padding:10px;
+  border-radius:6px;max-height:340px;overflow:auto;white-space:pre-wrap}
+</style></head><body>
+<header>kubernetes-tpu API browser
+  <a href="/swagger.json">swagger.json</a><a href="/ui/">dashboard</a>
+  <a href="/metrics">metrics</a></header>
+<div id="paths">loading /swagger.json…</div>
+<script>
+(async () => {
+  const doc = await (await fetch('/swagger.json')).json();
+  const root = document.getElementById('paths');
+  root.innerHTML = '<p style="color:#4a5766">' +
+    (doc.info ? doc.info.title + ' v' + doc.info.version + ' — ' : '') +
+    Object.keys(doc.paths).length + ' paths</p>';
+  for (const [path, ops] of Object.entries(doc.paths).sort()) {
+    const det = document.createElement('details');
+    det.className = 'path';
+    const verbs = Object.keys(ops).map(v =>
+      '<span class="verb ' + v + '">' + v.toUpperCase() + '</span>').join('');
+    det.innerHTML = '<summary>' + verbs + ' ' + path + '</summary>';
+    for (const [verb, op] of Object.entries(ops)) {
+      const d = document.createElement('div');
+      d.className = 'op';
+      d.innerHTML = '<span class="verb ' + verb + '">' + verb.toUpperCase() +
+        '</span><span class="summary">' + (op.summary || '') + '</span>';
+      if (verb === 'get') {
+        const params = [...path.matchAll(/{([^}]+)}/g)].map(m => m[1]);
+        const form = document.createElement('div');
+        form.className = 'try';
+        form.innerHTML = params.map(p =>
+          '<input placeholder="' + p + '" data-p="' + p + '">').join('') +
+          '<button>try it</button><pre class="result" hidden></pre>';
+        form.querySelector('button').onclick = async () => {
+          let url = path;
+          form.querySelectorAll('input').forEach(i => {
+            url = url.replace('{' + i.dataset.p + '}',
+                              encodeURIComponent(i.value || 'default'));
+          });
+          const out = form.querySelector('pre');
+          out.hidden = false;
+          out.textContent = 'GET ' + url + ' …';
+          try {
+            const r = await fetch(url);
+            const text = await r.text();
+            let body = text;
+            try { body = JSON.stringify(JSON.parse(text), null, 1); }
+            catch (e) {}
+            out.textContent = 'HTTP ' + r.status + '\\n' + body;
+          } catch (e) { out.textContent = String(e); }
+        };
+        d.appendChild(form);
+      }
+      det.appendChild(d);
+    }
+    root.appendChild(det);
+  }
+})();
+</script></body></html>
+"""
+
+
 #: The live dashboard: a self-contained single-page app (no external
 #: assets — this box has zero egress, and the reference vendors its
 #: AngularJS app into pkg/ui/datafile.go for the same reason). Hash
@@ -1009,7 +1104,7 @@ _UI_PAGE = """<!doctype html>
 <body>
 <header><h1>kubernetes-tpu</h1>
  <span id=status class=muted></span>
- <a href="/swagger.json">swagger</a> <a href="/metrics">metrics</a>
+ <a href="/swagger-ui/">api</a> <a href="/metrics">metrics</a>
  <a href="/healthz">healthz</a> <a href="/debug/requests">requests</a>
  <select id=nsSel title=namespace></select>
 </header>
