@@ -16,6 +16,7 @@ from kubernetes_tpu.controllers.serviceaccounts import (
     ServiceAccountsController,
     TokenController,
 )
+from kubernetes_tpu.controllers.pvrecycler import PersistentVolumeRecycler
 from kubernetes_tpu.controllers.volumeclaimbinder import (
     PersistentVolumeClaimBinder,
 )
@@ -80,6 +81,11 @@ class ControllerManager:
         if enable_pv_binder:
             self.pv_binder = PersistentVolumeClaimBinder(client)
             self.controllers.append(self.pv_binder)
+            # The binder's other half: Released+Recycle -> scrub ->
+            # Available (persistent_volume_recycler.go rides alongside
+            # the claim binder in the reference controller-manager).
+            self.pv_recycler = PersistentVolumeRecycler(client)
+            self.controllers.append(self.pv_recycler)
 
     def start(self) -> "ControllerManager":
         for c in self.controllers:

@@ -214,15 +214,13 @@ class PersistentVolumeClaimBinder:
             return
 
     def _release(self, pv) -> None:
-        if pv.spec.persistent_volume_reclaim_policy == "Recycle":
-            pv.spec.claim_ref = None
-            try:
-                self.client.update("persistentvolumes", pv)
-            except APIError:
-                return
-            pv.status.phase = "Available"
-        else:  # Retain (and Delete, which we model as Retain + operator action)
-            pv.status.phase = "Released"
+        # Every reclaim policy goes through Released: Recycle volumes
+        # are picked up from there by the PersistentVolumeRecycler
+        # (scrub THEN re-pool — returning one to Available before the
+        # scrub would hand the old tenant's data to the next claim);
+        # Retain (and Delete, modeled as Retain + operator action)
+        # stays Released forever.
+        pv.status.phase = "Released"
         self._put_pv_status(pv)
         _SYNCS.inc(result="released")
 

@@ -16,6 +16,7 @@ from kubernetes_tpu.controllers.serviceaccounts import (
     ServiceAccountsController,
     TokenController,
 )
+from kubernetes_tpu.controllers.pvrecycler import PersistentVolumeRecycler
 from kubernetes_tpu.controllers.volumeclaimbinder import (
     PersistentVolumeClaimBinder,
 )
@@ -189,7 +190,10 @@ class TestPVClaimBinder:
         pv = api.get("persistentvolumes", "", "v")
         assert pv["status"]["phase"] == "Released"
 
-    def test_release_recycle_returns_available(self, api, client):
+    def test_release_recycle_goes_released_until_scrubbed(self, api, client):
+        """Recycle no longer short-circuits to Available in the binder:
+        the volume waits Released for the recycler's scrub (returning
+        it dirty would hand old data to the next claim)."""
         api.create("persistentvolumes", "", mkpv("v", "10Gi", reclaim="Recycle"))
         api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
         binder = PersistentVolumeClaimBinder(client)
@@ -197,11 +201,95 @@ class TestPVClaimBinder:
         api.delete("persistentvolumeclaims", "default", "c1")
         binder.sync_once()
         pv = api.get("persistentvolumes", "", "v")
+        assert pv["status"]["phase"] == "Released"
+
+
+class TestPVRecycler:
+    """persistent_volume_recycler.go analog: Released+Recycle -> scrub
+    (real deletion on the host_path substrate) -> Available -> a new
+    claim binds the same volume."""
+
+    def _pv_at(self, path, reclaim="Recycle"):
+        pv = mkpv("rv", "10Gi", reclaim=reclaim)
+        pv["spec"]["persistentVolumeSource"]["hostPath"]["path"] = str(path)
+        return pv
+
+    def test_recycle_scrubs_and_repools(self, api, client, tmp_path):
+        voldir = tmp_path / "vol"
+        voldir.mkdir()
+        (voldir / "old-tenant-data.txt").write_text("secret")
+        (voldir / "sub").mkdir()
+        (voldir / "sub" / "f").write_text("x")
+        api.create("persistentvolumes", "", self._pv_at(voldir))
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
+        binder = PersistentVolumeClaimBinder(client)
+        recycler = PersistentVolumeRecycler(client)
+        binder.sync_once()
+        assert api.get("persistentvolumes", "", "rv")["status"]["phase"] == "Bound"
+
+        api.delete("persistentvolumeclaims", "default", "c1")
+        binder.sync_once()  # Bound -> Released
+        assert recycler.sync_once() == 1
+        pv = api.get("persistentvolumes", "", "rv")
         assert pv["status"]["phase"] == "Available"
         assert not pv["spec"].get("claimRef")
-        # Rebindable.
+        # The scrub really deleted the old tenant's files; the
+        # directory itself (the volume) survives.
+        assert voldir.is_dir()
+        assert list(voldir.iterdir()) == []
+
+        # A later claim binds the SAME volume (the e2e bar in VERDICT
+        # r3 missing #2).
         api.create("persistentvolumeclaims", "default", mkpvc("c2", "1Gi"))
         assert binder.sync_once() == 1
+        assert (
+            api.get("persistentvolumeclaims", "default", "c2")["spec"]["volumeName"]
+            == "rv"
+        )
+
+    def test_retain_stays_released(self, api, client, tmp_path):
+        voldir = tmp_path / "vol"
+        voldir.mkdir()
+        (voldir / "keep.txt").write_text("kept")
+        api.create("persistentvolumes", "", self._pv_at(voldir, reclaim="Retain"))
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
+        binder = PersistentVolumeClaimBinder(client)
+        binder.sync_once()
+        api.delete("persistentvolumeclaims", "default", "c1")
+        binder.sync_once()
+        assert PersistentVolumeRecycler(client).sync_once() == 0
+        assert api.get("persistentvolumes", "", "rv")["status"]["phase"] == "Released"
+        assert (voldir / "keep.txt").read_text() == "kept"  # untouched
+
+    def test_unrecyclable_source_goes_failed(self, api, client):
+        pv = mkpv("nfsvol", "10Gi", reclaim="Recycle")
+        pv["spec"]["persistentVolumeSource"] = {
+            "nfs": {"server": "fileserver", "path": "/exports/a"}
+        }
+        api.create("persistentvolumes", "", pv)
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
+        binder = PersistentVolumeClaimBinder(client)
+        binder.sync_once()
+        api.delete("persistentvolumeclaims", "default", "c1")
+        binder.sync_once()
+        assert PersistentVolumeRecycler(client).sync_once() == 0
+        pv = api.get("persistentvolumes", "", "nfsvol")
+        assert pv["status"]["phase"] == "Failed"
+        assert "no recyclable" in pv["status"]["message"]
+
+    def test_missing_scrub_dir_goes_failed(self, api, client, tmp_path):
+        api.create(
+            "persistentvolumes", "", self._pv_at(tmp_path / "never-created")
+        )
+        api.create("persistentvolumeclaims", "default", mkpvc("c1", "1Gi"))
+        binder = PersistentVolumeClaimBinder(client)
+        binder.sync_once()
+        api.delete("persistentvolumeclaims", "default", "c1")
+        binder.sync_once()
+        assert PersistentVolumeRecycler(client).sync_once() == 0
+        pv = api.get("persistentvolumes", "", "rv")
+        assert pv["status"]["phase"] == "Failed"
+        assert "not a directory" in pv["status"]["message"]
 
 
 class TestReviewRegressions:
