@@ -163,10 +163,18 @@ def apiserver_parser() -> argparse.ArgumentParser:
         "reference (hack/local-up-cluster.sh:152-153).",
     )
     p.add_argument(
-        "--data-fsync", action="store_true",
-        help="fsync every WAL append (power-loss durability; default "
-        "flushes to the OS, which survives process death but not "
-        "power loss)",
+        "--data-fsync", dest="data_fsync", action="store_true",
+        default=True,
+        help="fsync WAL records before acking writes (group-committed "
+        "across concurrent writers). ON by default: etcd's contract — "
+        "the one the reference's checkpoint/resume story leans on — is "
+        "fsync-before-ack.",
+    )
+    p.add_argument(
+        "--no-data-fsync", dest="data_fsync", action="store_false",
+        help="trade power-loss durability for write latency: WAL "
+        "records flush to the OS (survives process death, NOT power "
+        "loss) and acks don't wait for the disk",
     )
     p.add_argument("--tls-cert-file", default="")
     p.add_argument("--tls-private-key-file", default="")
@@ -196,7 +204,7 @@ def start_apiserver(args):
         from kubernetes_tpu.store.kvstore import KVStore
 
         store = KVStore(
-            data_dir=args.data_dir, fsync=getattr(args, "data_fsync", False)
+            data_dir=args.data_dir, fsync=getattr(args, "data_fsync", True)
         )
     api = APIServer(store=store)
     if args.admission_control:

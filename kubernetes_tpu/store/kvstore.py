@@ -24,6 +24,10 @@ Semantics mirror the reference's etcd usage through EtcdHelper
   role etcd plays for the reference (pkg/tools/etcd_helper.go:101,
   hack/local-up-cluster.sh:152-153): master state must survive process
   death. TTLs are wall-clock deadlines so they age across restarts.
+  fsync-before-ack is the DEFAULT (etcd's contract: acked writes
+  survive power loss, not just process death), group-committed so N
+  concurrent writers share a disk flush; fsync=False (daemon flag
+  --no-data-fsync) trades that for write latency.
 
 Thread-safe; many reader/writer threads, one lock (control-plane rates
 are tiny next to the TPU solver's work).
@@ -68,7 +72,7 @@ class KVStore:
         self,
         history_limit: int = 10000,
         data_dir: Optional[str] = None,
-        fsync: bool = False,
+        fsync: bool = True,
         snapshot_every: int = 4096,
     ):
         self._lock = threading.RLock()
@@ -89,6 +93,9 @@ class KVStore:
         self._snapshot_every = snapshot_every
         self._wal_file = None
         self._wal_count = 0
+        self._wal_seq = 0  # records appended (group-commit cursor)
+        self._synced_seq = 0  # records known durable
+        self._sync_lock = threading.Lock()
         self._closed = False
         self._lockfd: Optional[int] = None
         if data_dir:
@@ -197,11 +204,34 @@ class KVStore:
                 rec["e"] = exp
         self._wal_file.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._wal_file.flush()
-        if self._fsync:
-            os.fsync(self._wal_file.fileno())
+        # fsync does NOT happen here (we hold self._lock): callers ack
+        # through _wal_sync after releasing it — the group-commit seam.
+        self._wal_seq += 1
         self._wal_count += 1
         if self._wal_count >= self._snapshot_every:
             self._snapshot_locked()
+
+    def _wal_sync(self, seq: int) -> None:
+        """Group commit: make WAL record `seq` durable before the
+        caller acks. One fsync covers every record flushed before it,
+        so N concurrent writers pay ~1 disk flush, not N — the batching
+        etcd does on its WAL. Callers must NOT hold self._lock (appends
+        proceed while the disk flushes; that concurrency IS the
+        amortization). No-op when fsync is off or the store is
+        in-memory (seq stays 0)."""
+        if not self._fsync or seq == 0:
+            return
+        with self._sync_lock:
+            if self._synced_seq >= seq:
+                return  # a peer's fsync (or a snapshot) covered us
+            with self._lock:
+                wal = self._wal_file
+                flushed = self._wal_seq
+            if wal is None:
+                return  # closed underneath us; writes were refused
+            os.fsync(wal.fileno())
+            if flushed > self._synced_seq:
+                self._synced_seq = flushed
 
     def _snapshot_locked(self) -> None:
         """Write the full state atomically, then truncate the WAL.
@@ -229,6 +259,10 @@ class KVStore:
             # entry must be durable BEFORE new WAL appends land, or a
             # crash could pair the old snapshot with a truncated WAL.
             self._fsync_dir()
+            # Everything appended so far is folded into the (fsync'd)
+            # snapshot: waiting group-commit callers are already
+            # durable without touching the fresh WAL.
+            self._synced_seq = self._wal_seq
 
     def _fsync_dir(self) -> None:
         fd = os.open(self._data_dir, os.O_RDONLY)
@@ -312,7 +346,10 @@ class KVStore:
             if ttl is not None:
                 self._ttl[key] = self._now() + ttl
             self._record(v, ADDED, key, obj)
-            return copy.deepcopy(obj)
+            out = copy.deepcopy(obj)
+            seq = self._wal_seq
+        self._wal_sync(seq)  # fsync-before-ack, amortized across writers
+        return out
 
     def get(self, key: str) -> dict:
         with self._lock:
@@ -339,7 +376,10 @@ class KVStore:
             self._stamp(obj, v)
             self._data[key] = (obj, v)
             self._record(v, MODIFIED, key, obj)
-            return copy.deepcopy(obj)
+            out = copy.deepcopy(obj)
+            seq = self._wal_seq
+        self._wal_sync(seq)
+        return out
 
     def delete(self, key: str, expected_version: Optional[int] = None) -> dict:
         with self._lock:
@@ -355,7 +395,10 @@ class KVStore:
             self._ttl.pop(key, None)
             v = self._bump()
             self._record(v, DELETED, key, obj)
-            return copy.deepcopy(obj)
+            out = copy.deepcopy(obj)
+            seq = self._wal_seq
+        self._wal_sync(seq)
+        return out
 
     def list(self, prefix: str) -> Tuple[List[dict], int]:
         """All objects under prefix + the store version (for watch resume)."""

@@ -203,6 +203,48 @@ class TestDataDirExclusion:
             s.create("/k/a", obj("a"))
 
 
+class TestGroupCommit:
+    """fsync-before-ack is the default contract (etcd's); the fsync is
+    group-committed — concurrent writers share disk flushes."""
+
+    def test_default_is_fsync(self, tmp_path):
+        s = KVStore(data_dir=str(tmp_path / "d"))
+        assert s._fsync is True
+        s.close()
+
+    def test_concurrent_writers_all_durable(self, tmp_path):
+        d = str(tmp_path / "data")
+        s = KVStore(data_dir=d, fsync=True)
+        errors = []
+
+        def writer(wid):
+            try:
+                for i in range(50):
+                    s.create(f"/k/w{wid}-{i}", obj(f"w{wid}-{i}"))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        s.close()
+        s2 = KVStore(data_dir=d)
+        assert len(s2.keys("/k/")) == 400
+        s2.close()
+
+    def test_no_fsync_flag_parses(self):
+        from kubernetes_tpu.cmd.daemons import apiserver_parser
+
+        args = apiserver_parser().parse_args(["--no-data-fsync"])
+        assert args.data_fsync is False
+        assert apiserver_parser().parse_args([]).data_fsync is True
+
+
 class TestApiserverRestart:
     """Kill the apiserver mid-churn; restart on the same data-dir."""
 
