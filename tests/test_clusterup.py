@@ -154,3 +154,95 @@ class TestLocalClusterUp:
         time.sleep(1)
         with pytest.raises(Exception):
             urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=2)
+
+
+@pytest.mark.slow
+class TestSshProviderExecutes:
+    """The REMOTE code path (quoting, pidfile daemonization,
+    teardown-by-ssh) executed for real — not --dry-run. No sshd on
+    this box, so SSH_BASE is swapped for a shim that replays exactly
+    what real ssh does with the argv: join the command words with
+    spaces and hand the result to a shell on the 'remote' host (here:
+    this box) to re-parse. Every quoting decision in
+    cmd/clusterup.py's remote branch runs under the same two-level
+    shell parsing it would face over a wire (VERDICT r3 next #6)."""
+
+    def test_ssh_up_and_down(self, tmp_path, monkeypatch):
+        from kubernetes_tpu.client import Client, HTTPTransport
+        from kubernetes_tpu.cmd import clusterup
+
+        shim = tmp_path / "fake-ssh"
+        shim.write_text(
+            "#!/bin/sh\n"
+            "# fake-ssh <host> -- <words...>: real ssh joins the words\n"
+            "# with spaces and the remote login shell re-parses them.\n"
+            'shift\n[ "$1" = "--" ] && shift\n'
+            'exec sh -c "$*"\n'
+        )
+        shim.chmod(0o755)
+        monkeypatch.setattr(clusterup, "SSH_BASE", (str(shim),))
+
+        port = 18470
+        # 127.0.1.x are loopback to THIS box but not in the
+        # local-host exclusion list, so the remote branch triggers.
+        inv = {
+            "master": {
+                "host": "127.0.1.1", "port": port,
+                "data_dir": str(tmp_path / "master-data"),
+            },
+            "control_plane_replicas": 1,
+            "nodes": [{"name": "sn-0", "host": "127.0.1.2"}],
+            "runtime": "fake",
+            "addons": [],
+        }
+        inv_path = tmp_path / "inv.json"
+        inv_path.write_text(json.dumps(inv))
+        state = str(tmp_path / "state")
+
+        assert up(load_inventory(str(inv_path)), state, provider="ssh") == 0
+        pids = []
+        try:
+            st = json.load(open(os.path.join(state, "cluster.json")))
+            comps = st["components"]
+
+            def live_pid(info):
+                """The REMOTE side writes its pidfile (echo $$ before
+                exec) asynchronously — poll until it names a live
+                process."""
+                try:
+                    pid = int(open(info["pidfile"]).read())
+                    os.kill(pid, 0)
+                    return pid
+                except (OSError, ValueError):
+                    return None
+
+            # Every component took the remote path and recorded the
+            # pidfile the remote side wrote.
+            for role, info in comps.items():
+                assert info["remote"] is True, role
+                assert wait_until(
+                    lambda: live_pid(info) is not None, timeout=15
+                ), f"{role}: pidfile never named a live process"
+                pids.append(live_pid(info))
+            server = f"http://127.0.1.1:{port}"
+            client = Client(HTTPTransport(server))
+            assert wait_until(
+                lambda: len(client.list("nodes")[0]) == 1, timeout=90
+            ), "kubelet (via ssh shim) never registered"
+        finally:
+            assert down(state) == 0
+        # Teardown went through the ssh kill path: the daemons the
+        # pidfiles point at are dead (not just the local ssh clients).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            alive = []
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    alive.append(pid)
+                except ProcessLookupError:
+                    pass
+            if not alive:
+                break
+            time.sleep(0.3)
+        assert not alive, f"daemons survived kube-down: {alive}"
