@@ -23,13 +23,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PODS_PER_SEC = 15.0  # reference bind rate limit ceiling
 
 
-def churn_main() -> None:
-    """BASELINE config 5: 1k pods/s create/delete churn with
-    incremental device updates (no re-lowering the cluster)."""
-    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
-    rate = int(os.environ.get("BENCH_CHURN_RATE", "1000"))  # pods/s each way
-    ticks = int(os.environ.get("BENCH_CHURN_TICKS", "10"))
-
+def _churn_figure(n_nodes: int, rate: int, ticks: int, mode: str) -> dict:
+    """BASELINE config 5 measured: sustained create/delete churn with
+    incremental device updates (no re-lowering the cluster). Returns
+    {"churn_scheduled_per_sec": ..., ...} for embedding in any record."""
     import random
 
     from __graft_entry__ import _synthetic_problem  # noqa: F401 (warms imports)
@@ -78,7 +75,6 @@ def churn_main() -> None:
             ),
         )
 
-    mode = os.environ.get("BENCH_CHURN_MODE", "scan")
     session = SolverSession(nodes, mode=mode)
     # Warm-up must compile EVERY executable the timed ticks hit: the
     # solve itself AND the delete-path row scatter at the same dirty-
@@ -115,20 +111,34 @@ def churn_main() -> None:
     elapsed = time.perf_counter() - t0
     pods_per_sec = scheduled / elapsed
     print(
+        f"# churn: {ticks} ticks x {rate} create+delete/s, {scheduled} "
+        f"scheduled in {elapsed:.2f}s ({len(live)} live)",
+        file=sys.stderr,
+    )
+    return {
+        "churn_scheduled_per_sec": round(pods_per_sec, 1),
+        "churn_tick_mode": mode,
+        "churn_nodes": n_nodes,
+    }
+
+
+def churn_main() -> None:
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    rate = int(os.environ.get("BENCH_CHURN_RATE", "1000"))  # pods/s each way
+    ticks = int(os.environ.get("BENCH_CHURN_TICKS", "10"))
+    mode = os.environ.get("BENCH_CHURN_MODE", "scan")
+    fig = _churn_figure(n_nodes, rate, ticks, mode)
+    pods_per_sec = fig["churn_scheduled_per_sec"]
+    print(
         json.dumps(
             {
                 "metric": f"churn_scheduled_per_sec_{n_nodes}nodes",
-                "value": round(pods_per_sec, 1),
+                "value": pods_per_sec,
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 1),
                 "tick_mode": mode,
             }
         )
-    )
-    print(
-        f"# churn: {ticks} ticks x {rate} create+delete/s, {scheduled} "
-        f"scheduled in {elapsed:.2f}s ({len(live)} live)",
-        file=sys.stderr,
     )
 
 
@@ -194,18 +204,16 @@ def _parity_figures() -> dict:
     return {k: round(v, 4) for k, v in out.items()}
 
 
-def crud_main() -> None:
+def _crud_figure(n_workers: int, n_tasks: int) -> dict:
     """Master pod-CRUD throughput over real HTTP (reference:
     test/integration/master_benchmark_test.go:38-93 — -bench-pods /
-    -bench-workers against a local master)."""
+    -bench-workers against a local master). Returns
+    {"pod_crud_ops_per_sec": ..., ...}."""
     import threading
 
     from kubernetes_tpu.client import Client, HTTPTransport
     from kubernetes_tpu.server.api import APIServer
     from kubernetes_tpu.server.httpserver import APIHTTPServer
-
-    n_workers = int(os.environ.get("BENCH_CRUD_WORKERS", "4"))
-    n_tasks = int(os.environ.get("BENCH_CRUD_TASKS", "200"))  # per worker
 
     srv = APIHTTPServer(APIServer()).start()
     try:
@@ -250,22 +258,32 @@ def crud_main() -> None:
             raise errors[0]
         total_ops = n_workers * n_tasks * ops
         print(
-            json.dumps(
-                {
-                    "metric": f"pod_crud_ops_per_sec_{n_workers}w",
-                    "value": round(total_ops / elapsed, 1),
-                    "unit": "ops/s",
-                    "vs_baseline": 0,  # reference publishes no number
-                }
-            )
-        )
-        print(
             f"# crud: {n_workers} workers x {n_tasks} pods x {ops} ops "
             f"in {elapsed:.2f}s over HTTP",
             file=sys.stderr,
         )
+        return {
+            "pod_crud_ops_per_sec": round(total_ops / elapsed, 1),
+            "crud_workers": n_workers,
+        }
     finally:
         srv.stop()
+
+
+def crud_main() -> None:
+    n_workers = int(os.environ.get("BENCH_CRUD_WORKERS", "4"))
+    n_tasks = int(os.environ.get("BENCH_CRUD_TASKS", "200"))  # per worker
+    fig = _crud_figure(n_workers, n_tasks)
+    print(
+        json.dumps(
+            {
+                "metric": f"pod_crud_ops_per_sec_{n_workers}w",
+                "value": fig["pod_crud_ops_per_sec"],
+                "unit": "ops/s",
+                "vs_baseline": 0,  # reference publishes no number
+            }
+        )
+    )
 
 
 def main() -> None:
@@ -284,10 +302,22 @@ def main() -> None:
 
     from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
 
+    # Fast-path configuration (VERDICT r3 next #1): the wave-mode
+    # chunked pipeline. chunk=25088 (2 chunks at 50k) swept best on
+    # hardware — fewer chunk-boundary waves than small chunks, while
+    # still overlapping chunk 2's host lowering with chunk 1's device
+    # waves (single-chunk control: ~1.2s; 8192 chunks: ~1.5s;
+    # 25088: ~0.89s).
+    fast_mode = os.environ.get("BENCH_FAST_MODE", "wave")
+    fast_chunk = int(os.environ.get("BENCH_FAST_CHUNK", "25088"))
+
     # Warmup: one FULL pass of each path (compile + first-execution
     # program-load costs excluded from every timed repeat).
     pods, nodes, services = _synthetic_objects(n_pods, n_nodes, seed=1)
     solve_backlog_pipelined(pods, nodes, services=services)
+    solve_backlog_pipelined(
+        pods, nodes, services=services, mode=fast_mode, chunk=fast_chunk
+    )
     snap = build_snapshot(pods, nodes, services=services)
     d = device_snapshot(snap)
     np.asarray(solve(d.pods, d.nodes))
@@ -315,6 +345,24 @@ def main() -> None:
         gc.enable()
         times.append(t1 - t0)
         placed = sum(1 for x in out if x is not None)
+
+    # Fast path: same end-to-end contract (API objects in, bound node
+    # names out), wave-family solver, quality-gated below — regret
+    # bounds decide whether it may carry the headline.
+    fast_times = []
+    fast_placed = 0
+    for r in range(repeats):
+        pods, nodes, services = _synthetic_objects(n_pods, n_nodes, seed=2 + r)
+        gc.collect()
+        gc.disable()
+        t0 = time.perf_counter()
+        out = solve_backlog_pipelined(
+            pods, nodes, services=services, mode=fast_mode, chunk=fast_chunk
+        )
+        t1 = time.perf_counter()
+        gc.enable()
+        fast_times.append(t1 - t0)
+        fast_placed = sum(1 for x in out if x is not None)
 
     # One monolithic (unpipelined) pass for the per-phase breakdown —
     # the pipeline overlaps these phases, so they are only separable
@@ -432,14 +480,38 @@ def main() -> None:
         solve_backlog_pipelined(pods_s, nodes_s, services=svcs_s)
         small_walls[f"{cp}x{cn}"] = round(time.perf_counter() - t0, 4)
 
+    # Quality gate for the fast path: regret of the CHUNKED pipeline's
+    # own decisions at 10k x 1k (the bounds tests/test_quality_regression.py
+    # enforces in CI: mean <= 1.5, p99 <= 5). Passing lets the fast wall
+    # carry the headline; failing falls back to the parity scan's wall —
+    # speed never silently buys worse placements.
+    name_idx = {n.metadata.name: i for i, n in enumerate(nodes_q)}
+    fast_out = solve_backlog_pipelined(
+        pods_q, nodes_q, services=svcs_q, mode=fast_mode, chunk=fast_chunk
+    )
+    fast_a = np.array(
+        [name_idx.get(x, -1) if x is not None else -1 for x in fast_out],
+        dtype=np.int32,
+    )
+    fast_q = assignment_quality(snap_q, fast_a)
+    gate_ok = fast_q["mean_regret"] <= 1.5 and fast_q["p99_regret"] <= 5.0
+
     parity = _parity_figures()
     best = min(times)
-    pods_per_sec = n_pods / best
+    best_fast = min(fast_times)
+    headline = n_pods / (best_fast if gate_ok else best)
     record = {
         "metric": f"pods_scheduled_per_sec_{n_pods//1000}kx{n_nodes}",
-        "value": round(pods_per_sec, 1),
+        "value": round(headline, 1),
         "unit": "pods/s",
-        "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 1),
+        "vs_baseline": round(headline / BASELINE_PODS_PER_SEC, 1),
+        "wall_fast_s": [round(t, 3) for t in fast_times],
+        "fast_mode": fast_mode,
+        "fast_chunk": fast_chunk,
+        "fast_placed": fast_placed,
+        "fast_mean_regret_10kx1k": round(fast_q["mean_regret"], 3),
+        "fast_p99_regret_10kx1k": round(fast_q["p99_regret"], 1),
+        "fast_quality_gate": "pass" if gate_ok else "FAIL (headline=scan)",
         "wall_s": [round(t, 3) for t in times],
         "phases_serial_s": phases,
         "placed": placed,
@@ -447,10 +519,22 @@ def main() -> None:
     record["config_walls_s"] = small_walls
     record.update(wave_stats)
     record.update(parity)
+    # Short witnessed churn + CRUD segments (VERDICT r3 next #3: these
+    # lived only behind BENCH_MODE env vars nothing set). Kept brief;
+    # the dedicated BENCH_MODE=churn|crud runs remain for full-length
+    # figures.
+    if os.environ.get("BENCH_SEGMENTS", "1") != "0":
+        record.update(
+            _churn_figure(n_nodes=n_nodes, rate=1000, ticks=3, mode="scan")
+        )
+        record.update(_crud_figure(n_workers=4, n_tasks=100))
     print(json.dumps(record))
     print(
-        f"# pipelined wall best {best:.3f}s for {n_pods} pods x {n_nodes} "
-        f"nodes ({placed} placed); all={['%.3f' % t for t in times]}; "
+        f"# fast wall best {best_fast:.3f}s ({fast_mode}, gate "
+        f"{'pass' if gate_ok else 'FAIL'}), scan wall best {best:.3f}s for "
+        f"{n_pods} pods x {n_nodes} nodes ({placed} placed); "
+        f"fast={['%.3f' % t for t in fast_times]}; "
+        f"scan={['%.3f' % t for t in times]}; "
         f"serial phases={phases}; parity={parity}",
         file=sys.stderr,
     )

@@ -46,14 +46,44 @@ def solve_backlog_pipelined(
     mesh=None,
     chunk: int = DEFAULT_CHUNK,
     weights=DEFAULT_WEIGHTS,
+    mode: str = "scan",
 ) -> List[Optional[str]]:
     """Schedule the backlog; returns node names (None = unschedulable).
-    Bit-identical to schedule_backlog_tpu, faster at scale."""
+
+    mode="scan" (default) is bit-identical to schedule_backlog_tpu —
+    the sequential-parity path. mode="wave"/"sinkhorn" runs the
+    windowed batch solvers chunk-by-chunk over the SAME donated carry:
+    chunk k+1's host lowering and upload overlap chunk k's device
+    waves, so the end-to-end wall approaches the device-only wave
+    time. Decisions are the approximate wave family's (quality gated
+    by regret bounds in tests/test_quality_regression.py, published by
+    bench.py), but every capacity/port/volume invariant still holds —
+    the wave commit path enforces the same feasibility the scan does.
+    Chunking never loosens quality vs a monolithic wave solve: chunks
+    commit in backlog order, so a chunk's pods see strictly MORE
+    committed state than the same pods in one big window ever would.
+    """
     builder = SnapshotBuilder(pending, nodes, assigned, services)
     node_sharding, pod_sharding = shardings_for(mesh)
     carry = device_nodes(
         builder.node_columns(), node_sharding, node_mult=node_axis_multiple(mesh)
     )
+    if mode == "scan":
+        step = lambda dpods, carry: solve_with_state(dpods, carry, weights)
+    elif mode == "wave":
+        from kubernetes_tpu.ops.wave import solve_waves_with_state
+
+        step = lambda dpods, carry: solve_waves_with_state(
+            dpods, carry, weights
+        )[:2]
+    elif mode == "sinkhorn":
+        from kubernetes_tpu.ops.sinkhorn import solve_sinkhorn_with_state
+
+        step = lambda dpods, carry: solve_sinkhorn_with_state(
+            dpods, carry, weights
+        )[:2]
+    else:
+        raise ValueError(f"unknown pipeline mode {mode!r}")
     P = len(builder.pending)
     outs = []
     for start in range(0, max(P, 1), chunk):
@@ -62,7 +92,7 @@ def solve_backlog_pipelined(
         # pads to its own 128 bucket rather than a full chunk, so small
         # backlogs and tails don't scan thousands of padding steps.
         dpods = device_pods(cols, pod_sharding)
-        assignment, carry = solve_with_state(dpods, carry, weights)
+        assignment, carry = step(dpods, carry)
         outs.append((assignment, cols.count))
 
     names = [n.metadata.name for n in builder.nodes]

@@ -153,3 +153,57 @@ class TestWaveOnMesh:
         a = np.asarray(out)[: sharded.n_pods]
         a = np.where(a >= sharded.n_nodes, -1, a)
         assert (a == base).all()
+
+
+class TestPipelinedModes:
+    """solve_backlog_pipelined(mode='wave'|'sinkhorn'): the fast-path
+    chunk loop must preserve every placement invariant while chaining
+    the donated carry across chunks (bench.py's wall_fast_s path)."""
+
+    @staticmethod
+    def _as_indices(out, nodes):
+        idx = {n.metadata.name: i for i, n in enumerate(nodes)}
+        return np.array(
+            [idx[x] if x is not None else -1 for x in out], dtype=np.int64
+        )
+
+    @pytest.mark.parametrize("mode", ["wave", "sinkhorn"])
+    @pytest.mark.parametrize("seed", [0, 4])
+    def test_chunked_placements_valid(self, mode, seed):
+        from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
+
+        pods, nodes, assigned, services = random_cluster(seed)
+        out = solve_backlog_pipelined(
+            pods, nodes, assigned, services, mode=mode, chunk=8
+        )
+        snap = build_snapshot(pods, nodes, assigned, services)
+        check_validity(snap, self._as_indices(out, nodes))
+
+    @pytest.mark.parametrize("mode", ["wave", "sinkhorn"])
+    def test_chunked_matches_capacity_exactly(self, mode):
+        from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
+
+        pods = [mk_pod(f"p{i}", cpu=600, mem_mib=64) for i in range(10)]
+        nodes = [mk_node(f"n{j}", cpu=1000) for j in range(3)]
+        out = solve_backlog_pipelined(pods, nodes, mode=mode, chunk=4)
+        placed = [x for x in out if x is not None]
+        assert len(placed) == 3  # one 600m pod per 1000m node, ever
+        assert len(set(placed)) == 3
+
+    def test_chunk_boundaries_carry_occupancy(self):
+        """A node filled by chunk k must be unavailable to chunk k+1:
+        port exclusivity across a 1-pod chunk boundary proves the
+        carry actually chains."""
+        from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
+
+        pods = [mk_pod(f"hp{i}", host_port=8080) for i in range(4)]
+        nodes = [mk_node("n0"), mk_node("n1")]
+        out = solve_backlog_pipelined(pods, nodes, mode="wave", chunk=1)
+        placed = [x for x in out if x is not None]
+        assert sorted(placed) == ["n0", "n1"]
+
+    def test_unknown_mode_rejected(self):
+        from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
+
+        with pytest.raises(ValueError, match="unknown pipeline mode"):
+            solve_backlog_pipelined([], [], mode="hungarian")
