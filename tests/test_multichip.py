@@ -77,3 +77,63 @@ class TestDryrunEntrypoints:
         out = jax.jit(fn)(*args)
         out.block_until_ready()
         assert np.asarray(out).ndim == 1
+
+
+@pytest.mark.slow
+class TestShardedParityAtScale:
+    """VERDICT r3 next #4: sharded evidence above toy shapes. 5000
+    pods x 1037 nodes on the 8-device mesh — node count deliberately
+    NOT divisible by the mesh (padding rows live on the last shard),
+    the synthetic workload's 64 distinct hostPorts cross the 32-bit
+    bitset word boundary, and an extra volume-carrying cohort pushes
+    the exclusive-volume vocab past one word too."""
+
+    N_PODS = 5000
+    N_NODES = 1037  # prime-ish: 1037 = 17 * 61, not divisible by 8
+
+    @pytest.fixture(scope="class")
+    def big_snap(self):
+        from __graft_entry__ import _synthetic_objects
+        from kubernetes_tpu.models.objects import (
+            GCEPersistentDiskVolumeSource, Volume,
+        )
+
+        pods, nodes, services = _synthetic_objects(
+            self.N_PODS, self.N_NODES, seed=77
+        )
+        # Volume cohort: 40 distinct exclusive disks (> one 32-bit
+        # word) spread over the last 200 pods, some read-write.
+        for i, pod in enumerate(pods[-200:]):
+            pod.spec.volumes = [
+                Volume(
+                    name="data",
+                    gce_persistent_disk=GCEPersistentDiskVolumeSource(
+                        pd_name=f"disk-{i % 40}", read_only=(i % 3 != 0)
+                    ),
+                )
+            ]
+        return build_snapshot(pods, nodes, services=services)
+
+    def test_scan_bit_parity_at_scale(self, big_snap):
+        single = solve_assignments(device_snapshot(big_snap))
+        sharded = _solve_on_mesh(big_snap, 8)
+        np.testing.assert_array_equal(single, sharded)
+        assert int((single >= 0).sum()) == self.N_PODS
+
+    def test_wave_deterministic_and_matches_single_at_scale(self, big_snap):
+        from kubernetes_tpu.ops.wave import solve_waves
+
+        mesh = _mesh(8)
+        dsnap = device_snapshot(big_snap, mesh=mesh, pad_to=8)
+        with mesh:
+            out1, w1 = solve_waves(dsnap.pods, dsnap.nodes)
+            out1.block_until_ready()
+            out2, _ = solve_waves(dsnap.pods, dsnap.nodes)
+            out2.block_until_ready()
+        a1 = np.asarray(out1)[: dsnap.n_pods]
+        np.testing.assert_array_equal(a1, np.asarray(out2)[: dsnap.n_pods])
+        from kubernetes_tpu.ops.wave import wave_assignments
+
+        single, _ = wave_assignments(device_snapshot(big_snap))
+        a1 = np.where(a1 >= dsnap.n_nodes, -1, a1)
+        np.testing.assert_array_equal(single, a1)
