@@ -181,6 +181,7 @@ class HTTPTransport(Transport):
         timeout: float = 30.0,
         headers: Optional[Dict[str, str]] = None,
         ssl_context=None,
+        serialize: bool = False,
     ):
         u = urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
@@ -199,6 +200,15 @@ class HTTPTransport(Transport):
         # (TCP_NODELAY on both ends matters just as much — Nagle +
         # delayed ACK stall keep-alive round trips ~40ms each).
         self._local = threading.local()
+        # serialize=True: ONE shared connection, requests serialized
+        # behind a lock — the Go client's few-multiplexed-connections
+        # shape. A daemon with several worker threads (kubelet:
+        # heartbeat + sync workers + resync) otherwise opens one
+        # connection PER THREAD, and at 100 daemons the apiserver's
+        # thread-per-connection tier drowns in its own thread count.
+        # Watches are unaffected (they always own a dedicated socket).
+        self._serial_lock = threading.Lock() if serialize else None
+        self._shared_conn = None
 
     def _connect(self, timeout=None) -> http.client.HTTPConnection:
         if self.ssl_context is not None:
@@ -221,7 +231,12 @@ class HTTPTransport(Transport):
         return conn
 
     def _pooled(self) -> tuple:
-        """(connection, reused) for this thread."""
+        """(connection, reused) for this thread (or the shared one)."""
+        if self._serial_lock is not None:
+            if self._shared_conn is not None:
+                return self._shared_conn, True
+            self._shared_conn = self._connect(timeout=self.timeout)
+            return self._shared_conn, False
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             return conn, True
@@ -230,8 +245,11 @@ class HTTPTransport(Transport):
         return conn, False
 
     def _discard(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        self._local.conn = None
+        if self._serial_lock is not None:
+            conn, self._shared_conn = self._shared_conn, None
+        else:
+            conn = getattr(self._local, "conn", None)
+            self._local.conn = None
         if conn is not None:
             try:
                 conn.close()
@@ -274,6 +292,22 @@ class HTTPTransport(Transport):
         raises UnknownOutcomeError so callers can reconcile. Other
         read failures retry only GETs. A fresh connection's failure
         propagates: that is a real outage."""
+        if self._serial_lock is not None:
+            with self._serial_lock:
+                return self._do_locked(
+                    verb, path, query, body, raw, content_type
+                )
+        return self._do_locked(verb, path, query, body, raw, content_type)
+
+    def _do_locked(
+        self,
+        verb: str,
+        path: str,
+        query: dict = None,
+        body: dict = None,
+        raw: bool = False,
+        content_type: str = "application/json",
+    ):
         if query:
             path = path + "?" + urlencode({k: v for k, v in query.items() if v})
         payload = json.dumps(body).encode() if body is not None else None

@@ -9,7 +9,6 @@ LocalTransport, and the apiserver speaks real HTTP for ktctl.
 from __future__ import annotations
 
 import argparse
-import tempfile
 from typing import List, Optional
 
 from kubernetes_tpu.client import Client, LocalTransport
@@ -30,6 +29,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--batch-scheduler", action="store_true")
     p.add_argument(
+        "--batch-mode", default="scan", choices=["scan", "wave", "sinkhorn"],
+        help="device solver mode for --batch-scheduler (scan = "
+        "sequential-parity referee; wave/sinkhorn = high-throughput)",
+    )
+    p.add_argument(
         "--no-kube-proxy", dest="kube_proxy", action="store_false",
         default=True, help="skip the in-process kube-proxy",
     )
@@ -37,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--cluster-dns", action="store_true",
         help="start the DNS addon and publish it as the kube-dns "
         "service at 10.0.0.10 (cluster/addons/dns analog)",
+    )
+    p.add_argument(
+        "--kubelet-http", action="store_true",
+        help="kubelets talk to the apiserver over real HTTP instead of "
+        "in-process calls (the reference's actual topology: watch "
+        "fan-out, heartbeats and status writeback all cross the wire)",
     )
     return p
 
@@ -46,8 +56,6 @@ class LocalCluster:
 
     def __init__(self, args):
         from kubernetes_tpu.controllers import ControllerManager
-        from kubernetes_tpu.kubelet.agent import Kubelet
-        from kubernetes_tpu.kubelet.runtime import FakeRuntime
         from kubernetes_tpu.scheduler.daemon import (
             BatchScheduler,
             Scheduler,
@@ -64,27 +72,17 @@ class LocalCluster:
         )
         self.kubelets = []
         self._tmp_roots = []
-        for i in range(args.nodes):
-            if args.process_runtime:
-                from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
-
-                root = tempfile.mkdtemp(prefix=f"ktpu-node-{i}-")
-                self._tmp_roots.append(root)
-                runtime = ProcessRuntime(root, node_name=f"node-{i}")
-            else:
-                runtime = FakeRuntime()
-                root = None
-            self.kubelets.append(
-                Kubelet(
-                    self._client(),
-                    node_name=f"node-{i}",
-                    runtime=runtime,
-                    root_dir=root,
-                    serve_http=True,
-                )
-            )
+        self._kubelet_http = getattr(args, "kubelet_http", False)
+        if not self._kubelet_http:
+            # In-process transport: build now. HTTP kubelets are built
+            # in start(), once the apiserver's port is known.
+            self._build_kubelets(self._client)
         self.scheduler_config = SchedulerConfig(self._client())
-        self.scheduler_cls = BatchScheduler if args.batch_scheduler else Scheduler
+        if args.batch_scheduler:
+            mode = getattr(args, "batch_mode", "scan")
+            self.scheduler_cls = lambda cfg: BatchScheduler(cfg, mode=mode)
+        else:
+            self.scheduler_cls = Scheduler
         self.scheduler = None
         provider = None
         if args.cloud_provider:
@@ -96,8 +94,44 @@ class LocalCluster:
     def _client(self) -> Client:
         return Client(LocalTransport(self.api))
 
+    def _build_kubelets(self, client_factory) -> None:
+        import tempfile as _tempfile
+
+        from kubernetes_tpu.kubelet.agent import Kubelet
+        from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+        for i in range(self.args.nodes):
+            if self.args.process_runtime:
+                from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
+
+                root = _tempfile.mkdtemp(prefix=f"ktpu-node-{i}-")
+                self._tmp_roots.append(root)
+                runtime = ProcessRuntime(root, node_name=f"node-{i}")
+            else:
+                runtime = FakeRuntime()
+                root = None
+            self.kubelets.append(
+                Kubelet(
+                    client_factory(),
+                    node_name=f"node-{i}",
+                    runtime=runtime,
+                    root_dir=root,
+                    serve_http=True,
+                )
+            )
+
     def start(self) -> "LocalCluster":
         self.http.start()
+        if self._kubelet_http:
+            from kubernetes_tpu.client import HTTPTransport
+
+            # serialize=True: one multiplexed connection per kubelet
+            # (the Go client shape) instead of one per kubelet thread —
+            # at 100 kubelets the thread-per-connection apiserver would
+            # otherwise carry ~5x the connection threads.
+            self._build_kubelets(
+                lambda: Client(HTTPTransport(self.http.address, serialize=True))
+            )
         for kubelet in self.kubelets:
             kubelet.start()
         self.scheduler_config.start()

@@ -33,8 +33,10 @@ class ControllerManager:
         enable_resource_quota: bool = True,
         enable_service_accounts: bool = True,
         enable_pv_binder: bool = True,
-        node_grace_period: float = 8.0,
-        node_eviction_timeout: float = 4.0,
+        # Reference defaults (see nodelifecycle.py): grace 40s,
+        # eviction 5min there — 120s here keeps recovery drills sane.
+        node_grace_period: float = 40.0,
+        node_eviction_timeout: float = 120.0,
         sa_token_manager=None,
         cloud_provider=None,
     ):

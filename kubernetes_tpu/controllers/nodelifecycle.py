@@ -33,8 +33,17 @@ class NodeLifecycleController:
         self,
         client,
         monitor_period: float = 2.0,
-        grace_period: float = 8.0,
-        eviction_timeout: float = 4.0,
+        # Reference defaults, deliberately: --node-monitor-grace-period
+        # defaults to 40s ("must be N times more than the kubelet's
+        # status update frequency") and --pod-eviction-timeout to 5min
+        # (cmd/kube-controller-manager/app/controllermanager.go:106,140).
+        # Round 4 originally shipped 8s/4s — 5x/75x tighter — and at
+        # 100 kubelets a heartbeat delayed by the pod-creation burst
+        # read as node death, so mass eviction landed exactly when the
+        # control plane was busiest and the recreate/rebind storm fed
+        # itself. Failure-drill tests pass short values explicitly.
+        grace_period: float = 40.0,
+        eviction_timeout: float = 120.0,
         eviction_qps: float = 10.0,
     ):
         self.client = client

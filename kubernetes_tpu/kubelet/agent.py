@@ -58,35 +58,68 @@ def _proc_rss(pid: str) -> int:
         return 0
 
 
-class _PodWorker:
-    """Serialized per-pod sync executor (pod_workers.go:91-123)."""
+class _SyncPool:
+    """Per-pod serialized sync over a SMALL shared worker pool.
 
-    def __init__(self, sync_fn):
+    The reference dedicates a goroutine per pod (pod_workers.go:91-123);
+    goroutines are cheap, Python threads are not — spawning one per pod
+    update was measurably expensive at 100 kubelets x 30 pods. The pool
+    keeps the same contract: syncs for one pod never overlap (a pod is
+    'running' while synced; updates arriving meanwhile coalesce into one
+    re-run with the latest spec), different pods sync concurrently up to
+    the worker count."""
+
+    def __init__(self, sync_fn, workers: int = 2):
+        import queue
+
         self._sync = sync_fn
+        self._q: "queue.Queue[Optional[str]]" = queue.Queue()
         self._lock = threading.Lock()
-        self._pending: Optional[Pod] = None
-        self._running = False
+        self._pending: Dict[str, Pod] = {}  # key -> latest un-synced spec
+        self._running: set = set()  # keys currently inside sync_fn
+        self._threads = []
+        for _ in range(workers):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
 
-    def update(self, pod: Pod) -> None:
+    def update(self, key: str, pod: Pod) -> None:
         with self._lock:
-            self._pending = pod
-            if self._running:
-                return
-            self._running = True
-        threading.Thread(target=self._drain, daemon=True).start()
+            queued = key in self._pending
+            self._pending[key] = pod
+            if queued or key in self._running:
+                return  # will be picked up by the queued entry / re-run
+        self._q.put(key)
 
-    def _drain(self) -> None:
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._pending.pop(key, None)
+
+    def _worker(self) -> None:
         while True:
+            key = self._q.get()
+            if key is None:
+                return
             with self._lock:
-                pod = self._pending
-                self._pending = None
-                if pod is None:
-                    self._running = False
-                    return
+                pod = self._pending.pop(key, None)
+                if pod is not None:
+                    self._running.add(key)
+            if pod is None:
+                continue
             try:
                 self._sync(pod)
             except Exception:
                 pass  # crash containment (util.HandleCrash)
+            finally:
+                with self._lock:
+                    self._running.discard(key)
+                    requeue = key in self._pending
+                if requeue:
+                    self._q.put(key)
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
 
 
 class Kubelet:
@@ -139,8 +172,14 @@ class Kubelet:
         self.manifest_url = manifest_url
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._workers: Dict[str, _PodWorker] = {}
-        self._workers_lock = threading.Lock()
+        self._sync_pool = _SyncPool(self._sync_pod, workers=2)
+        # Last status wire-form successfully WRITTEN per pod uid (the
+        # reference's status_manager.go map). Dedup must compare
+        # against what we know reached the apiserver — comparing
+        # against a locally mutated pod object let one failed write
+        # (409 during the bind/status race) suppress every retry.
+        self._last_status: Dict[str, dict] = {}
+        self._hb_node: Optional[Node] = None  # cached across heartbeats
         self._volumes_mounted: set = set()
         from kubernetes_tpu.kubelet.probes import ProbeTracker
 
@@ -228,6 +267,7 @@ class Kubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        self._sync_pool.stop()
         self.pods.stop()
         if self.services is not None:
             self.services.stop()
@@ -273,16 +313,24 @@ class Kubelet:
         )
 
     def _heartbeat(self) -> None:
-        try:
-            node = self.client.get("nodes", self.node_name)
-        except APIError:
-            self.register_node()
-            return
+        # One RPC per beat, not two: status PUTs are server-side
+        # read-modify-writes (no client resourceVersion CAS), so the
+        # node object from the last beat is reusable — the GET is only
+        # needed on the first beat or after an error (node deleted /
+        # apiserver restarted). At 100 kubelets the get+put pair doubled
+        # heartbeat traffic exactly when delayed beats read as death.
+        node = self._hb_node
+        if node is None:
+            try:
+                node = self.client.get("nodes", self.node_name)
+            except APIError:
+                self.register_node()
+                return
         self._fill_status(node)
         try:
-            self.client.update_status("nodes", node)
+            self._hb_node = self.client.update_status("nodes", node)
         except APIError:
-            pass
+            self._hb_node = None  # refetch (or re-register) next beat
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_period):
@@ -383,13 +431,7 @@ class Kubelet:
         return f"{pod.metadata.namespace}/{pod.metadata.name}"
 
     def _dispatch(self, pod: Pod) -> None:
-        key = self._key(pod)
-        with self._workers_lock:
-            worker = self._workers.get(key)
-            if worker is None:
-                worker = _PodWorker(self._sync_pod)
-                self._workers[key] = worker
-        worker.update(pod)
+        self._sync_pool.update(self._key(pod), pod)
 
     def _handle_delete(self, pod: Pod) -> None:
         uid = pod.metadata.uid or pod.metadata.name
@@ -401,8 +443,8 @@ class Kubelet:
                 pass
         self._volumes_mounted.discard(uid)
         self._probes.forget(uid + "/")
-        with self._workers_lock:
-            self._workers.pop(self._key(pod), None)
+        self._last_status.pop(uid, None)
+        self._sync_pool.forget(self._key(pod))
 
     def _resync_loop(self) -> None:
         """Periodic full resync + orphan GC (syncLoop tick)."""
@@ -433,10 +475,18 @@ class Kubelet:
 
     def _sync_pod(self, pod: Pod) -> None:
         """One reconciliation of a single pod (kubelet.go:1092)."""
+        import copy as _copy
+
         start = time.monotonic()
         if pod.status.phase in ("Succeeded", "Failed"):
             return
         uid = pod.metadata.uid or pod.metadata.name
+        # Work on a private status: the incoming pod is the informer
+        # store's own object (server state) and must not carry local
+        # mutations — a locally flipped phase would poison both the
+        # terminal-phase early-return above and status dedup below.
+        pod = _copy.copy(pod)
+        pod.status = _copy.deepcopy(pod.status)
 
         # Volumes first (kubelet.go:1135 mountExternalVolumes): a pod
         # whose volumes can't materialize must not start containers.
@@ -508,14 +558,22 @@ class Kubelet:
         pod.status.container_statuses = statuses
         # Status dedup (reference: status_manager.go) — an unchanged
         # write would bounce back through the watch and re-trigger this
-        # sync, a self-sustaining hot loop.
-        if serde.to_wire(pod.status) != old_wire:
+        # sync, a self-sustaining hot loop. Two comparisons: against
+        # the server's view (old_wire, from the informer object) and
+        # against the last write KNOWN to have succeeded — a failed
+        # write leaves no record, so the next resync tick retries
+        # instead of silently stranding the pod at its server phase.
+        new_wire = serde.to_wire(pod.status)
+        if new_wire == old_wire:
+            self._last_status[uid] = new_wire  # in sync with the server
+        elif self._last_status.get(uid) != new_wire:
             try:
                 self.client.update_status(
                     "pods", pod, namespace=pod.metadata.namespace or "default"
                 )
+                self._last_status[uid] = new_wire
             except APIError:
-                pass
+                self._last_status.pop(uid, None)  # retry next resync
         _SYNC_LATENCY.observe(time.monotonic() - start, node=self.node_name)
 
     def _pod_ip(self, uid: str) -> str:

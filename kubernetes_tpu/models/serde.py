@@ -66,6 +66,33 @@ def _is_zero(v: Any) -> bool:
     return False
 
 
+# -- compiled encode plans ------------------------------------------------
+#
+# Per-class field tables, built once: the reference generates its
+# conversions ahead of time (pkg/api/v1/conversion_generated.go via
+# cmd/genconversion) for exactly this reason — reflective per-object
+# field walks are too slow on the watch/decode hot path. Here the
+# "generated code" is a cached plan: (attr, wire key, always?) tuples
+# for encode, wire-key -> (attr, decoder-closure) for decode.
+
+_encode_plan_cache: Dict[type, tuple] = {}
+
+
+def _encode_plan(cls: type) -> tuple:
+    plan = _encode_plan_cache.get(cls)
+    if plan is None:
+        plan = tuple(
+            (
+                f.name,
+                f.metadata.get("wire", snake_to_camel(f.name)),
+                bool(f.metadata.get("always")),
+            )
+            for f in dataclasses.fields(cls)
+        )
+        _encode_plan_cache[cls] = plan
+    return plan
+
+
 def to_wire(obj: Any, *, omit_empty: bool = True) -> Any:
     """Recursively encode a dataclass (or container) to wire-form JSON."""
     if obj is None:
@@ -74,13 +101,11 @@ def to_wire(obj: Any, *, omit_empty: bool = True) -> Any:
         return str(obj)
     if dataclasses.is_dataclass(obj):
         out: Dict[str, Any] = {}
-        for f in dataclasses.fields(obj):
-            v = getattr(obj, f.name)
-            if omit_empty and _is_zero(v) and not f.metadata.get("always"):
+        for name, wire_key, always in _encode_plan(type(obj)):
+            v = getattr(obj, name)
+            if omit_empty and not always and _is_zero(v):
                 continue
-            out[f.metadata.get("wire", snake_to_camel(f.name))] = to_wire(
-                v, omit_empty=omit_empty
-            )
+            out[wire_key] = to_wire(v, omit_empty=omit_empty)
         return out
     if isinstance(obj, dict):
         return {k: to_wire(v, omit_empty=omit_empty) for k, v in obj.items()}
@@ -89,27 +114,55 @@ def to_wire(obj: Any, *, omit_empty: bool = True) -> Any:
     return obj
 
 
-def _decode_value(hint: Any, v: Any) -> Any:
-    if v is None:
-        return None
+# -- compiled decode plans ------------------------------------------------
+
+_decode_plan_cache: Dict[type, Dict[str, tuple]] = {}
+
+
+def _decoder_for(hint: Any):
+    """Build a decoder closure for one type hint (None = identity).
+    Callers handle v=None before invoking."""
     origin = get_origin(hint)
     if origin is typing.Union:  # Optional[T] and friends
         args = [a for a in get_args(hint) if a is not type(None)]
         if len(args) == 1:
-            return _decode_value(args[0], v)
-        return v
+            return _decoder_for(args[0])
+        return None  # ambiguous union: raw passthrough
     if hint is Quantity:
-        return parse_quantity(v)
+        return parse_quantity
     if dataclasses.is_dataclass(hint):
-        return from_wire(hint, v)
+        return lambda v, _c=hint: from_wire(_c, v)
     if origin in (list, typing.List):
         (elem,) = get_args(hint) or (Any,)
-        return [_decode_value(elem, x) for x in v]
+        ed = _decoder_for(elem)
+        if ed is None:
+            return list  # fresh container, raw elements
+        return lambda v, _d=ed: [None if x is None else _d(x) for x in v]
     if origin in (dict, typing.Dict):
         args = get_args(hint)
         elem = args[1] if len(args) == 2 else Any
-        return {k: _decode_value(elem, x) for k, x in v.items()}
-    return v
+        vd = _decoder_for(elem)
+        if vd is None:
+            return dict  # fresh container, raw values
+        return lambda v, _d=vd: {
+            k: None if x is None else _d(x) for k, x in v.items()
+        }
+    return None  # scalars / Any: raw passthrough
+
+
+def _decode_plan(cls: type) -> Dict[str, tuple]:
+    plan = _decode_plan_cache.get(cls)
+    if plan is None:
+        hints = _hints(cls)
+        plan = {
+            f.metadata.get("wire", snake_to_camel(f.name)): (
+                f.name,
+                _decoder_for(hints[f.name]),
+            )
+            for f in dataclasses.fields(cls)
+        }
+        _decode_plan_cache[cls] = plan
+    return plan
 
 
 def from_wire(cls: Type, data: Dict[str, Any] | None):
@@ -118,15 +171,12 @@ def from_wire(cls: Type, data: Dict[str, Any] | None):
         return None
     if not isinstance(data, dict):
         raise ValueError(f"cannot decode {cls.__name__} from {type(data).__name__}")
-    hints = _hints(cls)
+    plan = _decode_plan(cls)
     kwargs: Dict[str, Any] = {}
-    wire_index = {
-        f.metadata.get("wire", snake_to_camel(f.name)): f.name
-        for f in dataclasses.fields(cls)
-    }
     for wire_key, v in data.items():
-        name = wire_index.get(wire_key)
-        if name is None:
+        ent = plan.get(wire_key)
+        if ent is None:
             continue
-        kwargs[name] = _decode_value(hints[name], v)
+        name, dec = ent
+        kwargs[name] = v if v is None or dec is None else dec(v)
     return cls(**kwargs)

@@ -60,12 +60,7 @@ class RebuildRequired(Exception):
     a fresh session from the authoritative host store."""
 
 
-def _bucket(n: int, minimum: int = 128) -> int:
-    """Next power-of-two bucket >= n (>= minimum)."""
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+from kubernetes_tpu.ops.matrices import pow2_bucket as _bucket  # noqa: E402
 
 
 @functools.partial(jax.jit, donate_argnames=("nodes",))

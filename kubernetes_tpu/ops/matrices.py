@@ -38,6 +38,27 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m if x > 0 else m
 
 
+def pow2_bucket(n: int, minimum: int = 128) -> int:
+    """Next power-of-two bucket >= n (>= minimum). Canonical copy —
+    incremental.py's session sizing uses this same helper."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pod_axis_bucket(n: int, minimum: int) -> int:
+    """Pod-axis padding target: power-of-two buckets up to 8192, then
+    multiples of 1024. A scheduler daemon's drain sizes vary with
+    arrival timing, and every distinct padded shape is a fresh XLA
+    compile (seconds each) — pow2 bucketing caps the daemon at ~7
+    executables total, while huge offline solves (50k backlog) stay
+    within ~2% padding waste on the scan's sequential steps."""
+    if n <= 8192:
+        return pow2_bucket(n, minimum)
+    return _round_up(n, 1024)
+
+
 def _pad_cols(arr: np.ndarray, m: int) -> np.ndarray:
     """Pad axis 1 up to a multiple of m (shape-bucketing for the minor
     dims: bitset word counts and the service axis drift with snapshot
@@ -95,7 +116,7 @@ def device_pods(
 ) -> Dict[str, jnp.ndarray]:
     """PodColumns -> device dict (padded axis 0 to a pad_to multiple)."""
     P = p.count
-    PP = _round_up(P, pad_to)
+    PP = _pod_axis_bucket(P, pad_to)
     sel_rows = (
         p.sel_bits[p.selector_id]
         if P

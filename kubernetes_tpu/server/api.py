@@ -45,7 +45,7 @@ from kubernetes_tpu.store import (
     KVStore,
     NotFoundError,
 )
-from kubernetes_tpu.store.watch import ADDED, DELETED, MODIFIED, Event, WatchStream
+from kubernetes_tpu.store.watch import WatchStream
 
 
 class APIError(Exception):
@@ -304,56 +304,6 @@ def _strategic_merge(target: dict, patch: dict) -> dict:
 
 def _bad_request(msg: str) -> APIError:
     return APIError(400, "BadRequest", msg)
-
-
-class _FilteredStream:
-    """Wraps a store WatchStream, applying selector filters.
-
-    An ADDED/MODIFIED event whose object no longer matches the filter is
-    rewritten as DELETED, so consumers watching e.g. spec.nodeName=""
-    see pods leave their view when another actor binds them (reference:
-    the modified-out-of-filter -> Deleted translation in
-    pkg/tools/etcd_helper_watch.go sendModify). A spurious DELETED for
-    an object the consumer never saw is a harmless no-op delete.
-    """
-
-    def __init__(self, inner: WatchStream, pred, filtered: bool):
-        self._inner = inner
-        self._pred = pred
-        self._filtered = filtered
-
-    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            t = None if deadline is None else max(0.0, deadline - time.monotonic())
-            ev = self._inner.next(timeout=t)
-            if ev is None:
-                return None
-            if not self._filtered or self._pred(ev.object):
-                return ev
-            # Non-matching events (etcd_helper_watch.go sendModify/sendDelete
-            # shape): ADDED of a never-matching object is skipped; MODIFIED
-            # means it may have matched before -> synthesize DELETED so
-            # consumers drop it (a spurious delete is a no-op); DELETED
-            # passes through for the same reason.
-            if ev.type in (MODIFIED, DELETED):
-                return Event(DELETED, ev.object, ev.version)
-            if deadline is not None and time.monotonic() >= deadline:
-                return None
-
-    def close(self) -> None:
-        self._inner.close()
-
-    @property
-    def closed(self) -> bool:
-        return self._inner.closed
-
-    def __iter__(self):
-        while True:
-            ev = self.next()
-            if ev is None:
-                return
-            yield ev
 
 
 class APIServer:
@@ -752,9 +702,15 @@ class APIServer:
         namespace: str = "",
         label_selector: str = "",
         field_selector: str = "",
+        copy: bool = True,
     ) -> dict:
+        """copy=False returns the store's own objects (READ-ONLY — for
+        callers that immediately serialize, like the HTTP tier: a
+        3000-pod LIST must not pay a full deep copy just to be JSON-
+        encoded and thrown away). Stored objects are never mutated in
+        place, so the refs are a consistent snapshot."""
         info = self._info(resource)
-        items, version = self.store.list(info.prefix(namespace))
+        items, version = self.store.list(info.prefix(namespace), copy=copy)
         pred = self._selector_pred(resource, label_selector, field_selector)
         items = [o for o in items if pred(o)]
         if info.name == "componentstatuses" and self._component_checks:
@@ -1188,7 +1144,10 @@ class APIServer:
             return cur
 
         try:
-            return self.store.guaranteed_update(key, apply)
+            # atomic_update, not guaranteed_update: status writes are
+            # the highest-traffic mutation (every kubelet sync), and
+            # the single-hold form halves lock handoffs under burst.
+            return self.store.atomic_update(key, apply)
         except NotFoundError:
             raise _not_found(info.name, name)
 
@@ -1221,17 +1180,19 @@ class APIServer:
         since: int = 0,
         label_selector: str = "",
         field_selector: str = "",
-    ) -> _FilteredStream:
+    ) -> WatchStream:
+        """Selector filtering happens INSIDE the store's fan-out (with
+        etcd's modified-out-of-filter -> DELETED translation,
+        kvstore._filter_event): a kubelet watching spec.nodeName=X never
+        has the other nodes' pod events copied or queued for it."""
         info = self._info(resource)
+        pred = None
+        if label_selector or field_selector:
+            pred = self._selector_pred(resource, label_selector, field_selector)
         try:
-            inner = self.store.watch(info.prefix(namespace), since=since)
+            return self.store.watch(info.prefix(namespace), since=since, pred=pred)
         except Exception as e:  # CompactedError -> 410 Gone
             raise APIError(410, "Expired", str(e))
-        return _FilteredStream(
-            inner,
-            self._selector_pred(resource, label_selector, field_selector),
-            filtered=bool(label_selector or field_selector),
-        )
 
     # -- bindings (the scheduler's commit path) ------------------------
 
@@ -1261,12 +1222,12 @@ class APIServer:
             return cur
 
         try:
-            self.store.guaranteed_update(key, assign)
+            self.store.atomic_update(key, assign)
         except NotFoundError:
             raise _not_found("pods", pod_name)
         except ConflictError as e:
-            # CAS retry exhaustion surfaces as 409 like any other
-            # write conflict (the caller retries the pod).
+            # The already-assigned guard raises inside atomic_update
+            # and surfaces as 409 (the caller retries the pod).
             raise _conflict(str(e))
         return {
             "kind": "Status",

@@ -592,12 +592,21 @@ class _Handler(BaseHTTPRequestHandler):
             return self._node_proxy(rest[1], rest[3:])
         if len(rest) == 1:
             return self._collection(verb, resource, "", lsel, fsel)
+        if info.namespaced and len(rest) >= 2:
+            raise APIError(
+                400, "BadRequest", f"{resource} is namespaced; use /namespaces/.."
+            )
         if len(rest) == 2:
-            if info.namespaced:
-                raise APIError(
-                    400, "BadRequest", f"{resource} is namespaced; use /namespaces/.."
-                )
             return self._item(verb, resource, "", rest[1])
+        if len(rest) == 3 and rest[2] == "status" and verb == "PUT":
+            # Cluster-scoped status subresource — PUT /nodes/{n}/status
+            # is every kubelet's heartbeat write (the reference installs
+            # status routes for all resources, api_installer.go).
+            out = api.update_status(
+                resource, "", rest[1], self._read_body(self._kind_of(resource))
+            )
+            self._send_json(200, out)
+            return resource, 200
         raise APIError(404, "NotFound", f"unknown path {self.path!r}")
 
     # -- pod subresources proxied to the kubelet API ------------------
@@ -818,7 +827,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # signal (the reference uses verb WATCHLIST the same
                 # way, pkg/apiserver/metrics.go).
                 return resource + "/watch", 200
-            self._send_json(200, api.list(resource, ns, lsel, fsel))
+            # copy=False: the list is encoded and discarded right here,
+            # so the store's read-only refs skip a full deep copy.
+            self._send_json(200, api.list(resource, ns, lsel, fsel, copy=False))
             return resource, 200
         if verb == "POST":
             out = api.create(resource, ns, self._read_body(self._kind_of(resource)))

@@ -38,9 +38,12 @@ from __future__ import annotations
 import copy
 import fcntl
 import json
+import math
 import os
+import queue as _queue
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -67,6 +70,62 @@ class CompactedError(StoreError):
     """Watch window no longer covers the requested version."""
 
 
+def _copy_obj(obj: dict) -> dict:
+    """Private copy of a wire-form object. Wire objects are JSON by
+    construction (they ride the WAL and the HTTP API as JSON), and a
+    C-accelerated json round-trip is ~2x faster than copy.deepcopy on
+    pod-sized dicts; anything non-JSON (test doubles) falls back.
+
+    Contract caveat: json.dumps coerces rather than rejects two
+    non-wire shapes — int dict keys become strings and tuples become
+    lists — so the fallback won't fire for them. That's the store's
+    documented JSON-object contract (same coercion the WAL and the
+    HTTP tier already apply); don't put non-wire values in the store."""
+    try:
+        return json.loads(json.dumps(obj))
+    except (TypeError, ValueError):
+        return copy.deepcopy(obj)
+
+
+def _dispatch_thread(store_ref: "weakref.ref", q: "_queue.SimpleQueue") -> None:
+    """Drains a store's dispatch queue until a None sentinel (close) or
+    the store itself is collected."""
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        store = store_ref()
+        if store is None:
+            return
+        store._dispatch_event(item)
+        del store  # don't pin the store across the blocking get()
+
+
+def _filter_event(
+    pred: Optional[Callable], etype: str, obj: dict, prev: Optional[dict], version: int
+) -> Optional[Event]:
+    """etcd's filtered-watch translation (pkg/tools/etcd_helper_watch.go
+    sendModify/sendDelete): a selector-filtered watcher sees ADDED/
+    MODIFIED only while the object matches, a synthesized DELETED when a
+    modification takes it out of the filter (so a spec.nodeName=""
+    watcher sees pods leave its view when the scheduler binds them), and
+    nothing at all for objects that never concerned it. With no previous
+    state to consult (history replay), a non-matching MODIFIED degrades
+    to a spurious DELETED — a harmless no-op for consumers."""
+    if pred is None:
+        return Event(etype, obj, version)
+    if etype == ADDED:
+        return Event(ADDED, obj, version) if pred(obj) else None
+    if etype == MODIFIED:
+        if pred(obj):
+            return Event(MODIFIED, obj, version)
+        if prev is None or pred(prev):
+            return Event(DELETED, obj, version)
+        return None
+    # DELETED: obj is the last stored state — deliver iff it was visible.
+    return Event(DELETED, obj, version) if pred(obj) else None
+
+
 class KVStore:
     def __init__(
         self,
@@ -82,7 +141,36 @@ class KVStore:
         # History ring for watch replay: (version, type, key, obj).
         self._history: deque = deque(maxlen=history_limit)
         self._oldest = 0  # lowest version NOT compacted out of history
-        self._watchers: List[Tuple[str, WatchStream]] = []  # (prefix, stream)
+        # (prefix, pred-or-None, stream). Selector predicates live HERE,
+        # not above the store: a filtered watcher (kubelet watching
+        # spec.nodeName=X) must not even be offered the other 99 nodes'
+        # events — at 100 kubelets that fan-out was the control plane's
+        # wall, not the solver.
+        self._watchers: List[Tuple[str, Optional[Callable], WatchStream]] = []
+        # Fan-out rides its own thread: writers only append to this
+        # queue under the lock; the dispatcher does the per-event copy
+        # and per-watcher predicate work OFF the write path, so write
+        # latency is independent of watcher count. Ordering: single
+        # dispatcher = version order; replay/live races are settled by
+        # each stream's version floor (WatchStream.push).
+        self._dispatch_q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        # The thread holds only (weakref, queue): a dropped store is
+        # still collectable even if close() was never called (tests
+        # build thousands of throwaway stores).
+        self._dispatcher = threading.Thread(
+            target=_dispatch_thread,
+            args=(weakref.ref(self), self._dispatch_q),
+            daemon=True,
+        )
+        self._dispatcher.start()
+        # A store dropped WITHOUT close() must still retire its thread:
+        # the weakref alone makes the object collectable, but the
+        # thread would park in q.get() forever. The finalizer holds
+        # only the queue, so it doesn't resurrect the store.
+        weakref.finalize(self, self._dispatch_q.put, None)
+        # TTL fast path: earliest pending expiry; ops skip the full
+        # O(ttl-keys) scan until the clock actually reaches it.
+        self._next_expiry = math.inf
         # Durability (off when data_dir is None — tests/benches that
         # want a pure in-memory store keep the old behavior).
         # TTL clock: wall time for durable stores (deadlines must age
@@ -124,6 +212,7 @@ class KVStore:
             os.ftruncate(self._lockfd, 0)  # clear any longer stale pid
             os.write(self._lockfd, str(os.getpid()).encode())
             replayed = self._recover()
+            self._next_expiry = min(self._ttl.values(), default=math.inf)
             self._wal_file = open(self._wal_path, "a", encoding="utf-8")
             if replayed:
                 # Compact on boot: fold the replayed tail into a fresh
@@ -301,8 +390,8 @@ class KVStore:
         return obj
 
     def _expire_locked(self) -> None:
-        if not self._ttl:
-            return
+        if self._now() < self._next_expiry:
+            return  # nothing can have expired yet — O(1) common path
         now = self._now()
         expired = [k for k, t in self._ttl.items() if t <= now]
         for k in expired:
@@ -311,75 +400,111 @@ class KVStore:
                 obj, _ = self._data.pop(k)
                 v = self._bump()
                 self._record(v, DELETED, k, obj)
+        self._next_expiry = min(self._ttl.values(), default=math.inf)
 
-    def _record(self, version: int, etype: str, key: str, obj: dict) -> None:
-        # History and watch consumers get their own copies: stored state
-        # must never be reachable (hence mutable) through an event.
-        obj = copy.deepcopy(obj)
+    def _record(
+        self, version: int, etype: str, key: str, obj: dict, prev: Optional[dict] = None
+    ) -> None:
+        """Journal one mutation (caller holds self._lock). The write
+        path only appends: WAL, history ring, dispatch queue. The
+        per-event copy and per-watcher filter/push work happens on the
+        dispatcher thread, so a write's lock hold is O(obj-serialize)
+        for durable stores and O(1) otherwise — independent of watcher
+        count. `obj` is the just-stored object (never mutated in place
+        after storage); history shares the ref and replay copies it
+        per delivery (watch())."""
         self._wal_append(version, etype, key, obj)
         if not self._history:
             self._oldest = version
         self._history.append((version, etype, key, obj))
         if len(self._history) == self._history.maxlen:
             self._oldest = self._history[0][0]
-        live = []
-        for prefix, stream in self._watchers:
+        self._dispatch_q.put((version, etype, key, obj, prev))
+
+    def _dispatch_event(self, item: tuple) -> None:
+        """Watch fan-out for one event, off the write path. ALL watchers
+        share ONE private copy per event: stored state stays unreachable
+        through events, and the copy cost doesn't scale with watcher
+        count (at 100 kubelets a per-watcher deepcopy under the store
+        lock was the control plane's wall, not the solver). Event
+        objects are read-only by contract — every consumer either
+        JSON-encodes them (HTTP watch) or decodes them into fresh typed
+        objects (serde.from_wire rebuilds every container)."""
+        version, etype, key, obj, prev = item
+        with self._lock:
+            watchers = list(self._watchers)
+        delivered = None  # lazily copied: most events match few watchers
+        saw_closed = False
+        for prefix, pred, stream in watchers:
             if stream.closed:
-                continue  # prune dead watchers as we go
+                saw_closed = True
+                continue
             if key.startswith(prefix):
-                stream.push(Event(etype, copy.deepcopy(obj), version))
-            if not stream.closed:
-                live.append((prefix, stream))
-        self._watchers = live
+                ev = _filter_event(pred, etype, obj, prev, version)
+                if ev is not None:
+                    if delivered is None:
+                        delivered = _copy_obj(obj)
+                    stream.push(Event(ev.type, delivered, version))
+            if stream.closed:
+                saw_closed = True  # push() just dropped a slow consumer
+        if saw_closed:
+            with self._lock:
+                self._watchers = [
+                    w for w in self._watchers if not w[2].closed
+                ]
 
     # -- CRUD ---------------------------------------------------------
 
     def create(self, key: str, obj: dict, ttl: Optional[float] = None) -> dict:
+        obj = _copy_obj(obj)  # before the lock: O(obj) work stays outside
         with self._lock:
             self._expire_locked()
             if key in self._data:
                 raise AlreadyExistsError(key)
-            obj = copy.deepcopy(obj)
             v = self._bump()
             self._stamp(obj, v)
             self._data[key] = (obj, v)
             if ttl is not None:
-                self._ttl[key] = self._now() + ttl
+                exp = self._now() + ttl
+                self._ttl[key] = exp
+                self._next_expiry = min(self._next_expiry, exp)
             self._record(v, ADDED, key, obj)
-            out = copy.deepcopy(obj)
             seq = self._wal_seq
         self._wal_sync(seq)  # fsync-before-ack, amortized across writers
-        return out
+        return _copy_obj(obj)
 
     def get(self, key: str) -> dict:
         with self._lock:
             self._expire_locked()
             if key not in self._data:
                 raise NotFoundError(key)
-            return copy.deepcopy(self._data[key][0])
+            obj = self._data[key][0]
+        # Copy OUTSIDE the lock: stored tuples are rebound, never
+        # mutated in place, so the ref is a consistent snapshot — and
+        # the store's one lock must not be held for O(object) copies.
+        return _copy_obj(obj)
 
     def set(
         self, key: str, obj: dict, expected_version: Optional[int] = None
     ) -> dict:
         """Update; CAS when expected_version is given (etcd CompareAndSwap)."""
+        obj = _copy_obj(obj)  # before the lock: O(obj) work stays outside
         with self._lock:
             self._expire_locked()
             if key not in self._data:
                 raise NotFoundError(key)
-            _, cur_v = self._data[key]
+            prev, cur_v = self._data[key]
             if expected_version is not None and cur_v != expected_version:
                 raise ConflictError(
                     f"{key}: version {expected_version} != current {cur_v}"
                 )
-            obj = copy.deepcopy(obj)
             v = self._bump()
             self._stamp(obj, v)
             self._data[key] = (obj, v)
-            self._record(v, MODIFIED, key, obj)
-            out = copy.deepcopy(obj)
+            self._record(v, MODIFIED, key, obj, prev=prev)
             seq = self._wal_seq
         self._wal_sync(seq)
-        return out
+        return _copy_obj(obj)
 
     def delete(self, key: str, expected_version: Optional[int] = None) -> dict:
         with self._lock:
@@ -395,26 +520,59 @@ class KVStore:
             self._ttl.pop(key, None)
             v = self._bump()
             self._record(v, DELETED, key, obj)
-            out = copy.deepcopy(obj)
             seq = self._wal_seq
         self._wal_sync(seq)
-        return out
+        return _copy_obj(obj)
 
-    def list(self, prefix: str) -> Tuple[List[dict], int]:
-        """All objects under prefix + the store version (for watch resume)."""
+    def list(self, prefix: str, copy: bool = True) -> Tuple[List[dict], int]:
+        """All objects under prefix + the store version (for watch
+        resume). copy=False hands out the stored objects themselves
+        (read-only contract — for callers that only serialize)."""
         with self._lock:
             self._expire_locked()
-            out = [
-                copy.deepcopy(obj)
+            # Snapshot refs under the lock (cheap), copy outside it: a
+            # 3000-pod list must not stall every writer for the copy.
+            snap = [
+                obj
                 for key, (obj, _) in sorted(self._data.items())
                 if key.startswith(prefix)
             ]
-            return out, self._version
+            version = self._version
+        if not copy:
+            return snap, version
+        return [_copy_obj(o) for o in snap], version
 
     def keys(self, prefix: str = "") -> List[str]:
         with self._lock:
             self._expire_locked()
             return sorted(k for k in self._data if k.startswith(prefix))
+
+    def atomic_update(self, key: str, update_fn: Callable[[dict], dict]) -> dict:
+        """Single-hold read-modify-write: update_fn runs under the store
+        lock on a private copy, so no CAS retry loop and ONE lock
+        acquisition per write instead of guaranteed_update's two. This
+        is the high-traffic write path (status PUTs, bindings): on a
+        single-core host a 100-kubelet status burst queues hundreds of
+        threads on this lock, and every extra lock handoff costs up to
+        a GIL switch interval. update_fn must be small and must not
+        call back into the store."""
+        with self._lock:
+            self._expire_locked()
+            if key not in self._data:
+                raise NotFoundError(key)
+            cur, _ = self._data[key]
+            # Stored state must be PRIVATE: update_fn may graft caller-
+            # owned sub-dicts into its return (update_status splices the
+            # request body's status), so the stored object is a copy —
+            # same invariant set() keeps by copying its input.
+            stored = _copy_obj(update_fn(_copy_obj(cur)))
+            v = self._bump()
+            self._stamp(stored, v)
+            self._data[key] = (stored, v)
+            self._record(v, MODIFIED, key, stored, prev=cur)
+            seq = self._wal_seq
+        self._wal_sync(seq)
+        return _copy_obj(stored)
 
     # -- GuaranteedUpdate (etcd_helper.go:510-600) ---------------------
 
@@ -429,7 +587,7 @@ class KVStore:
                 if key not in self._data:
                     raise NotFoundError(key)
                 cur, cur_v = self._data[key]
-                cur = copy.deepcopy(cur)
+            cur = _copy_obj(cur)  # private copy, made outside the lock
             new = update_fn(cur)
             try:
                 return self.set(key, new, expected_version=cur_v)
@@ -439,11 +597,21 @@ class KVStore:
 
     # -- Watch --------------------------------------------------------
 
-    def watch(self, prefix: str, since: int = 0, maxsize: int = 4096) -> WatchStream:
+    def watch(
+        self,
+        prefix: str,
+        since: int = 0,
+        maxsize: int = 4096,
+        pred: Optional[Callable[[dict], bool]] = None,
+    ) -> WatchStream:
         """Stream events for keys under prefix with version > since.
 
         since=0 means "from now". History older than the replay buffer
         raises CompactedError — caller must re-list (Reflector does).
+        `pred` is a selector filter applied INSIDE the fan-out with
+        etcd's modified-out-of-filter -> DELETED translation
+        (_filter_event): non-matching events are never copied or queued
+        for this watcher.
         """
         with self._lock:
             self._expire_locked()
@@ -457,25 +625,42 @@ class KVStore:
                         f"(oldest {self._oldest if self._history else self._version})"
                     )
             stream = WatchStream(maxsize=maxsize)
-            self._watchers = [(p, s) for p, s in self._watchers if not s.closed]
-            self._watchers.append((prefix, stream))
             if since:
                 for v, etype, key, obj in self._history:
                     if v > since and key.startswith(prefix):
-                        stream.push(Event(etype, copy.deepcopy(obj), v))
+                        # History has no prev state: replay uses the
+                        # spurious-DELETED degradation (_filter_event).
+                        # History entries share stored objects, so each
+                        # delivery gets its own copy.
+                        ev = _filter_event(pred, etype, obj, None, v)
+                        if ev is not None:
+                            stream.push(Event(ev.type, _copy_obj(obj), v))
+            # Replay covered everything <= the current version; the
+            # floor makes the dispatcher's not-yet-fanned-out backlog
+            # (all <= it, since writes need this lock) a no-op for this
+            # stream instead of a duplicate. Registration happens only
+            # AFTER replay so live events can't interleave mid-replay.
+            stream.floor = self._version
+            self._watchers = [
+                (p, f, s) for p, f, s in self._watchers if not s.closed
+            ]
+            self._watchers.append((prefix, pred, stream))
             return stream
 
     def stop_watch(self, stream: WatchStream) -> None:
         stream.close()
         with self._lock:
-            self._watchers = [(p, s) for p, s in self._watchers if not s.closed]
+            self._watchers = [
+                (p, f, s) for p, f, s in self._watchers if not s.closed
+            ]
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            for _, s in self._watchers:
+            for _, _, s in self._watchers:
                 s.close()
             self._watchers = []
+            self._dispatch_q.put(None)  # retire the dispatcher thread
             if self._wal_file is not None:
                 self._wal_file.close()
                 self._wal_file = None

@@ -38,10 +38,17 @@ class WatchStream:
     def __init__(self, maxsize: int = 4096):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize)
         self._closed = threading.Event()
+        # Version floor: events at or below it are silently dropped.
+        # The store sets it at registration time so the async dispatch
+        # thread's backlog (events the registration-time replay already
+        # covered) can never be double-delivered or re-ordered.
+        self.floor = 0
 
     def push(self, ev: Event) -> bool:
         if self._closed.is_set():
             return False
+        if ev.version and ev.version <= self.floor:
+            return True  # already covered by replay — drop, stay open
         try:
             self._q.put_nowait(ev)
             return True

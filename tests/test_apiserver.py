@@ -229,12 +229,50 @@ class TestRegressionsFromReview:
         w.close()
 
     def test_closed_watchers_pruned(self):
+        import time
+
         api = APIServer()
         base = len(api.store._watchers)
         for _ in range(5):
             api.watch("pods", "default").close()
         api.store.create("/prune-trigger", {"metadata": {"name": "x"}})
+        # Fan-out (and thus pruning) rides the dispatcher thread now.
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if len(api.store._watchers) == base:
+                break
+            time.sleep(0.01)
         assert len(api.store._watchers) == base
+
+    def test_cluster_scoped_status_subresource_over_http(self):
+        """PUT /api/v1/nodes/{name}/status — the kubelet heartbeat
+        write. The router only handled the namespaced form, so every
+        HTTP kubelet's heartbeat 404'd (silently, the kubelet swallows
+        APIError) and the node controller evicted the whole cluster
+        after the grace period."""
+        import json as jsonmod
+        import urllib.request
+
+        api = APIServer()
+        server = APIHTTPServer(api).start()
+        try:
+            api.create("nodes", "", {"metadata": {"name": "hb-n1"}})
+            node = api.get("nodes", "", "hb-n1")
+            node["status"] = {
+                "conditions": [{"type": "Ready", "status": "True"}]
+            }
+            req = urllib.request.Request(
+                server.address + "/api/v1/nodes/hb-n1/status",
+                data=jsonmod.dumps(node).encode(),
+                method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+            got = api.get("nodes", "", "hb-n1")
+            assert got["status"]["conditions"][0]["status"] == "True"
+        finally:
+            server.stop()
 
     def test_watch_bad_resource_version_400(self):
         import urllib.error

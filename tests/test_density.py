@@ -272,6 +272,84 @@ class TestDensityAtScale:
             c.stop()
 
 
+@pytest.mark.slow
+class TestDensityReferenceGoal:
+    """The reference's v1.0 cluster-size goal: 100 nodes x 30 pods/node
+    = 3000 pods (docs/roadmap.md:61-63), pass criteria from
+    test/e2e/density.go:108-129 — all pods Running, <=1% abnormal pod
+    events — plus the API latency SLO (99% of calls < 1s,
+    docs/roadmap.md:69) read from the apiserver's own summaries exactly
+    like test/e2e/util.go:1286 HighLatencyRequests.
+
+    Two topologies, scaled to what a single-core CI host can carry:
+    - 100 kubelets in-process (cmd/integration's fake-runtime-under-
+      real-control-plane pattern) with the client driving pod creation
+      and the SLO gate over the real HTTP apiserver;
+    - 50 kubelets each talking REAL HTTP (watch fan-out, heartbeats,
+      status writeback all cross the wire, one serialized connection
+      per kubelet like the Go client's few-multiplexed-connections
+      shape).
+    """
+
+    def _run(self, nodes, pods_per_node, kubelet_http, timeout_s):
+        from kubernetes_tpu.server.httpserver import high_latency_requests
+
+        argv = [
+            "--port", "0", "--nodes", str(nodes), "--batch-scheduler",
+            "--batch-mode", "wave", "--no-kube-proxy",
+        ]
+        if kubelet_http:
+            argv.append("--kubelet-http")
+        c = LocalCluster(build_parser().parse_args(argv)).start()
+        try:
+            client = Client(HTTPTransport(c.http.address))
+            total = nodes * pods_per_node
+            n_rcs = max(1, nodes // 10)
+            for i in range(n_rcs):
+                client.create(
+                    "replicationcontrollers",
+                    rc_wire(
+                        f"dense-{i}", total // n_rcs, f"dense-{i}",
+                        cpu="25m", mem="16Mi",
+                    ),
+                )
+
+            def all_running():
+                pods, _ = client.list("pods", namespace="default")
+                return sum(1 for p in pods if p.status.phase == "Running")
+
+            assert wait_until(
+                lambda: all_running() >= total,
+                timeout=timeout_s, interval=1.0,
+            ), f"only {all_running()}/{total} Running"
+            pods, _ = client.list("pods", namespace="default")
+            per_node = {}
+            for p in pods:
+                per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+            assert len(per_node) == nodes, "some kubelet carried no pods"
+            assert all(v <= 110 for v in per_node.values()), per_node
+            client.flush_events()
+            assert abnormal_event_fraction(client, total) <= 0.01
+            slow = high_latency_requests(threshold=1.0)
+            assert not slow, f"API p99 SLO violations: {slow}"
+        finally:
+            c.stop()
+
+    def test_density_3000_pods_100_nodes(self):
+        """The headline shape (reference cluster-size goal): measured
+        ~25s to all-Running on a 1-core host; 300s is the safety bound."""
+        self._run(nodes=100, pods_per_node=30, kubelet_http=False,
+                  timeout_s=300)
+
+    def test_density_http_kubelets_50_nodes(self):
+        """Full wire topology: 50 kubelets x 30 pods over real HTTP
+        (measured ~16s to all-Running; 100 HTTP kubelets exceeds a
+        single-core host's thread budget — the in-process variant
+        above carries the 100-node shape)."""
+        self._run(nodes=50, pods_per_node=30, kubelet_http=True,
+                  timeout_s=300)
+
+
 def test_proxy_subpath_is_long_running_exempt():
     """Proxy requests carry subpaths after the verb; they must bypass
     the in-flight limit wherever 'proxy' sits in the path (review
