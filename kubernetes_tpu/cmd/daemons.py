@@ -452,6 +452,14 @@ def kubelet_parser() -> argparse.ArgumentParser:
         help="in-memory runtime (integration testing); default is the "
         "process runtime when --root-dir is set",
     )
+    p.add_argument(
+        "--container-runtime", default="",
+        choices=["", "fake", "process", "sandbox"],
+        help="runtime backend (reference: kubelet --container_runtime "
+        "docker|rkt). sandbox = namespace-isolated pods + image store "
+        "(needs root + util-linux); default: process when --root-dir "
+        "is set, else fake",
+    )
     p.add_argument("--http-port", type=int, default=0)
     p.add_argument(
         "--cluster-dns", default="",
@@ -468,12 +476,29 @@ def start_kubelet(args, client=None):
     from kubernetes_tpu.kubelet.runtime import FakeRuntime
 
     client = client or Client(HTTPTransport(args.server))
-    runtime = None
-    if args.fake_runtime or not args.root_dir:
+    choice = getattr(args, "container_runtime", "") or (
+        "fake" if args.fake_runtime or not args.root_dir else "process"
+    )
+    if choice == "fake":
         runtime = FakeRuntime()
+    elif choice == "sandbox":
+        from kubernetes_tpu.kubelet.sandbox_runtime import (
+            SandboxRuntime,
+            sandbox_supported,
+        )
+
+        if not args.root_dir:
+            raise SystemExit("--container-runtime sandbox needs --root-dir")
+        if not sandbox_supported():
+            raise SystemExit(
+                "sandbox runtime unavailable (needs root + unshare/nsenter)"
+            )
+        runtime = SandboxRuntime(args.root_dir, node_name=args.node_name)
     else:
         from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
 
+        if not args.root_dir:
+            raise SystemExit("--container-runtime process needs --root-dir")
         runtime = ProcessRuntime(args.root_dir, node_name=args.node_name)
     if getattr(args, "cluster_dns", ""):
         # Reference: --cluster-dns/--cluster-domain flow into every

@@ -24,6 +24,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="pods become real OS processes (default: fake runtime)",
     )
     p.add_argument(
+        "--sandbox-runtime", action="store_true",
+        help="pods become namespace-isolated process groups with an "
+        "image store (the rkt-analog backend; needs root + util-linux)",
+    )
+    p.add_argument(
         "--cloud-provider", default="",
         help="register nodes from a cloud provider (e.g. 'tpu')",
     )
@@ -100,8 +105,25 @@ class LocalCluster:
         from kubernetes_tpu.kubelet.agent import Kubelet
         from kubernetes_tpu.kubelet.runtime import FakeRuntime
 
+        sandbox = getattr(self.args, "sandbox_runtime", False)
+        if sandbox:
+            from kubernetes_tpu.kubelet.sandbox_runtime import sandbox_supported
+
+            if not sandbox_supported():
+                # Fail loudly: pods silently running UNsandboxed would
+                # look isolated while providing nothing.
+                raise SystemExit(
+                    "--sandbox-runtime unavailable "
+                    "(needs root + unshare/nsenter)"
+                )
         for i in range(self.args.nodes):
-            if self.args.process_runtime:
+            if sandbox:
+                from kubernetes_tpu.kubelet.sandbox_runtime import SandboxRuntime
+
+                root = _tempfile.mkdtemp(prefix=f"ktpu-node-{i}-")
+                self._tmp_roots.append(root)
+                runtime = SandboxRuntime(root, node_name=f"node-{i}")
+            elif self.args.process_runtime:
                 from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
 
                 root = _tempfile.mkdtemp(prefix=f"ktpu-node-{i}-")

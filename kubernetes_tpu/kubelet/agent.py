@@ -197,6 +197,7 @@ class Kubelet:
         self._oom = OOMWatcher(client, node_name)
         self.disk = None
         self.container_gc = None
+        self.image_manager = None
         runtime_root = getattr(self.runtime, "root", None)
         if runtime_root:
             self.disk = DiskManager(runtime_root)
@@ -206,6 +207,17 @@ class Kubelet:
                 min_age_s=30.0,
                 disk=self.disk,
                 desired_uids=self._desired_uids,
+            )
+        # Image GC needs an image substrate, which only runtimes with a
+        # store carry (SandboxRuntime.images; reference:
+        # image_manager.go against docker's image list).
+        if getattr(self.runtime, "images", None) is not None:
+            from kubernetes_tpu.kubelet.managers import ImageManager
+
+            self.image_manager = ImageManager(
+                self.runtime.images,
+                high_bytes=256 * 1024 * 1024,
+                low_bytes=192 * 1024 * 1024,
             )
         self.housekeeping_period = 10.0
         self.pods = Informer(
@@ -374,10 +386,17 @@ class Kubelet:
         }
 
     def _housekeeping_loop(self) -> None:
-        """Container GC + disk-pressure reclaim + OOM-dedup prune."""
+        """Container GC + image GC + disk reclaim + OOM-dedup prune."""
         while not self._stop.wait(self.housekeeping_period):
             try:
                 self.container_gc.gc()
+                if self.image_manager is not None:
+                    in_use = {
+                        c.image
+                        for cs in self.runtime.list_pods().values()
+                        for c in cs
+                    }
+                    self.image_manager.gc(in_use)
                 self._oom.prune(self.runtime.list_pods())
             except Exception:
                 pass

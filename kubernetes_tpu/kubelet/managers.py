@@ -207,6 +207,44 @@ class ContainerGC:
         return removed
 
 
+class ImageManager:
+    """Image GC against a runtime's ImageStore (SandboxRuntime.images).
+
+    Reference: pkg/kubelet/image_manager.go GarbageCollect — once image
+    disk usage crosses the high threshold, evict least-recently-used
+    images NOT used by any live container until usage is back under the
+    low threshold. Thresholds here are byte budgets (the reference uses
+    percent-of-imagefs; a byte budget is the same policy on a store
+    that owns its own directory)."""
+
+    def __init__(self, store, high_bytes: int, low_bytes: int):
+        assert low_bytes <= high_bytes
+        self.store = store
+        self.high_bytes = high_bytes
+        self.low_bytes = low_bytes
+
+    def gc(self, in_use: set) -> int:
+        """Returns bytes freed. `in_use` = image names of live
+        containers (never evicted, image_manager.go:214)."""
+        used = self.store.bytes_used()
+        if used <= self.high_bytes:
+            return 0
+        candidates = sorted(
+            (
+                rec
+                for rec in self.store.list_images()
+                if rec.get("image") not in in_use
+            ),
+            key=lambda rec: rec.get("lastUsed", 0.0),
+        )
+        freed = 0
+        for rec in candidates:
+            if used - freed <= self.low_bytes:
+                break
+            freed += self.store.remove(rec["image"])
+        return freed
+
+
 class OOMWatcher:
     """Records an event when a container dies by SIGKILL — the
     process-runtime observable for kernel OOM kills (oom_watcher.go

@@ -1,0 +1,322 @@
+"""Sandbox runtime: pods in real Linux namespaces, with an image store.
+
+The second REAL container runtime behind the kubelet's runtime seam
+(kubernetes_tpu/kubelet/runtime.py), playing the role rkt plays for the
+reference (pkg/kubelet/rkt/rkt.go — the proof that the abstraction in
+pkg/kubelet/container/runtime.go:304 supports more than one backend).
+
+What it adds over ProcessRuntime:
+
+- **Pod-level isolation.** Each pod's anchor is created with
+  `unshare --pid --fork --kill-child --mount --mount-proc --uts`, so
+  the pod owns a PID namespace (containers see only pod processes;
+  /proc/1 is the pause anchor), a mount namespace (its own /proc
+  mount), and a UTS namespace (hostname == pod name, the reference's
+  infra-container hostname semantics, dockertools/manager.go:1202).
+  Containers and execs enter those namespaces with `nsenter -t <pid>
+  -p -m -u`. PID-namespace teardown is kernel-enforced: when the
+  anchor (ns PID 1) dies, every process in the pod is SIGKILLed —
+  kill_pod cannot leak processes even if this daemon crashes mid-kill
+  (`--kill-child` ties the anchor to our unshare parent too).
+
+- **An image substrate.** Containers "pull" their image on first use
+  into an on-disk store (<root>/images/): a manifest plus a layer blob
+  of deterministic size, giving image bytes a real existence the
+  kubelet's ImageManager (kubelet/managers.py, the image_manager.go
+  analog) can garbage-collect by LRU under a disk budget — the piece
+  a pure process runtime acknowledged it couldn't support.
+
+Everything else (spec-hash container replacement, restart counts, log
+files, adoption across kubelet restarts, service env injection) is
+shared with ProcessRuntime by inheritance — the runtime seam only
+varies WHERE processes run, not the kubelet contract above it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.models.objects import Pod
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime, _Proc, _spec_hash
+
+
+def sandbox_supported() -> bool:
+    """Namespaces need root + util-linux; probe once, cheaply."""
+    if os.geteuid() != 0:
+        return False
+    if shutil.which("unshare") is None or shutil.which("nsenter") is None:
+        return False
+    try:
+        rc = subprocess.run(
+            ["unshare", "--pid", "--fork", "true"],
+            capture_output=True, timeout=5,
+        ).returncode
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return rc == 0
+
+
+def _hostname_for(pod_name: str) -> str:
+    safe = re.sub(r"[^a-zA-Z0-9.-]", "-", pod_name or "pod")[:63]
+    return safe or "pod"
+
+
+class ImageStore:
+    """On-disk image storage: <root>/<digest>/{manifest.json,layer.bin}.
+
+    "Pulling" materializes a layer blob whose size is a deterministic
+    function of the image name (64KiB-1MiB) — real bytes on the
+    kubelet's disk, so disk accounting and image GC are exercised for
+    real, without a registry (this box has zero egress; the reference's
+    pull path is pkg/kubelet/dockertools/docker.go)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, image: str) -> str:
+        return os.path.join(self.root, hashlib.sha1(image.encode()).hexdigest()[:16])
+
+    def pull(self, image: str) -> None:
+        """Idempotent; refreshes last-used on every call (containers
+        starting FROM an image count as using it, image_manager.go
+        detectImages)."""
+        d = self._dir(image)
+        manifest = os.path.join(d, "manifest.json")
+        if not os.path.exists(manifest):
+            os.makedirs(d, exist_ok=True)
+            h = int(hashlib.sha1(image.encode()).hexdigest(), 16)
+            size = 65536 + (h % 16) * 65536  # 64KiB..1MiB
+            with open(os.path.join(d, "layer.bin"), "wb") as f:
+                f.write(b"\0" * size)
+            with open(manifest, "w") as f:
+                json.dump({"image": image, "bytes": size}, f)
+        self.touch(image)
+
+    def touch(self, image: str) -> None:
+        try:
+            os.utime(os.path.join(self._dir(image), "manifest.json"))
+        except OSError:
+            pass
+
+    def list_images(self) -> List[dict]:
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return out
+        for e in entries:
+            manifest = os.path.join(self.root, e, "manifest.json")
+            try:
+                with open(manifest) as f:
+                    rec = json.load(f)
+                rec["lastUsed"] = os.stat(manifest).st_mtime
+                out.append(rec)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def remove(self, image: str) -> int:
+        """Returns bytes freed."""
+        d = self._dir(image)
+        freed = 0
+        try:
+            for name in os.listdir(d):
+                try:
+                    freed += os.path.getsize(os.path.join(d, name))
+                except OSError:
+                    pass
+        except OSError:
+            return 0
+        shutil.rmtree(d, ignore_errors=True)
+        return freed
+
+    def bytes_used(self) -> int:
+        return sum(rec.get("bytes", 0) for rec in self.list_images())
+
+
+class SandboxRuntime(ProcessRuntime):
+    """Namespace-isolated pods rooted at `root_dir`."""
+
+    def __init__(self, root_dir: str, node_name: str = ""):
+        super().__init__(root_dir, node_name=node_name)
+        self.images = ImageStore(os.path.join(root_dir, "images"))
+        # unshare-wrapper pid -> inner (ns PID 1) pid, host view.
+        self._inner_pids: Dict[int, int] = {}
+        # pod uid -> pod name, for the UTS hostname (set by sync_pod
+        # before the anchor starts).
+        self._pod_names: Dict[str, str] = {}
+        # Adopted containers (kubelet restart) were spawned inside
+        # their pod's namespaces iff that pod's anchor is still alive.
+        for uid, containers in self._pods.items():
+            anchor = self._anchors.get(uid)
+            if anchor is not None and anchor.poll() is None:
+                for proc in containers.values():
+                    proc.sandboxed = True
+
+    # -- namespace plumbing -------------------------------------------
+
+    def _inner_pid(self, anchor: _Proc, timeout: float = 2.0) -> Optional[int]:
+        """Host-view pid of the pod's ns PID 1 (the pause under the
+        `unshare --fork` wrapper). Polled: the child appears a beat
+        after the wrapper starts."""
+        cached = self._inner_pids.get(anchor.pid)
+        if cached is not None:
+            try:
+                os.kill(cached, 0)
+                return cached
+            except OSError:
+                self._inner_pids.pop(anchor.pid, None)
+        deadline = time.monotonic() + timeout
+        path = f"/proc/{anchor.pid}/task/{anchor.pid}/children"
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    kids = f.read().split()
+            except OSError:
+                return None  # wrapper gone
+            if kids:
+                pid = int(kids[0])
+                self._inner_pids[anchor.pid] = pid
+                return pid
+            time.sleep(0.01)
+        return None
+
+    def _nsenter_argv(self, uid: str) -> List[str]:
+        """['nsenter', '-t', <pid>, ...] or [] if the pod has no live
+        sandbox (fall back to plain host process — degraded, visible
+        via container_id prefix)."""
+        anchor = self._anchors.get(uid)
+        if anchor is None or anchor.poll() is not None:
+            return []
+        inner = self._inner_pid(anchor)
+        if inner is None:
+            return []
+        return ["nsenter", "-t", str(inner), "--pid", "--mount", "--uts"]
+
+    # -- ProcessRuntime overrides -------------------------------------
+
+    def _start_anchor(self, uid: str) -> None:  # noqa: D102
+        if uid in self._anchors and self._anchors[uid].poll() is None:
+            return
+        pause = self._pause_path()
+        if pause is None:
+            import sys
+
+            inner = f"exec {sys.executable} -c 'import signal;signal.pause()'"
+        else:
+            inner = f"exec {pause}"
+        log = os.path.join(self._pod_dir(uid), "_pause.log")
+        os.makedirs(self._pod_dir(uid), exist_ok=True)
+        hostname = _hostname_for(self._pod_names.get(uid, uid))
+        argv = [
+            "unshare", "--pid", "--fork", "--kill-child",
+            "--mount", "--mount-proc", "--uts",
+            "sh", "-c", f"hostname {hostname}; {inner}",
+        ]
+        with open(log, "ab") as lf:
+            popen = subprocess.Popen(
+                argv, stdout=lf, stderr=lf, start_new_session=True
+            )
+        proc = _Proc(
+            pid=popen.pid,
+            popen=popen,
+            spec_hash="anchor",
+            name="_pause",
+            image="pause",
+            log_path=log,
+            started_at=time.monotonic(),
+        )
+        self._anchors[uid] = proc
+        self._record(uid, proc)
+
+    def sync_pod(self, pod: Pod) -> List:
+        uid = pod.metadata.uid or pod.metadata.name
+        self._pod_names[uid] = pod.metadata.name
+        return super().sync_pod(pod)
+
+    def _start_container(self, pod: Pod, uid: str, spec, restart_count: int) -> _Proc:
+        if spec.image:
+            self.images.pull(spec.image)
+        ns = self._nsenter_argv(uid)
+        if not ns:
+            return super()._start_container(pod, uid, spec, restart_count)
+        # Same spawn as the parent, wrapped in the pod's namespaces.
+        log = os.path.join(self._pod_dir(uid), f"{spec.name}.log")
+        argv = ns + self._container_argv(spec)
+        with open(log, "ab") as lf:
+            try:
+                popen = subprocess.Popen(
+                    argv,
+                    stdout=lf,
+                    stderr=lf,
+                    env=self._env_for(pod, spec),
+                    cwd=spec.working_dir or None,
+                    start_new_session=True,
+                    **self._run_as(spec),
+                )
+            except OSError as e:
+                lf.write(f"start error: {e}\n".encode())
+                return _Proc(
+                    pid=0, popen=None, spec_hash=_spec_hash(spec),
+                    name=spec.name, image=spec.image, log_path=log,
+                    restart_count=restart_count,
+                    started_at=time.monotonic(), exit_code=127,
+                )
+        proc = _Proc(
+            pid=popen.pid,
+            popen=popen,
+            spec_hash=_spec_hash(spec),
+            name=spec.name,
+            image=spec.image,
+            log_path=log,
+            restart_count=restart_count,
+            started_at=time.monotonic(),
+        )
+        proc.sandboxed = True  # spawned through the pod's namespaces
+        self._record(uid, proc)
+        return proc
+
+    def _to_rc(self, proc: _Proc):
+        """sandbox:// ONLY for containers that actually entered the
+        pod's namespaces — a degraded fallback spawn (dead anchor)
+        keeps proc://, so the missing isolation stays visible."""
+        rc = super()._to_rc(proc)
+        if getattr(proc, "sandboxed", False) and rc.container_id.startswith(
+            "proc://"
+        ):
+            rc.container_id = "sandbox://" + rc.container_id[len("proc://"):]
+        return rc
+
+    def exec_in_container(
+        self,
+        pod_uid: str,
+        container: str,
+        command: List[str],
+        pod: Optional[Pod] = None,
+        timeout: float = 10.0,
+    ) -> Tuple[int, str]:
+        """Exec INSIDE the pod's namespaces (the reference execs inside
+        the container's namespaces via docker exec / nsenter —
+        pkg/kubelet/server.go /exec)."""
+        ns = self._nsenter_argv(pod_uid)
+        return super().exec_in_container(
+            pod_uid, container, ns + list(command), pod=pod, timeout=timeout
+        )
+
+    def kill_pod(self, pod_uid: str) -> None:
+        with self._lock:
+            anchor = self._anchors.get(pod_uid)
+            if anchor is not None:
+                self._inner_pids.pop(anchor.pid, None)
+            self._pod_names.pop(pod_uid, None)
+        super().kill_pod(pod_uid)
+        # PID-ns teardown: the anchor's death SIGKILLs everything in
+        # the pod's namespace — nothing to sweep.

@@ -453,33 +453,74 @@ class SnapshotBuilder:
         stop = len(self.pending) if stop is None else stop
         chunk = self.pending[start:stop]
         P = len(chunk)
-        cpu_req = np.zeros(P, dtype=np.float32)
-        mem_req = np.zeros(P, dtype=np.float32)
-        zero_req = np.zeros(P, dtype=bool)
+        # This loop IS the serial "lower" phase of the pipelined solve
+        # (the only host work on the 50k-backlog critical path), so the
+        # extraction helpers (pod_resource_limits / pod_host_ports /
+        # pod_volumes — the single-pod API, kept for tests and scalar
+        # callers) are inlined here with locals bound outside the loop:
+        # per-pod function-call + per-element ndarray-store overhead was
+        # ~40% of the phase at 50k pods.
+        cpu_list: List[float] = []
+        mem_list: List[int] = []
+        zero_list: List[bool] = []
         pinned = np.full(P, -1, dtype=np.int32)
         service_id = np.full(P, -1, dtype=np.int32)
         svc_topk = np.full((P, SVC_K), -1, dtype=np.int32)
         port_id_lists: List[List[int]] = []
         vol_any_lists: List[List[int]] = []
         vol_rw_lists: List[List[int]] = []
+        port_vocab_id = self.port_vocab.id
+        vol_vocab_id = self.vol_vocab.id
+        node_index_get = self.node_index.get
+        membership_ids = self.matcher.membership_ids
+        cpu_key, mem_key = RESOURCE_CPU, RESOURCE_MEMORY
         for i, p in enumerate(chunk):
-            cpu, mem = pod_resource_limits(p)
-            cpu_req[i] = cpu
-            mem_req[i] = mem_to_mib_ceil(mem)
-            zero_req[i] = cpu == 0 and mem == 0
-            port_id_lists.append(
-                [self.port_vocab.id(str(x)) for x in pod_host_ports(p)]
-            )
-            vols = pod_volumes(p)
-            vol_any_lists.append([self.vol_vocab.id(v) for v, _ in vols])
-            vol_rw_lists.append([self.vol_vocab.id(v) for v, rw in vols if rw])
-            if p.spec.node_name:
-                pinned[i] = self.node_index.get(p.spec.node_name, -2)
-            ids, first = self.matcher.membership_ids(p)
+            spec = p.spec
+            cpu = 0
+            mem = 0
+            port_ids: List[int] = []
+            for c in spec.containers:
+                lim = c.resources.limits
+                q = lim.get(cpu_key)
+                if q is not None:
+                    cpu += q.milli_value()
+                q = lim.get(mem_key)
+                if q is not None:
+                    mem += q.value()
+                for cp in c.ports:
+                    hp = cp.host_port
+                    if hp > 0:
+                        port_ids.append(port_vocab_id(str(hp)))
+            cpu_list.append(cpu)
+            mem_list.append(-((-mem) // MIB))  # mem_to_mib_ceil
+            zero_list.append(cpu == 0 and mem == 0)
+            port_id_lists.append(port_ids)
+            vol_any: List[int] = []
+            vol_rw: List[int] = []
+            for v in spec.volumes:
+                pd = v.gce_persistent_disk
+                if pd is not None and pd.pd_name:
+                    vid = vol_vocab_id("gce-pd:" + pd.pd_name)
+                    vol_any.append(vid)
+                    if not pd.read_only:
+                        vol_rw.append(vid)
+                ebs = v.aws_elastic_block_store
+                if ebs is not None and ebs.volume_id:
+                    vid = vol_vocab_id("aws-ebs:" + ebs.volume_id)
+                    vol_any.append(vid)
+                    vol_rw.append(vid)
+            vol_any_lists.append(vol_any)
+            vol_rw_lists.append(vol_rw)
+            if spec.node_name:
+                pinned[i] = node_index_get(spec.node_name, -2)
+            ids, first = membership_ids(p)
             if len(ids):
                 k = min(len(ids), SVC_K)
                 svc_topk[i, :k] = ids[:k]
-            service_id[i] = first
+                service_id[i] = first
+        cpu_req = np.asarray(cpu_list, dtype=np.float32)
+        mem_req = np.asarray(mem_list, dtype=np.float32)
+        zero_req = np.asarray(zero_list, dtype=bool)
         aff_pin = None
         if self.spec is not None and self.spec.affinity_labels:
             # ServiceAffinity: per affinity label, the pod's pinned
