@@ -99,6 +99,11 @@ def solve_backlog_pipelined(
         # backlogs and tails don't scan thousands of padding steps.
         dpods = device_pods(cols, pod_sharding)
         assignment, carry = step(dpods, carry)
+        # Start this chunk's device->host copy NOW: it rides behind the
+        # next chunk's device work instead of serializing at the end
+        # (the final np.asarray then finds the bytes already local).
+        if hasattr(assignment, "copy_to_host_async"):
+            assignment.copy_to_host_async()
         outs.append((assignment, cols.count))
 
     names = [n.metadata.name for n in builder.nodes]

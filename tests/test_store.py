@@ -167,3 +167,90 @@ def test_slow_consumer_stream_closed():
     seen = list(w)
     assert len(seen) <= 3
     assert w.closed
+
+
+class TestFilteredWatch:
+    """Store-level selector filtering with etcd's old/new-aware
+    translation (kvstore._filter_event; reference:
+    pkg/tools/etcd_helper_watch.go sendModify/sendDelete). The filter
+    runs INSIDE the fan-out, so a watcher is never even offered events
+    for objects that don't concern it."""
+
+    @staticmethod
+    def drain(w, n, timeout=2.0):
+        out = []
+        deadline = time.time() + timeout
+        while len(out) < n and time.time() < deadline:
+            ev = w.next(timeout=0.1)
+            if ev is not None:
+                out.append(ev)
+        return out
+
+    @staticmethod
+    def unassigned_pred(o):
+        return not o.get("spec", {}).get("nodeName")
+
+    def test_modified_out_of_filter_becomes_deleted(self):
+        # The scheduler's spec.nodeName=="" watch: binding a pod must
+        # surface as DELETED (it left the view), not MODIFIED.
+        s = KVStore()
+        w = s.watch("/pods/", pred=self.unassigned_pred)
+        s.create("/pods/a", obj("a", spec={}))
+        s.set("/pods/a", obj("a", spec={"nodeName": "n1"}))
+        evs = self.drain(w, 2)
+        assert [e.type for e in evs] == [ADDED, DELETED]
+
+    def test_never_matching_object_is_silent(self):
+        # A pod born bound: the unassigned watcher sees NOTHING for its
+        # whole lifecycle — old/new awareness suppresses the spurious
+        # DELETED per status write that the pre-store filter emitted.
+        s = KVStore()
+        w = s.watch("/pods/", pred=self.unassigned_pred)
+        s.create("/pods/b", obj("b", spec={"nodeName": "n1"}))
+        s.set("/pods/b", obj("b", spec={"nodeName": "n1"}, status={"p": 1}))
+        s.set("/pods/b", obj("b", spec={"nodeName": "n1"}, status={"p": 2}))
+        s.delete("/pods/b")
+        s.create("/pods/c", obj("c", spec={}))  # sentinel that DOES match
+        evs = self.drain(w, 1)
+        assert len(evs) == 1 and evs[0].key == "default/c"
+
+    def test_delete_of_matching_object_delivered(self):
+        s = KVStore()
+        w = s.watch("/pods/", pred=self.unassigned_pred)
+        s.create("/pods/d", obj("d", spec={}))
+        s.delete("/pods/d")
+        evs = self.drain(w, 2)
+        assert [e.type for e in evs] == [ADDED, DELETED]
+
+    def test_modified_within_filter_stays_modified(self):
+        s = KVStore()
+        w = s.watch("/pods/", pred=self.unassigned_pred)
+        s.create("/pods/e", obj("e", spec={}))
+        s.set("/pods/e", obj("e", spec={}, status={"phase": "Pending"}))
+        evs = self.drain(w, 2)
+        assert [e.type for e in evs] == [ADDED, MODIFIED]
+
+    def test_replay_degrades_to_spurious_deleted(self):
+        # History has no prev state: a replayed non-matching MODIFIED
+        # becomes a (harmless) DELETED instead of being dropped.
+        s = KVStore()
+        s.create("/pods/f", obj("f", spec={"nodeName": "n1"}))
+        v = s.version
+        s.set("/pods/f", obj("f", spec={"nodeName": "n1"}, status={"x": 1}))
+        w = s.watch("/pods/", since=v, pred=self.unassigned_pred)
+        evs = self.drain(w, 1)
+        assert [e.type for e in evs] == [DELETED]
+
+    def test_replay_then_live_no_duplicates_no_gaps(self):
+        # The version floor: replay covers <= registration version;
+        # the dispatcher's backlog must not re-deliver, later writes
+        # must all arrive.
+        s = KVStore()
+        s.create("/pods/base", obj("base", spec={"nodeName": "n0"}))
+        v0 = s.version
+        s.create("/pods/g", obj("g", spec={}))
+        w = s.watch("/pods/", since=v0, pred=self.unassigned_pred)
+        s.create("/pods/h", obj("h", spec={}))
+        evs = self.drain(w, 2)
+        assert sorted(e.key for e in evs) == ["default/g", "default/h"]
+        assert len({e.version for e in evs}) == 2
