@@ -499,7 +499,11 @@ def main() -> None:
     parity = _parity_figures()
     best = min(times)
     best_fast = min(fast_times)
-    headline = n_pods / (best_fast if gate_ok else best)
+    # The parity scan is ALWAYS quality-eligible (it IS the oracle
+    # semantics); the approximate fast path must both pass its regret
+    # gate and actually be faster to carry the headline. With the
+    # pallas scan kernel the exact path usually wins outright.
+    headline = n_pods / (best_fast if (gate_ok and best_fast < best) else best)
     record = {
         "metric": f"pods_scheduled_per_sec_{n_pods//1000}kx{n_nodes}",
         "value": round(headline, 1),
@@ -512,6 +516,7 @@ def main() -> None:
         "fast_mean_regret_10kx1k": round(fast_q["mean_regret"], 3),
         "fast_p99_regret_10kx1k": round(fast_q["p99_regret"], 1),
         "fast_quality_gate": "pass" if gate_ok else "FAIL (headline=scan)",
+        "headline_path": "fast" if (gate_ok and best_fast < best) else "scan",
         "wall_s": [round(t, 3) for t in times],
         "phases_serial_s": phases,
         "placed": placed,
