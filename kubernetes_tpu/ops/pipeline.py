@@ -35,13 +35,15 @@ from kubernetes_tpu.ops.matrices import (
 )
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
 
-DEFAULT_CHUNK = 8192
-
-# Chunk-size note (measured on v5e-1, 50k x 5k, wave mode): a
-# progressive ramp (small first chunk to shrink the critical-path
-# lowering, big chunks after) was tried and LOST — every wave-mode
-# chunk boundary costs extra partial waves (~0.1s each), more than the
-# first-lower saving. Fixed 25088 (2 chunks) stays the sweet spot.
+# Measured on v5e-1 at 50k x 5k with the pallas scan kernel: 12544
+# (4 chunks) walls 0.61-0.66s vs 0.88-0.96s at 8192 and 0.71-0.76s at
+# 25088 — scan chunk boundaries are free (bit-identical carry
+# chaining), so the trade is purely per-chunk dispatch overhead vs
+# critical-path first-chunk lowering. Wave mode keeps its own sweet
+# spot (25088, set by bench.py): its boundaries DO cost partial waves,
+# which is also why a progressive small-first-chunk ramp was tried and
+# LOST for wave.
+DEFAULT_CHUNK = 12544
 
 
 def solve_backlog_pipelined(
