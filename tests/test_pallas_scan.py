@@ -170,3 +170,20 @@ class TestServiceAxisPadding:
         assert _vmem_bytes(3072, 1536, 2, 2, 2) > VMEM_BUDGET_BYTES
         # The bench's 50k x 5k shape (N=5120, S=512) must be admitted.
         assert _vmem_bytes(5120, 512, 2, 2, 2) <= VMEM_BUDGET_BYTES
+
+
+def test_multiword_bitsets_match_xla():
+    """Port vocabularies past 64 entries need 3+ u32 words — the
+    kernel's static per-word loops must agree with the XLA scan across
+    the word boundary (each pod claims a distinct hostPort; a second
+    same-port pod must avoid the first's node)."""
+    from tests.test_solver_parity import mk_node, mk_pod
+
+    nodes = [mk_node(f"n{j}", pods=200) for j in range(4)]
+    pods = []
+    for i in range(70):  # 70 distinct ports -> 3 words, bucketed to 4
+        pods.append(mk_pod(f"p{i}", cpu=10, mem_mib=8, host_port=7000 + i))
+    for i in range(8):  # conflicts: same ports again
+        pods.append(mk_pod(f"q{i}", cpu=10, mem_mib=8, host_port=7000 + i))
+    ref, _, got, _ = _both(pods, nodes)
+    assert (ref == got).all()
