@@ -89,6 +89,8 @@ def pallas_eligible(pods: Dict, nodes: Dict, lspec) -> bool:
         return False
     N = nodes["cpu_cap"].shape[0]
     S = nodes["svc_counts"].shape[1]
+    if N > 8192:
+        return False  # the packed (score, 8191-idx) select needs N <= 8192
     return (
         _vmem_bytes(
             N,
@@ -245,10 +247,17 @@ def _kernel(
             total = total + spread * w_spread
 
         # -- select: first max by lowest index (generic.select_host) --
-        masked = jnp.where(ok, total, -1)
-        m = jnp.max(masked)
-        idx = jnp.min(jnp.where(masked == m, iota, N)).astype(jnp.int32)
-        choice = jnp.where(m >= 0, idx, jnp.int32(-1))
+        # One reduction instead of three (max, tie-break min-index,
+        # feasibility test): pack (score, inverted index) into one i32.
+        # Among equal scores the larger 8191-idx — i.e. the LOWEST
+        # index — wins, exactly the scalar oracle's tie-break. Scores
+        # are bounded (<= 30 on the default spec) and N <= 8192 is an
+        # eligibility requirement, so the pack cannot overflow or
+        # collide. Infeasible nodes encode as -1, strictly below every
+        # feasible encoding (score >= 0 => enc >= 8191 - idx >= 0).
+        enc = jnp.where(ok, total * 8192 + (8191 - iota), -1)
+        m = jnp.max(enc)
+        choice = jnp.where(m >= 0, 8191 - (m & 8191), jnp.int32(-1))
 
         # -- commit (ops/solver.py _commit) ----------------------------
         assigned = choice >= 0
