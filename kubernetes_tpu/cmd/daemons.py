@@ -269,10 +269,11 @@ def scheduler_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--batch-mode", default="scan", choices=["scan", "wave", "sinkhorn"],
-        help="scan = sequential-parity solver; sinkhorn = congestion-"
-             "priced assignment waves (fastest, approximate parity); "
-             "wave = wave-commit "
-        "solver (~3x throughput, approximate decision-order parity)",
+        help="scan = sequential-parity solver (default; with the "
+        "pallas kernel also the fastest backlog mode on one TPU); "
+        "wave = wave-commit solver (approximate decision-order "
+        "parity; best sustained-churn throughput); sinkhorn = "
+        "congestion-priced assignment waves (fewest device steps)",
     )
     p.add_argument(
         "--solver-sidecar", default="",

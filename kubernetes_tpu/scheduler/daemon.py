@@ -361,11 +361,13 @@ class BatchScheduler(Scheduler):
         super().__init__(config)
         self.max_batch = max_batch
         self.batch_window = batch_window
-        # "scan" = sequential-parity solver (the >=99%-parity default);
-        # "wave" = wave-commit solver (~3x throughput, valid placements,
-        # approximate decision-order parity — ops/wave.py);
-        # "sinkhorn" = Sinkhorn-matched waves (congestion-priced
-        # assignment, fewest device steps — ops/sinkhorn.py).
+        # "scan" = sequential-parity solver — the default AND, with the
+        # pallas kernel (ops/pallas_scan.py), the fastest backlog mode
+        # on a single TPU; "wave" = wave-commit solver (valid
+        # placements, approximate decision-order parity — ops/wave.py;
+        # still the best sustained-churn mode); "sinkhorn" =
+        # Sinkhorn-matched waves (congestion-priced assignment, fewest
+        # device steps — ops/sinkhorn.py).
         if mode not in ("scan", "wave", "sinkhorn"):
             raise ValueError(f"unknown batch mode {mode!r}")
         self.mode = mode
