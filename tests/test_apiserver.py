@@ -517,3 +517,48 @@ class TestStaleKeepAliveReplay:
             assert len(posts) == 1
         finally:
             stop()
+
+
+def test_serialized_transport_one_connection_many_threads():
+    """HTTPTransport(serialize=True): one shared keep-alive connection,
+    requests serialized behind a lock — the kubelet's transport shape
+    at 100-node scale (one connection per daemon, not per thread)."""
+    import threading
+
+    from kubernetes_tpu.client import Client, HTTPTransport
+
+    api = APIServer()
+    server = APIHTTPServer(api).start()
+    try:
+        t = HTTPTransport(server.address, serialize=True)
+        client = Client(t)
+        client.create(
+            "pods",
+            {
+                "kind": "Pod",
+                "metadata": {"name": "ser-p", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]},
+            },
+        )
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(10):
+                    client.get("pods", "ser-p", namespace="default")
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        conn_before = t._shared_conn
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=10)
+        assert not errors, errors
+        # Same connection object throughout: per-thread conns would
+        # populate thread-locals instead, and a reconnect would rebind.
+        assert t._shared_conn is conn_before
+        assert getattr(t._local, "conn", None) is None
+    finally:
+        server.stop()
