@@ -59,17 +59,22 @@ def _proc_rss(pid: str) -> int:
 
 
 class _SyncPool:
-    """Per-pod serialized sync over a SMALL shared worker pool.
+    """Per-pod serialized sync over a small ELASTIC worker pool.
 
     The reference dedicates a goroutine per pod (pod_workers.go:91-123);
     goroutines are cheap, Python threads are not — spawning one per pod
     update was measurably expensive at 100 kubelets x 30 pods. The pool
     keeps the same contract: syncs for one pod never overlap (a pod is
     'running' while synced; updates arriving meanwhile coalesce into one
-    re-run with the latest spec), different pods sync concurrently up to
-    the worker count."""
+    re-run with the latest spec), different pods sync concurrently.
 
-    def __init__(self, sync_fn, workers: int = 2):
+    Elasticity is the reference's isolation property on a budget: when
+    every worker is busy (a slow volume mount, a wedged probe) and more
+    work queues, transient workers spawn up to `max_workers`, then
+    retire after a few idle seconds — so two stuck pods can't starve
+    the other 28 on the node, without carrying a thread per pod."""
+
+    def __init__(self, sync_fn, workers: int = 2, max_workers: int = 16):
         import queue
 
         self._sync = sync_fn
@@ -77,28 +82,64 @@ class _SyncPool:
         self._lock = threading.Lock()
         self._pending: Dict[str, Pod] = {}  # key -> latest un-synced spec
         self._running: set = set()  # keys currently inside sync_fn
-        self._threads = []
+        self._max = max_workers
+        self._nworkers = 0
+        self._idle = 0
+        self._stopping = False
         for _ in range(workers):
-            t = threading.Thread(target=self._worker, daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._spawn(transient=False)
+
+    def _spawn(self, transient: bool) -> None:
+        # caller holds self._lock (or init, pre-concurrency)
+        self._nworkers += 1
+        threading.Thread(
+            target=self._worker, args=(transient,), daemon=True
+        ).start()
 
     def update(self, key: str, pod: Pod) -> None:
         with self._lock:
+            if self._stopping:
+                return
             queued = key in self._pending
             self._pending[key] = pod
             if queued or key in self._running:
                 return  # will be picked up by the queued entry / re-run
-        self._q.put(key)
+            if self._idle == 0 and self._nworkers < self._max:
+                self._spawn(transient=True)
+            # Enqueue UNDER the lock: a timing-out transient worker's
+            # retire path checks queue emptiness under this same lock,
+            # so a key can never land unseen between its last check and
+            # its exit (which would strand the pod until some other
+            # pod's update spawned a worker).
+            self._q.put(key)
 
     def forget(self, key: str) -> None:
         with self._lock:
             self._pending.pop(key, None)
 
-    def _worker(self) -> None:
+    def _worker(self, transient: bool) -> None:
+        import queue
+
         while True:
-            key = self._q.get()
+            with self._lock:
+                self._idle += 1
+            try:
+                key = self._q.get(timeout=5.0 if transient else None)
+            except queue.Empty:
+                # Idle timeout: retire — unless work raced in (update()
+                # enqueues under the same lock, so this check is
+                # ordered against every put).
+                with self._lock:
+                    self._idle -= 1
+                    if not self._q.empty():
+                        continue
+                    self._nworkers -= 1
+                return
+            with self._lock:
+                self._idle -= 1
             if key is None:
+                with self._lock:
+                    self._nworkers -= 1
                 return
             with self._lock:
                 pod = self._pending.pop(key, None)
@@ -118,7 +159,10 @@ class _SyncPool:
                     self._q.put(key)
 
     def stop(self) -> None:
-        for _ in self._threads:
+        with self._lock:
+            self._stopping = True
+            n = self._nworkers
+        for _ in range(n):
             self._q.put(None)
 
 

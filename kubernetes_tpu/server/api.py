@@ -220,7 +220,11 @@ _FIELD_MERGE_KEYS: Dict[str, Tuple[str, ...]] = {
     # Container ports merge by containerPort; Service ports (same
     # field name, no containerPort on the elements) by port.
     "ports": ("containerPort", "port"),
-    "addresses": ("ip",),
+    # Two element shapes share this field name: Endpoints subset
+    # addresses (keyed by ip, pkg/api/types.go EndpointAddress) and
+    # NodeStatus addresses (keyed by type, NodeAddress has no ip
+    # field) — candidates in struct-tag order, first present wins.
+    "addresses": ("ip", "type"),
     "conditions": ("type",),
     "secrets": ("name",),
 }

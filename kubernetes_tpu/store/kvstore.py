@@ -311,16 +311,39 @@ class KVStore:
         if not self._fsync or seq == 0:
             return
         with self._sync_lock:
-            if self._synced_seq >= seq:
-                return  # a peer's fsync (or a snapshot) covered us
-            with self._lock:
-                wal = self._wal_file
-                flushed = self._wal_seq
-            if wal is None:
-                return  # closed underneath us; writes were refused
-            os.fsync(wal.fileno())
-            if flushed > self._synced_seq:
-                self._synced_seq = flushed
+            while True:
+                if self._synced_seq >= seq:
+                    return  # a peer's fsync / snapshot / close covered us
+                with self._lock:
+                    wal = self._wal_file
+                    flushed = self._wal_seq
+                if wal is None:
+                    # Closed underneath us. close() fsyncs the WAL and
+                    # advances _synced_seq BEFORE dropping the handle —
+                    # but the loop-top check may predate close(), so
+                    # re-check before refusing: only a close whose
+                    # fsync FAILED leaves _synced_seq behind seq.
+                    if self._synced_seq >= seq:
+                        return
+                    raise StoreError(
+                        "store closed before this write became durable"
+                    )
+                try:
+                    os.fsync(wal.fileno())
+                except (ValueError, OSError):
+                    with self._lock:
+                        rotated = wal is not self._wal_file
+                    if not rotated:
+                        raise  # real I/O failure on the live handle
+                    # A concurrent _snapshot_locked rotated the handle
+                    # between capture and fsync. The snapshot fsync'd
+                    # everything appended before it and advanced
+                    # _synced_seq — loop and re-check instead of
+                    # surfacing a bogus failure for a durable write.
+                    continue
+                if flushed > self._synced_seq:
+                    self._synced_seq = flushed
+                return
 
     def _snapshot_locked(self) -> None:
         """Write the full state atomically, then truncate the WAL.
@@ -662,6 +685,19 @@ class KVStore:
             self._watchers = []
             self._dispatch_q.put(None)  # retire the dispatcher thread
             if self._wal_file is not None:
+                # fsync-before-close: a writer that appended its record
+                # but hasn't reached _wal_sync yet must still find its
+                # bytes durable (its wal-is-None path checks
+                # _synced_seq). Without this, a write racing close()
+                # would be acked flushed-but-not-fsync'd — exactly what
+                # fsync-by-default promises can't happen.
+                if self._fsync:
+                    try:
+                        self._wal_file.flush()
+                        os.fsync(self._wal_file.fileno())
+                        self._synced_seq = self._wal_seq
+                    except OSError:
+                        pass  # racing writers will refuse their acks
                 self._wal_file.close()
                 self._wal_file = None
             if self._lockfd is not None:

