@@ -185,3 +185,43 @@ class TestOOMWatcher:
             name="side", image="x", container_id="q", state="running"
         )
         assert watcher.observe(self._pod(), [ok, running]) == 0
+
+
+def test_sync_pool_elastic_survives_wedged_workers():
+    """Round-4 review regression: two wedged syncs must not starve the
+    node's other pods — transient workers spawn when all are busy and
+    retire when idle (the reference's per-pod-worker isolation on a
+    thread budget, pod_workers.go:91-123)."""
+    import threading
+    import time
+
+    from kubernetes_tpu.kubelet.agent import _SyncPool
+
+    unblock = threading.Event()
+    synced = []
+
+    def sync_fn(pod):
+        if pod == "wedge":
+            unblock.wait(timeout=10)
+        else:
+            synced.append(pod)
+
+    pool = _SyncPool(sync_fn, workers=2, max_workers=8)
+    try:
+        pool.update("a", "wedge")
+        pool.update("b", "wedge")
+        time.sleep(0.3)  # both base workers now wedged
+        pool.update("c", "ok")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "ok" not in synced:
+            time.sleep(0.02)
+        assert "ok" in synced, "third pod starved behind wedged workers"
+        # Transient workers retire once idle (bounded thread growth).
+        unblock.set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and pool._nworkers > 2:
+            time.sleep(0.1)
+        assert pool._nworkers <= 2
+    finally:
+        unblock.set()
+        pool.stop()
