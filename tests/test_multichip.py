@@ -137,3 +137,25 @@ class TestShardedParityAtScale:
         single, _ = wave_assignments(device_snapshot(big_snap))
         a1 = np.where(a1 >= dsnap.n_nodes, -1, a1)
         np.testing.assert_array_equal(single, a1)
+
+    def test_sinkhorn_deterministic_and_matches_single_at_scale(self, big_snap):
+        """Sinkhorn at the same realistic sharded shape as scan/wave
+        (closing the last toy-shape-only mode): deterministic across
+        runs and identical to the single-device solve."""
+        from kubernetes_tpu.ops.sinkhorn import (
+            sinkhorn_assignments,
+            solve_sinkhorn,
+        )
+
+        mesh = _mesh(8)
+        dsnap = device_snapshot(big_snap, mesh=mesh, pad_to=8)
+        with mesh:
+            out1, _ = solve_sinkhorn(dsnap.pods, dsnap.nodes)
+            out1.block_until_ready()
+            out2, _ = solve_sinkhorn(dsnap.pods, dsnap.nodes)
+            out2.block_until_ready()
+        a1 = np.asarray(out1)[: dsnap.n_pods]
+        np.testing.assert_array_equal(a1, np.asarray(out2)[: dsnap.n_pods])
+        single, _ = sinkhorn_assignments(device_snapshot(big_snap))
+        a1 = np.where(a1 >= dsnap.n_nodes, -1, a1)
+        np.testing.assert_array_equal(single, a1)
