@@ -142,9 +142,17 @@ class _SyncPool:
                     self._nworkers -= 1
                 return
             with self._lock:
-                pod = self._pending.pop(key, None)
-                if pod is not None:
-                    self._running.add(key)
+                if key in self._running:
+                    # Owned by another worker (duplicate token: forget()
+                    # dropped the pending entry, then update() re-enqueued
+                    # the same key). Leave _pending intact — the owner's
+                    # finally-path sees it and requeues, preserving the
+                    # 'syncs for one pod never overlap' contract.
+                    pod = None
+                else:
+                    pod = self._pending.pop(key, None)
+                    if pod is not None:
+                        self._running.add(key)
             if pod is None:
                 continue
             try:

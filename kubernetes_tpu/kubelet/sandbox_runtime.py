@@ -124,17 +124,20 @@ class ImageStore:
         return out
 
     def remove(self, image: str) -> int:
-        """Returns bytes freed."""
+        """Returns bytes freed, in the SAME unit bytes_used() counts
+        (the manifest's declared layer bytes) — ImageManager.gc's
+        watermark math subtracts freed from used, so mixing units
+        (declared vs on-disk incl. manifest.json) would drift its
+        low-watermark stop condition."""
         d = self._dir(image)
-        freed = 0
         try:
-            for name in os.listdir(d):
-                try:
-                    freed += os.path.getsize(os.path.join(d, name))
-                except OSError:
-                    pass
-        except OSError:
-            return 0
+            with open(os.path.join(d, "manifest.json")) as f:
+                freed = int(json.load(f).get("bytes", 0))
+        except (OSError, ValueError):
+            # Partially-pulled dir (crash between layer.bin and
+            # manifest.json): invisible to bytes_used(), but still
+            # reclaim the disk.
+            freed = 0
         shutil.rmtree(d, ignore_errors=True)
         return freed
 

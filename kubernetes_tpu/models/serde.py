@@ -119,15 +119,32 @@ def to_wire(obj: Any, *, omit_empty: bool = True) -> Any:
 _decode_plan_cache: Dict[type, Dict[str, tuple]] = {}
 
 
+_SCALAR_HINTS = (str, int, float, bool)
+
+
+def _copy_raw(v: Any) -> Any:
+    """Deep-copy raw (untyped) wire leaves. Any-typed fields (e.g.
+    ContainerStatus.state) would otherwise alias the source dict —
+    and store watch events share ONE object across all watchers
+    (kvstore._dispatch_event), so an aliased leaf mutated by one
+    informer consumer would silently corrupt every other's view."""
+    if isinstance(v, dict):
+        return {k: _copy_raw(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_copy_raw(x) for x in v]
+    return v
+
+
 def _decoder_for(hint: Any):
-    """Build a decoder closure for one type hint (None = identity).
-    Callers handle v=None before invoking."""
+    """Build a decoder closure for one type hint (None = identity,
+    safe only for scalar hints). Callers handle v=None before
+    invoking."""
     origin = get_origin(hint)
     if origin is typing.Union:  # Optional[T] and friends
         args = [a for a in get_args(hint) if a is not type(None)]
         if len(args) == 1:
             return _decoder_for(args[0])
-        return None  # ambiguous union: raw passthrough
+        return _copy_raw  # ambiguous union: defensive copy
     if hint is Quantity:
         return parse_quantity
     if dataclasses.is_dataclass(hint):
@@ -136,18 +153,20 @@ def _decoder_for(hint: Any):
         (elem,) = get_args(hint) or (Any,)
         ed = _decoder_for(elem)
         if ed is None:
-            return list  # fresh container, raw elements
+            return list  # fresh container, scalar elements
         return lambda v, _d=ed: [None if x is None else _d(x) for x in v]
     if origin in (dict, typing.Dict):
         args = get_args(hint)
         elem = args[1] if len(args) == 2 else Any
         vd = _decoder_for(elem)
         if vd is None:
-            return dict  # fresh container, raw values
+            return dict  # fresh container, scalar values
         return lambda v, _d=vd: {
             k: None if x is None else _d(x) for k, x in v.items()
         }
-    return None  # scalars / Any: raw passthrough
+    if hint in _SCALAR_HINTS:
+        return None  # immutable: raw passthrough
+    return _copy_raw  # Any / unknown: never alias the source
 
 
 def _decode_plan(cls: type) -> Dict[str, tuple]:

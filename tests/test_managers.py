@@ -225,3 +225,97 @@ def test_sync_pool_elastic_survives_wedged_workers():
     finally:
         unblock.set()
         pool.stop()
+
+
+def test_sync_pool_never_overlaps_one_pod():
+    """Round-5 advisor regression: forget() (pod deleted) followed by
+    update() (pod recreated) leaves two queue tokens for one key; a
+    worker claiming the second token while the first sync is still
+    running must NOT start a concurrent sync for the same pod."""
+    import threading
+    import time
+
+    from kubernetes_tpu.kubelet.agent import _SyncPool
+
+    release = threading.Event()
+    in_flight = {}
+    overlaps = []
+    lock = threading.Lock()
+
+    def sync_fn(pod):
+        key, slow = pod
+        with lock:
+            if in_flight.get(key):
+                overlaps.append(key)
+            in_flight[key] = True
+        if slow:
+            release.wait(timeout=10)
+        with lock:
+            in_flight[key] = False
+
+    # No workers yet: stage the duplicate-token state deterministically.
+    pool = _SyncPool(sync_fn, workers=0, max_workers=0)
+    try:
+        pool.update("p", ("p", True))  # token 1
+        pool.forget("p")  # pod deleted: pending dropped, token 1 orphaned
+        pool.update("p", ("p", True))  # pod recreated: token 2
+        with pool._lock:
+            pool._spawn(transient=False)  # worker A: claims token 1,
+        time.sleep(0.3)  # ...pops the pending spec, blocks in sync
+        pool.update("p", ("p", False))  # key running -> pending only
+        with pool._lock:
+            pool._spawn(transient=False)  # worker B: claims token 2
+        time.sleep(0.3)  # pre-fix B would now sync "p" concurrently
+        release.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and in_flight.get("p", True):
+            time.sleep(0.02)
+        assert not overlaps, f"concurrent syncs for one pod: {overlaps}"
+        assert in_flight.get("p") is False  # the recreated pod did sync
+    finally:
+        release.set()
+        pool.stop()
+
+
+def test_serde_decode_never_aliases_source_dict():
+    """Round-5 advisor regression: Any-typed leaves (ContainerStatus.
+    state) must be deep-copied at decode — watch events share one
+    object across all watchers, so an aliased leaf mutated by one
+    informer consumer would corrupt every other's view."""
+    from kubernetes_tpu.models.objects import ContainerStatus
+    from kubernetes_tpu.models.serde import from_wire
+
+    wire = {
+        "name": "main",
+        "state": {"running": {"startedAt": "2026-01-01T00:00:00Z"}},
+    }
+    st = from_wire(ContainerStatus, wire)
+    assert st.state == wire["state"]
+    assert st.state is not wire["state"]
+    st.state["running"]["startedAt"] = "mutated"
+    assert wire["state"]["running"]["startedAt"] == "2026-01-01T00:00:00Z"
+
+
+def test_image_gc_units_consistent():
+    """Round-5 advisor regression: remove() must report freed bytes in
+    the same unit bytes_used() counts (manifest-declared), so the GC
+    watermark math `used - freed` tracks the store's own metric."""
+    import tempfile
+
+    from kubernetes_tpu.kubelet.managers import ImageManager
+    from kubernetes_tpu.kubelet.sandbox_runtime import ImageStore
+
+    with tempfile.TemporaryDirectory() as d:
+        store = ImageStore(d)
+        for i in range(6):
+            store.pull(f"img-{i}")
+        used = store.bytes_used()
+        sizes = {rec["image"]: rec["bytes"] for rec in store.list_images()}
+        freed = store.remove("img-0")
+        assert freed == sizes["img-0"]
+        assert store.bytes_used() == used - freed
+        # And the manager's stop condition lands where the store agrees.
+        mgr = ImageManager(store, high_bytes=0, low_bytes=0)
+        total_freed = mgr.gc(in_use=set())
+        assert store.bytes_used() == 0
+        assert total_freed == used - freed
