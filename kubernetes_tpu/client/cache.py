@@ -390,6 +390,7 @@ class Reflector:
         decode: Optional[Callable[[dict], Any]] = None,
         resync_period: float = 0.0,
         on_event: Optional[Callable] = None,
+        decode_deleted: bool = True,
     ):
         self.client = client
         self.resource = resource
@@ -400,6 +401,13 @@ class Reflector:
         self.decode = decode or (lambda o: o)
         self.resync_period = resync_period
         self.on_event = on_event
+        # decode_deleted=False skips the typed decode for DELETED
+        # events and hands the raw wire dict to store.delete/on_event:
+        # deletions only need the KEY (meta_namespace_key reads dicts),
+        # and on high-churn streams the discarded full decode is the
+        # reflector thread's main cost. Opt-in — handlers must accept
+        # wire dicts for deletes.
+        self.decode_deleted = decode_deleted
         self.last_sync_version = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -443,10 +451,25 @@ class Reflector:
             field_selector=self.field_selector,
         )
         objs = [self.decode(o) if isinstance(o, dict) else o for o in items]
+        # Objects that vanished during a watch outage must surface as
+        # DELETED on relist (DeltaFIFO.replace synthesizes Deleted the
+        # same way) — delta subscribers like the incremental scheduler
+        # would otherwise carry phantom state forever.
+        vanished = []
+        if self.on_event is not None and hasattr(self.store, "keys"):
+            key_func = getattr(self.store, "key_func", meta_namespace_key)
+            new_keys = {key_func(o) for o in objs}
+            for k in self.store.keys():
+                if k not in new_keys:
+                    old = self.store.get(k)
+                    if old is not None:
+                        vanished.append(old)
         self.store.replace(objs)
         self.last_sync_version = version
         self._synced.set()
         if self.on_event:
+            for o in vanished:
+                self.on_event(DELETED, o)
             for o in objs:
                 self.on_event(ADDED, o)
 
@@ -477,7 +500,16 @@ class Reflector:
                 continue
             if ev.type == ERROR:
                 return
-            obj = self.decode(ev.object) if isinstance(ev.object, dict) else ev.object
+            if (
+                ev.type == DELETED
+                and not self.decode_deleted
+                and isinstance(ev.object, dict)
+            ):
+                obj = ev.object
+            elif isinstance(ev.object, dict):
+                obj = self.decode(ev.object)
+            else:
+                obj = ev.object
             if ev.version:
                 self.last_sync_version = ev.version
             if ev.type == ADDED:
@@ -505,6 +537,7 @@ class Informer:
         on_add: Optional[Callable] = None,
         on_update: Optional[Callable] = None,
         on_delete: Optional[Callable] = None,
+        decode_deleted: bool = True,
     ):
         self.store = ThreadSafeStore()
         self._on_add = on_add
@@ -519,6 +552,7 @@ class Informer:
             field_selector=field_selector,
             decode=decode,
             on_event=self._handle,
+            decode_deleted=decode_deleted,
         )
 
     def _handle(self, etype: str, obj) -> None:

@@ -419,6 +419,13 @@ class HTTPTransport(Transport):
                 f"/api/v1/namespaces/{namespace or 'default'}/bulkbindings",
                 body=body,
             )
+        if op == "create_events_bulk":
+            (namespace,) = args
+            return self._do(
+                "POST",
+                f"/api/v1/namespaces/{namespace or 'default'}/bulkevents",
+                body=body,
+            )
         if op == "finalize_namespace":
             (name,) = args
             return self._do("PUT", f"/api/v1/namespaces/{name}/finalize", body=body)
@@ -617,6 +624,17 @@ class Client:
         ]
         self._throttle()
         out = self.t.request("POST", "bind_bulk", (namespace,), {"bindings": wire})
+        if isinstance(out, dict):
+            return out.get("results", [])
+        return out
+
+    def create_events_bulk(self, events, namespace: str = "default") -> list:
+        """Write many Events in one request (the broadcaster sink's
+        batched path; each event's own metadata.namespace wins)."""
+        self._throttle()
+        out = self.t.request(
+            "POST", "create_events_bulk", (namespace,), {"items": list(events)}
+        )
         if isinstance(out, dict):
             return out.get("results", [])
         return out

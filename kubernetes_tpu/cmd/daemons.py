@@ -282,6 +282,13 @@ def scheduler_parser() -> argparse.ArgumentParser:
         "plane then never touches the accelerator, and sidecar failure "
         "falls back to the scalar path",
     )
+    p.add_argument(
+        "--batch-incremental", action="store_true",
+        help="keep cluster state device-resident across ticks "
+        "(SolverSession): watch deltas patch node rows, each tick "
+        "uploads only the new pending pods — the sustained-churn mode; "
+        "implies --batch; default policy only",
+    )
     _healthz_flag(p, 10251)
     _leader_flags(p)
     return p
@@ -301,6 +308,7 @@ def start_scheduler(args, client=None):
 
     from kubernetes_tpu.scheduler.daemon import (
         BatchScheduler,
+        IncrementalBatchScheduler,
         Scheduler,
         SchedulerConfig,
     )
@@ -310,15 +318,25 @@ def start_scheduler(args, client=None):
     if args.policy_config_file:
         with open(args.policy_config_file) as f:
             policy = json.load(f)
+    incremental = getattr(args, "batch_incremental", False)
 
     def factory():
         config = SchedulerConfig(
-            client, provider_name=args.algorithm_provider, policy=policy
+            client, provider_name=args.algorithm_provider, policy=policy,
+            raw_scheduled_cache=incremental and not (policy or args.solver_sidecar),
         ).start()
         config.wait_for_sync()
-        # --batch-mode/--solver-sidecar imply --batch: silently dropping
-        # an explicit request onto the scalar per-pod path is a footgun.
-        if args.batch or args.batch_mode != "scan" or args.solver_sidecar:
+        # --batch-mode/--solver-sidecar/--batch-incremental imply
+        # --batch: silently dropping an explicit request onto the
+        # scalar per-pod path is a footgun.
+        if incremental and not (policy or args.solver_sidecar):
+            return IncrementalBatchScheduler(
+                config, mode=args.batch_mode
+            ).start()
+        if (
+            args.batch or args.batch_mode != "scan" or args.solver_sidecar
+            or incremental
+        ):
             return BatchScheduler(
                 config,
                 mode=args.batch_mode,

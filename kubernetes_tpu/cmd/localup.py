@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
         "sequential-parity referee; wave/sinkhorn = high-throughput)",
     )
     p.add_argument(
+        "--batch-incremental", action="store_true",
+        help="device-resident session across scheduler ticks "
+        "(sustained-churn mode); implies --batch-scheduler",
+    )
+    p.add_argument(
         "--no-kube-proxy", dest="kube_proxy", action="store_false",
         default=True, help="skip the in-process kube-proxy",
     )
@@ -63,6 +68,7 @@ class LocalCluster:
         from kubernetes_tpu.controllers import ControllerManager
         from kubernetes_tpu.scheduler.daemon import (
             BatchScheduler,
+            IncrementalBatchScheduler,
             Scheduler,
             SchedulerConfig,
         )
@@ -82,10 +88,14 @@ class LocalCluster:
             # In-process transport: build now. HTTP kubelets are built
             # in start(), once the apiserver's port is known.
             self._build_kubelets(self._client)
-        self.scheduler_config = SchedulerConfig(self._client())
-        if args.batch_scheduler:
+        incremental = getattr(args, "batch_incremental", False)
+        self.scheduler_config = SchedulerConfig(
+            self._client(), raw_scheduled_cache=incremental
+        )
+        if args.batch_scheduler or incremental:
             mode = getattr(args, "batch_mode", "scan")
-            self.scheduler_cls = lambda cfg: BatchScheduler(cfg, mode=mode)
+            cls = IncrementalBatchScheduler if incremental else BatchScheduler
+            self.scheduler_cls = lambda cfg: cls(cfg, mode=mode)
         else:
             self.scheduler_cls = Scheduler
         self.scheduler = None

@@ -333,6 +333,30 @@ class SolverSession:
         self._recompute_node_row(j)  # zeroes the row; sched stays False
         self._dirty.add(j)
 
+    def add_assigned(self, pod: Pod) -> bool:
+        """An already-bound pod appeared from outside this session
+        (bound by another scheduler, a static pod, resync replay):
+        charge its occupancy to its node's row. Greedy-fit replay via
+        the full row recompute — foreign pods may overcommit, which
+        _apply_commit_host (the mirror of a solver commit, which only
+        places fitting pods) cannot express. Idempotent per pod key."""
+        if not pod.spec.node_name:
+            return False
+        lp = self._lower_pod(pod)
+        if lp.key in self._pod_node:
+            return False
+        j = self.node_index.get(pod.spec.node_name)
+        if j is None:
+            return False
+        self._assigned[j].append(lp)
+        self._pod_node[lp.key] = j
+        self._recompute_node_row(j)
+        self._dirty.add(j)
+        return True
+
+    def has_assigned(self, key: str) -> bool:
+        return key in self._pod_node
+
     def delete_assigned(self, key: str) -> bool:
         """A running pod vanished: free its occupancy (one node row)."""
         j = self._pod_node.pop(key, None)

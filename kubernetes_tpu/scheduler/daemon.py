@@ -113,10 +113,19 @@ class SchedulerConfig:
         policy: Optional[dict] = None,
         bind_qps: float = 0.0,
         assume_ttl: float = 30.0,
+        raw_scheduled_cache: bool = False,
     ):
         self.client = client
+        # raw_scheduled_cache: keep the scheduled-pods cache in WIRE
+        # form and decode lazily. The incremental batch daemon tracks
+        # its own bound pods in the device session, so fully decoding
+        # every bind/delete event (most of which it discards by key)
+        # was the reflector threads' main cost under 1k/s churn. Typed
+        # consumers (scalar fallback, session rebuild) decode on access.
+        self.raw_scheduled_cache = raw_scheduled_cache
         # Unassigned pods -> FIFO (factory.go:180-186, field selector
-        # "spec.nodeName=").
+        # "spec.nodeName="). DELETED events (pod bound or removed) only
+        # need the key to drop the FIFO entry — skip their decode.
         self.pod_queue = FIFO()
         self._pod_reflector = Reflector(
             client,
@@ -124,18 +133,56 @@ class SchedulerConfig:
             self.pod_queue,
             field_selector="spec.nodeName=",
             decode=_decode_pod,
+            decode_deleted=False,
         )
+
+        # Cluster-event hook: the incremental batch scheduler subscribes
+        # to watch DELTAS (not just cache state) to keep its device-
+        # resident session in step. Set before start(); called from the
+        # reflector threads, so subscribers must only enqueue.
+        self.cluster_events: Optional[Callable[[str, str, object], None]] = None
+
+        def _emit(kind: str, etype: str):
+            def handler(obj, _k=kind, _e=etype):
+                cb = self.cluster_events
+                if cb is not None:
+                    cb(_k, _e, obj)
+
+            return handler
 
         # Scheduled pods cache (for occupancy).
         self.scheduled_pods = Informer(
-            client, "pods", field_selector="spec.nodeName!=", decode=_decode_pod
+            client, "pods", field_selector="spec.nodeName!=",
+            decode=None if raw_scheduled_cache else _decode_pod,
+            on_add=_emit("pod", "ADDED"),
+            on_update=_emit("pod", "MODIFIED"),
+            on_delete=_emit("pod", "DELETED"),
+            decode_deleted=False,
         )
         # Nodes + services caches (factory.go:187-193).
-        self.nodes = Informer(client, "nodes", decode=_decode_node)
-        self.services = Informer(client, "services", decode=_decode_service)
+        self.nodes = Informer(
+            client, "nodes", decode=_decode_node,
+            on_add=_emit("node", "ADDED"),
+            on_update=_emit("node", "MODIFIED"),
+            on_delete=_emit("node", "DELETED"),
+        )
+        self.services = Informer(
+            client, "services", decode=_decode_service,
+            on_add=_emit("service", "ADDED"),
+            on_update=_emit("service", "MODIFIED"),
+            on_delete=_emit("service", "DELETED"),
+        )
+
+        def _scheduled_typed() -> List[Pod]:
+            # With the raw cache, items are wire dicts: decode at the
+            # (rare) access points — scalar fallback, session rebuild.
+            return [
+                _decode_pod(p) if isinstance(p, dict) else p
+                for p in self.scheduled_pods.store.list()
+            ]
 
         self.modeler = SimpleModeler(
-            scheduled_pods=lambda: self.scheduled_pods.store.list(),
+            scheduled_pods=_scheduled_typed,
             ttl=assume_ttl,
         )
         self.pod_lister = self.modeler.pod_lister()
@@ -529,6 +576,224 @@ class BatchScheduler(Scheduler):
             elif res.get("code") == 409:
                 _SCHEDULED.inc(result="bind_conflict")  # raced; pod is bound
             else:
+                _SCHEDULED.inc(result="bind_error")
+                rejected.append(pod)
+        self._requeue_many(rejected)
+        _E2E_LATENCY.observe(time.monotonic() - start)
+        return len(pending)
+
+
+class IncrementalBatchScheduler(BatchScheduler):
+    """Session-backed batch mode: cluster state stays device-resident.
+
+    The plain BatchScheduler re-lowers the FULL cluster (every node row
+    + every assigned pod) each tick — fine for draining one backlog,
+    but under sustained churn the re-lowering dominates the tick and
+    with it the pod-to-bind latency. This daemon keeps a SolverSession
+    (ops/incremental.py): node occupancy/bitsets/service counts live on
+    the accelerator across ticks, watch deltas patch single node rows,
+    and each tick uploads only that tick's pending pods against the
+    donated device carry.
+
+    Reference analog: the scheduler's watch-fed caches ARE its
+    incremental state (factory.go:180-193) — this lifts the same
+    stay-in-sync-by-deltas design onto device-resident arrays.
+
+    Consistency contract: any surprise (vocab/slot overflow ->
+    RebuildRequired, device error, scalar fallback, service-set change)
+    invalidates the session; the next tick rebuilds it from the
+    authoritative watch caches. Handlers are idempotent, so replaying
+    an event already reflected in a freshly built session is harmless.
+    """
+
+    def __init__(self, config: SchedulerConfig, **kw):
+        super().__init__(config, **kw)
+        if self.policy_scalar or self.spec is not None:
+            # Non-default policy: the session solver replays only the
+            # default pipeline; stay on the parent's per-tick path.
+            raise ValueError(
+                "incremental batch mode supports the default policy only"
+            )
+        import collections
+
+        self._session = None
+        self._event_q: "collections.deque" = collections.deque()
+        config.cluster_events = self._on_cluster_event
+
+    # Called from reflector threads: enqueue only.
+    def _on_cluster_event(self, kind: str, etype: str, obj) -> None:
+        self._event_q.append((kind, etype, obj))
+
+    def _build_session(self):
+        from kubernetes_tpu.ops import SolverSession
+
+        cfg = self.config
+        # Drop deltas that predate the snapshot we are about to read:
+        # everything already in the caches is captured by the build;
+        # anything racing in lands in the queue and replays after
+        # (idempotent). Clear FIRST, then read.
+        self._event_q.clear()
+        nodes = cfg.nodes.store.list()
+        services = cfg.service_lister.list()
+        # pod_lister = scheduled cache ∪ live assumptions: pods WE just
+        # bound whose watch events haven't landed yet must occupy their
+        # rows in the rebuilt session (same race the scalar path's
+        # modeler covers; also decodes the raw cache).
+        assigned = cfg.pod_lister.list()
+        # Headroom: node slots bucket up; vocab words sized for the
+        # fleet's label/port/volume variety with slack for churn.
+        return SolverSession(
+            nodes,
+            services=services,
+            assigned=assigned,
+            node_capacity=max(64, int(len(nodes) * 1.25)),
+            mode=self.mode,
+        )
+
+    @staticmethod
+    def _obj_key(obj) -> str:
+        """pod_key over typed pods OR wire dicts (the raw cache and
+        decode_deleted paths deliver dicts)."""
+        if isinstance(obj, dict):
+            m = obj.get("metadata", {})
+            return f"{m.get('namespace', '')}/{m.get('name', '')}"
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def _apply_events(self, session) -> bool:
+        """Drain watch deltas into the session. Returns False when the
+        session must be rebuilt (service set changed). Events may carry
+        wire dicts (raw cache / key-only deletes): deletes use the key
+        alone; foreign bound pods decode on demand."""
+        while self._event_q:
+            kind, etype, obj = self._event_q.popleft()
+            if kind == "service":
+                return False  # frozen service set: resync
+            if kind == "node":
+                if etype == "DELETED":
+                    name = (
+                        obj.get("metadata", {}).get("name", "")
+                        if isinstance(obj, dict)
+                        else obj.metadata.name
+                    )
+                    session.remove_node(name)
+                else:
+                    session.upsert_node(obj)
+            elif kind == "pod":
+                key = self._obj_key(obj)
+                if etype == "DELETED":
+                    session.delete_assigned(key)
+                elif not session.has_assigned(key):
+                    # Bound by someone else (static pod, another
+                    # scheduler instance) or resync replay.
+                    if isinstance(obj, dict):
+                        obj = _decode_pod(obj)
+                    session.add_assigned(obj)
+        return True
+
+    def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
+        from kubernetes_tpu.ops import RebuildRequired
+
+        cfg = self.config
+        pending = self._drain(timeout)
+        if not pending:
+            # Keep the session current while idle so the next burst
+            # doesn't pay a rebuild.
+            if self._session is not None:
+                try:
+                    if not self._apply_events(self._session):
+                        self._session = None
+                except Exception:
+                    # RebuildRequired, decode error, anything — the
+                    # consumed delta is gone, so the session can no
+                    # longer be trusted.
+                    self._session = None
+            else:
+                # No session to apply them to, and the next build
+                # snapshots the caches anyway: don't let deltas pile
+                # up unboundedly in a quiet cluster.
+                self._event_q.clear()
+            return 0
+        start = time.monotonic()
+        try:
+            t0 = time.monotonic()
+            if self._session is None:
+                self._session = self._build_session()
+            if not self._apply_events(self._session):
+                self._session = self._build_session()
+            # A drained pod may have been bound ELSEWHERE since it was
+            # queued (another scheduler instance; HA failover overlap)
+            # — its watch event just charged the session. Feeding it to
+            # solve() would double-charge and orphan the true charge
+            # when the 409 rollback fires.
+            for pod in pending:
+                key = f"{pod.metadata.namespace or 'default'}/{pod.metadata.name}"
+                if not self._session.has_assigned(key):
+                    self._session.add_pending(pod)
+            results = self._session.solve()
+            _ALGO_LATENCY.observe(time.monotonic() - t0)
+        except Exception:
+            # RebuildRequired, device error, anything: invalidate and
+            # fall back to the parent's full-relower tick (which itself
+            # falls back to scalar if the device path is down).
+            self._session = None
+            self.fallback_count += 1
+            for pod in pending:
+                cfg.pod_queue.add(pod)
+            return super().schedule_batch(timeout=0.0)
+
+        by_key = {f"{p.metadata.namespace or 'default'}/{p.metadata.name}": p
+                  for p in pending}
+        by_ns: Dict[str, List] = {}
+        placed: List[Tuple[Pod, str]] = []
+        rejected: List[Pod] = []
+        for key, dest in results:
+            pod = by_key.get(key)
+            if pod is None:
+                continue
+            if dest is None:
+                _SCHEDULED.inc(result="unschedulable")
+                cfg.client.record_event(
+                    pod, "FailedScheduling", "no node fits", source="scheduler"
+                )
+                rejected.append(pod)
+                continue
+            ns = pod.metadata.namespace or "default"
+            by_ns.setdefault(ns, []).append((pod.metadata.name, dest))
+            placed.append((pod, dest))
+
+        t0 = time.monotonic()
+        outcome: Dict[Tuple[str, str], dict] = {}
+        try:
+            for ns, items in by_ns.items():
+                bind_results = cfg.binder.bind_bulk(items, namespace=ns)
+                for (pod_name, _dest), res in zip(items, bind_results):
+                    outcome[(ns, pod_name)] = res
+        except Exception:
+            pass  # unrecorded outcomes retry below; dupes 409 next round
+        if by_ns:
+            _BIND_LATENCY.observe(time.monotonic() - t0)
+
+        for pod, dest in placed:
+            ns = pod.metadata.namespace or "default"
+            key = f"{ns}/{pod.metadata.name}"
+            res = outcome.get((ns, pod.metadata.name), {})
+            if res.get("status") == "Success":
+                pod.spec.node_name = dest
+                cfg.modeler.assume_pod(pod)
+                _SCHEDULED.inc(result="scheduled")
+                cfg.client.record_event(
+                    pod, "Scheduled",
+                    f"Successfully assigned {pod.metadata.name} to {dest}",
+                    source="scheduler",
+                )
+            elif res.get("code") == 409:
+                # Raced: someone else bound it. The session charged OUR
+                # placement; release it — the true binding arrives via
+                # the scheduled-pods watch and re-charges the right row.
+                self._session.delete_assigned(key)
+                _SCHEDULED.inc(result="bind_conflict")
+            else:
+                self._session.delete_assigned(key)
                 _SCHEDULED.inc(result="bind_error")
                 rejected.append(pod)
         self._requeue_many(rejected)

@@ -1240,6 +1240,32 @@ class APIServer:
             "code": 201,
         }
 
+    def create_events_bulk(self, namespace: str, items) -> list:
+        """Write many Events in one call — the event broadcaster's
+        batched sink. No reference analog: one POST per event
+        (pkg/client/record/event.go recordToSink) is viable at the
+        reference's 15 binds/s but becomes the control plane's largest
+        per-pod cost at 1k+ binds/s. Per-item results; each event still
+        takes the normal create path (validation, TTL, watch fan-out)."""
+        if isinstance(items, dict):
+            items = items.get("items", [])
+        results = []
+        for ev in items:
+            ns = ev.get("metadata", {}).get("namespace") or namespace or "default"
+            try:
+                self.create("events", ns, ev)
+                results.append(
+                    {
+                        "kind": "Status",
+                        "apiVersion": "v1",
+                        "status": "Success",
+                        "code": 201,
+                    }
+                )
+            except APIError as e:
+                results.append(e.to_status())
+        return results
+
     def bind_bulk(self, namespace: str, bindings: list) -> list:
         """Commit many bindings in one call (no reference analog — this
         is the batch-solver commit path: one request for a whole solved

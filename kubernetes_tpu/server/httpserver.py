@@ -484,6 +484,13 @@ class _Handler(BaseHTTPRequestHandler):
                     200, {"kind": "BindingResultList", "results": results}
                 )
                 return "bulkbindings", 200
+            if resource == "bulkevents" and verb == "POST":
+                body = self._read_body()
+                results = api.create_events_bulk(ns, body.get("items", []))
+                self._send_json(
+                    200, {"kind": "EventResultList", "results": results}
+                )
+                return "bulkevents", 200
             if len(rest) == 3:
                 return self._collection(verb, resource, ns, lsel, fsel)
             name = rest[3]

@@ -115,6 +115,8 @@ def test_batch_unschedulable_and_mixed():
     sched.schedule_batch(timeout=1)
     assert client.get("pods", "fits", namespace="default").spec.node_name == "n0"
     assert client.get("pods", "huge", namespace="default").spec.node_name == ""
+    # Events ride the async broadcaster on the SCHEDULER's client.
+    cfg.client.flush_events()
     events, _ = client.list("events", namespace="default")
     assert any(e.reason == "FailedScheduling" for e in events)
     cfg.stop()

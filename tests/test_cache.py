@@ -235,3 +235,62 @@ class TestDeltaFIFO:
         q.close()
         t.join(timeout=5)
         assert out == [None]
+
+
+def test_reflector_relist_synthesizes_deleted_events():
+    """Round-5 review regression: objects deleted while the watch was
+    down must surface as DELETED on relist — delta subscribers (the
+    incremental scheduler's session) would otherwise carry phantom
+    occupancy forever (DeltaFIFO.replace's synthesized-Deleted rule,
+    lifted to the Reflector's on_event stream)."""
+    import time as _time
+
+    from kubernetes_tpu.client import Client, LocalTransport
+    from kubernetes_tpu.client.cache import Informer
+    from kubernetes_tpu.server.api import APIServer
+
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    spec = {"spec": {"containers": [{"name": "c", "image": "x"}]}}
+    client.create("pods", obj("stays", **spec), namespace="default")
+    client.create("pods", obj("vanishes", **spec), namespace="default")
+
+    events = []
+
+    def _n(o):  # list replay yields typed objects; watch yields dicts
+        return o["metadata"]["name"] if isinstance(o, dict) else o.metadata.name
+
+    inf = Informer(
+        client,
+        "pods",
+        on_add=lambda o: events.append(("ADDED", _n(o))),
+        on_delete=lambda o: events.append(("DELETED", _n(o))),
+    )
+    inf.start()
+    assert inf.wait_for_sync(10)
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline and len(events) < 2:
+        _time.sleep(0.02)
+    # Simulate a watch outage that misses a delete: stop, delete, and
+    # start a FRESH informer sharing the same store (the relist path).
+    inf.stop()
+    client.delete("pods", "vanishes", namespace="default")
+    inf2 = Informer(
+        client,
+        "pods",
+        on_add=lambda o: events.append(("ADDED", _n(o))),
+        on_delete=lambda o: events.append(("DELETED", _n(o))),
+    )
+    inf2.store = inf.store  # carry the stale cache into the relist
+    inf2.reflector.store = inf.store
+    inf2.start()
+    assert inf2.wait_for_sync(10)
+    deadline = _time.monotonic() + 5
+    while (
+        _time.monotonic() < deadline
+        and ("DELETED", "vanishes") not in events
+    ):
+        _time.sleep(0.02)
+    inf2.stop()
+    assert ("DELETED", "vanishes") in events
+    assert [n for n in inf.store.keys()] == ["default/stays"]
