@@ -211,7 +211,9 @@ def _churn_node_wire(j: int) -> dict:
 
 
 def _churn_pod_wire(name: str) -> dict:
-    h = hash(name)
+    import zlib
+
+    h = zlib.crc32(name.encode())  # deterministic across processes/runs
     return {
         "kind": "Pod",
         "metadata": {"name": name, "namespace": "default"},

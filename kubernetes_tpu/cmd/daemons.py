@@ -323,13 +323,23 @@ def start_scheduler(args, client=None):
     def factory():
         config = SchedulerConfig(
             client, provider_name=args.algorithm_provider, policy=policy,
-            raw_scheduled_cache=incremental and not (policy or args.solver_sidecar),
+            raw_scheduled_cache=incremental,
         ).start()
         config.wait_for_sync()
         # --batch-mode/--solver-sidecar/--batch-incremental imply
         # --batch: silently dropping an explicit request onto the
         # scalar per-pod path is a footgun.
-        if incremental and not (policy or args.solver_sidecar):
+        if incremental:
+            if policy or args.solver_sidecar:
+                # Same loud failure the class itself raises: the
+                # session replays only the default pipeline, and a
+                # silent downgrade to full-relower mode would betray
+                # the flag's promise.
+                raise SystemExit(
+                    "--batch-incremental supports the default policy "
+                    "only (drop --policy-config-file/--solver-sidecar, "
+                    "or drop --batch-incremental)"
+                )
             return IncrementalBatchScheduler(
                 config, mode=args.batch_mode
             ).start()

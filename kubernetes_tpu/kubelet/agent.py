@@ -397,6 +397,18 @@ class Kubelet:
             self._hb_node = None  # refetch (or re-register) next beat
 
     def _heartbeat_loop(self) -> None:
+        # Phase jitter: a fleet of kubelets started together would
+        # otherwise beat in lockstep — at 1000 nodes the synchronized
+        # herd of status PUTs convoys on the apiserver (the reference
+        # spreads --node-status-update-frequency load the same way).
+        import random as _random
+
+        if self._stop.wait(_random.uniform(0, self.heartbeat_period)):
+            return
+        try:
+            self._heartbeat()
+        except Exception:
+            pass
         while not self._stop.wait(self.heartbeat_period):
             try:
                 self._heartbeat()
@@ -518,7 +530,12 @@ class Kubelet:
         self._sync_pool.forget(self._key(pod))
 
     def _resync_loop(self) -> None:
-        """Periodic full resync + orphan GC (syncLoop tick)."""
+        """Periodic full resync + orphan GC (syncLoop tick). Initial
+        phase jitter: see _heartbeat_loop."""
+        import random as _random
+
+        if self._stop.wait(_random.uniform(0, self.sync_period)):
+            return
         while not self._stop.wait(self.sync_period):
             try:
                 pods = self.pods.store.list()

@@ -107,6 +107,7 @@ class SolverSession:
         weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
         mesh=None,
         mode: str = "scan",
+        pod_bucket: int = 0,
     ):
         nodes = list(nodes)
         self.services = list(services)
@@ -118,6 +119,12 @@ class SolverSession:
         if mode not in ("scan", "wave", "sinkhorn"):
             raise ValueError(f"unknown session mode {mode!r}")
         self.mode = mode
+        # pod_bucket > 0 pads every tick's pending upload to AT LEAST
+        # this bucket: ONE compiled executable instead of one per
+        # power-of-2 batch size. Long-lived daemons under churn want
+        # this — a fresh pow2 bucket mid-workload stalls the tick for
+        # a full XLA compile (minutes on CPU hosts).
+        self.pod_bucket = pod_bucket
         self.LW, self.PW, self.VW = label_words, port_words, vol_words
         self.S = max(1, len(self.services))
         self._matcher = ServiceMatcher(self.services)
@@ -405,7 +412,7 @@ class SolverSession:
 
     def _pod_arrays(self, pending: List[_LoweredPod]) -> Dict[str, jnp.ndarray]:
         P = len(pending)
-        PP = _bucket(P)
+        PP = max(_bucket(P), self.pod_bucket)
         arr = {
             "cpu": np.zeros(PP, np.float32),
             "mem": np.zeros(PP, np.float32),

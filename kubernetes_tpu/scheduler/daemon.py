@@ -606,7 +606,9 @@ class IncrementalBatchScheduler(BatchScheduler):
     an event already reflected in a freshly built session is harmless.
     """
 
-    def __init__(self, config: SchedulerConfig, **kw):
+    def __init__(
+        self, config: SchedulerConfig, pod_bucket: int = 0, **kw
+    ):
         super().__init__(config, **kw)
         if self.policy_scalar or self.spec is not None:
             # Non-default policy: the session solver replays only the
@@ -616,6 +618,7 @@ class IncrementalBatchScheduler(BatchScheduler):
             )
         import collections
 
+        self.pod_bucket = pod_bucket  # fixed tick upload bucket (0=pow2)
         self._session = None
         self._event_q: "collections.deque" = collections.deque()
         config.cluster_events = self._on_cluster_event
@@ -648,6 +651,7 @@ class IncrementalBatchScheduler(BatchScheduler):
             assigned=assigned,
             node_capacity=max(64, int(len(nodes) * 1.25)),
             mode=self.mode,
+            pod_bucket=self.pod_bucket,
         )
 
     @staticmethod

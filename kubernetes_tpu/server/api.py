@@ -66,6 +66,35 @@ class APIError(Exception):
         }
 
 
+def _pod_node_name(obj: dict) -> str:
+    """Shared shard extractor: equal shards must hash together, so this
+    is THE module-level callable for pod spec.nodeName routing."""
+    return obj.get("spec", {}).get("nodeName", "") or ""
+
+
+_SHARD_FIELDS = {("pods", "spec.nodeName"): _pod_node_name}
+
+
+def _watch_shard(resource: str, field_selector: str):
+    """Derive a dispatch-routing shard from a watch's field selector:
+    an exact-equality clause on an indexed field (pods' spec.nodeName —
+    the kubelet/scheduler watch shape) lets the store skip this
+    watcher for events that can't concern it. Conservative: any parse
+    surprise returns None (unindexed, full fan-out)."""
+    if not field_selector:
+        return None
+    try:
+        fsel = labelpkg.parse_fields(field_selector)
+    except ValueError:
+        return None
+    for key, op, value in fsel.requirements:
+        if op == labelpkg.EQUALS:
+            fn = _SHARD_FIELDS.get((resource, key))
+            if fn is not None:
+                return (fn, value)
+    return None
+
+
 def _not_found(resource: str, name: str) -> APIError:
     return APIError(404, "NotFound", f'{resource} "{name}" not found')
 
@@ -1191,10 +1220,14 @@ class APIServer:
         has the other nodes' pod events copied or queued for it."""
         info = self._info(resource)
         pred = None
+        shard = None
         if label_selector or field_selector:
             pred = self._selector_pred(resource, label_selector, field_selector)
+            shard = _watch_shard(resource, field_selector)
         try:
-            return self.store.watch(info.prefix(namespace), since=since, pred=pred)
+            return self.store.watch(
+                info.prefix(namespace), since=since, pred=pred, shard=shard
+            )
         except Exception as e:  # CompactedError -> 410 Gone
             raise APIError(410, "Expired", str(e))
 
@@ -1269,14 +1302,64 @@ class APIServer:
     def bind_bulk(self, namespace: str, bindings: list) -> list:
         """Commit many bindings in one call (no reference analog — this
         is the batch-solver commit path: one request for a whole solved
-        backlog instead of one per pod). Each binding still goes through
-        the same guarded CAS write; per-item results are returned."""
+        backlog instead of one per pod). The whole batch runs as ONE
+        store apply (atomic_update_many): per-binding lock acquisitions
+        would queue the scheduler behind every kubelet status writer
+        once per pod — at 1000 nodes that convoy, not the solve, was
+        the bind-rate ceiling. Each binding keeps the same guarded
+        emptiness check; per-item Status results are returned."""
+        from kubernetes_tpu.store import NotFoundError
+
         if isinstance(bindings, dict):
             bindings = bindings.get("bindings", [])
-        results = []
-        for binding in bindings:
-            try:
-                results.append(self.bind(namespace, binding))
-            except APIError as e:
-                results.append(e.to_status())
-        return results
+        out: List[Optional[dict]] = [None] * len(bindings)
+        ops = []
+        op_idx = []
+        for i, binding in enumerate(bindings):
+            pod_name = binding.get("metadata", {}).get("name", "")
+            target = binding.get("target", {})
+            node_name = target.get("name", "")
+            if not pod_name or not node_name:
+                out[i] = _bad_request(
+                    "binding requires metadata.name and target.name"
+                ).to_status()
+                continue
+            if target.get("kind", "") not in ("", "Node", "Minion"):
+                out[i] = _bad_request(
+                    f"cannot bind to {target.get('kind')!r}"
+                ).to_status()
+                continue
+            key = RESOURCES["pods"].key(namespace or "default", pod_name)
+
+            def assign(cur: dict, _node=node_name, _pod=pod_name) -> dict:
+                spec = cur.setdefault("spec", {})
+                if spec.get("nodeName"):
+                    raise _conflict(
+                        f'pod "{_pod}" is already assigned to node '
+                        f'"{spec["nodeName"]}"'
+                    )
+                spec["nodeName"] = _node
+                return cur
+
+            ops.append((key, assign))
+            op_idx.append(i)
+        if ops:
+            results = self.store.atomic_update_many(ops)
+            for i, res in zip(op_idx, results):
+                if isinstance(res, APIError):
+                    out[i] = res.to_status()
+                elif isinstance(res, NotFoundError):
+                    name = bindings[i].get("metadata", {}).get("name", "")
+                    out[i] = _not_found("pods", name).to_status()
+                elif isinstance(res, Exception):
+                    out[i] = APIError(
+                        500, "InternalError", str(res)
+                    ).to_status()
+                else:
+                    out[i] = {
+                        "kind": "Status",
+                        "apiVersion": "v1",
+                        "status": "Success",
+                        "code": 201,
+                    }
+        return out

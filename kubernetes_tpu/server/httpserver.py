@@ -84,6 +84,17 @@ def _request_is_long_running(parts, query) -> bool:
     )
 
 
+def reset_request_latency() -> None:
+    """Start a fresh measurement window on the process-global request
+    latency summary. The reference's e2e SLO gate scrapes a freshly
+    started cluster's apiserver (test/e2e/util.go:1286); in-process
+    suites share ONE registry across many clusters, so a test gating
+    on p99 must open its own window or it inherits every earlier
+    test's observations."""
+    with _LATENCY._lock:
+        _LATENCY._stats.clear()
+
+
 def high_latency_requests(threshold: float = 1.0, summary=None):
     """The HighLatencyRequests SLO gate (reference: test/e2e/
     util.go:1286 scrapes apiserver request-latency summaries and fails
@@ -1284,9 +1295,23 @@ class _TLSCapableServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that TLS-wraps each accepted connection with
     do_handshake_on_connect=False: the handshake then happens on the
     handler thread's first read, so a client that stalls mid-handshake
-    ties up one daemon thread instead of the accept loop."""
+    ties up one daemon thread instead of the accept loop.
+
+    Accepted sockets are tracked (weakly) so close_connections() can
+    sever live keep-alive sessions on shutdown: a process restart
+    resets every TCP connection, and an in-process restart (tests, the
+    HTTP-tier-only restart path) must behave the same — otherwise a
+    successor on the same port coexists with the predecessor's handler
+    threads still serving stale keep-alive clients."""
 
     ssl_context = None
+
+    def __init__(self, *args, **kwargs):
+        import weakref
+
+        super().__init__(*args, **kwargs)
+        self._conns: "weakref.WeakSet" = weakref.WeakSet()
+        self._conns_lock = threading.Lock()
 
     def get_request(self):
         sock, addr = self.socket.accept()
@@ -1294,7 +1319,20 @@ class _TLSCapableServer(ThreadingHTTPServer):
             sock = self.ssl_context.wrap_socket(
                 sock, server_side=True, do_handshake_on_connect=False
             )
+        with self._conns_lock:
+            self._conns.add(sock)
         return sock, addr
+
+    def close_connections(self) -> None:
+        import socket as _socket
+
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class APIHTTPServer:
@@ -1393,6 +1431,10 @@ class APIHTTPServer:
     def stop(self, release_store: bool = True) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # Sever live keep-alive connections: a dead server must not
+        # keep answering old clients through lingering handler threads
+        # (a successor may be about to bind the same port).
+        self.httpd.close_connections()
         if self._thread:
             self._thread.join(timeout=5)
         # Release the store (WAL handle + data-dir flock): a stopped

@@ -38,6 +38,7 @@ from __future__ import annotations
 import copy
 import fcntl
 import json
+import heapq
 import math
 import os
 import queue as _queue
@@ -126,6 +127,61 @@ def _filter_event(
     return Event(DELETED, obj, version) if pred(obj) else None
 
 
+def _write_thread(store_ref, q) -> None:
+    """Serialized write-combining loop (etcd's single raft-apply
+    thread, in spirit): drains queued mutations and executes them with
+    ONE thread. Under a thread herd (1000 kubelets' status writers on
+    one core), per-caller lock acquisition makes every write pay a
+    full wake+GIL-handoff latency and system write throughput
+    collapses to ~1/wake-latency; with a single applier the writes
+    themselves proceed at full speed and only each caller's own
+    wake-up is laggy."""
+    spin_s = 0.004  # stay runnable briefly between batches (see below)
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        while True:
+            batch = [item]
+            while len(batch) < 256:
+                try:
+                    batch.append(q.get_nowait())
+                except _queue.Empty:
+                    break
+            store = store_ref()
+            if store is None:
+                return
+            for entry in batch:
+                if entry is None:
+                    return
+                fn, ev, cell = entry
+                try:
+                    cell.append((True, fn()))
+                except BaseException as e:
+                    cell.append((False, e))
+                ev.set()
+            del store
+            # Spin-drain: a blocking get() puts this thread to SLEEP,
+            # and under a runnable herd each wake-up costs many GIL
+            # quanta — the writer's throughput became 1/wake-latency
+            # (~75 ops/s observed) no matter how fast the writes were.
+            # Yielding but staying runnable keeps the pump hot while
+            # load continues; after a quiet spell it blocks for real.
+            deadline = time.monotonic() + spin_s
+            item = None
+            while item is None and time.monotonic() < deadline:
+                try:
+                    nxt = q.get_nowait()
+                except _queue.Empty:
+                    time.sleep(0)  # yield the GIL, stay runnable
+                    continue
+                if nxt is None:
+                    return  # shutdown sentinel (close/GC finalizer)
+                item = nxt
+            if item is None:
+                break  # idle: go back to the blocking get
+
+
 class KVStore:
     def __init__(
         self,
@@ -133,6 +189,7 @@ class KVStore:
         data_dir: Optional[str] = None,
         fsync: bool = True,
         snapshot_every: int = 4096,
+        serialized_writes: bool = False,
     ):
         self._lock = threading.RLock()
         self._data: Dict[str, Tuple[dict, int]] = {}  # key -> (wire obj, version)
@@ -146,7 +203,12 @@ class KVStore:
         # spec.nodeName=X) must not even be offered the other 99 nodes'
         # events — at 100 kubelets that fan-out was the control plane's
         # wall, not the solver.
-        self._watchers: List[Tuple[str, Optional[Callable], WatchStream]] = []
+        # watcher tuple: (prefix, pred, stream, shard) where shard is
+        # None or (extract_fn, value) — see _dispatch_event.
+        self._watchers: List[tuple] = []
+        self._unsharded: List[tuple] = []
+        self._shard_buckets: Dict[tuple, List[tuple]] = {}
+        self._shard_fns: tuple = ()
         # Fan-out rides its own thread: writers only append to this
         # queue under the lock; the dispatcher does the per-event copy
         # and per-watcher predicate work OFF the write path, so write
@@ -168,9 +230,24 @@ class KVStore:
         # thread would park in q.get() forever. The finalizer holds
         # only the queue, so it doesn't resurrect the store.
         weakref.finalize(self, self._dispatch_q.put, None)
-        # TTL fast path: earliest pending expiry; ops skip the full
-        # O(ttl-keys) scan until the clock actually reaches it.
+        # Optional serialized write path (see _write_thread). Off by
+        # default: the queue hop + event wake adds ~100us of latency
+        # per write, only worth paying when HUNDREDS of threads would
+        # otherwise contend the lock (the 1000-kubelet shape).
+        self._write_q = None
+        if serialized_writes:
+            self._write_q = _queue.SimpleQueue()
+            threading.Thread(
+                target=_write_thread,
+                args=(weakref.ref(self), self._write_q),
+                daemon=True,
+            ).start()
+            weakref.finalize(self, self._write_q.put, None)
+        # TTL fast path: earliest pending expiry; ops skip all expiry
+        # work until the clock actually reaches it. The heap carries
+        # (expiry, key) with lazy invalidation (see _expire_locked).
         self._next_expiry = math.inf
+        self._ttl_heap: List[Tuple[float, str]] = []
         # Durability (off when data_dir is None — tests/benches that
         # want a pure in-memory store keep the old behavior).
         # TTL clock: wall time for durable stores (deadlines must age
@@ -212,6 +289,8 @@ class KVStore:
             os.ftruncate(self._lockfd, 0)  # clear any longer stale pid
             os.write(self._lockfd, str(os.getpid()).encode())
             replayed = self._recover()
+            self._ttl_heap = [(t, k) for k, t in self._ttl.items()]
+            heapq.heapify(self._ttl_heap)
             self._next_expiry = min(self._ttl.values(), default=math.inf)
             self._wal_file = open(self._wal_path, "a", encoding="utf-8")
             if replayed:
@@ -416,14 +495,24 @@ class KVStore:
         if self._now() < self._next_expiry:
             return  # nothing can have expired yet — O(1) common path
         now = self._now()
-        expired = [k for k, t in self._ttl.items() if t <= now]
-        for k in expired:
+        # Heap of (expiry, key) with lazy invalidation (the _ttl dict
+        # is authoritative): expiry work is O(expired log n). The old
+        # full scan of _ttl was O(all TTL entries) under the store
+        # lock EVERY write once any entry was due — with tens of
+        # thousands of TTL'd events continuously expiring at 1000-node
+        # scale, that scan WAS the store's write ceiling.
+        heap = self._ttl_heap
+        while heap and heap[0][0] <= now:
+            exp, k = heapq.heappop(heap)
+            cur = self._ttl.get(k)
+            if cur is None or cur != exp:
+                continue  # refreshed or already gone: stale heap entry
             del self._ttl[k]
             if k in self._data:
                 obj, _ = self._data.pop(k)
                 v = self._bump()
                 self._record(v, DELETED, k, obj)
-        self._next_expiry = min(self._ttl.values(), default=math.inf)
+        self._next_expiry = heap[0][0] if heap else math.inf
 
     def _record(
         self, version: int, etype: str, key: str, obj: dict, prev: Optional[dict] = None
@@ -452,13 +541,30 @@ class KVStore:
         lock was the control plane's wall, not the solver). Event
         objects are read-only by contract — every consumer either
         JSON-encodes them (HTTP watch) or decodes them into fresh typed
-        objects (serde.from_wire rebuilds every container)."""
+        objects (serde.from_wire rebuilds every container).
+
+        Sharded watchers (watch(..., shard=(fn, value))) are indexed by
+        their shard value and only offered events whose object (or
+        previous state) maps to that value — at 1000 kubelets each
+        watching spec.nodeName=<self>, per-event fan-out would
+        otherwise cost O(watchers) filter evaluations, and 90k pod
+        events x 1000 watchers of dispatch work WAS the 1000-node
+        drill's wall. Routing is conservative: a watcher's pred can
+        only match (directly or through the DELETED translation) when
+        obj or prev carries its shard value, so skipped watchers would
+        have produced no event anyway."""
         version, etype, key, obj, prev = item
         with self._lock:
-            watchers = list(self._watchers)
+            watchers = list(self._unsharded)
+            for fn in self._shard_fns:  # distinct extractors (usually 1)
+                vals = {fn(obj)}
+                if prev is not None:
+                    vals.add(fn(prev))
+                for v in vals:
+                    watchers.extend(self._shard_buckets.get((fn, v), ()))
         delivered = None  # lazily copied: most events match few watchers
         saw_closed = False
-        for prefix, pred, stream in watchers:
+        for prefix, pred, stream, _shard in watchers:
             if stream.closed:
                 saw_closed = True
                 continue
@@ -475,24 +581,41 @@ class KVStore:
                 self._watchers = [
                     w for w in self._watchers if not w[2].closed
                 ]
+                self._rebuild_watch_index_locked()
+
+    def _rebuild_watch_index_locked(self) -> None:
+        self._unsharded = []
+        self._shard_buckets = {}
+        for w in self._watchers:
+            shard = w[3]
+            if shard is None:
+                self._unsharded.append(w)
+            else:
+                self._shard_buckets.setdefault(tuple(shard), []).append(w)
+        self._shard_fns = tuple({fn for fn, _v in self._shard_buckets})
 
     # -- CRUD ---------------------------------------------------------
 
     def create(self, key: str, obj: dict, ttl: Optional[float] = None) -> dict:
         obj = _copy_obj(obj)  # before the lock: O(obj) work stays outside
-        with self._lock:
-            self._expire_locked()
-            if key in self._data:
-                raise AlreadyExistsError(key)
-            v = self._bump()
-            self._stamp(obj, v)
-            self._data[key] = (obj, v)
-            if ttl is not None:
-                exp = self._now() + ttl
-                self._ttl[key] = exp
-                self._next_expiry = min(self._next_expiry, exp)
-            self._record(v, ADDED, key, obj)
-            seq = self._wal_seq
+
+        def op():
+            with self._lock:
+                self._expire_locked()
+                if key in self._data:
+                    raise AlreadyExistsError(key)
+                v = self._bump()
+                self._stamp(obj, v)
+                self._data[key] = (obj, v)
+                if ttl is not None:
+                    exp = self._now() + ttl
+                    self._ttl[key] = exp
+                    heapq.heappush(self._ttl_heap, (exp, key))
+                    self._next_expiry = min(self._next_expiry, exp)
+                self._record(v, ADDED, key, obj)
+                return self._wal_seq
+
+        seq = self._apply_write(op)
         self._wal_sync(seq)  # fsync-before-ack, amortized across writers
         return _copy_obj(obj)
 
@@ -512,38 +635,45 @@ class KVStore:
     ) -> dict:
         """Update; CAS when expected_version is given (etcd CompareAndSwap)."""
         obj = _copy_obj(obj)  # before the lock: O(obj) work stays outside
-        with self._lock:
-            self._expire_locked()
-            if key not in self._data:
-                raise NotFoundError(key)
-            prev, cur_v = self._data[key]
-            if expected_version is not None and cur_v != expected_version:
-                raise ConflictError(
-                    f"{key}: version {expected_version} != current {cur_v}"
-                )
-            v = self._bump()
-            self._stamp(obj, v)
-            self._data[key] = (obj, v)
-            self._record(v, MODIFIED, key, obj, prev=prev)
-            seq = self._wal_seq
+
+        def op():
+            with self._lock:
+                self._expire_locked()
+                if key not in self._data:
+                    raise NotFoundError(key)
+                prev, cur_v = self._data[key]
+                if expected_version is not None and cur_v != expected_version:
+                    raise ConflictError(
+                        f"{key}: version {expected_version} != current {cur_v}"
+                    )
+                v = self._bump()
+                self._stamp(obj, v)
+                self._data[key] = (obj, v)
+                self._record(v, MODIFIED, key, obj, prev=prev)
+                return self._wal_seq
+
+        seq = self._apply_write(op)
         self._wal_sync(seq)
         return _copy_obj(obj)
 
     def delete(self, key: str, expected_version: Optional[int] = None) -> dict:
-        with self._lock:
-            self._expire_locked()
-            if key not in self._data:
-                raise NotFoundError(key)
-            obj, cur_v = self._data[key]
-            if expected_version is not None and cur_v != expected_version:
-                raise ConflictError(
-                    f"{key}: version {expected_version} != current {cur_v}"
-                )
-            del self._data[key]
-            self._ttl.pop(key, None)
-            v = self._bump()
-            self._record(v, DELETED, key, obj)
-            seq = self._wal_seq
+        def op():
+            with self._lock:
+                self._expire_locked()
+                if key not in self._data:
+                    raise NotFoundError(key)
+                obj, cur_v = self._data[key]
+                if expected_version is not None and cur_v != expected_version:
+                    raise ConflictError(
+                        f"{key}: version {expected_version} != current {cur_v}"
+                    )
+                del self._data[key]
+                self._ttl.pop(key, None)
+                v = self._bump()
+                self._record(v, DELETED, key, obj)
+                return obj, self._wal_seq
+
+        obj, seq = self._apply_write(op)
         self._wal_sync(seq)
         return _copy_obj(obj)
 
@@ -570,6 +700,38 @@ class KVStore:
             self._expire_locked()
             return sorted(k for k in self._data if k.startswith(prefix))
 
+    def _apply_write(self, op):
+        """Run a mutation closure directly, or through the serialized
+        writer when enabled. `op` takes the store lock itself (short
+        hold); exceptions propagate to the caller either way."""
+        q = self._write_q
+        if q is None:
+            return op()
+        ev = threading.Event()
+        cell: list = []
+        q.put((op, ev, cell))
+        ev.wait()
+        ok, val = cell[0]
+        if ok:
+            return val
+        raise val
+
+    def _atomic_update_locked(self, key: str, update_fn) -> dict:
+        """Caller holds self._lock."""
+        if key not in self._data:
+            raise NotFoundError(key)
+        cur, _ = self._data[key]
+        # Stored state must be PRIVATE: update_fn may graft caller-
+        # owned sub-dicts into its return (update_status splices the
+        # request body's status), so the stored object is a copy —
+        # same invariant set() keeps by copying its input.
+        stored = _copy_obj(update_fn(_copy_obj(cur)))
+        v = self._bump()
+        self._stamp(stored, v)
+        self._data[key] = (stored, v)
+        self._record(v, MODIFIED, key, stored, prev=cur)
+        return stored
+
     def atomic_update(self, key: str, update_fn: Callable[[dict], dict]) -> dict:
         """Single-hold read-modify-write: update_fn runs under the store
         lock on a private copy, so no CAS retry loop and ONE lock
@@ -579,23 +741,45 @@ class KVStore:
         threads on this lock, and every extra lock handoff costs up to
         a GIL switch interval. update_fn must be small and must not
         call back into the store."""
-        with self._lock:
-            self._expire_locked()
-            if key not in self._data:
-                raise NotFoundError(key)
-            cur, _ = self._data[key]
-            # Stored state must be PRIVATE: update_fn may graft caller-
-            # owned sub-dicts into its return (update_status splices the
-            # request body's status), so the stored object is a copy —
-            # same invariant set() keeps by copying its input.
-            stored = _copy_obj(update_fn(_copy_obj(cur)))
-            v = self._bump()
-            self._stamp(stored, v)
-            self._data[key] = (stored, v)
-            self._record(v, MODIFIED, key, stored, prev=cur)
-            seq = self._wal_seq
+
+        def op():
+            with self._lock:
+                self._expire_locked()
+                stored = self._atomic_update_locked(key, update_fn)
+                return stored, self._wal_seq
+
+        stored, seq = self._apply_write(op)
         self._wal_sync(seq)
         return _copy_obj(stored)
+
+    def atomic_update_many(
+        self, ops: List[Tuple[str, Callable[[dict], dict]]]
+    ) -> List:
+        """Batch of single-hold read-modify-writes under ONE lock
+        acquisition (and one serialized-writer hop). The batch solver
+        commits a whole backlog's bindings through this: per-binding
+        lock acquisitions would queue the scheduler behind every
+        kubelet status writer once per pod — at 1000 nodes that
+        convoy, not the solve, was the bind-rate ceiling. Per-item
+        results: the stored object, or the exception instance for
+        items whose update raised (APIError-style callers translate)."""
+
+        def batch():
+            out = []
+            with self._lock:
+                self._expire_locked()
+                for key, update_fn in ops:
+                    try:
+                        out.append(self._atomic_update_locked(key, update_fn))
+                    except Exception as e:  # per-item outcome, not abort
+                        out.append(e)
+                return out, self._wal_seq
+
+        results, seq = self._apply_write(batch)
+        self._wal_sync(seq)
+        return [
+            r if isinstance(r, Exception) else _copy_obj(r) for r in results
+        ]
 
     # -- GuaranteedUpdate (etcd_helper.go:510-600) ---------------------
 
@@ -626,6 +810,7 @@ class KVStore:
         since: int = 0,
         maxsize: int = 4096,
         pred: Optional[Callable[[dict], bool]] = None,
+        shard: Optional[tuple] = None,
     ) -> WatchStream:
         """Stream events for keys under prefix with version > since.
 
@@ -635,6 +820,15 @@ class KVStore:
         etcd's modified-out-of-filter -> DELETED translation
         (_filter_event): non-matching events are never copied or queued
         for this watcher.
+
+        `shard` = (extract_fn, value): a routing hint asserting this
+        watcher's pred can only match objects whose extract_fn(obj)
+        equals `value` (directly or via the previous state). The
+        dispatcher then indexes the watcher by value instead of
+        evaluating it against every event — O(1) fan-out for the
+        1000-kubelets-each-watching-their-node shape. extract_fn must
+        be a shared (module-level) callable so equal shards hash
+        together.
         """
         with self._lock:
             self._expire_locked()
@@ -665,24 +859,30 @@ class KVStore:
             # AFTER replay so live events can't interleave mid-replay.
             stream.floor = self._version
             self._watchers = [
-                (p, f, s) for p, f, s in self._watchers if not s.closed
+                w for w in self._watchers if not w[2].closed
             ]
-            self._watchers.append((prefix, pred, stream))
+            self._watchers.append((prefix, pred, stream, shard))
+            self._rebuild_watch_index_locked()
             return stream
 
     def stop_watch(self, stream: WatchStream) -> None:
         stream.close()
         with self._lock:
             self._watchers = [
-                (p, f, s) for p, f, s in self._watchers if not s.closed
+                w for w in self._watchers if not w[2].closed
             ]
+            self._rebuild_watch_index_locked()
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            for _, _, s in self._watchers:
-                s.close()
+            for w in self._watchers:
+                w[2].close()
             self._watchers = []
+            self._unsharded = []
+            self._shard_buckets = {}
+            if self._write_q is not None:
+                self._write_q.put(None)  # retire the serialized writer
             self._dispatch_q.put(None)  # retire the dispatcher thread
             if self._wal_file is not None:
                 # fsync-before-close: a writer that appended its record

@@ -1,0 +1,24 @@
+"""README drift gate (VERDICT r4 Weak #2): the headline-numbers table
+must match what tools/update_readme_bench.py generates from the newest
+BENCH_r*.json artifact. If a new artifact lands (or the generator
+changes), regenerate with `python tools/update_readme_bench.py`."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_readme_bench_table_matches_newest_artifact():
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "update_readme_bench.py"),
+         "--check"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        f"{proc.stdout}{proc.stderr}\n"
+        "README.md's bench table has drifted from the newest BENCH "
+        "artifact — run `python tools/update_readme_bench.py`."
+    )
