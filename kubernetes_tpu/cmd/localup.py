@@ -34,7 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--batch-scheduler", action="store_true")
     p.add_argument(
-        "--batch-mode", default="scan", choices=["scan", "wave", "sinkhorn"],
+        "--batch-mode", default="scan",
+        choices=["scan", "wave", "sinkhorn", "auto"],
         help="device solver mode for --batch-scheduler (scan = "
         "sequential-parity referee; wave/sinkhorn = high-throughput)",
     )

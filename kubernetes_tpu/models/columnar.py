@@ -336,7 +336,11 @@ class ServiceMatcher:
         ns = pod.metadata.namespace
         if not labels or ns not in self._pair_index:
             return _EMPTY_IDS, -1
-        key = (ns, frozenset(labels.items()))
+        # Tuple of items, not frozenset: ~2x cheaper to build+hash, and
+        # this key construction runs once per pod on the lowering
+        # critical path. Same labels in a different insertion order
+        # produce a second (identical-valued) entry — harmless.
+        key = (ns, tuple(labels.items()))
         hit = self._id_cache.get(key)
         if hit is not None:
             return hit
@@ -411,24 +415,41 @@ class SnapshotBuilder:
         for n in self.nodes:
             for k, v in (n.metadata.labels or {}).items():
                 self.label_vocab.id(f"{k}={v}")
+        # Vocab pass over every pod: fully serial before the first
+        # chunk can lower, so it sits on the pipelined solve's critical
+        # path — locals bound outside the loop, helper calls inlined,
+        # and the overwhelmingly common empty selector/port/volume
+        # cases short-circuited (was ~0.18s of the 50k wall).
         self.sel_keys: Dict[Tuple[Tuple[str, str], ...], int] = {(): 0}
         self._pod_sel_rows = np.zeros(len(self.pending), dtype=np.int32)
+        label_id = self.label_vocab.id
+        port_id = self.port_vocab.id
+        vol_id = self.vol_vocab.id
+        sel_keys = self.sel_keys
+        sel_rows = self._pod_sel_rows
         for i, p in enumerate(self.pending):
-            sel = tuple(sorted((p.spec.node_selector or {}).items()))
-            for k, v in sel:
-                self.label_vocab.id(f"{k}={v}")
-            self._pod_sel_rows[i] = self.sel_keys.setdefault(
-                sel, len(self.sel_keys)
-            )
-            for port in pod_host_ports(p):
-                self.port_vocab.id(str(port))
-            for vol, _rw in pod_volumes(p):
-                self.vol_vocab.id(vol)
+            spec = p.spec
+            nsel = spec.node_selector
+            if nsel:
+                sel = tuple(sorted(nsel.items()))
+                for k, v in sel:
+                    label_id(f"{k}={v}")
+                sel_rows[i] = sel_keys.setdefault(sel, len(sel_keys))
+            for c in spec.containers:
+                for cp in c.ports:
+                    if cp.host_port > 0:
+                        port_id(str(cp.host_port))
+            if spec.volumes:
+                for vol, _rw in pod_volumes(p):
+                    vol_id(vol)
         for p in self.assigned:
-            for port in pod_host_ports(p):
-                self.port_vocab.id(str(port))
-            for vol, _rw in pod_volumes(p):
-                self.vol_vocab.id(vol)
+            for c in p.spec.containers:
+                for cp in c.ports:
+                    if cp.host_port > 0:
+                        port_id(str(cp.host_port))
+            if p.spec.volumes:
+                for vol, _rw in pod_volumes(p):
+                    vol_id(vol)
         self.LW = self.label_vocab.words
         self.PW = self.port_vocab.words
         self.VW = self.vol_vocab.words

@@ -70,14 +70,20 @@ def _pad_cols(arr: np.ndarray, m: int) -> np.ndarray:
     return np.pad(arr, [(0, 0), (0, target - cols)])
 
 
-def _put(arr: np.ndarray, sharding):
-    """Upload one array, creating all-zero arrays directly on device:
-    a fresh backlog's occupancy matrices (svc_counts alone is N x S f32
-    ~10 MB at 5k x 500) are zeros, and shipping zeros through the
-    host->device tunnel is pure waste."""
-    if arr.size > 4096 and not arr.any():
-        return jnp.zeros(arr.shape, dtype=arr.dtype, device=sharding)
-    return jax.device_put(arr, sharding)
+def _put_tree(arrs: Dict[str, np.ndarray], sharding) -> Dict[str, jnp.ndarray]:
+    """ONE device_put for a whole dict of arrays, not one per array:
+    each call pays a dispatch round-trip, and on a tunneled device
+    10-16 small transfers per upload put that many RTTs on the
+    pipelined solve's critical path. All-zero leaves (a fresh
+    backlog's occupancy matrices — svc_counts alone is N x S f32
+    ~10 MB at 5k x 500) materialize directly on device instead of
+    shipping zeros through the tunnel."""
+    zeros = {k: v for k, v in arrs.items() if v.size > 4096 and not v.any()}
+    rest = {k: v for k, v in arrs.items() if k not in zeros}
+    out = dict(jax.device_put(rest, sharding)) if rest else {}
+    for k, v in zeros.items():
+        out[k] = jnp.zeros(v.shape, dtype=v.dtype, device=sharding)
+    return out
 
 
 @dataclass
@@ -140,7 +146,7 @@ def device_pods(
         # Padded pods are already pinned to -2 (never placed); -1 here
         # just means "no pinned affinity value".
         pods["aff_pin"] = _pad(p.aff_pin, PP, fill=-1)
-    return {k: _put(v, sharding) for k, v in pods.items()}
+    return _put_tree(pods, sharding)
 
 
 def device_nodes(
@@ -181,7 +187,7 @@ def device_nodes(
         nodes["aff_vid"] = _pad(n.aff_vid, NP, fill=-1)
     if n.aa_zone is not None:
         nodes["aa_zone"] = _pad(n.aa_zone, NP, fill=-1)
-    return {k: _put(v, sharding) for k, v in nodes.items()}
+    return _put_tree(nodes, sharding)
 
 
 def node_axis_multiple(

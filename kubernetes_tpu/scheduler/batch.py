@@ -32,6 +32,24 @@ from kubernetes_tpu.scheduler.types import (
 )
 
 
+def resolve_batch_mode(mode: str, mesh=None) -> str:
+    """Resolve --batch-mode auto by the topology the solve will
+    ACTUALLY run on. No mesh: the scan — exact sequential parity AND
+    the fastest path (the pallas kernel keeps the occupancy carry in
+    VMEM; ops/pallas_scan.py is single-device only). Sharded over a
+    mesh: the wave solver — the scan's per-pod step becomes a
+    cross-device argmax+psum round, so a P-pod backlog pays P
+    collective latencies (50k steps of ICI round-trips) where wave
+    pays ~a dozen windowed commits, and pallas is ineligible anyway
+    (docs/performance.md, mesh crossover). Keyed on the mesh the
+    caller will pass to the solve, NOT on how many devices are merely
+    visible — an unsharded solve on a multi-device host still wants
+    the scan."""
+    if mode != "auto":
+        return mode
+    return "wave" if mesh is not None else "scan"
+
+
 def schedule_backlog_scalar(
     pending: Sequence[Pod],
     nodes: Sequence[Node],

@@ -414,7 +414,12 @@ class BatchScheduler(Scheduler):
         # placements, approximate decision-order parity — ops/wave.py;
         # still the best sustained-churn mode); "sinkhorn" =
         # Sinkhorn-matched waves (congestion-priced assignment, fewest
-        # device steps — ops/sinkhorn.py).
+        # device steps — ops/sinkhorn.py); "auto" = topology-aware
+        # (scan+pallas on one chip, wave on a mesh —
+        # batch.resolve_batch_mode).
+        from kubernetes_tpu.scheduler.batch import resolve_batch_mode
+
+        mode = resolve_batch_mode(mode)
         if mode not in ("scan", "wave", "sinkhorn"):
             raise ValueError(f"unknown batch mode {mode!r}")
         self.mode = mode

@@ -292,3 +292,38 @@ def test_batch_unlowerable_policy_runs_scalar_with_policy():
     assert all(p.spec.node_name in ("n0", "n2") for p in pods), [
         (p.metadata.name, p.spec.node_name) for p in pods
     ]
+
+
+def test_batch_mode_auto_is_topology_aware():
+    """--batch-mode auto picks the scan (pallas-eligible, exact
+    parity) for an unsharded solve — even on a multi-device host,
+    since the daemon's solve runs on one device unless a mesh is in
+    play — and the wave solver when the solve shards over a mesh,
+    where the scan's per-pod step would pay one collective round per
+    pod (docs/performance.md, mesh crossover)."""
+    import jax
+
+    from kubernetes_tpu.scheduler.batch import resolve_batch_mode
+
+    # Explicit modes pass through untouched.
+    for m in ("scan", "wave", "sinkhorn"):
+        assert resolve_batch_mode(m) == m
+    # This test process sees 8 virtual devices, but an unsharded solve
+    # still wants the scan.
+    assert len(jax.devices()) > 1
+    assert resolve_batch_mode("auto") == "scan"
+    assert resolve_batch_mode("auto", mesh=object()) == "wave"
+
+
+def test_daemon_accepts_auto_mode():
+    from kubernetes_tpu.client import Client, LocalTransport
+    from kubernetes_tpu.scheduler.daemon import BatchScheduler, SchedulerConfig
+    from kubernetes_tpu.server import APIServer
+
+    cfg = SchedulerConfig(Client(LocalTransport(APIServer()))).start()
+    try:
+        assert cfg.wait_for_sync()
+        sched = BatchScheduler(cfg, mode="auto")
+        assert sched.mode in ("scan", "wave")  # resolved, never "auto"
+    finally:
+        cfg.stop()
