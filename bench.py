@@ -468,6 +468,8 @@ def _api_churn_figure(
         width *= 2
     del warm, warm_nodes
 
+    import gc
+
     srv = APIHTTPServer(api, max_in_flight=800).start()
 
     sched_client = Client(HTTPTransport(srv.address))
@@ -489,6 +491,14 @@ def _api_churn_figure(
         daemon=True,
     )
     try:
+        # The backlog phases (and the control plane just built — 5k
+        # nodes of reflector caches + the daemon's session) are a
+        # multi-GB heap; a gen2 GC pass over it mid-window lands
+        # straight in the bind-latency p99. Freeze it all out of
+        # collection consideration for the measured phase. Inside the
+        # try: every exit path below unfreezes.
+        gc.collect()
+        gc.freeze()
         child.start()
         child_conn.close()
         if not parent_conn.poll(warmup_s + duration_s + 60):
@@ -500,6 +510,7 @@ def _api_churn_figure(
             child.terminate()
         sched.stop()
         srv.stop()
+        gc.unfreeze()
     if "error" in result:
         raise RuntimeError(f"load generator failed: {result['error']}")
 
