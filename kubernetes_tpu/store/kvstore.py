@@ -29,8 +29,12 @@ Semantics mirror the reference's etcd usage through EtcdHelper
   concurrent writers share a disk flush; fsync=False (daemon flag
   --no-data-fsync) trades that for write latency.
 
-Thread-safe; many reader/writer threads, one lock (control-plane rates
-are tiny next to the TPU solver's work).
+Thread-safe; many reader/writer threads over one lock with short holds
+(TTL expiry via a heap, watch fan-out off-thread behind a sharded
+watcher index, bulk applies for the scheduler's commit path). For
+thread-herd hosts (1000 in-process kubelets) `serialized_writes=True`
+funnels mutations through one hot applier thread instead — etcd's
+single raft-apply loop, in spirit.
 """
 
 from __future__ import annotations
