@@ -977,6 +977,42 @@ def cmd_proxy(client: Client, args) -> int:
     return 0
 
 
+def cmd_trace(client: Client, args) -> int:
+    """Render recent scheduling traces as span trees (the CLI face of
+    GET /debug/traces): `ktctl trace <pod>` shows every trace that
+    touched the pod — enqueue through bind — with durations."""
+    from kubernetes_tpu.utils import tracing
+
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        data = get_json(
+            "/debug/traces",
+            query={"pod": args.name or "", "limit": str(args.limit)},
+        )
+    else:
+        # Transport without a raw-GET surface (LocalTransport: the
+        # injected in-process client of tests/embedding) — the trace
+        # buffer is process-local, read it directly.
+        data = tracing.DEFAULT_BUFFER.to_dicts(
+            pod=args.name or "", limit=args.limit
+        )
+    traces = data.get("traces", [])
+    if not traces:
+        what = f" for pod {args.name!r}" if args.name else ""
+        print(f"No traces found{what}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(data, default_flow_style=False))
+        return 0
+    for tr in traces:
+        print(tracing.format_trace(tr))
+    return 0
+
+
 def cmd_config(client: Client, args) -> int:
     """Reference: pkg/kubectl/cmd/config/ — view / set-cluster /
     set-credentials / set-context / use-context / set / unset over the
@@ -1158,6 +1194,11 @@ def build_parser() -> argparse.ArgumentParser:
     tp = sub.add_parser("top", parents=[common])
     tp.add_argument("what", choices=["nodes", "pods"])
     tp.set_defaults(fn=cmd_top)
+
+    tc = sub.add_parser("trace", parents=[common])
+    tc.add_argument("name", nargs="?", help="pod name (omit for all)")
+    tc.add_argument("--limit", type=int, default=16)
+    tc.set_defaults(fn=cmd_trace)
 
     pf = sub.add_parser("port-forward", parents=[common])
     pf.add_argument("name")

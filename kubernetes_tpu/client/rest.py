@@ -25,6 +25,7 @@ from kubernetes_tpu.models import serde
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.server.registry import RESOURCES
 from kubernetes_tpu.store.watch import Event
+from kubernetes_tpu.utils import tracing
 from kubernetes_tpu.utils.ratelimit import TokenBucket
 
 #: Failures that mean a pooled keep-alive connection went stale
@@ -79,12 +80,17 @@ class LocalTransport(Transport):
         self.api = api
 
     def request(self, verb, op, args, body=None, patch_type=None):
-        fn = getattr(self.api, op)
-        if patch_type is not None:
-            return fn(*args, body, patch_type=patch_type)
-        if body is not None:
-            return fn(*args, body)
-        return fn(*args)
+        # In-process "request span": the caller's trace context flows
+        # straight through (same thread), so this is the analog of the
+        # HTTP transport's X-Trace-Id hop. No-op without an active
+        # trace.
+        with tracing.span(f"api.{op}"):
+            fn = getattr(self.api, op)
+            if patch_type is not None:
+                return fn(*args, body, patch_type=patch_type)
+            if body is not None:
+                return fn(*args, body)
+            return fn(*args)
 
     def watch(self, resource, namespace, since, lsel, fsel):
         return self.api.watch(
@@ -314,6 +320,11 @@ class HTTPTransport(Transport):
         headers = dict(self.headers)
         if payload:
             headers["Content-Type"] = content_type
+        # Dapper hop: stamp the active trace id so the apiserver's
+        # handling of this request records under the same trace.
+        tid = tracing.current_trace_id()
+        if tid:
+            headers[tracing.TRACE_HEADER] = tid
         while True:
             conn, reused = self._pooled()
             try:
@@ -362,6 +373,13 @@ class HTTPTransport(Transport):
             if raw:
                 return raw_body.decode(errors="replace")
             return json.loads(raw_body or b"{}")
+
+    def get_json(self, path: str, query: Optional[Dict[str, str]] = None):
+        """Public raw GET for non-/api surfaces the typed verbs don't
+        model (debug endpoints, /metrics-adjacent JSON). Same pooled
+        connection, auth headers, and retry semantics as every other
+        request."""
+        return self._do("GET", path, query=query)
 
     def request(self, verb, op, args, body=None, patch_type=None):
         if op == "create":

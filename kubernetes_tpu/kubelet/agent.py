@@ -35,9 +35,11 @@ from kubernetes_tpu.models.objects import (
 from kubernetes_tpu.models.quantity import parse_quantity
 from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
 from kubernetes_tpu.server.api import APIError
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import metrics, tracing
 
-_SYNC_LATENCY = metrics.DEFAULT.summary(
+# Histogram (was a summary): bucketed sync latencies aggregate across
+# every kubelet in the fleet, which a per-instance summary can't.
+_SYNC_LATENCY = metrics.DEFAULT.histogram(
     "kubelet_sync_pod_latency_seconds", "Pod sync latency", ("node",)
 )
 _PODS_RUNNING = metrics.DEFAULT.gauge(
@@ -562,7 +564,23 @@ class Kubelet:
                 pass
 
     def _sync_pod(self, pod: Pod) -> None:
-        """One reconciliation of a single pod (kubelet.go:1092)."""
+        """One reconciliation of a single pod (kubelet.go:1092), under
+        a sync-loop trace so a pod's kubelet-side story lands in the
+        same /debug/traces surface as its scheduling."""
+        # record_threshold_s: a no-op resync sync (fake runtimes,
+        # already-converged pods) finishes in microseconds and runs for
+        # EVERY pod EVERY tick — recording those would flood the shared
+        # trace ring and evict the scheduling traces. Syncs that did
+        # real work (mounts, container starts, status writes) clear
+        # 10ms easily and are kept.
+        with tracing.trace(
+            "kubelet_sync_pod", pod=pod.metadata.name,
+            record_threshold_s=0.01,
+        ) as sp:
+            sp.note(node=self.node_name)
+            self._sync_pod_inner(pod)
+
+    def _sync_pod_inner(self, pod: Pod) -> None:
         import copy as _copy
 
         start = time.monotonic()
@@ -593,9 +611,11 @@ class Kubelet:
             self._volumes_mounted.add(uid)
 
         # Probes may demand restarts before the runtime sync.
-        self._run_probes(pod, uid)
+        with tracing.span("probes"):
+            self._run_probes(pod, uid)
 
-        containers = self.runtime.sync_pod(pod)
+        with tracing.span("runtime_sync"):
+            containers = self.runtime.sync_pod(pod)
         for c in containers:
             self._probes.note_started(f"{uid}/{c.name}", c.started_at)
         self._oom.observe(pod, containers)
@@ -656,9 +676,11 @@ class Kubelet:
             self._last_status[uid] = new_wire  # in sync with the server
         elif self._last_status.get(uid) != new_wire:
             try:
-                self.client.update_status(
-                    "pods", pod, namespace=pod.metadata.namespace or "default"
-                )
+                with tracing.span("status_write"):
+                    self.client.update_status(
+                        "pods", pod,
+                        namespace=pod.metadata.namespace or "default",
+                    )
                 self._last_status[uid] = new_wire
             except APIError:
                 self._last_status.pop(uid, None)  # retry next resync

@@ -378,28 +378,46 @@ class SolverSession:
         """Schedule the pending backlog against the device-resident
         cluster state; commits ride the donated carry. Returns
         [(pod_key, node_name | None)] and clears the backlog."""
+        from kubernetes_tpu.utils import tracing
+
         pending, self._pending = self._pending, []
         if not pending:
             self._flush_dirty()
             return []
-        self._flush_dirty()
-        pods = self._pod_arrays(pending)
-        if self.mode == "wave":
-            from kubernetes_tpu.ops.wave import solve_waves_with_state
+        # Phase spans cover the session tick's segments: "upload" is
+        # the dirty-row scatter plus staging this tick's pod arrays
+        # onto the device, "solve" the dispatch, "readback" the
+        # blocking copy-out (which therefore absorbs the async device
+        # time). The "lower" phase is the per-pod _lower_pod work,
+        # observed at the daemon's add_pending loop — NOT here, so each
+        # tick contributes exactly one observation per phase.
+        with tracing.phase(
+            "upload", dirty=len(self._dirty), pods=len(pending)
+        ):
+            self._flush_dirty()
+            pods = self._pod_arrays(pending)
+        with tracing.phase("solve", mode=self.mode, incremental=True):
+            if self.mode == "wave":
+                from kubernetes_tpu.ops.wave import solve_waves_with_state
 
-            assignment, self.dev, _ = solve_waves_with_state(
-                pods, self.dev, self.weights
-            )
-        elif self.mode == "sinkhorn":
-            from kubernetes_tpu.ops.sinkhorn import solve_sinkhorn_with_state
+                assignment, self.dev, _ = solve_waves_with_state(
+                    pods, self.dev, self.weights
+                )
+            elif self.mode == "sinkhorn":
+                from kubernetes_tpu.ops.sinkhorn import (
+                    solve_sinkhorn_with_state,
+                )
 
-            assignment, self.dev, _ = solve_sinkhorn_with_state(
-                pods, self.dev, self.weights
-            )
-        else:
-            assignment, self.dev = solve_with_state(pods, self.dev, self.weights)
+                assignment, self.dev, _ = solve_sinkhorn_with_state(
+                    pods, self.dev, self.weights
+                )
+            else:
+                assignment, self.dev = solve_with_state(
+                    pods, self.dev, self.weights
+                )
         out: List[Tuple[str, Optional[str]]] = []
-        picks = np.asarray(assignment)[: len(pending)]
+        with tracing.phase("readback"):
+            picks = np.asarray(assignment)[: len(pending)]
         for lp, j in zip(pending, picks.tolist()):
             if j < 0 or j >= self.N_cap or self.node_names[j] is None:
                 out.append((lp.key, None))

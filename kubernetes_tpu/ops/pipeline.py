@@ -34,6 +34,7 @@ from kubernetes_tpu.ops.matrices import (
     shardings_for,
 )
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
+from kubernetes_tpu.utils import tracing
 
 # Measured on v5e-1 at 50k x 5k with the pallas scan kernel: 12544
 # (4 chunks) walls 0.61-0.66s vs 0.88-0.96s at 8192 and 0.71-0.76s at
@@ -71,11 +72,18 @@ def solve_backlog_pipelined(
     commit in backlog order, so a chunk's pods see strictly MORE
     committed state than the same pods in one big window ever would.
     """
-    builder = SnapshotBuilder(pending, nodes, assigned, services)
-    node_sharding, pod_sharding = shardings_for(mesh)
-    carry = device_nodes(
-        builder.node_columns(), node_sharding, node_mult=node_axis_multiple(mesh)
-    )
+    # Phase spans wrap whole host-side segments, never per-pod work —
+    # their cost is a few monotonic reads per CHUNK. JAX dispatch is
+    # async, so per-chunk "solve" measures dispatch; the device time
+    # drains into the final blocking "readback".
+    with tracing.phase("lower", pods=len(pending)):
+        builder = SnapshotBuilder(pending, nodes, assigned, services)
+        node_sharding, pod_sharding = shardings_for(mesh)
+    with tracing.phase("upload"):
+        carry = device_nodes(
+            builder.node_columns(), node_sharding,
+            node_mult=node_axis_multiple(mesh),
+        )
     if mode == "scan":
         step = lambda dpods, carry: solve_with_state(dpods, carry, weights)
     elif mode == "wave":
@@ -94,25 +102,29 @@ def solve_backlog_pipelined(
         raise ValueError(f"unknown pipeline mode {mode!r}")
     P = len(builder.pending)
     outs = []
-    for start in range(0, max(P, 1), chunk):
-        cols = builder.pod_columns(start, min(start + chunk, P))
+    for ci, start in enumerate(range(0, max(P, 1), chunk)):
+        with tracing.phase("lower", chunk=ci):
+            cols = builder.pod_columns(start, min(start + chunk, P))
         # Full chunks share one executable; the (smaller) tail chunk
         # pads to its own 128 bucket rather than a full chunk, so small
         # backlogs and tails don't scan thousands of padding steps.
-        dpods = device_pods(cols, pod_sharding)
-        assignment, carry = step(dpods, carry)
-        # Start this chunk's device->host copy NOW: it rides behind the
-        # next chunk's device work instead of serializing at the end
-        # (the final np.asarray then finds the bytes already local).
-        if hasattr(assignment, "copy_to_host_async"):
-            assignment.copy_to_host_async()
+        with tracing.phase("upload", chunk=ci):
+            dpods = device_pods(cols, pod_sharding)
+        with tracing.phase("solve", chunk=ci):
+            assignment, carry = step(dpods, carry)
+            # Start this chunk's device->host copy NOW: it rides behind
+            # the next chunk's device work instead of serializing at the
+            # end (the final np.asarray finds the bytes already local).
+            if hasattr(assignment, "copy_to_host_async"):
+                assignment.copy_to_host_async()
         outs.append((assignment, cols.count))
 
-    names = [n.metadata.name for n in builder.nodes]
-    result: List[Optional[str]] = []
-    n_nodes = len(builder.nodes)
-    for assignment, count in outs:
-        picks = np.asarray(assignment)[:count]
-        for j in picks.tolist():
-            result.append(names[j] if 0 <= j < n_nodes else None)
-    return result
+    with tracing.phase("readback"):
+        names = [n.metadata.name for n in builder.nodes]
+        result: List[Optional[str]] = []
+        n_nodes = len(builder.nodes)
+        for assignment, count in outs:
+            picks = np.asarray(assignment)[:count]
+            for j in picks.tolist():
+                result.append(names[j] if 0 <= j < n_nodes else None)
+        return result

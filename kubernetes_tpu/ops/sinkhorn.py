@@ -109,8 +109,14 @@ def _priced_choose(masked, idx, valid, carry, N, *, eps, iters, price_cap):
 def sinkhorn_assignments(dsnap, **kw):
     """Run the Sinkhorn wave solver and strip padding: returns
     (i32[n_pods] with -1 = unschedulable, wave count)."""
-    out, waves = solve_sinkhorn(dsnap.pods, dsnap.nodes, **kw)
-    return strip_assignments(dsnap, out), int(waves)
+    from kubernetes_tpu.utils import tracing
+
+    with tracing.phase("solve", solver="sinkhorn") as sp:
+        out, waves = solve_sinkhorn(dsnap.pods, dsnap.nodes, **kw)
+        stripped = strip_assignments(dsnap, out)
+        waves = int(waves)
+        sp.note(waves=waves)
+    return stripped, waves
 
 
 @functools.partial(

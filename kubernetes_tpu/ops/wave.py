@@ -58,8 +58,17 @@ def strip_assignments(dsnap, out):
 def wave_assignments(dsnap, **kw):
     """Run the wave solver and strip padding: returns (i32[n_pods]
     with -1 = unschedulable, wave count)."""
-    out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
-    return strip_assignments(dsnap, out), int(waves)
+    from kubernetes_tpu.utils import tracing
+
+    # The per-wave loop itself is jitted (one device program), so the
+    # span carries the wave count as the device-side breakdown; the
+    # strip blocks, so this phase includes the device time.
+    with tracing.phase("solve", solver="wave") as sp:
+        out, waves = solve_waves(dsnap.pods, dsnap.nodes, **kw)
+        stripped = strip_assignments(dsnap, out)
+        waves = int(waves)
+        sp.note(waves=waves)
+    return stripped, waves
 
 FMAX = jnp.float32(3.4e38)
 

@@ -30,6 +30,7 @@ from kubernetes_tpu.scheduler.types import (
     StaticPodLister,
     StaticServiceLister,
 )
+from kubernetes_tpu.utils import tracing
 
 
 def resolve_batch_mode(mode: str, mesh=None) -> str:
@@ -63,6 +64,15 @@ def schedule_backlog_scalar(
     unschedulable). `spec` selects the configured plugin set — the
     fallback path must honor scheduler policy, not silently revert to
     defaults (round-2 VERDICT Weak #1)."""
+    # Distinct phase label: whole-backlog scalar plugin-loop times are
+    # seconds where device "solve" dispatch is sub-ms — folding them
+    # into one histogram series would make its percentiles a mixture
+    # nobody can decompose.
+    with tracing.phase("solve_scalar", pods=len(pending)):
+        return _schedule_backlog_scalar(pending, nodes, assigned, services, spec)
+
+
+def _schedule_backlog_scalar(pending, nodes, assigned, services, spec):
     committed: List[Pod] = list(assigned)
     pod_lister = StaticPodLister(committed)  # shared, mutated as we commit
     args = PluginFactoryArgs(
@@ -113,13 +123,19 @@ def schedule_backlog_tpu(
     the scalar path WITH the spec)."""
     from kubernetes_tpu.ops import device_snapshot, solve_assignments
 
-    snap = build_snapshot(
-        pending, nodes, assigned_pods=assigned, services=services, spec=spec
-    )
-    dsnap = device_snapshot(snap, mesh=mesh)
-    assignment = solve_assignments(dsnap)
-    names = snap.nodes.names
-    return [names[i] if i >= 0 else None for i in assignment]
+    with tracing.phase("lower", pods=len(pending)):
+        snap = build_snapshot(
+            pending, nodes, assigned_pods=assigned, services=services, spec=spec
+        )
+    with tracing.phase("upload"):
+        dsnap = device_snapshot(snap, mesh=mesh)
+    with tracing.phase("solve", mode="scan"):
+        # solve_assignments blocks on the host copy internally, so this
+        # phase captures the device time (unlike the async pipeline).
+        assignment = solve_assignments(dsnap)
+    with tracing.phase("readback"):
+        names = snap.nodes.names
+        return [names[i] if i >= 0 else None for i in assignment]
 
 
 def schedule_backlog_wave(
@@ -138,11 +154,18 @@ def schedule_backlog_wave(
     from kubernetes_tpu.ops import device_snapshot
     from kubernetes_tpu.ops.wave import wave_assignments
 
-    snap = build_snapshot(pending, nodes, assigned_pods=assigned, services=services)
-    dsnap = device_snapshot(snap, mesh=mesh)
+    with tracing.phase("lower", pods=len(pending)):
+        snap = build_snapshot(
+            pending, nodes, assigned_pods=assigned, services=services
+        )
+    with tracing.phase("upload"):
+        dsnap = device_snapshot(snap, mesh=mesh)
+    # wave_assignments opens the "solve" phase itself (it knows the
+    # wave count) and blocks on the strip, so readback is the residue.
     assignment, _waves = wave_assignments(dsnap)
-    names = snap.nodes.names
-    return [names[i] if i >= 0 else None for i in assignment]
+    with tracing.phase("readback"):
+        names = snap.nodes.names
+        return [names[i] if i >= 0 else None for i in assignment]
 
 
 def schedule_backlog_sinkhorn(
@@ -160,11 +183,16 @@ def schedule_backlog_sinkhorn(
     from kubernetes_tpu.ops import device_snapshot
     from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments
 
-    snap = build_snapshot(pending, nodes, assigned_pods=assigned, services=services)
-    dsnap = device_snapshot(snap, mesh=mesh)
+    with tracing.phase("lower", pods=len(pending)):
+        snap = build_snapshot(
+            pending, nodes, assigned_pods=assigned, services=services
+        )
+    with tracing.phase("upload"):
+        dsnap = device_snapshot(snap, mesh=mesh)
     assignment, _waves = sinkhorn_assignments(dsnap)
-    names = snap.nodes.names
-    return [names[i] if i >= 0 else None for i in assignment]
+    with tracing.phase("readback"):
+        names = snap.nodes.names
+        return [names[i] if i >= 0 else None for i in assignment]
 
 
 def parity_report(

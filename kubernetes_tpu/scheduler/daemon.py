@@ -35,17 +35,20 @@ from kubernetes_tpu.scheduler.plugins import (
 _LOG = logging.getLogger("kubernetes_tpu.scheduler")
 from kubernetes_tpu.scheduler.types import StaticNodeLister, StaticServiceLister
 from kubernetes_tpu.server.api import APIError
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import metrics, tracing
 from kubernetes_tpu.utils.ratelimit import Backoff, TokenBucket
 
-_E2E_LATENCY = metrics.DEFAULT.summary(
+# Histograms (were summaries): bucketed latencies aggregate across
+# daemons and expose the +le series the SLO checks interpolate; the
+# per-phase breakdown lives in scheduler_phase_seconds (utils/tracing).
+_E2E_LATENCY = metrics.DEFAULT.histogram(
     "scheduler_e2e_scheduling_latency_seconds",
     "E2e scheduling latency (scheduling algorithm + binding)",
 )
-_ALGO_LATENCY = metrics.DEFAULT.summary(
+_ALGO_LATENCY = metrics.DEFAULT.histogram(
     "scheduler_scheduling_algorithm_latency_seconds", "Scheduling algorithm latency"
 )
-_BIND_LATENCY = metrics.DEFAULT.summary(
+_BIND_LATENCY = metrics.DEFAULT.histogram(
     "scheduler_binding_latency_seconds", "Binding latency"
 )
 _SCHEDULED = metrics.DEFAULT.counter(
@@ -291,42 +294,54 @@ class Scheduler:
         if cfg.bind_limiter is not None:
             cfg.bind_limiter.accept()
         start = time.monotonic()
-        try:
-            t0 = time.monotonic()
-            dest = cfg.algorithm.schedule(pod, cfg.node_lister)
-            _ALGO_LATENCY.observe(time.monotonic() - t0)
-        except (FitError, NoNodesError, KeyError) as e:
-            # KeyError: a node vanished between list and predicate lookup
-            # (the watch mutates the cache concurrently) — treat like an
-            # unschedulable attempt and retry.
-            _SCHEDULED.inc(result="unschedulable")
-            cfg.client.record_event(pod, "FailedScheduling", str(e), source="scheduler")
-            self._requeue_later(pod)
-            return True
-        try:
-            t0 = time.monotonic()
-            cfg.binder.bind(
-                pod.metadata.name, dest, namespace=pod.metadata.namespace or "default"
-            )
-            _BIND_LATENCY.observe(time.monotonic() - t0)
-        except APIError as e:
-            _SCHEDULED.inc(result="bind_error")
+        with tracing.trace(
+            "schedule_one", pod=pod.metadata.name
+        ) as tr:
+            tr.step("enqueue")
+            try:
+                t0 = time.monotonic()
+                with tracing.span("algorithm"):
+                    dest = cfg.algorithm.schedule(pod, cfg.node_lister)
+                _ALGO_LATENCY.observe(time.monotonic() - t0)
+            except (FitError, NoNodesError, KeyError) as e:
+                # KeyError: a node vanished between list and predicate
+                # lookup (the watch mutates the cache concurrently) —
+                # treat like an unschedulable attempt and retry.
+                _SCHEDULED.inc(result="unschedulable")
+                cfg.client.record_event(
+                    pod, "FailedScheduling", str(e), source="scheduler"
+                )
+                self._requeue_later(pod)
+                return True
+            try:
+                t0 = time.monotonic()
+                # "bind_one", not "bind": a single-pod HTTP bind and a
+                # 50k-pod bulk commit must not share one series.
+                with tracing.phase("bind_one"):
+                    cfg.binder.bind(
+                        pod.metadata.name, dest,
+                        namespace=pod.metadata.namespace or "default",
+                    )
+                _BIND_LATENCY.observe(time.monotonic() - t0)
+            except APIError as e:
+                _SCHEDULED.inc(result="bind_error")
+                cfg.client.record_event(
+                    pod, "FailedBinding", str(e), source="scheduler"
+                )
+                self._requeue_later(pod)
+                return True
+            # Assume so capacity is held before the watch confirms
+            # (scheduler.go:142-157).
+            pod.spec.node_name = dest
+            cfg.modeler.assume_pod(pod)
+            _SCHEDULED.inc(result="scheduled")
+            _E2E_LATENCY.observe(time.monotonic() - start)
             cfg.client.record_event(
-                pod, "FailedBinding", str(e), source="scheduler"
+                pod, "Scheduled",
+                f"Successfully assigned {pod.metadata.name} to {dest}",
+                source="scheduler",
             )
-            self._requeue_later(pod)
             return True
-        # Assume so capacity is held before the watch confirms
-        # (scheduler.go:142-157).
-        pod.spec.node_name = dest
-        cfg.modeler.assume_pod(pod)
-        _SCHEDULED.inc(result="scheduled")
-        _E2E_LATENCY.observe(time.monotonic() - start)
-        cfg.client.record_event(
-            pod, "Scheduled", f"Successfully assigned {pod.metadata.name} to {dest}",
-            source="scheduler",
-        )
-        return True
 
     def _refetch_and_requeue(self, pod: Pod) -> None:
         """Re-fetch `pod` and re-add it to the queue if still pending.
@@ -479,6 +494,27 @@ class BatchScheduler(Scheduler):
 
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
         """One drain+solve+commit cycle; returns pods processed."""
+        t_drain = time.monotonic()
+        pending = self._drain(timeout)
+        if not pending:
+            return 0
+        # One trace per cycle (a per-pod trace at 50k-pod batches would
+        # be pure overhead): the pod set rides the trace for filtering,
+        # the phase spans (enqueue/lower/upload/solve/readback/bind)
+        # tell one pod's story because every pod in the batch shares
+        # them.
+        with tracing.trace(
+            "schedule_batch",
+            pods=(p.metadata.name for p in pending),
+            start=t_drain,
+        ) as tr:
+            tr.child(
+                "enqueue", start=t_drain, end=time.monotonic(),
+                pods=len(pending), mode=self.mode,
+            )
+            return self._solve_and_commit(pending)
+
+    def _solve_and_commit(self, pending: List[Pod]) -> int:
         from kubernetes_tpu.scheduler.batch import (
             schedule_backlog_scalar,
             schedule_backlog_sinkhorn,
@@ -487,9 +523,6 @@ class BatchScheduler(Scheduler):
         )
 
         cfg = self.config
-        pending = self._drain(timeout)
-        if not pending:
-            return 0
         start = time.monotonic()
         nodes = cfg.nodes.store.list()  # unfiltered; snapshot encodes readiness
         assigned = cfg.pod_lister.list()
@@ -506,10 +539,14 @@ class BatchScheduler(Scheduler):
             # carries it), so wave + sidecar compose instead of the
             # sidecar silently downgrading an explicit wave request.
             def solver(pending, nodes, assigned, services):
-                return self.sidecar.solve(
-                    pending, nodes, assigned, services, mode=self.mode,
-                    spec=self.spec,
-                )
+                # Distinct phase label: this times the whole remote
+                # round-trip (the sidecar's own lower/upload/readback
+                # happen in its process), not in-process dispatch.
+                with tracing.phase("solve_sidecar", mode=self.mode):
+                    return self.sidecar.solve(
+                        pending, nodes, assigned, services, mode=self.mode,
+                        spec=self.spec,
+                    )
         elif self.mode == "wave":
             solver = schedule_backlog_wave
         elif self.mode == "sinkhorn":
@@ -553,16 +590,17 @@ class BatchScheduler(Scheduler):
 
         t0 = time.monotonic()
         outcome: Dict[Tuple[str, str], dict] = {}
-        try:
-            for ns, items in by_ns.items():
-                results = cfg.binder.bind_bulk(items, namespace=ns)
-                for (pod_name, _dest), res in zip(items, results):
-                    outcome[(ns, pod_name)] = res
-        except Exception:
-            # Transport/apiserver failure mid-commit: pods without a
-            # recorded outcome get retried (already-committed ones are
-            # 409s next round, which is fine).
-            pass
+        with tracing.phase("bind", pods=len(placed)):
+            try:
+                for ns, items in by_ns.items():
+                    results = cfg.binder.bind_bulk(items, namespace=ns)
+                    for (pod_name, _dest), res in zip(items, results):
+                        outcome[(ns, pod_name)] = res
+            except Exception:
+                # Transport/apiserver failure mid-commit: pods without a
+                # recorded outcome get retried (already-committed ones
+                # are 409s next round, which is fine).
+                pass
         if by_ns:
             _BIND_LATENCY.observe(time.monotonic() - t0)
 
@@ -700,9 +738,7 @@ class IncrementalBatchScheduler(BatchScheduler):
         return True
 
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
-        from kubernetes_tpu.ops import RebuildRequired
-
-        cfg = self.config
+        t_drain = time.monotonic()
         pending = self._drain(timeout)
         if not pending:
             # Keep the session current while idle so the next burst
@@ -722,6 +758,19 @@ class IncrementalBatchScheduler(BatchScheduler):
                 # up unboundedly in a quiet cluster.
                 self._event_q.clear()
             return 0
+        with tracing.trace(
+            "schedule_batch",
+            pods=(p.metadata.name for p in pending),
+            start=t_drain,
+        ) as tr:
+            tr.child(
+                "enqueue", start=t_drain, end=time.monotonic(),
+                pods=len(pending), mode=self.mode, incremental=True,
+            )
+            return self._session_solve_and_commit(pending)
+
+    def _session_solve_and_commit(self, pending: List[Pod]) -> int:
+        cfg = self.config
         start = time.monotonic()
         try:
             t0 = time.monotonic()
@@ -734,10 +783,14 @@ class IncrementalBatchScheduler(BatchScheduler):
             # — its watch event just charged the session. Feeding it to
             # solve() would double-charge and orphan the true charge
             # when the 409 rollback fires.
-            for pod in pending:
-                key = f"{pod.metadata.namespace or 'default'}/{pod.metadata.name}"
-                if not self._session.has_assigned(key):
-                    self._session.add_pending(pod)
+            with tracing.phase("lower", pods=len(pending)):
+                for pod in pending:
+                    key = (
+                        f"{pod.metadata.namespace or 'default'}/"
+                        f"{pod.metadata.name}"
+                    )
+                    if not self._session.has_assigned(key):
+                        self._session.add_pending(pod)
             results = self._session.solve()
             _ALGO_LATENCY.observe(time.monotonic() - t0)
         except Exception:
@@ -772,13 +825,14 @@ class IncrementalBatchScheduler(BatchScheduler):
 
         t0 = time.monotonic()
         outcome: Dict[Tuple[str, str], dict] = {}
-        try:
-            for ns, items in by_ns.items():
-                bind_results = cfg.binder.bind_bulk(items, namespace=ns)
-                for (pod_name, _dest), res in zip(items, bind_results):
-                    outcome[(ns, pod_name)] = res
-        except Exception:
-            pass  # unrecorded outcomes retry below; dupes 409 next round
+        with tracing.phase("bind", pods=len(placed)):
+            try:
+                for ns, items in by_ns.items():
+                    bind_results = cfg.binder.bind_bulk(items, namespace=ns)
+                    for (pod_name, _dest), res in zip(items, bind_results):
+                        outcome[(ns, pod_name)] = res
+            except Exception:
+                pass  # unrecorded outcomes retry; dupes 409 next round
         if by_ns:
             _BIND_LATENCY.observe(time.monotonic() - t0)
 

@@ -32,13 +32,17 @@ from kubernetes_tpu import __version__
 from kubernetes_tpu.models import conversion
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.server.registry import RESOURCES
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import metrics, tracing
 
 _REQS = metrics.DEFAULT.counter(
     "apiserver_request_count", "API requests by verb/resource/code",
     ("verb", "resource", "code"),
 )
-_LATENCY = metrics.DEFAULT.summary(
+# Histogram (not summary): bucketed latencies aggregate across
+# scrapes/instances and the SLO gate reads interpolated quantiles off
+# the same series (the reference moved the scheduler/apiserver SLO
+# metrics the same way).
+_LATENCY = metrics.DEFAULT.histogram(
     "apiserver_request_latencies_seconds", "API request latency",
     ("verb", "resource"),
 )
@@ -91,8 +95,7 @@ def reset_request_latency() -> None:
     suites share ONE registry across many clusters, so a test gating
     on p99 must open its own window or it inherits every earlier
     test's observations."""
-    with _LATENCY._lock:
-        _LATENCY._stats.clear()
+    _LATENCY.reset()
 
 
 def high_latency_requests(threshold: float = 1.0, summary=None):
@@ -104,8 +107,7 @@ def high_latency_requests(threshold: float = 1.0, summary=None):
     own so suites sharing the process-global registry can't pollute
     each other's gates."""
     summary = summary if summary is not None else _LATENCY
-    with summary._lock:
-        keys = list(summary._stats.keys())
+    keys = summary.label_values()
     out = []
     for verb, resource in keys:
         if resource.rsplit("/", 1)[-1] in _LONG_RUNNING:
@@ -191,6 +193,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _serve_debug(self, rest: Tuple[str, ...]) -> None:
         from kubernetes_tpu.utils import debug
 
+        if rest == ("traces",):
+            # Recent scheduling traces (this process's buffer — the
+            # in-process cluster topology shares one buffer across all
+            # daemons), filterable to traces touching one pod.
+            try:
+                limit = int(self.query.get("limit", "64"))
+            except ValueError:
+                raise APIError(400, "BadRequest", "limit must be numeric")
+            self._send_text(
+                200,
+                tracing.render_json(
+                    pod=self.query.get("pod", ""), limit=limit
+                ),
+                "application/json",
+            )
+            return
         if rest == ("requests",):
             body = debug.DEFAULT_REQUEST_LOG.render()
         elif rest == ("stacks",):
@@ -204,7 +222,8 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             raise APIError(
                 404, "NotFound",
-                "debug endpoints: /debug/requests /debug/stacks /debug/profile",
+                "debug endpoints: /debug/requests /debug/stacks "
+                "/debug/profile /debug/traces",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
 
@@ -231,6 +250,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch("PATCH")
 
     def _dispatch(self, verb: str) -> None:
+        # Propagated request trace (Dapper hop): a client that stamped
+        # X-Trace-Id gets this request recorded as a span under ITS
+        # trace id — the scheduler's bind call and the apiserver's
+        # handling merge into one trace at /debug/traces. No header,
+        # no cost. (In-process LocalTransport calls skip HTTP entirely
+        # and join the caller's trace via the contextvar instead.)
+        tid = self.headers.get(tracing.TRACE_HEADER)
+        if not tid:
+            return self._dispatch_inner(verb)
+        with tracing.trace(
+            f"{verb} {urlparse(self.path).path}", trace_id=tid
+        ):
+            return self._dispatch_inner(verb)
+
+    def _dispatch_inner(self, verb: str) -> None:
         start = time.monotonic()
         resource = ""
         code = 200
@@ -485,11 +519,23 @@ class _Handler(BaseHTTPRequestHandler):
             ns = rest[1]
             resource = rest[2]
             if resource == "bindings" and verb == "POST":
-                out = api.bind(ns, self._read_body())
+                body = self._read_body()
+                name = body.get("metadata", {}).get("name", "")
+                if name:
+                    tracing.note_pods((name,))
+                out = api.bind(ns, body)
                 self._send_json(201, out)
                 return "bindings", 201
             if resource == "bulkbindings" and verb == "POST":
                 body = self._read_body()
+                tracing.note_pods(
+                    n
+                    for n in (
+                        b.get("metadata", {}).get("name", "")
+                        for b in body.get("bindings", ())
+                    )
+                    if n
+                )
                 results = api.bind_bulk(ns, body.get("bindings", []))
                 self._send_json(
                     200, {"kind": "BindingResultList", "results": results}
@@ -506,6 +552,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._collection(verb, resource, ns, lsel, fsel)
             name = rest[3]
             if len(rest) == 5 and rest[4] == "binding" and verb == "POST":
+                tracing.note_pods((name,))
                 body = self._read_body()
                 body.setdefault("metadata", {})["name"] = name
                 out = api.bind(ns, body)
@@ -850,7 +897,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, api.list(resource, ns, lsel, fsel, copy=False))
             return resource, 200
         if verb == "POST":
-            out = api.create(resource, ns, self._read_body(self._kind_of(resource)))
+            body = self._read_body(self._kind_of(resource))
+            if resource == "pods":
+                name = body.get("metadata", {}).get("name", "")
+                if name:
+                    tracing.note_pods((name,))
+            out = api.create(resource, ns, body)
             self._send_json(201, out)
             return resource, 201
         raise APIError(405, "MethodNotAllowed", f"{verb} not allowed on collection")

@@ -10,8 +10,25 @@ Prometheus text exposition format at /metrics.
 from __future__ import annotations
 
 import math
+import random
 import threading
 from typing import Dict, List, Sequence, Tuple
+
+#: Module-level RNG so reservoir sampling is seedable in tests
+#: (metrics._RNG.seed(...)) and the hot observe() path never re-imports.
+_RNG = random.Random()
+
+
+def _escape_label_value(v: str) -> str:
+    """Per the Prometheus text exposition format, label values escape
+    backslash, double-quote, and newline — a pod name carrying '"'
+    must not corrupt the /metrics output."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 class _Metric:
@@ -24,11 +41,31 @@ class _Metric:
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
         return tuple(labels.get(k, "") for k in self.label_names)
 
+    def _header(self, type_: str) -> List[str]:
+        help_ = self.help.replace("\\", "\\\\").replace("\n", "\\n")
+        return [f"# HELP {self.name} {help_}", f"# TYPE {self.name} {type_}"]
+
+    def reset(self) -> None:
+        """Drop every series (fresh measurement window — SLO gates and
+        benches open their own windows on the process-global registry)."""
+        with self._lock:
+            getattr(self, "_stats", getattr(self, "_values", {})).clear()
+
+    def label_values(self) -> List[Tuple[str, ...]]:
+        """Label-value tuples of the live series, ordered like
+        label_names."""
+        with self._lock:
+            return list(
+                getattr(self, "_stats", getattr(self, "_values", {}))
+            )
+
     @staticmethod
     def _fmt_labels(names, values) -> str:
         if not names:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in zip(names, values))
+        inner = ",".join(
+            f'{k}="{_escape_label_value(v)}"' for k, v in zip(names, values)
+        )
         return "{" + inner + "}"
 
 
@@ -47,7 +84,7 @@ class Counter(_Metric):
             return self._values.get(self._key(labels), 0.0)
 
     def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        out = self._header("counter")
         with self._lock:
             for k, v in sorted(self._values.items()):
                 out.append(f"{self.name}{self._fmt_labels(self.label_names, k)} {v}")
@@ -68,7 +105,7 @@ class Gauge(_Metric):
             return self._values.get(self._key(labels), 0.0)
 
     def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        out = self._header("gauge")
         with self._lock:
             for k, v in sorted(self._values.items()):
                 out.append(f"{self.name}{self._fmt_labels(self.label_names, k)} {v}")
@@ -98,9 +135,7 @@ class Summary(_Metric):
                 res.append(value)
             else:
                 # Reservoir sampling keeps the estimate unbiased.
-                import random
-
-                i = random.randrange(s["count"])
+                i = _RNG.randrange(s["count"])
                 if i < self.RESERVOIR:
                     res[i] = value
 
@@ -114,7 +149,7 @@ class Summary(_Metric):
             return xs[idx]
 
     def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} summary"]
+        out = self._header("summary")
         with self._lock:
             for k, s in sorted(self._stats.items()):
                 xs = sorted(s["res"])
@@ -136,6 +171,105 @@ class Summary(_Metric):
         return out
 
 
+#: client_golang's DefBuckets: tuned for request/phase latencies in
+#: seconds, 5ms through 10s.
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+def _fmt_float(v: float) -> str:
+    """Bucket-bound formatting like client_golang: '0.005', '1', '10'."""
+    return f"{v:g}"
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (the Prometheus exposition model's
+    native latency type): per label set, one count per `le` bucket plus
+    running sum/count. Unlike Summary, bucket counts aggregate across
+    scrapes and instances, which is why the SLO-feeding latency series
+    use this type. Internal state lives in `_stats` keyed like
+    Summary's, so histogram and summary series are interchangeable to
+    readers such as high_latency_requests / reset_request_latency."""
+
+    def __init__(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._stats: Dict[Tuple[str, ...], Dict] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            s = self._stats.get(k)
+            if s is None:
+                s = self._stats[k] = {
+                    "count": 0,
+                    "sum": 0.0,
+                    "buckets": [0] * len(self.buckets),
+                }
+            s["count"] += 1
+            s["sum"] += value
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    s["buckets"][i] += 1
+                    break
+            # value > highest bound: only the implicit +Inf bucket
+            # (== count) observes it.
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._stats.get(self._key(labels))
+            return s["count"] if s else 0
+
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile (histogram_quantile semantics):
+        linear within the bucket holding rank q*count; observations
+        beyond the highest finite bound report that bound."""
+        with self._lock:
+            s = self._stats.get(self._key(labels))
+            if not s or s["count"] == 0:
+                return math.nan
+            counts = list(s["buckets"])
+            total = s["count"]
+        rank = q * total
+        cum = 0.0
+        lo = 0.0
+        for ub, c in zip(self.buckets, counts):
+            if c and cum + c >= rank:
+                return lo + (ub - lo) * max(0.0, min(1.0, (rank - cum) / c))
+            cum += c
+            lo = ub
+        return self.buckets[-1]
+
+    def render(self) -> List[str]:
+        out = self._header("histogram")
+        bnames = self.label_names + ("le",)
+        with self._lock:
+            for k, s in sorted(self._stats.items()):
+                cum = 0
+                for ub, c in zip(self.buckets, s["buckets"]):
+                    cum += c
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{self._fmt_labels(bnames, k + (_fmt_float(ub),))}"
+                        f" {cum}"
+                    )
+                # The +Inf bucket is total count by construction.
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{self._fmt_labels(bnames, k + ('+Inf',))} {s['count']}"
+                )
+                out.append(
+                    f"{self.name}_sum{self._fmt_labels(self.label_names, k)}"
+                    f" {s['sum']}"
+                )
+                out.append(
+                    f"{self.name}_count{self._fmt_labels(self.label_names, k)}"
+                    f" {s['count']}"
+                )
+        return out
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -153,6 +287,13 @@ class Registry:
 
     def summary(self, name, help_="", labels=()) -> Summary:
         return self.register(Summary(name, help_, labels))  # type: ignore
+
+    def histogram(
+        self, name, help_="", labels=(), buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self.register(
+            Histogram(name, help_, labels, buckets)
+        )  # type: ignore
 
     def render(self) -> str:
         with self._lock:

@@ -1,0 +1,180 @@
+"""Prometheus text exposition format: golden output, label escaping,
+histogram bucket invariants, summary quantile rendering — plus the
+metric-name lint (tools/lint_metrics.py) over the live package."""
+
+import pathlib
+import subprocess
+import sys
+
+from kubernetes_tpu.utils import metrics
+
+
+class TestCounterGauge:
+    def test_counter_golden(self):
+        c = metrics.Counter("widgets_total", "Widgets made", ("kind",))
+        c.inc(kind="round")
+        c.inc(2, kind="square")
+        c.inc(kind="square")
+        assert c.render() == [
+            "# HELP widgets_total Widgets made",
+            "# TYPE widgets_total counter",
+            'widgets_total{kind="round"} 1.0',
+            'widgets_total{kind="square"} 3.0',
+        ]
+
+    def test_gauge_golden(self):
+        g = metrics.Gauge("queue_depth_bytes", "Depth")
+        g.set(7)
+        assert g.render() == [
+            "# HELP queue_depth_bytes Depth",
+            "# TYPE queue_depth_bytes gauge",
+            "queue_depth_bytes 7",
+        ]
+
+    def test_label_value_escaping(self):
+        """Backslash, double-quote, and newline must be escaped per the
+        text exposition format — a pod name carrying '"' used to
+        corrupt the /metrics output."""
+        c = metrics.Counter("pods_total", "by pod", ("pod",))
+        c.inc(pod='we"ird\\name\nx')
+        line = c.render()[-1]
+        assert line == 'pods_total{pod="we\\"ird\\\\name\\nx"} 1.0'
+        # The exposition line stays one physical line — the raw newline
+        # never leaks into the output.
+        assert "\n" not in line
+
+    def test_help_escaping(self):
+        c = metrics.Counter("x_total", "line1\nline2")
+        assert c.render()[0] == "# HELP x_total line1\\nline2"
+
+
+class TestHistogram:
+    def test_type_line_and_buckets(self):
+        h = metrics.Histogram(
+            "req_seconds", "Request latency", buckets=(0.1, 1.0, 10.0)
+        )
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        lines = h.render()
+        assert lines[0] == "# HELP req_seconds Request latency"
+        assert lines[1] == "# TYPE req_seconds histogram"
+        assert lines[2:] == [
+            'req_seconds_bucket{le="0.1"} 1',
+            'req_seconds_bucket{le="1"} 3',
+            'req_seconds_bucket{le="10"} 4',
+            'req_seconds_bucket{le="+Inf"} 5',
+            "req_seconds_sum 56.05",
+            "req_seconds_count 5",
+        ]
+
+    def test_bucket_monotonicity_and_inf_equals_count(self):
+        h = metrics.Histogram("lat_seconds", "x", ("phase",))
+        import random
+
+        rng = random.Random(7)
+        for _ in range(500):
+            h.observe(rng.expovariate(2.0), phase="solve")
+        cums = []
+        inf_val = count_val = None
+        for line in h.render():
+            if line.startswith("lat_seconds_bucket"):
+                v = int(line.rsplit(" ", 1)[1])
+                if 'le="+Inf"' in line:
+                    inf_val = v
+                else:
+                    cums.append(v)
+            elif line.startswith("lat_seconds_count"):
+                count_val = int(line.rsplit(" ", 1)[1])
+        assert cums == sorted(cums), "bucket counts must be cumulative"
+        assert inf_val == count_val == 500
+        assert cums[-1] <= inf_val
+
+    def test_quantile_interpolation(self):
+        h = metrics.Histogram("q_seconds", "x", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5,) * 50 + (1.5,) * 50:
+            h.observe(v)
+        # Median sits at the boundary of the first bucket.
+        assert h.quantile(0.5) == 1.0
+        # p99 interpolates inside the (1, 2] bucket.
+        assert 1.9 < h.quantile(0.99) <= 2.0
+        # Values beyond the top bound report the top finite bound.
+        h2 = metrics.Histogram("q2_seconds", "x", buckets=(1.0,))
+        h2.observe(100.0)
+        assert h2.quantile(0.99) == 1.0
+        # Empty series: NaN.
+        import math
+
+        assert math.isnan(metrics.Histogram("q3_seconds", "x").quantile(0.5))
+
+    def test_registry_histogram_in_default_render(self):
+        h = metrics.DEFAULT.histogram(
+            "exposition_test_seconds", "temp", ("k",)
+        )
+        h.observe(0.2, k="v")
+        text = metrics.DEFAULT.render()
+        assert "# TYPE exposition_test_seconds histogram" in text
+        assert 'exposition_test_seconds_bucket{k="v",le="+Inf"} 1' in text
+
+
+class TestSummary:
+    def test_quantile_rendering(self):
+        s = metrics.Summary("sum_seconds", "x", quantiles=(0.5, 0.99))
+        for v in range(1, 101):
+            s.observe(float(v))
+        lines = s.render()
+        assert lines[1] == "# TYPE sum_seconds summary"
+        assert 'sum_seconds{quantile="0.5"} 50.0' in lines
+        assert 'sum_seconds{quantile="0.99"} 99.0' in lines
+        assert "sum_seconds_sum 5050.0" in lines
+        assert "sum_seconds_count 100" in lines
+
+    def test_reservoir_seedable(self):
+        """Reservoir sampling draws from the module-level RNG, so tests
+        can seed it for reproducible eviction patterns (and observe()
+        no longer imports random on the hot path)."""
+
+        def run():
+            metrics._RNG.seed(42)
+            s = metrics.Summary("seed_seconds", "x")
+            for v in range(5000):
+                s.observe(float(v))
+            return sorted(s._stats[()]["res"])
+
+        assert run() == run()
+
+
+def test_lint_metrics_clean():
+    """tools/lint_metrics.py over the live package: every registered
+    metric is snake_case, unit-suffixed, and on metrics.DEFAULT."""
+    root = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "lint_metrics.py"),
+         str(root / "kubernetes_tpu")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_lint_metrics_catches_violations(tmp_path):
+    root = pathlib.Path(__file__).resolve().parent.parent
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        "from kubernetes_tpu.utils.metrics import Counter\n"
+        'A = metrics.DEFAULT.counter("CamelCase", "x")\n'
+        'B = metrics.DEFAULT.gauge("no_unit_suffix", "x")\n'
+        'C = metrics.Summary("rogue_seconds", "x")\n'
+        'D = Counter("imported_bypass_seconds", "x")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "lint_metrics.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "not snake_case" in proc.stderr
+    assert "lacks a unit suffix" in proc.stderr
+    assert "bypasses metrics.DEFAULT" in proc.stderr
+    # Both bypass shapes are caught: metrics.Summary(...) AND the
+    # from-import form Counter(...).
+    assert proc.stderr.count("bypasses metrics.DEFAULT") == 2

@@ -1,0 +1,265 @@
+"""End-to-end scheduling traces: Span/Trace mechanics, X-Trace-Id
+propagation through the HTTP boundary, the /debug/traces surface,
+`ktctl trace`, and the acceptance path — a pod scheduled through the
+batch daemon yields one trace with enqueue/lower/upload/solve/
+readback/bind steps."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.scheduler.daemon import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+from kubernetes_tpu.utils import metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.configure(sample_rate=1.0, log_threshold_s=0.0)
+    tracing.DEFAULT_BUFFER.clear()
+    yield
+    tracing.configure(sample_rate=1.0, log_threshold_s=0.0)
+    tracing.DEFAULT_BUFFER.clear()
+
+
+def pod_wire(name):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {"name": "c", "image": "nginx",
+                 "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}}}
+            ]
+        },
+    }
+
+
+def node_wire(name):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "status": {
+            "capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def span_names(trace_dict):
+    names = set()
+
+    def walk(s):
+        names.add(s["name"])
+        for c in s.get("children", ()):
+            walk(c)
+
+    for root in trace_dict["spans"]:
+        walk(root)
+    return names
+
+
+class TestSpanMechanics:
+    def test_nesting_steps_fields(self):
+        with tracing.trace("root", pod="p1") as tr:
+            tr.step("marker")
+            with tracing.span("child") as sp:
+                sp.note(k="v")
+                with tracing.span("grandchild"):
+                    pass
+        d = tracing.DEFAULT_BUFFER.to_dicts(pod="p1")["traces"][0]
+        root = d["spans"][0]
+        assert root["name"] == "root"
+        assert [s["label"] for s in root["steps"]] == ["marker"]
+        child = root["children"][0]
+        assert child["name"] == "child"
+        assert child["fields"] == {"k": "v"}
+        assert child["children"][0]["name"] == "grandchild"
+        assert d["pods"] == ["p1"]
+        assert root["duration_s"] >= 0
+
+    def test_nested_trace_joins_parent(self):
+        """A trace() inside an active trace becomes a child span, not a
+        second buffer entry (the incremental daemon's scalar fallback
+        relies on this)."""
+        with tracing.trace("outer", pod="p"):
+            with tracing.trace("inner", pod="q"):
+                pass
+        out = tracing.DEFAULT_BUFFER.to_dicts()["traces"]
+        assert len(out) == 1
+        assert span_names(out[0]) == {"outer", "inner"}
+        assert out[0]["pods"] == ["p", "q"]
+
+    def test_sampling_zero_records_nothing_but_phases_observe(self):
+        tracing.configure(sample_rate=0.0)
+        before = tracing.PHASE_SECONDS.count(phase="unit_test_phase")
+        with tracing.trace("invisible", pod="p"):
+            with tracing.phase("unit_test_phase"):
+                pass
+        assert tracing.DEFAULT_BUFFER.to_dicts()["traces"] == []
+        # The in-situ phase histogram observes regardless of sampling.
+        assert (
+            tracing.PHASE_SECONDS.count(phase="unit_test_phase")
+            == before + 1
+        )
+
+    def test_explicit_trace_id_bypasses_sampling(self):
+        tracing.configure(sample_rate=0.0)
+        with tracing.trace("propagated", trace_id="deadbeef01020304"):
+            pass
+        out = tracing.DEFAULT_BUFFER.to_dicts()["traces"]
+        assert [t["traceId"] for t in out] == ["deadbeef01020304"]
+
+    def test_merge_by_trace_id(self):
+        with tracing.trace("a", trace_id="cafe0000cafe0000", pod="p"):
+            pass
+        with tracing.trace("b", trace_id="cafe0000cafe0000"):
+            pass
+        out = tracing.DEFAULT_BUFFER.to_dicts()["traces"]
+        assert len(out) == 1
+        assert {s["name"] for s in out[0]["spans"]} == {"a", "b"}
+
+    def test_threshold_logging(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="kubernetes_tpu.trace"):
+            with tracing.trace("slowop", pod="p", threshold_s=0.001):
+                time.sleep(0.01)
+        assert any("over threshold" in r.message for r in caplog.records)
+        assert any("slowop" in r.getMessage() for r in caplog.records)
+
+    def test_thread_isolation(self):
+        """A fresh thread must not inherit the spawner's trace."""
+        import threading
+
+        seen = []
+        with tracing.trace("parent"):
+            t = threading.Thread(
+                target=lambda: seen.append(tracing.current_trace_id())
+            )
+            t.start()
+            t.join()
+            assert tracing.current_trace_id() != ""
+        assert seen == [""]
+
+
+class TestHTTPPropagation:
+    def test_trace_id_header_joins_apiserver_entry(self):
+        api = APIServer()
+        http = APIHTTPServer(api).start()
+        try:
+            client = Client(HTTPTransport(http.address))
+            with tracing.trace("client_op", pod="px") as tr:
+                client.create("pods", pod_wire("px"))
+                tid = tracing.current_trace_id()
+                assert tid
+        finally:
+            http.stop()
+        out = tracing.DEFAULT_BUFFER.to_dicts(pod="px")["traces"]
+        assert len(out) == 1
+        merged = out[0]
+        assert merged["traceId"] == tid
+        # Two entries under one id: the client's root span and the
+        # apiserver's request span (with the pod noted server-side).
+        names = span_names(merged)
+        assert "client_op" in names
+        assert any(n.startswith("POST ") for n in names)
+
+
+SCHED_TIMEOUT = 60.0
+
+
+class TestSchedulerTraces:
+    def _schedule(self, incremental=False):
+        from kubernetes_tpu.scheduler.daemon import IncrementalBatchScheduler
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        for j in range(5):
+            client.create("nodes", node_wire(f"n{j}"))
+        for i in range(8):
+            client.create("pods", pod_wire(f"tp{i}"))
+        cfg = SchedulerConfig(
+            Client(LocalTransport(api)),
+            raw_scheduled_cache=incremental,
+        ).start()
+        assert cfg.wait_for_sync(timeout=SCHED_TIMEOUT)
+        sched = (
+            IncrementalBatchScheduler(cfg)
+            if incremental
+            else BatchScheduler(cfg)
+        )
+        total = 0
+        deadline = time.monotonic() + SCHED_TIMEOUT
+        while total < 8 and time.monotonic() < deadline:
+            total += sched.schedule_batch(timeout=0.5)
+        assert total == 8
+        assert sched.fallback_count == 0
+        cfg.stop()
+        return api, client
+
+    def test_batch_trace_has_full_span_tree(self):
+        """Acceptance: one trace whose span tree contains enqueue,
+        lower, upload, solve, readback, and bind."""
+        api, client = self._schedule()
+        out = tracing.DEFAULT_BUFFER.to_dicts(pod="tp3")["traces"]
+        assert out, "no trace touched pod tp3"
+        names = span_names(out[0])
+        for required in (
+            "enqueue", "lower", "upload", "solve", "readback", "bind"
+        ):
+            assert required in names, f"missing span {required!r}"
+        # The in-process bind request joined the same trace.
+        assert "api.bind_bulk" in names
+        # /metrics exposes the histogram family with +Inf == _count.
+        text = metrics.DEFAULT.render()
+        assert "# TYPE scheduler_phase_seconds histogram" in text
+        solve_count = tracing.PHASE_SECONDS.count(phase="solve")
+        assert solve_count >= 1
+        assert (
+            f'scheduler_phase_seconds_bucket{{phase="solve",le="+Inf"}} '
+            f"{solve_count}" in text
+        )
+
+    def test_incremental_trace_has_full_span_tree(self):
+        api, client = self._schedule(incremental=True)
+        out = tracing.DEFAULT_BUFFER.to_dicts(pod="tp5")["traces"]
+        assert out, "no trace touched pod tp5"
+        names = span_names(out[0])
+        for required in (
+            "enqueue", "lower", "upload", "solve", "readback", "bind"
+        ):
+            assert required in names, f"missing span {required!r}"
+
+    def test_debug_traces_endpoint_and_ktctl(self, capsys):
+        from kubernetes_tpu.cli import ktctl
+
+        api, client = self._schedule()
+        http = APIHTTPServer(api).start()
+        try:
+            with urllib.request.urlopen(
+                http.address + "/debug/traces?pod=tp2", timeout=10
+            ) as resp:
+                data = json.loads(resp.read())
+        finally:
+            http.stop(release_store=False)
+        assert data["kind"] == "TraceList"
+        assert data["traces"], "endpoint returned no traces for tp2"
+        assert "tp2" in data["traces"][0]["pods"]
+
+        # ktctl trace <pod> renders the span tree with durations.
+        rc = ktctl.main(["trace", "tp2"], client=client)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "TRACE" in out
+        for required in ("enqueue", "lower", "solve", "bind"):
+            assert required in out
+        assert "ms)" in out
+
+        # Unknown pod: clean nonzero exit.
+        rc = ktctl.main(["trace", "no-such-pod"], client=client)
+        assert rc == 1
