@@ -42,6 +42,8 @@ ALIASES = {
     "namespace": "namespaces",
     "secret": "secrets",
     "event": "events",
+    "pg": "podgroups",
+    "podgroup": "podgroups",
 }
 
 
@@ -151,6 +153,16 @@ def _event_row(o) -> List[str]:
     ]
 
 
+def _podgroup_row(o) -> List[str]:
+    return [
+        o.metadata.name,
+        str(o.spec.min_member),
+        o.status.phase or "Pending",
+        f"{o.status.bound}/{o.status.members}",
+        _age(o.metadata.creation_timestamp),
+    ]
+
+
 TABLE_COLUMNS = {
     "pods": (["NAME", "READY", "STATUS", "RESTARTS", "NODE", "AGE"], _pod_row),
     "nodes": (["NAME", "STATUS", "CPU", "MEMORY", "AGE"], _node_row),
@@ -161,6 +173,10 @@ TABLE_COLUMNS = {
     ),
     "endpoints": (["NAME", "ENDPOINTS", "AGE"], _ep_row),
     "events": (["AGE", "REASON", "OBJECT", "SOURCE", "MESSAGE"], _event_row),
+    "podgroups": (
+        ["NAME", "MIN-MEMBER", "PHASE", "BOUND", "AGE"],
+        _podgroup_row,
+    ),
 }
 
 

@@ -628,9 +628,13 @@ class Client:
              "spec": {"finalizers": list(finalizers)}},
         )
 
-    def bind_bulk(self, bindings, namespace: str = "default") -> list:
+    def bind_bulk(
+        self, bindings, namespace: str = "default", atomic: bool = False
+    ) -> list:
         """Commit many (pod_name, node_name) bindings in one request;
-        returns per-item Status dicts (the batch solver's commit path)."""
+        returns per-item Status dicts (the batch solver's commit path).
+        atomic=True is the gang-commit mode: the first conflict rejects
+        the whole batch server-side and no pod is bound."""
         wire = [
             {
                 "kind": "Binding",
@@ -640,8 +644,11 @@ class Client:
             }
             for p, n in bindings
         ]
+        body = {"bindings": wire}
+        if atomic:
+            body["atomic"] = True
         self._throttle()
-        out = self.t.request("POST", "bind_bulk", (namespace,), {"bindings": wire})
+        out = self.t.request("POST", "bind_bulk", (namespace,), body)
         if isinstance(out, dict):
             return out.get("results", [])
         return out

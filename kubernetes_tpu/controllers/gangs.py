@@ -1,0 +1,208 @@
+"""GangController: PodGroup lifecycle (status, aging, events).
+
+No direct reference analog (the closest shape is the sig-scheduling
+coscheduling controller's PodGroup status loop); structurally it is a
+standard level-triggered controller like controllers/resourcequota.py:
+every sync period it reconciles each PodGroup's observed membership
+against its declared gang intent.
+
+Per group, each pass:
+
+- recounts members (pods carrying POD_GROUP_LABEL in the group's
+  namespace) and bound members (spec.nodeName set), publishing both in
+  status;
+- flips phase to Scheduled (+ event) once bound >= minMember — the
+  gang landed, whoever solved it;
+- ages groups stuck Pending past spec.scheduleTimeoutSeconds: marks
+  them Unschedulable, emits a GangTimeout event, and bumps
+  gang_solve_outcomes_total{outcome="timeout"}. Unschedulable is NOT
+  terminal — member pods stay in the scheduler's backoff requeue loop,
+  so a later successful gang solve flips the group straight to
+  Scheduled (the "requeue" half of age-out: nothing needs resubmitting).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from datetime import datetime, timezone
+from typing import Optional
+
+from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+
+_SYNCS = metrics.DEFAULT.counter(
+    "gang_controller_syncs_total", "PodGroup sync passes", ("result",)
+)
+#: Groups currently Pending/Unschedulable, refreshed every sync — the
+#: backlog-depth signal dashboards watch for gang starvation.
+_PENDING = metrics.DEFAULT.gauge(
+    "gang_pending_groups", "PodGroups currently Pending"
+)
+
+PENDING = "Pending"
+SCHEDULED = "Scheduled"
+UNSCHEDULABLE = "Unschedulable"
+
+
+def _parse_ts(ts: str) -> Optional[float]:
+    if not ts:
+        return None
+    try:
+        return (
+            datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")
+            .replace(tzinfo=timezone.utc)
+            .timestamp()
+        )
+    except ValueError:
+        return None
+
+
+class GangController:
+    def __init__(self, client, sync_period: float = 1.0):
+        self.client = client
+        self.sync_period = sync_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "GangController":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+                _SYNCS.inc(result="ok")
+            except Exception:
+                _SYNCS.inc(result="error")
+            self._stop.wait(self.sync_period)
+
+    def sync_once(self, now: Optional[float] = None) -> int:
+        """One reconcile pass over every PodGroup; returns groups whose
+        status changed. `now` is injectable for aging tests."""
+        from kubernetes_tpu.scheduler.gang import OUTCOMES, pod_is_live
+
+        now = time.time() if now is None else now
+        changed = 0
+        pending = 0
+        groups, _ = self.client.list("podgroups")
+        if not groups:
+            _PENDING.set(0)
+            return 0
+        # ONE cluster-wide pods list per sync, bucketed host-side: a
+        # per-group label-selected LIST is a full server-side scan of
+        # the namespace's pods EACH (api.list predicate-filters the
+        # whole collection), which at the 50k-pod target and G groups
+        # costs G full scans per second at steady state.
+        by_group: dict = {}
+        for p in self.client.list("pods")[0]:
+            g = (p.metadata.labels or {}).get(POD_GROUP_LABEL, "")
+            if g:
+                by_group.setdefault(
+                    (p.metadata.namespace or "default", g), []
+                ).append(p)
+        for pg in groups:
+            ns = pg.metadata.namespace or "default"
+            name = pg.metadata.name
+            labeled = by_group.get((ns, name), [])
+            # Live members only (same rule as admission and the solve's
+            # bound credit): a crashed member keeps label + nodeName but
+            # satisfies nothing — counting it would pin a dead gang
+            # "Scheduled" forever and mute GangTimeout.
+            members = [p for p in labeled if pod_is_live(p)]
+            bound = sum(1 for p in members if p.spec.node_name)
+            phase = pg.status.phase or PENDING
+            message = pg.status.message
+            # The current Pending stint's start: aging runs against
+            # THIS, not creationTimestamp — a gang that re-pends after
+            # running gets a full fresh timeout window.
+            pending_since = (
+                pg.status.pending_since or pg.metadata.creation_timestamp
+            )
+            if bound >= pg.spec.min_member:
+                if phase != SCHEDULED:
+                    phase = SCHEDULED
+                    message = (
+                        f"{bound}/{pg.spec.min_member} minMember pods bound"
+                    )
+                    self._event(
+                        pg, "GangScheduled",
+                        f'pod group "{ns}/{name}" fully bound '
+                        f"({bound} members)",
+                    )
+            elif phase == SCHEDULED:
+                # A bound gang lost members (deletes/evictions) below
+                # minMember: it is pending again and ages from now.
+                phase = PENDING
+                message = f"bound fell to {bound}/{pg.spec.min_member}"
+                pending_since = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)
+                )
+            elif phase == PENDING and pg.spec.schedule_timeout_seconds > 0:
+                since = _parse_ts(pending_since)
+                if (
+                    since is not None
+                    and now - since > pg.spec.schedule_timeout_seconds
+                ):
+                    phase = UNSCHEDULABLE
+                    message = (
+                        f"still {bound}/{pg.spec.min_member} bound after "
+                        f"{pg.spec.schedule_timeout_seconds}s; member pods "
+                        "remain queued and will gang-bind if capacity frees"
+                    )
+                    OUTCOMES.inc(outcome="timeout")
+                    self._event(
+                        pg, "GangTimeout",
+                        f'pod group "{ns}/{name}" unschedulable: {message}',
+                    )
+            if phase in (PENDING, UNSCHEDULABLE):
+                pending += 1
+            if (
+                phase == pg.status.phase
+                and bound == pg.status.bound
+                and len(members) == pg.status.members
+                and pending_since == (
+                    pg.status.pending_since
+                    or pg.metadata.creation_timestamp
+                )
+            ):
+                continue  # unchanged: skip the write, don't wake watchers
+            try:
+                self.client.update_status(
+                    "podgroups",
+                    {
+                        "kind": "PodGroup",
+                        "metadata": {"name": name, "namespace": ns},
+                        "status": {
+                            "phase": phase,
+                            "members": len(members),
+                            "bound": bound,
+                            "message": message,
+                            "pendingSince": pending_since,
+                        },
+                    },
+                    namespace=ns,
+                )
+                changed += 1
+            except APIError:
+                pass  # deleted mid-sync / racing writer: next pass fixes
+        _PENDING.set(pending)
+        return changed
+
+    def _event(self, pg, reason: str, message: str) -> None:
+        try:
+            self.client.record_event(
+                pg, reason, message,
+                source="gang-controller",
+                namespace=pg.metadata.namespace or "default",
+            )
+        except Exception:
+            pass  # events are observability, never control flow

@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.gangs import GangController
 from kubernetes_tpu.controllers.namespace import NamespaceManager
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replication import ReplicationManager
@@ -33,6 +34,7 @@ class ControllerManager:
         enable_resource_quota: bool = True,
         enable_service_accounts: bool = True,
         enable_pv_binder: bool = True,
+        enable_gangs: bool = True,
         # Reference defaults (see nodelifecycle.py): grace 40s,
         # eviction 5min there — 120s here keeps recovery drills sane.
         node_grace_period: float = 40.0,
@@ -80,6 +82,11 @@ class ControllerManager:
             if sa_token_manager is not None:
                 self.tokens = TokenController(client, sa_token_manager)
                 self.controllers.append(self.tokens)
+        if enable_gangs:
+            # PodGroup lifecycle: status reconcile + pending-gang aging
+            # (events, Unschedulable marking) for the gang scheduler.
+            self.gangs = GangController(client)
+            self.controllers.append(self.gangs)
         if enable_pv_binder:
             self.pv_binder = PersistentVolumeClaimBinder(client)
             self.controllers.append(self.pv_binder)

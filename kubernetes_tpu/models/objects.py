@@ -663,6 +663,54 @@ class PodTemplate:
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
 
 
+# The pod label naming the PodGroup (same namespace) a pod belongs to —
+# the association seam shared by admission, the gang solver, and the
+# gang lifecycle controller.
+POD_GROUP_LABEL = "pod-group.kubernetes-tpu.io/name"
+
+
+@dataclass
+class PodGroupSpec:
+    """Gang-scheduling intent (no reference analog in this tree; shape
+    follows the sig-scheduling coscheduling PodGroup CRD). A group's
+    member pods carry the pod-group label (scheduler/gang.py
+    POD_GROUP_LABEL); the batch solver places them all-or-nothing."""
+
+    # Minimum members that must be schedulable together; fewer than
+    # this many feasible placements rejects the whole group atomically.
+    min_member: int = 1
+    # Optional ceiling on group membership; 0 = unlimited. Admission
+    # rejects pods that would push the group past this (an "oversized"
+    # group is a manifest bug, not a scheduling problem).
+    max_member: int = 0
+    # Groups still Pending this many seconds after creation are marked
+    # Unschedulable by the gang controller (events + status); 0 = no
+    # timeout.
+    schedule_timeout_seconds: int = 0
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = "Pending"  # Pending | Scheduled | Unschedulable
+    members: int = 0  # pods carrying the group label
+    bound: int = 0  # members with spec.nodeName set
+    message: str = ""
+    # When the current Pending stint began (ISO8601); the gang
+    # controller ages scheduleTimeoutSeconds against THIS, not
+    # creationTimestamp, so a gang that re-pends after running gets a
+    # fresh timeout window. Empty = pending since creation.
+    pending_since: str = ""
+
+
+@dataclass
+class PodGroup:
+    kind: str = "PodGroup"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+
 @dataclass
 class ComponentCondition:
     type: str = "Healthy"
@@ -727,6 +775,7 @@ KINDS = {
     "PersistentVolume": PersistentVolume,
     "PersistentVolumeClaim": PersistentVolumeClaim,
     "PodTemplate": PodTemplate,
+    "PodGroup": PodGroup,
     "ComponentStatus": ComponentStatus,
     "DeleteOptions": DeleteOptions,
     "Status": Status,

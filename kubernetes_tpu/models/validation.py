@@ -226,6 +226,23 @@ def validate_persistent_volume(pv) -> None:
         raise ValidationError(errs)
 
 
+def validate_pod_group(pg) -> None:
+    """Gang-scheduling group: minMember >= 1, maxMember (when set)
+    covers minMember, timeout nonnegative."""
+    errs: List[str] = []
+    _validate_meta(pg.metadata, errs)
+    if pg.spec.min_member < 1:
+        errs.append("spec.minMember: must be >= 1")
+    if pg.spec.max_member < 0:
+        errs.append("spec.maxMember: must be nonnegative")
+    elif pg.spec.max_member and pg.spec.max_member < pg.spec.min_member:
+        errs.append("spec.maxMember: must cover spec.minMember")
+    if pg.spec.schedule_timeout_seconds < 0:
+        errs.append("spec.scheduleTimeoutSeconds: must be nonnegative")
+    if errs:
+        raise ValidationError(errs)
+
+
 def validate_persistent_volume_claim(pvc) -> None:
     errs: List[str] = []
     _validate_meta(pvc.metadata, errs)

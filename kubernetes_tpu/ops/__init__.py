@@ -19,12 +19,17 @@ jax.sharding.Mesh for multi-chip scale-out.
 from kubernetes_tpu.ops.matrices import DeviceSnapshot, device_snapshot
 from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
 from kubernetes_tpu.ops.solver import solve, solve_assignments, solve_with_state
-from kubernetes_tpu.ops.incremental import RebuildRequired, SolverSession
+from kubernetes_tpu.ops.incremental import (
+    RebuildRequired,
+    SessionGang,
+    SolverSession,
+)
 from kubernetes_tpu.ops.wave import solve_waves
 
 __all__ = [
     "DeviceSnapshot",
     "RebuildRequired",
+    "SessionGang",
     "SolverSession",
     "device_snapshot",
     "solve",

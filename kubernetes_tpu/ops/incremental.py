@@ -69,6 +69,18 @@ def _scatter_rows(nodes: Dict[str, jnp.ndarray], idx: jnp.ndarray, rows: Dict):
 
 
 @dataclass
+class SessionGang:
+    """One PodGroup's stake in a session tick (ops-layer mirror of
+    scheduler.gang.GangGroup, keyed by pod keys instead of backlog
+    indices — the session addresses pods by key)."""
+
+    key: str  # "namespace/name"
+    min_member: int
+    bound: int  # members already bound before this tick
+    pod_keys: frozenset  # this tick's pending members
+
+
+@dataclass
 class _LoweredPod:
     """Host-side lowered pod row (everything solve() needs)."""
 
@@ -427,6 +439,70 @@ class SolverSession:
             self._apply_commit_host(j, lp)
             out.append((lp.key, self.node_names[j]))
         return out
+
+    def solve_gang(
+        self, gangs: Sequence[SessionGang]
+    ) -> Tuple[List[Tuple[str, Optional[str]]], List[str]]:
+        """solve() with group-level all-or-nothing acceptance, session-
+        aware: a rejected group's tentative placements were already
+        committed into the DONATED device carry by the tick's solve, so
+        releasing them goes through delete_assigned — the host mirror
+        recomputes the touched node rows and the next solve's dirty
+        flush scatters them back onto the device. Each rejection round
+        releases EVERY placement made this tick and re-solves the
+        surviving backlog, so the freed capacity is usable immediately
+        and the accepted-group set matches the batch paths' (same
+        fixed-point loop as scheduler.gang.gang_solve). Acceptance
+        counts run through the same masked segment reduction as the
+        batch device path."""
+        from kubernetes_tpu.ops.pipeline import gang_member_counts_device
+
+        tick = list(self._pending)
+        if not gangs:
+            return self.solve(), []
+        gangs = list(gangs)
+        gi_of_key: Dict[str, int] = {}
+        for gi, g in enumerate(gangs):
+            for k in g.pod_keys:
+                gi_of_key[k] = gi
+        results: Dict[str, Optional[str]] = {}
+        rejected: set = set()
+        while True:
+            for key, dest in self.solve():
+                results[key] = dest
+            placed = np.fromiter(
+                (results.get(lp.key) is not None for lp in tick),
+                bool, count=len(tick),
+            )
+            gids = np.fromiter(
+                (gi_of_key.get(lp.key, -1) for lp in tick),
+                np.int32, count=len(tick),
+            )
+            counts = gang_member_counts_device(placed, gids, len(gangs))
+            newly = [
+                gi
+                for gi, g in enumerate(gangs)
+                if gi not in rejected
+                and int(counts[gi]) + g.bound < g.min_member
+            ]
+            if not newly:
+                break
+            rejected.update(newly)
+            # Release the whole tick's tentative placements (device rows
+            # restore via the dirty scatter) and re-solve the survivors
+            # in original arrival order.
+            for lp in tick:
+                if results.get(lp.key) is not None:
+                    self.delete_assigned(lp.key)
+                results[lp.key] = None
+            self._pending = [
+                lp for lp in tick
+                if gi_of_key.get(lp.key, -1) not in rejected
+            ]
+        return (
+            [(lp.key, results.get(lp.key)) for lp in tick],
+            [gangs[gi].key for gi in sorted(rejected)],
+        )
 
     def _pod_arrays(self, pending: List[_LoweredPod]) -> Dict[str, jnp.ndarray]:
         P = len(pending)

@@ -14,6 +14,7 @@ slightly different cluster sizes reuse the compiled executable
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
@@ -188,6 +189,25 @@ def device_nodes(
     if n.aa_zone is not None:
         nodes["aa_zone"] = _pad(n.aa_zone, NP, fill=-1)
     return _put_tree(nodes, sharding)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def gang_member_counts(
+    placed: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int
+) -> jnp.ndarray:
+    """Per-group placed-member counts as a MASKED segment reduction —
+    the gang-acceptance primitive. `placed` is bool[P] (pod i received
+    a feasible assignment), `group_ids` int32[P] with -1 for ungrouped
+    and padding rows. Ungrouped/padded rows are masked out of the sum
+    rather than filtered (static shapes: the solver's pod axis is
+    padded, and XLA recompiles on any shape change). Callers bucket
+    num_groups (it is a static arg) so group-count drift between
+    batches reuses the compiled executable."""
+    mask = placed & (group_ids >= 0)
+    idx = jnp.clip(group_ids, 0, num_groups - 1)
+    return jax.ops.segment_sum(
+        mask.astype(jnp.int32), idx, num_segments=num_groups
+    )
 
 
 def node_axis_multiple(

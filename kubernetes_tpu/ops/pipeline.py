@@ -31,6 +31,7 @@ from kubernetes_tpu.ops.matrices import (
     device_nodes,
     device_pods,
     node_axis_multiple,
+    pow2_bucket,
     shardings_for,
 )
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
@@ -45,6 +46,37 @@ from kubernetes_tpu.utils import tracing
 # which is also why a progressive small-first-chunk ramp was tried and
 # LOST for wave.
 DEFAULT_CHUNK = 12544
+
+
+def gang_member_counts_device(
+    placed, group_ids, num_groups: int
+) -> np.ndarray:
+    """Device path of the gang-acceptance reduction: stage the host
+    placed-mask + group-id columns, run the masked segment reduction
+    (ops.matrices.gang_member_counts), and return host counts. Both
+    axes pad to power-of-two buckets (pods with placed=False/id=-1 —
+    masked out by construction): num_groups is a static jit arg and the
+    pod length is a traced shape, so per-batch drift in either must not
+    trigger an XLA recompile."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.matrices import gang_member_counts
+
+    G = int(num_groups)
+    if G <= 0:
+        return np.zeros(0, np.int32)
+    placed = np.asarray(placed, bool)
+    gids = np.asarray(group_ids, np.int32)
+    P = placed.shape[0]
+    PP = pow2_bucket(max(P, 1), minimum=8)
+    if PP != P:
+        placed = np.pad(placed, (0, PP - P))
+        gids = np.pad(gids, (0, PP - P), constant_values=-1)
+    GP = pow2_bucket(G, minimum=8)
+    counts = gang_member_counts(
+        jnp.asarray(placed), jnp.asarray(gids), num_groups=GP
+    )
+    return np.asarray(counts)[:G]
 
 
 def solve_backlog_pipelined(

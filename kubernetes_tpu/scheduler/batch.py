@@ -195,6 +195,51 @@ def schedule_backlog_sinkhorn(
         return [names[i] if i >= 0 else None for i in assignment]
 
 
+def schedule_backlog_gang_scalar(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+    groups=(),
+    spec: Optional[AlgorithmSpec] = None,
+):
+    """Gang-accepting scalar backlog solve — the parity fallback AND
+    yardstick for the device gang path. Returns (destinations,
+    accepted_groups, rejected_groups); see scheduler.gang.gang_solve."""
+    from kubernetes_tpu.scheduler.gang import gang_solve
+
+    def solver(p, n, a, s):
+        return schedule_backlog_scalar(p, n, a, s, spec=spec)
+
+    return gang_solve(solver, pending, nodes, assigned, services, groups)
+
+
+def schedule_backlog_gang_tpu(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+    groups=(),
+    mesh=None,
+    spec: Optional[AlgorithmSpec] = None,
+):
+    """Gang-accepting device backlog solve: the scan solver per round,
+    group acceptance via the masked segment reduction on device
+    (ops.pipeline.gang_member_counts_device). Accepted-group parity
+    with schedule_backlog_gang_scalar is inherited from the underlying
+    solvers' decision parity — both run the identical acceptance loop."""
+    from kubernetes_tpu.ops.pipeline import gang_member_counts_device
+    from kubernetes_tpu.scheduler.gang import gang_solve
+
+    def solver(p, n, a, s):
+        return schedule_backlog_tpu(p, n, a, s, mesh=mesh, spec=spec)
+
+    return gang_solve(
+        solver, pending, nodes, assigned, services, groups,
+        counts_fn=gang_member_counts_device,
+    )
+
+
 def parity_report(
     scalar: Sequence[Optional[str]], batch: Sequence[Optional[str]]
 ) -> Tuple[float, List[int]]:

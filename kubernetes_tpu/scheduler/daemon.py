@@ -476,6 +476,95 @@ class BatchScheduler(Scheduler):
     def _step(self) -> None:
         self.schedule_batch()
 
+    # -- gang scheduling ----------------------------------------------
+
+    def _gang_groups(self, pending: List[Pod], assigned=None):
+        """Partition the drained backlog into PodGroups (empty when no
+        pod carries the group label — the common case costs one label
+        scan and nothing else). PodGroup specs are fetched per batch:
+        one cluster-wide list, only when grouped pods are present.
+
+        Returns None when the spec fetch failed TRANSIENTLY: the caller
+        must defer the grouped pods (requeue), never schedule them
+        per-pod — silently dropping gang semantics is exactly the
+        partial placement this subsystem exists to prevent. Only a
+        server that genuinely does not serve the resource (older
+        apiserver: 400/404) degrades to per-pod scheduling."""
+        from kubernetes_tpu.scheduler import gang
+
+        if not any(gang.pod_group_name(p) for p in pending):
+            return []
+        try:
+            pgs, _ = self.config.client.list("podgroups")
+        except APIError as e:
+            if e.code in (400, 404):
+                return []  # resource not served: per-pod is all there is
+            return None  # transient server error: defer the gangs
+        except Exception:
+            return None  # transport failure: defer the gangs
+        by_key = {
+            gang.group_key(pg.metadata.namespace, pg.metadata.name): pg
+            for pg in pgs
+        }
+
+        def min_member_of(ns: str, name: str):
+            pg = by_key.get(gang.group_key(ns, name))
+            return pg.spec.min_member if pg is not None else None
+
+        if assigned is None:
+            assigned = self.config.pod_lister.list()
+        return gang.partition_backlog(
+            pending, assigned=assigned, min_member_of=min_member_of
+        )
+
+    @staticmethod
+    def _split_deferred_gangs(pending: List[Pod]) -> Tuple[List[Pod], List[Pod]]:
+        """(ungrouped, grouped) split for the defer-on-fetch-failure
+        path: grouped pods wait for resolvable specs."""
+        from kubernetes_tpu.scheduler import gang
+
+        ungrouped = [p for p in pending if not gang.pod_group_name(p)]
+        grouped = [p for p in pending if gang.pod_group_name(p)]
+        return ungrouped, grouped
+
+    def _gang_counts_fn(self):
+        """Acceptance reducer: the device masked-segment-reduction when
+        this daemon solves on device; the host twin for the scalar /
+        sidecar shapes (the sidecar's arrays live in its process)."""
+        if self.policy_scalar or self.sidecar is not None:
+            return None  # gang_solve defaults to the host reducer
+        from kubernetes_tpu.ops.pipeline import gang_member_counts_device
+
+        return gang_member_counts_device
+
+    def _bind_groups_atomic(
+        self,
+        group_binds: Dict[str, Tuple[str, List[Tuple[str, str]]]],
+        outcome: Dict[Tuple[str, str], dict],
+    ) -> None:
+        """Commit each accepted group through bind_bulk(atomic=True):
+        a mid-batch conflict rejects the whole group server-side (no
+        stragglers), surfacing per-pod Aborted statuses the caller
+        requeues."""
+        from kubernetes_tpu.scheduler.gang import OUTCOMES
+
+        for _gkey, (ns, items) in sorted(group_binds.items()):
+            results = self.config.binder.bind_bulk(
+                items, namespace=ns, atomic=True
+            )
+            for (pod_name, _dest), res in zip(items, results):
+                outcome[(ns, pod_name)] = res
+            if any(r.get("status") != "Success" for r in results):
+                OUTCOMES.inc(outcome="bind_rollback")
+
+    @staticmethod
+    def _bind_retryable(res: dict) -> bool:
+        """A failed bind outcome that should requeue the pod. A plain
+        409 means the pod raced and IS bound (by someone else) — drop
+        it; 409 Aborted means its gang's atomic batch rolled back and
+        the pod is still pending."""
+        return res.get("code") != 409 or res.get("reason") == "Aborted"
+
     def _drain(self, timeout: Optional[float]) -> List[Pod]:
         """Pop the first pod (blocking) then everything already queued,
         up to max_batch (amortizes solves under churn)."""
@@ -556,36 +645,87 @@ class BatchScheduler(Scheduler):
                 return schedule_backlog_tpu(
                     pending, nodes, assigned, services, spec=self.spec
                 )
+        # Gang partitioning: grouped pods place all-or-nothing (the
+        # acceptance loop wraps WHATEVER solver this daemon runs —
+        # device, sidecar, or policy-pinned scalar).
+        groups = self._gang_groups(pending, assigned)
+        deferred: List[Pod] = []
+        if groups is None:
+            # Couldn't resolve PodGroup specs this tick: defer the
+            # grouped pods (retry after backoff) and solve the rest —
+            # scheduling a gang member per-pod would break the
+            # all-or-nothing contract.
+            pending, deferred = self._split_deferred_gangs(pending)
+            self._requeue_many(deferred)
+            groups = []
+            if not pending:
+                return len(deferred)
+
+        def run(solve_fn, counts_fn):
+            if not groups:
+                return solve_fn(pending, nodes, assigned, services), []
+            from kubernetes_tpu.scheduler.gang import gang_solve
+
+            dests, _accepted, denied = gang_solve(
+                solve_fn, pending, nodes, assigned, services, groups,
+                counts_fn=counts_fn,
+            )
+            return dests, denied
+
         try:
             t0 = time.monotonic()
-            destinations = solver(pending, nodes, assigned, services)
+            destinations, denied = run(solver, self._gang_counts_fn())
             _ALGO_LATENCY.observe(time.monotonic() - t0)
         except Exception:
             # Device path unavailable: scalar fallback with the
-            # CONFIGURED plugin set.
+            # CONFIGURED plugin set — and the HOST acceptance reducer
+            # (the device reducer would just re-raise the same outage).
             self.fallback_count += 1
             try:
-                destinations = schedule_backlog_scalar(
-                    pending, nodes, assigned, services, spec=self.spec
+                destinations, denied = run(
+                    lambda p, n, a, s: schedule_backlog_scalar(
+                        p, n, a, s, spec=self.spec
+                    ),
+                    None,
                 )
             except Exception:
                 self._requeue_many(pending)
                 return len(pending)
 
-        # Commit placed pods in one bulk call, grouped by namespace.
+        denied_at: Dict[int, str] = {
+            i: g.key for g in denied for i in g.indices
+        }
+        gkey_at: Dict[int, str] = {
+            i: g.key for g in groups for i in g.indices
+        }
+        # Commit placed pods in one bulk call, grouped by namespace;
+        # accepted gangs commit separately, each as one atomic batch.
         by_ns: Dict[str, List] = {}
+        group_binds: Dict[str, Tuple[str, List[Tuple[str, str]]]] = {}
         placed: List[Tuple[Pod, str]] = []
         rejected: List[Pod] = []
-        for pod, dest in zip(pending, destinations):
+        for i, (pod, dest) in enumerate(zip(pending, destinations)):
             if dest is None:
                 _SCHEDULED.inc(result="unschedulable")
+                message = (
+                    f'pod group "{denied_at[i]}" rejected: fewer than '
+                    "minMember pods schedulable"
+                    if i in denied_at
+                    else "no node fits"
+                )
                 cfg.client.record_event(
-                    pod, "FailedScheduling", "no node fits", source="scheduler"
+                    pod, "FailedScheduling", message, source="scheduler"
                 )
                 rejected.append(pod)
                 continue
             ns = pod.metadata.namespace or "default"
-            by_ns.setdefault(ns, []).append((pod.metadata.name, dest))
+            gkey = gkey_at.get(i)
+            if gkey is not None:
+                group_binds.setdefault(gkey, (ns, []))[1].append(
+                    (pod.metadata.name, dest)
+                )
+            else:
+                by_ns.setdefault(ns, []).append((pod.metadata.name, dest))
             placed.append((pod, dest))
 
         t0 = time.monotonic()
@@ -596,12 +736,13 @@ class BatchScheduler(Scheduler):
                     results = cfg.binder.bind_bulk(items, namespace=ns)
                     for (pod_name, _dest), res in zip(items, results):
                         outcome[(ns, pod_name)] = res
+                self._bind_groups_atomic(group_binds, outcome)
             except Exception:
                 # Transport/apiserver failure mid-commit: pods without a
                 # recorded outcome get retried (already-committed ones
                 # are 409s next round, which is fine).
                 pass
-        if by_ns:
+        if by_ns or group_binds:
             _BIND_LATENCY.observe(time.monotonic() - t0)
 
         for pod, dest in placed:
@@ -616,14 +757,14 @@ class BatchScheduler(Scheduler):
                     f"Successfully assigned {pod.metadata.name} to {dest}",
                     source="scheduler",
                 )
-            elif res.get("code") == 409:
+            elif not self._bind_retryable(res):
                 _SCHEDULED.inc(result="bind_conflict")  # raced; pod is bound
             else:
                 _SCHEDULED.inc(result="bind_error")
                 rejected.append(pod)
         self._requeue_many(rejected)
         _E2E_LATENCY.observe(time.monotonic() - start)
-        return len(pending)
+        return len(pending) + len(deferred)
 
 
 class IncrementalBatchScheduler(BatchScheduler):
@@ -778,6 +919,14 @@ class IncrementalBatchScheduler(BatchScheduler):
                 self._session = self._build_session()
             if not self._apply_events(self._session):
                 self._session = self._build_session()
+            groups = self._gang_groups(pending)
+            deferred: List[Pod] = []
+            if groups is None:
+                # PodGroup specs unresolvable this tick: defer the
+                # grouped pods rather than scheduling them per-pod.
+                pending, deferred = self._split_deferred_gangs(pending)
+                self._requeue_many(deferred)
+                groups = []
             # A drained pod may have been bound ELSEWHERE since it was
             # queued (another scheduler instance; HA failover overlap)
             # — its watch event just charged the session. Feeding it to
@@ -791,7 +940,35 @@ class IncrementalBatchScheduler(BatchScheduler):
                     )
                     if not self._session.has_assigned(key):
                         self._session.add_pending(pod)
-            results = self._session.solve()
+            if groups:
+                from kubernetes_tpu.ops import SessionGang
+                from kubernetes_tpu.scheduler.gang import OUTCOMES
+
+                gangs = [
+                    SessionGang(
+                        key=g.key,
+                        min_member=g.min_member,
+                        bound=g.bound,
+                        pod_keys=frozenset(
+                            f"{pending[i].metadata.namespace or 'default'}/"
+                            f"{pending[i].metadata.name}"
+                            for i in g.indices
+                        ),
+                    )
+                    for g in groups
+                ]
+                results, denied_keys = self._session.solve_gang(gangs)
+                denied_keys = set(denied_keys)
+                for g in gangs:
+                    OUTCOMES.inc(
+                        outcome=(
+                            "rejected" if g.key in denied_keys
+                            else "accepted"
+                        )
+                    )
+            else:
+                results = self._session.solve()
+                denied_keys = set()
             _ALGO_LATENCY.observe(time.monotonic() - t0)
         except Exception:
             # RebuildRequired, device error, anything: invalidate and
@@ -805,7 +982,14 @@ class IncrementalBatchScheduler(BatchScheduler):
 
         by_key = {f"{p.metadata.namespace or 'default'}/{p.metadata.name}": p
                   for p in pending}
+        gkey_of: Dict[str, str] = {
+            f"{pending[i].metadata.namespace or 'default'}/"
+            f"{pending[i].metadata.name}": g.key
+            for g in groups
+            for i in g.indices
+        }
         by_ns: Dict[str, List] = {}
+        group_binds: Dict[str, Tuple[str, List[Tuple[str, str]]]] = {}
         placed: List[Tuple[Pod, str]] = []
         rejected: List[Pod] = []
         for key, dest in results:
@@ -814,13 +998,26 @@ class IncrementalBatchScheduler(BatchScheduler):
                 continue
             if dest is None:
                 _SCHEDULED.inc(result="unschedulable")
+                gkey = gkey_of.get(key)
+                message = (
+                    f'pod group "{gkey}" rejected: fewer than minMember '
+                    "pods schedulable"
+                    if gkey in denied_keys
+                    else "no node fits"
+                )
                 cfg.client.record_event(
-                    pod, "FailedScheduling", "no node fits", source="scheduler"
+                    pod, "FailedScheduling", message, source="scheduler"
                 )
                 rejected.append(pod)
                 continue
             ns = pod.metadata.namespace or "default"
-            by_ns.setdefault(ns, []).append((pod.metadata.name, dest))
+            gkey = gkey_of.get(key)
+            if gkey is not None:
+                group_binds.setdefault(gkey, (ns, []))[1].append(
+                    (pod.metadata.name, dest)
+                )
+            else:
+                by_ns.setdefault(ns, []).append((pod.metadata.name, dest))
             placed.append((pod, dest))
 
         t0 = time.monotonic()
@@ -831,9 +1028,10 @@ class IncrementalBatchScheduler(BatchScheduler):
                     bind_results = cfg.binder.bind_bulk(items, namespace=ns)
                     for (pod_name, _dest), res in zip(items, bind_results):
                         outcome[(ns, pod_name)] = res
+                self._bind_groups_atomic(group_binds, outcome)
             except Exception:
                 pass  # unrecorded outcomes retry; dupes 409 next round
-        if by_ns:
+        if by_ns or group_binds:
             _BIND_LATENCY.observe(time.monotonic() - t0)
 
         for pod, dest in placed:
@@ -849,16 +1047,18 @@ class IncrementalBatchScheduler(BatchScheduler):
                     f"Successfully assigned {pod.metadata.name} to {dest}",
                     source="scheduler",
                 )
-            elif res.get("code") == 409:
+            elif not self._bind_retryable(res):
                 # Raced: someone else bound it. The session charged OUR
                 # placement; release it — the true binding arrives via
                 # the scheduled-pods watch and re-charges the right row.
                 self._session.delete_assigned(key)
                 _SCHEDULED.inc(result="bind_conflict")
             else:
+                # Bind error OR the gang's atomic batch rolled back
+                # (409 Aborted): release the session charge and retry.
                 self._session.delete_assigned(key)
                 _SCHEDULED.inc(result="bind_error")
                 rejected.append(pod)
         self._requeue_many(rejected)
         _E2E_LATENCY.observe(time.monotonic() - start)
-        return len(pending)
+        return len(pending) + len(deferred)

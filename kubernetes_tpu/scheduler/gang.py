@@ -1,0 +1,206 @@
+"""Gang scheduling: PodGroup partitioning + all-or-nothing acceptance.
+
+The paper's batch solver materializes the whole pending backlog as
+pod x node matrices, which makes group-level ("gang" / co-scheduling)
+feasibility a per-group segment reduction over arrays it already holds
+— something the reference's one-pod-at-a-time loop
+(plugin/pkg/scheduler/scheduler.go) cannot express without
+backtracking. Multi-host TPU training jobs need it: a 16-host slice
+job with 15 pods bound deadlocks the cluster (Gandiva/Tiresias-style
+DL schedulers solve the same problem; see PAPERS.md).
+
+Mechanics:
+
+- pods join a group via the POD_GROUP_LABEL label naming a PodGroup in
+  their namespace (models/objects.py; admission gates membership);
+- `partition_backlog` splits a drained backlog into GangGroups, each
+  carrying the group's minMember and the count of members ALREADY
+  bound (earlier ticks count toward the gang);
+- `gang_solve` wraps any backlog solver (scalar oracle, device scan,
+  wave, sinkhorn, sidecar) in the acceptance loop: solve, reduce
+  per-group placed counts (host numpy by default; the device path
+  passes ops.pipeline.gang_member_counts_device — a masked segment
+  reduction over the solver's own arrays), atomically reject every
+  group short of minMember, release its tentative placements by
+  RE-SOLVING the surviving backlog from scratch, and repeat to a fixed
+  point. Re-solving (rather than patching assignments) is what keeps
+  the scalar and device paths decision-parity: the sequential policy's
+  downstream choices depend on the full committed prefix.
+
+Commits ride bind_bulk(atomic=True): a mid-batch conflict rejects the
+whole group server-side instead of leaving stragglers bound.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.models.objects import POD_GROUP_LABEL, Pod
+from kubernetes_tpu.utils import metrics, tracing
+
+#: Group-level solve outcomes. accepted/rejected come from the solve's
+#: acceptance loop; bind_rollback from an atomic commit that conflicted
+#: server-side; timeout from the gang lifecycle controller.
+OUTCOMES = metrics.DEFAULT.counter(
+    "gang_solve_outcomes_total",
+    "PodGroup gang outcomes by kind",
+    ("outcome",),
+)
+
+PHASE_PENDING = "Pending"
+PHASE_SCHEDULED = "Scheduled"
+PHASE_UNSCHEDULABLE = "Unschedulable"
+
+
+def pod_group_name(pod: Pod) -> str:
+    """The PodGroup this pod belongs to ('' = ungrouped)."""
+    return (pod.metadata.labels or {}).get(POD_GROUP_LABEL, "")
+
+
+def pod_is_live(pod: Pod) -> bool:
+    """Gang membership counts LIVE pods only: terminal pods keep their
+    label and nodeName but no longer hold a slot or satisfy the floor —
+    crediting a Failed member as 'bound' would let its replacement bind
+    solo below minMember (the partial co-run gangs exist to prevent).
+    Mirrors the admission plugin's maxMember counting rule."""
+    return (
+        pod.status.phase not in ("Succeeded", "Failed")
+        and not pod.metadata.deletion_timestamp
+    )
+
+
+def group_key(namespace: str, name: str) -> str:
+    return f"{namespace or 'default'}/{name}"
+
+
+@dataclass
+class GangGroup:
+    """One PodGroup's slice of a drained backlog."""
+
+    key: str  # "namespace/name"
+    name: str
+    namespace: str
+    min_member: int
+    indices: List[int] = field(default_factory=list)  # positions in pending
+    bound: int = 0  # members already bound (count toward minMember)
+
+
+def partition_backlog(
+    pending: Sequence[Pod],
+    assigned: Sequence[Pod] = (),
+    min_member_of: Optional[Callable[[str, str], Optional[int]]] = None,
+) -> List[GangGroup]:
+    """Partition a backlog into its gang groups (ungrouped pods are
+    simply absent). `min_member_of(namespace, name)` resolves a group's
+    declared minMember; None (unknown group — admission normally
+    prevents this, but the scheduler must not wedge on a deleted
+    PodGroup) degrades the group to minMember 0, i.e. ordinary
+    per-pod scheduling. Already-bound members from `assigned` count
+    toward the gang: a group partially bound by an earlier tick only
+    needs the remainder."""
+    groups: Dict[str, GangGroup] = {}
+    for i, pod in enumerate(pending):
+        name = pod_group_name(pod)
+        if not name:
+            continue
+        ns = pod.metadata.namespace or "default"
+        key = group_key(ns, name)
+        g = groups.get(key)
+        if g is None:
+            mm = min_member_of(ns, name) if min_member_of is not None else None
+            g = groups[key] = GangGroup(
+                key=key, name=name, namespace=ns, min_member=int(mm or 0)
+            )
+        g.indices.append(i)
+    if groups:
+        for pod in assigned:
+            name = pod_group_name(pod)
+            if not name or not pod.spec.node_name or not pod_is_live(pod):
+                continue
+            g = groups.get(group_key(pod.metadata.namespace or "default", name))
+            if g is not None:
+                g.bound += 1
+    return [groups[k] for k in sorted(groups)]
+
+
+def member_counts_host(
+    placed: np.ndarray, group_ids: np.ndarray, num_groups: int
+) -> np.ndarray:
+    """Host (numpy) twin of ops.matrices.gang_member_counts — the
+    scalar-parity fallback's reducer."""
+    mask = placed & (group_ids >= 0)
+    return np.bincount(
+        group_ids[mask], minlength=num_groups
+    ).astype(np.int32)[:num_groups]
+
+
+Solver = Callable[
+    [Sequence[Pod], Sequence[object], Sequence[Pod], Sequence[object]],
+    List[Optional[str]],
+]
+
+
+def gang_solve(
+    solver: Solver,
+    pending: Sequence[Pod],
+    nodes,
+    assigned: Sequence[Pod] = (),
+    services=(),
+    groups: Sequence[GangGroup] = (),
+    counts_fn: Optional[Callable] = None,
+) -> Tuple[List[Optional[str]], List[GangGroup], List[GangGroup]]:
+    """Solve `pending` with group-level all-or-nothing acceptance.
+
+    Returns (destinations, accepted_groups, rejected_groups) —
+    destinations aligned with `pending`; every pod of a rejected group
+    maps to None. Each rejection round releases the rejected group's
+    tentative assignments back into the solve by re-solving the
+    surviving backlog from scratch against the same cluster state, so
+    capacity a rejected gang would have consumed is available to the
+    rest (and the sequential decision order stays parity-exact across
+    the scalar and device paths). Terminates in <= len(groups)+1
+    rounds: each round either converges or rejects >= 1 more group.
+    """
+    counts_fn = counts_fn or member_counts_host
+    n = len(pending)
+    if not groups:
+        return list(solver(pending, nodes, assigned, services)), [], []
+    group_ids = np.full(n, -1, np.int32)
+    for gi, g in enumerate(groups):
+        for i in g.indices:
+            group_ids[i] = gi
+    destinations: List[Optional[str]] = [None] * n
+    rejected: set = set()
+    with tracing.span("gang", groups=len(groups), pods=n):
+        while True:
+            active = [i for i in range(n) if group_ids[i] not in rejected]
+            dests = (
+                solver([pending[i] for i in active], nodes, assigned, services)
+                if active
+                else []
+            )
+            destinations = [None] * n
+            for i, d in zip(active, dests):
+                destinations[i] = d
+            with tracing.phase("gang_accept", groups=len(groups)):
+                placed = np.fromiter(
+                    (d is not None for d in destinations), bool, count=n
+                )
+                counts = counts_fn(placed, group_ids, len(groups))
+            newly = [
+                gi
+                for gi, g in enumerate(groups)
+                if gi not in rejected
+                and int(counts[gi]) + g.bound < g.min_member
+            ]
+            if not newly:
+                break
+            rejected.update(newly)
+    for gi in range(len(groups)):
+        OUTCOMES.inc(outcome="rejected" if gi in rejected else "accepted")
+    accepted = [g for gi, g in enumerate(groups) if gi not in rejected]
+    denied = [g for gi, g in enumerate(groups) if gi in rejected]
+    return destinations, accepted, denied

@@ -494,6 +494,84 @@ class ServiceAccountAdmission(Interface):
             )
 
 
+class PodGroupAdmission(Interface):
+    """Reject pods referencing unknown or oversized PodGroups (the
+    gang-scheduling admission gate; no reference analog — follows the
+    sig-scheduling coscheduling controller's membership rules).
+
+    A pod labeled with POD_GROUP_LABEL must name a PodGroup in its own
+    namespace, and when the group declares spec.maxMember, admitting
+    the pod must not push membership past it — an oversized group can
+    never gang-place atomically and would pin the whole group Pending.
+    UPDATE/PATCH is gated too (joining a gang by relabeling an existing
+    pod is the same membership change); updates that leave the label
+    untouched pass without re-checking."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation in (CREATE, UPDATE)
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+        from kubernetes_tpu.server.api import APIError
+
+        group = (
+            attrs.obj.get("metadata", {}).get("labels", {}) or {}
+        ).get(POD_GROUP_LABEL, "")
+        if not group:
+            # Unlabeled (or label-removing) writes always admit — and
+            # this is every ordinary pod UPDATE in the cluster, so it
+            # must return before any store fetch.
+            return
+        if attrs.operation == UPDATE:
+            try:
+                old = self.api.get("pods", attrs.namespace, attrs.name)
+            except APIError:
+                old = {}
+            old_group = (
+                old.get("metadata", {}).get("labels", {}) or {}
+            ).get(POD_GROUP_LABEL, "")
+            if group == old_group:
+                return  # membership unchanged: nothing to vet
+        try:
+            pg = self.api.get("podgroups", attrs.namespace, group)
+        except APIError:
+            raise AdmissionError(
+                f"pod group {attrs.namespace}/{group} does not exist", 404
+            )
+        max_member = int(pg.get("spec", {}).get("maxMember", 0) or 0)
+        if not max_member:
+            return
+        # Live members only: terminated pods (Succeeded/Failed) and
+        # pods being deleted no longer occupy a gang slot — counting
+        # them would permanently reject replacements for crashed
+        # members and wedge the gang below minMember. The pod being
+        # admitted never counts itself (relevant on relabel-updates).
+        # copy=False: the list is counted and discarded — a full
+        # deep copy of the namespace's pods under the admission lock
+        # would stall every concurrent write for nothing.
+        members = sum(
+            1
+            for p in self.api.list(
+                "pods", attrs.namespace,
+                label_selector=f"{POD_GROUP_LABEL}={group}",
+                copy=False,
+            )["items"]
+            if p.get("metadata", {}).get("name") != attrs.name
+            and p.get("status", {}).get("phase") not in ("Succeeded", "Failed")
+            and not p.get("metadata", {}).get("deletionTimestamp")
+        )
+        if members + 1 > max_member:
+            raise AdmissionError(
+                f"pod group {attrs.namespace}/{group} is full "
+                f"({members} live members, maxMember {max_member})"
+            )
+
+
 class SecurityContextDeny(Interface):
     """Reject pods that request privileged mode, added capabilities, or
     custom SELinux/RunAsUser options
@@ -556,5 +634,6 @@ register_plugin("NamespaceLifecycle", NamespaceLifecycle)
 register_plugin("LimitRanger", LimitRanger)
 register_plugin("ResourceQuota", ResourceQuotaAdmission)
 register_plugin("ServiceAccount", ServiceAccountAdmission)
+register_plugin("PodGroup", PodGroupAdmission)
 register_plugin("SecurityContextDeny", lambda api: SecurityContextDeny())
 register_plugin("DenyExecOnPrivileged", DenyExecOnPrivileged)

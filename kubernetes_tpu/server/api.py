@@ -1299,7 +1299,9 @@ class APIServer:
                 results.append(e.to_status())
         return results
 
-    def bind_bulk(self, namespace: str, bindings: list) -> list:
+    def bind_bulk(
+        self, namespace: str, bindings, atomic: bool = False
+    ) -> list:
         """Commit many bindings in one call (no reference analog — this
         is the batch-solver commit path: one request for a whole solved
         backlog instead of one per pod). The whole batch runs as ONE
@@ -1307,11 +1309,22 @@ class APIServer:
         would queue the scheduler behind every kubelet status writer
         once per pod — at 1000 nodes that convoy, not the solve, was
         the bind-rate ceiling. Each binding keeps the same guarded
-        emptiness check; per-item Status results are returned."""
-        from kubernetes_tpu.store import NotFoundError
+        emptiness check; per-item Status results are returned.
+
+        atomic=True (the gang-commit mode) makes the batch all-or-
+        nothing: the first conflict/invalid binding rejects EVERY
+        binding in the batch and commits none — the store stages all
+        writes and only publishes when every guard passes, so no pod is
+        ever observed bound and then rolled back. The failing item
+        carries its real error; the rest answer 409 Aborted."""
+        from kubernetes_tpu.store import AbortedError, NotFoundError
 
         if isinstance(bindings, dict):
+            atomic = bool(bindings.get("atomic", atomic))
             bindings = bindings.get("bindings", [])
+        aborted = APIError(
+            409, "Aborted", "atomic bind batch aborted; nothing applied"
+        ).to_status()
         out: List[Optional[dict]] = [None] * len(bindings)
         ops = []
         op_idx = []
@@ -1343,11 +1356,17 @@ class APIServer:
 
             ops.append((key, assign))
             op_idx.append(i)
+        if atomic and any(o is not None for o in out):
+            # A malformed binding rejects the whole atomic batch before
+            # any store work (reject-all on first invalid item).
+            return [o if o is not None else aborted for o in out]
         if ops:
-            results = self.store.atomic_update_many(ops)
+            results = self.store.atomic_update_many(ops, atomic=atomic)
             for i, res in zip(op_idx, results):
                 if isinstance(res, APIError):
                     out[i] = res.to_status()
+                elif isinstance(res, AbortedError):
+                    out[i] = aborted
                 elif isinstance(res, NotFoundError):
                     name = bindings[i].get("metadata", {}).get("name", "")
                     out[i] = _not_found("pods", name).to_status()

@@ -536,7 +536,10 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     if n
                 )
-                results = api.bind_bulk(ns, body.get("bindings", []))
+                # The whole body dict rides through: it carries the
+                # optional "atomic" (all-or-nothing gang commit) flag
+                # alongside "bindings".
+                results = api.bind_bulk(ns, body)
                 self._send_json(
                     200, {"kind": "BindingResultList", "results": results}
                 )
@@ -1230,6 +1233,10 @@ const RESOURCES = {
    (((v.spec||{}).claimRef)||{}).name||'', age(v)]},
  persistentvolumeclaims: {cols: ['name','phase','volume','age'],
   row: c => [name(c), pill((c.status||{}).phase), (c.spec||{}).volumeName||'', age(c)]},
+ podgroups: {cols: ['name','min-member','phase','bound','age'],
+  row: g => [name(g), ((g.spec||{}).minMember)||1,
+   pill((g.status||{}).phase||'Pending'),
+   ((g.status||{}).bound||0)+'/'+((g.status||{}).members||0), age(g)]},
  podtemplates: {cols: ['name','containers','age'],
   row: t => [name(t), (((t.template||{}).spec||{}).containers||[])
    .map(c=>c.name).join(', '), age(t)]},

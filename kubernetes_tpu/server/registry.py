@@ -107,6 +107,12 @@ _register(
 _register(ResourceInfo("podtemplates", "PodTemplate", O.PodTemplate))
 _register(
     ResourceInfo(
+        "podgroups", "PodGroup", O.PodGroup, validator=V.validate_pod_group
+    ),
+    "pg",
+)
+_register(
+    ResourceInfo(
         "componentstatuses", "ComponentStatus", O.ComponentStatus, namespaced=False
     ),
     "cs",

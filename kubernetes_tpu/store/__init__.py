@@ -9,6 +9,7 @@ checkpoints (everything device-side is reconstructible, SURVEY.md §5).
 """
 
 from kubernetes_tpu.store.kvstore import (
+    AbortedError,
     CompactedError,
     ConflictError,
     KVStore,
@@ -19,6 +20,7 @@ from kubernetes_tpu.store.watch import Event, ADDED, MODIFIED, DELETED, ERROR
 
 __all__ = [
     "KVStore",
+    "AbortedError",
     "ConflictError",
     "NotFoundError",
     "AlreadyExistsError",

@@ -75,6 +75,11 @@ class CompactedError(StoreError):
     """Watch window no longer covers the requested version."""
 
 
+class AbortedError(StoreError):
+    """An atomic batch aborted before this item was applied (some other
+    item in the batch failed); nothing in the batch was committed."""
+
+
 def _copy_obj(obj: dict) -> dict:
     """Private copy of a wire-form object. Wire objects are JSON by
     construction (they ride the WAL and the HTTP API as JSON), and a
@@ -757,7 +762,8 @@ class KVStore:
         return _copy_obj(stored)
 
     def atomic_update_many(
-        self, ops: List[Tuple[str, Callable[[dict], dict]]]
+        self, ops: List[Tuple[str, Callable[[dict], dict]]],
+        atomic: bool = False,
     ) -> List:
         """Batch of single-hold read-modify-writes under ONE lock
         acquisition (and one serialized-writer hop). The batch solver
@@ -766,17 +772,69 @@ class KVStore:
         kubelet status writer once per pod — at 1000 nodes that
         convoy, not the solve, was the bind-rate ceiling. Per-item
         results: the stored object, or the exception instance for
-        items whose update raised (APIError-style callers translate)."""
+        items whose update raised (APIError-style callers translate).
+
+        atomic=True makes the batch all-or-nothing (the gang-bind
+        path): every update_fn runs against a staged copy first, and
+        only when ALL succeed are the staged objects committed —
+        versions bumped, watches fanned out. On the first failure
+        nothing has been applied; the failing item carries its own
+        exception and every other item an AbortedError. Check-then-
+        commit under the one lock hold is strictly stronger than
+        apply-then-roll-back: no watcher can ever observe a state
+        that is later undone."""
 
         def batch():
             out = []
             with self._lock:
                 self._expire_locked()
+                if not atomic:
+                    for key, update_fn in ops:
+                        try:
+                            out.append(
+                                self._atomic_update_locked(key, update_fn)
+                            )
+                        except Exception as e:  # per-item outcome, not abort
+                            out.append(e)
+                    return out, self._wal_seq
+                # Atomic: stage everything, commit only if all succeed.
+                # `staged` doubles as an overlay so a batch touching the
+                # same key twice sees its own earlier (uncommitted) write.
+                staged: Dict[str, dict] = {}
+                order: List[Tuple[str, dict, dict]] = []
+                failure: Optional[Exception] = None
                 for key, update_fn in ops:
+                    cur = staged.get(key)
+                    if cur is None:
+                        if key not in self._data:
+                            failure = NotFoundError(key)
+                            break
+                        cur = self._data[key][0]
                     try:
-                        out.append(self._atomic_update_locked(key, update_fn))
-                    except Exception as e:  # per-item outcome, not abort
-                        out.append(e)
+                        stored = _copy_obj(update_fn(_copy_obj(cur)))
+                    except Exception as e:
+                        failure = e
+                        break
+                    staged[key] = stored
+                    order.append((key, stored, cur))
+                if failure is not None:
+                    n_done = len(order)
+                    for i in range(len(ops)):
+                        if i == n_done:
+                            out.append(failure)
+                        else:
+                            out.append(
+                                AbortedError(
+                                    "atomic batch aborted; nothing applied"
+                                )
+                            )
+                    return out, self._wal_seq
+                for key, stored, cur in order:
+                    v = self._bump()
+                    self._stamp(stored, v)
+                    self._data[key] = (stored, v)
+                    self._record(v, MODIFIED, key, stored, prev=cur)
+                    out.append(stored)
                 return out, self._wal_seq
 
         results, seq = self._apply_write(batch)

@@ -30,6 +30,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: at-scale tests (minutes); run with --runslow"
     )
+    config.addinivalue_line(
+        "markers",
+        "gang: gang-scheduling (PodGroup) tests; tier-1 includes them — "
+        "select just these with -m gang",
+    )
 
 
 def pytest_addoption(parser):

@@ -138,6 +138,113 @@ class TestBinding:
         assert e.value.code == 404
 
 
+class TestBulkBindings:
+    """bind_bulk semantics on both transports: the default per-item
+    mode (partial failure isolated) and atomic=True (gang commit:
+    reject-all on first conflict, nothing applied)."""
+
+    def test_partial_mode_isolates_failures(self, client):
+        client.create("pods", pod_wire("ok1"))
+        client.create("pods", pod_wire("ok2"))
+        client.create("pods", pod_wire("taken", node="n9"))
+        results = client.bind_bulk(
+            [("ok1", "n1"), ("taken", "n1"), ("ghost", "n1"), ("ok2", "n2")]
+        )
+        assert [r.get("status") for r in results] == [
+            "Success", "Failure", "Failure", "Success",
+        ]
+        assert results[1]["code"] == 409
+        assert results[2]["code"] == 404
+        # No pod is ever half-bound: each either has its full target
+        # nodeName or is untouched.
+        assert client.get("pods", "ok1", namespace="default").spec.node_name == "n1"
+        assert client.get("pods", "ok2", namespace="default").spec.node_name == "n2"
+        assert client.get("pods", "taken", namespace="default").spec.node_name == "n9"
+
+    def test_atomic_mode_rejects_all_on_conflict(self, client):
+        client.create("pods", pod_wire("g1"))
+        client.create("pods", pod_wire("g2", node="n9"))  # conflicts
+        client.create("pods", pod_wire("g3"))
+        results = client.bind_bulk(
+            [("g1", "n1"), ("g2", "n1"), ("g3", "n2")], atomic=True
+        )
+        assert all(r.get("status") == "Failure" for r in results)
+        # The conflicting item carries its real error; the rest abort.
+        assert results[1]["code"] == 409 and results[1]["reason"] == "Conflict"
+        assert results[0]["reason"] == "Aborted"
+        assert results[2]["reason"] == "Aborted"
+        # NOTHING was applied — the earlier-in-batch g1 stayed unbound.
+        assert not client.get("pods", "g1", namespace="default").spec.node_name
+        assert not client.get("pods", "g3", namespace="default").spec.node_name
+        assert client.get("pods", "g2", namespace="default").spec.node_name == "n9"
+
+    def test_atomic_mode_missing_pod_aborts_all(self, client):
+        client.create("pods", pod_wire("g1"))
+        results = client.bind_bulk(
+            [("g1", "n1"), ("ghost", "n1")], atomic=True
+        )
+        assert results[0]["reason"] == "Aborted"
+        assert results[1]["code"] == 404
+        assert not client.get("pods", "g1", namespace="default").spec.node_name
+
+    def test_atomic_mode_success_binds_all(self, client):
+        client.create("pods", pod_wire("g1"))
+        client.create("pods", pod_wire("g2"))
+        results = client.bind_bulk(
+            [("g1", "n1"), ("g2", "n2")], atomic=True
+        )
+        assert all(r.get("status") == "Success" for r in results)
+        assert client.get("pods", "g1", namespace="default").spec.node_name == "n1"
+        assert client.get("pods", "g2", namespace="default").spec.node_name == "n2"
+
+    def test_atomic_mode_malformed_binding_aborts_before_store(self, client):
+        client.create("pods", pod_wire("g1"))
+        # Raw body path: one binding lacks a target name.
+        results = client.t.request(
+            "POST", "bind_bulk", ("default",),
+            {
+                "atomic": True,
+                "bindings": [
+                    {"metadata": {"name": "g1"}, "target": {"name": "n1"}},
+                    {"metadata": {"name": "g1"}, "target": {}},
+                ],
+            },
+        )
+        if isinstance(results, dict):
+            results = results["results"]
+        assert results[0]["reason"] == "Aborted"
+        assert results[1]["code"] == 400
+        assert not client.get("pods", "g1", namespace="default").spec.node_name
+
+    def test_atomic_watch_sees_no_rolled_back_binding(self, client):
+        """Check-then-commit means a watcher never observes a binding
+        that is later undone by the atomic abort."""
+        client.create("pods", pod_wire("w1"))
+        client.create("pods", pod_wire("w2", node="n9"))
+        _, version = client.list("pods", namespace="default")
+        stream = client.watch("pods", namespace="default", since=version)
+        client.bind_bulk([("w1", "n1"), ("w2", "n1")], atomic=True)
+        client.create("pods", pod_wire("sentinel"))
+        seen = []
+        while True:
+            ev = stream.next(timeout=2)
+            if ev is None:
+                break
+            seen.append(ev)
+            if ev.object.get("metadata", {}).get("name") == "sentinel":
+                break
+        stream.close()
+        assert all(
+            not (ev.object.get("spec") or {}).get("nodeName")
+            for ev in seen
+            if ev.object.get("metadata", {}).get("name") == "w1"
+        )
+        assert any(
+            ev.object.get("metadata", {}).get("name") == "sentinel"
+            for ev in seen
+        )
+
+
 class TestWatch:
     def test_watch_stream(self, client):
         items, version = client.list("pods", namespace="default")

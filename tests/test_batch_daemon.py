@@ -327,3 +327,22 @@ def test_daemon_accepts_auto_mode():
         assert sched.mode in ("scan", "wave")  # resolved, never "auto"
     finally:
         cfg.stop()
+
+
+def test_batch_mode_auto_resolution_keyed_on_mesh_argument():
+    """Direct unit coverage for resolve_batch_mode's `mesh` keying with
+    a REAL jax.sharding.Mesh (not a sentinel): auto resolves by the
+    mesh the solve will actually run on, and explicit modes are never
+    second-guessed by topology."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.scheduler.batch import resolve_batch_mode
+
+    assert resolve_batch_mode("auto", mesh=None) == "scan"
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    assert resolve_batch_mode("auto", mesh=mesh) == "wave"
+    for m in ("scan", "wave", "sinkhorn"):
+        assert resolve_batch_mode(m, mesh=mesh) == m
+        assert resolve_batch_mode(m, mesh=None) == m

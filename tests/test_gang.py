@@ -1,0 +1,648 @@
+"""Gang scheduling (PodGroup) subsystem: API + admission + solve
+acceptance + daemon commit + lifecycle controller.
+
+The acceptance bar (ISSUE 2): a 2-group backlog where only one group
+fits — the fitting group binds completely, the other binds ZERO pods,
+gets an event + Unschedulable status from the gang controller, and the
+scalar and TPU batch paths accept the same group set.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.controllers.gangs import GangController
+from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+from kubernetes_tpu.scheduler.daemon import (
+    BatchScheduler,
+    IncrementalBatchScheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.server import APIError, APIServer
+from kubernetes_tpu.server.admission import new_from_plugins
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+pytestmark = pytest.mark.gang
+
+
+def pg_wire(name, min_member=1, max_member=0, timeout=0, ns="default"):
+    spec = {"minMember": min_member}
+    if max_member:
+        spec["maxMember"] = max_member
+    if timeout:
+        spec["scheduleTimeoutSeconds"] = timeout
+    return {
+        "kind": "PodGroup",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+def pod_wire(name, cpu="100m", mem="64Mi", group="", ns="default"):
+    labels = {POD_GROUP_LABEL: group} if group else {}
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": {
+            "containers": [
+                {"name": "c", "image": "pause",
+                 "resources": {"limits": {"cpu": cpu, "memory": mem}}}
+            ]
+        },
+    }
+
+
+def node_wire(name, cpu="1", mem="8Gi", pods="110"):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "status": {
+            "capacity": {"cpu": cpu, "memory": mem, "pods": pods},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def wait_until(cond, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# API resource
+# ---------------------------------------------------------------------------
+
+
+class TestPodGroupResource:
+    def test_crud_and_status_subresource(self):
+        client = Client(LocalTransport(APIServer()))
+        created = client.create("podgroups", pg_wire("g1", min_member=4))
+        assert created.spec.min_member == 4
+        assert created.status.phase == "Pending"
+        client.update_status(
+            "podgroups",
+            {"kind": "PodGroup",
+             "metadata": {"name": "g1", "namespace": "default"},
+             "status": {"phase": "Scheduled", "members": 4, "bound": 4}},
+            namespace="default",
+        )
+        got = client.get("podgroups", "g1", namespace="default")
+        assert got.status.phase == "Scheduled"
+        assert got.status.bound == 4
+        assert got.spec.min_member == 4  # status write preserved spec
+        items, _ = client.list("podgroups", namespace="default")
+        assert [g.metadata.name for g in items] == ["g1"]
+
+    def test_validation(self):
+        client = Client(LocalTransport(APIServer()))
+        with pytest.raises(APIError) as e:
+            client.create("podgroups", pg_wire("bad", min_member=0))
+        assert e.value.code == 422
+        with pytest.raises(APIError) as e:
+            client.create(
+                "podgroups", pg_wire("bad", min_member=4, max_member=2)
+            )
+        assert e.value.code == 422
+        bad = pg_wire("bad")
+        bad["spec"]["scheduleTimeoutSeconds"] = -5
+        with pytest.raises(APIError) as e:
+            client.create("podgroups", bad)
+        assert e.value.code == 422
+
+    def test_ktctl_get_podgroups_table(self, capsys):
+        from kubernetes_tpu.cli.ktctl import main
+
+        client = Client(LocalTransport(APIServer()))
+        client.create("podgroups", pg_wire("trainer", min_member=16))
+        assert main(["get", "pg", "-n", "default"], client=client) == 0
+        out = capsys.readouterr().out
+        assert "MIN-MEMBER" in out and "trainer" in out and "16" in out
+
+
+# ---------------------------------------------------------------------------
+# Admission
+# ---------------------------------------------------------------------------
+
+
+class TestPodGroupAdmission:
+    def _client(self):
+        api = APIServer()
+        api.admission = new_from_plugins(api, ["PodGroup"])
+        return Client(LocalTransport(api))
+
+    def test_unknown_group_rejected(self):
+        client = self._client()
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod_wire("p1", group="nope"))
+        assert e.value.code == 404
+
+    def test_oversized_group_rejected(self):
+        client = self._client()
+        client.create("podgroups", pg_wire("g1", min_member=1, max_member=2))
+        client.create("pods", pod_wire("p1", group="g1"))
+        client.create("pods", pod_wire("p2", group="g1"))
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod_wire("p3", group="g1"))
+        assert e.value.code == 403
+        assert "full" in e.value.message
+
+    def test_ungrouped_and_unbounded_pods_unaffected(self):
+        client = self._client()
+        client.create("pods", pod_wire("free"))
+        client.create("podgroups", pg_wire("g1", min_member=3))  # no max
+        for i in range(5):
+            client.create("pods", pod_wire(f"m{i}", group="g1"))
+
+    def test_update_joining_a_gang_is_gated(self):
+        """Relabeling an existing pod into a gang is the same
+        membership change as creating it there — unknown groups and
+        full groups reject; untouched labels pass."""
+        from kubernetes_tpu.models import serde
+
+        client = self._client()
+        client.create("podgroups", pg_wire("g1", min_member=1, max_member=1))
+        client.create("pods", pod_wire("member", group="g1"))
+        client.create("pods", pod_wire("outsider"))
+        outsider = serde.to_wire(
+            client.get("pods", "outsider", namespace="default")
+        )
+        outsider["metadata"]["labels"] = {POD_GROUP_LABEL: "ghost"}
+        with pytest.raises(APIError) as e:
+            client.update("pods", outsider, namespace="default")
+        assert e.value.code == 404
+        outsider["metadata"]["labels"] = {POD_GROUP_LABEL: "g1"}
+        with pytest.raises(APIError) as e:  # g1 is full
+            client.update("pods", outsider, namespace="default")
+        assert e.value.code == 403
+        # Unchanged membership: updating the existing member passes
+        # even though its group is at maxMember (it never counts
+        # itself).
+        member = serde.to_wire(
+            client.get("pods", "member", namespace="default")
+        )
+        member["metadata"]["annotations"] = {"touched": "yes"}
+        client.update("pods", member, namespace="default")
+
+    def test_terminated_members_free_their_gang_slot(self):
+        """A crashed member's replacement must admit: Succeeded/Failed
+        pods (and ones being deleted) do not count toward maxMember."""
+        client = self._client()
+        client.create("podgroups", pg_wire("g1", min_member=2, max_member=2))
+        client.create("pods", pod_wire("m0", group="g1"))
+        client.create("pods", pod_wire("m1", group="g1"))
+        client.update_status(
+            "pods",
+            {"kind": "Pod",
+             "metadata": {"name": "m1", "namespace": "default"},
+             "status": {"phase": "Failed"}},
+            namespace="default",
+        )
+        client.create("pods", pod_wire("m1-replacement", group="g1"))
+        with pytest.raises(APIError):  # live count is back at max
+            client.create("pods", pod_wire("m2", group="g1"))
+
+
+# ---------------------------------------------------------------------------
+# Solve-level acceptance
+# ---------------------------------------------------------------------------
+
+
+class TestGangSolve:
+    def test_rejected_group_releases_capacity_into_the_solve(self):
+        """A rejected gang's tentative placements free capacity the
+        SAME solve then hands to other pods (the release-and-resolve
+        loop, not just a veto)."""
+        from kubernetes_tpu.scheduler.batch import schedule_backlog_gang_scalar
+        from kubernetes_tpu.scheduler.gang import partition_backlog
+        from tests.test_solver_parity import mk_node, mk_pod
+
+        pods = []
+        for i in range(2):  # gang of 2 x 600m: only one fits -> reject
+            p = mk_pod(f"b{i}", cpu=600)
+            p.metadata.labels[POD_GROUP_LABEL] = "gb"
+            pods.append(p)
+        pods.append(mk_pod("single", cpu=800))  # fits only post-release
+        nodes = [mk_node("n0", cpu=1000)]
+        groups = partition_backlog(pods, min_member_of=lambda ns, n: 2)
+        dests, accepted, rejected = schedule_backlog_gang_scalar(
+            pods, nodes, groups=groups
+        )
+        assert [g.key for g in rejected] == ["default/gb"]
+        assert dests == [None, None, "n0"]
+
+    def test_already_bound_members_count_toward_min_member(self):
+        from kubernetes_tpu.scheduler.batch import schedule_backlog_gang_scalar
+        from kubernetes_tpu.scheduler.gang import partition_backlog
+        from tests.test_solver_parity import mk_node, mk_pod
+
+        bound = mk_pod("b0", cpu=100)
+        bound.metadata.labels[POD_GROUP_LABEL] = "ga"
+        bound.spec.node_name = "n0"
+        p = mk_pod("p0", cpu=100)
+        p.metadata.labels[POD_GROUP_LABEL] = "ga"
+        groups = partition_backlog(
+            [p], assigned=[bound], min_member_of=lambda ns, n: 2
+        )
+        assert groups[0].bound == 1
+        dests, accepted, rejected = schedule_backlog_gang_scalar(
+            [p], [mk_node("n0")], assigned=[bound], groups=groups
+        )
+        assert not rejected and dests == ["n0"]
+
+    def test_terminal_bound_members_do_not_credit_the_floor(self):
+        """A Failed member keeps its label and nodeName but must not
+        count toward minMember — otherwise its replacement binds solo
+        below the floor."""
+        from kubernetes_tpu.scheduler.gang import partition_backlog
+        from tests.test_solver_parity import mk_pod
+
+        dead = mk_pod("dead", cpu=100)
+        dead.metadata.labels[POD_GROUP_LABEL] = "ga"
+        dead.spec.node_name = "n0"
+        dead.status.phase = "Failed"
+        p = mk_pod("replacement", cpu=100)
+        p.metadata.labels[POD_GROUP_LABEL] = "ga"
+        (g,) = partition_backlog(
+            [p], assigned=[dead], min_member_of=lambda ns, n: 2
+        )
+        assert g.bound == 0  # the dead pod frees its credit
+
+    def test_unknown_group_degrades_to_per_pod(self):
+        from kubernetes_tpu.scheduler.gang import partition_backlog
+        from tests.test_solver_parity import mk_pod
+
+        p = mk_pod("p0")
+        p.metadata.labels[POD_GROUP_LABEL] = "ghost"
+        (g,) = partition_backlog([p], min_member_of=lambda ns, n: None)
+        assert g.min_member == 0  # never rejects
+
+    def test_host_and_device_reducers_agree(self):
+        import numpy as np
+
+        from kubernetes_tpu.ops.pipeline import gang_member_counts_device
+        from kubernetes_tpu.scheduler.gang import member_counts_host
+
+        rng = np.random.RandomState(7)
+        for _ in range(5):
+            n, g = rng.randint(1, 64), rng.randint(1, 9)
+            placed = rng.rand(n) < 0.6
+            gids = rng.randint(-1, g, size=n).astype(np.int32)
+            host = member_counts_host(placed, gids, g)
+            dev = gang_member_counts_device(placed, gids, g)
+            assert (host == dev).all(), (host, dev)
+
+
+# ---------------------------------------------------------------------------
+# Gang lifecycle controller
+# ---------------------------------------------------------------------------
+
+
+class TestGangController:
+    def test_scheduled_when_min_member_bound(self):
+        client = Client(LocalTransport(APIServer()))
+        client.create("podgroups", pg_wire("g1", min_member=2))
+        client.create("pods", pod_wire("m0", group="g1"))
+        client.create("pods", pod_wire("m1", group="g1"))
+        ctrl = GangController(client)
+        ctrl.sync_once()
+        got = client.get("podgroups", "g1", namespace="default")
+        assert got.status.phase == "Pending"
+        assert got.status.members == 2 and got.status.bound == 0
+        client.bind("m0", "n0", namespace="default")
+        client.bind("m1", "n1", namespace="default")
+        ctrl.sync_once()
+        got = client.get("podgroups", "g1", namespace="default")
+        assert got.status.phase == "Scheduled" and got.status.bound == 2
+        client.flush_events()
+        events, _ = client.list(
+            "events", namespace="default",
+            field_selector="involvedObject.name=g1",
+        )
+        assert any(e.reason == "GangScheduled" for e in events)
+
+    def test_pending_past_timeout_marked_unschedulable(self):
+        client = Client(LocalTransport(APIServer()))
+        client.create("podgroups", pg_wire("g1", min_member=2, timeout=5))
+        client.create("pods", pod_wire("m0", group="g1"))
+        ctrl = GangController(client)
+        ctrl.sync_once()  # young: stays Pending
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Pending"
+        )
+        ctrl.sync_once(now=time.time() + 60)  # aged past the timeout
+        got = client.get("podgroups", "g1", namespace="default")
+        assert got.status.phase == "Unschedulable"
+        assert "still 0/2" in got.status.message
+        client.flush_events()
+        events, _ = client.list(
+            "events", namespace="default",
+            field_selector="involvedObject.name=g1",
+        )
+        assert any(e.reason == "GangTimeout" for e in events)
+
+    def test_repending_gang_gets_a_fresh_timeout_window(self):
+        """A Scheduled gang that loses members re-pends and ages from
+        the re-pend time (status.pendingSince), not creation — no
+        instant spurious GangTimeout."""
+        client = Client(LocalTransport(APIServer()))
+        client.create("podgroups", pg_wire("g1", min_member=1, timeout=30))
+        client.create("pods", pod_wire("m0", group="g1"))
+        client.bind("m0", "n0", namespace="default")
+        ctrl = GangController(client)
+        ctrl.sync_once()
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Scheduled"
+        )
+        client.delete("pods", "m0", namespace="default")
+        late = time.time() + 1000  # way past creation + timeout
+        ctrl.sync_once(now=late)
+        got = client.get("podgroups", "g1", namespace="default")
+        assert got.status.phase == "Pending"  # NOT instantly timed out
+        assert got.status.pending_since
+        ctrl.sync_once(now=late + 5)  # inside the fresh window
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Pending"
+        )
+        ctrl.sync_once(now=late + 60)  # fresh window exhausted
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Unschedulable"
+        )
+
+    def test_crashed_gang_repends_instead_of_staying_scheduled(self):
+        """Terminal members keep nodeName but are not 'bound': a gang
+        whose pods all crashed must leave Scheduled (and can then age
+        out), not sit green with zero running members."""
+        client = Client(LocalTransport(APIServer()))
+        client.create("podgroups", pg_wire("g1", min_member=1))
+        client.create("pods", pod_wire("m0", group="g1"))
+        client.bind("m0", "n0", namespace="default")
+        ctrl = GangController(client)
+        ctrl.sync_once()
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Scheduled"
+        )
+        client.update_status(
+            "pods",
+            {"kind": "Pod",
+             "metadata": {"name": "m0", "namespace": "default"},
+             "status": {"phase": "Failed"}},
+            namespace="default",
+        )
+        ctrl.sync_once()
+        got = client.get("podgroups", "g1", namespace="default")
+        assert got.status.phase == "Pending"
+        assert got.status.bound == 0 and got.status.members == 0
+
+    def test_unschedulable_recovers_to_scheduled(self):
+        client = Client(LocalTransport(APIServer()))
+        client.create("podgroups", pg_wire("g1", min_member=1, timeout=5))
+        client.create("pods", pod_wire("m0", group="g1"))
+        ctrl = GangController(client)
+        ctrl.sync_once(now=time.time() + 60)
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Unschedulable"
+        )
+        client.bind("m0", "n0", namespace="default")
+        ctrl.sync_once()
+        assert (
+            client.get("podgroups", "g1", namespace="default").status.phase
+            == "Scheduled"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Daemon integration (the ISSUE acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def _two_group_cluster(client):
+    """Two 1-cpu nodes; gang ga (2 x 900m — fits, one pod per node) and
+    gang gb (2 x 900m, minMember 2 — cannot fit once ga lands)."""
+    for j in range(2):
+        client.create("nodes", node_wire(f"n{j}", cpu="1"))
+    client.create("podgroups", pg_wire("ga", min_member=2))
+    client.create("podgroups", pg_wire("gb", min_member=2, timeout=1))
+    for i in range(2):
+        client.create("pods", pod_wire(f"a{i}", cpu="900m", group="ga"))
+    for i in range(2):
+        client.create("pods", pod_wire(f"b{i}", cpu="900m", group="gb"))
+
+
+def _assert_all_or_nothing(client):
+    pods, _ = client.list("pods", namespace="default")
+    by_name = {p.metadata.name: p for p in pods}
+    assert by_name["a0"].spec.node_name and by_name["a1"].spec.node_name
+    assert {by_name["a0"].spec.node_name, by_name["a1"].spec.node_name} == {
+        "n0", "n1",
+    }
+    # The losing gang bound ZERO pods — no stragglers.
+    assert not by_name["b0"].spec.node_name
+    assert not by_name["b1"].spec.node_name
+
+
+@pytest.mark.parametrize("daemon_cls", [BatchScheduler, IncrementalBatchScheduler])
+def test_two_group_backlog_all_or_nothing(daemon_cls):
+    """One group fits, the other binds zero pods, gets an event +
+    Unschedulable from the gang controller — on both batch daemons."""
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    _two_group_cluster(client)
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = daemon_cls(cfg)
+        processed = 0
+        deadline = time.monotonic() + 60
+        while processed < 4 and time.monotonic() < deadline:
+            processed += sched.schedule_batch(timeout=0.5)
+        assert processed >= 4
+        _assert_all_or_nothing(client)
+        # Rejected-gang pods carry a gang-specific FailedScheduling event.
+        cfg.client.flush_events()
+        events, _ = client.list(
+            "events", namespace="default",
+            field_selector="involvedObject.name=b0",
+        )
+        assert any(
+            "pod group" in e.message and "gb" in e.message for e in events
+        ), [e.message for e in events]
+        # The gang controller ages the stuck group to Unschedulable
+        # (scheduleTimeoutSeconds=1) with an event; the winner is
+        # Scheduled.
+        ctrl = GangController(client)
+        ctrl.sync_once(now=time.time() + 60)
+        ga = client.get("podgroups", "ga", namespace="default")
+        gb = client.get("podgroups", "gb", namespace="default")
+        assert ga.status.phase == "Scheduled" and ga.status.bound == 2
+        assert gb.status.phase == "Unschedulable" and gb.status.bound == 0
+        client.flush_events()
+        events, _ = client.list(
+            "events", namespace="default",
+            field_selector="involvedObject.name=gb",
+        )
+        assert any(e.reason == "GangTimeout" for e in events)
+    finally:
+        cfg.stop()
+
+
+def test_transient_podgroup_fetch_failure_defers_gangs(monkeypatch):
+    """If PodGroup specs cannot be fetched this tick (apiserver
+    hiccup), grouped pods are DEFERRED — never scheduled per-pod, which
+    would break the all-or-nothing contract — while ungrouped pods
+    still schedule."""
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    client.create("nodes", node_wire("n0", cpu="4"))
+    client.create("podgroups", pg_wire("ga", min_member=2))
+    client.create("pods", pod_wire("a0", group="ga"))
+    client.create("pods", pod_wire("a1", group="ga"))
+    client.create("pods", pod_wire("solo"))
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = BatchScheduler(cfg)
+        real_list = cfg.client.list
+
+        def flaky_list(resource, *a, **k):
+            if resource == "podgroups":
+                raise ConnectionError("apiserver hiccup")
+            return real_list(resource, *a, **k)
+
+        monkeypatch.setattr(cfg.client, "list", flaky_list)
+        processed = 0
+        deadline = time.monotonic() + 30
+        while processed < 3 and time.monotonic() < deadline:
+            processed += sched.schedule_batch(timeout=0.5)
+        pods, _ = client.list("pods", namespace="default")
+        by_name = {p.metadata.name: p.spec.node_name for p in pods}
+        assert by_name["solo"] == "n0"
+        assert not by_name["a0"] and not by_name["a1"]
+        # Specs resolvable again: the deferred gang binds whole.
+        monkeypatch.setattr(cfg.client, "list", real_list)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.5)
+            pods, _ = client.list("pods", namespace="default")
+            if all(p.spec.node_name for p in pods):
+                break
+        assert all(p.spec.node_name for p in pods)
+    finally:
+        cfg.stop()
+
+
+def test_device_outage_falls_back_to_scalar_gang_solve(monkeypatch):
+    """When the device path is down, gang batches must still schedule:
+    the fallback runs the scalar solver AND the host acceptance reducer
+    (the device reducer would just re-raise the outage)."""
+    import kubernetes_tpu.ops.pipeline as pipeline
+    import kubernetes_tpu.scheduler.batch as batch
+
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    _two_group_cluster(client)
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = BatchScheduler(cfg)
+
+        def broken(*a, **k):
+            raise RuntimeError("device unavailable")
+
+        monkeypatch.setattr(batch, "schedule_backlog_tpu", broken)
+        monkeypatch.setattr(pipeline, "gang_member_counts_device", broken)
+        processed = 0
+        deadline = time.monotonic() + 60
+        while processed < 4 and time.monotonic() < deadline:
+            processed += sched.schedule_batch(timeout=0.5)
+        assert processed >= 4
+        assert sched.fallback_count > 0
+        _assert_all_or_nothing(client)
+    finally:
+        cfg.stop()
+
+
+def test_scalar_and_tpu_paths_accept_same_group_set():
+    """The acceptance loop is path-independent: scalar fallback and the
+    device scan agree on the accepted-group set AND destinations."""
+    from kubernetes_tpu.models import serde
+    from kubernetes_tpu.models.objects import Node, Pod
+    from kubernetes_tpu.scheduler.batch import (
+        schedule_backlog_gang_scalar,
+        schedule_backlog_gang_tpu,
+    )
+    from kubernetes_tpu.scheduler.gang import partition_backlog
+
+    pods = [
+        serde.from_wire(Pod, pod_wire(f"a{i}", cpu="900m", group="ga"))
+        for i in range(2)
+    ] + [
+        serde.from_wire(Pod, pod_wire(f"b{i}", cpu="900m", group="gb"))
+        for i in range(2)
+    ]
+    nodes = [serde.from_wire(Node, node_wire(f"n{j}", cpu="1")) for j in range(2)]
+    groups = partition_backlog(pods, min_member_of=lambda ns, n: 2)
+    ds, acc_s, rej_s = schedule_backlog_gang_scalar(pods, nodes, groups=groups)
+    dt, acc_t, rej_t = schedule_backlog_gang_tpu(pods, nodes, groups=groups)
+    assert [g.key for g in acc_s] == [g.key for g in acc_t] == ["default/ga"]
+    assert [g.key for g in rej_s] == [g.key for g in rej_t] == ["default/gb"]
+    assert ds == dt
+    assert ds[2] is None and ds[3] is None
+
+
+@pytest.mark.gang
+def test_http_smoke_podgroup_binds_all_or_nothing():
+    """Tier-1 smoke: create a PodGroup over the HTTP API, schedule with
+    an HTTP-backed batch daemon, and watch the gang bind all-or-nothing
+    (losing gang: zero bindings on the watch stream)."""
+    server = APIHTTPServer(APIServer()).start()
+    try:
+        client = Client(HTTPTransport(server.address))
+        _two_group_cluster(client)
+        assert (
+            client.get("podgroups", "ga", namespace="default").spec.min_member
+            == 2
+        )
+        _, version = client.list("pods", namespace="default")
+        stream = client.watch("pods", namespace="default", since=version)
+        cfg = SchedulerConfig(
+            Client(HTTPTransport(server.address))
+        ).start()
+        try:
+            assert cfg.wait_for_sync(timeout=60)
+            sched = BatchScheduler(cfg)
+            processed = 0
+            deadline = time.monotonic() + 60
+            while processed < 4 and time.monotonic() < deadline:
+                processed += sched.schedule_batch(timeout=0.5)
+            _assert_all_or_nothing(client)
+            # Watch saw exactly the winner gang's two bindings.
+            bound = set()
+            while True:
+                ev = stream.next(timeout=1.0)
+                if ev is None:
+                    break
+                if ev.type == "MODIFIED" and ev.object["spec"].get("nodeName"):
+                    bound.add(ev.object["metadata"]["name"])
+            assert bound == {"a0", "a1"}
+            GangController(client).sync_once()
+            assert (
+                client.get("podgroups", "ga", namespace="default").status.phase
+                == "Scheduled"
+            )
+        finally:
+            cfg.stop()
+        stream.close()
+    finally:
+        server.stop()

@@ -178,3 +178,44 @@ def test_lint_metrics_catches_violations(tmp_path):
     # Both bypass shapes are caught: metrics.Summary(...) AND the
     # from-import form Counter(...).
     assert proc.stderr.count("bypasses metrics.DEFAULT") == 2
+
+
+def test_lint_metrics_knows_gang_names(tmp_path):
+    """The gang_* metric family (scheduler/gang.py, controllers/
+    gangs.py) is known to the linter: the suffixed counters pass the
+    standard rule, the unitless gang_pending_groups gauge is
+    explicitly allowlisted, and a novel suffix-less gang name still
+    fails (the allowlist names metrics, not a prefix)."""
+    from tools.lint_metrics import GANG_METRICS
+
+    assert GANG_METRICS == {
+        "gang_solve_outcomes_total",
+        "gang_controller_syncs_total",
+        "gang_pending_groups",
+    }
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.counter("gang_solve_outcomes_total", "x", ("outcome",))\n'
+        'B = metrics.DEFAULT.counter("gang_controller_syncs_total", "x", ("result",))\n'
+        'C = metrics.DEFAULT.gauge("gang_pending_groups", "x")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "lint_metrics.py"), str(good)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("gang_stuck", "x")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "lint_metrics.py"), str(bad)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr

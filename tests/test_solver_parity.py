@@ -347,6 +347,77 @@ class TestPipelinedBacklog:
         assert out[2:] == [None, None]
 
 
+def attach_gangs(pods, rng):
+    """Attach PodGroups to a backlog fixture: consecutive chunks become
+    gangs (some chunks stay ungrouped) with randomized minMember, so
+    the acceptance loop exercises accept, reject, and release paths.
+    Deterministic per rng seed. Returns the partitioned GangGroups."""
+    from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+    from kubernetes_tpu.scheduler.gang import partition_backlog
+
+    min_members = {}
+    gi = i = 0
+    while i < len(pods):
+        chunk = pods[i : i + rng.randint(1, 4)]
+        i += len(chunk)
+        if rng.random() < 0.3:
+            continue  # ungrouped chunk: rides along per-pod
+        name = f"g{gi}"
+        gi += 1
+        for p in chunk:
+            p.metadata.labels[POD_GROUP_LABEL] = name
+        min_members[name] = rng.randint(1, len(chunk) + 1)
+    return partition_backlog(
+        pods, min_member_of=lambda ns, n: min_members.get(n)
+    )
+
+
+@pytest.mark.gang
+class TestGangParity:
+    """Every backlog fixture also runs with gangs attached: the scalar
+    and TPU paths must agree on the accepted-group set AND on every
+    destination (the acceptance loop re-solves, so group rejection must
+    not perturb decision parity)."""
+
+    @staticmethod
+    def _both(pods, nodes, assigned=(), services=(), groups=()):
+        from kubernetes_tpu.scheduler.batch import (
+            schedule_backlog_gang_scalar,
+            schedule_backlog_gang_tpu,
+        )
+
+        ds, acc_s, rej_s = schedule_backlog_gang_scalar(
+            pods, nodes, assigned, services, groups=groups
+        )
+        dt, acc_t, rej_t = schedule_backlog_gang_tpu(
+            pods, nodes, assigned, services, groups=groups
+        )
+        assert {g.key for g in acc_s} == {g.key for g in acc_t}
+        assert {g.key for g in rej_s} == {g.key for g in rej_t}
+        parity, mismatches = parity_report(ds, dt)
+        assert parity == 1.0, f"mismatches at {mismatches[:10]}"
+        return ds, acc_s, rej_s
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_cluster_with_gangs(self, seed):
+        pods, nodes, assigned, services = random_cluster(seed)
+        groups = attach_gangs(pods, random.Random(seed + 1000))
+        self._both(pods, nodes, assigned, services, groups)
+
+    def test_rejected_gang_zeroes_all_members(self):
+        from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+        from kubernetes_tpu.scheduler.gang import partition_backlog
+
+        pods = [mk_pod(f"p{i}", cpu=600) for i in range(3)]
+        for p in pods:
+            p.metadata.labels[POD_GROUP_LABEL] = "g0"
+        nodes = [mk_node("n0", cpu=1000)]  # fits 1 of 3; minMember 3
+        groups = partition_backlog(pods, min_member_of=lambda ns, n: 3)
+        ds, accepted, rejected = self._both(pods, nodes, groups=groups)
+        assert ds == [None, None, None]
+        assert [g.key for g in rejected] == ["default/g0"]
+
+
 class TestSpreadingParityRegressions:
     """Review findings: overlapping service selectors and terminal-phase
     pods must not diverge from the scalar oracle."""
