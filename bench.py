@@ -1093,6 +1093,19 @@ def main() -> None:
         )
         # Sinkhorn's winning regime (VERDICT r4 #9).
         record.update(_hotspot_figure())
+    # Static-analysis counters: per-rule ktlint findings ride the bench
+    # record so dashboards can chart lint debt over time alongside the
+    # perf series (same JSON pipeline).
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools import ktlint as _ktlint
+
+        _rep = _ktlint.lint()
+        record["ktlint_findings_per_rule"] = _rep.counts()
+        record["ktlint_suppressed"] = len(_rep.suppressed)
+        record["ktlint_baselined"] = len(_rep.baselined)
+    except Exception as e:
+        record["ktlint_error"] = str(e)  # lint must never sink a bench run
     print(json.dumps(record))
     print(
         f"# fast wall best {best_fast:.3f}s ({fast_mode}, gate "

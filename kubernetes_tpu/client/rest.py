@@ -487,7 +487,12 @@ class HTTPTransport(Transport):
         )
         if query:
             path += "?" + query
-        conn = self._connect()
+        # Bound the dial + response-header phase (a wedged apiserver
+        # must not hang the caller forever), then clear the socket
+        # timeout once the stream is established: watch connections
+        # are LONG-lived and legitimately silent for minutes, and a
+        # read timeout mid-readline would tear down every idle watch.
+        conn = self._connect(timeout=self.timeout)
         conn.request("GET", path, headers=self.headers)
         resp = conn.getresponse()
         if resp.status >= 400:
@@ -498,6 +503,8 @@ class HTTPTransport(Transport):
                 data.get("reason", "Unknown"),
                 data.get("message", f"HTTP {resp.status}"),
             )
+        if conn.sock is not None:
+            conn.sock.settimeout(None)
         return _HTTPWatchStream(conn, resp)
 
 

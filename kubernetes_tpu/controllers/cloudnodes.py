@@ -10,6 +10,7 @@ slice shows up as nodes joining/leaving the cluster.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
@@ -23,6 +24,8 @@ LABEL_INSTANCE_TYPE = "node.kubernetes-tpu.io/instance-type"
 LABEL_ZONE = "failure-domain.kubernetes-tpu.io/zone"
 LABEL_REGION = "failure-domain.kubernetes-tpu.io/region"
 LABEL_MANAGED = "node.kubernetes-tpu.io/managed-by"
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.cloudnodes")
 
 _SYNCS = metrics.DEFAULT.counter(
     "cloud_node_syncs_total", "cloud node sync actions", ("action",)
@@ -63,6 +66,7 @@ class CloudNodeController:
             try:
                 self.sync_once()
             except Exception:
+                _LOG.exception("cloud-node sync pass failed")
                 _SYNCS.inc(action="error")
             self._stop.wait(self.sync_period)
 

@@ -7,6 +7,7 @@ IPs, and write an Endpoints object mirroring the service's ports.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List, Optional
 
@@ -22,6 +23,8 @@ from kubernetes_tpu.models.objects import (
     Service,
 )
 from kubernetes_tpu.server.api import APIError
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.endpoints")
 
 
 def _decode_pod(wire: dict) -> Pod:
@@ -84,7 +87,7 @@ class EndpointsController:
             try:
                 self.sync_all()
             except Exception:
-                pass
+                _LOG.exception("endpoints sync pass failed")
 
     def sync_all(self) -> None:
         services = self.services.store.list()
@@ -92,7 +95,10 @@ class EndpointsController:
             try:
                 self.sync_service(svc)
             except Exception:
-                pass
+                _LOG.exception(
+                    "endpoints sync for service %s/%s failed",
+                    svc.metadata.namespace, svc.metadata.name,
+                )
         self._gc_orphans(services)
 
     def _gc_orphans(self, services: List[Service]) -> None:

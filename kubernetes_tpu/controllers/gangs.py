@@ -23,6 +23,7 @@ Per group, each pass:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from datetime import datetime, timezone
@@ -31,6 +32,8 @@ from typing import Optional
 from kubernetes_tpu.models.objects import POD_GROUP_LABEL
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.gangs")
 
 _SYNCS = metrics.DEFAULT.counter(
     "gang_controller_syncs_total", "PodGroup sync passes", ("result",)
@@ -82,6 +85,7 @@ class GangController:
                 self.sync_once()
                 _SYNCS.inc(result="ok")
             except Exception:
+                _LOG.exception("gang controller sync pass failed")
                 _SYNCS.inc(result="error")
             self._stop.wait(self.sync_period)
 
@@ -204,5 +208,5 @@ class GangController:
                 source="gang-controller",
                 namespace=pg.metadata.namespace or "default",
             )
-        except Exception:
+        except Exception:  # ktlint: disable=KT003
             pass  # events are observability, never control flow

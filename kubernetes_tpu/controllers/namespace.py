@@ -9,6 +9,7 @@ now-finalizer-free namespace for real.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List, Optional
 
@@ -31,6 +32,8 @@ _NAMESPACED_RESOURCES = [
     "podtemplates",
     "events",
 ]
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.namespace")
 
 _SYNCS = metrics.DEFAULT.counter(
     "namespace_controller_syncs_total", "namespace sync passes", ("result",)
@@ -59,6 +62,7 @@ class NamespaceManager:
             try:
                 self.sync_once()
             except Exception:
+                _LOG.exception("namespace lifecycle sync pass failed")
                 _SYNCS.inc(result="error")
             self._stop.wait(self.sync_period)
 

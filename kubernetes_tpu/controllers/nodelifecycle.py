@@ -9,6 +9,7 @@ elsewhere. Eviction is rate-limited.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, Optional
@@ -18,6 +19,8 @@ from kubernetes_tpu.models import serde
 from kubernetes_tpu.models.objects import Node, Pod, now_iso
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils.ratelimit import TokenBucket
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.nodelifecycle")
 
 
 def _decode_node(wire: dict) -> Node:
@@ -81,7 +84,7 @@ class NodeLifecycleController:
             try:
                 self.monitor()
             except Exception:
-                pass
+                _LOG.exception("node lifecycle monitor pass failed")
 
     # -- monitoring ---------------------------------------------------
 

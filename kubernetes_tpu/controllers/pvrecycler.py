@@ -20,6 +20,7 @@ reference's error path, instead of silently re-pooling dirty storage.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import threading
@@ -27,6 +28,8 @@ from typing import Callable, Optional
 
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.pvrecycler")
 
 _RECYCLES = metrics.DEFAULT.counter(
     "pv_recycler_total", "PV recycler outcomes", ("result",)
@@ -79,6 +82,7 @@ class PersistentVolumeRecycler:
             try:
                 self.sync_once()
             except Exception:
+                _LOG.exception("volume recycle pass failed")
                 _RECYCLES.inc(result="error")
             self._stop.wait(self.sync_period)
 

@@ -8,6 +8,7 @@ wait to observe N adds before diffing again.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -18,6 +19,8 @@ from kubernetes_tpu.models import serde
 from kubernetes_tpu.models.objects import Pod, ReplicationController
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.replication")
 
 _SYNCS = metrics.DEFAULT.counter(
     "replication_controller_syncs_total", "RC sync passes", ("result",)
@@ -163,7 +166,7 @@ class ReplicationManager:
             try:
                 self.sync_all()
             except Exception:
-                pass
+                _LOG.exception("replication sync pass failed")
 
     # -- reconciliation ----------------------------------------------
 
@@ -205,6 +208,10 @@ class ReplicationManager:
             try:
                 self.sync_rc(rc, matched)
             except Exception:
+                _LOG.exception(
+                    "sync of replicationcontroller %s/%s failed",
+                    rc.metadata.namespace, rc.metadata.name,
+                )
                 _SYNCS.inc(result="error")
 
     def _matching_pods(self, rc: ReplicationController) -> List[Pod]:

@@ -9,6 +9,7 @@ writes, controller restarts).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, Optional
 
@@ -16,6 +17,8 @@ from kubernetes_tpu.models.quantity import Quantity
 from kubernetes_tpu.server.admission import COUNTED_RESOURCES
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.resourcequota")
 
 _SYNCS = metrics.DEFAULT.counter(
     "resource_quota_controller_syncs_total", "quota sync passes", ("result",)
@@ -45,6 +48,7 @@ class ResourceQuotaManager:
                 self.sync_once()
                 _SYNCS.inc(result="ok")
             except Exception:
+                _LOG.exception("resourcequota sync pass failed")
                 _SYNCS.inc(result="error")
             self._stop.wait(self.sync_period)
 

@@ -9,6 +9,7 @@ provider's base connectivity is the ICI ring discovered from the fabric
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
@@ -16,6 +17,8 @@ from kubernetes_tpu.client.cache import Informer
 from kubernetes_tpu.models import serde
 from kubernetes_tpu.models.objects import Node
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.routes")
 
 _SYNCS = metrics.DEFAULT.counter(
     "route_syncs_total", "route sync outcomes", ("action",)
@@ -71,6 +74,7 @@ class RouteController:
                 _SYNCS.inc(action="ok")
             except Exception:
                 # Crash containment, but visibly (cloudnodes pattern).
+                _LOG.exception("route sync pass failed")
                 _SYNCS.inc(action="error")
 
     def sync(self) -> None:

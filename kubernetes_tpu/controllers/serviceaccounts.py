@@ -13,6 +13,7 @@ signs RS256; see auth.py module docstring for the deviation note).
 from __future__ import annotations
 
 import base64
+import logging
 import threading
 from typing import Optional
 
@@ -23,6 +24,8 @@ from kubernetes_tpu.utils import metrics
 
 DEFAULT_SERVICE_ACCOUNT = "default"
 SECRET_TYPE_SA_TOKEN = "kubernetes.io/service-account-token"
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.serviceaccounts")
 
 _SYNCS = metrics.DEFAULT.counter(
     "serviceaccount_controller_syncs_total", "SA sync passes", ("result",)
@@ -53,6 +56,7 @@ class ServiceAccountsController:
             try:
                 self.sync_once()
             except Exception:
+                _LOG.exception("serviceaccount sync pass failed")
                 _SYNCS.inc(result="error")
             self._stop.wait(self.sync_period)
 
@@ -117,6 +121,7 @@ class TokenController:
             try:
                 self.sync_once()
             except Exception:
+                _LOG.exception("serviceaccount token sync pass failed")
                 _SYNCS.inc(result="error")
             self._stop.wait(self.sync_period)
 

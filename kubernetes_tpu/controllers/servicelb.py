@@ -14,6 +14,7 @@ control loop is provider-agnostic through LoadBalancerStub.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional
 
@@ -22,6 +23,8 @@ from kubernetes_tpu.models import serde
 from kubernetes_tpu.models.objects import Node, Service
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.servicelb")
 
 _SYNCS = metrics.DEFAULT.counter(
     "service_lb_syncs_total", "service LB sync outcomes", ("action",)
@@ -96,6 +99,7 @@ class ServiceController:
             except Exception:
                 # Crash containment, but visibly: a permanently failing
                 # reconcile must show up in /metrics.
+                _LOG.exception("service LB sync pass failed")
                 _SYNCS.inc(action="error")
 
     def _hosts(self) -> List[str]:

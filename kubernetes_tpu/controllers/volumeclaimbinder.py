@@ -10,12 +10,15 @@ Released; Recycle returns it to Available).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
 from kubernetes_tpu.models.objects import ObjectReference
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.volumeclaimbinder")
 
 _SYNCS = metrics.DEFAULT.counter(
     "pv_claim_binder_syncs_total", "PV claim binder passes", ("result",)
@@ -49,6 +52,7 @@ class PersistentVolumeClaimBinder:
             try:
                 self.sync_once()
             except Exception:
+                _LOG.exception("claim binder sync pass failed")
                 _SYNCS.inc(result="error")
             self._stop.wait(self.sync_period)
 

@@ -15,6 +15,7 @@ Sources of truth:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import threading
@@ -36,6 +37,8 @@ from kubernetes_tpu.models.quantity import parse_quantity
 from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import metrics, tracing
+
+_LOG = logging.getLogger("kubernetes_tpu.kubelet")
 
 # Histogram (was a summary): bucketed sync latencies aggregate across
 # every kubelet in the fleet, which a per-instance summary can't.
@@ -89,9 +92,9 @@ class _SyncPool:
         self._idle = 0
         self._stopping = False
         for _ in range(workers):
-            self._spawn(transient=False)
+            self._spawn_locked(transient=False)
 
-    def _spawn(self, transient: bool) -> None:
+    def _spawn_locked(self, transient: bool) -> None:
         # caller holds self._lock (or init, pre-concurrency)
         self._nworkers += 1
         threading.Thread(
@@ -107,7 +110,7 @@ class _SyncPool:
             if queued or key in self._running:
                 return  # will be picked up by the queued entry / re-run
             if self._idle == 0 and self._nworkers < self._max:
-                self._spawn(transient=True)
+                self._spawn_locked(transient=True)
             # Enqueue UNDER the lock: a timing-out transient worker's
             # retire path checks queue emptiness under this same lock,
             # so a key can never land unseen between its last check and
@@ -160,7 +163,8 @@ class _SyncPool:
             try:
                 self._sync(pod)
             except Exception:
-                pass  # crash containment (util.HandleCrash)
+                # Crash containment (util.HandleCrash) — with evidence.
+                _LOG.exception("pod sync for %s crashed", key)
             finally:
                 with self._lock:
                     self._running.discard(key)
@@ -410,12 +414,12 @@ class Kubelet:
         try:
             self._heartbeat()
         except Exception:
-            pass
+            _LOG.debug("node heartbeat failed; retrying", exc_info=True)
         while not self._stop.wait(self.heartbeat_period):
             try:
                 self._heartbeat()
             except Exception:
-                pass
+                _LOG.debug("node heartbeat failed; retrying", exc_info=True)
 
     def _services_changed(self, _obj) -> None:
         """Recompute the runtime's PER-NAMESPACE service env maps
@@ -465,7 +469,7 @@ class Kubelet:
                     self.image_manager.gc(in_use)
                 self._oom.prune(self.runtime.list_pods())
             except Exception:
-                pass
+                _LOG.exception("housekeeping pass failed")
 
     # -- HTTP API data (reference /spec + /stats, cadvisor-backed) ----
 
@@ -561,7 +565,7 @@ class Kubelet:
                     self._volumes_mounted.discard(uid)
                 _PODS_RUNNING.set(len(pods), node=self.node_name)
             except Exception:
-                pass
+                _LOG.exception("pod resync tick failed")
 
     def _sync_pod(self, pod: Pod) -> None:
         """One reconciliation of a single pod (kubelet.go:1092), under
@@ -607,6 +611,7 @@ class Kubelet:
             try:
                 self.volumes.mount_pod_volumes(pod)
             except Exception:
+                _LOG.exception("volume mount for pod %s failed", uid)
                 return  # retried by the resync tick
             self._volumes_mounted.add(uid)
 

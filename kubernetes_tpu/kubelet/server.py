@@ -91,8 +91,8 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         except Exception as e:  # crash containment per request
             try:
                 self._send(500, {"error": str(e)})
-            except Exception:
-                pass
+            except Exception:  # ktlint: disable=KT003
+                pass  # client already gone; the 500 has nowhere to go
 
     def _get_logs(self, ns: str, name: str, container: str, url) -> None:
         pod, uid = self._pod_and_uid(ns, name)
@@ -166,8 +166,8 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         except Exception as e:
             try:
                 self._send(500, {"error": str(e)})
-            except Exception:
-                pass
+            except Exception:  # ktlint: disable=KT003
+                pass  # client already gone; the 500 has nowhere to go
 
     def _run(self, ns: str, name: str, container: str, url) -> None:
         pod, uid = self._pod_and_uid(ns, name)

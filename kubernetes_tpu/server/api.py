@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import random
 import string
 import threading
@@ -46,6 +47,8 @@ from kubernetes_tpu.store import (
     NotFoundError,
 )
 from kubernetes_tpu.store.watch import WatchStream
+
+_LOG = logging.getLogger("kubernetes_tpu.apiserver")
 
 
 class APIError(Exception):
@@ -501,7 +504,9 @@ class APIServer:
                 )
             )
         except Exception:
-            pass
+            # Usage bookkeeping drift is better logged than hidden —
+            # the write itself already succeeded, so don't fail it.
+            _LOG.exception("post-write admission commit failed")
 
     def _validate(self, info: ResourceInfo, obj: dict) -> None:
         if info.validator is None:

@@ -384,8 +384,8 @@ class _Handler(BaseHTTPRequestHandler):
                         "code": 500,
                     },
                 )
-            except Exception:
-                pass
+            except Exception:  # ktlint: disable=KT003
+                pass  # client already gone; the 500 has nowhere to go
         finally:
             duration = time.monotonic() - start
             _REQS.inc(verb=verb, resource=resource, code=str(code))
@@ -1010,8 +1010,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(ws.encode_frame(b"", ws.OP_CLOSE))
                 else:
                     self.wfile.write(b"0\r\n\r\n")
-            except Exception:
-                pass
+            except Exception:  # ktlint: disable=KT003
+                pass  # watch client already gone mid-close
             self.close_connection = True
 
 
@@ -1480,6 +1480,8 @@ class APIHTTPServer:
                     with _socket.socket(
                         _socket.AF_INET, _socket.SOCK_DGRAM
                     ) as probe:
+                        # UDP connect only records the peer addr;
+                        # it cannot block.  # ktlint: disable=KT004
                         probe.connect(("10.255.255.255", 1))
                         host = probe.getsockname()[0]
                 except OSError:

@@ -370,7 +370,7 @@ class KVStore:
                     f.truncate(good_offset)
         return replayed
 
-    def _wal_append(self, version: int, etype: str, key: str, obj: dict) -> None:
+    def _wal_append_locked(self, version: int, etype: str, key: str, obj: dict) -> None:
         if self._wal_file is None:
             return
         rec = {"v": version, "t": etype, "k": key}
@@ -398,6 +398,18 @@ class KVStore:
         in-memory (seq stays 0)."""
         if not self._fsync or seq == 0:
             return
+        # The documented contract, now enforced: holding self._lock
+        # here would serialize every writer behind the disk flush and
+        # deadlock against _snapshot_locked's handle rotation — the
+        # group-commit amortization depends on appends proceeding WHILE
+        # the fsync runs. (RLock._is_owned is the same probe
+        # threading.Condition uses.)
+        owned = getattr(self._lock, "_is_owned", None)
+        if owned is not None and owned():
+            raise AssertionError(
+                "_wal_sync must not be called while holding self._lock "
+                "(group-commit contract; see the _wal_sync docstring)"
+            )
         with self._sync_lock:
             while True:
                 if self._synced_seq >= seq:
@@ -534,7 +546,7 @@ class KVStore:
         count. `obj` is the just-stored object (never mutated in place
         after storage); history shares the ref and replay copies it
         per delivery (watch())."""
-        self._wal_append(version, etype, key, obj)
+        self._wal_append_locked(version, etype, key, obj)
         if not self._history:
             self._oldest = version
         self._history.append((version, etype, key, obj))

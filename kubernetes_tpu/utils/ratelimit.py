@@ -22,14 +22,14 @@ class TokenBucket:
         self._last = time.monotonic()
         self._lock = threading.Lock()
 
-    def _refill(self) -> None:
+    def _refill_locked(self) -> None:
         now = time.monotonic()
         self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
         self._last = now
 
     def try_accept(self) -> bool:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             if self._tokens >= 1.0:
                 self._tokens -= 1.0
                 return True
@@ -39,7 +39,7 @@ class TokenBucket:
         """Block until a token is available (reference: RateLimiter.Accept)."""
         while True:
             with self._lock:
-                self._refill()
+                self._refill_locked()
                 if self._tokens >= 1.0:
                     self._tokens -= 1.0
                     return

@@ -260,11 +260,11 @@ def test_sync_pool_never_overlaps_one_pod():
         pool.forget("p")  # pod deleted: pending dropped, token 1 orphaned
         pool.update("p", ("p", True))  # pod recreated: token 2
         with pool._lock:
-            pool._spawn(transient=False)  # worker A: claims token 1,
+            pool._spawn_locked(transient=False)  # worker A: claims token 1,
         time.sleep(0.3)  # ...pops the pending spec, blocks in sync
         pool.update("p", ("p", False))  # key running -> pending only
         with pool._lock:
-            pool._spawn(transient=False)  # worker B: claims token 2
+            pool._spawn_locked(transient=False)  # worker B: claims token 2
         time.sleep(0.3)  # pre-fix B would now sync "p" concurrently
         release.set()
         deadline = time.monotonic() + 5

@@ -1,6 +1,7 @@
 """Prometheus text exposition format: golden output, label escaping,
 histogram bucket invariants, summary quantile rendering — plus the
-metric-name lint (tools/lint_metrics.py) over the live package."""
+metric-name lint (ktlint pass KT005; tools/lint_metrics.py is now a
+deprecation shim onto it) over the live package."""
 
 import pathlib
 import subprocess
@@ -143,15 +144,20 @@ class TestSummary:
         assert run() == run()
 
 
-def test_lint_metrics_clean():
-    """tools/lint_metrics.py over the live package: every registered
-    metric is snake_case, unit-suffixed, and on metrics.DEFAULT."""
-    root = pathlib.Path(__file__).resolve().parent.parent
-    proc = subprocess.run(
-        [sys.executable, str(root / "tools" / "lint_metrics.py"),
-         str(root / "kubernetes_tpu")],
-        capture_output=True, text=True, timeout=120,
+def _ktlint_kt005(root, target):
+    """Run the KT005 pass the way CI does (baseline-free)."""
+    return subprocess.run(
+        [sys.executable, "-m", "tools.ktlint", "--select", "KT005",
+         "--baseline=", str(target)],
+        capture_output=True, text=True, timeout=120, cwd=str(root),
     )
+
+
+def test_lint_metrics_clean():
+    """ktlint KT005 over the live package: every registered metric is
+    snake_case, unit-suffixed, and on metrics.DEFAULT."""
+    root = pathlib.Path(__file__).resolve().parent.parent
+    proc = _ktlint_kt005(root, root / "kubernetes_tpu")
     assert proc.returncode == 0, proc.stderr
 
 
@@ -166,11 +172,7 @@ def test_lint_metrics_catches_violations(tmp_path):
         'C = metrics.Summary("rogue_seconds", "x")\n'
         'D = Counter("imported_bypass_seconds", "x")\n'
     )
-    proc = subprocess.run(
-        [sys.executable, str(root / "tools" / "lint_metrics.py"),
-         str(tmp_path)],
-        capture_output=True, text=True, timeout=120,
-    )
+    proc = _ktlint_kt005(root, tmp_path)
     assert proc.returncode == 1
     assert "not snake_case" in proc.stderr
     assert "lacks a unit suffix" in proc.stderr
@@ -180,13 +182,32 @@ def test_lint_metrics_catches_violations(tmp_path):
     assert proc.stderr.count("bypasses metrics.DEFAULT") == 2
 
 
+def test_lint_metrics_shim_still_works(tmp_path):
+    """The deprecated tools/lint_metrics.py entry point execs the
+    KT005 pass with the historical output format."""
+    root = pathlib.Path(__file__).resolve().parent.parent
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("no_unit_suffix", "x")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "lint_metrics.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+    assert "1 metric lint problem(s)" in proc.stderr
+
+
 def test_lint_metrics_knows_gang_names(tmp_path):
     """The gang_* metric family (scheduler/gang.py, controllers/
     gangs.py) is known to the linter: the suffixed counters pass the
     standard rule, the unitless gang_pending_groups gauge is
     explicitly allowlisted, and a novel suffix-less gang name still
     fails (the allowlist names metrics, not a prefix)."""
-    from tools.lint_metrics import GANG_METRICS
+    from tools.ktlint.rules_metrics import GANG_METRICS
 
     assert GANG_METRICS == {
         "gang_solve_outcomes_total",
@@ -202,10 +223,7 @@ def test_lint_metrics_knows_gang_names(tmp_path):
         'B = metrics.DEFAULT.counter("gang_controller_syncs_total", "x", ("result",))\n'
         'C = metrics.DEFAULT.gauge("gang_pending_groups", "x")\n'
     )
-    proc = subprocess.run(
-        [sys.executable, str(root / "tools" / "lint_metrics.py"), str(good)],
-        capture_output=True, text=True, timeout=120,
-    )
+    proc = _ktlint_kt005(root, good)
     assert proc.returncode == 0, proc.stderr
     bad = tmp_path / "bad"
     bad.mkdir()
@@ -213,9 +231,6 @@ def test_lint_metrics_knows_gang_names(tmp_path):
         "from kubernetes_tpu.utils import metrics\n"
         'A = metrics.DEFAULT.gauge("gang_stuck", "x")\n'
     )
-    proc = subprocess.run(
-        [sys.executable, str(root / "tools" / "lint_metrics.py"), str(bad)],
-        capture_output=True, text=True, timeout=120,
-    )
+    proc = _ktlint_kt005(root, bad)
     assert proc.returncode == 1
     assert "lacks a unit suffix" in proc.stderr

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# One-command CI gate: ktlint (all passes) + the tier-1 test suite.
+#
+#   tools/check.sh            # lint + tests
+#   tools/check.sh --lint-only
+#
+# ktlint JSON lands in /tmp/ktlint.json so dashboards/bench tooling can
+# count findings per rule over time (bench.py embeds the same counts in
+# its record).
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ktlint =="
+python -m tools.ktlint --format=json kubernetes_tpu/ > /tmp/ktlint.json
+rc=$?
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/ktlint.json"))
+print(
+    f"ktlint: {len(d['findings'])} finding(s) "
+    f"({d['suppressed']} suppressed, {d['baselined']} baselined) "
+    f"{d['counts']}"
+)
+for f in d["findings"]:
+    print(f"  {f['path']}:{f['line']}: {f['rule']} {f['message']}")
+for err in d["errors"]:
+    print(f"  ERROR {err}")
+EOF
+if [ $rc -ne 0 ]; then
+    echo "ktlint FAILED (see above; pragma or --write-baseline only with a reason)"
+    exit $rc
+fi
+if [ "$1" = "--lint-only" ]; then
+    exit 0
+fi
+
+echo "== tier-1 tests =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider

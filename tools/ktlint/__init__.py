@@ -1,0 +1,74 @@
+"""ktlint — the project-native multi-pass static analyzer.
+
+Run as a CLI (``python -m tools.ktlint [--format=json] [paths]``) or
+call :func:`lint` from tests/benches. Rule IDs are stable:
+
+=======  ==============================================================
+KT001    jit purity: no host syncs / impure calls inside jax.jit
+         functions; static_argnames/donate_argnames name real params
+KT002    lock discipline: self-attributes written both inside and
+         outside ``with self._lock`` blocks
+KT003    exception hygiene: broad excepts in controllers/kubelet/server
+         must log with context or re-raise
+KT004    bounded I/O: socket/HTTP operations carry explicit timeouts
+KT005    metric naming: snake_case, unit-suffixed, via metrics.DEFAULT
+=======  ==============================================================
+
+Suppress one finding with ``# ktlint: disable=KT00N`` (on the line or
+the line above); grandfather a backlog with the baseline file
+(``python -m tools.ktlint --write-baseline``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Optional, Sequence
+
+from tools.ktlint.framework import (  # noqa: F401  (public API)
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    Baseline,
+    Finding,
+    Report,
+    Rule,
+    run,
+)
+from tools.ktlint.rules_jit import JitPurityRule
+from tools.ktlint.rules_locks import LockDisciplineRule
+from tools.ktlint.rules_except import ExceptionHygieneRule
+from tools.ktlint.rules_io import BoundedIORule
+from tools.ktlint.rules_metrics import MetricNamingRule
+
+#: Registry, in rule-id order. Adding a pass = appending here.
+ALL_RULES = (
+    JitPurityRule(),
+    LockDisciplineRule(),
+    ExceptionHygieneRule(),
+    BoundedIORule(),
+    MetricNamingRule(),
+)
+
+
+def rules_by_id(select: Optional[Sequence[str]] = None):
+    if not select:
+        return list(ALL_RULES)
+    wanted = {s.strip().upper() for s in select}
+    unknown = wanted - {r.id for r in ALL_RULES}
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    return [r for r in ALL_RULES if r.id in wanted]
+
+
+def lint(
+    paths: Sequence = (),
+    select: Optional[Sequence[str]] = None,
+    baseline_path: Optional[pathlib.Path] = DEFAULT_BASELINE,
+) -> Report:
+    """Lint `paths` (default: the kubernetes_tpu package) and return a
+    Report. The default baseline applies; pass baseline_path=None for a
+    baseline-free run (fixture tests)."""
+    paths = [pathlib.Path(p) for p in paths] or [REPO_ROOT / "kubernetes_tpu"]
+    baseline = (
+        Baseline.load(baseline_path) if baseline_path is not None else None
+    )
+    return run(paths, rules_by_id(select), baseline)

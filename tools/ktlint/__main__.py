@@ -1,0 +1,105 @@
+"""CLI: python -m tools.ktlint [options] [paths]
+
+Text output (default) is one line per finding plus a summary; --format
+json emits a machine-readable report (bench.py and dashboards count
+findings per rule over time from it). Exit 0 iff no active findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+if __package__ in (None, ""):  # `python tools/ktlint` (not -m)
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent.parent))
+
+from tools import ktlint
+from tools.ktlint.framework import Baseline, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ktlint",
+        description="project-native multi-pass static analyzer",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: kubernetes_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline", default=str(ktlint.DEFAULT_BASELINE),
+        help="baseline file ('' disables)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from current findings and exit 0",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ktlint.ALL_RULES:
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    select = [s for s in args.select.split(",") if s.strip()]
+    try:
+        rules = ktlint.rules_by_id(select)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    paths = [pathlib.Path(p) for p in args.paths] or [
+        ktlint.REPO_ROOT / "kubernetes_tpu"
+    ]
+
+    if args.write_baseline:
+        # The baseline is a whole-tree, all-rules artifact: a narrowed
+        # regeneration would silently drop every entry the narrowed run
+        # never produced (e.g. --select KT005 wiping the KT003 backlog).
+        if select or args.paths:
+            print(
+                "--write-baseline regenerates the FULL baseline; do not "
+                "combine it with --select or explicit paths",
+                file=sys.stderr,
+            )
+            return 2
+        report = run(paths, rules, baseline=None)
+        baseline = Baseline.from_findings(report.findings)
+        out = pathlib.Path(args.baseline or str(ktlint.DEFAULT_BASELINE))
+        baseline.dump(out)
+        print(
+            f"baseline: {len(report.findings)} finding(s) written to {out}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = Baseline.load(pathlib.Path(args.baseline)) if args.baseline else None
+    report = run(paths, rules, baseline)
+
+    if args.format == "json":
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in report.findings:
+            print(f.render(), file=sys.stderr)
+        for err in report.errors:
+            print(f"ERROR {err}", file=sys.stderr)
+        counts = ", ".join(
+            f"{rule}={n}" for rule, n in sorted(report.counts().items())
+        )
+        print(
+            f"ktlint: {len(report.findings)} finding(s) "
+            f"({len(report.suppressed)} suppressed, "
+            f"{len(report.baselined)} baselined) [{counts}]",
+            file=sys.stderr,
+        )
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

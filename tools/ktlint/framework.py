@@ -1,0 +1,279 @@
+"""ktlint core: file walker, rule registry, pragma suppression,
+baseline matching, reporting.
+
+The analyzer is the Python/JAX analog of the vet/race tooling the
+reference codebase leans on: each rule encodes an invariant of THIS
+codebase (jit purity, lock discipline, exception hygiene, bounded I/O,
+metric naming) as an AST pass. Rules are pure functions over a parsed
+file; the framework owns everything shared:
+
+- walking a set of paths into ``*.py`` files (repo-root-relative paths
+  in reports, so baselines survive checkouts at different prefixes);
+- pragma suppression: ``# ktlint: disable=KT001`` (comma-separate for
+  several rules, or ``disable=all``) on the offending line or the line
+  directly above it suppresses matching findings;
+- the baseline file: grandfathered findings keyed by
+  (rule, path, fingerprint-of-source-line) so line-number drift never
+  resurrects them, with per-key counts so N identical offenses on
+  distinct lines need N entries. Regenerate with ``--write-baseline``.
+
+Exit status: 0 iff no finding survives pragmas + baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import pathlib
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Repo root (ktlint lives at tools/ktlint/framework.py).
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+_PRAGMA_RE = re.compile(r"#\s*ktlint:\s*disable=([A-Za-z0-9_,\s]+?|all)\s*(?:#|$)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative when possible
+    line: int  # 1-indexed
+    message: str
+    source_line: str = ""  # stripped offending line (fingerprint input)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.rule}:{self.source_line.strip()}".encode()
+        ).hexdigest()
+        return h[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class FileContext:
+    """Everything a rule sees for one file."""
+
+    path: pathlib.Path
+    relpath: str
+    tree: ast.Module
+    lines: List[str]  # source lines, 1-indexed via lines[lineno - 1]
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        src = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        return Finding(rule, self.relpath, line, message, src)
+
+
+class Rule:
+    """One pass. Subclasses set ``id``/``title`` and implement check()."""
+
+    id: str = ""
+    title: str = ""
+
+    def applies(self, ctx: FileContext) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+# -- shared AST helpers (used by several rules) ------------------------
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """['jax', 'jit'] for ``jax.jit``; [] when the base isn't a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def str_constants(node: ast.AST) -> Optional[List[str]]:
+    """Strings out of 'x' / ('x','y') / ['x','y']; None if dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+# -- pragma + baseline -------------------------------------------------
+
+
+def pragma_map(lines: Sequence[str]) -> Dict[int, frozenset]:
+    """line number -> rules disabled by a pragma ON that line."""
+    out: Dict[int, frozenset] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+            out[i] = frozenset(names)
+    return out
+
+
+def is_suppressed(finding: Finding, pragmas: Dict[int, frozenset]) -> bool:
+    for line in (finding.line, finding.line - 1):
+        rules = pragmas.get(line)
+        if rules and (finding.rule in rules or "all" in rules):
+            return True
+    return False
+
+
+class Baseline:
+    """Grandfathered findings: {(rule, path, fingerprint): count}."""
+
+    def __init__(self, entries: Optional[Dict[Tuple[str, str, str], int]] = None):
+        self.entries = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: pathlib.Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        entries: Dict[Tuple[str, str, str], int] = {}
+        for e in data.get("entries", []):
+            key = (e["rule"], e["path"], e["fingerprint"])
+            entries[key] = entries.get(key, 0) + int(e.get("count", 1))
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+        entries: Dict[Tuple[str, str, str], int] = {}
+        for f in findings:
+            key = (f.rule, f.path, f.fingerprint)
+            entries[key] = entries.get(key, 0) + 1
+        return cls(entries)
+
+    def dump(self, path: pathlib.Path) -> None:
+        entries = [
+            {"rule": r, "path": p, "fingerprint": fp, "count": c}
+            for (r, p, fp), c in sorted(self.entries.items())
+        ]
+        path.write_text(
+            json.dumps({"entries": entries}, indent=2, sort_keys=True) + "\n"
+        )
+
+    def match(self, finding: Finding) -> bool:
+        """Consume one baseline slot for this finding if available."""
+        key = (finding.rule, finding.path, finding.fingerprint)
+        left = self.entries.get(key, 0)
+        if left > 0:
+            self.entries[key] = left - 1
+            return True
+        return False
+
+
+# -- runner ------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)  # active
+    suppressed: List[Finding] = field(default_factory=list)  # by pragma
+    baselined: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)  # unparseable files
+    rules: List[str] = field(default_factory=list)  # rule ids that ran
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.errors) else 0
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {r: 0 for r in self.rules}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "rules": self.rules,
+            "counts": self.counts(),
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "message": f.message,
+                    "fingerprint": f.fingerprint,
+                }
+                for f in self.findings
+            ],
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "errors": self.errors,
+        }
+
+
+def iter_files(paths: Sequence[pathlib.Path]) -> List[pathlib.Path]:
+    """Every .py under `paths`, each file once — overlapping arguments
+    (a dir plus a file inside it) must not lint a file twice, which
+    would burn its baseline slots on the first pass and re-report the
+    grandfathered findings as active on the second."""
+    files: List[pathlib.Path] = []
+    seen = set()
+    for p in paths:
+        cands = sorted(p.rglob("*.py")) if p.is_dir() else (
+            [p] if p.suffix == ".py" else []
+        )
+        for f in cands:
+            key = f.resolve()
+            if key not in seen:
+                seen.add(key)
+                files.append(f)
+    return files
+
+
+def relpath_of(path: pathlib.Path) -> str:
+    try:
+        return str(path.resolve().relative_to(REPO_ROOT))
+    except ValueError:
+        return str(path)
+
+
+def run(
+    paths: Sequence[pathlib.Path],
+    rules: Sequence[Rule],
+    baseline: Optional[Baseline] = None,
+) -> Report:
+    report = Report(rules=[r.id for r in rules])
+    baseline = baseline or Baseline()
+    for path in iter_files(paths):
+        try:
+            src = path.read_text()
+            tree = ast.parse(src, filename=str(path))
+        except (OSError, SyntaxError, ValueError) as e:
+            report.errors.append(f"{relpath_of(path)}: {e}")
+            continue
+        lines = src.splitlines()
+        ctx = FileContext(path, relpath_of(path), tree, lines)
+        pragmas = pragma_map(lines)
+        for rule in rules:
+            if not rule.applies(ctx):
+                continue
+            for f in rule.check(ctx):
+                if is_suppressed(f, pragmas):
+                    report.suppressed.append(f)
+                elif baseline.match(f):
+                    report.baselined.append(f)
+                else:
+                    report.findings.append(f)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

@@ -1,0 +1,86 @@
+"""KT003 — exception hygiene in daemons.
+
+A bare ``except:`` / ``except Exception:`` whose body neither logs,
+re-raises, nor reports the failure upward swallows the only evidence a
+controller/kubelet/apiserver code path is broken — the reference
+codebase's util.HandleCrash at least prints the stack. Scope is the
+long-running daemon packages (``controllers/``, ``kubelet/``,
+``server/``): crash containment there is CORRECT, silent crash
+containment is not.
+
+A handler passes if it contains any of:
+- a logging call (``*.exception/error/warning/warn/info/debug/critical/
+  log`` or ``traceback.print_exc``/``format_exc``),
+- a ``raise``,
+- a response write that forwards the error to the caller
+  (``*.send*(...)`` / returning a value derived from the exception —
+  approximated as: the handler binds the exception (``as e``) AND
+  references it).
+
+Anything else needs a ``# ktlint: disable=KT003`` pragma with a reason,
+or a baseline entry while the backlog is burned down.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+
+_SCOPE_DIRS = {"controllers", "kubelet", "server"}
+_LOG_METHODS = {
+    "exception", "error", "warning", "warn", "info", "debug", "critical",
+    "log", "print_exc", "format_exc",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name):
+        return handler.type.id in ("Exception", "BaseException")
+    return False
+
+
+def _reports(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name  # `except Exception as e` binds e
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] in _LOG_METHODS:
+                return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            if isinstance(node.ctx, ast.Load):
+                return True  # error value is used, not dropped
+    return False
+
+
+class ExceptionHygieneRule(Rule):
+    id = "KT003"
+    title = "broad except handlers in daemons must log or re-raise"
+
+    def applies(self, ctx: FileContext) -> bool:
+        return bool(_SCOPE_DIRS & set(ctx.path.parts))
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _reports(node):
+                continue
+            what = "bare except:" if node.type is None else "except Exception:"
+            out.append(
+                ctx.finding(
+                    self.id,
+                    node,
+                    f"{what} swallows the failure — log with context "
+                    "(logger.exception / traceback) or re-raise",
+                )
+            )
+        return out

@@ -1,0 +1,117 @@
+"""KT004 — bounded I/O.
+
+Every blocking network operation must carry an explicit timeout: an
+unbounded ``urlopen`` in a kubelet probe or an unbounded connect in the
+apiserver's log-relay path wedges a worker thread forever the first
+time a peer hangs (not crashes), and thread-per-connection daemons run
+out of workers long before anyone notices. Checked shapes:
+
+- ``urllib.request.urlopen(...)`` needs ``timeout=`` (or the 3rd
+  positional argument);
+- ``socket.create_connection(...)`` needs ``timeout=`` (or the 2nd
+  positional argument);
+- ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)`` need
+  ``timeout=``;
+- ``<sock>.connect(...)`` where ``<sock>`` was built by
+  ``socket.socket(...)`` in the same function and no
+  ``<sock>.settimeout(...)`` appears in that function.
+
+UDP ``connect()`` (which only sets the peer address and cannot block)
+and deliberately-unbounded streams get a ``# ktlint: disable=KT004``
+pragma at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+
+
+def _has_kw(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords) or any(
+        kw.arg is None for kw in node.keywords  # **kwargs: assume bounded
+    )
+
+
+class BoundedIORule(Rule):
+    id = "KT004"
+    title = "network operations must carry an explicit timeout"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            name = chain[-1]
+            if name == "urlopen" and "urlopen" in chain:
+                if not _has_kw(node, "timeout") and len(node.args) < 3:
+                    out.append(
+                        ctx.finding(
+                            self.id, node,
+                            "urlopen() without timeout= blocks forever on "
+                            "a hung peer",
+                        )
+                    )
+            elif name == "create_connection":
+                if not _has_kw(node, "timeout") and len(node.args) < 2:
+                    out.append(
+                        ctx.finding(
+                            self.id, node,
+                            "socket.create_connection() without timeout= "
+                            "blocks forever on a hung peer",
+                        )
+                    )
+            elif name in ("HTTPConnection", "HTTPSConnection"):
+                if not _has_kw(node, "timeout"):
+                    out.append(
+                        ctx.finding(
+                            self.id, node,
+                            f"{name}() without timeout= gives every request "
+                            "on this connection an unbounded wait",
+                        )
+                    )
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_raw_sockets(ctx, node))
+        return out
+
+    def _check_raw_sockets(self, ctx: FileContext, fn) -> List[Finding]:
+        """Flag <name>.connect() where <name> = socket.socket(...) in
+        this function and <name>.settimeout(...) never appears."""
+        created: Set[str] = set()
+        timed: Set[str] = set()
+        connects: List[tuple] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if attr_chain(node.value.func)[-1:] == ["socket"]:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            created.add(t.id)
+            elif isinstance(node, ast.withitem) and isinstance(
+                node.context_expr, ast.Call
+            ):
+                if attr_chain(node.context_expr.func)[-1:] == ["socket"]:
+                    if isinstance(node.optional_vars, ast.Name):
+                        created.add(node.optional_vars.id)
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if len(chain) == 2 and chain[1] == "settimeout":
+                    timed.add(chain[0])
+                elif len(chain) == 2 and chain[1] == "connect":
+                    connects.append((chain[0], node))
+        out: List[Finding] = []
+        for name, node in connects:
+            if name in created and name not in timed:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{name}.connect() on a socket with no settimeout() "
+                        "blocks forever on a hung peer",
+                    )
+                )
+        return out

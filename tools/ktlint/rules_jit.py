@@ -1,0 +1,181 @@
+"""KT001 — jit purity.
+
+Inside a function compiled with ``jax.jit`` (directly or through
+``functools.partial(jax.jit, ...)``), host-side effects either crash at
+trace time, silently freeze into the compiled graph (``time.*``,
+``random.*``, ``print`` fire ONCE per compilation, not per call), or —
+worst for a scheduler hot path — force a device->host sync in the
+middle of the solve pipeline (``np.asarray``, ``.item()``,
+``float()``/``int()`` on traced arrays, ``jax.device_get``). The rule
+also cross-checks ``static_argnames``/``donate_argnames`` against the
+wrapped function's real parameter list: jit raises for unknown static
+names only at first CALL, and a typo'd donate name silently stops
+donating (an allocation regression no test asserts on).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain, str_constants
+
+#: Calls whose dotted name means a host sync / impurity inside jit.
+_HOST_CALLS = {
+    ("np", "asarray"): "forces a device->host sync inside jit",
+    ("np", "array"): "forces a device->host sync inside jit",
+    ("numpy", "asarray"): "forces a device->host sync inside jit",
+    ("numpy", "array"): "forces a device->host sync inside jit",
+    ("jax", "device_get"): "forces a device->host sync inside jit",
+}
+_HOST_MODULES = {
+    "time": "runs at TRACE time only — the compiled graph never sees it",
+    "random": "runs at TRACE time only — use jax.random with a key",
+}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _jit_decoration(dec: ast.AST) -> Optional[dict]:
+    """If `dec` is a jit decorator, return {static, donate} name lists
+    (None for 'not specified / dynamic'); else None."""
+    chain = attr_chain(dec)
+    if chain in (["jax", "jit"], ["jit"]):
+        return {"static": None, "donate": None}
+    if isinstance(dec, ast.Call):
+        fchain = attr_chain(dec.func)
+        # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
+        if fchain and fchain[-1] == "partial" and dec.args:
+            if attr_chain(dec.args[0]) in (["jax", "jit"], ["jit"]):
+                out = {"static": None, "donate": None}
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        out["static"] = str_constants(kw.value)
+                    elif kw.arg == "donate_argnames":
+                        out["donate"] = str_constants(kw.value)
+                return out
+        # jax.jit(static_argnames=...) used as a decorator factory
+        if fchain in (["jax", "jit"], ["jit"]):
+            out = {"static": None, "donate": None}
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    out["static"] = str_constants(kw.value)
+                elif kw.arg == "donate_argnames":
+                    out["donate"] = str_constants(kw.value)
+            return out
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class JitPurityRule(Rule):
+    id = "KT001"
+    title = "no host syncs or impure calls inside jax.jit functions"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            spec = None
+            for dec in node.decorator_list:
+                spec = _jit_decoration(dec)
+                if spec is not None:
+                    break
+            if spec is None:
+                continue
+            params = _param_names(node)
+            static = set(spec["static"] or ())
+            for kind in ("static", "donate"):
+                for name in spec[kind] or ():
+                    if name not in params:
+                        out.append(
+                            ctx.finding(
+                                self.id,
+                                node,
+                                f"{kind}_argnames names {name!r}, which is "
+                                f"not a parameter of {node.name}()",
+                            )
+                        )
+            out.extend(self._check_body(ctx, node, params - static))
+        return out
+
+    def _check_body(
+        self, ctx: FileContext, fn: ast.FunctionDef, traced: Set[str]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                # Method call on an expression: still catch .item().
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                ):
+                    out.append(
+                        ctx.finding(
+                            self.id, node,
+                            f".item() in jitted {fn.name}() forces a "
+                            "device->host sync",
+                        )
+                    )
+                continue
+            dotted = ".".join(chain)
+            key = tuple(chain[-2:]) if len(chain) >= 2 else None
+            if key in _HOST_CALLS:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{dotted}() in jitted {fn.name}() "
+                        f"{_HOST_CALLS[key]}",
+                    )
+                )
+            elif chain[0] in _HOST_MODULES and len(chain) > 1:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{dotted}() in jitted {fn.name}() "
+                        f"{_HOST_MODULES[chain[0]]}",
+                    )
+                )
+            elif chain == ["print"]:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"print() in jitted {fn.name}() fires once per "
+                        "TRACE, not per call — use jax.debug.print",
+                    )
+                )
+            elif chain[-1] == "item" and len(chain) >= 2:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{dotted}() in jitted {fn.name}() forces a "
+                        "device->host sync",
+                    )
+                )
+            elif (
+                len(chain) == 1
+                and chain[0] in _CAST_BUILTINS
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in traced
+            ):
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{chain[0]}({node.args[0].id}) in jitted "
+                        f"{fn.name}() concretizes a traced argument "
+                        "(host sync / TracerError)",
+                    )
+                )
+        return out

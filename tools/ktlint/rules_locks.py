@@ -1,0 +1,149 @@
+"""KT002 — lock discipline.
+
+For every class that constructs a ``threading.Lock``/``RLock``/
+``Condition`` and stashes it on ``self``, any OTHER self-attribute that
+is rebound both inside a ``with self.<lock>:`` block and outside one
+(in some other method) is a candidate data race: one writer thinks the
+attribute is lock-protected, the other doesn't.
+
+Scope decisions that keep the pass honest rather than noisy:
+
+- Only direct rebinds (``self.x = ...``, ``self.x += ...``) count.
+  Container mutation (``self.d[k] = v``, ``self.s.add(...)``) is out of
+  scope — tracking it without aliasing analysis drowns real findings.
+- ``__init__`` writes never count (construction is single-threaded by
+  convention here; every daemon finishes wiring before start()).
+- Methods whose name ends in ``_locked`` are treated as executing under
+  the lock — that suffix is this codebase's documented caller-holds-
+  the-lock contract (kvstore._expire_locked, _snapshot_locked, ...).
+
+A flagged attribute means: either take the lock at the bare write
+site, or pragma it with a comment explaining why the race is benign.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if isinstance(node, ast.IfExp):
+        return _is_lock_ctor(node.body) or _is_lock_ctor(node.orelse)
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        return bool(chain) and chain[-1] in _LOCK_FACTORIES
+    return False
+
+
+def _self_attr_target(node: ast.AST) -> str:
+    """'x' for a `self.x` store target, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _with_locks(stmt: ast.With, lock_attrs: Set[str]) -> Set[str]:
+    """Lock attrs entered by this with-statement's items."""
+    held = set()
+    for item in stmt.items:
+        name = _self_attr_target(item.context_expr)
+        if name in lock_attrs:
+            held.add(name)
+    return held
+
+
+class LockDisciplineRule(Rule):
+    id = "KT002"
+    title = "self-attributes written both inside and outside lock blocks"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> List[Finding]:
+        lock_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    name = _self_attr_target(t)
+                    if name:
+                        lock_attrs.add(name)
+        if not lock_attrs:
+            return []
+        # attr -> {"locked": [(method, line)], "bare": [(method, line)]}
+        writes: Dict[str, Dict[str, List[Tuple[str, int]]]] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            base_held = item.name.endswith("_locked")
+            self._walk(item.body, item.name, base_held, lock_attrs, writes)
+        out: List[Finding] = []
+        for attr in sorted(writes):
+            w = writes[attr]
+            if w["locked"] and w["bare"]:
+                locked_in = sorted({m for m, _ in w["locked"]})
+                for method, line in sorted(set(w["bare"]), key=lambda x: x[1]):
+                    out.append(
+                        ctx.finding(
+                            self.id,
+                            line,
+                            f"{cls.name}.{attr} is written without the lock "
+                            f"in {method}() but under it in "
+                            f"{', '.join(locked_in)}() — take the lock or "
+                            "pragma with a reason",
+                        )
+                    )
+        return out
+
+    def _walk(self, stmts, method: str, held: bool, lock_attrs, writes) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                now_held = held or bool(_with_locks(stmt, lock_attrs))
+                self._walk(stmt.body, method, now_held, lock_attrs, writes)
+                continue
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                for leaf in self._flatten(t):
+                    attr = _self_attr_target(leaf)
+                    if attr and attr not in lock_attrs:
+                        bucket = writes.setdefault(
+                            attr, {"locked": [], "bare": []}
+                        )
+                        bucket["locked" if held else "bare"].append(
+                            (method, stmt.lineno)
+                        )
+            # Recurse into nested blocks (loops, ifs, try, nested defs —
+            # a closure defined in a method runs on the same threads).
+            for name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, name, None)
+                if isinstance(sub, list):
+                    self._walk(sub, method, held, lock_attrs, writes)
+            for h in getattr(stmt, "handlers", ()):
+                self._walk(h.body, method, held, lock_attrs, writes)
+
+    @staticmethod
+    def _flatten(target: ast.AST) -> List[ast.AST]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = []
+            for elt in target.elts:
+                out.extend(LockDisciplineRule._flatten(elt))
+            return out
+        return [target]

@@ -1,0 +1,135 @@
+"""KT005 — metric naming and registration (promtool-check analog).
+
+Absorbed from the PR-1 standalone ``tools/lint_metrics.py`` (which now
+shims onto this pass). Enforces, for every metric registration:
+
+1. names are snake_case (``^[a-z][a-z0-9_]*$``);
+2. names carry a unit/kind suffix — one of ``_seconds``, ``_bytes``,
+   ``_total``, ``_ratio``, ``_info`` — so a scrape reader never has to
+   guess units (``_count``/``_sum``/``_bucket`` are reserved for
+   histogram/summary child series; a small reference-parity allowlist
+   is grandfathered);
+3. metrics are registered through ``metrics.DEFAULT`` (the registry the
+   /metrics endpoints render); a bare ``metrics.Counter(...)`` outside
+   utils/metrics.py would silently never be scraped;
+4. names are string literals (a dynamic name defeats static lint and
+   risks unbounded metric families).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# NOTE: "_count" is deliberately NOT a valid suffix — promtool reserves
+# _count/_sum/_bucket for histogram/summary child series.
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_info")
+FACTORY_METHODS = {"counter", "gauge", "summary", "histogram"}
+METRIC_CLASSES = {"Counter", "Gauge", "Summary", "Histogram"}
+
+#: Reference-parity names grandfathered in (they match the reference
+#: codebase's own metrics packages verbatim, and dashboards key on
+#: them); everything new must carry a unit suffix.
+ALLOWLIST = {
+    "apiserver_request_count",  # pkg/apiserver/metrics.go
+    "kubelet_running_pods",  # pkg/kubelet/metrics/metrics.go
+}
+
+#: Gang-scheduling metric family (scheduler/gang.py +
+#: controllers/gangs.py). gang_solve_outcomes_total and
+#: gang_controller_syncs_total satisfy the suffix rule on their own;
+#: gang_pending_groups is a unitless snapshot gauge (a count of
+#: objects, like kubelet_running_pods) and is allowlisted explicitly so
+#: the linter documents — rather than silently tolerates — the family.
+GANG_METRICS = {
+    "gang_solve_outcomes_total",
+    "gang_controller_syncs_total",
+    "gang_pending_groups",
+}
+ALLOWLIST |= GANG_METRICS
+
+
+class MetricNamingRule(Rule):
+    id = "KT005"
+    title = "metric names are snake_case, unit-suffixed, on metrics.DEFAULT"
+
+    def applies(self, ctx: FileContext) -> bool:
+        # The metric classes themselves live in utils/metrics.py.
+        return not (
+            ctx.path.name == "metrics.py" and ctx.path.parent.name == "utils"
+        )
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        problems: List[Finding] = []
+        # Names bound by `from ...metrics import Counter` (possibly
+        # aliased) — a bare `Counter(...)` call through such an import
+        # is the same registry bypass as `metrics.Counter(...)`.
+        imported_classes = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "metrics" or node.module.endswith(".metrics")
+            ):
+                for alias in node.names:
+                    if alias.name in METRIC_CLASSES:
+                        imported_classes.add(alias.asname or alias.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            via_registry = (
+                len(chain) >= 2
+                and chain[-2] == "DEFAULT"
+                and chain[-1] in FACTORY_METHODS
+            )
+            direct_class = (
+                chain
+                and chain[-1] in METRIC_CLASSES
+                and "metrics" in chain[:-1]
+            ) or (len(chain) == 1 and chain[0] in imported_classes)
+            if not (via_registry or direct_class):
+                continue
+            if direct_class:
+                problems.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"metrics.{chain[-1]}(...) bypasses metrics.DEFAULT "
+                        "— unregistered metrics never reach /metrics",
+                    )
+                )
+                continue
+            if not node.args:
+                problems.append(
+                    ctx.finding(
+                        self.id, node, "metric registration without a name"
+                    )
+                )
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                problems.append(
+                    ctx.finding(
+                        self.id, node, "metric name must be a string literal"
+                    )
+                )
+                continue
+            name = arg.value
+            if not NAME_RE.match(name):
+                problems.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"metric name {name!r} is not snake_case",
+                    )
+                )
+            if name not in ALLOWLIST and not name.endswith(UNIT_SUFFIXES):
+                problems.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"metric name {name!r} lacks a unit suffix "
+                        f"({'/'.join(UNIT_SUFFIXES)})",
+                    )
+                )
+        return problems

@@ -1,152 +1,58 @@
 #!/usr/bin/env python3
-"""Metric-name linter (promtool-check analog, run in tier-1 CI).
+"""DEPRECATED shim — the metric-name linter is now ktlint rule KT005.
 
-Walks the package source for metric registrations and enforces:
-
-1. names are snake_case (``^[a-z][a-z0-9_]*$``);
-2. names carry a unit/kind suffix — one of ``_seconds``, ``_bytes``,
-   ``_total``, ``_ratio``, ``_info`` — so a scrape reader never has to
-   guess units (the Prometheus naming convention; ``_count``/``_sum``/
-   ``_bucket`` are reserved for histogram/summary child series, and a
-   small reference-parity allowlist is grandfathered);
-3. metrics are registered through ``metrics.DEFAULT`` (the registry the
-   /metrics endpoints render); a bare ``metrics.Counter(...)`` outside
-   utils/metrics.py would silently never be scraped;
-4. names are string literals (a dynamic name defeats static lint and
-   risks unbounded metric families).
-
-Usage: python tools/lint_metrics.py [root]  (default: kubernetes_tpu/)
-Exits nonzero with one line per violation.
+Run ``python -m tools.ktlint --select KT005 [paths]`` instead; this
+entry point execs that pass with the historical output format (one
+``path:line: message`` per violation, a count summary, exit 1 on any
+finding) so existing CI invocations and scripts keep working. The rule
+constants (``ALLOWLIST``, ``GANG_METRICS``, ...) are re-exported from
+the pass for the same reason.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 from typing import List, Tuple
 
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-# NOTE: "_count" is deliberately NOT a valid suffix — promtool reserves
-# _count/_sum/_bucket for histogram/summary child series.
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_info")
-FACTORY_METHODS = {"counter", "gauge", "summary", "histogram"}
-METRIC_CLASSES = {"Counter", "Gauge", "Summary", "Histogram"}
+# Script invocation (`python tools/lint_metrics.py`) puts tools/ on
+# sys.path, not the repo root — fix that before the package import.
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
 
-#: Reference-parity names grandfathered in (they match the reference
-#: codebase's own metrics packages verbatim, and dashboards key on
-#: them); everything new must carry a unit suffix.
-ALLOWLIST = {
-    "apiserver_request_count",  # pkg/apiserver/metrics.go
-    "kubelet_running_pods",  # pkg/kubelet/metrics/metrics.go
-}
-
-#: Gang-scheduling metric family (scheduler/gang.py +
-#: controllers/gangs.py). gang_solve_outcomes_total and
-#: gang_controller_syncs_total satisfy the suffix rule on their own;
-#: gang_pending_groups is a unitless snapshot gauge (a count of
-#: objects, like kubelet_running_pods) and is allowlisted explicitly so
-#: the linter documents — rather than silently tolerates — the family.
-GANG_METRICS = {
-    "gang_solve_outcomes_total",
-    "gang_controller_syncs_total",
-    "gang_pending_groups",
-}
-ALLOWLIST |= GANG_METRICS
-
-
-def _attr_chain(node: ast.AST) -> List[str]:
-    """['metrics', 'DEFAULT', 'counter'] for metrics.DEFAULT.counter."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    return list(reversed(parts))
+from tools.ktlint.framework import run as _run  # noqa: E402
+from tools.ktlint.rules_metrics import (  # noqa: E402,F401  (re-exports)
+    ALLOWLIST,
+    FACTORY_METHODS,
+    GANG_METRICS,
+    METRIC_CLASSES,
+    NAME_RE,
+    UNIT_SUFFIXES,
+    MetricNamingRule,
+)
 
 
 def lint_file(path: pathlib.Path) -> List[Tuple[int, str]]:
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    problems: List[Tuple[int, str]] = []
-    # Names bound by `from ...metrics import Counter` (possibly
-    # aliased) — a bare `Counter(...)` call through such an import is
-    # the same registry bypass as `metrics.Counter(...)`.
-    imported_classes = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module and (
-            node.module == "metrics" or node.module.endswith(".metrics")
-        ):
-            for alias in node.names:
-                if alias.name in METRIC_CLASSES:
-                    imported_classes.add(alias.asname or alias.name)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        chain = _attr_chain(node.func)
-        via_registry = (
-            len(chain) >= 2
-            and chain[-2] == "DEFAULT"
-            and chain[-1] in FACTORY_METHODS
-        )
-        direct_class = (
-            chain
-            and chain[-1] in METRIC_CLASSES
-            and "metrics" in chain[:-1]
-        ) or (len(chain) == 1 and chain[0] in imported_classes)
-        if not (via_registry or direct_class):
-            continue
-        if direct_class:
-            problems.append(
-                (
-                    node.lineno,
-                    f"metrics.{chain[-1]}(...) bypasses metrics.DEFAULT — "
-                    "unregistered metrics never reach /metrics",
-                )
-            )
-            continue
-        if not node.args:
-            problems.append((node.lineno, "metric registration without a name"))
-            continue
-        arg = node.args[0]
-        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
-            problems.append(
-                (node.lineno, "metric name must be a string literal")
-            )
-            continue
-        name = arg.value
-        if not NAME_RE.match(name):
-            problems.append(
-                (node.lineno, f"metric name {name!r} is not snake_case")
-            )
-        if name not in ALLOWLIST and not name.endswith(UNIT_SUFFIXES):
-            problems.append(
-                (
-                    node.lineno,
-                    f"metric name {name!r} lacks a unit suffix "
-                    f"({'/'.join(UNIT_SUFFIXES)})",
-                )
-            )
-    return problems
+    """Back-compat: (lineno, message) per violation in one file."""
+    report = _run([pathlib.Path(path)], [MetricNamingRule()], baseline=None)
+    out = [(f.line, f.message) for f in report.findings]
+    out.extend(
+        (0, err.split(": ", 1)[-1]) for err in report.errors
+    )
+    return out
 
 
 def lint_tree(root: pathlib.Path) -> List[str]:
-    out: List[str] = []
-    for path in sorted(root.rglob("*.py")):
-        if path.name == "metrics.py" and path.parent.name == "utils":
-            continue  # the metric classes themselves live here
-        for lineno, msg in lint_file(path):
-            out.append(f"{path}:{lineno}: {msg}")
+    report = _run([pathlib.Path(root)], [MetricNamingRule()], baseline=None)
+    out = [f"{f.path}:{f.line}: {f.message}" for f in report.findings]
+    out.extend(f"{err}" for err in report.errors)
     return out
 
 
 def main(argv: List[str]) -> int:
     root = pathlib.Path(argv[1]) if len(argv) > 1 else (
-        pathlib.Path(__file__).resolve().parent.parent / "kubernetes_tpu"
+        _REPO_ROOT / "kubernetes_tpu"
     )
     problems = lint_tree(root)
     for p in problems:
