@@ -1093,6 +1093,19 @@ def main() -> None:
         )
         # Sinkhorn's winning regime (VERDICT r4 #9).
         record.update(_hotspot_figure())
+    # Preemption counters ride the record alongside the per-phase
+    # latency fields (phase_p50_s/phase_p99_s already carry the
+    # "preempt" phase when it ran): solve outcomes by kind + victims
+    # evicted, read from the scheduler's own process-global series.
+    from kubernetes_tpu.scheduler import daemon as _sched_daemon
+
+    record["preemption"] = {
+        "victims_total": _sched_daemon._PREEMPT_VICTIMS.value(),
+        "solve_outcomes": {
+            outcome: _sched_daemon._PREEMPT_OUTCOMES.value(outcome=outcome)
+            for (outcome,) in _sched_daemon._PREEMPT_OUTCOMES.label_values()
+        },
+    }
     # Static-analysis counters: per-rule ktlint findings ride the bench
     # record so dashboards can chart lint debt over time alongside the
     # perf series (same JSON pipeline).

@@ -44,6 +44,8 @@ ALIASES = {
     "event": "events",
     "pg": "podgroups",
     "podgroup": "podgroups",
+    "pc": "priorityclasses",
+    "priorityclass": "priorityclasses",
 }
 
 
@@ -163,6 +165,16 @@ def _podgroup_row(o) -> List[str]:
     ]
 
 
+def _priorityclass_row(o) -> List[str]:
+    return [
+        o.metadata.name,
+        str(o.value),
+        "true" if o.global_default else "false",
+        o.preemption_policy or "PreemptLowerPriority",
+        _age(o.metadata.creation_timestamp),
+    ]
+
+
 TABLE_COLUMNS = {
     "pods": (["NAME", "READY", "STATUS", "RESTARTS", "NODE", "AGE"], _pod_row),
     "nodes": (["NAME", "STATUS", "CPU", "MEMORY", "AGE"], _node_row),
@@ -176,6 +188,10 @@ TABLE_COLUMNS = {
     "podgroups": (
         ["NAME", "MIN-MEMBER", "PHASE", "BOUND", "AGE"],
         _podgroup_row,
+    ),
+    "priorityclasses": (
+        ["NAME", "VALUE", "GLOBAL-DEFAULT", "PREEMPTION-POLICY", "AGE"],
+        _priorityclass_row,
     ),
 }
 
@@ -355,11 +371,15 @@ def cmd_apply(client: Client, args) -> int:
 
 
 def cmd_delete(client: Client, args) -> int:
+    grace = getattr(args, "grace_period", None)
     if args.filename:
         for wire in load_manifests(args.filename):
             resource = resource_for_kind(wire.get("kind", ""))
             name = wire.get("metadata", {}).get("name", "")
-            client.delete(resource, name, namespace=args.namespace)
+            client.delete(
+                resource, name, namespace=args.namespace,
+                grace_period_seconds=grace,
+            )
             print(f"{resource}/{name} deleted")
         return 0
     if args.resource and args.name and getattr(args, "selector", None):
@@ -377,7 +397,10 @@ def cmd_delete(client: Client, args) -> int:
             print(f"No resources found matching -l {args.selector}")
             return 0
         for o in objs:
-            client.delete(resource, o.metadata.name, namespace=args.namespace)
+            client.delete(
+                resource, o.metadata.name, namespace=args.namespace,
+                grace_period_seconds=grace,
+            )
             print(f"{resource}/{o.metadata.name} deleted")
         return 0
     if not args.resource or not args.name:
@@ -386,7 +409,10 @@ def cmd_delete(client: Client, args) -> int:
             "or -f FILE"
         )
     resource = resolve_resource(args.resource)
-    client.delete(resource, args.name, namespace=args.namespace)
+    client.delete(
+        resource, args.name, namespace=args.namespace,
+        grace_period_seconds=grace,
+    )
     print(f"{resource}/{args.name} deleted")
     return 0
 
@@ -1141,6 +1167,11 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("name", nargs="?")
     d.add_argument("--filename", "-f")
     d.add_argument("--selector", "-l")
+    d.add_argument(
+        "--grace-period", type=int, default=None,
+        help="seconds a bound pod stays Terminating before removal "
+        "(0 = immediate; pods only)",
+    )
     d.set_defaults(fn=cmd_delete)
 
     ds = sub.add_parser("describe", parents=[common])

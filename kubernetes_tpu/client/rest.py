@@ -408,9 +408,24 @@ class HTTPTransport(Transport):
                 body=body,
             )
         if op == "delete":
-            resource, namespace, name = args
+            resource, namespace, name = args[:3]
+            grace = args[3] if len(args) > 3 else None
             return self._do(
-                "DELETE", self._collection_path(resource, namespace) + f"/{name}"
+                "DELETE",
+                self._collection_path(resource, namespace) + f"/{name}",
+                query=(
+                    {"gracePeriodSeconds": str(int(grace))}
+                    if grace is not None
+                    else None
+                ),
+            )
+        if op == "evict_pod":
+            namespace, name = args
+            return self._do(
+                "POST",
+                self._collection_path("pods", namespace or "default")
+                + f"/{name}/eviction",
+                body=body,
             )
         if op == "patch":
             resource, namespace, name = args
@@ -574,9 +589,42 @@ class Client:
         )
         return self._typed(resource, out)
 
-    def delete(self, resource: str, name: str, namespace: str = "") -> None:
+    def delete(
+        self,
+        resource: str,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        """Delete; grace_period_seconds > 0 on a bound pod marks it
+        Terminating instead of removing it (the kubelet confirms with a
+        grace-0 delete at the stamped deadline). None/0 = immediate —
+        the pre-graceful behavior every existing caller relies on."""
         self._throttle()
-        self.t.request("DELETE", "delete", (resource, namespace, name))
+        args = (resource, namespace, name)
+        if grace_period_seconds is not None:
+            args = args + (grace_period_seconds,)
+        self.t.request("DELETE", "delete", args)
+
+    def evict(
+        self,
+        name: str,
+        namespace: str = "default",
+        grace_period_seconds: Optional[int] = None,
+    ):
+        """POST the pods/{name}/eviction subresource — graceful delete
+        with an Eviction body (the preemption path's victim exit)."""
+        self._throttle()
+        opts = {}
+        if grace_period_seconds is not None:
+            opts["gracePeriodSeconds"] = int(grace_period_seconds)
+        body = {
+            "kind": "Eviction",
+            "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": namespace},
+            "deleteOptions": opts,
+        }
+        return self.t.request("POST", "evict_pod", (namespace, name), body)
 
     def patch(
         self,

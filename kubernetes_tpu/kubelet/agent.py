@@ -231,6 +231,10 @@ class Kubelet:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._sync_pool = _SyncPool(self._sync_pod, workers=2)
+        # Terminating pods this kubelet has acknowledged (uid -> True
+        # once the Killing event went out): dedup so the grace window's
+        # repeated syncs emit one event, not one per resync tick.
+        self._terminating: Dict[str, bool] = {}
         # Last status wire-form successfully WRITTEN per pod uid (the
         # reference's status_manager.go map). Dedup must compare
         # against what we know reached the apiserver — comparing
@@ -529,10 +533,15 @@ class Kubelet:
             try:
                 self.volumes.teardown_pod_volumes(uid)
             except Exception:
-                pass
+                # Retried by the resync tick's orphan GC (the uid is no
+                # longer desired, and on-disk volume dirs re-surface it
+                # via volumes.list_pod_uids) — but a teardown that keeps
+                # failing must be visible, not silent.
+                _LOG.exception("volume teardown for pod %s failed", uid)
         self._volumes_mounted.discard(uid)
         self._probes.forget(uid + "/")
         self._last_status.pop(uid, None)
+        self._terminating.pop(uid, None)
         self._sync_pool.forget(self._key(pod))
 
     def _resync_loop(self) -> None:
@@ -561,7 +570,11 @@ class Kubelet:
                         if self.volumes is not None:
                             self.volumes.teardown_pod_volumes(uid)
                     except Exception:
-                        pass  # one bad orphan must not stall the tick
+                        # One bad orphan must not stall the tick — but
+                        # a teardown that fails every pass (wedged
+                        # mount, permission rot) needs evidence, not
+                        # silence; the next tick retries it anyway.
+                        _LOG.exception("orphan teardown for %s failed", uid)
                     self._volumes_mounted.discard(uid)
                 _PODS_RUNNING.set(len(pods), node=self.node_name)
             except Exception:
@@ -584,10 +597,67 @@ class Kubelet:
             sp.note(node=self.node_name)
             self._sync_pod_inner(pod)
 
+    @staticmethod
+    def _deletion_deadline(pod: Pod) -> Optional[float]:
+        """Epoch seconds of the graceful-delete deadline (the apiserver
+        stamps deletionTimestamp = delete time + grace)."""
+        import calendar
+
+        ts = pod.metadata.deletion_timestamp
+        if not ts:
+            return None
+        try:
+            return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+        except ValueError:
+            return 0.0  # unparseable stamp: treat as already expired
+
+    def _sync_terminating(self, pod: Pod) -> None:
+        """Graceful termination (reference: killPod with grace →
+        status-manager force delete). The pod stays Terminating —
+        containers running, capacity charged — until the stamped
+        deadline, then this kubelet kills it and confirms with a
+        grace-0 delete so watchers see exactly one DELETED."""
+        uid = pod.metadata.uid or pod.metadata.name
+        if not self._terminating.get(uid):
+            self._terminating[uid] = True
+            try:
+                self.client.record_event(
+                    pod, "Killing",
+                    f"Stopping pod {pod.metadata.name} "
+                    f"(grace {pod.metadata.deletion_grace_period_seconds or 0}s)",
+                    source=f"kubelet/{self.node_name}",
+                )
+            except Exception:
+                _LOG.exception("Killing event for %s failed to record", uid)
+        deadline = self._deletion_deadline(pod)
+        if deadline is not None and time.time() < deadline:
+            return  # grace still running; the resync tick re-checks
+        self.runtime.kill_pod(uid)
+        if self.volumes is not None:
+            try:
+                self.volumes.teardown_pod_volumes(uid)
+            except Exception:
+                _LOG.exception("volume teardown for pod %s failed", uid)
+        self._volumes_mounted.discard(uid)
+        try:
+            self.client.delete(
+                "pods", pod.metadata.name,
+                namespace=pod.metadata.namespace or "default",
+                grace_period_seconds=0,
+            )
+        except APIError as e:
+            if e.code != 404:  # already gone is success
+                _LOG.warning(
+                    "force delete of terminated pod %s failed: %s", uid, e
+                )
+
     def _sync_pod_inner(self, pod: Pod) -> None:
         import copy as _copy
 
         start = time.monotonic()
+        if pod.metadata.deletion_timestamp:
+            self._sync_terminating(pod)
+            return
         if pod.status.phase in ("Succeeded", "Failed"):
             return
         uid = pod.metadata.uid or pod.metadata.name

@@ -17,11 +17,14 @@ Reference:
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger("kubernetes_tpu.kubelet.managers")
 
 
 @dataclass
@@ -279,7 +282,12 @@ class OOMWatcher:
                 )
                 recorded += 1
             except Exception:
-                self._seen.discard(key)  # retry next sync
+                # Drop the dedup key so the next sync retries the
+                # write; a sink that keeps failing must leave a trail.
+                _LOG.exception(
+                    "OOM event for %s/%s failed to record", uid, c.name
+                )
+                self._seen.discard(key)
         return recorded
 
     def prune(self, runtime_pods: Dict) -> None:

@@ -47,6 +47,11 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     generate_name: str = ""
+    # Graceful-delete bookkeeping (reference: api.ObjectMeta — later
+    # releases): set together with deletion_timestamp when a pod is
+    # marked Terminating; the kubelet force-deletes once the stamped
+    # deadline passes.
+    deletion_grace_period_seconds: Optional[int] = None
 
 
 @dataclass
@@ -256,6 +261,14 @@ class PodSpec:
     node_name: str = ""
     host_network: bool = False
     service_account: str = ""
+    # Priority & preemption (shape follows the later reference's
+    # scheduling.k8s.io wiring): priorityClassName names a cluster
+    # PriorityClass; the Priority admission plugin resolves it into
+    # `priority` (and `preemption_policy`) and freezes all three.
+    # None = unresolved; schedulers read it through pod_priority().
+    priority_class_name: str = ""
+    priority: Optional[int] = None
+    preemption_policy: str = ""  # "" -> PreemptLowerPriority
 
 
 @dataclass
@@ -287,6 +300,10 @@ class PodStatus:
     pod_ip: str = ""
     start_time: str = ""
     container_statuses: List[ContainerStatus] = field(default_factory=list)
+    # Node the scheduler nominated this (still pending) pod onto after
+    # preempting victims there; cleared implicitly by binding. Lower-
+    # priority pods must not race the freed capacity while this is set.
+    nominated_node_name: str = ""
 
 
 @dataclass
@@ -711,6 +728,60 @@ class PodGroup:
     status: PodGroupStatus = field(default_factory=PodGroupStatus)
 
 
+# Preemption policies (reference: core.PreemptionPolicy). The empty
+# string on a pod/class means PREEMPT_LOWER_PRIORITY.
+PREEMPT_LOWER_PRIORITY = "PreemptLowerPriority"
+PREEMPT_NEVER = "Never"
+
+#: |value| ceiling for user PriorityClasses (reference:
+#: scheduling.k8s.io HighestUserDefinablePriority).
+MAX_PRIORITY = 1_000_000_000
+
+
+@dataclass
+class PriorityClass:
+    """Cluster-scoped pod importance (no analog in this reference tree;
+    shape follows scheduling.k8s.io/v1 PriorityClass). `value` is
+    copied onto pods by the Priority admission plugin; `globalDefault`
+    marks the class applied to pods naming no class at all;
+    `preemptionPolicy: Never` opts a class's pods out of preempting
+    (they still queue by priority and can themselves be preempted)."""
+
+    kind: str = "PriorityClass"
+    api_version: str = "v1"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = PREEMPT_LOWER_PRIORITY
+    description: str = ""
+
+
+def pod_priority(pod: "Pod") -> int:
+    """Resolved scheduling priority (0 = unset/best-effort)."""
+    return pod.spec.priority or 0
+
+
+def pod_full_key(pod: "Pod") -> str:
+    """Canonical 'namespace/name' pod key with the empty namespace
+    defaulted — THE format preemption decisions, nominations, and the
+    gang preemption guard compare (one definition, not three)."""
+    return f"{pod.metadata.namespace or 'default'}/{pod.metadata.name}"
+
+
+def pod_can_preempt(pod: "Pod") -> bool:
+    """Whether this pod may evict others (its own policy, not its
+    victims'). Unset policy = PreemptLowerPriority, matching the
+    reference's default."""
+    return (pod.spec.preemption_policy or PREEMPT_LOWER_PRIORITY) != PREEMPT_NEVER
+
+
+def pod_is_terminating(pod: "Pod") -> bool:
+    """Graceful delete in flight: marked with deletionTimestamp but not
+    yet removed from the store. Still occupies node capacity; no longer
+    a preemption victim candidate (its capacity is already promised)."""
+    return bool(pod.metadata.deletion_timestamp)
+
+
 @dataclass
 class ComponentCondition:
     type: str = "Healthy"
@@ -776,6 +847,7 @@ KINDS = {
     "PersistentVolumeClaim": PersistentVolumeClaim,
     "PodTemplate": PodTemplate,
     "PodGroup": PodGroup,
+    "PriorityClass": PriorityClass,
     "ComponentStatus": ComponentStatus,
     "DeleteOptions": DeleteOptions,
     "Status": Status,

@@ -81,11 +81,29 @@ def _validate_containers(containers, errs: List[str]) -> None:
 
 
 def validate_pod(pod: Pod) -> None:
+    from kubernetes_tpu.models.objects import (
+        MAX_PRIORITY,
+        PREEMPT_LOWER_PRIORITY,
+        PREEMPT_NEVER,
+    )
+
     errs: List[str] = []
     _validate_meta(pod.metadata, errs)
     _validate_containers(pod.spec.containers, errs)
     if pod.spec.restart_policy not in RESTART_POLICIES:
         errs.append(f"spec.restartPolicy: invalid {pod.spec.restart_policy!r}")
+    if pod.spec.preemption_policy not in (
+        "", PREEMPT_LOWER_PRIORITY, PREEMPT_NEVER
+    ):
+        # A typoed opt-out ("Nevr") must fail loudly, not silently
+        # leave the pod preempt-capable (pod_can_preempt treats any
+        # non-"Never" string as PreemptLowerPriority).
+        errs.append(
+            f"spec.preemptionPolicy: invalid {pod.spec.preemption_policy!r} "
+            f"(want {PREEMPT_LOWER_PRIORITY} or {PREEMPT_NEVER})"
+        )
+    if pod.spec.priority is not None and abs(pod.spec.priority) > MAX_PRIORITY:
+        errs.append(f"spec.priority: must be within ±{MAX_PRIORITY}")
     vol_names = set()
     for i, v in enumerate(pod.spec.volumes):
         if not is_dns1123_label(v.name):
@@ -239,6 +257,30 @@ def validate_pod_group(pg) -> None:
         errs.append("spec.maxMember: must cover spec.minMember")
     if pg.spec.schedule_timeout_seconds < 0:
         errs.append("spec.scheduleTimeoutSeconds: must be nonnegative")
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_priority_class(pc) -> None:
+    """PriorityClass: value within the user-definable band, policy one
+    of the two enum values (empty = PreemptLowerPriority)."""
+    from kubernetes_tpu.models.objects import (
+        MAX_PRIORITY,
+        PREEMPT_LOWER_PRIORITY,
+        PREEMPT_NEVER,
+    )
+
+    errs: List[str] = []
+    _validate_meta(pc.metadata, errs, namespace_required=False)
+    if not isinstance(pc.value, int) or isinstance(pc.value, bool):
+        errs.append("value: must be an integer")
+    elif abs(pc.value) > MAX_PRIORITY:
+        errs.append(f"value: must be within ±{MAX_PRIORITY}")
+    if pc.preemption_policy not in ("", PREEMPT_LOWER_PRIORITY, PREEMPT_NEVER):
+        errs.append(
+            f"preemptionPolicy: invalid {pc.preemption_policy!r} "
+            f"(want {PREEMPT_LOWER_PRIORITY} or {PREEMPT_NEVER})"
+        )
     if errs:
         raise ValidationError(errs)
 

@@ -18,6 +18,11 @@ jax.sharding.Mesh for multi-chip scale-out.
 
 from kubernetes_tpu.ops.matrices import DeviceSnapshot, device_snapshot
 from kubernetes_tpu.ops.pipeline import solve_backlog_pipelined
+from kubernetes_tpu.ops.preemption import (
+    PreemptionDecision,
+    build_preemption_problem,
+    solve_preemption_device,
+)
 from kubernetes_tpu.ops.solver import solve, solve_assignments, solve_with_state
 from kubernetes_tpu.ops.incremental import (
     RebuildRequired,
@@ -28,13 +33,16 @@ from kubernetes_tpu.ops.wave import solve_waves
 
 __all__ = [
     "DeviceSnapshot",
+    "PreemptionDecision",
     "RebuildRequired",
     "SessionGang",
     "SolverSession",
+    "build_preemption_problem",
     "device_snapshot",
     "solve",
     "solve_assignments",
     "solve_backlog_pipelined",
+    "solve_preemption_device",
     "solve_waves",
     "solve_with_state",
 ]

@@ -1,0 +1,364 @@
+"""Device-side preemption: minimal-victim selection as masked matrices.
+
+Victim selection is itself an assignment problem — "which minimal,
+lowest-priority set of running pods frees enough capacity on some node
+for this unschedulable pod" — and it lowers onto the same machinery as
+the batch solve: per-(pod, node) eviction-cost arrays, masked by
+`victim.priority < preemptor.priority`, reduced per node-segment.
+
+Canonical selection rule (shared verbatim by the scalar fallback in
+scheduler/batch.py — the parity yardstick):
+
+- candidate victims on a node are its live, non-terminating assigned
+  pods with strictly lower priority, ordered (priority asc, arrival
+  idx asc) — evict the least important, oldest-listed first;
+- a node's victim set is the shortest prefix of that order whose freed
+  cpu+mem (plus a pod slot) lets the preemptor fit; a node where the
+  preemptor already fits with zero evictions is NOT a preemption
+  candidate (capacity isn't its blocker, so eviction can't help);
+- among feasible nodes the winner minimizes, lexicographically,
+  (priority of the highest-priority victim, victim count, node index)
+  — disturb the least important workloads, then the fewest, then
+  deterministically.
+
+The device path stages victims/nodes as padded arrays (pow2 bucketing
+on BOTH axes, mirroring gang_member_counts — padded victims carry
+node=-1 and mask out; padded nodes are never ok) and runs ONE jitted
+kernel per preemptor: lexsort by (node, priority, idx), per-node
+prefix sums via cumsum minus segment offsets, and a masked segment_min
+over the first fitting prefix length. Preemptors are processed
+highest-priority-first on the host, each one's chosen victims leaving
+the alive mask and its own request charged against the node — so two
+preemptors in one tick never double-spend the same victim's capacity.
+
+Resource model deliberately matches what eviction can actually fix:
+cpu/mem/pod-slot capacity plus node readiness and the preemptor's
+nodeSelector. Port/volume/service conflicts are left to the real solve
+after victims exit — a nomination is a reservation, not a binding.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.models.columnar import (
+    mem_to_mib_ceil,
+    node_is_ready,
+    pod_resource_limits,
+)
+from kubernetes_tpu.models.objects import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    Node,
+    Pod,
+    pod_can_preempt,
+    pod_full_key,
+    pod_is_terminating,
+    pod_priority,
+)
+from kubernetes_tpu.ops.matrices import pow2_bucket
+
+#: Sentinel "no feasible victim prefix" for per-node k arrays.
+INFEASIBLE = np.int32(2**31 - 1)
+
+
+@dataclass
+class PreemptionDecision:
+    """One granted preemption: evict `victims` (pod keys, eviction
+    order) on `node`, then nominate `key` there."""
+
+    key: str  # preemptor pod key "ns/name"
+    node: str
+    victims: Tuple[str, ...]
+
+
+@dataclass
+class PreemptionProblem:
+    """Host-lowered cluster state for one preemption pass."""
+
+    node_names: List[str]
+    node_labels: List[Dict[str, str]]
+    node_ready: np.ndarray  # bool[N]
+    free_cpu: np.ndarray  # f64[N], +inf = unlimited
+    free_mem: np.ndarray
+    free_pods: np.ndarray
+    victim_keys: List[str]
+    v_cpu: np.ndarray  # f64[V] milli-cores
+    v_mem: np.ndarray  # f64[V] MiB
+    v_prio: np.ndarray  # i64[V]
+    v_node: np.ndarray  # i32[V]
+
+
+def _pod_request(pod: Pod) -> Tuple[float, float]:
+    cpu, mem = pod_resource_limits(pod)
+    return float(cpu), float(mem_to_mib_ceil(mem))
+
+
+def build_preemption_problem(
+    nodes: Sequence[Node], assigned: Sequence[Pod]
+) -> PreemptionProblem:
+    """Lower nodes + assigned pods into the preemption arrays. ALL
+    assigned pods charge node usage (a Terminating victim still holds
+    its capacity until it actually exits); only live, non-terminating
+    pods become victim rows."""
+    nodes = list(nodes)
+    index = {n.metadata.name: j for j, n in enumerate(nodes)}
+    N = len(nodes)
+    free_cpu = np.full(N, np.inf)
+    free_mem = np.full(N, np.inf)
+    free_pods = np.full(N, np.inf)
+    ready = np.zeros(N, bool)
+    labels: List[Dict[str, str]] = []
+    for j, node in enumerate(nodes):
+        cap = node.status.capacity or {}
+        if RESOURCE_CPU in cap and cap[RESOURCE_CPU].milli_value() > 0:
+            free_cpu[j] = cap[RESOURCE_CPU].milli_value()
+        if RESOURCE_MEMORY in cap and cap[RESOURCE_MEMORY].value() > 0:
+            free_mem[j] = cap[RESOURCE_MEMORY].value() // (1024**2)
+        if RESOURCE_PODS in cap and cap[RESOURCE_PODS].value() > 0:
+            free_pods[j] = cap[RESOURCE_PODS].value()
+        ready[j] = node_is_ready(node) and not node.spec.unschedulable
+        labels.append(node.metadata.labels or {})
+    keys: List[str] = []
+    v_cpu: List[float] = []
+    v_mem: List[float] = []
+    v_prio: List[int] = []
+    v_node: List[int] = []
+    for pod in assigned:
+        j = index.get(pod.spec.node_name, -1)
+        if j < 0:
+            continue
+        cpu, mem = _pod_request(pod)
+        free_cpu[j] -= cpu
+        free_mem[j] -= mem
+        free_pods[j] -= 1
+        if pod.status.phase in ("Succeeded", "Failed") or pod_is_terminating(pod):
+            continue  # occupies, but is not (or no longer) a candidate
+        keys.append(pod_full_key(pod))
+        v_cpu.append(cpu)
+        v_mem.append(mem)
+        v_prio.append(pod_priority(pod))
+        v_node.append(j)
+    return PreemptionProblem(
+        node_names=[n.metadata.name for n in nodes],
+        node_labels=labels,
+        node_ready=ready,
+        free_cpu=free_cpu,
+        free_mem=free_mem,
+        free_pods=free_pods,
+        victim_keys=keys,
+        v_cpu=np.asarray(v_cpu, np.float64),
+        v_mem=np.asarray(v_mem, np.float64),
+        v_prio=np.asarray(v_prio, np.int64),
+        v_node=np.asarray(v_node, np.int32),
+    )
+
+
+def _selector_ok(problem: PreemptionProblem, pod: Pod) -> np.ndarray:
+    """bool[N]: node ready AND labels satisfy the pod's nodeSelector."""
+    sel = pod.spec.node_selector or {}
+    ok = problem.node_ready.copy()
+    if sel:
+        for j, labels in enumerate(problem.node_labels):
+            if ok[j] and any(labels.get(k) != v for k, v in sel.items()):
+                ok[j] = False
+    return ok
+
+
+# -- device kernel ----------------------------------------------------
+
+
+def _victim_prefix_kernel():
+    """Build (lazily, so a CPU-only host without jax configured never
+    imports it at module load) the jitted per-preemptor kernel.
+
+    Returns per-node minimal victim counts and the priority of each
+    prefix's last (= highest-priority) victim, via one lexsort + masked
+    segment reductions over static, pow2-bucketed shapes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("num_nodes",))
+    def kernel(
+        v_cpu, v_mem, v_prio, v_node, v_alive,
+        free_cpu, free_mem, free_pods, node_ok,
+        p_cpu, p_mem, p_prio,
+        num_nodes: int,
+    ):
+        V = v_cpu.shape[0]
+        # Eligibility mask: alive, on a real node, strictly dominated.
+        mask = v_alive & (v_node >= 0) & (v_prio < p_prio)
+        # Masked-out rows sort into a trailing dummy segment.
+        seg = jnp.where(mask, v_node, num_nodes).astype(jnp.int32)
+        idx = jnp.arange(V, dtype=jnp.int32)
+        order = jnp.lexsort((idx, v_prio, seg))
+        seg_s = seg[order]
+        cpu_s = jnp.where(mask, v_cpu, 0.0)[order]
+        mem_s = jnp.where(mask, v_mem, 0.0)[order]
+        prio_s = v_prio[order]
+        one_s = mask[order].astype(jnp.float32)
+        S = num_nodes + 1
+        # Per-node prefix sums: global cumsum minus each segment's
+        # starting offset (segments are contiguous after the sort).
+        tot_cpu = jax.ops.segment_sum(cpu_s, seg_s, num_segments=S)
+        tot_mem = jax.ops.segment_sum(mem_s, seg_s, num_segments=S)
+        tot_cnt = jax.ops.segment_sum(one_s, seg_s, num_segments=S)
+        off_cpu = jnp.cumsum(tot_cpu) - tot_cpu
+        off_mem = jnp.cumsum(tot_mem) - tot_mem
+        off_cnt = jnp.cumsum(tot_cnt) - tot_cnt
+        freed_cpu = jnp.cumsum(cpu_s) - off_cpu[seg_s]
+        freed_mem = jnp.cumsum(mem_s) - off_mem[seg_s]
+        rank = jnp.cumsum(one_s) - off_cnt[seg_s]  # 1-based within node
+        on_node = seg_s < num_nodes
+        fits = (
+            on_node
+            & node_ok[jnp.clip(seg_s, 0, num_nodes - 1)]
+            & (free_cpu[jnp.clip(seg_s, 0, num_nodes - 1)] + freed_cpu >= p_cpu)
+            & (free_mem[jnp.clip(seg_s, 0, num_nodes - 1)] + freed_mem >= p_mem)
+            & (free_pods[jnp.clip(seg_s, 0, num_nodes - 1)] + rank >= 1.0)
+        )
+        big = jnp.int32(INFEASIBLE)
+        cand = jnp.where(fits, rank.astype(jnp.int32), big)
+        k_min = jax.ops.segment_min(cand, seg_s, num_segments=S)[:num_nodes]
+        # Nodes where the preemptor fits with ZERO evictions: capacity
+        # is not the blocker there — preemption cannot help.
+        fits0 = (
+            node_ok
+            & (free_cpu >= p_cpu)
+            & (free_mem >= p_mem)
+            & (free_pods >= 1.0)
+        )
+        k_min = jnp.where(fits0, big, k_min)
+        # Priority of each feasible prefix's last victim.
+        pos = jnp.clip(
+            off_cnt[jnp.arange(num_nodes)].astype(jnp.int32)
+            + jnp.minimum(k_min, jnp.int32(V)) - 1,
+            0, V - 1,
+        )
+        maxp = jnp.where(k_min < big, prio_s[pos], jnp.int32(0))
+        return k_min, maxp, order, seg_s
+
+    return kernel
+
+
+_KERNEL = None
+
+
+def candidate_prefixes_device(
+    v_cpu, v_mem, v_prio, v_node, v_alive,
+    free_cpu, free_mem, free_pods, node_ok,
+    p_cpu: float, p_mem: float, p_prio: int,
+):
+    """Stage one preemptor's problem onto the device and run the
+    prefix kernel. Both axes pad to pow2 buckets (padded victims:
+    node=-1, dead; padded nodes: never ok) so per-tick drift in either
+    count reuses the compiled executable instead of recompiling."""
+    global _KERNEL
+    import jax.numpy as jnp
+
+    if _KERNEL is None:
+        _KERNEL = _victim_prefix_kernel()
+    V = int(v_cpu.shape[0])
+    N = int(free_cpu.shape[0])
+    VP = pow2_bucket(max(V, 1), minimum=8)
+    NP = pow2_bucket(max(N, 1), minimum=8)
+    if VP != V:
+        pad = VP - V
+        v_cpu = np.pad(v_cpu, (0, pad))
+        v_mem = np.pad(v_mem, (0, pad))
+        v_prio = np.pad(v_prio, (0, pad))
+        v_node = np.pad(v_node, (0, pad), constant_values=-1)
+        v_alive = np.pad(v_alive, (0, pad))
+    if NP != N:
+        pad = NP - N
+        free_cpu = np.pad(free_cpu, (0, pad))
+        free_mem = np.pad(free_mem, (0, pad))
+        free_pods = np.pad(free_pods, (0, pad))
+        node_ok = np.pad(node_ok, (0, pad))
+    k_min, maxp, order, seg_s = _KERNEL(
+        jnp.asarray(v_cpu, jnp.float32),
+        jnp.asarray(v_mem, jnp.float32),
+        jnp.asarray(v_prio, jnp.int32),
+        jnp.asarray(v_node, jnp.int32),
+        jnp.asarray(v_alive, bool),
+        jnp.asarray(free_cpu, jnp.float32),
+        jnp.asarray(free_mem, jnp.float32),
+        jnp.asarray(free_pods, jnp.float32),
+        jnp.asarray(node_ok, bool),
+        jnp.float32(p_cpu),
+        jnp.float32(p_mem),
+        jnp.int32(p_prio),
+        num_nodes=NP,
+    )
+    return (
+        np.asarray(k_min)[:N],
+        np.asarray(maxp)[:N],
+        np.asarray(order),
+        np.asarray(seg_s),
+    )
+
+
+def solve_preemption_device(
+    problem: PreemptionProblem, preemptors: Sequence[Pod]
+) -> List[Optional[PreemptionDecision]]:
+    """Victim selection for each preemptor (device path). Preemptors
+    run highest-priority-first; each grant marks its victims dead and
+    charges the preemptor's request onto the node (net of the freed
+    capacity) so later preemptors see the post-preemption cluster.
+    Returns decisions aligned with `preemptors` (None = no feasible
+    node / pod may not preempt / dominates no victim)."""
+    out: List[Optional[PreemptionDecision]] = [None] * len(preemptors)
+    alive = np.ones(len(problem.victim_keys), bool)
+    free_cpu = problem.free_cpu.copy()
+    free_mem = problem.free_mem.copy()
+    free_pods = problem.free_pods.copy()
+    order_p = sorted(
+        range(len(preemptors)),
+        key=lambda i: (-pod_priority(preemptors[i]), i),
+    )
+    for i in order_p:
+        pod = preemptors[i]
+        prio = pod_priority(pod)
+        if prio <= 0 or not pod_can_preempt(pod):
+            continue
+        cpu, mem = _pod_request(pod)
+        node_ok = _selector_ok(problem, pod)
+        k_min, maxp, order, seg_s = candidate_prefixes_device(
+            problem.v_cpu, problem.v_mem, problem.v_prio, problem.v_node,
+            alive, free_cpu, free_mem, free_pods, node_ok,
+            cpu, mem, prio,
+        )
+        best = None
+        for j in range(len(problem.node_names)):
+            k = int(k_min[j])
+            if k >= int(INFEASIBLE):
+                continue
+            score = (int(maxp[j]), k, j)
+            if best is None or score < best[0]:
+                best = (score, j, k)
+        if best is None:
+            continue
+        _, j, k = best
+        chosen = [
+            int(order[t])
+            for t in range(len(order))
+            if int(seg_s[t]) == j
+        ][:k]
+        alive[chosen] = False
+        freed_cpu = float(problem.v_cpu[chosen].sum())
+        freed_mem = float(problem.v_mem[chosen].sum())
+        free_cpu[j] += freed_cpu - cpu
+        free_mem[j] += freed_mem - mem
+        free_pods[j] += k - 1
+        out[i] = PreemptionDecision(
+            key=pod_full_key(pod),
+            node=problem.node_names[j],
+            victims=tuple(problem.victim_keys[t] for t in chosen),
+        )
+    return out

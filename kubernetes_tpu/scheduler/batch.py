@@ -240,6 +240,130 @@ def schedule_backlog_gang_tpu(
     )
 
 
+def preempt_backlog_scalar(
+    preemptors: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+):
+    """Scalar victim selection — the preemption parity yardstick AND
+    the fallback when the device path errors. Implements the canonical
+    rule from ops/preemption.py independently (pure python, no device):
+    per node, victims are the shortest (priority asc, arrival asc)
+    prefix of strictly-dominated live pods whose freed cpu/mem/slots
+    fit the preemptor; nodes rank by (max victim priority, count, node
+    index); preemptors run highest-priority-first, each grant charging
+    the post-eviction node state seen by the next. Returns decisions
+    aligned with `preemptors` (None = no preemption granted)."""
+    from kubernetes_tpu.models.columnar import (
+        mem_to_mib_ceil,
+        node_is_ready,
+        pod_resource_limits,
+    )
+    from kubernetes_tpu.models.objects import (
+        pod_can_preempt,
+        pod_full_key,
+        pod_is_terminating,
+        pod_priority,
+    )
+    from kubernetes_tpu.ops.preemption import PreemptionDecision
+
+    INF = float("inf")
+    nodes = list(nodes)
+    index = {n.metadata.name: j for j, n in enumerate(nodes)}
+    free = []  # per node [cpu, mem, pods]
+    for node in nodes:
+        cap = node.status.capacity or {}
+        cpu = cap.get("cpu").milli_value() if cap.get("cpu") else 0
+        mem = cap.get("memory").value() // (1024**2) if cap.get("memory") else 0
+        pods = cap.get("pods").value() if cap.get("pods") else 0
+        free.append([cpu or INF, mem or INF, pods or INF])
+    victims = []  # (prio, arrival_idx, node_j, cpu, mem, key, alive)
+    for i, pod in enumerate(assigned):
+        j = index.get(pod.spec.node_name, -1)
+        if j < 0:
+            continue
+        cpu, mem = pod_resource_limits(pod)
+        cpu, mem = float(cpu), float(mem_to_mib_ceil(mem))
+        free[j][0] -= cpu
+        free[j][1] -= mem
+        free[j][2] -= 1
+        if pod.status.phase in ("Succeeded", "Failed") or pod_is_terminating(pod):
+            continue
+        victims.append(
+            [pod_priority(pod), i, j, cpu, mem, pod_full_key(pod), True]
+        )
+    out = [None] * len(preemptors)
+    for i in sorted(
+        range(len(preemptors)),
+        key=lambda t: (-pod_priority(preemptors[t]), t),
+    ):
+        pod = preemptors[i]
+        prio = pod_priority(pod)
+        if prio <= 0 or not pod_can_preempt(pod):
+            continue
+        cpu, mem = pod_resource_limits(pod)
+        cpu, mem = float(cpu), float(mem_to_mib_ceil(mem))
+        sel = pod.spec.node_selector or {}
+        best = None
+        for j, node in enumerate(nodes):
+            if not node_is_ready(node) or node.spec.unschedulable:
+                continue
+            labels = node.metadata.labels or {}
+            if any(labels.get(k) != v for k, v in sel.items()):
+                continue
+            f_cpu, f_mem, f_pods = free[j]
+            if f_cpu >= cpu and f_mem >= mem and f_pods >= 1:
+                continue  # fits without eviction: not a preemption case
+            prefix = []
+            for v in sorted(
+                (v for v in victims if v[6] and v[2] == j and v[0] < prio),
+                key=lambda v: (v[0], v[1]),
+            ):
+                prefix.append(v)
+                f_cpu += v[3]
+                f_mem += v[4]
+                f_pods += 1
+                if f_cpu >= cpu and f_mem >= mem and f_pods >= 1:
+                    score = (prefix[-1][0], len(prefix), j)
+                    if best is None or score < best[0]:
+                        best = (score, j, list(prefix))
+                    break
+        if best is None:
+            continue
+        _, j, prefix = best
+        for v in prefix:
+            v[6] = False
+            free[j][0] += v[3]
+            free[j][1] += v[4]
+            free[j][2] += 1
+        free[j][0] -= cpu
+        free[j][1] -= mem
+        free[j][2] -= 1
+        out[i] = PreemptionDecision(
+            key=pod_full_key(pod),
+            node=nodes[j].metadata.name,
+            victims=tuple(v[5] for v in prefix),
+        )
+    return out
+
+
+def preempt_backlog_tpu(
+    preemptors: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+):
+    """Device victim selection (ops/preemption.py): same decisions as
+    preempt_backlog_scalar — 100% victim-set parity is the contract
+    (tests/test_solver_parity.py)."""
+    from kubernetes_tpu.ops.preemption import (
+        build_preemption_problem,
+        solve_preemption_device,
+    )
+
+    problem = build_preemption_problem(nodes, assigned)
+    return solve_preemption_device(problem, preemptors)
+
+
 def parity_report(
     scalar: Sequence[Optional[str]], batch: Sequence[Optional[str]]
 ) -> Tuple[float, List[int]]:

@@ -54,6 +54,24 @@ _BIND_LATENCY = metrics.DEFAULT.histogram(
 _SCHEDULED = metrics.DEFAULT.counter(
     "scheduler_pods_scheduled_total", "Pods successfully bound", ("result",)
 )
+# Preemption series (ktlint KT005 PREEMPTION_METRICS family).
+_PREEMPT_VICTIMS = metrics.DEFAULT.counter(
+    "preemption_victims_total",
+    "Pods evicted to make room for higher-priority pods",
+)
+_PREEMPT_OUTCOMES = metrics.DEFAULT.counter(
+    "preemption_solve_outcomes_total",
+    "Per-preemptor preemption solve outcomes by kind",
+    ("outcome",),
+)
+_PREEMPT_NOMINATED = metrics.DEFAULT.gauge(
+    "preemption_active_nominations",
+    "Pending pods currently holding a nominated node",
+)
+
+#: Seconds past the victims' grace a nomination stays live before the
+#: preemptor is allowed to preempt again (covers kubelet confirm lag).
+NOMINATION_SLACK_SECONDS = 10.0
 
 
 def _decode_pod(wire: dict) -> Pod:
@@ -419,10 +437,26 @@ class BatchScheduler(Scheduler):
         batch_window: float = 0.02,
         mode: str = "scan",
         sidecar_path: Optional[str] = None,
+        eviction_grace_seconds: Optional[int] = None,
     ):
         super().__init__(config)
         self.max_batch = max_batch
         self.batch_window = batch_window
+        # Priority & preemption: victims terminate with this grace;
+        # nominations (pod -> node reserved while victims drain) expire
+        # shortly after it so a wedged eviction can't pin a pod forever.
+        from kubernetes_tpu.server.api import DEFAULT_EVICTION_GRACE_SECONDS
+
+        self.eviction_grace_seconds = (
+            DEFAULT_EVICTION_GRACE_SECONDS
+            if eviction_grace_seconds is None
+            else int(eviction_grace_seconds)
+        )
+        # pod key -> (node, priority, monotonic expiry). The preemptor
+        # is skipped by later preemption passes while this is live; the
+        # priority-ordered drain is what actually holds the freed slot
+        # against lower-priority placements.
+        self._nominations: Dict[str, Tuple[str, int, float]] = {}
         # "scan" = sequential-parity solver — the default AND, with the
         # pallas kernel (ops/pallas_scan.py), the fastest backlog mode
         # on a single TPU; "wave" = wave-commit solver (valid
@@ -567,7 +601,13 @@ class BatchScheduler(Scheduler):
 
     def _drain(self, timeout: Optional[float]) -> List[Pod]:
         """Pop the first pod (blocking) then everything already queued,
-        up to max_batch (amortizes solves under churn)."""
+        up to max_batch (amortizes solves under churn). The drained
+        batch solves highest-priority-first (stable within a priority
+        band, preserving arrival order) — the reference's priority
+        queue shape, and the mechanism that holds a nominated pod's
+        freed capacity against lower-priority placements: when victims
+        exit, the nominated (higher-priority) pod gets first claim in
+        the very tick the capacity appears."""
         first = self.config.pod_queue.pop(timeout=timeout)
         if first is None:
             return []
@@ -579,7 +619,155 @@ class BatchScheduler(Scheduler):
             if pod is None:
                 break
             batch.append(pod)
-        return [p for p in batch if not p.spec.node_name]
+        batch = [p for p in batch if not p.spec.node_name]
+        batch.sort(key=lambda p: -(p.spec.priority or 0))
+        return batch
+
+    # -- priority & preemption ----------------------------------------
+
+    @staticmethod
+    def _pod_key(pod: Pod) -> str:
+        from kubernetes_tpu.models.objects import pod_full_key
+
+        return pod_full_key(pod)
+
+    def _maybe_preempt(
+        self, unbound: List[Pod], nodes, assigned, groups=()
+    ) -> int:
+        """Preemption pass over the tick's unschedulable pods: solve
+        victim selection (device path, scalar fallback), enforce the
+        gang all-or-nothing guard, then nominate + gracefully evict.
+        The preemptors themselves stay in the requeue loop — they bind
+        through the ordinary solve once their victims exit. Returns
+        nominations granted."""
+        from kubernetes_tpu.models.objects import pod_can_preempt, pod_priority
+
+        now = time.monotonic()
+        for key in [
+            k for k, (_, _, exp) in self._nominations.items() if exp <= now
+        ]:
+            del self._nominations[key]
+        candidates = [
+            p for p in unbound
+            if pod_priority(p) > 0
+            and pod_can_preempt(p)
+            and self._pod_key(p) not in self._nominations
+        ]
+        _PREEMPT_NOMINATED.set(len(self._nominations))
+        if not candidates:
+            return 0
+        with tracing.phase("preempt", pods=len(candidates)):
+            return self._preempt(
+                candidates, unbound, nodes, assigned, now, groups
+            )
+
+    def _preempt(
+        self, candidates, unbound, nodes, assigned, now, groups=()
+    ) -> int:
+        from kubernetes_tpu.models.objects import pod_priority
+        from kubernetes_tpu.scheduler.batch import (
+            preempt_backlog_scalar,
+            preempt_backlog_tpu,
+        )
+        from kubernetes_tpu.scheduler.gang import drop_partial_gang_preemptions
+
+        cfg = self.config
+        try:
+            if self.policy_scalar or self.sidecar is not None:
+                # Sidecar/scalar-pinned daemons never touch the local
+                # device for the main solve; same for victim selection.
+                decisions = preempt_backlog_scalar(candidates, nodes, assigned)
+            else:
+                decisions = preempt_backlog_tpu(candidates, nodes, assigned)
+        except Exception:
+            self.fallback_count += 1
+            try:
+                decisions = preempt_backlog_scalar(candidates, nodes, assigned)
+            except Exception:
+                _LOG.exception("preemption solve failed on both paths")
+                _PREEMPT_OUTCOMES.inc(outcome="error")
+                return 0
+        covered = frozenset(self._nominations)
+        solved = list(decisions)
+        decisions, dropped = drop_partial_gang_preemptions(
+            unbound, candidates, decisions, covered_keys=covered,
+            groups=groups or (),
+        )
+        for gkey in dropped:
+            _PREEMPT_OUTCOMES.inc(outcome="gang_partial")
+            _LOG.info(
+                "preemption for pod group %s dropped: not every unbound "
+                "member could be granted a nomination", gkey,
+            )
+        granted = 0
+        for pod, dec, pre_guard in zip(candidates, decisions, solved):
+            if dec is None:
+                # Grants the gang guard nulled are accounted by their
+                # group's gang_partial above, not double-counted as
+                # per-pod infeasibility.
+                if pre_guard is None:
+                    _PREEMPT_OUTCOMES.inc(outcome="infeasible")
+                continue
+            ns = pod.metadata.namespace or "default"
+            key = self._pod_key(pod)
+            evicted = 0
+            gone = 0
+            for vkey in dec.victims:
+                vns, _, vname = vkey.partition("/")
+                try:
+                    cfg.client.evict(
+                        vname, namespace=vns,
+                        grace_period_seconds=self.eviction_grace_seconds,
+                    )
+                except APIError as e:
+                    if e.code == 404:
+                        gone += 1  # already gone: capacity freed anyway
+                        continue
+                    _LOG.warning("eviction of %s failed: %s", vkey, e)
+                    continue
+                except Exception:
+                    _LOG.exception("eviction of %s failed", vkey)
+                    continue
+                evicted += 1
+                cfg.client.record_event(
+                    {"kind": "Pod",
+                     "metadata": {"name": vname, "namespace": vns}},
+                    "Preempted",
+                    f"Preempted by {key} on node {dec.node}",
+                    source="scheduler", namespace=vns,
+                )
+            _PREEMPT_VICTIMS.inc(evicted)
+            if evicted + gone == 0:
+                # Every eviction failed transiently: no capacity was
+                # (or will be) freed, so recording a nomination would
+                # just freeze the preemptor out of re-solving for the
+                # whole grace+slack window. Retry next tick.
+                _PREEMPT_OUTCOMES.inc(outcome="evict_failed")
+                continue
+            try:
+                # Publish the reservation so operators (and HA peers)
+                # can see why the freed capacity is spoken for.
+                cfg.client.patch(
+                    "pods", pod.metadata.name,
+                    {"status": {"nominatedNodeName": dec.node}},
+                    namespace=ns,
+                )
+            except Exception:
+                _LOG.debug(
+                    "nominatedNodeName write for %s failed", key,
+                    exc_info=True,
+                )
+            _PREEMPT_OUTCOMES.inc(outcome="nominated")
+            self._nominations[key] = (
+                dec.node, pod_priority(pod),
+                now + self.eviction_grace_seconds + NOMINATION_SLACK_SECONDS,
+            )
+            # Retry promptly: the nominated pod must contest the freed
+            # capacity the tick it appears, not after a grown backoff.
+            cfg.backoff.reset(key)
+            granted += 1
+        _PREEMPT_NOMINATED.set(len(self._nominations))
+        return granted
 
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
         """One drain+solve+commit cycle; returns pods processed."""
@@ -751,6 +939,7 @@ class BatchScheduler(Scheduler):
             if res.get("status") == "Success":
                 pod.spec.node_name = dest
                 cfg.modeler.assume_pod(pod)
+                self._nominations.pop(f"{ns}/{pod.metadata.name}", None)
                 _SCHEDULED.inc(result="scheduled")
                 cfg.client.record_event(
                     pod, "Scheduled",
@@ -762,6 +951,16 @@ class BatchScheduler(Scheduler):
             else:
                 _SCHEDULED.inc(result="bind_error")
                 rejected.append(pod)
+        # Preemption: pods the solve could not place anywhere may evict
+        # lower-priority pods and hold a nomination while the victims'
+        # grace drains; they bind through the ordinary solve on retry.
+        unbound = [p for p, d in zip(pending, destinations) if d is None]
+        if unbound:
+            # Fresh occupancy view: this tick's own binds were assumed
+            # into the modeler after `assigned` was captured.
+            self._maybe_preempt(
+                unbound, nodes, cfg.pod_lister.list(), groups=groups
+            )
         self._requeue_many(rejected)
         _E2E_LATENCY.observe(time.monotonic() - start)
         return len(pending) + len(deferred)
@@ -1041,6 +1240,7 @@ class IncrementalBatchScheduler(BatchScheduler):
             if res.get("status") == "Success":
                 pod.spec.node_name = dest
                 cfg.modeler.assume_pod(pod)
+                self._nominations.pop(key, None)
                 _SCHEDULED.inc(result="scheduled")
                 cfg.client.record_event(
                     pod, "Scheduled",
@@ -1059,6 +1259,20 @@ class IncrementalBatchScheduler(BatchScheduler):
                 self._session.delete_assigned(key)
                 _SCHEDULED.inc(result="bind_error")
                 rejected.append(pod)
+        # Preemption over this tick's unplaceable pods — same pass as
+        # the parent daemon; the session is not consulted (victims are
+        # selected from the watch caches, and their exits flow back in
+        # as ordinary pod DELETED deltas).
+        unbound = [
+            by_key[key]
+            for key, dest in results
+            if dest is None and key in by_key
+        ]
+        if unbound:
+            self._maybe_preempt(
+                unbound, cfg.nodes.store.list(), cfg.pod_lister.list(),
+                groups=groups,
+            )
         self._requeue_many(rejected)
         _E2E_LATENCY.observe(time.monotonic() - start)
         return len(pending) + len(deferred)

@@ -126,6 +126,67 @@ def partition_backlog(
     return [groups[k] for k in sorted(groups)]
 
 
+def drop_partial_gang_preemptions(
+    unbound: Sequence[Pod],
+    candidates: Sequence[Pod],
+    decisions: Sequence[Optional[object]],
+    covered_keys: frozenset = frozenset(),
+    groups: Sequence[GangGroup] = (),
+) -> Tuple[List[Optional[object]], List[str]]:
+    """Gang/preemption interaction guard: a preemptor that belongs to a
+    PodGroup preempts for the WHOLE gang or not at all. Victims must
+    only be evicted when the gang can actually land afterwards, or pods
+    die to free capacity the all-or-nothing solve then refuses to use
+    and the group stays stranded half-placed. Two conditions, both
+    required:
+
+    - every unbound member visible this tick got a nomination this
+      pass (or already holds one — `covered_keys`); a member excluded
+      from `candidates` by priority/policy still vetoes;
+    - when `groups` (the tick's partitioned GangGroups, carrying
+      minMember and the already-bound credit) names the gang, the
+      granted+covered+bound count must reach minMember — members
+      sitting in backoff requeue are invisible to `unbound`, and a
+      2-of-3 grant would evict victims for a gang the solve still
+      rejects until the third member resurfaces.
+
+    `decisions` aligns with `candidates`. Returns the filtered
+    decision list and the dropped groups' keys.
+    """
+    from kubernetes_tpu.models.objects import pod_full_key
+
+    need: Dict[str, set] = {}
+    for pod in unbound:
+        name = pod_group_name(pod)
+        if name:
+            key = group_key(pod.metadata.namespace or "default", name)
+            need.setdefault(key, set()).add(pod_full_key(pod))
+    if not need:
+        return list(decisions), []
+    granted = {
+        pod_full_key(c): i
+        for i, (c, d) in enumerate(zip(candidates, decisions))
+        if d is not None
+    }
+    floor_of = {g.key: (g.min_member, g.bound) for g in groups}
+    out = list(decisions)
+    dropped: List[str] = []
+    for gkey, keys in sorted(need.items()):
+        ok_count = sum(1 for k in keys if k in granted or k in covered_keys)
+        min_member, bound = floor_of.get(gkey, (0, 0))
+        if ok_count == len(keys) and ok_count + bound >= min_member:
+            continue
+        had_any = False
+        for k in keys:
+            i = granted.get(k)
+            if i is not None:
+                out[i] = None
+                had_any = True
+        if had_any:
+            dropped.append(gkey)
+    return out, dropped
+
+
 def member_counts_host(
     placed: np.ndarray, group_ids: np.ndarray, num_groups: int
 ) -> np.ndarray:

@@ -572,6 +572,95 @@ class PodGroupAdmission(Interface):
             )
 
 
+class PriorityAdmission(Interface):
+    """Resolve and freeze pod priority (no analog in this reference
+    tree; follows the later reference's Priority admission plugin).
+
+    CREATE: a pod naming spec.priorityClassName gets spec.priority and
+    spec.preemptionPolicy copied from the class (unknown class: 404);
+    a pod naming none inherits the globalDefault class (highest value
+    wins when several are marked) or priority 0. A caller-supplied
+    spec.priority must agree with the resolved value — priority comes
+    from classes, never free-form.
+
+    UPDATE: priorityClassName/priority/preemptionPolicy are immutable
+    (a priority bump would silently re-rank a queued pod past peers
+    that were admitted under the old value); omitted fields carry over
+    from the stored pod so status-ish full updates keep passing."""
+
+    _FROZEN = ("priorityClassName", "priority", "preemptionPolicy")
+
+    def __init__(self, api):
+        self.api = api
+
+    def handles(self, operation: str) -> bool:
+        return operation in (CREATE, UPDATE)
+
+    def _default_class(self) -> Optional[dict]:
+        best = None
+        for pc in self.api.list("priorityclasses", "", copy=False)["items"]:
+            if not pc.get("globalDefault"):
+                continue
+            if best is None or int(pc.get("value", 0)) > int(best.get("value", 0)):
+                best = pc
+        return best
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.obj is None:
+            return
+        spec = attrs.obj.setdefault("spec", {})
+        if attrs.operation == UPDATE:
+            from kubernetes_tpu.server.api import APIError
+
+            try:
+                old = self.api.get("pods", attrs.namespace, attrs.name)
+            except APIError:
+                return  # racing delete: the update will 404 on its own
+            old_spec = old.get("spec", {})
+            for field_ in self._FROZEN:
+                if field_ not in spec or spec[field_] in ("", None):
+                    if field_ in old_spec:
+                        spec[field_] = old_spec[field_]
+                elif spec[field_] != old_spec.get(field_):
+                    # Compare against the STORED value (None when the
+                    # pod never had one) — defaulting to the new value
+                    # would let any update grant itself arbitrary
+                    # priority after creation.
+                    raise AdmissionError(
+                        f"spec.{field_} is immutable "
+                        f"(was {old_spec.get(field_)!r})"
+                    )
+            return
+        name = spec.get("priorityClassName", "")
+        if name:
+            from kubernetes_tpu.server.api import APIError
+
+            try:
+                pc = self.api.get("priorityclasses", "", name)
+            except APIError:
+                raise AdmissionError(
+                    f"priority class {name!r} does not exist", 404
+                )
+        else:
+            pc = self._default_class()
+        value = int(pc.get("value", 0)) if pc else 0
+        supplied = spec.get("priority")
+        if supplied is not None and int(supplied) != value:
+            raise AdmissionError(
+                f"spec.priority {supplied} conflicts with priority class "
+                f"value {value}; priority is resolved from "
+                "priorityClassName, not set directly"
+            )
+        if pc:
+            spec["priorityClassName"] = pc["metadata"]["name"]
+            spec["priority"] = value
+            policy = pc.get("preemptionPolicy", "")
+            if policy:
+                spec["preemptionPolicy"] = policy
+        elif supplied is not None:
+            spec["priority"] = 0
+
+
 class SecurityContextDeny(Interface):
     """Reject pods that request privileged mode, added capabilities, or
     custom SELinux/RunAsUser options
@@ -635,5 +724,6 @@ register_plugin("LimitRanger", LimitRanger)
 register_plugin("ResourceQuota", ResourceQuotaAdmission)
 register_plugin("ServiceAccount", ServiceAccountAdmission)
 register_plugin("PodGroup", PodGroupAdmission)
+register_plugin("Priority", PriorityAdmission)
 register_plugin("SecurityContextDeny", lambda api: SecurityContextDeny())
 register_plugin("DenyExecOnPrivileged", DenyExecOnPrivileged)

@@ -50,6 +50,11 @@ from kubernetes_tpu.store.watch import WatchStream
 
 _LOG = logging.getLogger("kubernetes_tpu.apiserver")
 
+#: Default grace for the eviction subresource when the Eviction body
+#: names none (reference: 30s pod default, scaled to this codebase's
+#: test-sized clusters).
+DEFAULT_EVICTION_GRACE_SECONDS = 5
+
 
 class APIError(Exception):
     def __init__(self, code: int, reason: str, message: str):
@@ -1189,7 +1194,72 @@ class APIServer:
         except NotFoundError:
             raise _not_found(info.name, name)
 
-    def delete(self, resource: str, namespace: str, name: str) -> dict:
+    def _mark_pod_terminating(
+        self, namespace: str, name: str, grace: int
+    ) -> Optional[dict]:
+        """Graceful pod delete: instead of removing the object, stamp
+        metadata.deletionTimestamp (= now + grace, the force-delete
+        deadline) and deletionGracePeriodSeconds, so watchers see ONE
+        MODIFIED (Terminating) now and ONE DELETED when the kubelet
+        confirms termination with a grace-0 delete. A second graceful
+        DELETE can only shorten the remaining grace, never extend it
+        (reference: rest.BeforeDelete's CheckGracefulDelete shape).
+        Returns the marked pod, or None when the pod should be removed
+        immediately (unbound — no kubelet will ever confirm it)."""
+        try:
+            pod = self.store.get(RESOURCES["pods"].key(namespace, name))
+        except NotFoundError:
+            raise _not_found("pods", name)
+        if not pod.get("spec", {}).get("nodeName"):
+            return None  # pending pod: nothing to terminate gracefully
+
+        deadline = time.time() + grace
+
+        def mark(obj: dict) -> dict:
+            meta = obj.setdefault("metadata", {})
+            prev = meta.get("deletionTimestamp", "")
+            new_ts = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(deadline)
+            )
+            if not prev or new_ts < prev:
+                meta["deletionTimestamp"] = new_ts
+                meta["deletionGracePeriodSeconds"] = grace
+            return obj
+
+        try:
+            return self.store.guaranteed_update(
+                RESOURCES["pods"].key(namespace, name), mark
+            )
+        except NotFoundError:
+            raise _not_found("pods", name)
+
+    def evict_pod(self, namespace: str, name: str, body: Optional[dict]) -> dict:
+        """POST /pods/{name}/eviction — the graceful-delete subresource
+        (shape follows policy/v1 Eviction: metadata + deleteOptions).
+        The preemption path uses this so victims terminate with grace
+        instead of vanishing under their kubelet."""
+        body = body or {}
+        opts = body.get("deleteOptions") or {}
+        grace = opts.get("gracePeriodSeconds")
+        if grace is None:
+            grace = DEFAULT_EVICTION_GRACE_SECONDS
+        try:
+            grace = int(grace)
+        except (TypeError, ValueError):
+            raise _bad_request(
+                f"deleteOptions.gracePeriodSeconds: invalid {grace!r}"
+            )
+        return self.delete(
+            "pods", namespace, name, grace_period_seconds=grace
+        )
+
+    def delete(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> dict:
         info = self._info(resource)
         if info.name == "namespaces":
             marked = self._mark_namespace_terminating(name)
@@ -1197,6 +1267,21 @@ class APIServer:
                 return marked
         with self._write_guard():
             self._admit("DELETE", info, self._ns(info, namespace), name, None)
+            if (
+                info.name == "pods"
+                and grace_period_seconds is not None
+                and grace_period_seconds > 0
+            ):
+                # Bound-ness check and the immediate-delete fallback
+                # stay under ONE guard hold: a bind landing between
+                # them would otherwise hard-delete a pod the caller
+                # asked to terminate gracefully.
+                marked = self._mark_pod_terminating(
+                    self._ns(info, namespace), name, int(grace_period_seconds)
+                )
+                if marked is not None:
+                    return marked
+                # Unbound pod: nothing to terminate — delete now.
             try:
                 deleted = self.store.delete(info.key(self._ns(info, namespace), name))
             except NotFoundError:

@@ -561,6 +561,18 @@ class _Handler(BaseHTTPRequestHandler):
                 out = api.bind(ns, body)
                 self._send_json(201, out)
                 return "bindings", 201
+            if (
+                len(rest) == 5
+                and rest[4] == "eviction"
+                and resource == "pods"
+                and verb == "POST"
+            ):
+                # Eviction subresource (shape: policy/v1 Eviction) —
+                # graceful delete; the victim goes Terminating now and
+                # is removed when its kubelet confirms.
+                out = api.evict_pod(ns, name, self._read_body())
+                self._send_json(201, out)
+                return "pods/eviction", 201
             if len(rest) == 5 and rest[4] == "status" and verb == "PUT":
                 out = api.update_status(
                     resource, ns, name, self._read_body(self._kind_of(resource))
@@ -939,7 +951,19 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
             )
         elif verb == "DELETE":
-            self._send_json(200, api.delete(resource, ns, name))
+            grace = None
+            g = self.query.get("gracePeriodSeconds")
+            if g is not None:
+                try:
+                    grace = int(g)
+                except ValueError:
+                    raise APIError(
+                        400, "BadRequest",
+                        f"gracePeriodSeconds must be numeric, got {g!r}",
+                    )
+            self._send_json(
+                200, api.delete(resource, ns, name, grace_period_seconds=grace)
+            )
         else:
             raise APIError(405, "MethodNotAllowed", f"{verb} not allowed on item")
         return resource, 200
@@ -1240,6 +1264,10 @@ const RESOURCES = {
  podtemplates: {cols: ['name','containers','age'],
   row: t => [name(t), (((t.template||{}).spec||{}).containers||[])
    .map(c=>c.name).join(', '), age(t)]},
+ priorityclasses: {ns: false,
+  cols: ['name','value','global-default','preemption-policy','age'],
+  row: c => [name(c), c.value||0, String(!!c.globalDefault),
+   c.preemptionPolicy||'PreemptLowerPriority', age(c)]},
  componentstatuses: {ns: false, cols: ['name','status','message'],
   row: c => {const cond=(c.conditions||[{}])[0];
    return [name(c), pill(cond.status==='True'?'Healthy':'Unhealthy',

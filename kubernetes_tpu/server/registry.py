@@ -113,6 +113,16 @@ _register(
 )
 _register(
     ResourceInfo(
+        "priorityclasses",
+        "PriorityClass",
+        O.PriorityClass,
+        namespaced=False,
+        validator=V.validate_priority_class,
+    ),
+    "pc",
+)
+_register(
+    ResourceInfo(
         "componentstatuses", "ComponentStatus", O.ComponentStatus, namespaced=False
     ),
     "cs",

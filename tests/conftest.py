@@ -35,6 +35,11 @@ def pytest_configure(config):
         "gang: gang-scheduling (PodGroup) tests; tier-1 includes them — "
         "select just these with -m gang",
     )
+    config.addinivalue_line(
+        "markers",
+        "preempt: priority & preemption (PriorityClass/eviction) tests; "
+        "tier-1 includes them — select just these with -m preempt",
+    )
 
 
 def pytest_addoption(parser):

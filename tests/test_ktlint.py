@@ -460,7 +460,10 @@ class TestFramework:
 def test_ktlint_clean_on_live_tree():
     """All five passes over kubernetes_tpu/: zero non-baselined
     findings, and the run proves it audited real code (>0 pragma
-    suppressions + baseline entries, not a no-op walker)."""
+    suppressions, not a no-op walker). The grandfathered baseline was
+    burned down to empty (PR 4: the kubelet agent/managers teardown
+    handlers now log); it must STAY empty — new debt wants a pragma
+    with a reason, not a baseline entry."""
     proc = subprocess.run(
         [sys.executable, "-m", "tools.ktlint", "--format=json",
          str(ROOT / "kubernetes_tpu")],
@@ -471,6 +474,5 @@ def test_ktlint_clean_on_live_tree():
     assert len(data["rules"]) >= 5
     assert data["findings"] == []
     assert data["errors"] == []
-    assert data["suppressed"] + data["baselined"] > 0
     assert data["suppressed"] > 0  # pragmas with reasons exist in-tree
-    assert data["baselined"] > 0  # grandfathered backlog is tracked
+    assert data["baselined"] == 0  # backlog burned down; keep it that way

@@ -234,3 +234,40 @@ def test_lint_metrics_knows_gang_names(tmp_path):
     proc = _ktlint_kt005(root, bad)
     assert proc.returncode == 1
     assert "lacks a unit suffix" in proc.stderr
+
+
+def test_lint_metrics_knows_preemption_names(tmp_path):
+    """The preemption_* family (scheduler/daemon.py) is known to the
+    linter: the _total counters pass the standard rule, the unitless
+    preemption_active_nominations gauge is explicitly allowlisted, and
+    a novel suffix-less preemption name still fails (the allowlist
+    names metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, PREEMPTION_METRICS
+
+    assert PREEMPTION_METRICS == {
+        "preemption_victims_total",
+        "preemption_solve_outcomes_total",
+        "preemption_active_nominations",
+    }
+    assert PREEMPTION_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.counter("preemption_victims_total", "x")\n'
+        'B = metrics.DEFAULT.counter('
+        '"preemption_solve_outcomes_total", "x", ("outcome",))\n'
+        'C = metrics.DEFAULT.gauge("preemption_active_nominations", "x")\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("preemption_backlog", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr

@@ -1,0 +1,811 @@
+"""Priority & preemption subsystem: PriorityClass API + admission,
+graceful eviction end-to-end, TPU-solved victim selection, scheduler
+integration, and the gang all-or-nothing preemption guard.
+
+The acceptance bar (ISSUE 4): on a full cluster a high-priority pod
+binds within two scheduler ticks of victim grace expiry, with
+`Preempted` events on victims and `nominatedNodeName` set meanwhile;
+pods whose priority does not dominate any victim are never granted a
+preemption; scalar and TPU victim selection agree 100% (the randomized
+suite lives in test_solver_parity.py).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.kubelet.agent import Kubelet
+from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+from kubernetes_tpu.scheduler.daemon import (
+    BatchScheduler,
+    IncrementalBatchScheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.server import APIError, APIServer
+from kubernetes_tpu.server.admission import new_from_plugins
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+pytestmark = pytest.mark.preempt
+
+
+def pc_wire(name, value, global_default=False, policy=""):
+    out = {
+        "kind": "PriorityClass",
+        "apiVersion": "v1",
+        "metadata": {"name": name},
+        "value": value,
+    }
+    if global_default:
+        out["globalDefault"] = True
+    if policy:
+        out["preemptionPolicy"] = policy
+    return out
+
+
+def pod_wire(name, cpu="100m", mem="64Mi", pc="", group="", ns="default",
+             node=""):
+    labels = {POD_GROUP_LABEL: group} if group else {}
+    spec = {
+        "containers": [
+            {"name": "c", "image": "pause",
+             "resources": {"limits": {"cpu": cpu, "memory": mem}}}
+        ]
+    }
+    if pc:
+        spec["priorityClassName"] = pc
+    if node:
+        spec["nodeName"] = node
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+        "spec": spec,
+    }
+
+
+def wait_until(cond, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# API resource
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityClassResource:
+    def test_crud_and_alias(self):
+        client = Client(LocalTransport(APIServer()))
+        created = client.create("priorityclasses", pc_wire("high", 1000))
+        assert created.value == 1000
+        assert created.preemption_policy in ("", "PreemptLowerPriority")
+        got = client.get("pc", "high")  # registry alias
+        assert got.value == 1000
+        items, _ = client.list("priorityclasses")
+        assert [c.metadata.name for c in items] == ["high"]
+        client.delete("priorityclasses", "high")
+        with pytest.raises(APIError):
+            client.get("priorityclasses", "high")
+
+    def test_validation(self):
+        client = Client(LocalTransport(APIServer()))
+        with pytest.raises(APIError) as e:
+            client.create("priorityclasses", pc_wire("big", 2 * 10**9))
+        assert e.value.code == 422
+        with pytest.raises(APIError) as e:
+            client.create(
+                "priorityclasses", pc_wire("weird", 1, policy="Sometimes")
+            )
+        assert e.value.code == 422
+
+    def test_pod_preemption_policy_enum_validated(self):
+        """A typoed opt-out ('Nevr') must fail validation, not silently
+        leave the pod preempt-capable."""
+        client = Client(LocalTransport(APIServer()))
+        wire = pod_wire("p1")
+        wire["spec"]["preemptionPolicy"] = "Nevr"
+        with pytest.raises(APIError) as e:
+            client.create("pods", wire)
+        assert e.value.code == 422
+        wire["spec"]["preemptionPolicy"] = "Never"
+        wire["spec"]["priority"] = 2 * 10**9  # out of band
+        with pytest.raises(APIError) as e:
+            client.create("pods", wire)
+        assert e.value.code == 422
+
+    def test_ktctl_get_priorityclasses_table(self, capsys):
+        from kubernetes_tpu.cli.ktctl import print_table, resolve_resource
+
+        assert resolve_resource("pc") == "priorityclasses"
+        client = Client(LocalTransport(APIServer()))
+        client.create(
+            "priorityclasses",
+            pc_wire("high", 1000, global_default=True, policy="Never"),
+        )
+        objs, _ = client.list("priorityclasses")
+        print_table("priorityclasses", objs)
+        out = capsys.readouterr().out
+        assert "VALUE" in out and "GLOBAL-DEFAULT" in out
+        assert "high" in out and "1000" in out and "Never" in out
+
+
+# ---------------------------------------------------------------------------
+# Admission: resolve + freeze
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityAdmission:
+    def _api(self):
+        api = APIServer()
+        api.admission = new_from_plugins(api, ["Priority"])
+        return api, Client(LocalTransport(api))
+
+    def test_class_resolves_onto_pod(self):
+        api, client = self._api()
+        client.create(
+            "priorityclasses", pc_wire("high", 500, policy="Never")
+        )
+        pod = client.create("pods", pod_wire("p1", pc="high"))
+        assert pod.spec.priority == 500
+        assert pod.spec.preemption_policy == "Never"
+
+    def test_unknown_class_rejected(self):
+        api, client = self._api()
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod_wire("p1", pc="nope"))
+        assert e.value.code == 404
+
+    def test_global_default_applies_highest_value(self):
+        api, client = self._api()
+        client.create("priorityclasses", pc_wire("low", 5, global_default=True))
+        client.create("priorityclasses", pc_wire("mid", 50, global_default=True))
+        pod = client.create("pods", pod_wire("p1"))
+        assert pod.spec.priority == 50
+        assert pod.spec.priority_class_name == "mid"
+
+    def test_no_class_means_priority_zero(self):
+        api, client = self._api()
+        pod = client.create("pods", pod_wire("p1"))
+        assert (pod.spec.priority or 0) == 0
+
+    def test_direct_priority_must_match_class(self):
+        api, client = self._api()
+        client.create("priorityclasses", pc_wire("high", 500))
+        wire = pod_wire("p1", pc="high")
+        wire["spec"]["priority"] = 7
+        with pytest.raises(APIError) as e:
+            client.create("pods", wire)
+        assert e.value.code == 403
+
+    def test_priority_frozen_on_update(self):
+        api, client = self._api()
+        client.create("priorityclasses", pc_wire("high", 500))
+        client.create("priorityclasses", pc_wire("higher", 900))
+        pod = api.create("pods", "default", pod_wire("p1", pc="high"))
+        pod["spec"]["priorityClassName"] = "higher"
+        pod["spec"]["priority"] = 900
+        with pytest.raises(APIError) as e:
+            api.update("pods", "default", "p1", pod)
+        assert e.value.code == 403
+        # Omitting the frozen fields carries them over instead.
+        fresh = api.get("pods", "default", "p1")
+        fresh["spec"].pop("priority", None)
+        fresh["spec"].pop("priorityClassName", None)
+        out = api.update("pods", "default", "p1", fresh)
+        assert out["spec"]["priority"] == 500
+        assert out["spec"]["priorityClassName"] == "high"
+
+    def test_classless_pod_cannot_self_promote_on_update(self):
+        """Freeze-bypass regression: a pod stored WITHOUT a priority
+        (no class, no default) must not be grantable one by a later
+        update/patch — 'frozen at unset' is still frozen."""
+        api, client = self._api()
+        pod = api.create("pods", "default", pod_wire("p1"))
+        assert "priority" not in pod["spec"]
+        pod["spec"]["priority"] = 999_999_999
+        with pytest.raises(APIError) as e:
+            api.update("pods", "default", "p1", pod)
+        assert e.value.code == 403
+        with pytest.raises(APIError) as e:
+            api.patch(
+                "pods", "default", "p1", {"spec": {"priority": 12345}}
+            )
+        assert e.value.code == 403
+        client.create("priorityclasses", pc_wire("high", 500))
+        with pytest.raises(APIError) as e:
+            api.patch(
+                "pods", "default", "p1",
+                {"spec": {"priorityClassName": "high"}},
+            )
+        assert e.value.code == 403
+
+
+# ---------------------------------------------------------------------------
+# Graceful eviction
+# ---------------------------------------------------------------------------
+
+
+class TestGracefulDelete:
+    def test_unbound_pod_deletes_immediately_despite_grace(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create("pods", pod_wire("p1"))
+        client.delete("pods", "p1", namespace="default",
+                      grace_period_seconds=30)
+        with pytest.raises(APIError):
+            client.get("pods", "p1", namespace="default")
+
+    def test_bound_pod_marks_terminating(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create("pods", pod_wire("p1", node="n1"))
+        client.delete("pods", "p1", namespace="default",
+                      grace_period_seconds=30)
+        got = client.get("pods", "p1", namespace="default")
+        assert got.metadata.deletion_timestamp
+        assert got.metadata.deletion_grace_period_seconds == 30
+        # Second graceful delete can only shorten, never extend.
+        client.delete("pods", "p1", namespace="default",
+                      grace_period_seconds=1)
+        ts1 = client.get("pods", "p1", namespace="default")
+        assert ts1.metadata.deletion_grace_period_seconds == 1
+        client.delete("pods", "p1", namespace="default",
+                      grace_period_seconds=600)
+        ts2 = client.get("pods", "p1", namespace="default")
+        assert (
+            ts2.metadata.deletion_timestamp == ts1.metadata.deletion_timestamp
+        )
+        # Grace 0 force-deletes.
+        client.delete("pods", "p1", namespace="default",
+                      grace_period_seconds=0)
+        with pytest.raises(APIError):
+            client.get("pods", "p1", namespace="default")
+
+    def test_eviction_subresource_local(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        client.create("pods", pod_wire("p1", node="n1"))
+        client.evict("p1", namespace="default", grace_period_seconds=30)
+        got = client.get("pods", "p1", namespace="default")
+        assert got.metadata.deletion_timestamp
+
+    def test_kubelet_honors_grace_end_to_end(self):
+        """The victim stays Terminating (still present, still bound)
+        until grace expiry; watchers see exactly one DELETED."""
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        kl = Kubelet(
+            Client(LocalTransport(api)), "n1",
+            sync_period=0.2, heartbeat_period=30,
+        ).start()
+        try:
+            client.create("pods", pod_wire("p1", node="n1"))
+            assert wait_until(
+                lambda: client.get(
+                    "pods", "p1", namespace="default"
+                ).status.phase == "Running",
+                timeout=20,
+            )
+            stream = client.watch("pods", namespace="default")
+            t0 = time.monotonic()
+            client.delete("pods", "p1", namespace="default",
+                          grace_period_seconds=2)
+            got = client.get("pods", "p1", namespace="default")
+            assert got.metadata.deletion_timestamp  # Terminating
+            # Mid-grace the pod is still there.
+            time.sleep(0.8)
+            assert client.get("pods", "p1", namespace="default")
+            types = []
+            deleted_at = None
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                ev = stream.next(timeout=0.5)
+                if ev is None:
+                    continue
+                types.append(ev.type)
+                if ev.type == "DELETED":
+                    deleted_at = time.monotonic() - t0
+                    break
+            stream.close()
+            assert deleted_at is not None, types
+            # ISO stamps truncate to whole seconds: expiry can land up
+            # to 1s early but never immediately.
+            assert deleted_at >= 0.9, deleted_at
+            assert types.count("DELETED") == 1, types
+            with pytest.raises(APIError):
+                client.get("pods", "p1", namespace="default")
+        finally:
+            kl.stop()
+
+    def test_http_eviction_and_grace_query(self):
+        api = APIServer()
+        server = APIHTTPServer(api).start()
+        try:
+            client = Client(HTTPTransport(server.address))
+            client.create("pods", pod_wire("p1", node="n1"))
+            client.evict("p1", namespace="default", grace_period_seconds=60)
+            got = client.get("pods", "p1", namespace="default")
+            assert got.metadata.deletion_timestamp
+            client.create("pods", pod_wire("p2", node="n1"))
+            client.delete("pods", "p2", namespace="default",
+                          grace_period_seconds=60)
+            got = client.get("pods", "p2", namespace="default")
+            assert got.metadata.deletion_grace_period_seconds == 60
+            # Plain DELETE stays immediate (pre-graceful behavior).
+            client.delete("pods", "p2", namespace="default")
+            with pytest.raises(APIError):
+                client.get("pods", "p2", namespace="default")
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Victim selection (unit; randomized parity in test_solver_parity.py)
+# ---------------------------------------------------------------------------
+
+
+class TestVictimSelection:
+    def _mk(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_solver_parity import mk_node, mk_pod
+
+        return mk_node, mk_pod
+
+    def test_minimal_prefix_lowest_priority_first(self):
+        mk_node, mk_pod = self._mk()
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+
+        node = mk_node("n0", cpu=1000, mem_mib=8192, pods=10)
+        a = mk_pod("a", cpu=400)
+        b = mk_pod("b", cpu=400)
+        c = mk_pod("c", cpu=200)
+        for p, prio in ((a, 10), (b, 5), (c, 20)):
+            p.spec.node_name = "n0"
+            p.spec.priority = prio
+        hi = mk_pod("hi", cpu=500)
+        hi.spec.priority = 100
+        (dec,) = preempt_backlog_scalar([hi], [node], [a, b, c])
+        # b (prio 5) alone frees 400 < 500; b + a frees 800 >= 500.
+        assert dec is not None
+        assert dec.victims == ("default/b", "default/a")
+        assert dec.node == "n0"
+
+    def test_no_domination_never_grants(self):
+        mk_node, mk_pod = self._mk()
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+
+        node = mk_node("n0", cpu=1000, mem_mib=8192, pods=10)
+        a = mk_pod("a", cpu=900)
+        a.spec.node_name = "n0"
+        a.spec.priority = 100
+        same = mk_pod("same", cpu=500)
+        same.spec.priority = 100  # equal, not dominating
+        zero = mk_pod("zero", cpu=500)  # priority 0 cannot preempt
+        decs = preempt_backlog_scalar([same, zero], [node], [a])
+        assert decs == [None, None]
+
+    def test_never_policy_opts_out(self):
+        mk_node, mk_pod = self._mk()
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+
+        node = mk_node("n0", cpu=1000, mem_mib=8192, pods=10)
+        a = mk_pod("a", cpu=900)
+        a.spec.node_name = "n0"
+        hi = mk_pod("hi", cpu=500)
+        hi.spec.priority = 100
+        hi.spec.preemption_policy = "Never"
+        (dec,) = preempt_backlog_scalar([hi], [node], [a])
+        assert dec is None
+
+    def test_fitting_node_is_not_a_preemption_case(self):
+        mk_node, mk_pod = self._mk()
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+
+        empty = mk_node("n0", cpu=4000, mem_mib=8192, pods=10)
+        hi = mk_pod("hi", cpu=500)
+        hi.spec.priority = 100
+        (dec,) = preempt_backlog_scalar([hi], [empty], [])
+        assert dec is None  # it fits; preemption has nothing to fix
+
+    def test_terminating_victims_not_chosen_again(self):
+        mk_node, mk_pod = self._mk()
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+
+        node = mk_node("n0", cpu=1000, mem_mib=8192, pods=10)
+        a = mk_pod("a", cpu=900)
+        a.spec.node_name = "n0"
+        a.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+        hi = mk_pod("hi", cpu=500)
+        hi.spec.priority = 100
+        (dec,) = preempt_backlog_scalar([hi], [node], [a])
+        # The only dominated pod is already terminating: its capacity
+        # is promised, evicting it again buys nothing.
+        assert dec is None
+
+    def test_node_ranking_prefers_cheapest_victims(self):
+        mk_node, mk_pod = self._mk()
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+
+        n0 = mk_node("n0", cpu=1000, mem_mib=8192, pods=10)
+        n1 = mk_node("n1", cpu=1000, mem_mib=8192, pods=10)
+        expensive = mk_pod("expensive", cpu=900)
+        expensive.spec.node_name = "n0"
+        expensive.spec.priority = 50
+        cheap = mk_pod("cheap", cpu=900)
+        cheap.spec.node_name = "n1"
+        cheap.spec.priority = 1
+        hi = mk_pod("hi", cpu=500)
+        hi.spec.priority = 100
+        (dec,) = preempt_backlog_scalar([hi], [n0, n1], [expensive, cheap])
+        assert dec.node == "n1" and dec.victims == ("default/cheap",)
+
+
+# ---------------------------------------------------------------------------
+# Gang/preemption interaction guard
+# ---------------------------------------------------------------------------
+
+
+class TestGangPreemptionGuard:
+    def _pods(self, specs):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_solver_parity import mk_pod
+
+        pods = []
+        for name, group in specs:
+            labels = {POD_GROUP_LABEL: group} if group else {}
+            p = mk_pod(name, labels=labels)
+            pods.append(p)
+        return pods
+
+    def test_partial_gang_preemption_dropped(self):
+        from kubernetes_tpu.ops.preemption import PreemptionDecision
+        from kubernetes_tpu.scheduler.gang import drop_partial_gang_preemptions
+
+        g0, g1 = self._pods([("g0", "gang"), ("g1", "gang")])
+        solo = self._pods([("solo", "")])[0]
+        unbound = [g0, g1, solo]
+        candidates = [g0, g1, solo]
+        decisions = [
+            PreemptionDecision("default/g0", "n0", ("default/v0",)),
+            None,  # g1 infeasible: the gang cannot land whole
+            PreemptionDecision("default/solo", "n1", ("default/v1",)),
+        ]
+        out, dropped = drop_partial_gang_preemptions(
+            unbound, candidates, decisions
+        )
+        assert out[0] is None  # g0's grant dropped with the gang
+        assert out[2] is not None  # ungrouped pod unaffected
+        assert dropped == ["default/gang"]
+
+    def test_whole_gang_grants_survive(self):
+        from kubernetes_tpu.ops.preemption import PreemptionDecision
+        from kubernetes_tpu.scheduler.gang import drop_partial_gang_preemptions
+
+        g0, g1 = self._pods([("g0", "gang"), ("g1", "gang")])
+        decisions = [
+            PreemptionDecision("default/g0", "n0", ("default/v0",)),
+            PreemptionDecision("default/g1", "n1", ("default/v1",)),
+        ]
+        out, dropped = drop_partial_gang_preemptions(
+            [g0, g1], [g0, g1], decisions
+        )
+        assert out == decisions and dropped == []
+
+    def test_backoff_hidden_member_vetoes_via_min_member(self):
+        """A gang member sitting in backoff requeue is invisible to the
+        tick's unbound set; the declared minMember floor must veto a
+        grant the gang still cannot use."""
+        from kubernetes_tpu.ops.preemption import PreemptionDecision
+        from kubernetes_tpu.scheduler.gang import (
+            GangGroup,
+            drop_partial_gang_preemptions,
+        )
+
+        g0, g1 = self._pods([("g0", "gang"), ("g1", "gang")])
+        decisions = [
+            PreemptionDecision("default/g0", "n0", ("default/v0",)),
+            PreemptionDecision("default/g1", "n1", ("default/v1",)),
+        ]
+        # Gang of 3, nobody bound: the third member is in backoff, so
+        # even a full grant for the two visible members is partial.
+        group = GangGroup(
+            key="default/gang", name="gang", namespace="default",
+            min_member=3, bound=0,
+        )
+        out, dropped = drop_partial_gang_preemptions(
+            [g0, g1], [g0, g1], decisions, groups=[group]
+        )
+        assert out == [None, None] and dropped == ["default/gang"]
+        # One member already bound: 2 grants + 1 bound reach the floor.
+        group.bound = 1
+        out, dropped = drop_partial_gang_preemptions(
+            [g0, g1], [g0, g1], decisions, groups=[group]
+        )
+        assert out == decisions and dropped == []
+
+    def test_member_outside_candidates_vetoes(self):
+        """A gang member excluded from candidacy (e.g. it already
+        holds a nomination) only counts when covered; an unbound,
+        uncovered member vetoes the whole gang."""
+        from kubernetes_tpu.ops.preemption import PreemptionDecision
+        from kubernetes_tpu.scheduler.gang import drop_partial_gang_preemptions
+
+        g0, g1 = self._pods([("g0", "gang"), ("g1", "gang")])
+        decisions = [PreemptionDecision("default/g0", "n0", ("default/v0",))]
+        out, dropped = drop_partial_gang_preemptions(
+            [g0, g1], [g0], decisions
+        )
+        assert out == [None] and dropped == ["default/gang"]
+        out, dropped = drop_partial_gang_preemptions(
+            [g0, g1], [g0], decisions,
+            covered_keys=frozenset({"default/g1"}),
+        )
+        assert out == decisions and dropped == []
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration (the acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def _full_cluster(api):
+    """One 1-cpu node (kubelet-backed) filled by two best-effort pods."""
+    client = Client(LocalTransport(api))
+    client.create("priorityclasses", pc_wire("high", 1000))
+    kl = Kubelet(
+        Client(LocalTransport(api)), "n1", cpu="1", memory="1Gi",
+        max_pods=10, sync_period=0.2, heartbeat_period=30,
+    ).start()
+    for i in range(2):
+        client.create("pods", pod_wire(f"be{i}", cpu="500m", mem="256Mi"))
+    return client, kl
+
+
+@pytest.mark.parametrize(
+    "daemon_cls", [BatchScheduler, IncrementalBatchScheduler]
+)
+def test_high_priority_pod_preempts_and_binds(daemon_cls):
+    api = APIServer()
+    api.admission = new_from_plugins(api, ["Priority"])
+    client, kl = _full_cluster(api)
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = daemon_cls(cfg, eviction_grace_seconds=2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            pods, _ = client.list("pods", namespace="default")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+        pods, _ = client.list("pods", namespace="default")
+        assert all(p.spec.node_name == "n1" for p in pods)
+
+        client.create(
+            "pods", pod_wire("trainer", cpu="800m", mem="512Mi", pc="high")
+        )
+        t0 = time.monotonic()
+        nominated_seen = False
+        grace_expired_at = None
+        bound_at = None
+        while time.monotonic() - t0 < 40:
+            sched.schedule_batch(timeout=0.3)
+            tr = client.get("pods", "trainer", namespace="default")
+            if tr.status.nominated_node_name == "n1":
+                nominated_seen = True
+            if grace_expired_at is None:
+                try:
+                    client.get("pods", "be0", namespace="default")
+                    client.get("pods", "be1", namespace="default")
+                except APIError:
+                    grace_expired_at = time.monotonic()
+            if tr.spec.node_name:
+                bound_at = time.monotonic()
+                break
+        assert nominated_seen, "nominatedNodeName never set"
+        assert bound_at is not None, "trainer never bound"
+        tr = client.get("pods", "trainer", namespace="default")
+        assert tr.spec.node_name == "n1"
+        # Binds within ~two scheduler ticks of a victim's exit (the
+        # loop ticks every ≤0.3s; allow generous scheduling slack).
+        if grace_expired_at is not None:
+            assert bound_at - grace_expired_at < 5.0
+
+        cfg.client.flush_events()
+        events, _ = client.list("events", namespace="default")
+        preempted = [e for e in events if e.reason == "Preempted"]
+        assert {e.involved_object.name for e in preempted} == {"be0", "be1"}
+        assert any("default/trainer" in e.message for e in preempted)
+    finally:
+        cfg.stop()
+        kl.stop()
+
+
+def test_non_dominating_pod_is_never_granted_preemption():
+    """Equal priority everywhere: the cluster stays full, nothing is
+    evicted, the pod keeps requeueing with FailedScheduling."""
+    api = APIServer()
+    api.admission = new_from_plugins(api, ["Priority"])
+    client = Client(LocalTransport(api))
+    client.create("priorityclasses", pc_wire("high", 1000))
+    kl = Kubelet(
+        Client(LocalTransport(api)), "n1", cpu="1", memory="1Gi",
+        max_pods=10, sync_period=0.2, heartbeat_period=30,
+    ).start()
+    for i in range(2):
+        client.create(
+            "pods", pod_wire(f"peer{i}", cpu="500m", mem="256Mi", pc="high")
+        )
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = BatchScheduler(cfg, eviction_grace_seconds=1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            pods, _ = client.list("pods", namespace="default")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+        client.create(
+            "pods", pod_wire("same-prio", cpu="800m", mem="512Mi", pc="high")
+        )
+        for _ in range(8):
+            sched.schedule_batch(timeout=0.3)
+        pods, _ = client.list("pods", namespace="default")
+        by_name = {p.metadata.name: p for p in pods}
+        assert "peer0" in by_name and "peer1" in by_name  # nobody evicted
+        assert not by_name["peer0"].metadata.deletion_timestamp
+        assert not by_name["same-prio"].spec.node_name
+        assert not by_name["same-prio"].status.nominated_node_name
+    finally:
+        cfg.stop()
+        kl.stop()
+
+
+def test_gang_preemptor_preempts_whole_gang_or_not_at_all():
+    """Regression for the gang guard wired into the daemons: a
+    2-member high-priority gang that can only free room for ONE member
+    must evict nobody."""
+    api = APIServer()
+    api.admission = new_from_plugins(api, ["Priority", "PodGroup"])
+    client = Client(LocalTransport(api))
+    client.create("priorityclasses", pc_wire("high", 1000))
+    client.create(
+        "podgroups",
+        {
+            "kind": "PodGroup",
+            "apiVersion": "v1",
+            "metadata": {"name": "gang", "namespace": "default"},
+            "spec": {"minMember": 2},
+        },
+    )
+    kl = Kubelet(
+        Client(LocalTransport(api)), "n1", cpu="1", memory="1Gi",
+        max_pods=10, sync_period=0.2, heartbeat_period=30,
+    ).start()
+    # Fill the node: one dominated filler + one same-priority peer.
+    client.create("pods", pod_wire("filler", cpu="500m", mem="256Mi"))
+    client.create(
+        "pods", pod_wire("peer", cpu="500m", mem="256Mi", pc="high")
+    )
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = BatchScheduler(cfg, eviction_grace_seconds=1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            pods, _ = client.list("pods", namespace="default")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+        # Two gang members, each 500m: evicting the filler frees room
+        # for ONE member only (peer is not dominated) — so the gang
+        # guard must drop the grant and the filler must survive.
+        for i in range(2):
+            client.create(
+                "pods",
+                pod_wire(f"g{i}", cpu="500m", mem="256Mi", pc="high",
+                         group="gang"),
+            )
+        for _ in range(8):
+            sched.schedule_batch(timeout=0.3)
+        pods, _ = client.list("pods", namespace="default")
+        by_name = {p.metadata.name: p for p in pods}
+        assert "filler" in by_name
+        assert not by_name["filler"].metadata.deletion_timestamp
+        assert not by_name["g0"].spec.node_name
+        assert not by_name["g1"].spec.node_name
+    finally:
+        cfg.stop()
+        kl.stop()
+
+
+def test_failed_evictions_do_not_record_a_nomination(monkeypatch):
+    """If every eviction fails transiently, no capacity was freed: the
+    preemptor must stay eligible to re-solve next tick instead of being
+    frozen behind a dead nomination."""
+    api = APIServer()
+    api.admission = new_from_plugins(api, ["Priority"])
+    client, kl = _full_cluster(api)
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = BatchScheduler(cfg, eviction_grace_seconds=1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.2)
+            pods, _ = client.list("pods", namespace="default")
+            if pods and all(p.spec.node_name for p in pods):
+                break
+
+        def broken_evict(*a, **kw):
+            raise APIError(500, "InternalError", "sink is down")
+
+        monkeypatch.setattr(cfg.client, "evict", broken_evict)
+        client.create(
+            "pods", pod_wire("trainer", cpu="800m", mem="512Mi", pc="high")
+        )
+        for _ in range(4):
+            sched.schedule_batch(timeout=0.3)
+        assert sched._nominations == {}
+        tr = client.get("pods", "trainer", namespace="default")
+        assert not tr.status.nominated_node_name
+        be0 = client.get("pods", "be0", namespace="default")
+        assert not be0.metadata.deletion_timestamp  # nothing half-evicted
+        # Evictions healed: the very next ticks preempt and nominate.
+        monkeypatch.undo()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.3)
+            tr = client.get("pods", "trainer", namespace="default")
+            if tr.spec.node_name:
+                break
+        assert tr.spec.node_name == "n1"
+    finally:
+        cfg.stop()
+        kl.stop()
+
+
+def test_priority_orders_the_drained_backlog():
+    """Two pods contending for one slot in the same batch: the higher
+    priority one wins regardless of arrival order."""
+    api = APIServer()
+    api.admission = new_from_plugins(api, ["Priority"])
+    client = Client(LocalTransport(api))
+    client.create("priorityclasses", pc_wire("high", 1000))
+    kl = Kubelet(
+        Client(LocalTransport(api)), "n1", cpu="1", memory="1Gi",
+        max_pods=10, sync_period=0.2, heartbeat_period=30,
+    ).start()
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    try:
+        assert cfg.wait_for_sync(timeout=60)
+        sched = BatchScheduler(cfg)
+        # Low-priority first into the queue, high-priority second; only
+        # one fits. Both land in one drain (batch window).
+        client.create("pods", pod_wire("lo", cpu="800m"))
+        client.create("pods", pod_wire("hi", cpu="800m", pc="high"))
+        assert wait_until(
+            lambda: len(cfg.pod_queue._items) >= 2, timeout=20
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sched.schedule_batch(timeout=0.3)
+            hi = client.get("pods", "hi", namespace="default")
+            if hi.spec.node_name:
+                break
+        hi = client.get("pods", "hi", namespace="default")
+        lo = client.get("pods", "lo", namespace="default")
+        assert hi.spec.node_name == "n1"
+        assert not lo.spec.node_name
+    finally:
+        cfg.stop()
+        kl.stop()

@@ -418,6 +418,89 @@ class TestGangParity:
         assert [g.key for g in rejected] == ["default/g0"]
 
 
+class TestPreemptionParity:
+    """Scalar and TPU victim selection must pick IDENTICAL victim sets
+    (and nodes, and preemptor ordering effects) on randomized clusters
+    — the preemption analog of the backlog decision-parity bar."""
+
+    @staticmethod
+    def _random_preemption_problem(seed):
+        rng = random.Random(seed)
+        N = rng.randint(1, 8)
+        nodes = [
+            mk_node(
+                f"n{j}",
+                cpu=rng.choice([1000, 2000, 4000]),
+                mem_mib=rng.choice([1024, 2048, 4096]),
+                pods=rng.randint(2, 8),
+                labels={"zone": rng.choice(["a", "b"])},
+                ready=rng.random() > 0.1,
+            )
+            for j in range(N)
+        ]
+        assigned = []
+        for i in range(rng.randint(0, 24)):
+            p = mk_pod(
+                f"a{i}",
+                cpu=rng.choice([0, 100, 300, 500, 900]),
+                mem_mib=rng.choice([0, 64, 256, 512]),
+            )
+            p.spec.node_name = f"n{rng.randrange(N)}"
+            p.spec.priority = rng.choice([0, 0, 5, 10, 50, 100])
+            if rng.random() < 0.1:
+                p.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+            if rng.random() < 0.1:
+                p.status.phase = rng.choice(["Succeeded", "Failed"])
+            assigned.append(p)
+        preemptors = []
+        for i in range(rng.randint(1, 5)):
+            p = mk_pod(
+                f"p{i}",
+                cpu=rng.choice([200, 600, 1200, 2500]),
+                mem_mib=rng.choice([128, 512, 1024]),
+                selector={"zone": rng.choice(["a", "b"])}
+                if rng.random() < 0.3
+                else None,
+            )
+            p.spec.priority = rng.choice([0, 20, 60, 200])
+            if rng.random() < 0.15:
+                p.spec.preemption_policy = "Never"
+            preemptors.append(p)
+        return preemptors, nodes, assigned
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_victim_set_parity_random_clusters(self, seed):
+        from kubernetes_tpu.scheduler.batch import (
+            preempt_backlog_scalar,
+            preempt_backlog_tpu,
+        )
+
+        preemptors, nodes, assigned = self._random_preemption_problem(seed)
+        scalar = preempt_backlog_scalar(preemptors, nodes, assigned)
+        device = preempt_backlog_tpu(preemptors, nodes, assigned)
+        for i, (a, b) in enumerate(zip(scalar, device)):
+            ka = (a.key, a.node, a.victims) if a else None
+            kb = (b.key, b.node, b.victims) if b else None
+            assert ka == kb, f"preemptor #{i}: scalar={ka} device={kb}"
+
+    def test_dominated_only_victims(self):
+        """The mask is strict: priority ties are not victims, on both
+        paths."""
+        from kubernetes_tpu.scheduler.batch import (
+            preempt_backlog_scalar,
+            preempt_backlog_tpu,
+        )
+
+        node = mk_node("n0", cpu=1000)
+        a = mk_pod("a", cpu=900)
+        a.spec.node_name = "n0"
+        a.spec.priority = 100
+        hi = mk_pod("hi", cpu=500)
+        hi.spec.priority = 100
+        for fn in (preempt_backlog_scalar, preempt_backlog_tpu):
+            assert fn([hi], [node], [a]) == [None]
+
+
 class TestSpreadingParityRegressions:
     """Review findings: overlapping service selectors and terminal-phase
     pods must not diverge from the scalar oracle."""

@@ -35,5 +35,55 @@ if [ "$1" = "--lint-only" ]; then
 fi
 
 echo "== tier-1 tests =="
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
+rc=$?
+
+# Surface preemption solve counts alongside the per-phase latency
+# fields (bench.py embeds the same series in its JSON record): a tiny
+# in-process exercise of the scalar victim selector proves the series
+# are live and prints them the way dashboards will scrape them.
+echo "== preemption metrics smoke =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+from kubernetes_tpu.models.objects import (
+    Container, Node, NodeCondition, NodeStatus, ObjectMeta, Pod,
+    PodSpec, ResourceRequirements,
+)
+from kubernetes_tpu.models.quantity import parse_quantity
+from kubernetes_tpu.scheduler.batch import preempt_backlog_scalar
+from kubernetes_tpu.scheduler.daemon import (
+    _PREEMPT_OUTCOMES, _PREEMPT_VICTIMS,
+)
+
+def pod(name, cpu, prio=0, node=""):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodSpec(
+            containers=[Container(name="c", image="x",
+                resources=ResourceRequirements(
+                    limits={"cpu": parse_quantity(cpu)}))],
+            priority=prio, node_name=node,
+        ),
+    )
+
+node = Node(metadata=ObjectMeta(name="n0"), status=NodeStatus(
+    capacity={"cpu": parse_quantity("1"), "pods": parse_quantity("10")},
+    conditions=[NodeCondition(type="Ready", status="True")]))
+decisions = preempt_backlog_scalar(
+    [pod("hi", "800m", prio=100)], [node], [pod("lo", "900m", node="n0")]
+)
+granted = sum(1 for d in decisions if d)
+victims = sum(len(d.victims) for d in decisions if d)
+_PREEMPT_OUTCOMES.inc(outcome="nominated", amount=granted)
+_PREEMPT_VICTIMS.inc(victims)
+print(
+    f"preemption_solve_outcomes_total{{outcome=\"nominated\"}} "
+    f"{_PREEMPT_OUTCOMES.value(outcome='nominated')}"
+)
+print(f"preemption_victims_total {_PREEMPT_VICTIMS.value()}")
+EOF
+smoke_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$smoke_rc  # a broken smoke must fail CI even when tests passed
+fi
+exit $rc

@@ -52,6 +52,18 @@ GANG_METRICS = {
 }
 ALLOWLIST |= GANG_METRICS
 
+#: Priority & preemption family (scheduler/daemon.py). The counters
+#: carry _total on their own; preemption_active_nominations is a
+#: unitless snapshot gauge (a count of held reservations, like
+#: gang_pending_groups) and is allowlisted explicitly so the linter
+#: documents the whole family rather than silently tolerating it.
+PREEMPTION_METRICS = {
+    "preemption_victims_total",
+    "preemption_solve_outcomes_total",
+    "preemption_active_nominations",
+}
+ALLOWLIST |= PREEMPTION_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"
