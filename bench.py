@@ -1076,6 +1076,23 @@ def main() -> None:
     }
     record["config_walls_s"] = small_walls
     record.update(wave_stats)
+    # Sinkhorn convergence telemetry next to the phase percentiles:
+    # iteration-count p50/p99 + final residual, read from the same
+    # always-on flight-recorder series the running daemons observe
+    # (scheduler_solve_iterations / scheduler_sinkhorn_residual were
+    # fed by the sinkhorn runs above). NaN-guarded like phase_p50_s so
+    # the BENCH json stays strictly valid.
+    from kubernetes_tpu.utils import flightrecorder as _fr
+
+    sk_it_p50 = _fr.SOLVE_ITERATIONS.quantile(0.5, mode="sinkhorn")
+    sk_it_p99 = _fr.SOLVE_ITERATIONS.quantile(0.99, mode="sinkhorn")
+    if sk_it_p50 == sk_it_p50:
+        record["sinkhorn_iters_p50"] = round(sk_it_p50, 1)
+    if sk_it_p99 == sk_it_p99:
+        record["sinkhorn_iters_p99"] = round(sk_it_p99, 1)
+    record["sinkhorn_final_residual"] = round(
+        float(_fr.SINKHORN_RESIDUAL.value()), 4
+    )
     record.update(parity)
     # Short witnessed churn + CRUD segments (VERDICT r3 next #3: these
     # lived only behind BENCH_MODE env vars nothing set). Kept brief;

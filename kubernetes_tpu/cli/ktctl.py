@@ -1041,8 +1041,14 @@ def cmd_trace(client: Client, args) -> int:
         )
     traces = data.get("traces", [])
     if not traces:
-        what = f" for pod {args.name!r}" if args.name else ""
-        print(f"No traces found{what}", file=sys.stderr)
+        # Clean nonzero exit, nothing on stdout: a script piping this
+        # must see the miss, not an empty tree.
+        if args.name:
+            print(
+                f'no trace recorded for pod "{args.name}"', file=sys.stderr
+            )
+        else:
+            print("no traces recorded", file=sys.stderr)
         return 1
     if args.output == "json":
         print(json.dumps(data, indent=2))
@@ -1052,6 +1058,46 @@ def cmd_trace(client: Client, args) -> int:
         return 0
     for tr in traces:
         print(tracing.format_trace(tr))
+    return 0
+
+
+def cmd_explain(client: Client, args) -> int:
+    """`ktctl explain pod <name>` — the CLI face of the scheduling
+    flight recorder (GET /debug/decisions): why the pod landed where it
+    did (winner + score decomposition), or a per-node table of "why
+    not" predicate reasons when it is stuck, plus any preemption
+    verdict (nominated node / victims)."""
+    from kubernetes_tpu.utils import flightrecorder
+
+    resource = resolve_resource(args.resource)
+    if resource != "pods":
+        raise SystemExit("error: explain supports pods only")
+    key = f"{args.namespace}/{args.name}"
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        data = get_json(
+            "/debug/decisions",
+            query={"pod": key, "limit": str(args.limit)},
+        )
+    else:
+        # Injected in-process transport (LocalTransport): the recorder
+        # is process-local, read it directly — same as `ktctl trace`.
+        data = flightrecorder.DEFAULT.decisions(pod=key, limit=args.limit)
+    decisions = data.get("decisions", [])
+    if not decisions:
+        print(
+            f'no decision recorded for pod "{args.name}"', file=sys.stderr
+        )
+        return 1
+    if args.output == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(data, default_flow_style=False))
+        return 0
+    for d in decisions:
+        print(flightrecorder.format_decision(d))
     return 0
 
 
@@ -1246,6 +1292,13 @@ def build_parser() -> argparse.ArgumentParser:
     tc.add_argument("name", nargs="?", help="pod name (omit for all)")
     tc.add_argument("--limit", type=int, default=16)
     tc.set_defaults(fn=cmd_trace)
+
+    xp = sub.add_parser("explain", parents=[common])
+    xp.add_argument("resource", help="pods (or an alias)")
+    xp.add_argument("name")
+    xp.add_argument("--limit", type=int, default=1,
+                    help="decisions to show, newest first")
+    xp.set_defaults(fn=cmd_explain)
 
     pf = sub.add_parser("port-forward", parents=[common])
     pf.add_argument("name")

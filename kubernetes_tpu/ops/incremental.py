@@ -169,6 +169,9 @@ class SolverSession:
         self._pending: List[_LoweredPod] = []
         self.dev = self._upload_all()
         self._dirty: set = set()
+        # Convergence telemetry of the most recent solve() tick — the
+        # incremental daemon folds this into its SolveRecord.
+        self.last_stats: Dict[str, float] = {}
 
     # -- lowering -----------------------------------------------------
 
@@ -408,11 +411,12 @@ class SolverSession:
         ):
             self._flush_dirty()
             pods = self._pod_arrays(pending)
+        waves = s_iters = s_res = None
         with tracing.phase("solve", mode=self.mode, incremental=True):
             if self.mode == "wave":
                 from kubernetes_tpu.ops.wave import solve_waves_with_state
 
-                assignment, self.dev, _ = solve_waves_with_state(
+                assignment, self.dev, waves = solve_waves_with_state(
                     pods, self.dev, self.weights
                 )
             elif self.mode == "sinkhorn":
@@ -420,8 +424,8 @@ class SolverSession:
                     solve_sinkhorn_with_state,
                 )
 
-                assignment, self.dev, _ = solve_sinkhorn_with_state(
-                    pods, self.dev, self.weights
+                assignment, self.dev, waves, s_iters, s_res = (
+                    solve_sinkhorn_with_state(pods, self.dev, self.weights)
                 )
             else:
                 assignment, self.dev = solve_with_state(
@@ -430,6 +434,24 @@ class SolverSession:
         out: List[Tuple[str, Optional[str]]] = []
         with tracing.phase("readback"):
             picks = np.asarray(assignment)[: len(pending)]
+            # Telemetry scalars convert AFTER the assignment copy
+            # blocked — no extra device sync on the tick path.
+            self.last_stats = {}
+            if waves is not None:
+                self.last_stats["waves"] = int(waves)
+            if s_iters is not None:
+                from kubernetes_tpu.utils import flightrecorder
+
+                self.last_stats["sinkhorn_iters"] = int(s_iters)
+                self.last_stats["sinkhorn_residual"] = float(s_res)
+                flightrecorder.observe_solve_telemetry(
+                    "sinkhorn", int(s_iters), residual=float(s_res),
+                    waves=int(waves),
+                )
+            elif waves is not None:
+                from kubernetes_tpu.utils import flightrecorder
+
+                flightrecorder.observe_solve_telemetry("wave", int(waves))
         for lp, j in zip(pending, picks.tolist()):
             if j < 0 or j >= self.N_cap or self.node_names[j] is None:
                 out.append((lp.key, None))

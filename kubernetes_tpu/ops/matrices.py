@@ -191,6 +191,32 @@ def device_nodes(
     return _put_tree(nodes, sharding)
 
 
+#: Predicate bit positions in the explain readback's packed per-node
+#: failure mask (ops.solver.explain_rows; bit set = the predicate
+#: REJECTED the node). Order is the solver's evaluation order; names
+#: match the reference FitPredicate names operators already know from
+#: FailedScheduling events (plugin/pkg/scheduler/factory/plugins.go) —
+#: plus NodeSchedulable, the reference's ready/unschedulable node
+#: filter that runs before predicates (factory.go:166,209).
+EXPLAIN_PREDICATES = (
+    "NodeSchedulable",
+    "PodFitsResources",
+    "MatchNodeSelector",
+    "PodFitsPorts",
+    "NoDiskConflict",
+    "HostName",
+)
+
+
+def decode_predicate_bits(bits: int) -> list:
+    """Failed-predicate names for one node's packed verdict mask."""
+    return [
+        name
+        for i, name in enumerate(EXPLAIN_PREDICATES)
+        if bits & (1 << i)
+    ]
+
+
 @functools.partial(jax.jit, static_argnames=("num_groups",))
 def gang_member_counts(
     placed: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int

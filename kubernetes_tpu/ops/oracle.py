@@ -35,6 +35,121 @@ def solve_sequential_numpy(snap: Snapshot) -> np.ndarray:
     return out
 
 
+def explain_bits_numpy(snap: Snapshot):
+    """The explain readback's scalar twin: per-(pod, node) packed
+    predicate-failure bits plus the default priority components, in
+    host arithmetic over the FIXED snapshot state (no sequential
+    commit — every pod sees the same occupancy, exactly like the
+    device readback in ops.solver.explain_rows evaluates it).
+
+    Bit layout is ops.matrices.EXPLAIN_PREDICATES. Returns
+    (bits u32[P, N], lr i64[P, N], bra i64[P, N], spread i64[P, N]).
+
+    Unlike the solve oracle above, BalancedResourceAllocation here
+    reproduces the device's float32 + epsilon recipe on purpose: this
+    twin certifies the READBACK bit-for-bit (tests/test_solver_parity
+    TestExplainParity demands 100%), while Go-semantics divergence
+    remains the solve-parity suite's business."""
+    p, n = snap.pods, snap.nodes
+    P, N = p.count, n.count
+    bits = np.zeros((P, N), np.uint32)
+    lr = np.zeros((P, N), np.int64)
+    bra = np.zeros((P, N), np.int64)
+    spread = np.zeros((P, N), np.int64)
+    if P == 0 or N == 0:
+        return bits, lr, bra, spread
+
+    cpu_cap = n.cpu_cap.astype(np.int64)
+    mem_cap = n.mem_cap.astype(np.int64)
+    pods_cap = n.pods_cap.astype(np.int64)
+    cpu_fit = n.cpu_fit_used.astype(np.int64)
+    mem_fit = n.mem_fit_used.astype(np.int64)
+    over = n.overcommitted
+    cpu_used = n.cpu_used.astype(np.int64)
+    mem_used = n.mem_used.astype(np.int64)
+    pods_used = n.pods_used.astype(np.int64)
+    labels = n.label_bits
+    uport = n.used_port_bits
+    uvol_any = n.used_vol_any_bits
+    uvol_rw = n.used_vol_rw_bits
+    svc_counts = n.service_counts.astype(np.int64)
+    sched = n.schedulable
+    idx = np.arange(N, dtype=np.int64)
+    pod_cpu = p.cpu_milli.astype(np.int64)
+    pod_mem = p.mem_mib.astype(np.int64)
+    sel_rows = p.sel_bits[p.selector_id]
+
+    for i in range(P):
+        # -- predicates, one bit each (solver._pred_* formulas) --
+        fits_cpu = (cpu_cap == 0) | (cpu_fit + pod_cpu[i] <= cpu_cap)
+        fits_mem = (mem_cap == 0) | (mem_fit + pod_mem[i] <= mem_cap)
+        fits_count = pods_used + 1 <= pods_cap
+        if p.zero_req[i]:
+            res_ok = pods_used < pods_cap
+        else:
+            res_ok = (~over) & fits_cpu & fits_mem & fits_count
+        sel = sel_rows[i]
+        sel_ok = ((sel[None, :] & labels) == sel[None, :]).all(axis=1)
+        port_ok = ~(p.port_bits[i][None, :] & uport).any(axis=1)
+        vol_ok = ~(
+            (p.vol_rw_bits[i][None, :] & uvol_any)
+            | (p.vol_any_bits[i][None, :] & uvol_rw)
+        ).any(axis=1)
+        pin = int(p.pinned_node[i])
+        host_ok = np.ones(N, bool) if pin == -1 else (idx == pin)
+        for b, ok in enumerate(
+            (sched, res_ok, sel_ok, port_ok, vol_ok, host_ok)
+        ):
+            bits[i] |= (~ok).astype(np.uint32) << b
+
+        # -- components --
+        creq = cpu_used + pod_cpu[i]
+        mreq = mem_used + pod_mem[i]
+        lr_c = np.where(
+            (cpu_cap == 0) | (creq > cpu_cap),
+            0,
+            ((cpu_cap - creq) * 10) // np.maximum(cpu_cap, 1),
+        )
+        lr_m = np.where(
+            (mem_cap == 0) | (mreq > mem_cap),
+            0,
+            ((mem_cap - mreq) * 10) // np.maximum(mem_cap, 1),
+        )
+        lr[i] = (lr_c + lr_m) // 2
+        # float32 on the host is IEEE round-to-nearest — identical to
+        # the CPU jax backend the parity suite runs on.
+        cfrac = np.where(
+            cpu_cap == 0,
+            np.float32(1.0),
+            creq.astype(np.float32) / np.maximum(cpu_cap, 1).astype(np.float32),
+        ).astype(np.float32)
+        mfrac = np.where(
+            mem_cap == 0,
+            np.float32(1.0),
+            mreq.astype(np.float32) / np.maximum(mem_cap, 1).astype(np.float32),
+        ).astype(np.float32)
+        bra[i] = np.where(
+            (cfrac >= 1) | (mfrac >= 1),
+            0,
+            (
+                np.float32(10)
+                - np.abs(cfrac - mfrac) * np.float32(10)
+                + np.float32(1e-5)
+            ).astype(np.int64),
+        )
+        svc = int(p.service_id[i])
+        if svc < 0:
+            spread[i] = 10
+        else:
+            counts = svc_counts[:, svc]
+            maxc = int(counts.max())
+            if maxc == 0:
+                spread[i] = 10
+            else:
+                spread[i] = (10 * (maxc - counts)) // maxc
+    return bits, lr, bra, spread
+
+
 def assignment_quality(snap: Snapshot, assignment: np.ndarray) -> dict:
     """Score an APPROXIMATE solver's assignment against the greedy
     oracle (VERDICT r2 Weak #2: wave/sinkhorn quality must be a

@@ -21,7 +21,7 @@ pipeline is the TPU-native replacement for that loop's concurrency.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -116,20 +116,28 @@ def solve_backlog_pipelined(
             builder.node_columns(), node_sharding,
             node_mult=node_axis_multiple(mesh),
         )
+    # Convergence telemetry per chunk (device scalars — converted to
+    # host ints only at the blocking readback, so the async overlap
+    # never stalls on a telemetry copy).
+    tele: List[Tuple] = []
     if mode == "scan":
         step = lambda dpods, carry: solve_with_state(dpods, carry, weights)
     elif mode == "wave":
         from kubernetes_tpu.ops.wave import solve_waves_with_state
 
-        step = lambda dpods, carry: solve_waves_with_state(
-            dpods, carry, weights
-        )[:2]
+        def step(dpods, carry):
+            a, c, w = solve_waves_with_state(dpods, carry, weights)
+            tele.append((w, None, None))
+            return a, c
     elif mode == "sinkhorn":
         from kubernetes_tpu.ops.sinkhorn import solve_sinkhorn_with_state
 
-        step = lambda dpods, carry: solve_sinkhorn_with_state(
-            dpods, carry, weights
-        )[:2]
+        def step(dpods, carry):
+            a, c, w, it, res = solve_sinkhorn_with_state(
+                dpods, carry, weights
+            )
+            tele.append((w, it, res))
+            return a, c
     else:
         raise ValueError(f"unknown pipeline mode {mode!r}")
     P = len(builder.pending)
@@ -159,4 +167,137 @@ def solve_backlog_pipelined(
             picks = np.asarray(assignment)[:count]
             for j in picks.tolist():
                 result.append(names[j] if 0 <= j < n_nodes else None)
+        if tele:
+            from kubernetes_tpu.utils import flightrecorder
+
+            waves = sum(int(w) for w, _, _ in tele)
+            if mode == "sinkhorn":
+                flightrecorder.observe_solve_telemetry(
+                    "sinkhorn",
+                    sum(int(it) for _, it, _ in tele),
+                    residual=float(tele[-1][2]),
+                    waves=waves,
+                )
+            else:
+                flightrecorder.observe_solve_telemetry("wave", waves)
         return result
+
+
+# -- explain readback ---------------------------------------------------
+
+
+def explain_matrix(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+    mesh=None,
+):
+    """Raw explain readback for a backlog against one FIXED cluster
+    state (`assigned` pods charge occupancy; `pending` pods commit
+    nothing — every row sees the same state). Returns (node_names,
+    bits u32[P, N], components dict of i32[P, N]): bit i of bits[p, n]
+    set means matrices.EXPLAIN_PREDICATES[i] rejected node n for pod
+    p; bits == 0 is feasibility under the default pipeline. One kernel
+    dispatch + one readback — never on the solve path (the daemons run
+    it inside its own "explain" phase)."""
+    from kubernetes_tpu.models.columnar import build_snapshot
+    from kubernetes_tpu.ops.matrices import device_snapshot
+    from kubernetes_tpu.ops.solver import explain_rows
+
+    snap = build_snapshot(
+        pending, nodes, assigned_pods=assigned, services=services
+    )
+    dsnap = device_snapshot(snap, mesh=mesh)
+    bits, lr, bra, spread = explain_rows(dsnap.pods, dsnap.nodes)
+    P, N = dsnap.n_pods, dsnap.n_nodes
+    return (
+        snap.nodes.names,
+        np.asarray(bits)[:P, :N],
+        {
+            "leastRequested": np.asarray(lr)[:P, :N],
+            "balanced": np.asarray(bra)[:P, :N],
+            "spreading": np.asarray(spread)[:P, :N],
+        },
+    )
+
+
+def explain_backlog(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    assigned: Sequence[Pod] = (),
+    services: Sequence[Service] = (),
+    mesh=None,
+    top_k: int = 3,
+    max_failed: int = 16,
+) -> List[dict]:
+    """Bounded per-pod explain verdicts — the flight recorder's shape.
+    For each pending pod (aligned with the input): the top_k feasible
+    nodes ranked by total default-priority score (lowest index wins
+    ties, the solver's tie-break) with the score decomposition, up to
+    max_failed individually-listed infeasible nodes, and aggregate
+    failed-predicate counts over ALL nodes — a 5k-node cluster folds
+    into a handful of reason counts, not 5k rows."""
+    from kubernetes_tpu.models.objects import pod_full_key
+    from kubernetes_tpu.ops.matrices import (
+        EXPLAIN_PREDICATES,
+        decode_predicate_bits,
+    )
+
+    pending = list(pending)
+    if not pending:
+        return []
+    names, bits, comps = explain_matrix(
+        pending, nodes, assigned, services, mesh=mesh
+    )
+    total = (
+        comps["leastRequested"] + comps["balanced"] + comps["spreading"]
+    )
+    out: List[dict] = []
+    n_nodes = len(names)
+    for i, pod in enumerate(pending):
+        row = bits[i]
+        feasible = np.flatnonzero(row == 0)
+        entry_nodes: List[dict] = []
+        # Feasible candidates: score desc, node index asc on ties
+        # (argsort is stable, so sorting by -score preserves index
+        # order inside a score band — the scan's argmax tie-break).
+        for j in feasible[np.argsort(-total[i][feasible], kind="stable")][
+            :top_k
+        ].tolist():
+            entry_nodes.append(
+                {
+                    "node": names[j],
+                    "ok": True,
+                    "score": int(total[i, j]),
+                    "components": {
+                        k: int(v[i, j]) for k, v in comps.items()
+                    },
+                }
+            )
+        # Aggregate counts vectorized (one popcount per predicate bit,
+        # not a Python loop over 5k nodes); only the max_failed nodes
+        # listed individually pay per-node decoding.
+        reason_counts: Dict[str, int] = {}
+        for b, name in enumerate(EXPLAIN_PREDICATES):
+            c = int(((row >> np.uint32(b)) & 1).sum())
+            if c:
+                reason_counts[name] = c
+        for j in np.flatnonzero(row != 0)[:max_failed].tolist():
+            entry_nodes.append(
+                {
+                    "node": names[j],
+                    "ok": False,
+                    "reasons": decode_predicate_bits(int(row[j])),
+                }
+            )
+        out.append(
+            {
+                "pod": pod_full_key(pod),
+                "feasibleNodes": int(len(feasible)),
+                "totalNodes": n_nodes,
+                "nodes": entry_nodes,
+                "reasonCounts": reason_counts,
+            }
+        )
+    return out

@@ -66,6 +66,14 @@ from kubernetes_tpu.ops.matrices import pow2_bucket
 #: Sentinel "no feasible victim prefix" for per-node k arrays.
 INFEASIBLE = np.int32(2**31 - 1)
 
+#: Canonical rejection reason the flight recorder records for a
+#: preemptor no node could be freed for — the preemption face of the
+#: per-predicate "why not" surface (shared by both solve paths).
+REASON_INFEASIBLE = (
+    "no node can free enough capacity by evicting strictly "
+    "lower-priority pods"
+)
+
 
 @dataclass
 class PreemptionDecision:
@@ -75,6 +83,14 @@ class PreemptionDecision:
     key: str  # preemptor pod key "ns/name"
     node: str
     victims: Tuple[str, ...]
+
+    def to_wire(self) -> dict:
+        """The /debug/decisions shape of a granted preemption."""
+        return {
+            "pod": self.key,
+            "node": self.node,
+            "victims": list(self.victims),
+        }
 
 
 @dataclass

@@ -54,13 +54,23 @@ def _congestion_prices(
     capacity: jnp.ndarray,  # f32[N]: remaining pod-count capacity
     eps: float,
     iters: int,
-) -> jnp.ndarray:
-    """f32[N] score-domain column prices g (<= 0). Capped Sinkhorn:
-    row-normalize the plan so each shipping pod distributes one unit of
-    mass by softmax((S + g)/eps), then lower g wherever a column's
-    mass exceeds its capacity. Fixed iteration count — convergence to
-    machine precision buys nothing here, the prices only steer an
-    argmax."""
+    tol: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Capped Sinkhorn with convergence telemetry. Returns
+    (g f32[N], iters_run i32, residual f32): row-normalize the plan so
+    each shipping pod distributes one unit of mass by
+    softmax((S + g)/eps), then lower g wherever a column's mass exceeds
+    its capacity.
+
+    The residual is the worst column's log-domain mass excess over its
+    capacity, measured entering the last executed price update (0 =
+    demand already fits everywhere; further updates are no-ops), and
+    iters_run counts the updates actually executed — the convergence
+    telemetry scheduler_sinkhorn_residual / scheduler_solve_iterations
+    surface. `tol` stops the loop early once the residual is at or
+    below it; the default 0.0 reproduces the historic fixed-iteration
+    prices bit-for-bit (a zero residual means every remaining update
+    is the identity)."""
     logits = jnp.where(masked >= 0, masked / eps, _NEG)
     # Pods with zero feasible nodes ship NO mass: letting them
     # row-normalize anyway would spray phantom demand across nodes they
@@ -70,22 +80,40 @@ def _congestion_prices(
     log_a = jnp.where(ships, 0.0, _NEG)
     log_b = jnp.where(capacity > 0, jnp.log(jnp.maximum(capacity, 1e-9)), _NEG)
 
-    def body(_, g):
+    def cond(state):
+        i, _, res = state
+        return (i < iters) & (res > tol)
+
+    def body(state):
+        i, g, _ = state
         # g lives in the SCORE domain (it is added to S at the argmax),
         # so inside the softmax it scales by 1/eps like the scores.
         row = logits + g[None, :] / eps
         row_lse = jax.nn.logsumexp(row, axis=1, keepdims=True)
         log_t = log_a[:, None] + row - jnp.maximum(row_lse, _NEG)
         col_mass = jax.nn.logsumexp(log_t, axis=0)  # f32[N]
+        excess = jnp.where(
+            capacity > 0, jnp.maximum(col_mass - log_b, 0.0), 0.0
+        )
         # Overloaded columns get cheaper; never boost empty ones.
-        return g + jnp.minimum(0.0, log_b - col_mass) * eps
+        g = g + jnp.minimum(0.0, log_b - col_mass) * eps
+        return i + 1, g, jnp.max(excess)
 
-    return jax.lax.fori_loop(0, iters, body, jnp.zeros_like(capacity))
+    i, g, res = jax.lax.while_loop(
+        cond,
+        body,
+        (jnp.int32(0), jnp.zeros_like(capacity), jnp.float32(jnp.inf)),
+    )
+    # A window that never iterated (iters == 0) reports residual 0.
+    return g, i, jnp.where(jnp.isinf(res), 0.0, res)
 
 
-def _priced_choose(masked, idx, valid, carry, N, *, eps, iters, price_cap):
+def _priced_choose(masked, idx, valid, carry, N, *, eps, iters, price_cap,
+                   tol=0.0):
     """Sinkhorn-priced choice: argmax over S_ij + g_j with a tiny
-    deterministic jitter as tie-break.
+    deterministic jitter as tie-break. Returns (choice, iters_run,
+    residual) — the telemetry rides the windowed loop's carry
+    (ops.wave.run_windowed) up to the solve wrappers.
 
     price_cap bounds how far pricing may push a pod off its greedy
     best: with g clamped to [-price_cap, 0], the chosen node satisfies
@@ -95,36 +123,50 @@ def _priced_choose(masked, idx, valid, carry, N, *, eps, iters, price_cap):
     Congestion relief degrades gracefully: overloaded columns still
     repel up to the cap, they just can't exile pods arbitrarily far."""
     remaining = jnp.maximum(carry["pods_cap"] - carry["pods_used"], 0.0)
-    g = _congestion_prices(
-        masked.astype(jnp.float32), valid, remaining, eps, iters
+    g, iters_run, residual = _congestion_prices(
+        masked.astype(jnp.float32), valid, remaining, eps, iters, tol
     )
     g = jnp.maximum(g, -jnp.float32(price_cap))
     priced = jnp.where(
         masked >= 0, masked.astype(jnp.float32) + g[None, :], -jnp.inf
     )
     jitter = _tie_hash(idx, N).astype(jnp.float32) * jnp.float32(1e-6)
-    return jnp.argmax(priced + jitter, axis=1).astype(jnp.int32)
+    choice = jnp.argmax(priced + jitter, axis=1).astype(jnp.int32)
+    return choice, iters_run, residual
 
 
 def sinkhorn_assignments(dsnap, **kw):
     """Run the Sinkhorn wave solver and strip padding: returns
-    (i32[n_pods] with -1 = unschedulable, wave count)."""
-    from kubernetes_tpu.utils import tracing
+    (i32[n_pods] with -1 = unschedulable, wave count). Convergence
+    telemetry (total price iterations + final residual) is observed
+    into scheduler_solve_iterations / scheduler_sinkhorn_residual and
+    noted on the solve span."""
+    from kubernetes_tpu.utils import flightrecorder, tracing
 
     with tracing.phase("solve", solver="sinkhorn") as sp:
-        out, waves = solve_sinkhorn(dsnap.pods, dsnap.nodes, **kw)
+        out, waves, titers, residual = solve_sinkhorn_stats(
+            dsnap.pods, dsnap.nodes, **kw
+        )
         stripped = strip_assignments(dsnap, out)
         waves = int(waves)
-        sp.note(waves=waves)
+        titers = int(titers)
+        residual = float(residual)
+        sp.note(
+            waves=waves, sinkhorn_iters=titers,
+            sinkhorn_residual=round(residual, 4),
+        )
+    flightrecorder.observe_solve_telemetry(
+        "sinkhorn", titers, residual=residual, waves=waves
+    )
     return stripped, waves
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("weights", "window", "per_node_limit", "eps", "iters",
-                     "price_cap"),
+                     "price_cap", "tol"),
 )
-def solve_sinkhorn(
+def solve_sinkhorn_stats(
     pods: Dict[str, jnp.ndarray],
     nodes: Dict[str, jnp.ndarray],
     weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
@@ -133,26 +175,43 @@ def solve_sinkhorn(
     eps: float = 2.0,
     iters: int = 8,
     price_cap: float = 4.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(assignment i32[P] with -1 = unschedulable, wave count).
+    tol: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(assignment i32[P] with -1 = unschedulable, wave count, total
+    Sinkhorn price iterations, final residual).
 
     Same contract and commit path as ops.wave.solve_waves; the choice
     step is Sinkhorn-priced instead of raw argmax, so the per-node
     acceptance limit can be far looser (prices already meter demand to
-    capacity) — that is where the wave-count win comes from."""
+    capacity) — that is where the wave-count win comes from. The
+    telemetry scalars ride the windowed loop's carry: the iteration
+    total sums every wave's price updates, the residual is the LAST
+    wave's (see _congestion_prices)."""
     choose = functools.partial(
-        _priced_choose, eps=eps, iters=iters, price_cap=price_cap
+        _priced_choose, eps=eps, iters=iters, price_cap=price_cap, tol=tol
     )
-    assignment, _, waves = run_windowed(
+    assignment, _, waves, titers, residual = run_windowed(
         pods, nodes, weights, window, per_node_limit, choose
     )
+    return assignment, waves, titers, residual
+
+
+def solve_sinkhorn(
+    pods: Dict[str, jnp.ndarray],
+    nodes: Dict[str, jnp.ndarray],
+    **kw,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(assignment i32[P] with -1 = unschedulable, wave count) — thin
+    alias of solve_sinkhorn_stats (ONE jit cache) for callers that
+    don't read the convergence telemetry."""
+    assignment, waves, _, _ = solve_sinkhorn_stats(pods, nodes, **kw)
     return assignment, waves
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("weights", "window", "per_node_limit", "eps", "iters",
-                     "price_cap"),
+                     "price_cap", "tol"),
     donate_argnames=("nodes",),
 )
 def solve_sinkhorn_with_state(
@@ -164,10 +223,17 @@ def solve_sinkhorn_with_state(
     eps: float = 2.0,
     iters: int = 8,
     price_cap: float = 4.0,
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
-    """Like solve_sinkhorn, but also returns the post-commit occupancy
-    carry; `nodes` is DONATED (the incremental-churn substrate)."""
+    tol: float = 0.0,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray,
+           jnp.ndarray]:
+    """Like solve_sinkhorn_stats, but also returns the post-commit
+    occupancy carry; `nodes` is DONATED (the incremental-churn
+    substrate). Returns (assignment, carry, waves, total Sinkhorn
+    iterations, final residual)."""
     choose = functools.partial(
-        _priced_choose, eps=eps, iters=iters, price_cap=price_cap
+        _priced_choose, eps=eps, iters=iters, price_cap=price_cap, tol=tol
     )
-    return run_windowed(pods, nodes, weights, window, per_node_limit, choose)
+    assignment, carry, waves, titers, residual = run_windowed(
+        pods, nodes, weights, window, per_node_limit, choose
+    )
+    return assignment, carry, waves, titers, residual

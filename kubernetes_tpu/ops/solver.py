@@ -42,41 +42,64 @@ from kubernetes_tpu.ops.matrices import DeviceSnapshot
 DEFAULT_WEIGHTS = (1, 1, 1)
 
 
+def _pred_resources(pod: Dict, nodes: Dict) -> jnp.ndarray:
+    """PodFitsResources (predicates.go:139-156) as bool[N]."""
+    cpu_cap, mem_cap = nodes["cpu_cap"], nodes["mem_cap"]
+    fits_cpu = (cpu_cap == 0) | (nodes["cpu_fit"] + pod["cpu"] <= cpu_cap)
+    fits_mem = (mem_cap == 0) | (nodes["mem_fit"] + pod["mem"] <= mem_cap)
+    fits_count = nodes["pods_used"] + 1 <= nodes["pods_cap"]
+    nonzero_ok = (~nodes["over"]) & fits_cpu & fits_mem & fits_count
+    # Zero-request pods only check pod-count headroom (predicates.go:146).
+    zero_ok = nodes["pods_used"] < nodes["pods_cap"]
+    return jnp.where(pod["zero_req"], zero_ok, nonzero_ok)
+
+
+def _pred_selector(pod: Dict, nodes: Dict) -> jnp.ndarray:
+    """MatchNodeSelector: selector bits must be a subset of labels."""
+    sel = pod["sel"][None, :]
+    return jnp.all((sel & nodes["labels"]) == sel, axis=1)
+
+
+def _pred_ports(pod: Dict, nodes: Dict) -> jnp.ndarray:
+    """PodFitsPorts."""
+    return ~jnp.any(pod["port"][None, :] & nodes["uport"], axis=1)
+
+
+def _pred_disk(pod: Dict, nodes: Dict) -> jnp.ndarray:
+    """NoDiskConflict: conflict when either side holds it read-write."""
+    return ~jnp.any(
+        (pod["vol_rw"][None, :] & nodes["uvol_any"])
+        | (pod["vol_any"][None, :] & nodes["uvol_rw"]),
+        axis=1,
+    )
+
+
+def _pred_hostname(pod: Dict, N: int) -> jnp.ndarray:
+    """HostName."""
+    idx = jnp.arange(N, dtype=jnp.int32)
+    return (pod["pinned"] == -1) | (idx == pod["pinned"])
+
+
 def _feasible(
     pod: Dict, nodes: Dict, N: int, ls: LoweredSpec = DEFAULT_LOWERED
 ) -> jnp.ndarray:
     """The configured predicates as one bool[N] mask (defaults when no
     policy is lowered — each term is gated by the static LoweredSpec,
-    so a policy that omits a predicate omits its ops entirely)."""
+    so a policy that omits a predicate omits its ops entirely). The
+    per-predicate helpers above are the single implementation shared
+    with the explain readback (explain_rows): the decision and its
+    explanation can never drift."""
     ok = nodes["sched"]
     if ls.resources:
-        cpu_cap, mem_cap = nodes["cpu_cap"], nodes["mem_cap"]
-        # -- PodFitsResources --
-        fits_cpu = (cpu_cap == 0) | (nodes["cpu_fit"] + pod["cpu"] <= cpu_cap)
-        fits_mem = (mem_cap == 0) | (nodes["mem_fit"] + pod["mem"] <= mem_cap)
-        fits_count = nodes["pods_used"] + 1 <= nodes["pods_cap"]
-        nonzero_ok = (~nodes["over"]) & fits_cpu & fits_mem & fits_count
-        # Zero-request pods only check pod-count headroom (predicates.go:146).
-        zero_ok = nodes["pods_used"] < nodes["pods_cap"]
-        ok = ok & jnp.where(pod["zero_req"], zero_ok, nonzero_ok)
+        ok = ok & _pred_resources(pod, nodes)
     if ls.selector:
-        # -- MatchNodeSelector: selector bits must be a subset of labels --
-        sel = pod["sel"][None, :]
-        ok = ok & jnp.all((sel & nodes["labels"]) == sel, axis=1)
+        ok = ok & _pred_selector(pod, nodes)
     if ls.ports:
-        # -- PodFitsPorts --
-        ok = ok & ~jnp.any(pod["port"][None, :] & nodes["uport"], axis=1)
+        ok = ok & _pred_ports(pod, nodes)
     if ls.disk:
-        # -- NoDiskConflict: conflict when either side holds it read-write --
-        ok = ok & ~jnp.any(
-            (pod["vol_rw"][None, :] & nodes["uvol_any"])
-            | (pod["vol_any"][None, :] & nodes["uvol_rw"]),
-            axis=1,
-        )
+        ok = ok & _pred_disk(pod, nodes)
     if ls.hostname:
-        # -- HostName --
-        idx = jnp.arange(N, dtype=jnp.int32)
-        ok = ok & ((pod["pinned"] == -1) | (idx == pod["pinned"]))
+        ok = ok & _pred_hostname(pod, N)
     if ls.node_label:
         # -- CheckNodeLabelPresence: static node mask (predicates.go:226) --
         ok = ok & nodes["policy_ok"]
@@ -107,6 +130,60 @@ def _feasible(
     return ok
 
 
+def _component_scores(
+    pod: Dict, nodes: Dict
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The three default priority columns — (LeastRequested,
+    BalancedResourceAllocation, ServiceSpreading) as separate int32[N]
+    vectors. _scores sums them weighted; the explain readback
+    (explain_rows) reports them decomposed. One implementation, so the
+    published breakdown can never drift from the decision.
+
+    Integer score math in int32: columns are integer-valued f32 with
+    magnitudes < 2^24, so the cast is exact and the Go int64 division
+    semantics (truncation of nonnegative quotients) are reproduced
+    without float rounding hazards."""
+    cpu_cap = nodes["cpu_cap"].astype(jnp.int32)
+    mem_cap = nodes["mem_cap"].astype(jnp.int32)
+    cpu_req = (nodes["cpu_used"] + pod["cpu"]).astype(jnp.int32)
+    mem_req = (nodes["mem_used"] + pod["mem"]).astype(jnp.int32)
+
+    def calc_score(req, cap):
+        # priorities.go:31-40: 0 if cap == 0 or req > cap.
+        raw = jnp.where(cap > 0, ((cap - req) * 10) // jnp.maximum(cap, 1), 0)
+        return jnp.where((cap == 0) | (req > cap), 0, raw)
+
+    lr = (calc_score(cpu_req, cpu_cap) + calc_score(mem_req, mem_cap)) // 2
+
+    # BalancedResourceAllocation (priorities.go:146-205). TPU float
+    # division is reciprocal-based and NOT correctly rounded (~1 ulp
+    # low), which truncates scores one short at exact boundaries like
+    # |0.75-0.25|*10 == 5. The epsilon absorbs that device error; it is
+    # far below the smallest legitimate gap between distinct exact
+    # score values for realistic capacities.
+    cfrac = jnp.where(cpu_cap == 0, 1.0, cpu_req / jnp.maximum(cpu_cap, 1))
+    mfrac = jnp.where(mem_cap == 0, 1.0, mem_req / jnp.maximum(mem_cap, 1))
+    bra = jnp.where(
+        (cfrac >= 1) | (mfrac >= 1),
+        0,
+        (10 - jnp.abs(cfrac - mfrac) * 10 + 1e-5).astype(jnp.int32),
+    )
+
+    # ServiceSpreading (spreading.go:38-87) in exact integer math
+    # (counts are small integers): 10*(maxc-count) // maxc. Go truncates
+    # the float32 quotient; integer division agrees except where Go's
+    # f32 rounding lands exactly on an integer from below — rare and
+    # covered by the >=99% parity budget.
+    svc = pod["svc"]
+    counts = jax.lax.dynamic_index_in_dim(
+        nodes["svc_counts"], jnp.maximum(svc, 0), axis=1, keepdims=False
+    ).astype(jnp.int32)
+    maxc = jnp.max(counts)
+    spread_raw = (10 * (maxc - counts)) // jnp.maximum(maxc, 1)
+    spread = jnp.where((svc < 0) | (maxc == 0), 10, spread_raw)
+    return lr, bra, spread
+
+
 def _scores(
     pod: Dict,
     nodes: Dict,
@@ -121,58 +198,26 @@ def _scores(
     only matters for ServiceAntiAffinity — its per-zone peer counts
     skip peers hosted on filtered-out nodes (spreading.go:133-147).
     Every other priority's per-node score is filter-independent."""
-    # Integer score math in int32: columns are integer-valued f32 with
-    # magnitudes < 2^24, so the cast is exact and the Go int64 division
-    # semantics (truncation of nonnegative quotients) are reproduced
-    # without float rounding hazards.
-    cpu_cap = nodes["cpu_cap"].astype(jnp.int32)
-    mem_cap = nodes["mem_cap"].astype(jnp.int32)
-    cpu_req = (nodes["cpu_used"] + pod["cpu"]).astype(jnp.int32)
-    mem_req = (nodes["mem_used"] + pod["mem"]).astype(jnp.int32)
     w_lr, w_bra, w_spread = weights
-    total = jnp.zeros(cpu_cap.shape[0], dtype=jnp.int32)
+    total = jnp.zeros(nodes["cpu_cap"].shape[0], dtype=jnp.int32)
 
+    if w_lr or w_bra or w_spread:
+        # Unused components are dead code XLA eliminates; the shared
+        # helper keeps the explain readback's score decomposition
+        # (explain_rows) THE solver arithmetic, not a twin.
+        lr, bra, spread = _component_scores(pod, nodes)
     if w_lr:
-        def calc_score(req, cap):
-            # priorities.go:31-40: 0 if cap == 0 or req > cap.
-            raw = jnp.where(cap > 0, ((cap - req) * 10) // jnp.maximum(cap, 1), 0)
-            return jnp.where((cap == 0) | (req > cap), 0, raw)
-
-        lr = (calc_score(cpu_req, cpu_cap) + calc_score(mem_req, mem_cap)) // 2
         total = total + lr * w_lr
-
     if w_bra:
-        # BalancedResourceAllocation (priorities.go:146-205). TPU float
-        # division is reciprocal-based and NOT correctly rounded (~1 ulp
-        # low), which truncates scores one short at exact boundaries like
-        # |0.75-0.25|*10 == 5. The epsilon absorbs that device error; it is
-        # far below the smallest legitimate gap between distinct exact
-        # score values for realistic capacities.
-        cfrac = jnp.where(cpu_cap == 0, 1.0, cpu_req / jnp.maximum(cpu_cap, 1))
-        mfrac = jnp.where(mem_cap == 0, 1.0, mem_req / jnp.maximum(mem_cap, 1))
-        bra = jnp.where(
-            (cfrac >= 1) | (mfrac >= 1),
-            0,
-            (10 - jnp.abs(cfrac - mfrac) * 10 + 1e-5).astype(jnp.int32),
-        )
         total = total + bra * w_bra
+    if w_spread:
+        total = total + spread * w_spread
 
     svc = pod["svc"]
-    if w_spread or ls.aa_weights:
+    if ls.aa_weights:
         counts = jax.lax.dynamic_index_in_dim(
             nodes["svc_counts"], jnp.maximum(svc, 0), axis=1, keepdims=False
         ).astype(jnp.int32)
-
-    if w_spread:
-        # ServiceSpreading (spreading.go:38-87) in exact integer math
-        # (counts are small integers): 10*(maxc-count) // maxc. Go truncates
-        # the float32 quotient; integer division agrees except where Go's
-        # f32 rounding lands exactly on an integer from below — rare and
-        # covered by the >=99% parity budget.
-        maxc = jnp.max(counts)
-        spread_raw = (10 * (maxc - counts)) // jnp.maximum(maxc, 1)
-        spread = jnp.where((svc < 0) | (maxc == 0), 10, spread_raw)
-        total = total + spread * w_spread
 
     if ls.static_prio:
         # CalculateNodeLabelPriority: pod-independent, weights folded
@@ -341,6 +386,43 @@ def solve_with_state(
 
         return solve_with_state_pallas(pods, nodes, weights)
     return _solve_with_state_xla(pods, nodes, weights, lspec)
+
+
+# -- explain readback --------------------------------------------------
+
+
+def _explain_row(pod: Dict, nodes: Dict, N: int):
+    """One pod's per-node verdict against a FIXED occupancy state:
+    packed predicate-failure bits (bit i = matrices.EXPLAIN_PREDICATES
+    [i] REJECTED the node) plus the default priority components. Built
+    from the same _pred_* / _component_scores the solver decides with."""
+    preds = (
+        nodes["sched"],
+        _pred_resources(pod, nodes),
+        _pred_selector(pod, nodes),
+        _pred_ports(pod, nodes),
+        _pred_disk(pod, nodes),
+        _pred_hostname(pod, N),
+    )
+    bits = jnp.zeros(N, jnp.uint32)
+    for i, ok in enumerate(preds):
+        bits = bits | ((~ok).astype(jnp.uint32) << i)
+    lr, bra, spread = _component_scores(pod, nodes)
+    return bits, lr, bra, spread
+
+
+@jax.jit
+def explain_rows(pods: Dict[str, jnp.ndarray], nodes: Dict[str, jnp.ndarray]):
+    """The explain readback: default-pipeline verdicts for a batch of
+    pods, vmapped — (bits u32[P, N], lr i32[P, N], bra, spread). The
+    occupancy state `nodes` is FIXED (no commits): callers choose
+    which state — pre-solve for "why did this pod win", post-solve for
+    "why is this pod still stuck" — and strip padding themselves
+    (ops.pipeline.explain_matrix does both). Off the solve hot path by
+    construction: one dispatch per tick, over arrays the tick already
+    staged."""
+    N = nodes["cpu_cap"].shape[0]
+    return jax.vmap(lambda p: _explain_row(p, nodes, N))(pods)
 
 
 def solve_assignments(

@@ -58,7 +58,7 @@ def strip_assignments(dsnap, out):
 def wave_assignments(dsnap, **kw):
     """Run the wave solver and strip padding: returns (i32[n_pods]
     with -1 = unschedulable, wave count)."""
-    from kubernetes_tpu.utils import tracing
+    from kubernetes_tpu.utils import flightrecorder, tracing
 
     # The per-wave loop itself is jitted (one device program), so the
     # span carries the wave count as the device-side breakdown; the
@@ -68,6 +68,7 @@ def wave_assignments(dsnap, **kw):
         stripped = strip_assignments(dsnap, out)
         waves = int(waves)
         sp.note(waves=waves)
+    flightrecorder.observe_solve_telemetry("wave", waves)
     return stripped, waves
 
 FMAX = jnp.float32(3.4e38)
@@ -225,10 +226,13 @@ def _tie_hash(idx: jnp.ndarray, N: int) -> jnp.ndarray:
 
 def _argmax_choose(masked, idx, valid, carry, N):
     """Plain wave choice: per-pod argmax with hashed tie-break packed
-    into the low bits (scores are small ints, so << 16 is lossless)."""
+    into the low bits (scores are small ints, so << 16 is lossless).
+    The zero telemetry scalars satisfy the shared choose contract
+    (Sinkhorn's priced choice reports real ones)."""
     h = _tie_hash(idx, N)
     combined = (masked << 16) | h.astype(jnp.int32)
-    return jnp.argmax(combined, axis=1).astype(jnp.int32)
+    choice = jnp.argmax(combined, axis=1).astype(jnp.int32)
+    return choice, jnp.int32(0), jnp.float32(0.0)
 
 
 def run_windowed(
@@ -238,15 +242,19 @@ def run_windowed(
     window: int,
     per_node_limit: int,
     choose,
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray,
+           jnp.ndarray]:
     """The shared windowed-commit loop (trace-time function — callers
     jit it). Returns (assignment, post-commit occupancy carry, wave
-    count). `choose(masked, idx, valid, carry, N) -> i32[W]` picks
-    each window pod's candidate node; everything else — windowing,
-    capacity-aware packing, bulk commit, finalization — is common to
-    every wave-family solver (plain argmax, Sinkhorn-priced, ...), so
-    invariants live exactly once. Every wave finalizes at least one
-    pod, so the loop terminates."""
+    count, total choose iterations, last wave's residual).
+    `choose(masked, idx, valid, carry, N) -> (i32[W], i32, f32)` picks
+    each window pod's candidate node and reports its convergence
+    telemetry (iterations executed, residual — zeros for the plain
+    argmax); everything else — windowing, capacity-aware packing, bulk
+    commit, finalization — is common to every wave-family solver
+    (plain argmax, Sinkhorn-priced, ...), so invariants live exactly
+    once. Every wave finalizes at least one pod, so the loop
+    terminates."""
     P = pods["cpu"].shape[0]
     N = nodes["cpu_cap"].shape[0]
     W = min(window, P)
@@ -256,18 +264,18 @@ def run_windowed(
     assignment0 = jnp.where(pods["pinned"] == -2, -1, assignment0)
 
     def cond(state):
-        assignment, _, waves = state
+        assignment, _, waves, _, _ = state
         return jnp.any(assignment == UNDECIDED) & (waves < P)
 
     def body(state):
-        assignment, carry, waves = state
+        assignment, carry, waves, titers, _ = state
         undecided = assignment == UNDECIDED
         idx = jnp.nonzero(undecided, size=W, fill_value=P)[0].astype(jnp.int32)
         valid = idx < P
         wpods = _window_rows(pods, idx)
         feas, score = _batched_eval(wpods, carry, weights, N)
         masked = jnp.where(feas, score, -1)
-        best = choose(masked, idx, valid, carry, N)
+        best, c_iters, c_residual = choose(masked, idx, valid, carry, N)
         feasible = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] >= 0
         choice = jnp.where(valid & feasible, best, -1)
 
@@ -299,16 +307,18 @@ def run_windowed(
             jnp.where(newly_unschedulable, -1, UNDECIDED),
         )
         assignment = assignment.at[idx].set(value, mode="drop")
-        return assignment, carry, waves + 1
+        return assignment, carry, waves + 1, titers + c_iters, c_residual
 
-    assignment, carry, waves = jax.lax.while_loop(
-        cond, body, (assignment0, dict(nodes), jnp.int32(0))
+    assignment, carry, waves, titers, residual = jax.lax.while_loop(
+        cond, body,
+        (assignment0, dict(nodes), jnp.int32(0), jnp.int32(0),
+         jnp.float32(0.0)),
     )
     # Safety valve: the wave cap (P) cannot be hit given the
     # first-undecided-pod-always-finalizes invariant, but an UNDECIDED
     # sentinel must never leak to callers.
     assignment = jnp.where(assignment == UNDECIDED, -1, assignment)
-    return assignment, carry, waves
+    return assignment, carry, waves, titers, residual
 
 
 @functools.partial(
@@ -322,7 +332,7 @@ def solve_waves(
     per_node_limit: int = 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(assignment i32[P] with -1 = unschedulable, wave count)."""
-    assignment, _, waves = run_windowed(
+    assignment, _, waves, _, _ = run_windowed(
         pods, nodes, weights, window, per_node_limit, _argmax_choose
     )
     return assignment, waves
@@ -343,6 +353,7 @@ def solve_waves_with_state(
     """Like solve_waves, but also returns the post-commit occupancy
     carry; `nodes` is DONATED — the incremental-churn substrate, same
     contract as solver.solve_with_state."""
-    return run_windowed(
+    assignment, carry, waves, _, _ = run_windowed(
         pods, nodes, weights, window, per_node_limit, _argmax_choose
     )
+    return assignment, carry, waves

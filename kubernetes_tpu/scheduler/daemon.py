@@ -35,7 +35,7 @@ from kubernetes_tpu.scheduler.plugins import (
 _LOG = logging.getLogger("kubernetes_tpu.scheduler")
 from kubernetes_tpu.scheduler.types import StaticNodeLister, StaticServiceLister
 from kubernetes_tpu.server.api import APIError
-from kubernetes_tpu.utils import metrics, tracing
+from kubernetes_tpu.utils import flightrecorder, metrics, tracing
 from kubernetes_tpu.utils.ratelimit import Backoff, TokenBucket
 
 # Histograms (were summaries): bucketed latencies aggregate across
@@ -704,9 +704,25 @@ class BatchScheduler(Scheduler):
             if dec is None:
                 # Grants the gang guard nulled are accounted by their
                 # group's gang_partial above, not double-counted as
-                # per-pod infeasibility.
+                # per-pod infeasibility. Either way the flight
+                # recorder's decision for the pod gains the preemption
+                # verdict (the explain surface's rejection reason).
                 if pre_guard is None:
+                    from kubernetes_tpu.ops.preemption import (
+                        REASON_INFEASIBLE,
+                    )
+
                     _PREEMPT_OUTCOMES.inc(outcome="infeasible")
+                    flightrecorder.DEFAULT.record_preemption(
+                        self._pod_key(pod), "preempt_infeasible",
+                        reason=REASON_INFEASIBLE,
+                    )
+                else:
+                    flightrecorder.DEFAULT.record_preemption(
+                        self._pod_key(pod), "preempt_gang_partial",
+                        reason="pod group preemption dropped: not every "
+                        "unbound member could be granted a nomination",
+                    )
                 continue
             ns = pod.metadata.namespace or "default"
             key = self._pod_key(pod)
@@ -743,6 +759,11 @@ class BatchScheduler(Scheduler):
                 # just freeze the preemptor out of re-solving for the
                 # whole grace+slack window. Retry next tick.
                 _PREEMPT_OUTCOMES.inc(outcome="evict_failed")
+                flightrecorder.DEFAULT.record_preemption(
+                    key, "preempt_evict_failed", node=dec.node,
+                    victims=dec.victims,
+                    reason="every victim eviction failed; retrying",
+                )
                 continue
             try:
                 # Publish the reservation so operators (and HA peers)
@@ -758,6 +779,10 @@ class BatchScheduler(Scheduler):
                     exc_info=True,
                 )
             _PREEMPT_OUTCOMES.inc(outcome="nominated")
+            flightrecorder.DEFAULT.record_preemption(
+                key, "preempt_nominated", node=dec.node,
+                victims=dec.victims,
+            )
             self._nominations[key] = (
                 dec.node, pod_priority(pod),
                 now + self.eviction_grace_seconds + NOMINATION_SLACK_SECONDS,
@@ -768,6 +793,131 @@ class BatchScheduler(Scheduler):
             granted += 1
         _PREEMPT_NOMINATED.set(len(self._nominations))
         return granted
+
+    # -- flight recorder ----------------------------------------------
+
+    def _record_decisions(
+        self, rows, nodes, services, assigned_pre, solve_s=0.0, stats=None
+    ) -> None:
+        """Feed the scheduling flight recorder: one SolveRecord for the
+        tick plus one Decision per drained pod (outcome + chosen node),
+        with bounded per-node explain verdicts captured in their OWN
+        phase — never inside the solve path (the phase=solve p99 gate
+        bench.py publishes must not move). rows are (pod, dest,
+        outcome, gang_key); assigned_pre is the pre-solve occupancy
+        (None = derive it from the post-solve lister by subtracting
+        this tick's binds — the incremental daemon's shape)."""
+        if not rows:
+            return
+        # Wave/sinkhorn batch solves return placements only; their
+        # convergence figures were parked by observe_solve_telemetry —
+        # consume them (once) so this tick's SolveRecord carries them.
+        # The incremental daemon passes explicit stats (session
+        # last_stats); the pop still runs so a later tick can never
+        # inherit this solve's numbers.
+        tele = flightrecorder.take_last_solve_telemetry()
+        if not stats and tele is not None and tele["mode"] == self.mode:
+            stats = {"waves": tele["waves"]}
+            if self.mode == "sinkhorn":
+                stats["sinkhorn_iters"] = tele["iterations"]
+                stats["sinkhorn_residual"] = tele["residual"]
+        stats = stats or {}
+        tick = flightrecorder.DEFAULT.next_tick()
+        trace_id = tracing.current_trace_id()
+        flightrecorder.DEFAULT.record_solve(
+            flightrecorder.SolveRecord(
+                tick=tick, trace_id=trace_id, mode=self.mode,
+                pods=len(rows), duration_s=solve_s,
+                waves=int(stats.get("waves", 0)),
+                sinkhorn_iterations=int(stats.get("sinkhorn_iters", 0)),
+                sinkhorn_residual=stats.get("sinkhorn_residual"),
+                incremental=bool(stats.get("incremental", False)),
+            )
+        )
+        decisions: Dict[str, flightrecorder.Decision] = {}
+        for pod, dest, outcome, gkey in rows:
+            key = self._pod_key(pod)
+            decisions[key] = flightrecorder.Decision(
+                pod=key, tick=tick, trace_id=trace_id, mode=self.mode,
+                outcome=outcome, node=dest or "", group=gkey or "",
+            )
+        limit = flightrecorder.explain_limit()
+        # Non-default policies have no device explain lowering (the
+        # readback evaluates the default pipeline), and sidecar daemons
+        # keep the control plane off the local accelerator; outcome
+        # records still land, verdict tables are skipped.
+        if limit > 0 and self.spec is None and self.sidecar is None:
+            try:
+                with tracing.phase(
+                    "explain", pods=min(len(rows), limit)
+                ):
+                    self._attach_verdicts(
+                        rows, decisions, nodes, services, assigned_pre,
+                        limit,
+                    )
+            except Exception:
+                _LOG.debug(
+                    "explain readback failed for tick %d", tick,
+                    exc_info=True,
+                )
+        flightrecorder.DEFAULT.record(decisions.values())
+
+    def _attach_verdicts(
+        self, rows, decisions, nodes, services, assigned_pre, limit
+    ) -> None:
+        """Per-node verdicts from the device explain readback. Unbound
+        pods are explained against the POST-solve occupancy (why they
+        are stuck NOW, including this tick's own placements — since
+        occupancy only grows, a pod the scan left behind has a failing
+        predicate on every node in that state); bound pods against the
+        PRE-solve state (the view they won under). Unbound pods get
+        first claim on the budget — they are what operators explain."""
+        import copy
+
+        from kubernetes_tpu.models.objects import pod_full_key
+        from kubernetes_tpu.ops.pipeline import explain_backlog
+
+        unbound = [pod for pod, dest, _, _ in rows if dest is None][:limit]
+        budget = limit - len(unbound)
+        bound = []
+        for pod, dest, _, _ in rows:
+            if dest is None or budget <= 0:
+                continue
+            # Bound this tick (spec.node_name may already carry the
+            # assumed binding): explain the pre-bind view, or the
+            # HostName predicate would pin the verdict to the answer.
+            ep = copy.deepcopy(pod)
+            ep.spec.node_name = ""
+            bound.append(ep)
+            budget -= 1
+        post = self.config.pod_lister.list()
+        if assigned_pre is None:
+            bound_keys = {
+                self._pod_key(pod)
+                for pod, dest, outcome, _ in rows
+                if dest is not None and outcome == "bound"
+            }
+            assigned_pre = [
+                q for q in post if pod_full_key(q) not in bound_keys
+            ]
+        top_k = flightrecorder.explain_top_k()
+        max_failed = flightrecorder.explain_failed_nodes()
+        if bound:
+            for entry in explain_backlog(
+                bound, nodes, assigned_pre, services,
+                top_k=top_k, max_failed=max_failed,
+            ):
+                d = decisions.get(entry["pod"])
+                if d is not None:
+                    d.attach(entry)
+        if unbound:
+            for entry in explain_backlog(
+                unbound, nodes, post, services,
+                top_k=top_k, max_failed=max_failed,
+            ):
+                d = decisions.get(entry["pod"])
+                if d is not None:
+                    d.attach(entry)
 
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
         """One drain+solve+commit cycle; returns pods processed."""
@@ -863,7 +1013,8 @@ class BatchScheduler(Scheduler):
         try:
             t0 = time.monotonic()
             destinations, denied = run(solver, self._gang_counts_fn())
-            _ALGO_LATENCY.observe(time.monotonic() - t0)
+            solve_s = time.monotonic() - t0
+            _ALGO_LATENCY.observe(solve_s)
         except Exception:
             # Device path unavailable: scalar fallback with the
             # CONFIGURED plugin set — and the HOST acceptance reducer
@@ -879,6 +1030,7 @@ class BatchScheduler(Scheduler):
             except Exception:
                 self._requeue_many(pending)
                 return len(pending)
+            solve_s = time.monotonic() - t0
 
         denied_at: Dict[int, str] = {
             i: g.key for g in denied for i in g.indices
@@ -933,6 +1085,7 @@ class BatchScheduler(Scheduler):
         if by_ns or group_binds:
             _BIND_LATENCY.observe(time.monotonic() - t0)
 
+        bind_outcome: Dict[str, str] = {}
         for pod, dest in placed:
             ns = pod.metadata.namespace or "default"
             res = outcome.get((ns, pod.metadata.name), {})
@@ -941,6 +1094,7 @@ class BatchScheduler(Scheduler):
                 cfg.modeler.assume_pod(pod)
                 self._nominations.pop(f"{ns}/{pod.metadata.name}", None)
                 _SCHEDULED.inc(result="scheduled")
+                bind_outcome[self._pod_key(pod)] = "bound"
                 cfg.client.record_event(
                     pod, "Scheduled",
                     f"Successfully assigned {pod.metadata.name} to {dest}",
@@ -948,9 +1102,24 @@ class BatchScheduler(Scheduler):
                 )
             elif not self._bind_retryable(res):
                 _SCHEDULED.inc(result="bind_conflict")  # raced; pod is bound
+                bind_outcome[self._pod_key(pod)] = "bind_conflict"
             else:
                 _SCHEDULED.inc(result="bind_error")
+                bind_outcome[self._pod_key(pod)] = "bind_error"
                 rejected.append(pod)
+        # Flight recorder: the tick's decisions (and their bounded
+        # explain verdicts) land before the preemption pass so it can
+        # amend the unbound pods' records with preemption verdicts.
+        rows = []
+        for i, (pod, dest) in enumerate(zip(pending, destinations)):
+            if dest is None:
+                oc = "gang_rejected" if i in denied_at else "unschedulable"
+            else:
+                oc = bind_outcome.get(self._pod_key(pod), "bind_error")
+            rows.append((pod, dest, oc, gkey_at.get(i)))
+        self._record_decisions(
+            rows, nodes, services, assigned, solve_s=solve_s
+        )
         # Preemption: pods the solve could not place anywhere may evict
         # lower-priority pods and hold a nomination while the victims'
         # grace drains; they bind through the ordinary solve on retry.
@@ -1168,7 +1337,8 @@ class IncrementalBatchScheduler(BatchScheduler):
             else:
                 results = self._session.solve()
                 denied_keys = set()
-            _ALGO_LATENCY.observe(time.monotonic() - t0)
+            solve_s = time.monotonic() - t0
+            _ALGO_LATENCY.observe(solve_s)
         except Exception:
             # RebuildRequired, device error, anything: invalidate and
             # fall back to the parent's full-relower tick (which itself
@@ -1233,6 +1403,7 @@ class IncrementalBatchScheduler(BatchScheduler):
         if by_ns or group_binds:
             _BIND_LATENCY.observe(time.monotonic() - t0)
 
+        bind_outcome: Dict[str, str] = {}
         for pod, dest in placed:
             ns = pod.metadata.namespace or "default"
             key = f"{ns}/{pod.metadata.name}"
@@ -1242,6 +1413,7 @@ class IncrementalBatchScheduler(BatchScheduler):
                 cfg.modeler.assume_pod(pod)
                 self._nominations.pop(key, None)
                 _SCHEDULED.inc(result="scheduled")
+                bind_outcome[key] = "bound"
                 cfg.client.record_event(
                     pod, "Scheduled",
                     f"Successfully assigned {pod.metadata.name} to {dest}",
@@ -1253,12 +1425,38 @@ class IncrementalBatchScheduler(BatchScheduler):
                 # the scheduled-pods watch and re-charges the right row.
                 self._session.delete_assigned(key)
                 _SCHEDULED.inc(result="bind_conflict")
+                bind_outcome[key] = "bind_conflict"
             else:
                 # Bind error OR the gang's atomic batch rolled back
                 # (409 Aborted): release the session charge and retry.
                 self._session.delete_assigned(key)
                 _SCHEDULED.inc(result="bind_error")
+                bind_outcome[key] = "bind_error"
                 rejected.append(pod)
+        # Flight recorder: this tick's decisions + convergence stats
+        # (pre-solve occupancy is derived inside — the raw scheduled
+        # cache only decodes when verdict capture is on). Runs before
+        # the preemption pass so it can amend the unbound records.
+        rows = []
+        for key, dest in results:
+            pod = by_key.get(key)
+            if pod is None:
+                continue
+            if dest is None:
+                oc = (
+                    "gang_rejected"
+                    if gkey_of.get(key) in denied_keys
+                    else "unschedulable"
+                )
+            else:
+                oc = bind_outcome.get(key, "bind_error")
+            rows.append((pod, dest, oc, gkey_of.get(key)))
+        stats = dict(getattr(self._session, "last_stats", {}) or {})
+        stats["incremental"] = True
+        self._record_decisions(
+            rows, cfg.nodes.store.list(), cfg.service_lister.list(),
+            None, solve_s=solve_s, stats=stats,
+        )
         # Preemption over this tick's unplaceable pods — same pass as
         # the parent daemon; the session is not consulted (victims are
         # selected from the watch caches, and their exits flow back in

@@ -191,21 +191,44 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_text(200, _UI_PAGE, "text/html; charset=utf-8")
 
     def _serve_debug(self, rest: Tuple[str, ...]) -> None:
-        from kubernetes_tpu.utils import debug
+        from kubernetes_tpu.utils import debug, flightrecorder
+
+        def _limit() -> int:
+            try:
+                return int(self.query.get("limit", "64"))
+            except ValueError:
+                raise APIError(400, "BadRequest", "limit must be numeric")
 
         if rest == ("traces",):
             # Recent scheduling traces (this process's buffer — the
             # in-process cluster topology shares one buffer across all
             # daemons), filterable to traces touching one pod.
-            try:
-                limit = int(self.query.get("limit", "64"))
-            except ValueError:
-                raise APIError(400, "BadRequest", "limit must be numeric")
             self._send_text(
                 200,
                 tracing.render_json(
-                    pod=self.query.get("pod", ""), limit=limit
+                    pod=self.query.get("pod", ""), limit=_limit()
                 ),
+                "application/json",
+            )
+            return
+        if rest == ("decisions",):
+            # The scheduling flight recorder: per-pod decisions with
+            # explain verdicts (ktctl explain's data source), joined
+            # with /debug/traces by traceId.
+            self._send_text(
+                200,
+                flightrecorder.render_decisions_json(
+                    pod=self.query.get("pod", ""), limit=_limit()
+                ),
+                "application/json",
+            )
+            return
+        if rest == ("solves",):
+            # Per-tick solve records: mode, duration, wave/Sinkhorn
+            # convergence telemetry, traceId.
+            self._send_text(
+                200,
+                flightrecorder.render_solves_json(limit=_limit()),
                 "application/json",
             )
             return
@@ -223,9 +246,67 @@ class _Handler(BaseHTTPRequestHandler):
             raise APIError(
                 404, "NotFound",
                 "debug endpoints: /debug/requests /debug/stacks "
-                "/debug/profile /debug/traces",
+                "/debug/profile /debug/traces /debug/decisions "
+                "/debug/solves",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
+
+    def _serve_healthz(self) -> None:
+        """/healthz with JSON subchecks (kvstore, watch hub, flight
+        recorder), 200 only when every check passes — the reference's
+        bare "ok" told an operator nothing about WHICH dependency was
+        sick. Stays ahead of the auth chain like the plain probe did
+        (load balancers and kubelets probe unauthenticated)."""
+        from kubernetes_tpu.utils import flightrecorder
+
+        checks = {}
+        try:
+            store = self.api.store
+            if store.closed:
+                checks["kvstore"] = {
+                    "status": "unhealthy", "message": "store closed",
+                }
+            else:
+                checks["kvstore"] = {
+                    "status": "ok", "resourceVersion": store.version,
+                }
+        except Exception as e:
+            checks["kvstore"] = {"status": "unhealthy", "message": str(e)}
+        try:
+            alive = self.api.store.dispatcher_alive()
+            checks["watchHub"] = (
+                {"status": "ok"}
+                if alive
+                else {
+                    "status": "unhealthy",
+                    "message": "watch dispatcher thread dead",
+                }
+            )
+        except Exception as e:
+            checks["watchHub"] = {"status": "unhealthy", "message": str(e)}
+        try:
+            size, cap = flightrecorder.DEFAULT.ring_stats()
+            checks["flightRecorder"] = (
+                {"status": "ok", "decisions": size, "capacity": cap}
+                if size <= cap
+                else {
+                    "status": "unhealthy",
+                    "message": f"ring overflow: {size} > {cap}",
+                }
+            )
+        except Exception as e:
+            checks["flightRecorder"] = {
+                "status": "unhealthy", "message": str(e),
+            }
+        healthy = all(c.get("status") == "ok" for c in checks.values())
+        self._send_json(
+            200 if healthy else 503,
+            {
+                "kind": "Health",
+                "status": "ok" if healthy else "unhealthy",
+                "checks": checks,
+            },
+        )
 
     def _route(self) -> Tuple[str, ...]:
         parsed = urlparse(self.path)
@@ -274,7 +355,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             parts = self._route()
             if parts == ("healthz",):
-                self._send_text(200, b"ok")
+                self._serve_healthz()
                 return
             if parts == ("metrics",):
                 self._send_text(

@@ -492,6 +492,20 @@ class KVStore:
     # -- version plumbing ---------------------------------------------
 
     @property
+    def closed(self) -> bool:
+        """Whether close() ran — the healthz kvstore subcheck (a closed
+        store still answers reads from memory, so liveness must be
+        asked, not probed)."""
+        return self._closed
+
+    def dispatcher_alive(self) -> bool:
+        """Liveness of the watch fan-out thread (healthz watch-hub
+        subcheck): a dead dispatcher freezes every watcher — scheduler,
+        kubelets, controllers — while writes still succeed, which is
+        exactly the failure a plain write probe cannot see."""
+        return self._dispatcher.is_alive()
+
+    @property
     def version(self) -> int:
         with self._lock:
             return self._version

@@ -1,0 +1,429 @@
+"""Scheduling flight recorder: a bounded per-decision ring.
+
+The batched solvers already materialize the dense pod x node
+feasibility mask and score matrix on device — this module is where the
+readback of those arrays lands as auditable records. Each batch-daemon
+tick appends one ``Decision`` per drained pod (outcome, chosen node,
+and — for a bounded subset — per-node predicate verdicts plus the
+winner's score decomposition) and one ``SolveRecord`` (mode, duration,
+wave/Sinkhorn convergence telemetry), both carrying the tick's trace
+id so ``/debug/decisions`` and ``/debug/solves`` join against
+``/debug/traces``.
+
+Bounds: the decision ring holds at most ``_CONFIG["ring"]`` entries
+(default 4096, newest win) and per-node verdicts are captured for at
+most ``explain_limit`` pods per tick with ``explain_top_k`` feasible
+candidates each — a 50k-pod drain records 50k outcomes but never 50k
+verdict tables. Everything here is host-side bookkeeping off the jit
+hot path; the device readback itself lives in ops (solver.explain_rows
+/ pipeline.explain_backlog).
+
+Reference lineage: the per-predicate failure reasons kubernetes
+surfaced through FailedScheduling events (generic_scheduler.go
+FitError.Error), upgraded from a flattened string to queryable
+records.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kubernetes_tpu.utils import metrics
+
+#: Decision outcome EVENTS recorded, by outcome: one per drained pod
+#: per tick (bound / unschedulable / bind_error / bind_conflict /
+#: gang_rejected) PLUS one per preemption verdict (preempt_*) — a pod
+#: the solve left unbound and the preemption pass then nominated
+#: counts once under each, mirroring preemption_solve_outcomes_total.
+#: The sum over outcomes therefore exceeds the ring's entry count; it
+#: is an event counter, not a ring gauge.
+DECISIONS_TOTAL = metrics.DEFAULT.counter(
+    "scheduler_decisions_total",
+    "Decision outcome events recorded by the flight recorder (solve "
+    "outcomes plus preemption verdicts), by outcome",
+    ("outcome",),
+)
+
+#: Final Sinkhorn column-mass residual (log domain) of the most recent
+#: sinkhorn solve: 0 = every node's demand fit its capacity when the
+#: price loop stopped. ktlint KT005: unit-less by nature (allowlisted).
+SINKHORN_RESIDUAL = metrics.DEFAULT.gauge(
+    "scheduler_sinkhorn_residual",
+    "Final Sinkhorn column-mass residual (log domain) of the latest solve",
+)
+
+#: Device solve iterations per solve, by mode: waves for the wave
+#: family, total Sinkhorn price iterations for sinkhorn. Buckets are
+#: powers of two — iteration counts, not seconds.
+SOLVE_ITERATIONS = metrics.DEFAULT.histogram(
+    "scheduler_solve_iterations",
+    "Device solve iterations per solve (waves / Sinkhorn price updates)",
+    ("mode",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+)
+
+
+_LAST_SOLVE_LOCK = threading.Lock()
+_LAST_SOLVE: Optional[dict] = None
+
+
+def observe_solve_telemetry(
+    mode: str,
+    iterations: int,
+    residual: Optional[float] = None,
+    waves: Optional[int] = None,
+) -> None:
+    """One solve's convergence telemetry: iteration histogram (always)
+    plus the residual gauge (sinkhorn family). Shared by the batch
+    wrappers, the pipelined path, and the incremental session so the
+    series never depend on which path ran. The figures are also parked
+    for take_last_solve_telemetry() so the daemon that just ran the
+    solve can stamp them onto its SolveRecord (the wave/sinkhorn batch
+    wrappers return placements only)."""
+    global _LAST_SOLVE
+    SOLVE_ITERATIONS.observe(float(iterations), mode=mode)
+    if residual is not None:
+        SINKHORN_RESIDUAL.set(float(residual))
+    with _LAST_SOLVE_LOCK:
+        _LAST_SOLVE = {
+            "mode": mode,
+            "iterations": int(iterations),
+            "waves": int(waves if waves is not None else iterations),
+            "residual": None if residual is None else float(residual),
+        }
+
+
+def take_last_solve_telemetry() -> Optional[dict]:
+    """Pop the most recent solve's telemetry (None when nothing is
+    parked). Consume-once: each solve's figures stamp at most one
+    SolveRecord, so a later tick can never inherit stale numbers."""
+    global _LAST_SOLVE
+    with _LAST_SOLVE_LOCK:
+        tele, _LAST_SOLVE = _LAST_SOLVE, None
+        return tele
+
+
+_CONFIG = {
+    # Decision ring bound (newest win). 4096 decisions with bounded
+    # verdicts is a few MB — sized so a burst drain can't evict the
+    # whole recent history before an operator looks.
+    "ring": 4096,
+    # Solve-record ring bound (one entry per tick, much smaller rows).
+    "solve_ring": 512,
+    # Per-pod verdict caps: feasible candidates kept with full score
+    # decomposition / infeasible nodes listed individually (the rest
+    # fold into reasonCounts).
+    "explain_top_k": 3,
+    "explain_failed_nodes": 16,
+    # Pods per tick that get per-node verdicts (0 disables verdict
+    # capture; outcome records always land).
+    "explain_limit": 64,
+}
+
+
+def configure(
+    ring: Optional[int] = None,
+    solve_ring: Optional[int] = None,
+    explain_top_k: Optional[int] = None,
+    explain_failed_nodes: Optional[int] = None,
+    explain_limit: Optional[int] = None,
+) -> None:
+    if ring is not None:
+        _CONFIG["ring"] = int(ring)
+    if solve_ring is not None:
+        _CONFIG["solve_ring"] = int(solve_ring)
+    if explain_top_k is not None:
+        _CONFIG["explain_top_k"] = int(explain_top_k)
+    if explain_failed_nodes is not None:
+        _CONFIG["explain_failed_nodes"] = int(explain_failed_nodes)
+    if explain_limit is not None:
+        _CONFIG["explain_limit"] = int(explain_limit)
+
+
+def explain_top_k() -> int:
+    return _CONFIG["explain_top_k"]
+
+
+def explain_failed_nodes() -> int:
+    return _CONFIG["explain_failed_nodes"]
+
+
+def explain_limit() -> int:
+    return _CONFIG["explain_limit"]
+
+
+def _wall_stamp() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time()))
+
+
+@dataclass
+class Decision:
+    """One pod's scheduling decision in one tick."""
+
+    pod: str  # "namespace/name"
+    tick: int
+    trace_id: str
+    mode: str
+    outcome: str
+    node: str = ""  # chosen node ("" when unschedulable)
+    group: str = ""  # PodGroup key when gang-scheduled
+    # Explain verdicts (populated for at most explain_limit pods/tick):
+    # top-k feasible candidates with score decomposition + individually
+    # listed infeasible nodes; the remainder aggregate in reason_counts.
+    verdicts: List[dict] = field(default_factory=list)
+    reason_counts: Dict[str, int] = field(default_factory=dict)
+    feasible_nodes: int = -1  # -1 = verdicts not captured
+    total_nodes: int = 0
+    # Preemption verdict (amended by the preemption pass).
+    nominated_node: str = ""
+    victims: Tuple[str, ...] = ()
+    reason: str = ""
+    time: str = field(default_factory=_wall_stamp)
+
+    def attach(self, entry: dict) -> None:
+        """Fold one ops.pipeline.explain_backlog entry into this
+        decision (the per-node verdict table)."""
+        self.feasible_nodes = int(entry.get("feasibleNodes", 0))
+        self.total_nodes = int(entry.get("totalNodes", 0))
+        self.verdicts = list(entry.get("nodes", ()))
+        self.reason_counts = dict(entry.get("reasonCounts", {}))
+
+    def to_dict(self) -> dict:
+        d = {
+            "pod": self.pod,
+            "tick": self.tick,
+            "traceId": self.trace_id,
+            "mode": self.mode,
+            "outcome": self.outcome,
+            "time": self.time,
+        }
+        if self.node:
+            d["node"] = self.node
+        if self.group:
+            d["group"] = self.group
+        if self.feasible_nodes >= 0:
+            d["feasibleNodes"] = self.feasible_nodes
+            d["totalNodes"] = self.total_nodes
+            d["nodes"] = self.verdicts
+            d["reasonCounts"] = self.reason_counts
+        if self.nominated_node:
+            d["nominatedNode"] = self.nominated_node
+            d["victims"] = list(self.victims)
+        if self.reason:
+            d["reason"] = self.reason
+        return d
+
+
+@dataclass
+class SolveRecord:
+    """One batch tick's solve, with convergence telemetry."""
+
+    tick: int
+    trace_id: str
+    mode: str
+    pods: int
+    duration_s: float
+    waves: int = 0
+    sinkhorn_iterations: int = 0
+    sinkhorn_residual: Optional[float] = None
+    incremental: bool = False
+    time: str = field(default_factory=_wall_stamp)
+
+    def to_dict(self) -> dict:
+        d = {
+            "tick": self.tick,
+            "traceId": self.trace_id,
+            "mode": self.mode,
+            "pods": self.pods,
+            "duration_s": round(self.duration_s, 6),
+            "time": self.time,
+        }
+        if self.incremental:
+            d["incremental"] = True
+        if self.waves:
+            d["waves"] = self.waves
+        if self.sinkhorn_iterations:
+            d["sinkhornIterations"] = self.sinkhorn_iterations
+        if self.sinkhorn_residual is not None:
+            d["sinkhornResidual"] = round(self.sinkhorn_residual, 6)
+        return d
+
+
+class FlightRecorder:
+    """Bounded rings of decisions and solve records (newest win)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._decisions: List[Decision] = []
+        self._solves: List[SolveRecord] = []
+        self._tick = 0
+
+    def next_tick(self) -> int:
+        with self._lock:
+            self._tick += 1
+            return self._tick
+
+    def record(self, decisions: Iterable[Decision]) -> None:
+        decisions = list(decisions)
+        with self._lock:
+            self._decisions.extend(decisions)
+            cap = _CONFIG["ring"]
+            if len(self._decisions) > cap:
+                del self._decisions[: len(self._decisions) - cap]
+        for d in decisions:
+            DECISIONS_TOTAL.inc(outcome=d.outcome)
+
+    def record_solve(self, rec: SolveRecord) -> None:
+        with self._lock:
+            self._solves.append(rec)
+            cap = _CONFIG["solve_ring"]
+            if len(self._solves) > cap:
+                del self._solves[: len(self._solves) - cap]
+
+    def record_preemption(
+        self,
+        pod: str,
+        outcome: str,
+        node: str = "",
+        victims: Tuple[str, ...] = (),
+        reason: str = "",
+    ) -> None:
+        """Fold a preemption verdict into the pod's most recent
+        decision (the preemption pass runs right after the tick's
+        decisions land), or append a standalone record when none
+        exists (e.g. the decision already rotated out of the ring)."""
+        with self._lock:
+            amended = False
+            for d in reversed(self._decisions):
+                if d.pod == pod:
+                    d.outcome = outcome
+                    d.nominated_node = node
+                    d.victims = tuple(victims)
+                    d.reason = reason
+                    amended = True
+                    break
+            if not amended:
+                self._decisions.append(
+                    Decision(
+                        pod=pod, tick=self._tick, trace_id="", mode="",
+                        outcome=outcome, nominated_node=node,
+                        victims=tuple(victims), reason=reason,
+                    )
+                )
+                cap = _CONFIG["ring"]
+                if len(self._decisions) > cap:
+                    del self._decisions[: len(self._decisions) - cap]
+        DECISIONS_TOTAL.inc(outcome=outcome)
+
+    def ring_stats(self) -> Tuple[int, int]:
+        """(recorded decisions, configured capacity) — the healthz
+        flight-recorder subcheck."""
+        with self._lock:
+            return len(self._decisions), _CONFIG["ring"]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._decisions.clear()
+            self._solves.clear()
+
+    @staticmethod
+    def _pod_matches(key: str, pod: str) -> bool:
+        """Match a decision's 'ns/name' key against a query that may be
+        the full key or a bare pod name."""
+        return key == pod or ("/" not in pod and key.endswith("/" + pod))
+
+    def decisions(self, pod: str = "", limit: int = 64) -> dict:
+        with self._lock:
+            entries = list(self._decisions)
+        limit = max(0, limit)  # limit=0 means none, not one
+        out = []
+        for d in reversed(entries):  # newest first
+            if len(out) >= limit:
+                break
+            if pod and not self._pod_matches(d.pod, pod):
+                continue
+            out.append(d.to_dict())
+        return {"kind": "DecisionList", "decisions": out}
+
+    def solves(self, limit: int = 64) -> dict:
+        with self._lock:
+            entries = list(self._solves)
+        return {
+            "kind": "SolveList",
+            "solves": [r.to_dict() for r in reversed(entries)][
+                : max(0, limit)
+            ],
+        }
+
+
+DEFAULT = FlightRecorder()
+
+
+def render_decisions_json(pod: str = "", limit: int = 64) -> str:
+    import json
+
+    return json.dumps(DEFAULT.decisions(pod=pod, limit=limit))
+
+
+def render_solves_json(limit: int = 64) -> str:
+    import json
+
+    return json.dumps(DEFAULT.solves(limit=limit))
+
+
+# -- rendering (shared by `ktctl explain` and the check.sh smoke) ------
+
+
+def format_decision(d: dict) -> str:
+    """Render one decision dict as the per-node 'why/why not' table."""
+    head = (
+        f"DECISION {d.get('pod', '')}  tick {d.get('tick', 0)}"
+        f"  mode {d.get('mode', '') or '-'}  outcome {d.get('outcome', '')}"
+    )
+    if d.get("node"):
+        head += f" -> {d['node']}"
+    if d.get("traceId"):
+        head += f"  trace {d['traceId']}"
+    lines = [head]
+    if d.get("group"):
+        lines.append(f"  pod group: {d['group']}")
+    if d.get("nominatedNode"):
+        victims = ", ".join(d.get("victims", ())) or "<none>"
+        lines.append(f"  nominated {d['nominatedNode']} evicting [{victims}]")
+    if d.get("reason"):
+        lines.append(f"  reason: {d['reason']}")
+    nodes = d.get("nodes", ())
+    if "feasibleNodes" in d:
+        lines.append(
+            f"  {d['feasibleNodes']}/{d.get('totalNodes', 0)} nodes feasible"
+        )
+    if nodes:
+        width = max(len(v.get("node", "")) for v in nodes) + 2
+        for v in nodes:
+            if v.get("ok"):
+                comps = v.get("components", {})
+                detail = f"score {v.get('score', 0)}"
+                if comps:
+                    detail += (
+                        " ("
+                        + ", ".join(f"{k} {val}" for k, val in comps.items())
+                        + ")"
+                    )
+                lines.append(
+                    f"  {v.get('node', ''):<{width}}feasible    {detail}"
+                )
+            else:
+                lines.append(
+                    f"  {v.get('node', ''):<{width}}infeasible  "
+                    + ", ".join(v.get("reasons", ()))
+                )
+    counts = d.get("reasonCounts")
+    if counts:
+        lines.append(
+            "  why not: "
+            + ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+        )
+    return "\n".join(lines)

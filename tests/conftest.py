@@ -40,6 +40,12 @@ def pytest_configure(config):
         "preempt: priority & preemption (PriorityClass/eviction) tests; "
         "tier-1 includes them — select just these with -m preempt",
     )
+    config.addinivalue_line(
+        "markers",
+        "explain: scheduling explainability (flight recorder / explain "
+        "readback / ktctl explain) tests; tier-1 includes them — select "
+        "just these with -m explain",
+    )
 
 
 def pytest_addoption(parser):

@@ -299,7 +299,11 @@ def test_healthz_metrics_version():
     server = APIHTTPServer(api).start()
     try:
         base = server.address
-        assert urllib.request.urlopen(base + "/healthz").read() == b"ok"
+        health = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert health["status"] == "ok"
+        assert set(health["checks"]) == {
+            "kvstore", "watchHub", "flightRecorder",
+        }
         v = json.loads(urllib.request.urlopen(base + "/version").read())
         assert v["platform"] == "tpu"
         # Generate one request then check it shows up in metrics.

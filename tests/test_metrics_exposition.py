@@ -236,6 +236,72 @@ def test_lint_metrics_knows_gang_names(tmp_path):
     assert "lacks a unit suffix" in proc.stderr
 
 
+def test_lint_metrics_knows_explain_names(tmp_path):
+    """The explainability/convergence family (utils/flightrecorder.py)
+    is known to the linter: scheduler_decisions_total passes the
+    standard _total rule on its own, the unit-less residual gauge and
+    iterations histogram are explicitly allowlisted, and a novel
+    suffix-less scheduler_* name still fails (the allowlist names
+    metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, EXPLAIN_METRICS
+
+    assert EXPLAIN_METRICS == {
+        "scheduler_decisions_total",
+        "scheduler_sinkhorn_residual",
+        "scheduler_solve_iterations",
+    }
+    assert EXPLAIN_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.counter('
+        '"scheduler_decisions_total", "x", ("outcome",))\n'
+        'B = metrics.DEFAULT.gauge("scheduler_sinkhorn_residual", "x")\n'
+        'C = metrics.DEFAULT.histogram('
+        '"scheduler_solve_iterations", "x", ("mode",))\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("scheduler_explain_lag", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_decision_and_convergence_metrics_exposed():
+    """Exposition golden for the flight-recorder family: the decision
+    counter, the residual gauge, and the iterations histogram all
+    render on metrics.DEFAULT with their declared types (they are
+    registered at flightrecorder import, so a scrape can never miss
+    the family)."""
+    from kubernetes_tpu.utils import flightrecorder as fr
+
+    fr.DECISIONS_TOTAL.inc(outcome="exposition_test")
+    fr.observe_solve_telemetry("exposition_test_mode", 7, residual=0.25)
+    text = metrics.DEFAULT.render()
+    assert "# TYPE scheduler_decisions_total counter" in text
+    assert 'scheduler_decisions_total{outcome="exposition_test"} 1.0' in text
+    assert "# TYPE scheduler_sinkhorn_residual gauge" in text
+    assert "scheduler_sinkhorn_residual 0.25" in text
+    assert "# TYPE scheduler_solve_iterations histogram" in text
+    # 7 iterations lands in the le=8 bucket of the power-of-two ladder.
+    assert (
+        'scheduler_solve_iterations_bucket{mode="exposition_test_mode",'
+        'le="8"} 1' in text
+    )
+    assert (
+        'scheduler_solve_iterations_count{mode="exposition_test_mode"} 1'
+        in text
+    )
+
+
 def test_lint_metrics_knows_preemption_names(tmp_path):
     """The preemption_* family (scheduler/daemon.py) is known to the
     linter: the _total counters pass the standard rule, the unitless

@@ -6,9 +6,12 @@ readiness probe must remove the pod from Endpoints WITHOUT restarting
 it)."""
 
 import http.server
+import json
 import socket
 import threading
 import time
+import urllib.error
+import urllib.request
 
 import pytest
 
@@ -132,6 +135,57 @@ class TestProbeTracker:
         assert not t.in_initial_delay("k", Probe(initial_delay_seconds=0))
         t.note_started("k", time.monotonic() - 120)
         assert not t.in_initial_delay("k", Probe(initial_delay_seconds=60))
+
+
+# ---------------------------------------------------------------------------
+# apiserver /healthz: JSON subchecks with per-check status
+# ---------------------------------------------------------------------------
+
+
+class TestApiserverHealthz:
+    """/healthz upgraded from a bare "ok" to JSON subchecks — kvstore,
+    watch hub, flight-recorder ring — so an operator (or a probe that
+    parses bodies) sees WHICH dependency is sick, not just that one
+    is."""
+
+    def test_healthz_json_subchecks_all_ok(self):
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api = APIServer()
+        srv = APIHTTPServer(api).start()
+        try:
+            with urllib.request.urlopen(
+                srv.address + "/healthz", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+        finally:
+            srv.stop()
+        assert body["kind"] == "Health"
+        assert body["status"] == "ok"
+        checks = body["checks"]
+        assert set(checks) == {"kvstore", "watchHub", "flightRecorder"}
+        for check in checks.values():
+            assert check["status"] == "ok"
+        assert checks["kvstore"]["resourceVersion"] >= 0
+        fr = checks["flightRecorder"]
+        assert 0 <= fr["decisions"] <= fr["capacity"]
+
+    def test_healthz_unhealthy_store_is_503(self):
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api = APIServer()
+        srv = APIHTTPServer(api).start()
+        try:
+            api.store.close()  # degrade: the kvstore subcheck must trip
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                urllib.request.urlopen(srv.address + "/healthz", timeout=10)
+            assert excinfo.value.code == 503
+            body = json.loads(excinfo.value.read())
+        finally:
+            srv.stop()
+        assert body["status"] == "unhealthy"
+        assert body["checks"]["kvstore"]["status"] == "unhealthy"
 
 
 # ---------------------------------------------------------------------------

@@ -501,6 +501,91 @@ class TestPreemptionParity:
             assert fn([hi], [node], [a]) == [None]
 
 
+@pytest.mark.explain
+class TestExplainParity:
+    """Device explain readback vs the NumPy scalar predicate twin:
+    per-node predicate-failure bits AND the component-score
+    decomposition must match 100% (the acceptance bar for the
+    flight-recorder surface) — exercised on raw randomized clusters
+    and on the states the daemons actually explain: bound pods
+    (pre-solve occupancy), infeasible pods (post-solve occupancy), and
+    preemption-nominated pods."""
+
+    @staticmethod
+    def _assert_parity(pending, nodes, assigned=(), services=()):
+        import numpy as np
+
+        from kubernetes_tpu.models.columnar import build_snapshot
+        from kubernetes_tpu.ops.oracle import explain_bits_numpy
+        from kubernetes_tpu.ops.pipeline import explain_matrix
+
+        names, bits, comps = explain_matrix(
+            pending, nodes, assigned, services
+        )
+        snap = build_snapshot(
+            pending, nodes, assigned_pods=assigned, services=services
+        )
+        tbits, tlr, tbra, tspread = explain_bits_numpy(snap)
+        mism = int((bits != tbits).sum())
+        assert mism == 0, f"{mism} predicate-bit mismatches"
+        assert (comps["leastRequested"] == tlr).all()
+        assert (comps["balanced"] == tbra).all()
+        assert (comps["spreading"] == tspread).all()
+        return names, np.asarray(bits)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_cluster_bit_parity(self, seed):
+        pods, nodes, assigned, services = random_cluster(seed)
+        self._assert_parity(pods, nodes, assigned, services)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_bound_and_infeasible_pod_states(self, seed):
+        """The daemon's two explain states: bound pods against the
+        pre-solve occupancy, unbound pods against the post-solve
+        occupancy — where, occupancy only growing, every node must
+        show at least one failing predicate for every unbound pod."""
+        import copy
+
+        pods, nodes, assigned, services = random_cluster(seed)
+        dests = schedule_backlog_tpu(pods, nodes, assigned, services)
+        bound = [p for p, d in zip(pods, dests) if d is not None]
+        unbound = [p for p, d in zip(pods, dests) if d is None]
+        if bound:
+            self._assert_parity(bound, nodes, assigned, services)
+        if unbound:
+            placed = []
+            for p, d in zip(pods, dests):
+                if d is not None:
+                    q = copy.deepcopy(p)
+                    q.spec.node_name = d
+                    placed.append(q)
+            _, bits = self._assert_parity(
+                unbound, nodes, list(assigned) + placed, services
+            )
+            assert (bits != 0).all(), (
+                "an unbound pod showed a feasible node in the "
+                "post-solve state"
+            )
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_preemption_nominated_pods(self, seed):
+        """Preemptors granted a nomination explain with the same 100%
+        bit parity as everyone else (and their verdicts evaluate
+        against the cluster state the victim selection saw)."""
+        from kubernetes_tpu.scheduler.batch import preempt_backlog_tpu
+
+        preemptors, nodes, assigned = (
+            TestPreemptionParity._random_preemption_problem(seed)
+        )
+        decisions = preempt_backlog_tpu(preemptors, nodes, assigned)
+        nominated = [
+            p for p, d in zip(preemptors, decisions) if d is not None
+        ]
+        if not nominated:
+            pytest.skip("no nomination granted for this seed")
+        self._assert_parity(nominated, nodes, assigned)
+
+
 class TestSpreadingParityRegressions:
     """Review findings: overlapping service selectors and terminal-phase
     pods must not diverge from the scalar oracle."""

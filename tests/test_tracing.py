@@ -263,3 +263,20 @@ class TestSchedulerTraces:
         # Unknown pod: clean nonzero exit.
         rc = ktctl.main(["trace", "no-such-pod"], client=client)
         assert rc == 1
+
+
+class TestTraceMissRendering:
+    def test_unknown_pod_exits_nonzero_with_clear_message(self, capsys):
+        """`ktctl trace <pod>` with nothing recorded must exit nonzero
+        with a 'no trace recorded for pod' message on stderr and dump
+        NOTHING on stdout (it used to print an empty tree a script
+        piping the output could mistake for data)."""
+        from kubernetes_tpu.cli import ktctl
+
+        client = Client(LocalTransport(APIServer()))
+        capsys.readouterr()  # drop any prior output
+        rc = ktctl.main(["trace", "ghost-pod"], client=client)
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert captured.out == ""
+        assert 'no trace recorded for pod "ghost-pod"' in captured.err

@@ -86,4 +86,68 @@ smoke_rc=$?
 if [ $rc -eq 0 ]; then
     rc=$smoke_rc  # a broken smoke must fail CI even when tests passed
 fi
+
+# Explain smoke: boot the in-process e2e cluster, schedule one
+# feasible and one infeasible pod through the batch daemon, and assert
+# `ktctl explain` reports the bind (with its score) and a per-predicate
+# "why not" reason — the flight-recorder surface end to end.
+echo "== explain smoke =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import time
+from contextlib import redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.scheduler.daemon import BatchScheduler, SchedulerConfig
+from kubernetes_tpu.server import APIServer
+
+api = APIServer()
+client = Client(LocalTransport(api))
+for j in range(2):
+    client.create("nodes", {
+        "kind": "Node", "metadata": {"name": f"n{j}"},
+        "status": {"capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+def pod(name, selector=None):
+    return {"kind": "Pod", "metadata": {"name": name, "namespace": "default"},
+            "spec": {"nodeSelector": selector or {},
+                     "containers": [{"name": "c", "image": "x",
+                                     "resources": {"limits": {
+                                         "cpu": "100m", "memory": "64Mi"}}}]}}
+
+client.create("pods", pod("ok-pod"))
+client.create("pods", pod("stuck-pod", {"disk": "ssd"}))  # no node matches
+cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+assert cfg.wait_for_sync(timeout=60), "caches never synced"
+sched = BatchScheduler(cfg)
+bound = ""
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline and not bound:
+    sched.schedule_batch(timeout=0.5)
+    bound = client.get("pods", "ok-pod").spec.node_name
+cfg.stop()
+assert bound, "ok-pod never bound"
+
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["explain", "pod", "ok-pod"], client=client)
+text = out.getvalue()
+assert rc == 0, text
+assert "outcome bound" in text and f"-> {bound}" in text, text
+assert "score" in text, text
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["explain", "pod", "stuck-pod"], client=client)
+text = out.getvalue()
+assert rc == 0, text
+assert "MatchNodeSelector" in text, text
+print(f"explain smoke OK: ok-pod bound to {bound}; stuck-pod explained "
+      "with a per-predicate reason")
+EOF
+explain_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$explain_rc
+fi
 exit $rc

@@ -64,6 +64,20 @@ PREEMPTION_METRICS = {
 }
 ALLOWLIST |= PREEMPTION_METRICS
 
+#: Explainability & solver-convergence family (utils/flightrecorder.py,
+#: observed by ops/sinkhorn.py, ops/wave.py, ops/pipeline.py,
+#: ops/incremental.py). scheduler_decisions_total carries _total on its
+#: own; the residual gauge (a log-domain mass excess) and the iteration
+#: histogram (a count of price updates / waves) are unit-less by nature
+#: and allowlisted explicitly so the linter documents the family rather
+#: than silently tolerating it.
+EXPLAIN_METRICS = {
+    "scheduler_decisions_total",
+    "scheduler_sinkhorn_residual",
+    "scheduler_solve_iterations",
+}
+ALLOWLIST |= EXPLAIN_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"
