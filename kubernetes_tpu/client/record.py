@@ -206,6 +206,18 @@ class _SinkHandler:
             for ev in fresh:
                 self._create_one(ev)
             return
+        # Capability probe happens at ATTRIBUTE RESOLUTION, not by
+        # classifying exceptions from inside the call: a genuine
+        # AttributeError raised WITHIN create_events_bulk (a bug in a
+        # custom transport, or the in-process server path — the
+        # LocalTransport executes the API handler on this thread) must
+        # surface as a transient failure, not permanently disable the
+        # bulk path (ADVICE r5).
+        if not hasattr(self.client, "create_events_bulk"):
+            self._bulk_ok = False
+            for ev in fresh:
+                self._create_one(ev)
+            return
         try:
             results = self.client.create_events_bulk(fresh)
             self._bulk_ok = True
@@ -216,12 +228,15 @@ class _SinkHandler:
             # have applied the batch — re-creating there would write
             # duplicates, so DROP instead (events are observability;
             # the reference drops on sink errors too) and leave
-            # _bulk_ok for the next burst to re-probe.
+            # _bulk_ok for the next burst to re-probe. ValueError is a
+            # transport-level "unknown op" probe (Transport.request
+            # raises it for ops it does not model); APIError
+            # 400/404/405 is the server-side probe.
             from kubernetes_tpu.server.api import APIError
 
-            unsupported = isinstance(
-                e, (AttributeError, ValueError, TypeError)
-            ) or (isinstance(e, APIError) and e.code in (400, 404, 405))
+            unsupported = isinstance(e, (ValueError, TypeError)) or (
+                isinstance(e, APIError) and e.code in (400, 404, 405)
+            )
             if unsupported:
                 self._bulk_ok = False
                 for ev in fresh:

@@ -60,12 +60,19 @@ class EndpointsController:
             client, "pods", decode=_decode_pod,
             on_add=mark, on_update=mark, on_delete=mark,
         )
+        # Endpoints cache for orphan GC: the per-sync full LIST of
+        # endpoints was the controller's remaining steady-state read
+        # against the API plane (wire dicts are enough — GC only needs
+        # keys).
+        self.endpoints = Informer(client, "endpoints")
 
     def start(self) -> "EndpointsController":
         self.services.start()
         self.pods.start()
+        self.endpoints.start()
         self.services.wait_for_sync()
         self.pods.wait_for_sync()
+        self.endpoints.wait_for_sync()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -75,6 +82,7 @@ class EndpointsController:
         self._dirty.set()
         self.services.stop()
         self.pods.stop()
+        self.endpoints.stop()
         if self._thread:
             self._thread.join(timeout=3)
 
@@ -105,17 +113,20 @@ class EndpointsController:
         """Endpoints whose service is gone are garbage-collected
         (reference: endpoints_controller.go removes them)."""
         live = {f"{s.metadata.namespace}/{s.metadata.name}" for s in services}
-        try:
-            eps, _ = self.client.list("endpoints")
-        except APIError:
-            return
-        for ep in eps:
-            key = f"{ep.metadata.namespace}/{ep.metadata.name}"
-            if key not in live:
+        # Informer-fed: no per-sync endpoints LIST. The undecoded cache
+        # mixes typed objects (reflector list) and wire dicts (watch
+        # events); GC only needs the key, so read both shapes.
+        for ep in self.endpoints.store.list():
+            if isinstance(ep, dict):
+                meta = ep.get("metadata", {})
+                ns = meta.get("namespace", "")
+                name = meta.get("name", "")
+            else:
+                ns, name = ep.metadata.namespace, ep.metadata.name
+            if f"{ns}/{name}" not in live:
                 try:
                     self.client.delete(
-                        "endpoints", ep.metadata.name,
-                        namespace=ep.metadata.namespace or "default",
+                        "endpoints", name, namespace=ns or "default"
                     )
                 except APIError:
                     pass

@@ -85,7 +85,14 @@ class ControllerManager:
         if enable_gangs:
             # PodGroup lifecycle: status reconcile + pending-gang aging
             # (events, Unschedulable marking) for the gang scheduler.
-            self.gangs = GangController(client)
+            # Shares the replication manager's typed pods informer when
+            # present: one all-pods watch + decode per process, not two.
+            self.gangs = GangController(
+                client,
+                pods_informer=getattr(
+                    getattr(self, "replication", None), "pods", None
+                ),
+            )
             self.controllers.append(self.gangs)
         if enable_pv_binder:
             self.pv_binder = PersistentVolumeClaimBinder(client)

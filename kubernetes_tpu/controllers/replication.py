@@ -85,9 +85,9 @@ class ReplicationManager:
         self._thread: Optional[threading.Thread] = None
         self.rcs = Informer(
             client, "replicationcontrollers", decode=_decode_rc,
-            on_add=lambda o: self._dirty.set(),
-            on_update=lambda o: self._dirty.set(),
-            on_delete=lambda o: self._dirty.set(),
+            on_add=self._rc_changed,
+            on_update=self._rc_changed,
+            on_delete=self._rc_changed,
         )
         self.pods = Informer(
             client, "pods", decode=_decode_pod,
@@ -97,13 +97,24 @@ class ReplicationManager:
 
     # -- watch handlers ----------------------------------------------
 
+    def _rc_changed(self, _rc) -> None:
+        """RC add/update/delete: invalidate the pod->RC memo BEFORE
+        waking the sync loop. The memo can hold a stale None computed
+        before a new matching RC appeared — pod events for that RC
+        would then skip expectation observation until the 30s
+        expectations timeout (slow convergence; ADVICE r5). The
+        per-round clear in sync_all still runs; this closes the gap
+        between an RC appearing and the next round."""
+        self._rc_key_cache.clear()
+        self._dirty.set()
+
     def _rc_key_for_pod(self, pod: Pod) -> Optional[str]:
         # Memoized by (namespace, label signature): this runs on the
         # reflector thread for EVERY pod event, and rebuilding one
         # Selector per RC per event is O(RCs) selector constructions x
         # 30k events at scale. Pods from one template share a
-        # signature; sync_all clears the cache each round so RC churn
-        # converges within a sync period.
+        # signature; sync_all (and _rc_changed) clear the cache so RC
+        # churn converges within a sync period.
         labels = pod.metadata.labels or {}
         sig = (pod.metadata.namespace, frozenset(labels.items()))
         cache = self._rc_key_cache

@@ -246,7 +246,13 @@ class Snapshot:
 
 
 def pod_key(pod: Pod) -> str:
-    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+    """Canonical 'namespace/name' key with the empty namespace
+    normalized to 'default' — the SAME scheme the daemons' pending-path
+    maps, gang keys, and preemption records use (models.objects.
+    pod_full_key is the typed twin). One scheme everywhere: a pod
+    created with namespace='' must solve, match, and bind under ONE
+    key, never slip between '/p' and 'default/p' (ADVICE r5)."""
+    return f"{pod.metadata.namespace or 'default'}/{pod.metadata.name}"
 
 
 def node_is_ready(node: Node) -> bool:

@@ -11,8 +11,8 @@ ignores unknown wire fields so richer manifests still load.
 
 from __future__ import annotations
 
+import random as _random
 import time
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -25,13 +25,36 @@ RESOURCE_PODS = "pods"
 
 ResourceList = Dict[str, Quantity]
 
+#: Second-granular ISO timestamp memo: creationTimestamp stamping sits
+#: on the bulk-create hot path, and strftime+gmtime per object was
+#: ~6us of pure re-formatting of the same second.
+_NOW_ISO = (0, "")
+
 
 def now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    global _NOW_ISO
+    t = int(time.time())
+    if t != _NOW_ISO[0]:
+        _NOW_ISO = (t, time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t)))
+    return _NOW_ISO[1]
+
+
+#: uid entropy: one urandom-seeded PRNG per process instead of a
+#: urandom() syscall per object (uuid.uuid4 reads the kernel CSPRNG
+#: every call — ~57us/pod, the single largest cost of a bulk create).
+#: uids need uniqueness, not cryptographic unpredictability; the seed
+#: itself still comes from the kernel.
+_UID_RAND = _random.Random()
 
 
 def new_uid() -> str:
-    return str(uuid.uuid4())
+    h = "%032x" % _UID_RAND.getrandbits(128)
+    # uuid4-shaped (version/variant nibbles fixed) so anything parsing
+    # uids as UUIDs keeps working.
+    return (
+        f"{h[0:8]}-{h[8:12]}-4{h[13:16]}-"
+        f"{'89ab'[int(h[16], 16) & 3]}{h[17:20]}-{h[20:32]}"
+    )
 
 
 @dataclass

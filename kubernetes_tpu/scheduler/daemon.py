@@ -86,6 +86,12 @@ def _decode_service(wire: dict) -> Service:
     return serde.from_wire(Service, wire)
 
 
+def _decode_podgroup(wire: dict):
+    from kubernetes_tpu.models.objects import PodGroup
+
+    return serde.from_wire(PodGroup, wire)
+
+
 class _StorePodLister:
     def __init__(self, store: ThreadSafeStore):
         self.store = store
@@ -193,6 +199,14 @@ class SchedulerConfig:
             on_update=_emit("service", "MODIFIED"),
             on_delete=_emit("service", "DELETED"),
         )
+        # PodGroup cache: the gang partitioner reads specs from HERE
+        # instead of a per-tick cluster-wide LIST (at churn rates the
+        # repeated full fetch was pure API-plane load; the informer
+        # costs one watch). Cache misses fall back to one read-through
+        # LIST (see BatchScheduler._gang_groups).
+        self.podgroups = Informer(
+            client, "podgroups", decode=_decode_podgroup,
+        )
 
         def _scheduled_typed() -> List[Pod]:
             # With the raw cache, items are wire dicts: decode at the
@@ -242,17 +256,24 @@ class SchedulerConfig:
         self.scheduled_pods.start()
         self.nodes.start()
         self.services.start()
+        self.podgroups.start()
         return self
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         return all(
             x.wait_for_sync(timeout)
-            for x in (self._pod_reflector, self.scheduled_pods, self.nodes, self.services)
+            for x in (
+                self._pod_reflector, self.scheduled_pods, self.nodes,
+                self.services, self.podgroups,
+            )
         )
 
     def stop(self) -> None:
         self.pod_queue.close()
-        for x in (self._pod_reflector, self.scheduled_pods, self.nodes, self.services):
+        for x in (
+            self._pod_reflector, self.scheduled_pods, self.nodes,
+            self.services, self.podgroups,
+        ):
             x.stop()
 
 
@@ -515,31 +536,71 @@ class BatchScheduler(Scheduler):
     def _gang_groups(self, pending: List[Pod], assigned=None):
         """Partition the drained backlog into PodGroups (empty when no
         pod carries the group label — the common case costs one label
-        scan and nothing else). PodGroup specs are fetched per batch:
-        one cluster-wide list, only when grouped pods are present.
+        scan and nothing else). PodGroup specs come from the daemon's
+        podgroups INFORMER (no per-tick cluster-wide LIST on the hot
+        path); only a cache miss — a group the watch hasn't delivered
+        yet, or one that was deleted — falls back to one read-through
+        LIST so gang semantics never ride a stale cache.
 
-        Returns None when the spec fetch failed TRANSIENTLY: the caller
-        must defer the grouped pods (requeue), never schedule them
-        per-pod — silently dropping gang semantics is exactly the
-        partial placement this subsystem exists to prevent. Only a
-        server that genuinely does not serve the resource (older
-        apiserver: 400/404) degrades to per-pod scheduling."""
+        Returns None when the read-through fetch failed TRANSIENTLY:
+        the caller must defer the grouped pods (requeue), never
+        schedule them per-pod — silently dropping gang semantics is
+        exactly the partial placement this subsystem exists to prevent.
+        Only a server that genuinely does not serve the resource
+        (older apiserver: 400/404) degrades to per-pod scheduling."""
         from kubernetes_tpu.scheduler import gang
 
-        if not any(gang.pod_group_name(p) for p in pending):
+        needed = {
+            gang.group_key(p.metadata.namespace or "default", name)
+            for p in pending
+            for name in (gang.pod_group_name(p),)
+            if name
+        }
+        if not needed:
             return []
-        try:
-            pgs, _ = self.config.client.list("podgroups")
-        except APIError as e:
-            if e.code in (400, 404):
-                return []  # resource not served: per-pod is all there is
-            return None  # transient server error: defer the gangs
-        except Exception:
-            return None  # transport failure: defer the gangs
         by_key = {
             gang.group_key(pg.metadata.namespace, pg.metadata.name): pg
-            for pg in pgs
+            for pg in self.config.podgroups.store.list()
         }
+        missing = needed - by_key.keys()
+        if missing:
+            # A read-through already CONFIRMED some groups absent (the
+            # authoritative LIST is read-your-writes): they're deleted
+            # — degrade to per-pod (partition treats unknown groups as
+            # minMember 0) instead of re-fetching the whole collection
+            # every tick while their member pods sit in requeue.
+            now = time.monotonic()
+            memo = getattr(self, "_missing_groups", None)
+            if memo is None:
+                memo = self._missing_groups = {}
+            missing = {
+                k for k in missing if memo.get(k, 0.0) <= now
+            }
+        if missing:
+            # Informer lag or deleted group: ONE read-through fetch
+            # disambiguates (admission guarantees the group existed at
+            # pod-create time, so a genuine miss means deletion).
+            try:
+                pgs, _ = self.config.client.list("podgroups")
+            except APIError as e:
+                if e.code in (400, 404):
+                    return []  # resource not served: per-pod is all there is
+                return None  # transient server error: defer the gangs
+            except Exception:
+                return None  # transport failure: defer the gangs
+            by_key = {
+                gang.group_key(pg.metadata.namespace, pg.metadata.name): pg
+                for pg in pgs
+            }
+            # Still absent from the authoritative LIST = deleted; memo
+            # with a TTL so a recreated group is picked up promptly
+            # even if the informer misses it.
+            expiry = time.monotonic() + 30.0
+            memo = self._missing_groups
+            if len(memo) > 4096:
+                memo.clear()
+            for k in needed - by_key.keys():
+                memo[k] = expiry
 
         def min_member_of(ns: str, name: str):
             pg = by_key.get(gang.group_key(ns, name))
@@ -1208,12 +1269,16 @@ class IncrementalBatchScheduler(BatchScheduler):
 
     @staticmethod
     def _obj_key(obj) -> str:
-        """pod_key over typed pods OR wire dicts (the raw cache and
-        decode_deleted paths deliver dicts)."""
+        """Canonical pod key over typed pods OR wire dicts (the raw
+        cache and decode_deleted paths deliver dicts). Uses the SAME
+        empty-namespace normalization as columnar.pod_key (the session
+        keys) and the pending-path by_key maps — one scheme, so an
+        empty-namespace pod can never be silently dropped between the
+        solve and the bind (ADVICE r5)."""
         if isinstance(obj, dict):
             m = obj.get("metadata", {})
-            return f"{m.get('namespace', '')}/{m.get('name', '')}"
-        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+            return f"{m.get('namespace') or 'default'}/{m.get('name', '')}"
+        return f"{obj.metadata.namespace or 'default'}/{obj.metadata.name}"
 
     def _apply_events(self, session) -> bool:
         """Drain watch deltas into the session. Returns False when the

@@ -358,6 +358,12 @@ class APIServer:
         node_port_range: Tuple[int, int] = (30000, 32767),
     ):
         self.store = store or KVStore()
+        # Watch-cache read path (server/watchcache.py): per-resource
+        # event-fed mirrors serving GET/LIST without touching kvstore
+        # or re-serializing. Lazily built per resource on first LIST.
+        from kubernetes_tpu.server.watchcache import WatchCacheSet
+
+        self.caches = WatchCacheSet(self.store)
         # Reentrant: admission plugins may issue writes of their own
         # (NamespaceAutoprovision creates the namespace mid-admission).
         self._lock = threading.RLock()
@@ -415,34 +421,14 @@ class APIServer:
 
     def create(self, resource: str, namespace: str, obj: dict) -> dict:
         info = self._info(resource)
-        meta = obj.setdefault("metadata", {})
-        if info.namespaced:
-            ns = meta.get("namespace") or namespace or "default"
-            meta["namespace"] = ns
-            if namespace and meta["namespace"] != namespace:
-                raise _bad_request(
-                    f"namespace mismatch: body {meta['namespace']!r} vs url {namespace!r}"
-                )
-        else:
-            meta.pop("namespace", None)
-            ns = ""
-        if not meta.get("name") and meta.get("generateName"):
-            meta["name"] = self._gen_name(meta["generateName"])
-        if not meta.get("name"):
-            raise _invalid("metadata.name: required")
-        obj.setdefault("kind", info.kind)
-        obj.setdefault("apiVersion", "v1")
-        if obj["kind"] != info.kind:
-            raise _bad_request(f"kind {obj['kind']!r} does not match {info.kind!r}")
         if info.name == "namespaces":
             # Reference: namespaces default to the "kubernetes" finalizer
             # (pkg/registry/namespace/etcd + pkg/api defaults), making
             # deletion two-phase (Terminating -> content purge -> gone).
             obj.setdefault("spec", {}).setdefault("finalizers", ["kubernetes"])
             obj.setdefault("status", {}).setdefault("phase", "Active")
-        meta["uid"] = new_uid()
-        meta["creationTimestamp"] = now_iso()
-        meta.pop("resourceVersion", None)
+        ns, _name = self._default_create_meta(info, namespace, obj)
+        meta = obj["metadata"]
         with self._write_guard():
             self._admit("CREATE", info, ns, meta["name"], obj)
             self._validate(info, obj)
@@ -521,6 +507,19 @@ class APIServer:
             info.validator(typed)
         except ValidationError as e:
             raise _invalid("; ".join(e.errors))
+
+    def _validate_fast(self, info: ResourceInfo, obj: dict) -> None:
+        """Bulk-path validation: the wire-form twin when the resource
+        registers one (same checks, no typed decode — the decode was
+        the apiserver's largest per-pod cost at bulk rates), otherwise
+        the ordinary typed validator."""
+        if info.wire_validator is not None:
+            try:
+                info.wire_validator(obj)
+            except ValidationError as e:
+                raise _invalid("; ".join(e.errors))
+            return
+        self._validate(info, obj)
 
     def _ns(self, info: ResourceInfo, namespace: str) -> str:
         return (namespace or "default") if info.namespaced else ""
@@ -739,6 +738,15 @@ class APIServer:
         except NotFoundError:
             raise _not_found(info.name, name)
 
+    def _cache_list(self, info: ResourceInfo, namespace: str):
+        """(object REFS, version) through the watch cache when it is
+        fresh, falling back to a direct store scan when the dispatcher
+        trails too far (wedged fan-out must degrade, not error)."""
+        cache = self.caches.cache_for(info.prefix())
+        if cache.fresh():
+            return cache.list_refs(info.prefix(namespace))
+        return self.store.list(info.prefix(namespace), copy=False)
+
     def list(
         self,
         resource: str,
@@ -747,15 +755,23 @@ class APIServer:
         field_selector: str = "",
         copy: bool = True,
     ) -> dict:
-        """copy=False returns the store's own objects (READ-ONLY — for
+        """Served from the watch cache (event-fed, read-your-writes via
+        the version wait) — a LIST never scans or re-copies kvstore
+        state on the steady-state path.
+
+        copy=False returns the cache's own objects (READ-ONLY — for
         callers that immediately serialize, like the HTTP tier: a
         3000-pod LIST must not pay a full deep copy just to be JSON-
         encoded and thrown away). Stored objects are never mutated in
         place, so the refs are a consistent snapshot."""
         info = self._info(resource)
-        items, version = self.store.list(info.prefix(namespace), copy=copy)
+        items, version = self._cache_list(info, namespace)
         pred = self._selector_pred(resource, label_selector, field_selector)
         items = [o for o in items if pred(o)]
+        if copy:
+            from kubernetes_tpu.store.kvstore import _copy_obj
+
+            items = [_copy_obj(o) for o in items]
         if info.name == "componentstatuses" and self._component_checks:
             # Live probes first (the reference ignores selectors here
             # entirely, rest.go:52; we at least apply them uniformly);
@@ -775,6 +791,51 @@ class APIServer:
             "metadata": {"resourceVersion": str(version)},
             "items": items,
         }
+
+    def list_response_bytes(
+        self,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> Optional[bytes]:
+        """Complete JSON LIST response assembled from the watch cache's
+        per-object encodings (each object serialized at most once per
+        resourceVersion, ever — across LISTs, watchers, and callers).
+        None when the fast path does not apply (live componentstatuses,
+        stale cache) — the caller falls back to list()."""
+        info = self._info(resource)
+        if info.name == "componentstatuses" and self._component_checks:
+            return None
+        cache = self.caches.cache_for(info.prefix())
+        if not cache.fresh():
+            return None
+        pred = None
+        if label_selector or field_selector:
+            pred = self._selector_pred(
+                resource, label_selector, field_selector
+            )
+        body, _count, version = cache.list_encoded(
+            info.prefix(namespace), pred
+        )
+        head = (
+            '{"kind": "%sList", "apiVersion": "v1", "metadata": '
+            '{"resourceVersion": "%d"}, "items": [' % (info.kind, version)
+        ).encode()
+        return head + body + b"]}"
+
+    def get_response_bytes(
+        self, resource: str, namespace: str, name: str
+    ) -> Optional[bytes]:
+        """Encoded GET served from the watch cache; None = fall back
+        (missing object included — the slow path owns 404 semantics)."""
+        info = self._info(resource)
+        if info.name == "componentstatuses":
+            return None
+        cache = self.caches.cache_for(info.prefix())
+        if not cache.fresh():
+            return None
+        return cache.get_encoded(info.key(self._ns(info, namespace), name))
 
     def _selector_pred(self, resource: str, label_selector: str, field_selector: str):
         lsel = labelpkg.parse(label_selector)
@@ -1303,11 +1364,18 @@ class APIServer:
         since: int = 0,
         label_selector: str = "",
         field_selector: str = "",
+        maxsize: int = 4096,
     ) -> WatchStream:
         """Selector filtering happens INSIDE the store's fan-out (with
         etcd's modified-out-of-filter -> DELETED translation,
         kvstore._filter_event): a kubelet watching spec.nodeName=X never
-        has the other nodes' pod events copied or queued for it."""
+        has the other nodes' pod events copied or queued for it.
+
+        `maxsize` bounds the consumer's event queue (slow consumers are
+        dropped at overflow and must re-list); bulk-churn clients ask
+        for deeper buffers (?maxsize=) so a single group commit's burst
+        of N events cannot out-run one scheduling quantum of their
+        reader."""
         info = self._info(resource)
         pred = None
         shard = None
@@ -1316,7 +1384,8 @@ class APIServer:
             shard = _watch_shard(resource, field_selector)
         try:
             return self.store.watch(
-                info.prefix(namespace), since=since, pred=pred, shard=shard
+                info.prefix(namespace), since=since, pred=pred, shard=shard,
+                maxsize=max(1024, min(int(maxsize), 65536)),
             )
         except Exception as e:  # CompactedError -> 410 Gone
             raise APIError(410, "Expired", str(e))
@@ -1362,6 +1431,304 @@ class APIServer:
             "status": "Success",
             "code": 201,
         }
+
+    # -- bulk object verbs (the write fast path) -----------------------
+
+    #: Resources whose create/delete carry side effects (allocators,
+    #: finalizer phases) — bulk falls back to the per-item verbs there.
+    _BULK_SLOW = frozenset({"services", "namespaces"})
+
+    def create_bulk(
+        self, resource: str, namespace: str, items, copy: bool = True
+    ) -> list:
+        """POST {resource}:bulk — create N objects through ONE store
+        batch (one lock hold, one WAL append, one group-commit fsync;
+        KVStore.create_many). Per-item Status results in input order;
+        failures never abort the rest (pods are independent objects —
+        the atomic path is bind_bulk(atomic=True), not creation).
+        Watch events land in version order matching the input order.
+
+        copy=False trusts the items to be PRIVATE dicts (the HTTP
+        tier's just-parsed body); in-process callers keep the copy."""
+        info = self._info(resource)
+        if isinstance(items, dict):
+            items = items.get("items", [])
+        out: List[Optional[dict]] = [None] * len(items)
+        if info.name in self._BULK_SLOW or self.admission is not None:
+            # Admission is check-then-act against CURRENT usage: a
+            # batched admit-everything-then-commit would let one
+            # request blow a hard quota/limit by up to the batch size.
+            # With a chain configured, each item takes the full
+            # admit->commit->bookkeep cycle (correctness over the
+            # group-commit fast path).
+            for i, obj in enumerate(items):
+                try:
+                    created = self.create(resource, namespace, obj)
+                    out[i] = self._created_status(created)
+                except APIError as e:
+                    out[i] = e.to_status()
+                except Exception as e:
+                    out[i] = _invalid(f"{type(e).__name__}: {e}").to_status()
+            return out
+        entries = []
+        entry_idx = []
+        with self._write_guard():
+            for i, obj in enumerate(items):
+                try:
+                    ns, name = self._default_create_meta(
+                        info, namespace, obj
+                    )
+                    self._admit("CREATE", info, ns, name, obj)
+                    self._validate_fast(info, obj)
+                except APIError as e:
+                    out[i] = e.to_status()
+                    continue
+                except Exception as e:
+                    # Per-item contract: a malformed object (non-
+                    # numeric priority, non-string label value, ...)
+                    # that slips past the validator's field checks
+                    # must fail ITS slot, never abort the batch.
+                    out[i] = _invalid(f"{type(e).__name__}: {e}").to_status()
+                    continue
+                entries.append((info.key(ns, name), obj, info.ttl))
+                entry_idx.append(i)
+            if entries:
+                results = self.store.create_many(entries, copy=copy)
+                for i, res in zip(entry_idx, results):
+                    if isinstance(res, AlreadyExistsError):
+                        name = items[i].get("metadata", {}).get("name", "")
+                        out[i] = _conflict(
+                            f'{info.name} "{name}" already exists'
+                        ).to_status()
+                    elif isinstance(res, Exception):
+                        out[i] = APIError(
+                            500, "InternalError", str(res)
+                        ).to_status()
+                    else:
+                        out[i] = self._created_status(res)
+                        self._commit(
+                            "CREATE", info,
+                            res.get("metadata", {}).get("namespace", ""),
+                            res.get("metadata", {}).get("name", ""), res,
+                        )
+        return out
+
+    @staticmethod
+    def _created_status(obj: dict) -> dict:
+        meta = obj.get("metadata", {})
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Success",
+            "code": 201,
+            "details": {
+                "name": meta.get("name", ""),
+                "resourceVersion": meta.get("resourceVersion", ""),
+            },
+        }
+
+    def _default_create_meta(
+        self, info: ResourceInfo, namespace: str, obj: dict
+    ) -> Tuple[str, str]:
+        """The create() defaulting pass (namespace/name/kind/uid/
+        creationTimestamp), shared by the single and bulk paths."""
+        meta = obj.setdefault("metadata", {})
+        if info.namespaced:
+            ns = meta.get("namespace") or namespace or "default"
+            meta["namespace"] = ns
+            if namespace and ns != namespace:
+                raise _bad_request(
+                    f"namespace mismatch: body {ns!r} vs url {namespace!r}"
+                )
+        else:
+            meta.pop("namespace", None)
+            ns = ""
+        if not meta.get("name") and meta.get("generateName"):
+            meta["name"] = self._gen_name(meta["generateName"])
+        if not meta.get("name"):
+            raise _invalid("metadata.name: required")
+        obj.setdefault("kind", info.kind)
+        obj.setdefault("apiVersion", "v1")
+        if obj["kind"] != info.kind:
+            raise _bad_request(
+                f"kind {obj['kind']!r} does not match {info.kind!r}"
+            )
+        meta["uid"] = new_uid()
+        meta["creationTimestamp"] = now_iso()
+        meta.pop("resourceVersion", None)
+        return ns, meta["name"]
+
+    def update_bulk(
+        self, resource: str, namespace: str, items, copy: bool = True
+    ) -> list:
+        """POST {resource}:bulkupdate — replace N objects through one
+        store batch (atomic_update_many: one lock hold, one WAL append,
+        one fsync). Each item keeps update()'s semantics: CAS when the
+        body carries metadata.resourceVersion, last-write-wins when
+        not; uid/creationTimestamp carry over from the stored object.
+
+        copy=False trusts the items to be PRIVATE dicts (the HTTP
+        tier's parsed body): the store then skips its defensive
+        per-item round-trip copies — the dominant bulk-update cost."""
+        info = self._info(resource)
+        if isinstance(items, dict):
+            items = items.get("items", [])
+        if info.name in self._BULK_SLOW or self.admission is not None:
+            # Same check-then-act concern as create_bulk: quota usage
+            # deltas must be observed item by item under the guard.
+            out = []
+            for obj in items:
+                try:
+                    name = obj.get("metadata", {}).get("name", "")
+                    self.update(resource, namespace, name, obj)
+                    out.append(
+                        {"kind": "Status", "apiVersion": "v1",
+                         "status": "Success", "code": 200}
+                    )
+                except APIError as e:
+                    out.append(e.to_status())
+                except Exception as e:
+                    out.append(
+                        _invalid(f"{type(e).__name__}: {e}").to_status()
+                    )
+            return out
+        out = [None] * len(items)
+        ops = []
+        op_idx = []
+        with self._write_guard():
+            for i, obj in enumerate(items):
+                ns = self._ns(info, namespace)
+                try:
+                    meta = obj.setdefault("metadata", {})
+                    name = meta.get("name", "")
+                    if info.namespaced:
+                        meta.setdefault("namespace", ns)
+                    if not name:
+                        out[i] = _invalid(
+                            "metadata.name: required"
+                        ).to_status()
+                        continue
+                    expected = None
+                    if meta.get("resourceVersion"):
+                        try:
+                            expected = int(meta["resourceVersion"])
+                        except ValueError:
+                            out[i] = _bad_request(
+                                f"invalid resourceVersion "
+                                f"{meta['resourceVersion']!r}"
+                            ).to_status()
+                            continue
+                    self._admit("UPDATE", info, ns, name, obj)
+                    self._validate_fast(info, obj)
+                except APIError as e:
+                    out[i] = e.to_status()
+                    continue
+                except Exception as e:
+                    # Per-item contract: a malformed item (non-dict,
+                    # string metadata, ...) fails ITS slot, never the
+                    # batch.
+                    out[i] = _invalid(f"{type(e).__name__}: {e}").to_status()
+                    continue
+
+                def apply(cur, _obj=obj, _expected=expected):
+                    if _expected is not None:
+                        cur_v = int(
+                            cur.get("metadata", {})
+                            .get("resourceVersion", "0") or "0"
+                        )
+                        if cur_v != _expected:
+                            raise ConflictError(
+                                f"version {_expected} != current {cur_v}"
+                            )
+                    m_cur = cur.get("metadata", {})
+                    m = _obj.setdefault("metadata", {})
+                    m["uid"] = m_cur.get("uid", "")
+                    m["creationTimestamp"] = m_cur.get(
+                        "creationTimestamp", ""
+                    )
+                    return _obj
+
+                ops.append((info.key(ns, name), apply))
+                op_idx.append(i)
+            if ops:
+                results = self.store.atomic_update_many(
+                    ops, copy=copy, copy_results=False
+                )
+                for i, res in zip(op_idx, results):
+                    name = items[i].get("metadata", {}).get("name", "")
+                    if isinstance(res, NotFoundError):
+                        out[i] = _not_found(info.name, name).to_status()
+                    elif isinstance(res, ConflictError):
+                        out[i] = _conflict(str(res)).to_status()
+                    elif isinstance(res, Exception):
+                        out[i] = APIError(
+                            500, "InternalError", str(res)
+                        ).to_status()
+                    else:
+                        out[i] = {
+                            "kind": "Status", "apiVersion": "v1",
+                            "status": "Success", "code": 200,
+                            "details": {
+                                "name": name,
+                                "resourceVersion": res.get("metadata", {})
+                                .get("resourceVersion", ""),
+                            },
+                        }
+                        self._commit("UPDATE", info, ns, name, res)
+        return out
+
+    def delete_bulk(self, resource: str, namespace: str, names) -> list:
+        """POST {resource}:bulkdelete — immediate delete of N objects
+        through one store batch (delete_many: one lock hold, one WAL
+        append, one fsync). Graceful pod termination is a per-item
+        concern; this is the churn-drain path (the reference analog is
+        a DeleteCollection)."""
+        info = self._info(resource)
+        if isinstance(names, dict):
+            names = names.get("names", [])
+        if info.name in self._BULK_SLOW or self.admission is not None:
+            # Per-item when an admission chain is configured so usage
+            # bookkeeping (quota release) observes each delete.
+            out = []
+            for name in names:
+                try:
+                    self.delete(resource, namespace, name)
+                    out.append(
+                        {"kind": "Status", "apiVersion": "v1",
+                         "status": "Success", "code": 200}
+                    )
+                except APIError as e:
+                    out.append(e.to_status())
+            return out
+        ns = self._ns(info, namespace)
+        out = [None] * len(names)
+        keys = []
+        key_idx = []
+        with self._write_guard():
+            for i, name in enumerate(names):
+                try:
+                    self._admit("DELETE", info, ns, name, None)
+                except APIError as e:
+                    out[i] = e.to_status()
+                    continue
+                keys.append(info.key(ns, name))
+                key_idx.append(i)
+            if keys:
+                results = self.store.delete_many(keys)
+                for i, res in zip(key_idx, results):
+                    if isinstance(res, NotFoundError):
+                        out[i] = _not_found(info.name, names[i]).to_status()
+                    elif isinstance(res, Exception):
+                        out[i] = APIError(
+                            500, "InternalError", str(res)
+                        ).to_status()
+                    else:
+                        out[i] = {
+                            "kind": "Status", "apiVersion": "v1",
+                            "status": "Success", "code": 200,
+                        }
+                        self._commit("DELETE", info, ns, names[i], None)
+        return out
 
     def create_events_bulk(self, namespace: str, items) -> list:
         """Write many Events in one call — the event broadcaster's
@@ -1451,7 +1818,12 @@ class APIServer:
             # any store work (reject-all on first invalid item).
             return [o if o is not None else aborted for o in out]
         if ops:
-            results = self.store.atomic_update_many(ops, atomic=atomic)
+            # copy_results=False: only per-item status is inspected;
+            # a result copy per binding would re-copy the whole solved
+            # backlog on every bulk commit.
+            results = self.store.atomic_update_many(
+                ops, atomic=atomic, copy_results=False
+            )
             for i, res in zip(op_idx, results):
                 if isinstance(res, APIError):
                     out[i] = res.to_status()

@@ -524,6 +524,9 @@ class _Handler(BaseHTTPRequestHandler):
                 ns, resource = rest[1], rest[2]
             elif rest:
                 resource = rest[0]
+            # Bulk verbs ride the resource segment ("pods:bulk");
+            # policy is written against the underlying resource.
+            resource = resource.partition(":")[0]
             try:
                 authorizer.authorize(
                     authpkg.AuthzAttributes(
@@ -632,6 +635,11 @@ class _Handler(BaseHTTPRequestHandler):
                     200, {"kind": "EventResultList", "results": results}
                 )
                 return "bulkevents", 200
+            if ":" in resource and verb == "POST" and len(rest) == 3:
+                # Bulk object verbs: POST .../{resource}:bulk (create),
+                # :bulkupdate, :bulkdelete — N objects through one
+                # store group commit (the API-plane write fast path).
+                return self._bulk(resource, ns)
             if len(rest) == 3:
                 return self._collection(verb, resource, ns, lsel, fsel)
             name = rest[3]
@@ -738,6 +746,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         # Cluster-scoped or cross-namespace.
         resource = rest[0]
+        if ":" in resource and verb == "POST" and len(rest) == 1:
+            return self._bulk(resource, "")
         info = RESOURCES.get(resource)
         if info is None:
             raise APIError(404, "NotFound", f"unknown resource {resource!r}")
@@ -769,6 +779,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, out)
             return resource, 200
         raise APIError(404, "NotFound", f"unknown path {self.path!r}")
+
+    def _bulk(self, spec: str, ns: str) -> Tuple[str, int]:
+        """POST {resource}:bulk|:bulkupdate|:bulkdelete — batch verbs
+        committing N objects under one WAL group commit (api.create_bulk
+        and friends). Bodies: {"items": [...]} for create/update,
+        {"names": [...]} for delete. Per-item Status results in order."""
+        base, _, bulk_verb = spec.partition(":")
+        if RESOURCES.get(base) is None:
+            raise APIError(404, "NotFound", f"unknown resource {base!r}")
+        # No kind hint: the body is a bulk ENVELOPE, not an object —
+        # version conversion dispatches on kind and would mangle it.
+        # Bulk verbs are v1-only by contract.
+        body = self._read_body()
+        if bulk_verb == "bulk":
+            # copy=False: the just-parsed body is private to this
+            # request — the store may own the dicts outright.
+            results = self.api.create_bulk(
+                base, ns, body.get("items", []), copy=False
+            )
+        elif bulk_verb == "bulkupdate":
+            results = self.api.update_bulk(
+                base, ns, body.get("items", []), copy=False
+            )
+        elif bulk_verb == "bulkdelete":
+            results = self.api.delete_bulk(base, ns, body.get("names", []))
+        else:
+            raise APIError(
+                404, "NotFound",
+                f"unknown bulk verb {bulk_verb!r} "
+                "(bulk, bulkupdate, bulkdelete)",
+            )
+        self._send_json(200, {"kind": "BulkResultList", "results": results})
+        return f"{base}/{bulk_verb}", 200
 
     # -- pod subresources proxied to the kubelet API ------------------
 
@@ -988,6 +1031,16 @@ class _Handler(BaseHTTPRequestHandler):
                 # signal (the reference uses verb WATCHLIST the same
                 # way, pkg/apiserver/metrics.go).
                 return resource + "/watch", 200
+            # Watch-cache fast path: the response is assembled from
+            # per-object encodings cached by resourceVersion — repeat
+            # LISTs (controller relists, reflector syncs) never
+            # re-serialize unchanged objects. Non-v1 wire versions and
+            # live componentstatuses fall back to the dict path.
+            if getattr(self, "wire_version", "v1") == "v1":
+                enc = api.list_response_bytes(resource, ns, lsel, fsel)
+                if enc is not None:
+                    self._send_text(200, enc, "application/json")
+                    return resource, 200
             # copy=False: the list is encoded and discarded right here,
             # so the store's read-only refs skip a full deep copy.
             self._send_json(200, api.list(resource, ns, lsel, fsel, copy=False))
@@ -1006,7 +1059,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _item(self, verb, resource, ns, name) -> Tuple[str, int]:
         api = self.api
         if verb == "GET":
-            self._send_json(200, api.get(resource, ns, name))
+            enc = None
+            if getattr(self, "wire_version", "v1") == "v1":
+                # Cached per-object encoding (miss = absent object or
+                # stale cache: the slow path owns 404 semantics).
+                enc = api.get_response_bytes(resource, ns, name)
+            if enc is not None:
+                self._send_text(200, enc, "application/json")
+            else:
+                self._send_json(200, api.get(resource, ns, name))
         elif verb == "PUT":
             self._send_json(
                 200, api.update(resource, ns, name, self._read_body(self._kind_of(resource)))
@@ -1053,10 +1114,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             since = int(q.get("resourceVersion", "0") or "0")
             timeout = float(q.get("timeoutSeconds", "0") or "0") or None
+            maxsize = int(q.get("maxsize", "4096") or "4096")
         except ValueError:
             raise APIError(
                 400, "BadRequest",
-                "resourceVersion/timeoutSeconds must be numeric",
+                "resourceVersion/timeoutSeconds/maxsize must be numeric",
             )
         # Both transports the reference serves (pkg/apiserver/watch.go:
         # 45-102): websocket when the client asks to upgrade, chunked
@@ -1066,7 +1128,8 @@ class _Handler(BaseHTTPRequestHandler):
             and self.headers.get("Sec-WebSocket-Key")
         )
         stream = self.api.watch(
-            resource, ns, since=since, label_selector=lsel, field_selector=fsel
+            resource, ns, since=since, label_selector=lsel,
+            field_selector=fsel, maxsize=maxsize,
         )
         from kubernetes_tpu.utils import websocket as ws
 
@@ -1095,16 +1158,41 @@ class _Handler(BaseHTTPRequestHandler):
                     if stream.closed:
                         break
                     continue
-                obj = ev.object
+                # Burst coalescing: drain whatever else is already
+                # queued (bounded) and ship ONE socket write. At bulk
+                # churn rates a write+flush syscall per event made this
+                # writer thread the slow consumer — the store would
+                # drop the stream mid-drill.
+                batch = [ev]
+                while len(batch) < 512:
+                    nxt = stream.next(timeout=0)
+                    if nxt is None:
+                        break
+                    batch.append(nxt)
+                out = []
                 version = getattr(self, "wire_version", "v1")
-                if version != "v1" and isinstance(obj, dict):
-                    obj = conversion.from_internal(obj, version)
-                frame = json.dumps({"type": ev.type, "object": obj}).encode()
-                if websocket:
-                    self.wfile.write(ws.encode_frame(frame))
-                else:
-                    frame += b"\n"
-                    self.wfile.write(b"%x\r\n" % len(frame) + frame + b"\r\n")
+                for ev in batch:
+                    obj = ev.object
+                    if version != "v1" and isinstance(obj, dict):
+                        obj = conversion.from_internal(obj, version)
+                        frame = json.dumps(
+                            {"type": ev.type, "object": obj}
+                        ).encode()
+                    else:
+                        # Shared frame cache: one event fanned out to
+                        # N watch connections is encoded once (keyed
+                        # by the store's globally unique version).
+                        frame = self.api.caches.frame_bytes(
+                            ev.type, ev.version, obj
+                        )
+                    if websocket:
+                        out.append(ws.encode_frame(frame))
+                    else:
+                        frame += b"\n"
+                        out.append(
+                            b"%x\r\n" % len(frame) + frame + b"\r\n"
+                        )
+                self.wfile.write(b"".join(out))
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass

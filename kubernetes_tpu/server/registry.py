@@ -23,6 +23,10 @@ class ResourceInfo:
     namespaced: bool = True
     validator: Optional[Callable] = None
     ttl: Optional[float] = None  # seconds; events are TTL'd
+    # Optional wire-form validator twin (same checks, no typed
+    # decode) — the bulk-path fast validator; parity with `validator`
+    # is pinned by tests.
+    wire_validator: Optional[Callable] = None
 
     def key(self, namespace: str, name: str) -> str:
         if self.namespaced:
@@ -44,7 +48,13 @@ def _register(info: ResourceInfo, *aliases: str) -> None:
         RESOURCES[a] = info
 
 
-_register(ResourceInfo("pods", "Pod", O.Pod, validator=V.validate_pod))
+_register(
+    ResourceInfo(
+        "pods", "Pod", O.Pod,
+        validator=V.validate_pod,
+        wire_validator=V.validate_pod_wire,
+    )
+)
 _register(
     ResourceInfo("nodes", "Node", O.Node, namespaced=False, validator=V.validate_node),
     "minions",  # legacy alias (reference: pkg/registry/minion)

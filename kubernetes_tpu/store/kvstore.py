@@ -80,6 +80,11 @@ class AbortedError(StoreError):
     item in the batch failed); nothing in the batch was committed."""
 
 
+class StoreClosedError(StoreError):
+    """The store was closed while this write was queued/in flight; the
+    write was NOT applied (serialized-writer shutdown path)."""
+
+
 def _copy_obj(obj: dict) -> dict:
     """Private copy of a wire-form object. Wire objects are JSON by
     construction (they ride the WAL and the HTTP API as JSON), and a
@@ -136,6 +141,26 @@ def _filter_event(
     return Event(DELETED, obj, version) if pred(obj) else None
 
 
+def _drain_write_queue(q, batch=()) -> None:
+    """Shutdown path: fail every not-yet-applied queued entry so no
+    writer thread is stranded in ev.wait() forever (the None sentinel
+    used to retire the applier mid-batch, silently dropping already-
+    dequeued entries)."""
+    err = StoreClosedError("store closed before this write was applied")
+    pending = list(batch)
+    while True:
+        try:
+            pending.append(q.get_nowait())
+        except _queue.Empty:
+            break
+    for entry in pending:
+        if entry is None:
+            continue
+        _fn, ev, cell = entry
+        cell.append((False, err))
+        ev.set()
+
+
 def _write_thread(store_ref, q) -> None:
     """Serialized write-combining loop (etcd's single raft-apply
     thread, in spirit): drains queued mutations and executes them with
@@ -144,11 +169,22 @@ def _write_thread(store_ref, q) -> None:
     full wake+GIL-handoff latency and system write throughput
     collapses to ~1/wake-latency; with a single applier the writes
     themselves proceed at full speed and only each caller's own
-    wake-up is laggy."""
+    wake-up is laggy.
+
+    Group commit rides the batch: after applying a drained batch the
+    thread fsyncs the WAL ONCE (advancing _synced_seq past every record
+    the batch appended) and only then wakes the callers — their own
+    _wal_sync finds the work already done, so N queued writers pay one
+    disk flush instead of racing N.
+
+    Shutdown: on the None sentinel every already-dequeued and still-
+    queued entry is failed with StoreClosedError (events always set) —
+    a write racing close() must error out, never hang."""
     spin_s = 0.004  # stay runnable briefly between batches (see below)
     while True:
         item = q.get()
         if item is None:
+            _drain_write_queue(q)
             return
         while True:
             batch = [item]
@@ -160,14 +196,28 @@ def _write_thread(store_ref, q) -> None:
             store = store_ref()
             if store is None:
                 return
+            if None in batch:
+                # Sentinel mid-batch: fail the whole drained batch and
+                # everything still queued, then retire.
+                _drain_write_queue(q, batch)
+                return
+            done = []
             for entry in batch:
-                if entry is None:
-                    return
                 fn, ev, cell = entry
                 try:
                     cell.append((True, fn()))
                 except BaseException as e:
                     cell.append((False, e))
+                done.append(ev)
+            # One fsync covers the whole drained batch before any
+            # caller is woken (their _wal_sync then no-ops). Failures
+            # fall through: each caller's own _wal_sync retries and
+            # surfaces the real error.
+            try:
+                store._sync_batch_locked_free()
+            except Exception:
+                pass
+            for ev in done:
                 ev.set()
             del store
             # Spin-drain: a blocking get() puts this thread to SLEEP,
@@ -185,7 +235,10 @@ def _write_thread(store_ref, q) -> None:
                     time.sleep(0)  # yield the GIL, stay runnable
                     continue
                 if nxt is None:
-                    return  # shutdown sentinel (close/GC finalizer)
+                    # Shutdown sentinel (close/GC finalizer): fail any
+                    # entries that raced in behind it.
+                    _drain_write_queue(q)
+                    return
                 item = nxt
             if item is None:
                 break  # idle: go back to the blocking get
@@ -218,6 +271,11 @@ class KVStore:
         self._unsharded: List[tuple] = []
         self._shard_buckets: Dict[tuple, List[tuple]] = {}
         self._shard_fns: tuple = ()
+        # Event subscribers (the apiserver's watch cache): called on
+        # the DISPATCHER thread for every event, before watcher
+        # fan-out, with the stored (read-only) object — no copy. See
+        # subscribe().
+        self._subscribers: tuple = ()
         # Fan-out rides its own thread: writers only append to this
         # queue under the lock; the dispatcher does the per-event copy
         # and per-watcher predicate work OFF the write path, so write
@@ -370,7 +428,10 @@ class KVStore:
                     f.truncate(good_offset)
         return replayed
 
-    def _wal_append_locked(self, version: int, etype: str, key: str, obj: dict) -> None:
+    def _wal_append_locked(
+        self, version: int, etype: str, key: str, obj: dict,
+        flush: bool = True,
+    ) -> None:
         if self._wal_file is None:
             return
         rec = {"v": version, "t": etype, "k": key}
@@ -380,13 +441,32 @@ class KVStore:
             if exp is not None:
                 rec["e"] = exp
         self._wal_file.write(json.dumps(rec, separators=(",", ":")) + "\n")
-        self._wal_file.flush()
+        # flush=False is the batch path (create_many/atomic_update_many
+        # and friends): records accumulate in the file object's buffer
+        # and _wal_flush_locked writes them as ONE append at the end of
+        # the lock hold — the "single WAL append" half of group commit.
+        if flush:
+            self._wal_file.flush()
         # fsync does NOT happen here (we hold self._lock): callers ack
         # through _wal_sync after releasing it — the group-commit seam.
         self._wal_seq += 1
         self._wal_count += 1
         if self._wal_count >= self._snapshot_every:
             self._snapshot_locked()
+
+    def _wal_flush_locked(self) -> None:
+        """Flush buffered batch appends to the OS (one write syscall
+        for the whole batch); the fsync still happens in _wal_sync."""
+        if self._wal_file is not None:
+            self._wal_file.flush()
+
+    def _sync_batch_locked_free(self) -> None:
+        """One group-commit fsync covering everything appended so far
+        (the serialized write thread's per-batch flush). Caller must
+        NOT hold self._lock. No-op for in-memory / fsync=off stores."""
+        with self._lock:
+            seq = self._wal_seq
+        self._wal_sync(seq)
 
     def _wal_sync(self, seq: int) -> None:
         """Group commit: make WAL record `seq` durable before the
@@ -550,7 +630,8 @@ class KVStore:
         self._next_expiry = heap[0][0] if heap else math.inf
 
     def _record(
-        self, version: int, etype: str, key: str, obj: dict, prev: Optional[dict] = None
+        self, version: int, etype: str, key: str, obj: dict,
+        prev: Optional[dict] = None, flush: bool = True,
     ) -> None:
         """Journal one mutation (caller holds self._lock). The write
         path only appends: WAL, history ring, dispatch queue. The
@@ -560,7 +641,7 @@ class KVStore:
         count. `obj` is the just-stored object (never mutated in place
         after storage); history shares the ref and replay copies it
         per delivery (watch())."""
-        self._wal_append_locked(version, etype, key, obj)
+        self._wal_append_locked(version, etype, key, obj, flush=flush)
         if not self._history:
             self._oldest = version
         self._history.append((version, etype, key, obj))
@@ -589,6 +670,15 @@ class KVStore:
         obj or prev carries its shard value, so skipped watchers would
         have produced no event anyway."""
         version, etype, key, obj, prev = item
+        for sub in self._subscribers:
+            # Subscribers see every event in version order before the
+            # watcher fan-out (they feed read caches, so they must be
+            # at least as fresh as anything a watcher could observe).
+            # obj is the stored object — read-only by contract.
+            try:
+                sub(version, etype, key, obj, prev)
+            except Exception:
+                pass  # a broken cache must not stall watch fan-out
         with self._lock:
             watchers = list(self._unsharded)
             for fn in self._shard_fns:  # distinct extractors (usually 1)
@@ -653,6 +743,76 @@ class KVStore:
         seq = self._apply_write(op)
         self._wal_sync(seq)  # fsync-before-ack, amortized across writers
         return _copy_obj(obj)
+
+    def create_many(
+        self,
+        entries: List[Tuple[str, dict, Optional[float]]],
+        copy: bool = True,
+    ) -> List:
+        """Create a batch of objects under ONE lock hold, ONE buffered
+        WAL append, and ONE group-commit fsync — the bulk write fast
+        path (a 512-pod bulk POST pays one commit, not 512). Per-item
+        results: the stored object (a ref — callers must not mutate)
+        or the exception instance (AlreadyExistsError) for items that
+        failed; failures never abort the rest of the batch. Versions
+        are assigned in list order, so watchers observe the batch's
+        ADDED events in exactly the submitted order.
+
+        copy=False trusts the caller to hand over PRIVATE dicts (the
+        HTTP tier's just-parsed request body) and skips the defensive
+        per-object copy — the dominant per-item cost at bulk rates."""
+        if copy:
+            entries = [(k, _copy_obj(o), t) for k, o, t in entries]
+
+        def op():
+            out = []
+            with self._lock:
+                self._expire_locked()
+                for key, obj, ttl in entries:
+                    if key in self._data:
+                        out.append(AlreadyExistsError(key))
+                        continue
+                    v = self._bump()
+                    self._stamp(obj, v)
+                    self._data[key] = (obj, v)
+                    if ttl is not None:
+                        exp = self._now() + ttl
+                        self._ttl[key] = exp
+                        heapq.heappush(self._ttl_heap, (exp, key))
+                        self._next_expiry = min(self._next_expiry, exp)
+                    self._record(v, ADDED, key, obj, flush=False)
+                    out.append(obj)
+                self._wal_flush_locked()
+                return out, self._wal_seq
+
+        results, seq = self._apply_write(op)
+        self._wal_sync(seq)  # ONE fsync for the whole batch
+        return results
+
+    def delete_many(self, keys: List[str]) -> List:
+        """Delete a batch of keys under one lock hold / WAL append /
+        fsync (the bulk-churn drain path). Per-item results: the
+        deleted object or NotFoundError."""
+
+        def op():
+            out = []
+            with self._lock:
+                self._expire_locked()
+                for key in keys:
+                    if key not in self._data:
+                        out.append(NotFoundError(key))
+                        continue
+                    obj, _ = self._data.pop(key)
+                    self._ttl.pop(key, None)
+                    v = self._bump()
+                    self._record(v, DELETED, key, obj, flush=False)
+                    out.append(obj)
+                self._wal_flush_locked()
+                return out, self._wal_seq
+
+        results, seq = self._apply_write(op)
+        self._wal_sync(seq)
+        return results
 
     def get(self, key: str) -> dict:
         with self._lock:
@@ -738,33 +898,58 @@ class KVStore:
     def _apply_write(self, op):
         """Run a mutation closure directly, or through the serialized
         writer when enabled. `op` takes the store lock itself (short
-        hold); exceptions propagate to the caller either way."""
+        hold); exceptions propagate to the caller either way.
+
+        Shutdown-safe: close() retires the applier thread (which fails
+        every queued entry with StoreClosedError) and nulls _write_q so
+        late writers fall back to the direct path (where _bump refuses
+        with "store is closed"). The wait is bounded with a closed-
+        store re-check so a write racing close() can never block its
+        thread forever."""
         q = self._write_q
         if q is None:
             return op()
         ev = threading.Event()
         cell: list = []
         q.put((op, ev, cell))
-        ev.wait()
+        while not ev.wait(timeout=5.0):
+            if self._closed and not cell:
+                # Applier retired without reaching this entry (close()
+                # raced the enqueue above the sentinel-drain window).
+                raise StoreClosedError(
+                    "store closed before this write was applied"
+                )
         ok, val = cell[0]
         if ok:
             return val
         raise val
 
-    def _atomic_update_locked(self, key: str, update_fn) -> dict:
-        """Caller holds self._lock."""
+    def _atomic_update_locked(
+        self, key: str, update_fn, flush: bool = True, copy: bool = True
+    ) -> dict:
+        """Caller holds self._lock.
+
+        copy=False is the trusted bulk-replace path: update_fn receives
+        the STORED object itself (READ-ONLY — it must not mutate it)
+        and must return a PRIVATE dict (the HTTP tier's parsed request
+        body qualifies), which is stored without the two defensive
+        json round-trips — at bulk-update rates those copies were the
+        batch's dominant cost."""
         if key not in self._data:
             raise NotFoundError(key)
         cur, _ = self._data[key]
-        # Stored state must be PRIVATE: update_fn may graft caller-
-        # owned sub-dicts into its return (update_status splices the
-        # request body's status), so the stored object is a copy —
-        # same invariant set() keeps by copying its input.
-        stored = _copy_obj(update_fn(_copy_obj(cur)))
+        if copy:
+            # Stored state must be PRIVATE: update_fn may graft caller-
+            # owned sub-dicts into its return (update_status splices the
+            # request body's status), so the stored object is a copy —
+            # same invariant set() keeps by copying its input.
+            stored = _copy_obj(update_fn(_copy_obj(cur)))
+        else:
+            stored = update_fn(cur)
         v = self._bump()
         self._stamp(stored, v)
         self._data[key] = (stored, v)
-        self._record(v, MODIFIED, key, stored, prev=cur)
+        self._record(v, MODIFIED, key, stored, prev=cur, flush=flush)
         return stored
 
     def atomic_update(self, key: str, update_fn: Callable[[dict], dict]) -> dict:
@@ -790,6 +975,8 @@ class KVStore:
     def atomic_update_many(
         self, ops: List[Tuple[str, Callable[[dict], dict]]],
         atomic: bool = False,
+        copy: bool = True,
+        copy_results: Optional[bool] = None,
     ) -> List:
         """Batch of single-hold read-modify-writes under ONE lock
         acquisition (and one serialized-writer hop). The batch solver
@@ -818,10 +1005,13 @@ class KVStore:
                     for key, update_fn in ops:
                         try:
                             out.append(
-                                self._atomic_update_locked(key, update_fn)
+                                self._atomic_update_locked(
+                                    key, update_fn, flush=False, copy=copy
+                                )
                             )
                         except Exception as e:  # per-item outcome, not abort
                             out.append(e)
+                    self._wal_flush_locked()
                     return out, self._wal_seq
                 # Atomic: stage everything, commit only if all succeed.
                 # `staged` doubles as an overlay so a batch touching the
@@ -859,12 +1049,22 @@ class KVStore:
                     v = self._bump()
                     self._stamp(stored, v)
                     self._data[key] = (stored, v)
-                    self._record(v, MODIFIED, key, stored, prev=cur)
+                    self._record(v, MODIFIED, key, stored, prev=cur, flush=False)
                     out.append(stored)
+                self._wal_flush_locked()
                 return out, self._wal_seq
 
         results, seq = self._apply_write(batch)
         self._wal_sync(seq)
+        # copy_results=False hands back the STORED objects (read-only
+        # contract) — callers that only inspect status/metadata (the
+        # bind commit path, bulk update) skip a per-item json round
+        # trip, which at 50k-pod bulk binds was a full copy of the
+        # cluster per commit.
+        if copy_results is None:
+            copy_results = copy
+        if not copy_results:
+            return results
         return [
             r if isinstance(r, Exception) else _copy_obj(r) for r in results
         ]
@@ -891,6 +1091,25 @@ class KVStore:
         raise ConflictError(f"{key}: too many CAS retries")
 
     # -- Watch --------------------------------------------------------
+
+    def subscribe(self, fn: Callable) -> None:
+        """Register an event subscriber: fn(version, etype, key, obj,
+        prev) is invoked on the dispatcher thread for EVERY event, in
+        version order, before watcher fan-out. `obj` is the stored
+        object itself (read-only by contract — subscribers must not
+        mutate and must copy before handing out). This is the
+        apiserver watch cache's feed: one hook, no extra threads, no
+        per-event copies."""
+        with self._lock:
+            self._subscribers = self._subscribers + (fn,)
+
+    def expire_now(self) -> None:
+        """Process due TTL expirations (O(1) when none are due). Read
+        caches call this before serving: expiry normally piggybacks on
+        writes, so a quiet store could otherwise serve TTL'd objects
+        past their deadline from a cache."""
+        with self._lock:
+            self._expire_locked()
 
     def watch(
         self,
@@ -970,7 +1189,12 @@ class KVStore:
             self._unsharded = []
             self._shard_buckets = {}
             if self._write_q is not None:
-                self._write_q.put(None)  # retire the serialized writer
+                # Retire the serialized writer (it fails every queued
+                # entry with StoreClosedError) and null the queue so
+                # late writers take the direct path, where _bump
+                # refuses writes on a closed store.
+                self._write_q.put(None)
+                self._write_q = None
             self._dispatch_q.put(None)  # retire the dispatcher thread
             if self._wal_file is not None:
                 # fsync-before-close: a writer that appended its record

@@ -186,6 +186,17 @@ class TestMaxInFlight:
             return real_list(resource, *a, **kw)
 
         api.list = slow_list
+        # The HTTP tier serves LISTs from the watch cache
+        # (list_response_bytes); slow that entry point the same way so
+        # the in-flight slots actually fill.
+        real_enc = api.list_response_bytes
+
+        def slow_enc(resource, *a, **kw):
+            if resource == "pods":
+                slow.wait(timeout=5)
+            return real_enc(resource, *a, **kw)
+
+        api.list_response_bytes = slow_enc
         srv = APIHTTPServer(api, max_in_flight=2).start()
         try:
             client = Client(HTTPTransport(srv.address))

@@ -498,10 +498,11 @@ def test_two_group_backlog_all_or_nothing(daemon_cls):
 
 
 def test_transient_podgroup_fetch_failure_defers_gangs(monkeypatch):
-    """If PodGroup specs cannot be fetched this tick (apiserver
-    hiccup), grouped pods are DEFERRED — never scheduled per-pod, which
-    would break the all-or-nothing contract — while ungrouped pods
-    still schedule."""
+    """If PodGroup specs cannot be resolved this tick (informer lag on
+    a group the cache hasn't seen AND the read-through fetch hits an
+    apiserver hiccup), grouped pods are DEFERRED — never scheduled
+    per-pod, which would break the all-or-nothing contract — while
+    ungrouped pods still schedule."""
     api = APIServer()
     client = Client(LocalTransport(api))
     client.create("nodes", node_wire("n0", cpu="4"))
@@ -520,6 +521,11 @@ def test_transient_podgroup_fetch_failure_defers_gangs(monkeypatch):
                 raise ConnectionError("apiserver hiccup")
             return real_list(resource, *a, **k)
 
+        # Specs come from the podgroups informer now; a hiccup only
+        # bites when the cache MISSES the group (watch lag) and the
+        # read-through fetch fails too. Simulate both.
+        real_store_list = cfg.podgroups.store.list
+        monkeypatch.setattr(cfg.podgroups.store, "list", lambda: [])
         monkeypatch.setattr(cfg.client, "list", flaky_list)
         processed = 0
         deadline = time.monotonic() + 30
@@ -531,6 +537,7 @@ def test_transient_podgroup_fetch_failure_defers_gangs(monkeypatch):
         assert not by_name["a0"] and not by_name["a1"]
         # Specs resolvable again: the deferred gang binds whole.
         monkeypatch.setattr(cfg.client, "list", real_list)
+        monkeypatch.setattr(cfg.podgroups.store, "list", real_store_list)
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             sched.schedule_batch(timeout=0.5)
