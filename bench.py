@@ -1454,6 +1454,22 @@ def main() -> None:
         record["ktlint_baselined"] = len(_rep.baselined)
     except Exception as e:
         record["ktlint_error"] = str(e)  # lint must never sink a bench run
+    # ktsan: the interprocedural lock analysis rides next to the
+    # per-rule counts — cycles/contract violations must chart at ZERO;
+    # the lock/edge totals show the sanitizer's coverage growing.
+    try:
+        from tools.ktlint import lockgraph as _lockgraph
+
+        _lg = _lockgraph.analyze()
+        record["ktsan_findings"] = {
+            "cycles": len(_lg.cycles),
+            "locked_contract": len(_lg.violations),
+            "suppressed": _lg.suppressed,
+            "locks": len(_lg.locks),
+            "edges": len(_lg.edges),
+        }
+    except Exception as e:
+        record["ktsan_error"] = str(e)
     print(json.dumps(record))
     print(
         f"# fast wall best {best_fast:.3f}s ({fast_mode}, gate "

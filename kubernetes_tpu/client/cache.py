@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.store.watch import ADDED, DELETED, ERROR, MODIFIED
+from kubernetes_tpu.utils import sanitizer
 
 
 def meta_namespace_key(obj) -> str:
@@ -34,7 +35,7 @@ class ThreadSafeStore:
     """Keyed object cache (reference: cache.ThreadSafeStore)."""
 
     def __init__(self, key_func: Callable = meta_namespace_key):
-        self._lock = threading.RLock()
+        self._lock = sanitizer.rlock("informer.store")
         self._items: Dict[str, Any] = {}
         self.key_func = key_func
 
@@ -236,7 +237,7 @@ class FIFO:
     version of each enqueued object (reference: cache.FIFO, fifo.go:49-184)."""
 
     def __init__(self, key_func: Callable = meta_namespace_key):
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("informer.fifo")
         self._cond = threading.Condition(self._lock)
         self._items: Dict[str, Any] = {}
         self._queue: List[str] = []
@@ -305,7 +306,7 @@ class DeltaFIFO:
 
     def __init__(self, key_func: Callable = meta_namespace_key):
         self.key_func = key_func
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(sanitizer.lock("informer.deltafifo"))
         self._deltas: Dict[str, List[tuple]] = {}
         self._queue: List[str] = []
         self._known: Dict[str, Any] = {}  # last object seen per key

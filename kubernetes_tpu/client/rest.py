@@ -303,6 +303,9 @@ class HTTPTransport(Transport):
                 return self._do_locked(
                     verb, path, query, body, raw, content_type
                 )
+        # serialize=False: there IS no serial lock — per-call sockets,
+        # nothing shared to guard; the _locked suffix means "under the
+        # serial lock when one exists".  # ktlint: disable=KTSAN02
         return self._do_locked(verb, path, query, body, raw, content_type)
 
     def _do_locked(

@@ -274,7 +274,10 @@ def scheduler_parser() -> argparse.ArgumentParser:
         "pallas kernel also the fastest backlog mode on one TPU); "
         "wave = wave-commit solver (approximate decision-order "
         "parity; best sustained-churn throughput); sinkhorn = "
-        "congestion-priced assignment waves (fewest device steps)",
+        "congestion-priced assignment waves (fewest device steps); "
+        "auto = scan unless the solve runs over a device mesh — the "
+        "daemons construct no mesh yet, so auto currently always "
+        "selects scan here (docs/performance.md, mesh crossover)",
     )
     p.add_argument(
         "--solver-sidecar", default="",

@@ -37,7 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--batch-mode", default="scan",
         choices=["scan", "wave", "sinkhorn", "auto"],
         help="device solver mode for --batch-scheduler (scan = "
-        "sequential-parity referee; wave/sinkhorn = high-throughput)",
+        "sequential-parity referee; wave/sinkhorn = high-throughput; "
+        "auto = mesh-keyed, and with no mesh threaded through local-up "
+        "it always selects scan today)",
     )
     p.add_argument(
         "--batch-incremental", action="store_true",

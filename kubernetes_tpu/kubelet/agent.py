@@ -82,9 +82,11 @@ class _SyncPool:
     def __init__(self, sync_fn, workers: int = 2, max_workers: int = 16):
         import queue
 
+        from kubernetes_tpu.utils import sanitizer
+
         self._sync = sync_fn
         self._q: "queue.Queue[Optional[str]]" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("kubelet.syncpool")
         self._pending: Dict[str, Pod] = {}  # key -> latest un-synced spec
         self._running: set = set()  # keys currently inside sync_fn
         self._max = max_workers

@@ -305,3 +305,71 @@ def _replay(snap: Snapshot, forced):
             "placed": placed,
         }
     return out, stats
+
+
+def scatter_rows_numpy(
+    nodes: dict, idx: np.ndarray, rows: dict
+) -> dict:
+    """NumPy twin of ops.incremental._scatter_rows (the session's
+    dirty-row commit): out-of-place fancy-index row replacement over a
+    dict-of-arrays. Registered in ops/parity.py; parity pinned by
+    tests/test_ktsan.py."""
+    out = {}
+    for k, arr in nodes.items():
+        a = np.array(arr, copy=True)
+        a[np.asarray(idx)] = np.asarray(rows[k])
+        out[k] = a
+    return out
+
+
+def validate_assignment_numpy(snap: Snapshot, assignment) -> None:
+    """Replay every placement against the snapshot's own predicate
+    semantics in NumPy; raises AssertionError on any capacity /
+    selector / port / volume / pin violation.
+
+    This is the NumPy oracle twin for the approximate wave-family
+    kernels (ops.wave.solve_waves, ops.sinkhorn.solve_sinkhorn_stats):
+    they trade decision-ORDER parity for batching, so their invariant
+    is placement VALIDITY, not destination equality — see
+    tests/test_wave.py / tests/test_sinkhorn.py, which drive every
+    fuzz case through this checker."""
+    n = snap.nodes
+    cpu_fit = n.cpu_fit_used.copy()
+    mem_fit = n.mem_fit_used.copy()
+    pods_used = n.pods_used.copy()
+    uport = n.used_port_bits.copy()
+    uvol_any = n.used_vol_any_bits.copy()
+    uvol_rw = n.used_vol_rw_bits.copy()
+    p = snap.pods
+    sel_rows = p.sel_bits[p.selector_id]
+    for i, j in enumerate(assignment):
+        if j < 0:
+            continue
+        assert n.schedulable[j], f"pod {i} on unschedulable node {j}"
+        assert not n.overcommitted[j], f"pod {i} on overcommitted node {j}"
+        if p.zero_req[i]:
+            assert pods_used[j] < n.pods_cap[j], f"pod {i}: count overflow"
+        else:
+            if n.cpu_cap[j] > 0:
+                assert cpu_fit[j] + p.cpu_milli[i] <= n.cpu_cap[j], (
+                    f"pod {i}: cpu overflow on node {j}"
+                )
+            if n.mem_cap[j] > 0:
+                assert mem_fit[j] + p.mem_mib[i] <= n.mem_cap[j], (
+                    f"pod {i}: mem overflow on node {j}"
+                )
+            assert pods_used[j] + 1 <= n.pods_cap[j], f"pod {i}: count"
+        sel = sel_rows[i]
+        assert ((sel & n.label_bits[j]) == sel).all(), f"pod {i}: selector"
+        assert not (p.port_bits[i] & uport[j]).any(), f"pod {i}: port clash"
+        assert not (
+            (p.vol_rw_bits[i] & uvol_any[j]) | (p.vol_any_bits[i] & uvol_rw[j])
+        ).any(), f"pod {i}: volume clash"
+        pin = p.pinned_node[i]
+        assert pin in (-1, j), f"pod {i}: pinned to {pin}, placed on {j}"
+        cpu_fit[j] += p.cpu_milli[i]
+        mem_fit[j] += p.mem_mib[i]
+        pods_used[j] += 1
+        uport[j] |= p.port_bits[i]
+        uvol_any[j] |= p.vol_any_bits[i]
+        uvol_rw[j] |= p.vol_rw_bits[i]

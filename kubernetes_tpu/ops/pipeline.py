@@ -35,7 +35,7 @@ from kubernetes_tpu.ops.matrices import (
     shardings_for,
 )
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
-from kubernetes_tpu.utils import tracing
+from kubernetes_tpu.utils import sanitizer, tracing
 
 # Measured on v5e-1 at 50k x 5k with the pallas scan kernel: 12544
 # (4 chunks) walls 0.61-0.66s vs 0.88-0.96s at 8192 and 0.71-0.76s at
@@ -104,6 +104,11 @@ def solve_backlog_pipelined(
     commit in backlog order, so a chunk's pods see strictly MORE
     committed state than the same pods in one big window ever would.
     """
+    # jit dispatch + the final blocking readback must never run under a
+    # sanitized lock (ktsan blocking-under-lock check; a multi-second
+    # first-bucket compile under the apiserver or store lock would
+    # freeze the control plane).
+    sanitizer.check_blocking("jit-dispatch", "solve_backlog_pipelined")
     # Phase spans wrap whole host-side segments, never per-pod work —
     # their cost is a few monotonic reads per CHUNK. JAX dispatch is
     # async, so per-chunk "solve" measures dispatch; the device time

@@ -30,7 +30,10 @@ from kubernetes_tpu.scheduler.types import (
     StaticPodLister,
     StaticServiceLister,
 )
-from kubernetes_tpu.utils import tracing
+from kubernetes_tpu.utils import sanitizer, tracing
+
+
+_AUTO_NO_MESH_WARNED = False
 
 
 def resolve_batch_mode(mode: str, mesh=None) -> str:
@@ -45,10 +48,30 @@ def resolve_batch_mode(mode: str, mesh=None) -> str:
     (docs/performance.md, mesh crossover). Keyed on the mesh the
     caller will pass to the solve, NOT on how many devices are merely
     visible — an unsharded solve on a multi-device host still wants
-    the scan."""
+    the scan.
+
+    Today NO shipped daemon constructs a mesh (ADVICE r5: both
+    production call sites pass mesh=None), so in the daemons `auto`
+    always resolves to scan until ROADMAP item 2 threads a real
+    jax.sharding.Mesh through the schedulers — the one-time warning
+    below keeps that honest for operators reading logs."""
     if mode != "auto":
         return mode
-    return "wave" if mesh is not None else "scan"
+    if mesh is None:
+        global _AUTO_NO_MESH_WARNED
+        if not _AUTO_NO_MESH_WARNED:
+            _AUTO_NO_MESH_WARNED = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "--batch-mode auto resolved to 'scan': no device mesh "
+                "is threaded through this scheduler (the daemons never "
+                "construct one yet — ROADMAP item 2), so auto currently "
+                "ALWAYS selects scan in production; the wave path "
+                "engages only when a solve runs over a real mesh"
+            )
+        return "scan"
+    return "wave"
 
 
 def schedule_backlog_scalar(
@@ -123,6 +146,10 @@ def schedule_backlog_tpu(
     the scalar path WITH the spec)."""
     from kubernetes_tpu.ops import device_snapshot, solve_assignments
 
+    # jit dispatch blocks on device work and (first call per shape
+    # bucket) on an XLA compile measured in seconds — ktsan treats it
+    # like any other blocking call: never under a sanitized lock.
+    sanitizer.check_blocking("jit-dispatch", "schedule_backlog_tpu")
     with tracing.phase("lower", pods=len(pending)):
         snap = build_snapshot(
             pending, nodes, assigned_pods=assigned, services=services, spec=spec
@@ -154,6 +181,7 @@ def schedule_backlog_wave(
     from kubernetes_tpu.ops import device_snapshot
     from kubernetes_tpu.ops.wave import wave_assignments
 
+    sanitizer.check_blocking("jit-dispatch", "schedule_backlog_wave")
     with tracing.phase("lower", pods=len(pending)):
         snap = build_snapshot(
             pending, nodes, assigned_pods=assigned, services=services
@@ -183,6 +211,7 @@ def schedule_backlog_sinkhorn(
     from kubernetes_tpu.ops import device_snapshot
     from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments
 
+    sanitizer.check_blocking("jit-dispatch", "schedule_backlog_sinkhorn")
     with tracing.phase("lower", pods=len(pending)):
         snap = build_snapshot(
             pending, nodes, assigned_pods=assigned, services=services

@@ -23,9 +23,11 @@ class SimpleModeler:
         scheduled_pods: Callable[[], List[Pod]],
         ttl: float = 30.0,
     ):
+        from kubernetes_tpu.utils import sanitizer
+
         self._scheduled = scheduled_pods
         self._ttl = ttl
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("scheduler.modeler")
         self._assumed: Dict[str, tuple] = {}  # key -> (pod, expiry)
 
     @staticmethod

@@ -366,7 +366,9 @@ class APIServer:
         self.caches = WatchCacheSet(self.store)
         # Reentrant: admission plugins may issue writes of their own
         # (NamespaceAutoprovision creates the namespace mid-admission).
-        self._lock = threading.RLock()
+        from kubernetes_tpu.utils import sanitizer
+
+        self._lock = sanitizer.rlock("apiserver.state")
         self._rand = random.Random(0xC0FFEE)
         # Admission chain (kubernetes_tpu.server.admission.Chain); None
         # means admit everything (reference default --admission-control

@@ -36,6 +36,8 @@ import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu.utils import sanitizer
+
 
 class _Entry:
     __slots__ = ("obj", "version", "enc")
@@ -53,7 +55,7 @@ class ResourceCache:
         self.prefix = prefix
         self._store = store
         self._set = cache_set
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("watchcache.resource")
         self._items: Dict[str, _Entry] = {}
         self._sorted: Optional[List[str]] = None  # lazily (re)sorted keys
         # Everything <= seed_version is reflected (from the seed list);
@@ -212,17 +214,19 @@ class WatchCacheSet:
 
     def __init__(self, store):
         self._store = store
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("watchcache.set")
         self._caches: Dict[str, ResourceCache] = {}  # prefix -> cache
         self._routes: List[Tuple[str, object]] = []
         self.applied = 0  # highest event version processed by the feed
-        self._applied_cond = threading.Condition()
+        self._applied_cond = threading.Condition(
+            sanitizer.lock("watchcache.applied")
+        )
         # Encoded watch frames keyed by (event type, version): the
         # store's version clock is global, so within one store the key
         # uniquely identifies the frame bytes. One event fanned out to
         # N watch connections is json.dumps'd once. Per-set (per-store)
         # on purpose: two stores' clocks both start at 1.
-        self._frame_lock = threading.Lock()
+        self._frame_lock = sanitizer.lock("watchcache.frames")
         self._frames: Dict[Tuple[str, int], bytes] = {}
         store.subscribe(self._on_event)
 
@@ -301,7 +305,7 @@ class _BufferingRoute:
 
     def __init__(self, prefix: str):
         self.prefix = prefix
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("watchcache.bufroute")
         self._buf: List[tuple] = []
         self._target: Optional[ResourceCache] = None
 

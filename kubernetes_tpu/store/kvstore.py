@@ -53,6 +53,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.store.watch import ADDED, DELETED, Event, MODIFIED, WatchStream
+from kubernetes_tpu.utils import sanitizer
 
 
 class StoreError(Exception):
@@ -253,7 +254,7 @@ class KVStore:
         snapshot_every: int = 4096,
         serialized_writes: bool = False,
     ):
-        self._lock = threading.RLock()
+        self._lock = sanitizer.rlock("kvstore.lock")
         self._data: Dict[str, Tuple[dict, int]] = {}  # key -> (wire obj, version)
         self._ttl: Dict[str, float] = {}  # key -> expiry wall-clock time
         self._version = 0
@@ -327,7 +328,9 @@ class KVStore:
         self._wal_count = 0
         self._wal_seq = 0  # records appended (group-commit cursor)
         self._synced_seq = 0  # records known durable
-        self._sync_lock = threading.Lock()
+        # io_gate: this lock EXISTS to serialize the group-commit fsync
+        # (ktsan's blocking-under-lock check exempts it by declaration).
+        self._sync_lock = sanitizer.lock("kvstore.sync", io_gate=True)
         self._closed = False
         self._lockfd: Optional[int] = None
         if data_dir:
@@ -531,17 +534,26 @@ class KVStore:
         Crash-safe in both orders: a crash after the rename but before
         the truncate leaves WAL records with v <= snapshot version,
         which _recover skips.
+
+        Runs (fsyncs included) under self._lock on purpose: compaction
+        is stop-the-world for writers — rotating the WAL handle while
+        appends proceed would lose records. The ktsan allow_blocking
+        grant below documents that exception; everything else in the
+        store honors "no blocking I/O under kvstore.lock".
         """
         items = [
             [key, obj, ver, self._ttl.get(key)]
             for key, (obj, ver) in sorted(self._data.items())
         ]
         tmp = self._snap_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": self._version, "items": items}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
+        with sanitizer.allow_blocking(
+            "snapshot compaction is stop-the-world by design"
+        ):
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": self._version, "items": items}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snap_path)
         if self._wal_file is not None:
             self._wal_file.close()
         self._wal_file = open(self._wal_path, "w", encoding="utf-8")
@@ -550,7 +562,10 @@ class KVStore:
             # Power-loss ordering: the snapshot rename's directory
             # entry must be durable BEFORE new WAL appends land, or a
             # crash could pair the old snapshot with a truncated WAL.
-            self._fsync_dir()
+            with sanitizer.allow_blocking(
+                "snapshot compaction is stop-the-world by design"
+            ):
+                self._fsync_dir()
             # Everything appended so far is folded into the (fsync'd)
             # snapshot: waiting group-commit callers are already
             # durable without touching the fresh WAL.
@@ -626,14 +641,15 @@ class KVStore:
             if k in self._data:
                 obj, _ = self._data.pop(k)
                 v = self._bump()
-                self._record(v, DELETED, k, obj)
+                self._record_locked(v, DELETED, k, obj)
         self._next_expiry = heap[0][0] if heap else math.inf
 
-    def _record(
+    def _record_locked(
         self, version: int, etype: str, key: str, obj: dict,
         prev: Optional[dict] = None, flush: bool = True,
     ) -> None:
-        """Journal one mutation (caller holds self._lock). The write
+        """Journal one mutation; the _locked suffix IS the contract
+        (callers hold self._lock; ktsan checks it interprocedurally). The write
         path only appends: WAL, history ring, dispatch queue. The
         per-event copy and per-watcher filter/push work happens on the
         dispatcher thread, so a write's lock hold is O(obj-serialize)
@@ -737,7 +753,7 @@ class KVStore:
                     self._ttl[key] = exp
                     heapq.heappush(self._ttl_heap, (exp, key))
                     self._next_expiry = min(self._next_expiry, exp)
-                self._record(v, ADDED, key, obj)
+                self._record_locked(v, ADDED, key, obj)
                 return self._wal_seq
 
         seq = self._apply_write(op)
@@ -780,7 +796,7 @@ class KVStore:
                         self._ttl[key] = exp
                         heapq.heappush(self._ttl_heap, (exp, key))
                         self._next_expiry = min(self._next_expiry, exp)
-                    self._record(v, ADDED, key, obj, flush=False)
+                    self._record_locked(v, ADDED, key, obj, flush=False)
                     out.append(obj)
                 self._wal_flush_locked()
                 return out, self._wal_seq
@@ -805,7 +821,7 @@ class KVStore:
                     obj, _ = self._data.pop(key)
                     self._ttl.pop(key, None)
                     v = self._bump()
-                    self._record(v, DELETED, key, obj, flush=False)
+                    self._record_locked(v, DELETED, key, obj, flush=False)
                     out.append(obj)
                 self._wal_flush_locked()
                 return out, self._wal_seq
@@ -844,7 +860,7 @@ class KVStore:
                 v = self._bump()
                 self._stamp(obj, v)
                 self._data[key] = (obj, v)
-                self._record(v, MODIFIED, key, obj, prev=prev)
+                self._record_locked(v, MODIFIED, key, obj, prev=prev)
                 return self._wal_seq
 
         seq = self._apply_write(op)
@@ -865,7 +881,7 @@ class KVStore:
                 del self._data[key]
                 self._ttl.pop(key, None)
                 v = self._bump()
-                self._record(v, DELETED, key, obj)
+                self._record_locked(v, DELETED, key, obj)
                 return obj, self._wal_seq
 
         obj, seq = self._apply_write(op)
@@ -949,7 +965,7 @@ class KVStore:
         v = self._bump()
         self._stamp(stored, v)
         self._data[key] = (stored, v)
-        self._record(v, MODIFIED, key, stored, prev=cur, flush=flush)
+        self._record_locked(v, MODIFIED, key, stored, prev=cur, flush=flush)
         return stored
 
     def atomic_update(self, key: str, update_fn: Callable[[dict], dict]) -> dict:
@@ -1049,7 +1065,7 @@ class KVStore:
                     v = self._bump()
                     self._stamp(stored, v)
                     self._data[key] = (stored, v)
-                    self._record(v, MODIFIED, key, stored, prev=cur, flush=False)
+                    self._record_locked(v, MODIFIED, key, stored, prev=cur, flush=False)
                     out.append(stored)
                 self._wal_flush_locked()
                 return out, self._wal_seq
@@ -1205,8 +1221,12 @@ class KVStore:
                 # fsync-by-default promises can't happen.
                 if self._fsync:
                     try:
-                        self._wal_file.flush()
-                        os.fsync(self._wal_file.fileno())
+                        with sanitizer.allow_blocking(
+                            "close() is terminal; no writer can make "
+                            "progress past a closed store anyway"
+                        ):
+                            self._wal_file.flush()
+                            os.fsync(self._wal_file.fileno())
                         self._synced_seq = self._wal_seq
                     except OSError:
                         pass  # racing writers will refuse their acks

@@ -92,7 +92,9 @@ class Broadcaster:
     """Fan-out of events to many streams (reference: pkg/watch/mux.go)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from kubernetes_tpu.utils import sanitizer
+
+        self._lock = sanitizer.lock("watch.broadcaster")
         self._streams: List[WatchStream] = []
 
     def watch(self, maxsize: int = 4096) -> WatchStream:

@@ -31,7 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import metrics, sanitizer
 
 #: Decision outcome EVENTS recorded, by outcome: one per drained pod
 #: per tick (bound / unschedulable / bind_error / bind_conflict /
@@ -66,7 +66,7 @@ SOLVE_ITERATIONS = metrics.DEFAULT.histogram(
 )
 
 
-_LAST_SOLVE_LOCK = threading.Lock()
+_LAST_SOLVE_LOCK = sanitizer.lock("flightrecorder.lastsolve")
 _LAST_SOLVE: Optional[dict] = None
 
 
@@ -256,7 +256,7 @@ class FlightRecorder:
     """Bounded rings of decisions and solve records (newest win)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("flightrecorder.ring")
         self._decisions: List[Decision] = []
         self._solves: List[SolveRecord] = []
         self._tick = 0

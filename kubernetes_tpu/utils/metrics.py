@@ -14,6 +14,8 @@ import random
 import threading
 from typing import Dict, List, Sequence, Tuple
 
+from kubernetes_tpu.utils import sanitizer
+
 #: Module-level RNG so reservoir sampling is seedable in tests
 #: (metrics._RNG.seed(...)) and the hot observe() path never re-imports.
 _RNG = random.Random()
@@ -36,7 +38,7 @@ class _Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("metrics.series")
 
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
         return tuple(labels.get(k, "") for k in self.label_names)
@@ -272,7 +274,7 @@ class Histogram(_Metric):
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitizer.lock("metrics.registry")
         self._metrics: Dict[str, _Metric] = {}
 
     def register(self, metric: _Metric) -> _Metric:

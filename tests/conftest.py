@@ -20,10 +20,28 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import jax  # noqa: E402  (after env setup on purpose)
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+#: Tier-1 runs these concurrency-heavy modules with the ktsan runtime
+#: sanitizer ON (utils/sanitizer.py): their tests construct fresh
+#: stores / watch caches / daemons per test, so every hot lock is
+#: instrumented, and the teardown guard below fails the test on any
+#: lock-order inversion, blocking-call-under-lock, lock held by a dead
+#: thread, or leaked non-daemon thread. The empty-findings gate IS the
+#: ktsan baseline — and it must stay empty.
+KTSAN_MODULES = {
+    "test_store",
+    "test_watchcache",
+    "test_gang",
+    "test_preemption",
+    "test_ktsan",
+}
 
 
 def pytest_configure(config):
@@ -46,6 +64,13 @@ def pytest_configure(config):
         "readback / ktctl explain) tests; tier-1 includes them — select "
         "just these with -m explain",
     )
+    config.addinivalue_line(
+        "markers",
+        "sanitize: run this test with the ktsan lock sanitizer enabled "
+        "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
+        "finding or leaked non-daemon thread; the concurrency-heavy "
+        "modules in conftest.KTSAN_MODULES get this implicitly",
+    )
 
 
 def pytest_addoption(parser):
@@ -62,3 +87,63 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _ktsan_guard(request):
+    """Per-test ktsan harness: sanitizer-on for KTSAN_MODULES /
+    @pytest.mark.sanitize / KT_SANITIZE=locks runs, with a thread
+    snapshot so a test that leaks a non-daemon thread (or a lock held
+    by a dead thread) fails HERE, not as a hang three modules later.
+
+    Enablement is creation-time: locks built BEFORE enable() (e.g. in
+    module-scoped fixtures) stay plain — tests in the sanitized
+    modules construct their stores/daemons per test, which is exactly
+    what makes per-test enablement effective. The KT_SANITIZE env
+    path instruments import-time singletons too."""
+    from kubernetes_tpu.utils import sanitizer
+
+    module = request.node.module.__name__.rpartition(".")[2]
+    env_on = sanitizer.enabled()
+    want = (
+        env_on
+        or module in KTSAN_MODULES
+        or request.node.get_closest_marker("sanitize") is not None
+    )
+    if not want:
+        yield
+        return
+    sanitizer.enable()
+    sanitizer.reset()
+    before = {t.ident for t in threading.enumerate()}
+    yield
+
+    def fresh_nondaemon():
+        return [
+            t for t in threading.enumerate()
+            if t.ident not in before and t.is_alive() and not t.daemon
+        ]
+
+    # Let teardown-stopped workers actually exit before judging.
+    deadline = time.monotonic() + 2.0
+    while fresh_nondaemon() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    leaked_threads = [t.name for t in fresh_nondaemon()]
+    found = sanitizer.findings()
+    dead_held = sanitizer.leaked_locks()
+    sanitizer.reset()
+    if dead_held:
+        # Reported below — forget the dead holders so ONE real leak
+        # fails one test instead of cascading into every later one.
+        sanitizer.purge_dead_threads()
+    if not env_on:
+        sanitizer.disable()
+    problems = []
+    if found:
+        problems.append(f"ktsan findings: {found}")
+    if dead_held:
+        problems.append(f"locks held by dead threads: {dead_held}")
+    if leaked_threads:
+        problems.append(f"leaked non-daemon threads: {leaked_threads}")
+    if problems:
+        pytest.fail("ktsan: " + "; ".join(problems))

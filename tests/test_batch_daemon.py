@@ -346,3 +346,22 @@ def test_batch_mode_auto_resolution_keyed_on_mesh_argument():
     for m in ("scan", "wave", "sinkhorn"):
         assert resolve_batch_mode(m, mesh=mesh) == m
         assert resolve_batch_mode(m, mesh=None) == m
+
+
+def test_batch_mode_auto_meshless_warns_once(caplog):
+    """ADVICE r5: no shipped daemon threads a mesh, so auto always
+    resolves to scan in production — resolve_batch_mode says so in the
+    log, ONCE per process, and never when a mesh is actually passed."""
+    import logging
+
+    from kubernetes_tpu.scheduler import batch
+
+    batch._AUTO_NO_MESH_WARNED = False  # fresh one-shot for this test
+    with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.scheduler.batch"):
+        batch.resolve_batch_mode("auto")
+        batch.resolve_batch_mode("auto")  # second resolve: silent
+        batch.resolve_batch_mode("scan")  # explicit modes: silent
+    warned = [
+        r for r in caplog.records if "auto currently ALWAYS selects scan" in r.message
+    ]
+    assert len(warned) == 1

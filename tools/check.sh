@@ -30,8 +30,77 @@ if [ $rc -ne 0 ]; then
     echo "ktlint FAILED (see above; pragma or --write-baseline only with a reason)"
     exit $rc
 fi
+
+echo "== ktsan lock graph (static) =="
+python -m tools.ktlint --lock-graph --format=json > /tmp/ktsan_lockgraph.json
+rc=$?
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/ktsan_lockgraph.json"))
+print(
+    f"ktsan: {len(d['locks'])} locks, {len(d['edges'])} edges, "
+    f"{len(d['cycles'])} cycle(s), {len(d['violations'])} contract "
+    f"violation(s) ({d['suppressed']} suppressed)"
+)
+for c in d["cycles"]:
+    print(f"  KTSAN01 {' -> '.join(c['path'])}")
+for v in d["violations"]:
+    print(f"  {v['path']}:{v['line']}: {v['rule']} {v['message']}")
+EOF
+if [ $rc -ne 0 ]; then
+    echo "ktsan lock graph FAILED (zero cycles / zero *_locked violations is the gate)"
+    exit $rc
+fi
 if [ "$1" = "--lint-only" ]; then
     exit 0
+fi
+
+echo "== ktsan runtime (sanitizer-on concurrency subset) =="
+# The concurrency-heavy modules under KT_SANITIZE=locks, dumping the
+# OBSERVED lock-order graph; the merge below closes the loop: a cycle
+# needs both halves in neither order. The module list IS
+# conftest.KTSAN_MODULES (one source of truth) minus test_ktsan — its
+# deliberate-inversion fixtures run in tier-1 but must not pollute
+# the live merge. A stale report from a killed earlier run must not
+# survive into the merge either.
+rm -f /tmp/ktsan_runtime.json
+KTSAN_TESTS=$(python - <<'EOF'
+import sys
+sys.path.insert(0, "tests")
+from conftest import KTSAN_MODULES
+print(" ".join(
+    f"tests/{m}.py" for m in sorted(KTSAN_MODULES) if m != "test_ktsan"
+))
+EOF
+)
+env JAX_PLATFORMS=cpu KT_SANITIZE=locks \
+    KT_SANITIZE_REPORT=/tmp/ktsan_runtime.json \
+    python -m pytest $KTSAN_TESTS \
+    -q -m 'not slow' -p no:cacheprovider
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "ktsan runtime subset FAILED (sanitizer finding or test regression)"
+    exit $rc
+fi
+if [ -f /tmp/ktsan_runtime.json ]; then
+    python -m tools.ktlint --lock-graph \
+        --runtime-graph /tmp/ktsan_runtime.json --format=json \
+        > /tmp/ktsan_merged.json
+    rc=$?
+    python - <<'EOF'
+import json
+d = json.load(open("/tmp/ktsan_merged.json"))
+runtime = sum(1 for e in d["edges"] if e["kind"] == "runtime")
+print(
+    f"ktsan merged: {len(d['edges'])} edges ({runtime} runtime-observed), "
+    f"{len(d['cycles'])} cycle(s), "
+    f"{len(d['runtime_findings'])} runtime finding(s)"
+)
+EOF
+    if [ $rc -ne 0 ]; then
+        echo "ktsan merged static+runtime graph FAILED"
+        exit $rc
+    fi
 fi
 
 echo "== tier-1 tests =="

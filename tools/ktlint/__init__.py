@@ -12,7 +12,14 @@ KT003    exception hygiene: broad excepts in controllers/kubelet/server
          must log with context or re-raise
 KT004    bounded I/O: socket/HTTP operations carry explicit timeouts
 KT005    metric naming: snake_case, unit-suffixed, via metrics.DEFAULT
+KT006    parity: jitted ops kernels need a registered NumPy oracle
+         twin (ops/parity.py) exercised by the named suite
 =======  ==============================================================
+
+The interprocedural lock analysis (lock-order cycles KTSAN01, the
+cross-module ``*_locked`` contract KTSAN02/KTSAN03) lives in
+tools/ktlint/lockgraph.py and runs via ``python -m tools.ktlint
+--lock-graph`` — see that module's docstring.
 
 Suppress one finding with ``# ktlint: disable=KT00N`` (on the line or
 the line above); grandfather a backlog with the baseline file
@@ -38,6 +45,11 @@ from tools.ktlint.rules_locks import LockDisciplineRule
 from tools.ktlint.rules_except import ExceptionHygieneRule
 from tools.ktlint.rules_io import BoundedIORule
 from tools.ktlint.rules_metrics import MetricNamingRule
+from tools.ktlint.rules_parity import OracleTwinRule
+from tools.ktlint.lockgraph import (  # noqa: F401  (public API)
+    LockGraphReport,
+    analyze as lock_graph,
+)
 
 #: Registry, in rule-id order. Adding a pass = appending here.
 ALL_RULES = (
@@ -46,6 +58,7 @@ ALL_RULES = (
     ExceptionHygieneRule(),
     BoundedIORule(),
     MetricNamingRule(),
+    OracleTwinRule(),
 )
 
 

@@ -41,7 +41,35 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit"
     )
+    ap.add_argument(
+        "--lock-graph", action="store_true",
+        help="run the interprocedural lock analysis instead of the "
+        "per-file rules: lock-order cycles (KTSAN01) and the "
+        "*_locked contract (KTSAN02/KTSAN03)",
+    )
+    ap.add_argument(
+        "--runtime-graph", default="",
+        help="with --lock-graph: merge a runtime edge graph dumped by "
+        "a KT_SANITIZE_REPORT=<file> sanitizer run",
+    )
     args = ap.parse_args(argv)
+
+    if args.lock_graph:
+        from tools.ktlint import lockgraph
+
+        runtime = None
+        if args.runtime_graph:
+            try:
+                runtime = lockgraph.load_runtime_report(args.runtime_graph)
+            except (OSError, ValueError) as e:
+                print(f"--runtime-graph: {e}", file=sys.stderr)
+                return 2
+        report = lockgraph.analyze(args.paths, runtime=runtime)
+        if args.format == "json":
+            print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+        else:
+            print(report.render(), file=sys.stderr)
+        return report.exit_code
 
     if args.list_rules:
         for rule in ktlint.ALL_RULES:

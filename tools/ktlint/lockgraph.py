@@ -1,0 +1,793 @@
+"""ktsan, static half: a repo-wide lock-order graph and the
+interprocedural ``*_locked`` contract.
+
+ktlint's per-file rules (KT002) see one function at a time; the bugs
+PR 6 made possible live BETWEEN functions and modules — the apiserver
+holds its state lock and calls into the store, the watch-cache seeds
+under its set lock while listing the store, the WAL group commit
+crosses three locks. This pass builds one picture of all of it:
+
+1. **Lock inventory.** Every ``threading.Lock/RLock/Condition`` or
+   ``sanitizer.lock/rlock`` assigned to ``self.<attr>`` (or a module
+   global). Sanitizer-factory locks contribute their runtime NAME as
+   the graph node, so the static graph and a runtime graph dumped by
+   ``KT_SANITIZE_REPORT`` merge on identical nodes.
+2. **Ordering edges.** ``with a: with b:`` nesting (lexical), plus
+   interprocedural closure: a call made while holding ``a`` to a
+   function whose transitive acquisitions include ``b`` adds
+   ``a -> b``. Call resolution covers ``self.m()``, ``self.attr.m()``
+   via constructor-assignment type inference, module functions, and a
+   unique-definer fallback for other receivers (skipped when the
+   method name is defined by more than one class).
+3. **Cycles (KTSAN01).** Strongly connected components of the merged
+   (static + optional runtime) graph — each is a potential deadlock.
+4. **``*_locked`` contract (KTSAN02/KTSAN03).** A call to any
+   ``*_locked`` function must lexically hold the target class's
+   contract lock (its ``_lock``, or its only lock) — or the caller is
+   itself ``*_locked`` on the same contract, or is ``__init__``
+   (construction is single-threaded by convention). And a ``*_locked``
+   body must never re-acquire its own contract lock (re-entrancy
+   masks ordering bugs and double-pays even when the lock is an
+   RLock).
+
+Findings accept the standard ``# ktlint: disable=KTSAN02`` pragma on
+the offending line (or the line above). There is deliberately no
+baseline: the tree must be clean.
+
+Entry points: :func:`analyze` (library; bench.py embeds its counts)
+and ``python -m tools.ktlint --lock-graph [--runtime-graph FILE]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.ktlint.framework import (
+    REPO_ROOT,
+    attr_chain,
+    is_suppressed,
+    iter_files,
+    pragma_map,
+    relpath_of,
+)
+from tools.ktlint.framework import Finding
+
+_THREADING_FACTORIES = {"Lock", "RLock", "Condition"}
+_SAN_FACTORIES = {"lock": False, "rlock": True}  # name -> reentrant
+
+#: Method names too generic for unique-definer call resolution even
+#: when only one class currently defines them — a collision with a
+#: future class would silently flip resolution.
+_COMMON_NAMES = {
+    "get", "put", "list", "add", "update", "delete", "close", "start",
+    "stop", "run", "push", "pop", "next", "send", "clear", "items",
+}
+
+
+@dataclass
+class LockDef:
+    node: str  # graph node name (sanitizer name, or module.Class.attr)
+    attr: str
+    path: str
+    line: int
+    reentrant: bool
+    io_gate: bool
+
+
+@dataclass
+class ClassInfo:
+    module: str  # dotted module ("kubernetes_tpu.store.kvstore")
+    name: str
+    path: str
+    locks: Dict[str, LockDef] = field(default_factory=dict)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    attr_class: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.module}.{self.name}"
+
+    def contract_node(self) -> Optional[str]:
+        """The lock the class's ``*_locked`` suffix names: ``_lock``
+        when present, else the only lock, else undeterminable."""
+        if "_lock" in self.locks:
+            return self.locks["_lock"].node
+        if len(self.locks) == 1:
+            return next(iter(self.locks.values())).node
+        return None
+
+
+@dataclass
+class CallSite:
+    target_key: Optional[str]  # resolved summary key, or None
+    target_cls: Optional[ClassInfo]
+    target_name: str
+    held: Tuple[str, ...]
+    path: str
+    line: int
+
+
+@dataclass
+class FnSummary:
+    key: str  # "module.Class.method" / "module.func"
+    cls: Optional[ClassInfo]
+    name: str
+    path: str
+    line: int
+    direct: List[Tuple[str, int]] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    kind: str  # "static" | "static-call" | "runtime"
+    site: str
+    count: int = 1
+
+
+@dataclass
+class LockGraphReport:
+    locks: List[LockDef] = field(default_factory=list)
+    edges: List[Edge] = field(default_factory=list)
+    cycles: List[dict] = field(default_factory=list)
+    violations: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    runtime_findings: List[dict] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.cycles or self.violations or
+                     self.runtime_findings) else 0
+
+    def counts(self) -> Dict[str, int]:
+        out = {"KTSAN01": len(self.cycles), "KTSAN02": 0, "KTSAN03": 0}
+        for v in self.violations:
+            out[v.rule] = out.get(v.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "locks": [
+                {"node": l.node, "path": l.path, "line": l.line,
+                 "reentrant": l.reentrant, "io_gate": l.io_gate}
+                for l in self.locks
+            ],
+            "edges": [
+                {"from": e.src, "to": e.dst, "kind": e.kind,
+                 "site": e.site, "count": e.count}
+                for e in self.edges
+            ],
+            "cycles": self.cycles,
+            "violations": [
+                {"rule": v.rule, "path": v.path, "line": v.line,
+                 "message": v.message}
+                for v in self.violations
+            ],
+            "counts": self.counts(),
+            "suppressed": self.suppressed,
+            "runtime_findings": self.runtime_findings,
+        }
+
+    def render(self) -> str:
+        lines = [
+            f"lock graph: {len(self.locks)} locks, {len(self.edges)} "
+            f"ordering edges ({sum(1 for e in self.edges if e.kind == 'runtime')}"
+            " runtime-observed)",
+        ]
+        for e in sorted(self.edges, key=lambda e: (e.src, e.dst)):
+            lines.append(f"  {e.src} -> {e.dst}  [{e.kind}] {e.site}")
+        if self.cycles:
+            lines.append(f"CYCLES ({len(self.cycles)}):")
+            for c in self.cycles:
+                lines.append(f"  KTSAN01 {' -> '.join(c['path'])}")
+                for s in c.get("sites", []):
+                    lines.append(f"    {s}")
+        for v in self.violations:
+            lines.append(f"{v.render()}")
+        for f in self.runtime_findings:
+            lines.append(f"RUNTIME {f.get('kind')}: {f}")
+        lines.append(
+            f"ktsan: {len(self.cycles)} cycle(s), "
+            f"{len(self.violations)} contract violation(s), "
+            f"{len(self.runtime_findings)} runtime finding(s) "
+            f"({self.suppressed} suppressed)"
+        )
+        return "\n".join(lines)
+
+
+# -- lock constructor detection ----------------------------------------
+
+
+def lock_ctor_info(value: ast.AST) -> Optional[dict]:
+    """{"name", "reentrant", "io_gate"} when `value` constructs a lock
+    (threading.* or sanitizer factory, possibly behind an IfExp or
+    wrapped in threading.Condition(...)), else None."""
+    if isinstance(value, ast.IfExp):
+        return lock_ctor_info(value.body) or lock_ctor_info(value.orelse)
+    if not isinstance(value, ast.Call):
+        return None
+    chain = attr_chain(value.func)
+    if not chain:
+        return None
+    tail = chain[-1]
+    if tail == "Condition" and value.args:
+        inner = lock_ctor_info(value.args[0])
+        if inner:
+            return inner
+        ref = attr_chain(value.args[0])
+        if ref:
+            # Condition(self._lock) / Condition(_LOCK): the condition
+            # wraps an EXISTING lock — same runtime object, so it must
+            # resolve to the same graph node, not a phantom sibling
+            # (otherwise a static edge through the condition and a
+            # runtime edge through the lock never merge into a cycle).
+            return {
+                "name": None, "reentrant": False, "io_gate": False,
+                "alias": ref[-1], "alias_self": ref[0] == "self",
+            }
+    if tail in _THREADING_FACTORIES:
+        return {"name": None, "reentrant": tail == "RLock", "io_gate": False}
+    if tail in _SAN_FACTORIES and len(chain) >= 2 and chain[-2] == "sanitizer":
+        name = None
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and isinstance(value.args[0].value, str):
+            name = value.args[0].value
+        io_gate = any(
+            kw.arg == "io_gate" and isinstance(kw.value, ast.Constant)
+            and bool(kw.value.value)
+            for kw in value.keywords
+        )
+        return {"name": name, "reentrant": _SAN_FACTORIES[tail],
+                "io_gate": io_gate}
+    return None
+
+
+def _self_attr(node: ast.AST) -> str:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+# -- index --------------------------------------------------------------
+
+
+class _Index:
+    def __init__(self):
+        self.classes: Dict[str, ClassInfo] = {}  # qual -> info
+        self.by_name: Dict[str, List[ClassInfo]] = {}
+        self.module_locks: Dict[Tuple[str, str], LockDef] = {}
+        self.module_funcs: Dict[str, Tuple[ast.AST, str]] = {}  # key->(fn,path)
+        self.method_definers: Dict[str, List[ClassInfo]] = {}
+        self.pragmas: Dict[str, Dict[int, frozenset]] = {}  # relpath->map
+
+    def class_by_simple_name(self, name: Optional[str]) -> Optional[ClassInfo]:
+        if not name:
+            return None
+        hits = self.by_name.get(name, ())
+        return hits[0] if len(hits) == 1 else None
+
+    def unique_definer(self, method: str) -> Optional[ClassInfo]:
+        if method in _COMMON_NAMES or method.startswith("__"):
+            return None
+        hits = self.method_definers.get(method, ())
+        return hits[0] if len(hits) == 1 else None
+
+
+def _module_of(relpath: str) -> str:
+    return relpath.replace("\\", "/").removesuffix(".py").replace("/", ".")
+
+
+def _index_file(idx: _Index, tree: ast.Module, relpath: str) -> None:
+    module = _module_of(relpath)
+    mod_stem = module.rsplit(".", 1)[-1]
+    idx.pragmas[relpath] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            info = lock_ctor_info(node.value)
+            if info:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        alias = info.get("alias")
+                        if alias and not info.get("alias_self"):
+                            target = idx.module_locks.get((module, alias))
+                            if target is not None:
+                                idx.module_locks[(module, t.id)] = target
+                                continue
+                        nodename = info["name"] or f"{mod_stem}.{t.id}"
+                        idx.module_locks[(module, t.id)] = LockDef(
+                            nodename, t.id, relpath, node.lineno,
+                            info["reentrant"], info["io_gate"],
+                        )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx.module_funcs[f"{module}.{node.name}"] = (node, relpath)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = ClassInfo(module, node.name, relpath)
+        aliases: List[Tuple[str, str, int]] = []  # (attr, target attr, line)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                info = lock_ctor_info(sub.value)
+                for t in sub.targets:
+                    attr = _self_attr(t)
+                    if not attr:
+                        continue
+                    if info:
+                        alias = info.get("alias")
+                        if alias and info.get("alias_self"):
+                            # Resolved after the walk: the wrapped lock
+                            # attr may be assigned later in the class.
+                            aliases.append((attr, alias, sub.lineno))
+                            continue
+                        nodename = (
+                            info["name"] or f"{mod_stem}.{node.name}.{attr}"
+                        )
+                        ci.locks[attr] = LockDef(
+                            nodename, attr, relpath, sub.lineno,
+                            info["reentrant"], info["io_gate"],
+                        )
+                    else:
+                        cls_name = _ctor_class_name(sub.value)
+                        if cls_name and attr not in ci.attr_class:
+                            ci.attr_class[attr] = cls_name
+        for attr, target_attr, lineno in aliases:
+            target = ci.locks.get(target_attr)
+            if target is not None:
+                ci.locks[attr] = target
+            else:
+                ci.locks[attr] = LockDef(
+                    f"{mod_stem}.{node.name}.{attr}", attr, relpath,
+                    lineno, False, False,
+                )
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[item.name] = item
+        idx.classes[ci.qual] = ci
+        idx.by_name.setdefault(ci.name, []).append(ci)
+        for m in ci.methods:
+            idx.method_definers.setdefault(m, []).append(ci)
+
+
+def _ctor_class_name(value: ast.AST) -> Optional[str]:
+    """Class simple name when `value` looks like ClassName(...) (also
+    through `x or ClassName(...)` / IfExp) — the light type inference
+    behind self.<attr>.method() resolution."""
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            got = _ctor_class_name(v)
+            if got:
+                return got
+        return None
+    if isinstance(value, ast.IfExp):
+        return _ctor_class_name(value.body) or _ctor_class_name(value.orelse)
+    if isinstance(value, ast.Call):
+        chain = attr_chain(value.func)
+        if chain and chain[-1][:1].isupper():
+            return chain[-1]
+    return None
+
+
+# -- per-function analysis ---------------------------------------------
+
+
+class _Analyzer:
+    def __init__(self, idx: _Index):
+        self.idx = idx
+        self.summaries: Dict[str, FnSummary] = {}
+        self.edges: Dict[Tuple[str, str, str], Edge] = {}
+        self.violations: List[Finding] = []
+        self.suppressed = 0
+
+    # .. resolution ....................................................
+
+    def _resolve_lock_expr(
+        self, expr: ast.AST, cls: Optional[ClassInfo], module: str
+    ) -> Optional[LockDef]:
+        chain = attr_chain(expr)
+        if not chain:
+            return None
+        if chain[0] == "self" and cls is not None:
+            if len(chain) == 2:
+                return cls.locks.get(chain[1])
+            if len(chain) == 3:
+                target = self.idx.class_by_simple_name(
+                    cls.attr_class.get(chain[1])
+                )
+                if target:
+                    return target.locks.get(chain[2])
+            return None
+        if len(chain) == 1:
+            return self.idx.module_locks.get((module, chain[0]))
+        return None
+
+    def _resolve_call(
+        self, call: ast.Call, cls: Optional[ClassInfo], module: str
+    ) -> Tuple[Optional[str], Optional[ClassInfo], str]:
+        """(summary key or None, target class or None, method name)."""
+        chain = attr_chain(call.func)
+        if not chain:
+            return None, None, ""
+        name = chain[-1]
+        if len(chain) == 1:
+            key = f"{module}.{name}"
+            if key in self.idx.module_funcs:
+                return key, None, name
+            target = self.idx.class_by_simple_name(name)
+            if target and "__init__" in target.methods:
+                return f"{target.qual}.__init__", target, "__init__"
+            return None, None, name
+        if chain[0] == "self" and cls is not None:
+            if len(chain) == 2:
+                if name in cls.methods:
+                    return f"{cls.qual}.{name}", cls, name
+                return None, cls, name
+            if len(chain) == 3:
+                target = self.idx.class_by_simple_name(
+                    cls.attr_class.get(chain[1])
+                )
+                if target and name in target.methods:
+                    return f"{target.qual}.{name}", target, name
+                return None, target, name
+        # Fallback: obj.m() with exactly one definer repo-wide.
+        target = self.idx.unique_definer(name)
+        if target:
+            return f"{target.qual}.{name}", target, name
+        return None, None, name
+
+    # .. walking .......................................................
+
+    def analyze_function(
+        self, fn, cls: Optional[ClassInfo], module: str, relpath: str,
+        key: str,
+    ) -> None:
+        held: Tuple[str, ...] = ()
+        if cls is not None and fn.name.endswith("_locked"):
+            c = cls.contract_node()
+            if c:
+                held = (c,)
+        summary = FnSummary(key, cls, fn.name, relpath, fn.lineno)
+        self.summaries[key] = summary
+        self._visit_block(fn.body, held, summary, cls, module, relpath)
+
+    def _visit_block(self, stmts, held, summary, cls, module, relpath):
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._collect_calls(
+                        item.context_expr, held, summary, cls, module, relpath
+                    )
+                acquired: List[LockDef] = []
+                for item in st.items:
+                    ld = self._resolve_lock_expr(item.context_expr, cls, module)
+                    if ld is not None:
+                        acquired.append(ld)
+                for ld in acquired:
+                    self._on_acquire(
+                        held, ld, summary, cls, relpath, st.lineno
+                    )
+                new = held + tuple(
+                    ld.node for ld in acquired if ld.node not in held
+                )
+                self._visit_block(st.body, new, summary, cls, module, relpath)
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Closures run on the same threads by convention here
+                # (KT002 makes the same call) — analyze under the
+                # current held set.
+                self._visit_block(
+                    st.body, held, summary, cls, module, relpath
+                )
+                continue
+            for node in self._own_exprs(st):
+                self._collect_calls(
+                    node, held, summary, cls, module, relpath, walk=False
+                )
+            for fld in ("body", "orelse", "finalbody"):
+                sub = getattr(st, fld, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    self._visit_block(sub, held, summary, cls, module, relpath)
+            for h in getattr(st, "handlers", ()):
+                self._visit_block(h.body, held, summary, cls, module, relpath)
+
+    @staticmethod
+    def _own_exprs(st: ast.stmt):
+        """Every AST node belonging to `st` except nested statement
+        blocks (those get their own _visit_block pass)."""
+        blocked = {"body", "orelse", "finalbody", "handlers"}
+        stack: List[ast.AST] = []
+        for fld, value in ast.iter_fields(st):
+            if fld in blocked:
+                continue
+            if isinstance(value, ast.AST):
+                stack.append(value)
+            elif isinstance(value, list):
+                stack.extend(v for v in value if isinstance(v, ast.AST))
+        out = []
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _collect_calls(
+        self, node, held, summary, cls, module, relpath, walk=True
+    ):
+        nodes = ast.walk(node) if walk else (node,)
+        for n in nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            key, target_cls, name = self._resolve_call(n, cls, module)
+            summary.calls.append(
+                CallSite(key, target_cls, name, held, relpath, n.lineno)
+            )
+            if name.endswith("_locked"):
+                self._check_locked_call(
+                    summary, cls, target_cls, name, held, relpath, n.lineno
+                )
+
+    def _on_acquire(self, held, ld: LockDef, summary, cls, relpath, line):
+        summary.direct.append((ld.node, line))
+        contract = cls.contract_node() if cls else None
+        if (
+            summary.name.endswith("_locked")
+            and contract is not None
+            and ld.node == contract
+        ):
+            self._violation(
+                "KTSAN03", relpath, line,
+                f"{summary.key.rsplit('.', 2)[-2]}.{summary.name} "
+                f"re-acquires its own contract lock {ld.node} — the "
+                "_locked suffix promises the caller already holds it "
+                "(re-entrancy masks ordering bugs even on an RLock)",
+            )
+            return
+        for h in held:
+            if h == ld.node:
+                continue
+            self._edge(h, ld.node, "static", f"{relpath}:{line}")
+
+    def _edge(self, src: str, dst: str, kind: str, site: str) -> None:
+        k = (src, dst, kind)
+        hit = self.edges.get(k)
+        if hit is None:
+            self.edges[k] = Edge(src, dst, kind, site)
+        else:
+            hit.count += 1
+
+    def _check_locked_call(
+        self, summary, cls, target_cls, name, held, relpath, line
+    ):
+        if summary.name == "__init__":
+            return  # construction is single-threaded by convention
+        if target_cls is None:
+            target_cls = self.idx.unique_definer(name)
+        if target_cls is None or name not in target_cls.methods:
+            return  # unresolvable receiver — runtime half covers it
+        contract = target_cls.contract_node()
+        if contract is None:
+            return
+        if contract in held:
+            return
+        self._violation(
+            "KTSAN02", relpath, line,
+            f"call to {target_cls.name}.{name}() without holding its "
+            f"contract lock {contract} on this path — *_locked means "
+            "the CALLER holds the lock (take it, or rename the callee "
+            "if the contract no longer applies)",
+        )
+
+    def _violation(self, rule, relpath, line, message):
+        f = Finding(rule, relpath, line, message)
+        pragmas = self.idx.pragmas.get(relpath, {})
+        if is_suppressed(f, pragmas):
+            self.suppressed += 1
+        else:
+            self.violations.append(f)
+
+    # .. interprocedural closure .......................................
+
+    def propagate(self) -> None:
+        """Fixpoint transitive acquisitions, then call-site edges."""
+        acq: Dict[str, Set[str]] = {
+            k: {n for n, _ in s.direct} for k, s in self.summaries.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for k, s in self.summaries.items():
+                cur = acq[k]
+                for cs in s.calls:
+                    if cs.target_key and cs.target_key in acq:
+                        extra = acq[cs.target_key] - cur
+                        if extra:
+                            cur |= extra
+                            changed = True
+        for k, s in self.summaries.items():
+            for cs in s.calls:
+                if not cs.target_key or cs.target_key not in acq:
+                    continue
+                for h in cs.held:
+                    for L in acq[cs.target_key]:
+                        if L == h:
+                            continue
+                        self._edge(
+                            h, L, "static-call",
+                            f"{cs.path}:{cs.line} via {cs.target_name}()",
+                        )
+
+
+# -- cycles -------------------------------------------------------------
+
+
+def _find_cycles(edges: Sequence[Edge]) -> List[dict]:
+    adj: Dict[str, List[Tuple[str, Edge]]] = {}
+    nodes: Set[str] = set()
+    for e in edges:
+        adj.setdefault(e.src, []).append((e.dst, e))
+        nodes.add(e.src)
+        nodes.add(e.dst)
+
+    # Tarjan SCC (iterative).
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, iter(adj.get(v0, ())))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w, _e in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+
+    out = []
+    for comp in sccs:
+        compset = set(comp)
+        # One concrete cycle path inside the SCC for the report.
+        start = sorted(comp)[0]
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxt = None
+            for w, _e in adj.get(cur, ()):
+                if w in compset and (w == start or w not in seen):
+                    nxt = w
+                    break
+            if nxt is None or nxt == start:
+                path.append(start)
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+        sites = []
+        for a, b in zip(path, path[1:]):
+            for e in adj.get(a, ()):
+                if e[0] == b:
+                    sites.append(f"{a} -> {b}: [{e[1].kind}] {e[1].site}")
+                    break
+        out.append({
+            "rule": "KTSAN01",
+            "nodes": sorted(comp),
+            "path": path,
+            "sites": sites,
+        })
+    return out
+
+
+# -- entry point --------------------------------------------------------
+
+
+def analyze(
+    paths: Sequence = (),
+    runtime: Optional[dict] = None,
+) -> LockGraphReport:
+    """Run the whole-tree lock-graph analysis. `runtime` is an
+    optional sanitizer report dict ({"edges": [...], "findings":
+    [...]}, the KT_SANITIZE_REPORT format) merged into the graph."""
+    roots = [pathlib.Path(p) for p in paths] or [REPO_ROOT / "kubernetes_tpu"]
+    idx = _Index()
+    parsed: List[Tuple[ast.Module, str]] = []
+    for path in iter_files(roots):
+        try:
+            src = path.read_text()
+            tree = ast.parse(src, filename=str(path))
+        except (OSError, SyntaxError, ValueError):
+            continue
+        relpath = relpath_of(path)
+        parsed.append((tree, relpath))
+        _index_file(idx, tree, relpath)
+        idx.pragmas[relpath] = pragma_map(src.splitlines())
+
+    ana = _Analyzer(idx)
+    for tree, relpath in parsed:
+        module = _module_of(relpath)
+        for ci in [c for c in idx.classes.values() if c.path == relpath]:
+            for mname, fn in ci.methods.items():
+                ana.analyze_function(
+                    fn, ci, module, relpath, f"{ci.qual}.{mname}"
+                )
+        for key, (fn, fpath) in idx.module_funcs.items():
+            if fpath == relpath and key.rsplit(".", 1)[0] == module:
+                ana.analyze_function(fn, None, module, relpath, key)
+    ana.propagate()
+
+    report = LockGraphReport()
+    seen_locks = set()
+    for ci in idx.classes.values():
+        for ld in ci.locks.values():
+            if ld.node not in seen_locks:
+                seen_locks.add(ld.node)
+                report.locks.append(ld)
+    for ld in idx.module_locks.values():
+        if ld.node not in seen_locks:
+            seen_locks.add(ld.node)
+            report.locks.append(ld)
+    report.locks.sort(key=lambda l: l.node)
+
+    edges = list(ana.edges.values())
+    if runtime:
+        for e in runtime.get("edges", ()):
+            edges.append(Edge(
+                e["from"], e["to"], "runtime",
+                e.get("site", ""), int(e.get("count", 1)),
+            ))
+        report.runtime_findings = list(runtime.get("findings", ()))
+    report.edges = edges
+    report.cycles = _find_cycles(edges)
+    report.violations = sorted(
+        ana.violations, key=lambda f: (f.path, f.line, f.rule)
+    )
+    report.suppressed = ana.suppressed
+    return report
+
+
+def load_runtime_report(path) -> dict:
+    return json.loads(pathlib.Path(path).read_text())

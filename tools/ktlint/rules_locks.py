@@ -36,7 +36,19 @@ def _is_lock_ctor(node: ast.AST) -> bool:
         return _is_lock_ctor(node.body) or _is_lock_ctor(node.orelse)
     if isinstance(node, ast.Call):
         chain = attr_chain(node.func)
-        return bool(chain) and chain[-1] in _LOCK_FACTORIES
+        if not chain:
+            return False
+        if chain[-1] in _LOCK_FACTORIES:
+            # threading.Condition(sanitizer.lock(...)) is still a lock.
+            return True
+        # The ktsan factory (utils/sanitizer.py): sanitizer.lock("name")
+        # / sanitizer.rlock("name") — adopted components must not fall
+        # out of KT002's lock-attr inventory.
+        return (
+            len(chain) >= 2
+            and chain[-2] == "sanitizer"
+            and chain[-1] in {"lock", "rlock"}
+        )
     return False
 
 

@@ -1,0 +1,252 @@
+"""KT006 — kernel/oracle parity registration.
+
+Every ``jax.jit``-decorated function under ``kubernetes_tpu/ops/``
+must have a registered NumPy oracle twin in
+``kubernetes_tpu/ops/parity.py`` (ORACLE_TWINS), and the registry must
+stay live: oracles must resolve to real functions, suites must exist
+and actually mention what they claim to exercise, and stale keys
+(kernels that no longer exist) are findings too.
+
+Pure-AST on purpose: the CLI lints the whole tree in milliseconds
+without importing jax. The runtime complement (imports + getattr over
+the same registry) lives in tests/test_ktsan.py.
+
+Finding placement: missing-twin findings attach to the kernel's def
+line in its ops file; registry-health findings attach to the entry's
+line in parity.py — both sites accept the usual ``# ktlint:
+disable=KT006`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+from tools.ktlint.framework import (
+    REPO_ROOT,
+    FileContext,
+    Finding,
+    Rule,
+    attr_chain,
+)
+
+OPS_DIR = "kubernetes_tpu/ops"
+REGISTRY_PATH = "kubernetes_tpu/ops/parity.py"
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """jax.jit / jit bare, or functools.partial(jax.jit, ...) /
+    partial(jit, ...), or jax.jit(...) used as a decorator factory."""
+    chain = attr_chain(dec)
+    if chain and chain[-1] == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        fchain = attr_chain(dec.func)
+        if fchain and fchain[-1] == "jit":
+            return True
+        if fchain and fchain[-1] == "partial" and dec.args:
+            achain = attr_chain(dec.args[0])
+            return bool(achain) and achain[-1] == "jit"
+    return False
+
+
+def jitted_kernels(tree: ast.Module, module_stem: str) -> List[Tuple[str, int]]:
+    """[(registry key, lineno)] for every jitted def/assignment in one
+    ops module. Nested defs key through their enclosing functions:
+    'preemption._victim_prefix_kernel.kernel'."""
+    out: List[Tuple[str, int]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}{child.name}"
+                if any(_is_jit_decorator(d) for d in child.decorator_list):
+                    out.append((f"{module_stem}.{name}", child.lineno))
+                visit(child, name + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.Assign) and isinstance(
+                child.value, ast.Call
+            ):
+                fchain = attr_chain(child.value.func)
+                if fchain and fchain[-1] == "jit":
+                    for t in child.targets:
+                        if isinstance(t, ast.Name):
+                            out.append(
+                                (f"{module_stem}.{prefix}{t.id}", child.lineno)
+                            )
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _load_registry(path: pathlib.Path) -> Tuple[Dict[str, dict], Dict[str, int]]:
+    """(entries, key -> lineno) parsed from ORACLE_TWINS' dict literal.
+    Raises ValueError when the registry is missing or not a literal."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "ORACLE_TWINS"
+            for t in node.targets
+        ):
+            if not isinstance(node.value, ast.Dict):
+                raise ValueError("ORACLE_TWINS must be a dict literal")
+            entries: Dict[str, dict] = {}
+            lines: Dict[str, int] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ):
+                    raise ValueError("ORACLE_TWINS keys must be str literals")
+                entries[k.value] = ast.literal_eval(v)
+                lines[k.value] = k.lineno
+            return entries, lines
+    raise ValueError("ORACLE_TWINS not found")
+
+
+def _function_defined_in(path: pathlib.Path, func: str) -> bool:
+    """Does `path` define (top-level, or as an assignment alias)
+    `func`? AST check — no import."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError, ValueError):
+        return False
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == func:
+                return True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == func:
+                    return True
+    return False
+
+
+def resolve_oracle(ref: str) -> Optional[pathlib.Path]:
+    """File defining the dotted oracle `ref`, or None. The module part
+    resolves under kubernetes_tpu/ first (registry refs are package-
+    relative), then from the repo root (tests.* helpers)."""
+    if "." not in ref:
+        return None
+    modpath, func = ref.rsplit(".", 1)
+    rel = modpath.replace(".", "/") + ".py"
+    for cand in (REPO_ROOT / "kubernetes_tpu" / rel, REPO_ROOT / rel):
+        if cand.exists() and _function_defined_in(cand, func):
+            return cand
+    return None
+
+
+class OracleTwinRule(Rule):
+    id = "KT006"
+    title = "jitted ops kernels must have a registered NumPy oracle twin"
+
+    def __init__(self):
+        self._kernel_index: Optional[Dict[str, Tuple[str, int]]] = None
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath.replace("\\", "/").startswith(OPS_DIR)
+
+    # -- shared indexes (built once per process) -----------------------
+
+    def _kernels_in_tree(self) -> Dict[str, Tuple[str, int]]:
+        """registry key -> (relpath, lineno) over the whole ops dir
+        (the stale-key check needs the full inventory regardless of
+        which files this run lints)."""
+        if self._kernel_index is None:
+            idx: Dict[str, Tuple[str, int]] = {}
+            for path in sorted((REPO_ROOT / OPS_DIR).glob("*.py")):
+                try:
+                    tree = ast.parse(path.read_text(), filename=str(path))
+                except (OSError, SyntaxError, ValueError):
+                    continue
+                for key, line in jitted_kernels(tree, path.stem):
+                    idx[key] = (f"{OPS_DIR}/{path.name}", line)
+            self._kernel_index = idx
+        return self._kernel_index
+
+    # -- the pass ------------------------------------------------------
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        reg_path = REPO_ROOT / REGISTRY_PATH
+        try:
+            entries, entry_lines = _load_registry(reg_path)
+        except (OSError, ValueError) as e:
+            # Attach the broken-registry finding to whichever ops file
+            # we're linting — every kernel is unverifiable without it.
+            return [ctx.finding(self.id, 1, f"ops/parity.py unusable: {e}")]
+
+        out: List[Finding] = []
+        if ctx.relpath.replace("\\", "/") == REGISTRY_PATH:
+            out.extend(self._check_registry(ctx, entries, entry_lines))
+            return out
+
+        module_stem = pathlib.Path(ctx.relpath).stem
+        for key, line in jitted_kernels(ctx.tree, module_stem):
+            if key not in entries:
+                out.append(
+                    ctx.finding(
+                        self.id,
+                        line,
+                        f"jitted kernel {key} has no NumPy oracle twin "
+                        "registered in ops/parity.py ORACLE_TWINS "
+                        "(kernels land WITH their referee or not at all)",
+                    )
+                )
+        return out
+
+    def _check_registry(
+        self, ctx: FileContext, entries: Dict[str, dict],
+        entry_lines: Dict[str, int],
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        kernels = self._kernels_in_tree()
+        for key, entry in entries.items():
+            line = entry_lines.get(key, 1)
+            if key not in kernels:
+                out.append(
+                    ctx.finding(
+                        self.id, line,
+                        f"ORACLE_TWINS entry {key!r} matches no jitted "
+                        "kernel in ops/ (stale after a rename/removal?)",
+                    )
+                )
+                continue
+            oracle = entry.get("oracle", "")
+            if not oracle or resolve_oracle(oracle) is None:
+                out.append(
+                    ctx.finding(
+                        self.id, line,
+                        f"ORACLE_TWINS[{key!r}].oracle = {oracle!r} does "
+                        "not resolve to a defined function",
+                    )
+                )
+            suite_rel = entry.get("suite", "")
+            suite = REPO_ROOT / suite_rel
+            if not suite_rel or not suite.exists():
+                out.append(
+                    ctx.finding(
+                        self.id, line,
+                        f"ORACLE_TWINS[{key!r}].suite = {suite_rel!r} "
+                        "does not exist",
+                    )
+                )
+                continue
+            src = suite.read_text()
+            mentions = [key.rsplit(".", 1)[-1]]
+            if oracle:
+                mentions.append(oracle.rsplit(".", 1)[-1])
+            if entry.get("exercised_as"):
+                mentions.append(entry["exercised_as"])
+            if not any(m in src for m in mentions):
+                out.append(
+                    ctx.finding(
+                        self.id, line,
+                        f"suite {suite_rel} never mentions "
+                        f"{' / '.join(sorted(set(mentions)))} — the "
+                        f"registered twin for {key} is not exercised",
+                    )
+                )
+        return out
