@@ -537,9 +537,33 @@ def _api_churn_figure(
         "bind_latency_nodes": n_nodes,
         "bind_rate_requested": rate,
         "bind_tick_mode": mode,
-        "bind_latency_slo": (
-            "pass" if p99 < gate_s and unbound == 0 else "FAIL"
+        # Engine verdict (utils/slo.py BENCH_OBJECTIVES, target tuned
+        # by gate_s): the p99 gate, worsened to "burn" outright when
+        # any created pod never bound — a cluster that sheds pods
+        # cannot pass its latency SLO on the survivors.
+        "bind_latency_slo": _slo.worst(
+            _slo.verdict_for_value(
+                _slo.with_target(
+                    _slo.BENCH_OBJECTIVES["bind_latency_slo"], gate_s
+                ),
+                p99,
+            ),
+            "burn" if unbound else "pass",
         ),
+    }
+    # The production SLO engine's own report over this drill: the
+    # apiserver ran in THIS process, so the always-on SLI collector
+    # (utils/sli.py) watched every create/bind through the same
+    # dispatcher feed production uses. Embedding it proves bench and
+    # /debug/slo read one truth.
+    report = _slo.evaluate()
+    fig["slo_verdict"] = report["verdict"]
+    fig["slo_report"] = {
+        o["name"]: {
+            k: o[k] for k in ("p50", "p99", "value", "samples", "verdict")
+            if k in o
+        }
+        for o in report["objectives"]
     }
     print(
         f"# api-churn: {len(lats)} pods bound through HTTP control plane "
@@ -614,10 +638,14 @@ def _bulk_churn_figure(duration_s: float = 8.0, batch: int = 1024) -> dict:
         # rate then excludes fan-out cost and must not be trusted.
         "churn_api_watch_complete": result["watch_added_seen"] >= created,
         "churn_api_slo_target": CHURN_API_SLO_PODS_PER_SEC,
-        "churn_api_slo": (
-            "pass" if rate >= CHURN_API_SLO_PODS_PER_SEC
-            and result["watch_added_seen"] >= created
-            else "warn"
+        # Engine verdict (utils/slo.py). An incomplete watch means the
+        # rate excludes fan-out cost — the figure can't be trusted, so
+        # the verdict is at best "warn" regardless of the rate.
+        "churn_api_slo": _slo.worst(
+            _slo.verdict_for_value(
+                _slo.BENCH_OBJECTIVES["churn_api_slo"], rate
+            ),
+            "pass" if result["watch_added_seen"] >= created else "warn",
         ),
     }
     print(
@@ -980,9 +1008,14 @@ def _parity_figures() -> dict:
 
 #: Warn-only SLO thresholds for the API-plane drills (ISSUE 6): the
 #: achieved figures and these targets are BOTH recorded in the bench
-#: JSON; missing a target flags "warn", never fails the run.
-CHURN_API_SLO_PODS_PER_SEC = 25000
-POD_CRUD_SLO_OPS_PER_SEC = 20000
+#: JSON; missing a target flags "warn", never fails the run. Since
+#: PR 9 the definitions live in the production SLO engine
+#: (utils/slo.BENCH_OBJECTIVES) so bench and `ktctl slo` can never
+#: disagree; these module constants just surface the targets.
+from kubernetes_tpu.utils import slo as _slo  # noqa: E402
+
+CHURN_API_SLO_PODS_PER_SEC = _slo.BENCH_OBJECTIVES["churn_api_slo"].target
+POD_CRUD_SLO_OPS_PER_SEC = _slo.BENCH_OBJECTIVES["pod_crud_slo"].target
 
 
 def _crud_figure(n_workers: int, n_tasks: int, batch: int = 256) -> dict:
@@ -1103,8 +1136,10 @@ def _crud_figure(n_workers: int, n_tasks: int, batch: int = 256) -> dict:
             "crud_workers": n_workers,
             "crud_batch": batch,
             "pod_crud_slo_target": POD_CRUD_SLO_OPS_PER_SEC,
-            "pod_crud_slo": (
-                "pass" if rate >= POD_CRUD_SLO_OPS_PER_SEC else "warn"
+            # Engine verdict (utils/slo.py): the warn-severity floor —
+            # identical definition production serves at /debug/slo.
+            "pod_crud_slo": _slo.verdict_for_value(
+                _slo.BENCH_OBJECTIVES["pod_crud_slo"], rate
             ),
         }
     finally:

@@ -752,6 +752,8 @@ def cmd_top(client: Client, args) -> int:
     import urllib.error
     import urllib.request
 
+    if args.what == "cluster":
+        return _cmd_top_cluster(client, args)
     nodes, _ = client.list("nodes")
     if args.what == "nodes":
         print(f"{'NAME':20}{'PODS':6}{'RSS':>12}{'DISK-USED':>11}")
@@ -1101,6 +1103,117 @@ def cmd_explain(client: Client, args) -> int:
     return 0
 
 
+def _fetch_slo_report(client: Client, args) -> Dict:
+    """The SLO report: GET /debug/slo over HTTP transports, or the
+    process-local engine for injected LocalTransport clients (same
+    split as `ktctl trace` / `ktctl explain`)."""
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        return get_json("/debug/slo")
+    from kubernetes_tpu.utils import slo
+
+    return slo.evaluate()
+
+
+def _render_slo_table(report: Dict) -> List[str]:
+    lines = [
+        f"{'OBJECTIVE':24}{'SERIES':34}{'P50':>9}{'P99':>9}"
+        f"{'TARGET':>9}{'SAMPLES':>9}  VERDICT"
+    ]
+    for o in report.get("objectives", ()):
+        series = o.get("series", "")
+        labels = o.get("labels") or {}
+        if labels:
+            inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            series = f"{series}{{{inner}}}"
+
+        def num(v):
+            return "-" if v is None else f"{v:.4g}"
+
+        lines.append(
+            f"{o.get('name', ''):24}{series:34}"
+            f"{num(o.get('p50')):>9}"
+            f"{num(o.get('p99', o.get('value'))):>9}"
+            f"{num(o.get('target')):>9}"
+            f"{o.get('samples', 0):>9}  {o.get('verdict', '')}"
+        )
+    lines.append(f"overall: {report.get('verdict', 'no_data')}")
+    return lines
+
+
+def cmd_slo(client: Client, args) -> int:
+    """`ktctl slo` — per-objective service-level verdicts from the SLO
+    engine (GET /debug/slo): pod-startup milestone watermarks, watch
+    fan-out lag, and solver device telemetry with pass/warn/burn
+    verdicts. Exits 1 with 'no SLI samples recorded' when no objective
+    has samples yet (mirror of the trace/explain miss contract)."""
+    report = _fetch_slo_report(client, args)
+    if not report.get("sampled"):
+        # Clean nonzero exit, empty stdout: a script gating on SLOs
+        # must see that nothing has been measured, not a hollow pass.
+        print("no SLI samples recorded", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(report, default_flow_style=False))
+        return 0
+    for line in _render_slo_table(report):
+        print(line)
+    return 0
+
+
+#: /metrics series prefixes `ktctl top cluster` surfaces (the telemetry
+#: plane's device/solver/watch families, not the whole exposition).
+_TOP_CLUSTER_PREFIXES = (
+    "pod_startup_latency_seconds",
+    "watch_fanout_lag_versions",
+    "watch_streams_dropped_total",
+    "watch_stream_queue_depth",
+    "scheduler_informer_staleness_seconds",
+    "solver_device_transfer_bytes_total",
+    "solver_xla_",
+    "device_memory_bytes",
+)
+
+
+def _cmd_top_cluster(client: Client, args) -> int:
+    """`ktctl top cluster` — the cluster-level resource view: SLO
+    verdict table plus the raw telemetry-plane series from /metrics
+    (device memory, transfer bytes, compile cache, watch fan-out)."""
+    report = _fetch_slo_report(client, args)
+    for line in _render_slo_table(report):
+        print(line)
+    transport = client.t
+    if getattr(transport, "get_json", None) is not None and args.server:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{args.server}/metrics",
+            headers=getattr(args, "_auth_headers", {}) or {},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+    else:
+        from kubernetes_tpu.utils import metrics as _metrics
+
+        text = _metrics.DEFAULT.render()
+    shown = [
+        line
+        for line in text.splitlines()
+        if not line.startswith("#")
+        and line.startswith(_TOP_CLUSTER_PREFIXES)
+    ]
+    if shown:
+        print()
+        print("TELEMETRY")
+        for line in shown:
+            print(line)
+    return 0
+
+
 def cmd_config(client: Client, args) -> int:
     """Reference: pkg/kubectl/cmd/config/ — view / set-cluster /
     set-credentials / set-context / use-context / set / unset over the
@@ -1285,8 +1398,11 @@ def build_parser() -> argparse.ArgumentParser:
     ee.set_defaults(fn=cmd_exec)
 
     tp = sub.add_parser("top", parents=[common])
-    tp.add_argument("what", choices=["nodes", "pods"])
+    tp.add_argument("what", choices=["nodes", "pods", "cluster"])
     tp.set_defaults(fn=cmd_top)
+
+    sl = sub.add_parser("slo", parents=[common])
+    sl.set_defaults(fn=cmd_slo)
 
     tc = sub.add_parser("trace", parents=[common])
     tc.add_argument("name", nargs="?", help="pod name (omit for all)")

@@ -410,6 +410,10 @@ class Reflector:
         # wire dicts for deletes.
         self.decode_deleted = decode_deleted
         self.last_sync_version = 0
+        # Monotonic time this reflector last processed a delta or
+        # relist — the scheduler daemons' informer-staleness SLI
+        # (utils/sli.INFORMER_STALENESS) reads it per solve tick.
+        self.last_event_mono = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._synced = threading.Event()
@@ -478,6 +482,7 @@ class Reflector:
                         vanished.append(old)
         self.store.replace(objs)
         self.last_sync_version = version
+        self.last_event_mono = time.monotonic()
         self._synced.set()
         if self.on_event:
             for o in vanished:
@@ -530,6 +535,7 @@ class Reflector:
                 obj = ev.object
             if ev.version:
                 self.last_sync_version = ev.version
+            self.last_event_mono = time.monotonic()
             if ev.type == ADDED:
                 self.store.add(obj)
             elif ev.type == MODIFIED:

@@ -737,8 +737,30 @@ class Kubelet:
         )
         if not pod.status.start_time:
             pod.status.start_time = now_iso()
+        # Ready-transition timestamping (telemetry plane): stamp
+        # lastTransitionTime when the condition FLIPS and carry the
+        # prior stamp when it doesn't — the Running/Ready instant must
+        # survive every later status rewrite, and re-stamping each sync
+        # would defeat status dedup below (a self-sustaining write
+        # loop). pod.status still holds the server's view here (the
+        # private copy above), so prev_ready is the stored condition.
+        ready_str = "True" if ready else "False"
+        prev_ready = next(
+            (c for c in pod.status.conditions or () if c.type == "Ready"),
+            None,
+        )
+        transition = (
+            prev_ready.last_transition_time
+            if prev_ready is not None
+            and prev_ready.status == ready_str
+            and prev_ready.last_transition_time
+            else now_iso()
+        )
         pod.status.conditions = [
-            PodCondition(type="Ready", status="True" if ready else "False")
+            PodCondition(
+                type="Ready", status=ready_str,
+                last_transition_time=transition,
+            )
         ]
         pod.status.container_statuses = statuses
         # Status dedup (reference: status_manager.go) — an unchanged

@@ -308,6 +308,12 @@ class ContainerStatus:
 class PodCondition:
     type: str = ""
     status: str = ""
+    # Wall-clock of the last status flip (reference: v1.PodCondition
+    # .lastTransitionTime). The kubelet stamps it when the condition
+    # changes and CARRIES it over when it doesn't, so the Running/Ready
+    # transition instant survives status rewrites — the telemetry
+    # plane's wire-visible startup timestamp (utils/sli.py).
+    last_transition_time: str = ""
 
 
 @dataclass

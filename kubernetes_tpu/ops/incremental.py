@@ -309,6 +309,9 @@ class SolverSession:
     # -- device transfer ----------------------------------------------
 
     def _upload_all(self) -> Dict[str, jnp.ndarray]:
+        from kubernetes_tpu.utils import sli
+
+        sli.note_transfer("h2d", sli.nbytes_of(self.h))
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
@@ -326,6 +329,9 @@ class SolverSession:
         width = _bucket(len(idx), minimum=8)
         padded = idx + [idx[-1]] * (width - len(idx))
         rows = {k: self.h[k][padded] for k in self.h}
+        from kubernetes_tpu.utils import sli
+
+        sli.note_transfer("h2d", sli.nbytes_of(rows))
         self.dev = _scatter_rows(
             self.dev, jnp.asarray(padded, dtype=jnp.int32), rows
         )
@@ -433,7 +439,11 @@ class SolverSession:
                 )
         out: List[Tuple[str, Optional[str]]] = []
         with tracing.phase("readback"):
-            picks = np.asarray(assignment)[: len(pending)]
+            from kubernetes_tpu.utils import sli
+
+            full = np.asarray(assignment)
+            sli.note_transfer("d2h", full.nbytes)
+            picks = full[: len(pending)]
             # Telemetry scalars convert AFTER the assignment copy
             # blocked — no extra device sync on the tick path.
             self.last_stats = {}
@@ -556,6 +566,9 @@ class SolverSession:
                 arr["pinned"][i] = -1
             arr["svc"][i] = lp.svc
             arr["svc_ids"][i, : len(lp.svc_topk)] = lp.svc_topk
+        from kubernetes_tpu.utils import sli
+
+        sli.note_transfer("h2d", sli.nbytes_of(arr))
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 

@@ -81,6 +81,12 @@ def _put_tree(arrs: Dict[str, np.ndarray], sharding) -> Dict[str, jnp.ndarray]:
     shipping zeros through the tunnel."""
     zeros = {k: v for k, v in arrs.items() if v.size > 4096 and not v.any()}
     rest = {k: v for k, v in arrs.items() if k not in zeros}
+    if rest:
+        # Transfer SLI (utils/sli.py): what actually ships host->device
+        # — the all-zero leaves materialize on device and move nothing.
+        from kubernetes_tpu.utils import sli
+
+        sli.note_transfer("h2d", sli.nbytes_of(rest))
     out = dict(jax.device_put(rest, sharding)) if rest else {}
     for k, v in zeros.items():
         out[k] = jnp.zeros(v.shape, dtype=v.dtype, device=sharding)

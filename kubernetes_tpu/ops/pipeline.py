@@ -35,7 +35,7 @@ from kubernetes_tpu.ops.matrices import (
     shardings_for,
 )
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
-from kubernetes_tpu.utils import sanitizer, tracing
+from kubernetes_tpu.utils import sanitizer, sli, tracing
 
 # Measured on v5e-1 at 50k x 5k with the pallas scan kernel: 12544
 # (4 chunks) walls 0.61-0.66s vs 0.88-0.96s at 8192 and 0.71-0.76s at
@@ -117,6 +117,9 @@ def solve_backlog_pipelined(
         builder = SnapshotBuilder(pending, nodes, assigned, services)
         node_sharding, pod_sharding = shardings_for(mesh)
     with tracing.phase("upload"):
+        # h2d transfer SLI is counted once, inside matrices._put_tree
+        # (which device_nodes/device_pods funnel through) — counting
+        # the host columns here too would double the metric.
         carry = device_nodes(
             builder.node_columns(), node_sharding,
             node_mult=node_axis_multiple(mesh),
@@ -168,10 +171,14 @@ def solve_backlog_pipelined(
         names = [n.metadata.name for n in builder.nodes]
         result: List[Optional[str]] = []
         n_nodes = len(builder.nodes)
+        d2h = 0
         for assignment, count in outs:
-            picks = np.asarray(assignment)[:count]
+            full = np.asarray(assignment)
+            d2h += full.nbytes
+            picks = full[:count]
             for j in picks.tolist():
                 result.append(names[j] if 0 <= j < n_nodes else None)
+        sli.note_transfer("d2h", d2h)
         if tele:
             from kubernetes_tpu.utils import flightrecorder
 

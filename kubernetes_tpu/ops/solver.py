@@ -434,6 +434,9 @@ def solve_assignments(
     if weights is None:
         weights = dsnap.weights
     out = np.asarray(solve(dsnap.pods, dsnap.nodes, weights, dsnap.lowered))
+    from kubernetes_tpu.utils import sli
+
+    sli.note_transfer("d2h", out.nbytes)
     out = out[: dsnap.n_pods]
     # Padding nodes can never be chosen (schedulable=False), but clamp
     # defensively so a bug can't leak a phantom index.

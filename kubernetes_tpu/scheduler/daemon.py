@@ -35,7 +35,7 @@ from kubernetes_tpu.scheduler.plugins import (
 _LOG = logging.getLogger("kubernetes_tpu.scheduler")
 from kubernetes_tpu.scheduler.types import StaticNodeLister, StaticServiceLister
 from kubernetes_tpu.server.api import APIError
-from kubernetes_tpu.utils import flightrecorder, metrics, tracing
+from kubernetes_tpu.utils import flightrecorder, metrics, sli, tracing
 from kubernetes_tpu.utils.ratelimit import Backoff, TokenBucket
 
 # Histograms (were summaries): bucketed latencies aggregate across
@@ -855,6 +855,26 @@ class BatchScheduler(Scheduler):
         _PREEMPT_NOMINATED.set(len(self._nominations))
         return granted
 
+    def _observe_informer_staleness(self) -> None:
+        """Set scheduler_informer_staleness_seconds per informer:
+        seconds since each watch-fed cache last processed a delta or
+        relist. Under churn a growing value means this daemon is
+        solving against an increasingly stale cluster view (a quiet
+        cluster legitimately grows it too — read it against event
+        rates, see docs/architecture.md)."""
+        cfg = self.config
+        now = time.monotonic()
+        for resource, ref in (
+            ("pods_pending", cfg._pod_reflector),
+            ("pods_scheduled", cfg.scheduled_pods.reflector),
+            ("nodes", cfg.nodes.reflector),
+            ("services", cfg.services.reflector),
+            ("podgroups", cfg.podgroups.reflector),
+        ):
+            ts = getattr(ref, "last_event_mono", 0.0)
+            if ts:
+                sli.INFORMER_STALENESS.set(now - ts, resource=resource)
+
     # -- flight recorder ----------------------------------------------
 
     def _record_decisions(
@@ -870,6 +890,11 @@ class BatchScheduler(Scheduler):
         this tick's binds — the incremental daemon's shape)."""
         if not rows:
             return
+        # Post-solve telemetry sample (utils/sli.py): the compile-cache
+        # sentinel reflects THIS tick's compiles next to its phase
+        # histograms (the every-tick pre-drain sample in schedule_batch
+        # covers idle/stalled ticks).
+        sli.observe_device_telemetry()
         # Wave/sinkhorn batch solves return placements only; their
         # convergence figures were parked by observe_solve_telemetry —
         # consume them (once) so this tick's SolveRecord carries them.
@@ -902,6 +927,14 @@ class BatchScheduler(Scheduler):
                 pod=key, tick=tick, trace_id=trace_id, mode=self.mode,
                 outcome=outcome, node=dest or "", group=gkey or "",
             )
+        # Announce outcomes to decision sinks NOW (SLI "decision"
+        # milestone, utils/sli.py) — the explain readback below may
+        # stall seconds on a first-bucket XLA compile, and a fast pod
+        # can complete its whole lifecycle in that window. record()
+        # re-announces; sinks are idempotent by contract.
+        flightrecorder.notify_decision_sinks(
+            (d.pod, d.outcome) for d in decisions.values()
+        )
         limit = flightrecorder.explain_limit()
         # Non-default policies have no device explain lowering (the
         # readback evaluates the default pipeline), and sidecar daemons
@@ -983,6 +1016,13 @@ class BatchScheduler(Scheduler):
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
         """One drain+solve+commit cycle; returns pods processed."""
         t_drain = time.monotonic()
+        # Telemetry sample EVERY tick, idle ones included: a wedged
+        # informer produces empty ticks, and a staleness gauge that
+        # only updates on busy ticks would freeze at a healthy value
+        # exactly when the feed it watches stalls. (_record_decisions
+        # samples again post-solve for compile-cache freshness.)
+        self._observe_informer_staleness()
+        sli.observe_device_telemetry()
         pending = self._drain(timeout)
         if not pending:
             return 0
@@ -1313,6 +1353,9 @@ class IncrementalBatchScheduler(BatchScheduler):
 
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
         t_drain = time.monotonic()
+        # Every-tick telemetry sample — see BatchScheduler.schedule_batch.
+        self._observe_informer_staleness()
+        sli.observe_device_telemetry()
         pending = self._drain(timeout)
         if not pending:
             # Keep the session current while idle so the next burst

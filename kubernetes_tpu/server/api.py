@@ -364,6 +364,15 @@ class APIServer:
         from kubernetes_tpu.server.watchcache import WatchCacheSet
 
         self.caches = WatchCacheSet(self.store)
+        # Lifecycle SLI collector (utils/sli.py): the process-global
+        # collector rides the SAME dispatcher feed as the watch cache —
+        # pod events become pod_startup_latency_seconds milestone
+        # watermarks with zero polling and zero extra copies. Always
+        # on (tests/test_sli.py pins its cost under 5% of the bulk
+        # churn drill's per-pod budget).
+        from kubernetes_tpu.utils import sli
+
+        sli.DEFAULT.attach(self.store)
         # Reentrant: admission plugins may issue writes of their own
         # (NamespaceAutoprovision creates the namespace mid-admission).
         from kubernetes_tpu.utils import sanitizer

@@ -32,7 +32,7 @@ from kubernetes_tpu import __version__
 from kubernetes_tpu.models import conversion
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.server.registry import RESOURCES
-from kubernetes_tpu.utils import metrics, tracing
+from kubernetes_tpu.utils import metrics, sli, tracing
 
 _REQS = metrics.DEFAULT.counter(
     "apiserver_request_count", "API requests by verb/resource/code",
@@ -232,6 +232,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "application/json",
             )
             return
+        if rest == ("slo",):
+            # The SLO engine over the live metrics registry: per-
+            # objective pass/warn/burn verdicts (utils/slo.py; the data
+            # behind `ktctl slo` and the check.sh SLO smoke).
+            from kubernetes_tpu.utils import slo
+
+            self._send_text(
+                200, json.dumps(slo.evaluate()), "application/json"
+            )
+            return
         if rest == ("requests",):
             body = debug.DEFAULT_REQUEST_LOG.render()
         elif rest == ("stacks",):
@@ -247,7 +257,7 @@ class _Handler(BaseHTTPRequestHandler):
                 404, "NotFound",
                 "debug endpoints: /debug/requests /debug/stacks "
                 "/debug/profile /debug/traces /debug/decisions "
-                "/debug/solves",
+                "/debug/solves /debug/slo",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
 
@@ -1194,6 +1204,19 @@ class _Handler(BaseHTTPRequestHandler):
                         )
                 self.wfile.write(b"".join(out))
                 self.wfile.flush()
+                # Fan-out lag SLI: how many store versions this
+                # connection's delivery trails ITS resource's applied
+                # watermark by (one observation per burst, not per
+                # event). Filtered streams — selector OR namespace
+                # scoped — are skipped: events filtered out of their
+                # view never advance the delivered version, which
+                # would read as permanent false lag against the
+                # resource-wide watermark.
+                last_v = batch[-1].version
+                if last_v and not ns and not lsel and not fsel:
+                    applied = self.api.caches.applied_version(resource)
+                    if applied:
+                        sli.observe_watch_lag(resource, applied - last_v)
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
         finally:

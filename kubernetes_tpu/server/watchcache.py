@@ -228,6 +228,14 @@ class WatchCacheSet:
         # on purpose: two stores' clocks both start at 1.
         self._frame_lock = sanitizer.lock("watchcache.frames")
         self._frames: Dict[Tuple[str, int], bytes] = {}
+        # Per-resource applied watermark: highest event version seen
+        # FOR each resource (keyed by the '/registry/<res>/' segment).
+        # The fan-out lag SLI compares a stream's delivered version
+        # against ITS resource's watermark — comparing against the
+        # global `applied` would charge a caught-up services watch
+        # with every pod write's version (false SLO warns). Plain dict:
+        # single writer (the dispatcher), GIL-atomic reads.
+        self._applied_by_resource: Dict[str, int] = {}
         store.subscribe(self._on_event)
 
     def _on_event(
@@ -237,9 +245,19 @@ class WatchCacheSet:
             if key.startswith(prefix):
                 cache.apply(version, etype, key, obj)
                 break
+        # key shape '/registry/<resource>/...' — split bounded at 3.
+        parts = key.split("/", 3)
+        if len(parts) > 2:
+            self._applied_by_resource[parts[2]] = version
         with self._applied_cond:
             self.applied = version
             self._applied_cond.notify_all()
+
+    def applied_version(self, resource: str) -> int:
+        """Highest event version the feed has processed for ONE
+        resource (0 = no event seen yet) — the fan-out lag SLI's
+        comparison point."""
+        return self._applied_by_resource.get(resource, 0)
 
     def wait_applied(self, version: int, timeout: float = 2.0) -> bool:
         """Block until the feed has processed every event <= version."""

@@ -1164,7 +1164,11 @@ class KVStore:
                         f"version {since} compacted "
                         f"(oldest {self._oldest if self._history else self._version})"
                     )
-            stream = WatchStream(maxsize=maxsize)
+            from kubernetes_tpu.store.watch import resource_of_prefix
+
+            stream = WatchStream(
+                maxsize=maxsize, resource=resource_of_prefix(prefix)
+            )
             if since:
                 for v, etype, key, obj in self._history:
                     if v > since and key.startswith(prefix):

@@ -8,15 +8,53 @@ store pushes into; consumers iterate or poll with timeouts.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from dataclasses import dataclass
 from typing import Any, Iterator, List, Optional
 
+from kubernetes_tpu.utils import metrics
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 ERROR = "ERROR"
+
+_LOG = logging.getLogger("kubernetes_tpu.store.watch")
+
+#: Slow-consumer watch streams dropped (each forces the consumer to
+#: re-list). This drop used to be SILENT — a bulk churn drill would
+#: quietly lose its watch and report rates that excluded fan-out cost.
+STREAMS_DROPPED = metrics.DEFAULT.counter(
+    "watch_streams_dropped_total",
+    "Watch streams dropped for falling behind (slow consumers)",
+    ("resource",),
+)
+
+#: Sampled event-queue depth per resource — a rough backpressure gauge
+#: (deep queues mean consumers are trailing the dispatcher and drops
+#: are near). Updated every 64 queued events and at the drop site, NOT
+#: per push: the fan-out path is the hot path PR 6 burst-coalesced,
+#: and a healthy (shallow) queue is exactly the case that needs zero
+#: added cost. One gauge per resource, not per stream: label
+#: cardinality must not scale with watcher count.
+QUEUE_DEPTH = metrics.DEFAULT.gauge(
+    "watch_stream_queue_depth",
+    "Sampled watch stream queue depth (every 64 queued events and at "
+    "slow-consumer drops), by resource",
+    ("resource",),
+)
+
+
+def resource_of_prefix(prefix: str) -> str:
+    """The resource name inside a registry key prefix
+    ('/registry/pods/default/' -> 'pods'); the prefix itself when the
+    shape is foreign (metric label fallback)."""
+    parts = prefix.strip("/").split("/")
+    if len(parts) >= 2 and parts[0] == "registry":
+        return parts[1]
+    return prefix
 
 
 @dataclass
@@ -35,7 +73,7 @@ class Event:
 class WatchStream:
     """One consumer's view of a watch. Closed by either side."""
 
-    def __init__(self, maxsize: int = 4096):
+    def __init__(self, maxsize: int = 4096, resource: str = ""):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize)
         self._closed = threading.Event()
         # Version floor: events at or below it are silently dropped.
@@ -43,6 +81,9 @@ class WatchStream:
         # thread's backlog (events the registration-time replay already
         # covered) can never be double-delivered or re-ordered.
         self.floor = 0
+        #: Resource this stream watches (metric label for the drop
+        #: counter / depth gauge; "" for anonymous broadcast streams).
+        self.resource = resource
 
     def push(self, ev: Event) -> bool:
         if self._closed.is_set():
@@ -51,10 +92,24 @@ class WatchStream:
             return True  # already covered by replay — drop, stay open
         try:
             self._q.put_nowait(ev)
+            depth = self._q.qsize()
+            if not depth & 63:  # sampled: zero cost while shallow
+                QUEUE_DEPTH.set(depth, resource=self.resource)
             return True
         except queue.Full:
             # Slow consumer: drop the stream (reference watchers are also
-            # terminated and must re-list; Reflector handles that).
+            # terminated and must re-list; Reflector handles that) —
+            # OBSERVABLY: the counter + warn log are what tell an
+            # operator the churn figures just stopped including this
+            # consumer's fan-out cost.
+            STREAMS_DROPPED.inc(resource=self.resource)
+            QUEUE_DEPTH.set(self._q.qsize(), resource=self.resource)
+            _LOG.warning(
+                "dropping slow watch consumer (resource=%r, version "
+                "floor=%d, queue depth=%d/%d); it must re-list",
+                self.resource, self.floor, self._q.qsize(),
+                self._q.maxsize,
+            )
             self.close()
             return False
 
@@ -98,7 +153,7 @@ class Broadcaster:
         self._streams: List[WatchStream] = []
 
     def watch(self, maxsize: int = 4096) -> WatchStream:
-        s = WatchStream(maxsize=maxsize)
+        s = WatchStream(maxsize=maxsize, resource="broadcast")
         with self._lock:
             self._streams.append(s)
         return s

@@ -106,6 +106,34 @@ def take_last_solve_telemetry() -> Optional[dict]:
         return tele
 
 
+#: Decision sinks: callables invoked (pod_key, outcome) for every
+#: Decision the recorder logs — the SLI collector (utils/sli.py) joins
+#: its "decision" lifecycle milestone here. Called OUTSIDE the ring
+#: lock; sinks must be fast and never raise (raises are swallowed).
+_DECISION_SINKS: List = []
+
+
+def add_decision_sink(fn) -> None:
+    """Sinks MUST be idempotent per pod key: a decision is announced
+    once early (notify_decision_sinks, pre-explain) and again when the
+    finished records land (record())."""
+    _DECISION_SINKS.append(fn)
+
+
+def notify_decision_sinks(pods_outcomes) -> None:
+    """Early decision announcement: the daemons call this the moment a
+    tick's outcomes are known, BEFORE the bounded explain readback —
+    whose first-bucket XLA compile can outlast a fast pod's entire
+    lifecycle, which would lose the SLI decision milestone (the track
+    drains on Running)."""
+    for pod, outcome in pods_outcomes:
+        for sink in _DECISION_SINKS:
+            try:
+                sink(pod, outcome)
+            except Exception:
+                pass  # a broken sink must not sink the tick
+
+
 _CONFIG = {
     # Decision ring bound (newest win). 4096 decisions with bounded
     # verdicts is a few MB — sized so a burst drain can't evict the
@@ -275,6 +303,11 @@ class FlightRecorder:
                 del self._decisions[: len(self._decisions) - cap]
         for d in decisions:
             DECISIONS_TOTAL.inc(outcome=d.outcome)
+            for sink in _DECISION_SINKS:
+                try:
+                    sink(d.pod, d.outcome)
+                except Exception:
+                    pass  # a broken sink must not sink the tick
 
     def record_solve(self, rec: SolveRecord) -> None:
         with self._lock:

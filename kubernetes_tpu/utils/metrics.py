@@ -12,7 +12,7 @@ from __future__ import annotations
 import math
 import random
 import threading
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.utils import sanitizer
 
@@ -280,6 +280,12 @@ class Registry:
     def register(self, metric: _Metric) -> _Metric:
         with self._lock:
             return self._metrics.setdefault(metric.name, metric)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        """The registered metric by name, or None (the SLO engine's
+        series lookup — utils/slo.py)."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def counter(self, name, help_="", labels=()) -> Counter:
         return self.register(Counter(name, help_, labels))  # type: ignore

@@ -1,0 +1,278 @@
+"""Declarative SLO engine over the metrics registry.
+
+An :class:`Objective` names a metric series, a percentile, and a
+target; :func:`evaluate` turns the registry's current window into
+pass / warn / burn verdicts. One engine serves every consumer —
+``GET /debug/slo`` on the apiserver, ``ktctl slo`` / ``ktctl top
+cluster``, the check.sh SLO smoke, and bench.py's gates — so
+production and bench can never disagree about what an SLO means
+(the pre-PR-9 state: bench.py derived its own ``bind_latency_slo`` /
+``churn_api_slo`` / ``pod_crud_slo`` math inline).
+
+Verdict ladder (worst wins):
+
+    pass     within target (and outside the warn band)
+    no_data  the series has no samples in the current window
+    warn     inside the warn band, or a warn-severity objective breached
+    burn     a gate-severity objective breached (error budget burning)
+
+Objective kinds:
+
+    quantile_max  series percentile must stay <= target (latency SLOs;
+                  histograms/summaries — multiple matching label sets
+                  evaluate as the WORST set, like HighLatencyRequests)
+    counter_max   the summed counter must stay <= target (e.g. zero
+                  dropped watch streams)
+    value_max     a directly supplied figure must stay <= target
+    value_min     a directly supplied figure must stay >= target
+                  (throughput floors; bench's churn/CRUD gates)
+
+Windows: the underlying series are cumulative since their last
+``reset()``; ``window_s`` documents the objective's intended
+evaluation window (SLO gates and benches open fresh windows by
+resetting the series, exactly how ``reset_request_latency`` works for
+the HighLatencyRequests gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kubernetes_tpu.utils import metrics
+
+#: Verdict severity order — worst() picks the rightmost.
+_RANK = {"pass": 0, "no_data": 1, "warn": 2, "burn": 3}
+
+
+def worst(*verdicts: str) -> str:
+    """The most severe of the given verdicts (pass < no_data < warn <
+    burn); 'no_data' when none are given."""
+    out = None
+    for v in verdicts:
+        if out is None or _RANK.get(v, 0) > _RANK.get(out, 0):
+            out = v
+    return out if out is not None else "no_data"
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One service-level objective against one metric series."""
+
+    name: str
+    series: str
+    target: float
+    kind: str = "quantile_max"  # quantile_max|counter_max|value_max|value_min
+    percentile: float = 0.99
+    #: Label filter as (name, value) pairs (hashable for frozen);
+    #: partial filters evaluate the worst matching label set.
+    labels: Tuple[Tuple[str, str], ...] = ()
+    #: gate -> breach is "burn"; warn -> breach is only ever "warn"
+    #: (advisory objectives, like bench's throughput floors on CI CPUs).
+    severity: str = "gate"
+    #: For max kinds: values above warn_ratio*target verdict "warn"
+    #: before the target is breached. 0 disables the warn band.
+    warn_ratio: float = 0.75
+    #: Intended evaluation window (documentation; series are cumulative
+    #: since their last reset — see module docstring).
+    window_s: float = 0.0
+    description: str = ""
+
+
+def verdict_for_value(obj: Objective, value: Optional[float]) -> str:
+    """Verdict for a directly supplied figure (bench.py's entry point;
+    also the final step of every registry evaluation)."""
+    if value is None or (isinstance(value, float) and math.isnan(value)):
+        return "no_data"
+    breach = "warn" if obj.severity == "warn" else "burn"
+    if obj.kind == "value_min":
+        return "pass" if value >= obj.target else breach
+    if value > obj.target:
+        return breach
+    if (
+        obj.kind in ("quantile_max", "value_max")
+        and obj.warn_ratio
+        and value > obj.warn_ratio * obj.target
+    ):
+        return "warn"
+    return "pass"
+
+
+def _matching_label_sets(metric, labels: Dict[str, str]):
+    """Label-value dicts of the metric's live series matching the
+    (possibly partial) filter."""
+    for values in metric.label_values():
+        lm = dict(zip(metric.label_names, values))
+        if all(lm.get(k) == v for k, v in labels.items()):
+            yield lm
+
+
+def evaluate_objective(obj: Objective, registry=None) -> dict:
+    """Evaluate one objective against the registry's current window.
+    Returns a dict entry for the SLO report: measured value, p50/p99
+    context, sample count, and the verdict."""
+    registry = metrics.DEFAULT if registry is None else registry
+    labels = dict(obj.labels)
+    entry = {
+        "name": obj.name,
+        "series": obj.series,
+        "kind": obj.kind,
+        "target": obj.target,
+        "severity": obj.severity,
+        "samples": 0,
+    }
+    if labels:
+        entry["labels"] = labels
+    if obj.kind.startswith("quantile"):
+        entry["percentile"] = obj.percentile
+    if obj.description:
+        entry["description"] = obj.description
+    metric = registry.get(obj.series) if hasattr(registry, "get") else None
+    if metric is None:
+        entry["verdict"] = "no_data"
+        return entry
+    value: Optional[float] = None
+    if obj.kind == "counter_max":
+        # A counter with no series yet IS zero (nothing has been
+        # counted): verdict pass, but samples stay 0 so the report's
+        # `sampled` flag (the ktctl slo miss contract) is untouched.
+        total = 0.0
+        for lm in _matching_label_sets(metric, labels):
+            total += metric.value(**lm)
+        value = total
+        entry["samples"] = int(total)
+    elif obj.kind == "quantile_max":
+        samples = 0
+        p50 = None
+        for lm in _matching_label_sets(metric, labels):
+            q = metric.quantile(obj.percentile, **lm)
+            if math.isnan(q):
+                continue
+            # Worst matching label set carries the verdict — the
+            # HighLatencyRequests shape for partially-filtered series.
+            if value is None or q > value:
+                value = q
+            q50 = metric.quantile(0.5, **lm)
+            if not math.isnan(q50) and (p50 is None or q50 > p50):
+                p50 = q50
+            count = getattr(metric, "count", None)
+            samples += count(**lm) if count is not None else 0
+        entry["samples"] = samples
+        if p50 is not None:
+            entry["p50"] = round(p50, 6)
+        if value is not None:
+            entry["p99" if obj.percentile >= 0.99 else "value"] = round(
+                value, 6
+            )
+    else:
+        # value_max / value_min objectives have no registry series to
+        # read — they verdict figures the caller supplies
+        # (verdict_for_value); evaluating them here reports no_data.
+        entry["verdict"] = "no_data"
+        return entry
+    if value is not None:
+        entry["value"] = round(value, 6)
+    entry["verdict"] = verdict_for_value(obj, value)
+    return entry
+
+
+#: The cluster's default objective set — what /debug/slo serves and
+#: ``ktctl slo`` renders. Latency targets are the reference's e2e bars
+#: (99% of scheduling decisions < 1 s, docs/roadmap.md; density.go's
+#: 5 s pod-startup watermark); the advisory (warn-severity) objectives
+#: chart direction without failing CI CPU boxes.
+DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
+    Objective(
+        "pod_startup_latency", "pod_startup_latency_seconds", target=5.0,
+        labels=(("milestone", "running"),),
+        description="watch-visible create -> kubelet Running, p99",
+    ),
+    Objective(
+        "pod_bound_latency", "pod_startup_latency_seconds", target=1.0,
+        labels=(("milestone", "bound"),),
+        description="watch-visible create -> binding visible, p99 "
+        "(the reference's 99%-in-1s scheduling SLO)",
+    ),
+    Objective(
+        "pod_decision_latency", "pod_startup_latency_seconds", target=1.0,
+        labels=(("milestone", "decision"),), severity="warn",
+        description="watch-visible create -> flight-recorder decision, p99",
+    ),
+    Objective(
+        "watch_fanout_lag", "watch_fanout_lag_versions", target=4096.0,
+        severity="warn", warn_ratio=0.0,
+        description="store versions a watch delivery trails the applied "
+        "watermark by, p99",
+    ),
+    Objective(
+        "watch_stream_drops", "watch_streams_dropped_total",
+        kind="counter_max", target=0.0,
+        description="slow-consumer watch streams dropped (forced relists)",
+    ),
+    Objective(
+        "solve_phase_latency", "scheduler_phase_seconds", target=1.0,
+        labels=(("phase", "solve"),), severity="warn",
+        description="device solve dispatch phase, p99",
+    ),
+    Objective(
+        "solver_compile_churn", "solver_xla_compiles_total",
+        kind="counter_max", target=64.0, severity="warn",
+        description="XLA solver compiles observed; shape-bucket padding "
+        "keeps this bounded (PR-7 recompilation sentinel)",
+    ),
+)
+
+
+#: Bench gate objectives (bench.py reads targets AND verdicts from
+#: here; tests/test_bind_latency.py asserts the figures carry these
+#: verdicts). The throughput floors are warn-severity: they chart the
+#: API-plane targets (ROADMAP item 1) without failing CPU CI boxes.
+BENCH_OBJECTIVES: Dict[str, Objective] = {
+    "bind_latency_slo": Objective(
+        "bind_latency_slo", "bind_latency_p99_s", target=1.0,
+        kind="value_max", warn_ratio=0.0,
+        description="p99 create -> binding watch-visible over the real "
+        "HTTP control plane",
+    ),
+    "churn_api_slo": Objective(
+        "churn_api_slo", "churn_api_pods_per_sec", target=25000.0,
+        kind="value_min", severity="warn",
+        description="API-plane bulk churn ingestion floor",
+    ),
+    "pod_crud_slo": Objective(
+        "pod_crud_slo", "pod_crud_ops_per_sec", target=20000.0,
+        kind="value_min", severity="warn",
+        description="bulk CRUD ops floor over HTTP",
+    ),
+}
+
+
+def evaluate(
+    objectives: Optional[Iterable[Objective]] = None, registry=None
+) -> dict:
+    """Evaluate the objective set into an SLOReport dict (the
+    /debug/slo response shape): per-objective entries plus the overall
+    worst verdict and whether ANY objective has samples (``sampled`` —
+    the ``ktctl slo`` empty-cluster miss contract keys on it)."""
+    objectives = DEFAULT_OBJECTIVES if objectives is None else objectives
+    entries: List[dict] = [
+        evaluate_objective(o, registry=registry) for o in objectives
+    ]
+    # Overall verdict: worst MEASURED verdict — an objective with no
+    # data yet must not drag a healthy cluster's overall to no_data
+    # (it stays visible per-objective); all-no_data reports no_data.
+    measured = [e["verdict"] for e in entries if e["verdict"] != "no_data"]
+    return {
+        "kind": "SLOReport",
+        "verdict": worst(*measured) if measured else "no_data",
+        "sampled": any(e["samples"] for e in entries),
+        "objectives": entries,
+    }
+
+
+def with_target(obj: Objective, target: float) -> Objective:
+    """The objective with a different target (bench knobs like
+    ``gate_s`` tune the gate without forking the definition)."""
+    return dataclasses.replace(obj, target=float(target))

@@ -66,6 +66,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "slo: SLI/SLO telemetry-plane tests (lifecycle collector, watch "
+        "fan-out lag/drops, SLO engine, ktctl slo); tier-1 includes "
+        "them — select just these with -m slo",
+    )
+    config.addinivalue_line(
+        "markers",
         "sanitize: run this test with the ktsan lock sanitizer enabled "
         "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
         "finding or leaked non-daemon thread; the concurrency-heavy "

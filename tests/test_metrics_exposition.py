@@ -302,6 +302,101 @@ def test_decision_and_convergence_metrics_exposed():
     )
 
 
+def test_lint_metrics_knows_sli_names(tmp_path):
+    """The SLI/SLO telemetry-plane family (utils/sli.py,
+    store/watch.py, scheduler/daemon.py) is known to the linter: the
+    suffixed series pass the standard rule on their own, the unit-less
+    ones (queue depth, version lag, compile-cache entries) are
+    explicitly allowlisted, and a novel suffix-less name still fails
+    (the allowlist names metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, SLI_METRICS
+
+    assert SLI_METRICS == {
+        "pod_startup_latency_seconds",
+        "watch_streams_dropped_total",
+        "watch_stream_queue_depth",
+        "watch_fanout_lag_versions",
+        "scheduler_informer_staleness_seconds",
+        "solver_device_transfer_bytes_total",
+        "solver_xla_compiles_total",
+        "solver_xla_compile_cache_entries",
+        "device_memory_bytes",
+    }
+    assert SLI_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.histogram('
+        '"pod_startup_latency_seconds", "x", ("milestone",))\n'
+        'B = metrics.DEFAULT.counter('
+        '"watch_streams_dropped_total", "x", ("resource",))\n'
+        'C = metrics.DEFAULT.gauge('
+        '"watch_stream_queue_depth", "x", ("resource",))\n'
+        'D = metrics.DEFAULT.histogram('
+        '"watch_fanout_lag_versions", "x", ("resource",))\n'
+        'E = metrics.DEFAULT.gauge("solver_xla_compile_cache_entries", "x")\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("watch_backlog", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_sli_metrics_exposed():
+    """Exposition golden for the telemetry-plane family: the milestone
+    histogram renders cumulative +le buckets, the drop counter escapes
+    hostile label values, and the lag/depth/device series render on
+    metrics.DEFAULT with their declared types."""
+    from kubernetes_tpu.store import watch as watchmod
+    from kubernetes_tpu.utils import sli
+
+    sli.STARTUP_LATENCY.observe(0.007, milestone="exposition_m")
+    sli.STARTUP_LATENCY.observe(0.2, milestone="exposition_m")
+    watchmod.STREAMS_DROPPED.inc(resource='we"ird\\res\nx')
+    watchmod.QUEUE_DEPTH.set(3, resource="exposition_r")
+    sli.observe_watch_lag("exposition_r", 5)
+    sli.TRANSFER_BYTES.inc(1024, direction="exposition_d")
+    text = metrics.DEFAULT.render()
+    assert "# TYPE pod_startup_latency_seconds histogram" in text
+    # Cumulative buckets: the 0.2 observation lands at le=0.25 and the
+    # 0.007 one at le=0.01 — the +Inf bucket equals the count.
+    assert (
+        'pod_startup_latency_seconds_bucket{milestone="exposition_m",'
+        'le="0.01"} 1' in text
+    )
+    assert (
+        'pod_startup_latency_seconds_bucket{milestone="exposition_m",'
+        'le="+Inf"} 2' in text
+    )
+    assert (
+        'pod_startup_latency_seconds_count{milestone="exposition_m"} 2'
+        in text
+    )
+    # Label escaping at the drop counter (a resource label can never
+    # corrupt the exposition).
+    assert (
+        'watch_streams_dropped_total{resource="we\\"ird\\\\res\\nx"} 1.0'
+        in text
+    )
+    assert "# TYPE watch_stream_queue_depth gauge" in text
+    assert "# TYPE watch_fanout_lag_versions histogram" in text
+    assert (
+        'watch_fanout_lag_versions_bucket{resource="exposition_r",le="8"}'
+        in text
+    )
+    assert "# TYPE solver_device_transfer_bytes_total counter" in text
+    assert "# TYPE solver_xla_compile_cache_entries gauge" in text
+
+
 def test_lint_metrics_knows_preemption_names(tmp_path):
     """The preemption_* family (scheduler/daemon.py) is known to the
     linter: the _total counters pass the standard rule, the unitless

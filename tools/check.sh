@@ -289,4 +289,111 @@ bulk_rc=$?
 if [ $rc -eq 0 ]; then
     rc=$bulk_rc
 fi
+
+# SLO smoke (ISSUE 9): in a FRESH process (fresh metrics registry),
+# assert the `ktctl slo` empty-cluster miss contract first, then churn
+# ~200 pods through the HTTP control plane (bulk create -> informer-fed
+# incremental daemon binds -> stand-in kubelet flips Running) and
+# assert the telemetry plane end to end: /debug/slo serves verdicts, a
+# populated pod_startup_latency objective, and `ktctl slo` exits 0.
+echo "== slo smoke =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stderr, redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.scheduler.daemon import (
+    IncrementalBatchScheduler, SchedulerConfig,
+)
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+N_PODS = 200
+
+api = APIServer()
+srv = APIHTTPServer(api, max_in_flight=800).start()
+client = Client(HTTPTransport(srv.address))
+
+# Miss contract FIRST (empty cluster, no SLI samples yet): exit 1,
+# empty stdout, the reason on stderr — mirror of ktctl trace/explain.
+out, err = io.StringIO(), io.StringIO()
+with redirect_stdout(out), redirect_stderr(err):
+    rc = ktctl.main(["slo"], client=client)
+assert rc == 1, (rc, out.getvalue(), err.getvalue())
+assert out.getvalue() == "", out.getvalue()
+assert "no SLI samples recorded" in err.getvalue(), err.getvalue()
+
+client.create_bulk("nodes", [
+    {"kind": "Node", "metadata": {"name": f"n{j}"},
+     "status": {"capacity": {"cpu": "64", "memory": "256Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}]}}
+    for j in range(8)
+])
+cfg = SchedulerConfig(
+    Client(HTTPTransport(srv.address)), raw_scheduled_cache=True
+).start()
+assert cfg.wait_for_sync(timeout=60), "scheduler caches never synced"
+sched = IncrementalBatchScheduler(cfg, max_batch=512).start()
+
+def pod(name):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "app",
+                     "resources": {"limits": {"cpu": "50m",
+                                              "memory": "32Mi"}}}]}}
+
+res = client.create_bulk(
+    "pods", [pod(f"slo-{i}") for i in range(N_PODS)], namespace="default"
+)
+assert all(r.get("status") == "Success" for r in res)
+deadline = time.monotonic() + 120
+bound = 0
+while time.monotonic() < deadline and bound < N_PODS:
+    pods, _ = client.list("pods", namespace="default")
+    bound = sum(1 for p in pods if p.spec.node_name)
+    if bound < N_PODS:
+        time.sleep(0.25)
+assert bound == N_PODS, f"only {bound}/{N_PODS} bound"
+# Stand-in kubelet: flip every pod Running through the status
+# subresource; the collector reads the resulting watch events.
+for p in pods:
+    p.status.phase = "Running"
+    client.update_status("pods", p, namespace="default")
+
+def slo_report():
+    with urllib.request.urlopen(srv.address + "/debug/slo", timeout=10) as r:
+        return json.loads(r.read())
+
+deadline = time.monotonic() + 30
+objs = {}
+while time.monotonic() < deadline:
+    objs = {o["name"]: o for o in slo_report()["objectives"]}
+    if objs.get("pod_startup_latency", {}).get("samples", 0) >= N_PODS:
+        break
+    time.sleep(0.25)
+assert objs["pod_startup_latency"]["samples"] >= N_PODS, objs
+assert objs["pod_startup_latency"]["verdict"] in ("pass", "warn", "burn")
+assert objs["pod_bound_latency"]["samples"] >= N_PODS, objs
+
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["slo"], client=client)
+text = out.getvalue()
+assert rc == 0, text
+assert "pod_startup_latency" in text and "overall:" in text, text
+sched.stop()
+srv.stop()
+print(f"slo smoke OK: {N_PODS} pods churned; pod_startup_latency "
+      f"p99={objs['pod_startup_latency'].get('p99')}s verdict="
+      f"{objs['pod_startup_latency']['verdict']}; empty-cluster miss "
+      "contract held")
+EOF
+slo_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$slo_rc
+fi
 exit $rc

@@ -78,6 +78,28 @@ EXPLAIN_METRICS = {
 }
 ALLOWLIST |= EXPLAIN_METRICS
 
+#: SLI/SLO telemetry-plane family (utils/sli.py, store/watch.py,
+#: scheduler/daemon.py — see docs/architecture.md "Telemetry plane &
+#: SLOs"). Most names carry standard unit suffixes on their own; the
+#: exceptions are unit-less by nature — watch_stream_queue_depth (a
+#: count of queued events, like gang_pending_groups),
+#: watch_fanout_lag_versions (a count of store versions), and
+#: solver_xla_compile_cache_entries (a count of cached executables) —
+#: and are allowlisted explicitly so the linter documents the whole
+#: family rather than silently tolerating it.
+SLI_METRICS = {
+    "pod_startup_latency_seconds",
+    "watch_streams_dropped_total",
+    "watch_stream_queue_depth",
+    "watch_fanout_lag_versions",
+    "scheduler_informer_staleness_seconds",
+    "solver_device_transfer_bytes_total",
+    "solver_xla_compiles_total",
+    "solver_xla_compile_cache_entries",
+    "device_memory_bytes",
+}
+ALLOWLIST |= SLI_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"
