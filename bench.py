@@ -23,6 +23,22 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PODS_PER_SEC = 15.0  # reference bind rate limit ceiling
 
 
+def _mp_context():
+    """Process context for the load-generator children. NEVER fork:
+    the parent runs JAX plus a dozen reflector/daemon threads, and
+    os.fork() from a multithreaded process is exactly what the
+    'os.fork() is incompatible with multithreaded code' RuntimeWarning
+    (and the latent post-fork deadlock it warns about) is for. The
+    children only do sockets/json, so a fresh interpreter via
+    forkserver (spawn where unavailable) is cheap and clean."""
+    import multiprocessing as mp
+
+    try:
+        return mp.get_context("forkserver")
+    except ValueError:  # pragma: no cover - non-POSIX fallback
+        return mp.get_context("spawn")
+
+
 def _churn_figure(n_nodes: int, rate: int, ticks: int, mode: str) -> dict:
     """BASELINE config 5 measured: sustained create/delete churn with
     incremental device updates (no re-lowering the cluster). Returns
@@ -405,7 +421,8 @@ def _api_churn_figure(
     mode: str = "scan",
     warmup_s: float = 6.0,
     creators: int = 2,
-    gate_s: float = 1.0,
+    gate_s: float = 0.0,
+    microticks: bool = True,
 ) -> dict:
     """The OTHER half of the headline metric (VERDICT r4 #1): p99
     pod-to-bind latency + churn throughput THROUGH the real control
@@ -432,42 +449,6 @@ def _api_churn_figure(
     for j in range(n_nodes):
         setup.create("nodes", node_wire(j))
 
-    # Pre-compile every executable the timed window can hit: a fresh
-    # SolverSession with IDENTICAL array shapes (same node bucket, same
-    # vocab widths) shares the XLA compile cache with the daemon's
-    # session, so each pending-bucket solve and dirty-row scatter width
-    # compiles here, not inside an SLO-gated tick.
-    from kubernetes_tpu.models import serde
-    from kubernetes_tpu.models.objects import Node, Pod
-    from kubernetes_tpu.ops import SolverSession
-
-    warm_nodes = [serde.from_wire(Node, node_wire(j)) for j in range(n_nodes)]
-    warm = SolverSession(
-        warm_nodes, node_capacity=max(64, int(n_nodes * 1.25)), mode=mode
-    )
-    counter = 0
-    max_bucket = 1024
-    bucket = 1
-    bound_keys = []
-    while bucket <= max_bucket:
-        for _ in range(bucket):
-            counter += 1
-            warm.add_pending(serde.from_wire(Pod, pod_wire(f"w{counter}")))
-        for key, dest in warm.solve():
-            if dest is not None:
-                bound_keys.append(key)
-        bucket *= 2
-    # Scatter widths (deletes dirty rows; width buckets at >=8).
-    width = 8
-    i = 0
-    while width <= 512 and i + width <= len(bound_keys):
-        for _ in range(width):
-            warm.delete_assigned(bound_keys[i])
-            i += 1
-        warm.solve()  # flush triggers the scatter at this width
-        width *= 2
-    del warm, warm_nodes
-
     import gc
 
     srv = APIHTTPServer(api, max_in_flight=800).start()
@@ -475,15 +456,25 @@ def _api_churn_figure(
     sched_client = Client(HTTPTransport(srv.address))
     config = SchedulerConfig(sched_client, raw_scheduled_cache=True).start()
     config.wait_for_sync(30.0)
-    sched = IncrementalBatchScheduler(config, mode=mode, max_batch=1024).start()
+    # prewarm_buckets=1024 + prewarm(): the daemon builds its session
+    # and compiles every pod-bucket solve and dirty-row scatter width
+    # the timed window can hit BEFORE traffic starts — a fresh bucket
+    # must never stall an SLO-gated tick (SolverSession.prewarm).
+    # microticks=False is the fixed-tick baseline leg: the PR-11-era
+    # cadence (blocking drain window, inline commits) measured on the
+    # same box for the before/after comparison BENCH artifacts record.
+    sched = IncrementalBatchScheduler(
+        config, mode=mode, max_batch=1024, prewarm_buckets=1024,
+        microticks=microticks,
+    )
+    sched.prewarm()
+    sched.start()
 
     # The load generator runs in its OWN process (the reference's e2e
     # shape: the driver is outside the system under test). On a 1-core
     # host this also keeps the driver's Python work off the control
     # plane's GIL.
-    import multiprocessing as mp
-
-    ctx = mp.get_context("fork")  # child only does sockets/json, no jax
+    ctx = _mp_context()  # child only does sockets/json, no jax
     parent_conn, child_conn = ctx.Pipe(duplex=False)
     child = ctx.Process(
         target=_churn_load,
@@ -537,19 +528,26 @@ def _api_churn_figure(
         "bind_latency_nodes": n_nodes,
         "bind_rate_requested": rate,
         "bind_tick_mode": mode,
-        # Engine verdict (utils/slo.py BENCH_OBJECTIVES, target tuned
-        # by gate_s): the p99 gate, worsened to "burn" outright when
-        # any created pod never bound — a cluster that sheds pods
-        # cannot pass its latency SLO on the survivors.
+        # Engine verdict (utils/slo.py BENCH_OBJECTIVES — the 100ms
+        # always-resident-loop gate; gate_s>0 overrides the target):
+        # the p99 gate, worsened to "burn" outright when any created
+        # pod never bound — a cluster that sheds pods cannot pass its
+        # latency SLO on the survivors.
         "bind_latency_slo": _slo.worst(
             _slo.verdict_for_value(
                 _slo.with_target(
                     _slo.BENCH_OBJECTIVES["bind_latency_slo"], gate_s
-                ),
+                )
+                if gate_s
+                else _slo.BENCH_OBJECTIVES["bind_latency_slo"],
                 p99,
             ),
             "burn" if unbound else "pass",
         ),
+        "bind_latency_slo_target": (
+            gate_s or _slo.BENCH_OBJECTIVES["bind_latency_slo"].target
+        ),
+        "bind_microticks": microticks,
     }
     # The production SLO engine's own report over this drill: the
     # apiserver ran in THIS process, so the always-on SLI collector
@@ -595,7 +593,7 @@ def _bulk_churn_figure(duration_s: float = 8.0, batch: int = 1024) -> dict:
     api = APIServer()
     api.list("pods", "default")  # build the pods watch cache up front
     srv = APIHTTPServer(api, max_in_flight=800).start()
-    ctx = mp.get_context("fork")
+    ctx = _mp_context()
     parent_conn, child_conn = ctx.Pipe(duplex=False)
     child = ctx.Process(
         target=_bulk_churn_load,
@@ -828,6 +826,22 @@ def apichurn_main() -> None:
     duration = float(os.environ.get("BENCH_CHURN_SECONDS", "10"))
     mode = os.environ.get("BENCH_CHURN_MODE", "scan")
     fig = _api_churn_figure(n_nodes, rate, duration, mode=mode)
+    if os.environ.get("BENCH_BASELINE", "0") == "1":
+        # Before/after leg: the SAME drill with micro-ticks off (fixed
+        # drain window, inline commits, no pipeline) — the fixed-tick
+        # cadence this PR replaced, measured on the same box so the
+        # artifact records the comparison the acceptance gate asks for.
+        base = _api_churn_figure(
+            n_nodes, rate, duration, mode=mode, microticks=False
+        )
+        fig["fixed_tick_baseline"] = {
+            k: base[k]
+            for k in (
+                "bind_latency_p50_s", "bind_latency_p99_s",
+                "bind_latency_max_s", "churn_bound_pods_per_sec",
+                "bind_latency_pods", "bind_latency_unbound",
+            )
+        }
     fig.update(_bulk_churn_figure())
     print(
         json.dumps(
@@ -1018,6 +1032,78 @@ CHURN_API_SLO_PODS_PER_SEC = _slo.BENCH_OBJECTIVES["churn_api_slo"].target
 POD_CRUD_SLO_OPS_PER_SEC = _slo.BENCH_OBJECTIVES["pod_crud_slo"].target
 
 
+def _crud_pod_wire(name: str) -> dict:
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "x"}]},
+    }
+
+
+def _crud_worker(address, wid, tasks, batch, errors) -> None:
+    """One CRUD driver connection: bulk create -> LIST -> bulk update
+    -> bulk delete per cycle. Module-level (not a closure) so the
+    forkserver/spawn driver process can pickle its way here."""
+    c = _LeanHTTP(address)
+    path = "/api/v1/namespaces/default/pods"
+    try:
+        for i in range(tasks):
+            names = [f"crud-{wid}-{i}-{j}" for j in range(batch)]
+            items = [_crud_pod_wire(n) for n in names]
+            st = c.request(
+                "POST", path + ":bulk",
+                json.dumps({"items": items}).encode(),
+            )
+            if st != 200:
+                raise RuntimeError(f"bulk create: HTTP {st}")
+            # Read: one LIST over this worker's label-less namespace
+            # view (served from the watch cache's per-object
+            # encodings).
+            st = c.request("GET", path)
+            if st != 200:
+                raise RuntimeError(f"list: HTTP {st}")
+            for it in items:
+                it["metadata"]["labels"] = {"touched": "true"}
+                it["metadata"].pop("resourceVersion", None)
+            st = c.request(
+                "POST", path + ":bulkupdate",
+                json.dumps({"items": items}).encode(),
+            )
+            if st != 200:
+                raise RuntimeError(f"bulk update: HTTP {st}")
+            st = c.request(
+                "POST", path + ":bulkdelete",
+                json.dumps({"names": names}).encode(),
+            )
+            if st != 200:
+                raise RuntimeError(f"bulk delete: HTTP {st}")
+    except Exception as e:  # pragma: no cover
+        errors.append(e)
+    finally:
+        c.close()
+
+
+def _crud_drive(address, n_workers, n_tasks, batch, conn) -> None:
+    """Driver process body for _crud_figure: the timed worker threads
+    in their own interpreter, result over the pipe."""
+    import threading
+
+    errors: list = []
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=_crud_worker, args=(address, w, n_tasks, batch, errors)
+        )
+        for w in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    conn.send({"elapsed": elapsed, "errors": [repr(e) for e in errors]})
+
+
 def _crud_figure(n_workers: int, n_tasks: int, batch: int = 256) -> dict:
     """Master pod-CRUD throughput over real HTTP (reference:
     test/integration/master_benchmark_test.go:38-93 — -bench-pods /
@@ -1027,8 +1113,6 @@ def _crud_figure(n_workers: int, n_tasks: int, batch: int = 256) -> dict:
     them — 4 object operations per pod, one WAL group commit per batch
     verb. `n_tasks` counts cycles per worker. Returns
     {"pod_crud_ops_per_sec": ..., ...} (ops = objects touched)."""
-    import threading
-
     from kubernetes_tpu.server.api import APIServer
     from kubernetes_tpu.server.httpserver import APIHTTPServer
 
@@ -1036,84 +1120,25 @@ def _crud_figure(n_workers: int, n_tasks: int, batch: int = 256) -> dict:
     api.list("pods", "default")  # build the pods watch cache up front
     srv = APIHTTPServer(api).start()
     try:
-        def pod_wire(name):
-            return {
-                "kind": "Pod",
-                "metadata": {"name": name, "namespace": "default"},
-                "spec": {"containers": [{"name": "c", "image": "x"}]},
-            }
-
-        errors = []
         ops = 4  # create + read + update(label) + delete, per pod
-        path = "/api/v1/namespaces/default/pods"
-
-        def worker(wid, tasks=n_tasks):
-            c = _LeanHTTP(srv.address)
-            try:
-                for i in range(tasks):
-                    names = [f"crud-{wid}-{i}-{j}" for j in range(batch)]
-                    items = [pod_wire(n) for n in names]
-                    st = c.request(
-                        "POST", path + ":bulk",
-                        json.dumps({"items": items}).encode(),
-                    )
-                    if st != 200:
-                        raise RuntimeError(f"bulk create: HTTP {st}")
-                    # Read: one LIST over this worker's label-less
-                    # namespace view (served from the watch cache's
-                    # per-object encodings).
-                    st = c.request("GET", path)
-                    if st != 200:
-                        raise RuntimeError(f"list: HTTP {st}")
-                    for it in items:
-                        it["metadata"]["labels"] = {"touched": "true"}
-                        it["metadata"].pop("resourceVersion", None)
-                    st = c.request(
-                        "POST", path + ":bulkupdate",
-                        json.dumps({"items": items}).encode(),
-                    )
-                    if st != 200:
-                        raise RuntimeError(f"bulk update: HTTP {st}")
-                    st = c.request(
-                        "POST", path + ":bulkdelete",
-                        json.dumps({"names": names}).encode(),
-                    )
-                    if st != 200:
-                        raise RuntimeError(f"bulk delete: HTTP {st}")
-            except Exception as e:  # pragma: no cover
-                errors.append(e)
-            finally:
-                c.close()
 
         # Short warmup (primes connections/threads); a failure here
         # means the server is broken — don't run the timed section.
-        worker("warm", tasks=2)
+        errors: list = []
+        _crud_worker(srv.address, "warm", 2, batch, errors)
         if errors:
             raise errors[0]
 
-        # The timed workers run in their OWN process (fork): the load
+        # The timed workers run in their OWN process: the load
         # generator's JSON encode/decode must not share the control
         # plane's GIL, or the driver becomes the thing measured.
-        import multiprocessing as mp
-
-        def drive(conn):
-            t0 = time.perf_counter()
-            threads = [
-                threading.Thread(target=worker, args=(w,))
-                for w in range(n_workers)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            elapsed = time.perf_counter() - t0
-            conn.send(
-                {"elapsed": elapsed, "errors": [repr(e) for e in errors]}
-            )
-
-        ctx = mp.get_context("fork")
+        ctx = _mp_context()
         parent_conn, child_conn = ctx.Pipe(duplex=False)
-        child = ctx.Process(target=drive, args=(child_conn,), daemon=True)
+        child = ctx.Process(
+            target=_crud_drive,
+            args=(srv.address, n_workers, n_tasks, batch, child_conn),
+            daemon=True,
+        )
         child.start()
         child_conn.close()
         if not parent_conn.poll(600):

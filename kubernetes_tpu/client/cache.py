@@ -242,7 +242,23 @@ class FIFO:
         self._items: Dict[str, Any] = {}
         self._queue: List[str] = []
         self._closed = False
+        self._wakes: List = []
         self.key_func = key_func
+
+    def attach_wake(self, event) -> None:
+        """Register a threading.Event set whenever the queue gains
+        items (or closes). Event-driven consumers (the incremental
+        scheduler's micro-ticks) wait on ONE event fed by several
+        sources — queue arrivals, watch deltas, commit releases —
+        instead of blocking inside pop() where only arrivals can wake
+        them. Event.set is async-signal-cheap; no ordering is implied
+        beyond 'something changed, sweep the queue'."""
+        with self._cond:
+            self._wakes.append(event)
+
+    def _signal_locked(self) -> None:
+        for ev in self._wakes:
+            ev.set()
 
     def add(self, obj) -> None:
         key = self.key_func(obj)
@@ -251,6 +267,7 @@ class FIFO:
                 self._queue.append(key)
             self._items[key] = obj
             self._cond.notify()
+            self._signal_locked()
 
     update = add
 
@@ -283,11 +300,13 @@ class FIFO:
             self._items = {self.key_func(o): o for o in objs}
             self._queue = list(self._items.keys())
             self._cond.notify_all()
+            self._signal_locked()
 
     def close(self) -> None:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+            self._signal_locked()
 
     def __len__(self) -> int:
         with self._lock:

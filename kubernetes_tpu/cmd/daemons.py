@@ -265,7 +265,25 @@ def scheduler_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--batch", action="store_true",
-        help="TPU batch mode: solve pending backlogs on-device",
+        help="TPU batch mode: solve pending backlogs on-device. With "
+        "the default policy and no sidecar this boots the ALWAYS-"
+        "RESIDENT incremental session daemon (device-resident cluster "
+        "state, event-driven micro-ticks, pipelined commits — the "
+        "production latency path); --batch-full-relower opts back "
+        "into the per-tick full-relower daemon",
+    )
+    p.add_argument(
+        "--batch-full-relower", action="store_true",
+        help="with --batch: re-lower the full cluster every tick "
+        "(the pre-incremental BatchScheduler) instead of the "
+        "device-resident session",
+    )
+    p.add_argument(
+        "--prewarm-buckets", type=int, default=128,
+        help="pre-compile the incremental session's solve executables "
+        "for pod buckets up to this size (and the dirty-row scatter "
+        "widths) at session build, so a fresh bucket never stalls a "
+        "live tick; 0 disables",
     )
     p.add_argument(
         "--batch-mode", default="scan",
@@ -323,6 +341,23 @@ def start_scheduler(args, client=None):
         with open(args.policy_config_file) as f:
             policy = json.load(f)
     incremental = getattr(args, "batch_incremental", False)
+    # Promotion (ISSUE 12): a plain --batch request with the default
+    # policy and no sidecar boots the always-resident incremental
+    # session daemon — the production scheduling loop. Policy and
+    # sidecar configurations keep the full-relower daemon (the session
+    # replays only the default pipeline), as does an explicit
+    # --batch-full-relower.
+    wants_batch = (
+        args.batch or args.batch_mode != "scan" or args.solver_sidecar
+    )
+    if (
+        wants_batch
+        and not incremental
+        and not getattr(args, "batch_full_relower", False)
+        and not policy
+        and not args.solver_sidecar
+    ):
+        incremental = True
 
     def factory():
         config = SchedulerConfig(
@@ -345,7 +380,8 @@ def start_scheduler(args, client=None):
                     "or drop --batch-incremental)"
                 )
             return IncrementalBatchScheduler(
-                config, mode=args.batch_mode
+                config, mode=args.batch_mode,
+                prewarm_buckets=getattr(args, "prewarm_buckets", 0),
             ).start()
         if (
             args.batch or args.batch_mode != "scan" or args.solver_sidecar

@@ -32,7 +32,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--cloud-provider", default="",
         help="register nodes from a cloud provider (e.g. 'tpu')",
     )
-    p.add_argument("--batch-scheduler", action="store_true")
+    p.add_argument(
+        "--batch-scheduler", action="store_true",
+        help="TPU-solved batch scheduling; boots the always-resident "
+        "incremental session daemon (the default production path: "
+        "device-resident cluster state, event-driven micro-ticks, "
+        "pipelined commits) unless --batch-full-relower",
+    )
     p.add_argument(
         "--batch-mode", default="scan",
         choices=["scan", "wave", "sinkhorn", "auto"],
@@ -43,8 +49,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--batch-incremental", action="store_true",
-        help="device-resident session across scheduler ticks "
-        "(sustained-churn mode); implies --batch-scheduler",
+        help="device-resident session across scheduler ticks; implies "
+        "--batch-scheduler (since ISSUE 12 this is what "
+        "--batch-scheduler boots anyway — the flag remains for "
+        "compatibility)",
+    )
+    p.add_argument(
+        "--batch-full-relower", action="store_true",
+        help="with --batch-scheduler: the per-tick full-relower "
+        "BatchScheduler instead of the incremental session",
+    )
+    p.add_argument(
+        "--prewarm-buckets", type=int, default=128,
+        help="pre-compile the session's solve executables for pod "
+        "buckets up to this size at session build (0 disables) — a "
+        "fresh bucket never stalls a live tick",
     )
     p.add_argument(
         "--no-kube-proxy", dest="kube_proxy", action="store_false",
@@ -91,14 +110,27 @@ class LocalCluster:
             # In-process transport: build now. HTTP kubelets are built
             # in start(), once the apiserver's port is known.
             self._build_kubelets(self._client)
-        incremental = getattr(args, "batch_incremental", False)
+        # Promotion (ISSUE 12): --batch-scheduler boots the always-
+        # resident incremental session daemon unless the caller opts
+        # back into the per-tick full relower.
+        incremental = getattr(args, "batch_incremental", False) or (
+            args.batch_scheduler
+            and not getattr(args, "batch_full_relower", False)
+        )
         self.scheduler_config = SchedulerConfig(
             self._client(), raw_scheduled_cache=incremental
         )
         if args.batch_scheduler or incremental:
             mode = getattr(args, "batch_mode", "scan")
-            cls = IncrementalBatchScheduler if incremental else BatchScheduler
-            self.scheduler_cls = lambda cfg: cls(cfg, mode=mode)
+            if incremental:
+                prewarm = getattr(args, "prewarm_buckets", 0)
+                self.scheduler_cls = lambda cfg: IncrementalBatchScheduler(
+                    cfg, mode=mode, prewarm_buckets=prewarm
+                )
+            else:
+                self.scheduler_cls = lambda cfg: BatchScheduler(
+                    cfg, mode=mode
+                )
         else:
             self.scheduler_cls = Scheduler
         self.scheduler = None

@@ -24,6 +24,7 @@ stays the source of truth, SURVEY.md §5 checkpoint model).
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -78,6 +79,53 @@ class SessionGang:
     min_member: int
     bound: int  # members already bound before this tick
     pod_keys: frozenset  # this tick's pending members
+
+
+class PendingSolve:
+    """One in-flight session tick: the jitted solve has been DISPATCHED
+    (async — no host sync) and the assignment's device->host copy is
+    already streaming (`copy_to_host_async`). ``result()`` blocks on
+    the readback, applies the host-mirror commits, and returns the
+    same ``[(pod_key, node_name | None)]`` list ``solve()`` does.
+
+    The overlap contract: between dispatch and ``result()`` the owner
+    may freely ``add_pending`` (next tick's staging), apply node/pod
+    deltas (``upsert_node``/``delete_assigned``/``add_assigned`` — row
+    recomputes miss the in-flight placements, but ``result()`` re-adds
+    them incrementally, so recompute-then-apply converges to the same
+    rows), and do arbitrary host work (bind commits, HTTP). Only the
+    next dirty-row flush / solve dispatch requires the tick to finish
+    first — ``solve_async`` resolves any outstanding handle itself."""
+
+    __slots__ = (
+        "_session", "pending", "assignment", "tele",
+        "dispatch_s", "block_s", "_done", "_result",
+    )
+
+    def __init__(self, session, pending, assignment, tele, dispatch_s):
+        self._session = session
+        self.pending = pending
+        self.assignment = assignment
+        self.tele = tele  # (waves, sinkhorn_iters, sinkhorn_residual)
+        self.dispatch_s = dispatch_s
+        self.block_s = 0.0
+        self._done = assignment is None
+        self._result: List[Tuple[str, Optional[str]]] = []
+
+    @property
+    def keys(self) -> List[str]:
+        """Pod keys of the in-flight tick (placement unknown until
+        result()): owners use these to avoid re-staging a pod whose
+        first solve has not landed yet."""
+        return [lp.key for lp in self.pending]
+
+    def done(self) -> bool:
+        return self._done
+
+    def result(self) -> List[Tuple[str, Optional[str]]]:
+        if not self._done:
+            self._session._finish_solve(self)
+        return self._result
 
 
 @dataclass
@@ -172,6 +220,13 @@ class SolverSession:
         # Convergence telemetry of the most recent solve() tick — the
         # incremental daemon folds this into its SolveRecord.
         self.last_stats: Dict[str, float] = {}
+        # Pipelined dispatch state: the (at most one) in-flight tick,
+        # plus double-buffered host staging arrays — tick k+1's pod
+        # staging must never overwrite buffers whose device transfer
+        # for tick k may still be draining (device_put is async).
+        self._inflight: Optional[PendingSolve] = None
+        self._stage_bufs: Tuple[Dict, Dict] = ({}, {})
+        self._stage_flip = 0
 
     # -- lowering -----------------------------------------------------
 
@@ -395,53 +450,89 @@ class SolverSession:
         self._dirty.add(j)
         return True
 
-    def solve(self) -> List[Tuple[str, Optional[str]]]:
-        """Schedule the pending backlog against the device-resident
-        cluster state; commits ride the donated carry. Returns
-        [(pod_key, node_name | None)] and clears the backlog."""
+    def _dispatch(self, pods, carry):
+        """Enqueue one tick's jitted solve for the session mode. Pure
+        dispatch — JAX returns immediately; nothing here syncs the
+        host. Returns (assignment, new_carry, (waves, iters, res)) with
+        the telemetry entries still device scalars (or None)."""
+        waves = s_iters = s_res = None
+        if self.mode == "wave":
+            from kubernetes_tpu.ops.wave import solve_waves_with_state
+
+            assignment, carry, waves = solve_waves_with_state(
+                pods, carry, self.weights
+            )
+        elif self.mode == "sinkhorn":
+            from kubernetes_tpu.ops.sinkhorn import solve_sinkhorn_with_state
+
+            assignment, carry, waves, s_iters, s_res = (
+                solve_sinkhorn_with_state(pods, carry, self.weights)
+            )
+        else:
+            assignment, carry = solve_with_state(pods, carry, self.weights)
+        return assignment, carry, (waves, s_iters, s_res)
+
+    def solve_async(self) -> PendingSolve:
+        """Pipelined tick: stage the pending backlog, dispatch the
+        jitted solve, start the assignment's device->host copy, and
+        return WITHOUT a blocking host sync. The returned handle's
+        ``result()`` performs the readback and host-mirror commits;
+        until then the caller overlaps the device time with the next
+        tick's staging (``add_pending``), watch-delta application, and
+        its own commit I/O. At most one tick is in flight: a second
+        ``solve_async`` resolves the first before dispatching (the
+        donated carry and the dirty-row flush both require it)."""
         from kubernetes_tpu.utils import tracing
 
+        self._finish_inflight()
         pending, self._pending = self._pending, []
         if not pending:
             self._flush_dirty()
-            return []
+            return PendingSolve(self, [], None, (None, None, None), 0.0)
+        t0 = time.monotonic()
         # Phase spans cover the session tick's segments: "upload" is
         # the dirty-row scatter plus staging this tick's pod arrays
-        # onto the device, "solve" the dispatch, "readback" the
-        # blocking copy-out (which therefore absorbs the async device
-        # time). The "lower" phase is the per-pod _lower_pod work,
-        # observed at the daemon's add_pending loop — NOT here, so each
-        # tick contributes exactly one observation per phase.
+        # onto the device, "solve" the async dispatch, "readback" the
+        # blocking copy-out (which therefore absorbs the device time).
+        # The "lower" phase is the per-pod _lower_pod work, observed at
+        # the daemon's add_pending loop — NOT here, so each tick
+        # contributes exactly one observation per phase.
         with tracing.phase(
             "upload", dirty=len(self._dirty), pods=len(pending)
         ):
             self._flush_dirty()
             pods = self._pod_arrays(pending)
-        waves = s_iters = s_res = None
         with tracing.phase("solve", mode=self.mode, incremental=True):
-            if self.mode == "wave":
-                from kubernetes_tpu.ops.wave import solve_waves_with_state
+            assignment, self.dev, tele = self._dispatch(pods, self.dev)
+            # Start the device->host copy NOW: it streams behind the
+            # solve, so result() finds the bytes (mostly) local.
+            if hasattr(assignment, "copy_to_host_async"):
+                assignment.copy_to_host_async()
+        handle = PendingSolve(
+            self, pending, assignment, tele, time.monotonic() - t0
+        )
+        self._inflight = handle
+        return handle
 
-                assignment, self.dev, waves = solve_waves_with_state(
-                    pods, self.dev, self.weights
-                )
-            elif self.mode == "sinkhorn":
-                from kubernetes_tpu.ops.sinkhorn import (
-                    solve_sinkhorn_with_state,
-                )
+    def _finish_inflight(self) -> None:
+        if self._inflight is not None:
+            self._inflight.result()
 
-                assignment, self.dev, waves, s_iters, s_res = (
-                    solve_sinkhorn_with_state(pods, self.dev, self.weights)
-                )
-            else:
-                assignment, self.dev = solve_with_state(
-                    pods, self.dev, self.weights
-                )
-        out: List[Tuple[str, Optional[str]]] = []
+    def _finish_solve(self, handle: PendingSolve) -> None:
+        """Blocking half of a pipelined tick: copy the assignment out,
+        record telemetry, and mirror the device commits into the host
+        rows. Called (once) from PendingSolve.result()."""
+        from kubernetes_tpu.utils import tracing
+
+        if self._inflight is handle:
+            self._inflight = None
+        t0 = time.monotonic()
+        waves, s_iters, s_res = handle.tele
+        pending = handle.pending
         with tracing.phase("readback"):
             from kubernetes_tpu.utils import sli
 
-            full = np.asarray(assignment)
+            full = np.asarray(handle.assignment)
             sli.note_transfer("d2h", full.nbytes)
             picks = full[: len(pending)]
             # Telemetry scalars convert AFTER the assignment copy
@@ -462,6 +553,7 @@ class SolverSession:
                 from kubernetes_tpu.utils import flightrecorder
 
                 flightrecorder.observe_solve_telemetry("wave", int(waves))
+        out: List[Tuple[str, Optional[str]]] = []
         for lp, j in zip(pending, picks.tolist()):
             if j < 0 or j >= self.N_cap or self.node_names[j] is None:
                 out.append((lp.key, None))
@@ -470,7 +562,58 @@ class SolverSession:
             self._pod_node[lp.key] = j
             self._apply_commit_host(j, lp)
             out.append((lp.key, self.node_names[j]))
-        return out
+        handle.block_s = time.monotonic() - t0
+        handle._result = out
+        handle._done = True
+
+    def solve(self) -> List[Tuple[str, Optional[str]]]:
+        """Schedule the pending backlog against the device-resident
+        cluster state; commits ride the donated carry. Returns
+        [(pod_key, node_name | None)] and clears the backlog. The
+        synchronous shape of solve_async() — dispatch + immediate
+        readback."""
+        return self.solve_async().result()
+
+    def prewarm(
+        self, max_pod_bucket: int = 0, max_scatter_width: int = 512
+    ) -> int:
+        """Compile every executable a live tick can hit — the solve at
+        each pow2 pod bucket up to max_pod_bucket and the dirty-row
+        scatter at each pow2 width — against THROWAWAY copies of the
+        node state, so the process-global XLA cache is hot before the
+        first real pod arrives (a fresh bucket mid-workload otherwise
+        stalls that tick for a full compile: seconds on TPU, minutes on
+        CPU hosts). Returns the number of warm dispatches issued."""
+        compiled = 0
+        bucket = max(_bucket(1), self.pod_bucket)
+        top = max(bucket, _bucket(max_pod_bucket)) if max_pod_bucket else 0
+        while bucket <= top:
+            pods = self._stage_arrays([], bucket, reuse=False)
+            # Throwaway carries go through _upload_all: identical
+            # sharding (mesh sessions included) to the live self.dev —
+            # a differently-placed warm carry would compile a cache
+            # entry the real ticks never hit.
+            carry = self._upload_all()
+            assignment, carry, _tele = self._dispatch(pods, carry)
+            jax.block_until_ready(assignment)
+            del carry
+            compiled += 1
+            bucket *= 2
+        width = 8
+        idx_max = max(
+            (j for j, n in enumerate(self.node_names) if n is not None),
+            default=0,
+        )
+        while width <= min(max_scatter_width, self.N_cap):
+            idx = np.full(width, idx_max, np.int32)
+            rows = {k: self.h[k][idx] for k in self.h}
+            carry = self._upload_all()
+            out = _scatter_rows(carry, jnp.asarray(idx), rows)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            del carry, out
+            compiled += 1
+            width *= 2
+        return compiled
 
     def solve_gang(
         self, gangs: Sequence[SessionGang]
@@ -537,21 +680,50 @@ class SolverSession:
         )
 
     def _pod_arrays(self, pending: List[_LoweredPod]) -> Dict[str, jnp.ndarray]:
-        P = len(pending)
-        PP = max(_bucket(P), self.pod_bucket)
-        arr = {
-            "cpu": np.zeros(PP, np.float32),
-            "mem": np.zeros(PP, np.float32),
-            "zero_req": np.zeros(PP, bool),
-            "sel": np.zeros((PP, self.LW), np.uint32),
-            "port": np.zeros((PP, self.PW), np.uint32),
-            "vol_any": np.zeros((PP, self.VW), np.uint32),
-            "vol_rw": np.zeros((PP, self.VW), np.uint32),
-            # Padding slots pinned to -2: never placeable.
-            "pinned": np.full(PP, -2, np.int32),
-            "svc": np.full(PP, -1, np.int32),
-            "svc_ids": np.full((PP, SVC_K), -1, np.int32),
-        }
+        PP = max(_bucket(len(pending)), self.pod_bucket)
+        return self._stage_arrays(pending, PP)
+
+    #: (key, pad value) layout of the staged pod columns; padding slots
+    #: are pinned to -2 (never placeable).
+    _STAGE_FILL = (
+        ("cpu", 0), ("mem", 0), ("zero_req", 0), ("sel", 0), ("port", 0),
+        ("vol_any", 0), ("vol_rw", 0), ("pinned", -2), ("svc", -1),
+        ("svc_ids", -1),
+    )
+
+    def _stage_arrays(
+        self, pending: List[_LoweredPod], PP: int, reuse: bool = True
+    ) -> Dict[str, jnp.ndarray]:
+        """Host staging buffers for one tick's pod upload. Buffers are
+        DOUBLE-buffered per bucket size: device_put may still be
+        draining tick k's transfer when tick k+1 stages, so k+1 always
+        writes the other slot (at most one solve is in flight — two
+        slots suffice). reuse=False (prewarm) allocates throwaway
+        arrays instead."""
+        arr = None
+        if reuse:
+            slot = self._stage_bufs[self._stage_flip]
+            self._stage_flip ^= 1
+            arr = slot.get(PP)
+            if arr is not None:
+                for key, fill in self._STAGE_FILL:
+                    arr[key].fill(fill)
+        if arr is None:
+            arr = {
+                "cpu": np.zeros(PP, np.float32),
+                "mem": np.zeros(PP, np.float32),
+                "zero_req": np.zeros(PP, bool),
+                "sel": np.zeros((PP, self.LW), np.uint32),
+                "port": np.zeros((PP, self.PW), np.uint32),
+                "vol_any": np.zeros((PP, self.VW), np.uint32),
+                "vol_rw": np.zeros((PP, self.VW), np.uint32),
+                # Padding slots pinned to -2: never placeable.
+                "pinned": np.full(PP, -2, np.int32),
+                "svc": np.full(PP, -1, np.int32),
+                "svc_ids": np.full((PP, SVC_K), -1, np.int32),
+            }
+            if reuse:
+                slot[PP] = arr
         for i, lp in enumerate(pending):
             arr["cpu"][i] = lp.cpu
             arr["mem"][i] = lp.mem_mib

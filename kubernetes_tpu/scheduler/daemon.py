@@ -14,6 +14,7 @@ Two operating modes share this daemon:
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -35,7 +36,7 @@ from kubernetes_tpu.scheduler.plugins import (
 _LOG = logging.getLogger("kubernetes_tpu.scheduler")
 from kubernetes_tpu.scheduler.types import StaticNodeLister, StaticServiceLister
 from kubernetes_tpu.server.api import APIError
-from kubernetes_tpu.utils import flightrecorder, metrics, sli, tracing
+from kubernetes_tpu.utils import flightrecorder, metrics, sanitizer, sli, tracing
 from kubernetes_tpu.utils.ratelimit import Backoff, TokenBucket
 
 # Histograms (were summaries): bucketed latencies aggregate across
@@ -296,6 +297,44 @@ class Scheduler:
         self.config = config
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Capacity-freed signal: retry backoffs are EVENT-waits, not
+        # sleeps — a pod DELETED / node joined delta bumps the epoch
+        # and every backlogged pod re-solves the tick the capacity
+        # appears instead of waiting out a grown backoff. (Only the
+        # incremental daemon has a delta feed to bump it; for the
+        # others the wait simply runs to its deadline, but stays
+        # interruptible.)
+        self._capacity_cond = threading.Condition(
+            sanitizer.lock("scheduler.capacity")
+        )
+        self._capacity_epoch = 0
+
+    def _capacity_freed(self) -> None:
+        with self._capacity_cond:
+            self._capacity_epoch += 1
+            self._capacity_cond.notify_all()
+
+    def _backoff_wait(self, delay: float, epoch: Optional[int] = None) -> bool:
+        """Wait out a retry backoff, returning EARLY when cluster
+        capacity frees (capacity epoch bump) or the daemon stops.
+        True = released early by a capacity event.
+
+        ``epoch`` is the baseline to compare against — callers that
+        know WHEN the pod's failed solve read the cluster state pass
+        the epoch sampled then, so a victim exiting between the solve
+        and this wait still releases immediately (the lost-wakeup
+        window of sampling at wait start). None = sample now."""
+        deadline = time.monotonic() + delay
+        with self._capacity_cond:
+            base = self._capacity_epoch if epoch is None else epoch
+            while not self._stop.is_set():
+                if self._capacity_epoch != base:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._capacity_cond.wait(min(remaining, 5.0))
+        return False
 
     def start(self) -> "Scheduler":
         self._thread = threading.Thread(target=self.run, daemon=True)
@@ -304,6 +343,8 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._capacity_cond:
+            self._capacity_cond.notify_all()  # wake backoff waiters
         self.config.stop()
         if self._thread:
             self._thread.join(timeout=5)
@@ -319,7 +360,7 @@ class Scheduler:
                 self._step()
             except Exception:
                 if not self._stop.is_set():
-                    time.sleep(0.1)
+                    self._stop.wait(0.1)
 
     def schedule_one(self, timeout: Optional[float] = 0.5) -> bool:
         """Pop one pending pod, schedule, bind, assume. Returns True if
@@ -409,17 +450,21 @@ class Scheduler:
         delay = self.config.backoff.duration(key)
 
         def later():
-            time.sleep(delay)
+            self._backoff_wait(delay)
             if self._stop.is_set():
                 return
             self._refetch_and_requeue(pod)
 
         threading.Thread(target=later, daemon=True).start()
 
-    def _requeue_many(self, pods: List[Pod]) -> None:
+    def _requeue_many(
+        self, pods: List[Pod], epoch: Optional[int] = None
+    ) -> None:
         """Batch-friendly requeue: ONE worker thread re-adds the whole
         rejected set at each pod's backoff deadline (the per-pod-thread
-        scalar mechanism would spawn up to max_batch threads)."""
+        scalar mechanism would spawn up to max_batch threads). ``epoch``
+        is the capacity epoch the failed solve read its cluster state
+        at (see _backoff_wait)."""
         if not pods:
             return
         now = time.monotonic()
@@ -435,9 +480,15 @@ class Scheduler:
         )
 
         def worker():
+            # One capacity event releases the WHOLE rejected set: the
+            # freed slot is contested by the full backlog in one tick,
+            # not dribbled out across per-pod deadlines.
+            released = False
             for deadline, i in schedule:
                 wait = deadline - time.monotonic()
-                if wait > 0 and self._stop.wait(wait):
+                if wait > 0 and not released:
+                    released = self._backoff_wait(wait, epoch)
+                if self._stop.is_set():
                     return
                 self._refetch_and_requeue(pods[i])
 
@@ -855,6 +906,18 @@ class BatchScheduler(Scheduler):
         _PREEMPT_NOMINATED.set(len(self._nominations))
         return granted
 
+    def _explain_shed(self) -> bool:
+        """Whether this tick's bound-pod explain capture should defer
+        off the latency path. The plain batch daemon never sheds (the
+        explain phase already runs outside the solve path); the
+        pipelined incremental daemon always does."""
+        return False
+
+    def _queue_deferred_explain(self, ctx) -> None:
+        """Accept a deferred bound-table explain context (no-op here;
+        the pipelined daemon queues it for the commit worker's idle
+        drain)."""
+
     def _observe_informer_staleness(self) -> None:
         """Set scheduler_informer_staleness_seconds per informer:
         seconds since each watch-fed cache last processed a delta or
@@ -939,25 +1002,46 @@ class BatchScheduler(Scheduler):
         # Non-default policies have no device explain lowering (the
         # readback evaluates the default pipeline), and sidecar daemons
         # keep the control plane off the local accelerator; outcome
-        # records still land, verdict tables are skipped.
+        # records still land, verdict tables are skipped. Pipelined
+        # daemons additionally SHED under pressure (_explain_shed):
+        # the readback is a device dispatch of its own and must never
+        # sit on the next pod's bind latency — bound-pod tables are
+        # dropped, UNBOUND pods (the thing operators explain) keep
+        # theirs, and full capture resumes when the cluster quiets.
+        shed = self._explain_shed()
+        has_unbound = any(dest is None for _p, dest, _o, _g in rows)
         if limit > 0 and self.spec is None and self.sidecar is None:
-            try:
-                with tracing.phase(
-                    "explain", pods=min(len(rows), limit)
-                ):
-                    self._attach_verdicts(
-                        rows, decisions, nodes, services, assigned_pre,
-                        limit,
+            if not shed or has_unbound:
+                # Shed = pressure path: unbound pods (the thing
+                # operators explain) still capture inline; bound
+                # tables defer below.
+                try:
+                    with tracing.phase(
+                        "explain", pods=min(len(rows), limit)
+                    ):
+                        self._attach_verdicts(
+                            rows, decisions, nodes, services,
+                            assigned_pre, limit,
+                            only="unbound" if shed else None,
+                        )
+                except Exception:
+                    _LOG.debug(
+                        "explain readback failed for tick %d", tick,
+                        exc_info=True,
                     )
-            except Exception:
-                _LOG.debug(
-                    "explain readback failed for tick %d", tick,
-                    exc_info=True,
+            if shed:
+                # Bound-pod verdict tables attach POST-HOC: Decision
+                # objects live in the ring, so the commit worker's
+                # idle drain amends the same records readers see.
+                self._queue_deferred_explain(
+                    (rows, decisions, nodes, services, assigned_pre,
+                     limit)
                 )
         flightrecorder.DEFAULT.record(decisions.values())
 
     def _attach_verdicts(
-        self, rows, decisions, nodes, services, assigned_pre, limit
+        self, rows, decisions, nodes, services, assigned_pre, limit,
+        only: Optional[str] = None,
     ) -> None:
         """Per-node verdicts from the device explain readback. Unbound
         pods are explained against the POST-solve occupancy (why they
@@ -965,14 +1049,19 @@ class BatchScheduler(Scheduler):
         occupancy only grows, a pod the scan left behind has a failing
         predicate on every node in that state); bound pods against the
         PRE-solve state (the view they won under). Unbound pods get
-        first claim on the budget — they are what operators explain."""
+        first claim on the budget — they are what operators explain.
+        ``only`` restricts the pass: "unbound" (the pipelined daemon's
+        inline pressure capture) or "bound" (its deferred worker-idle
+        half)."""
         import copy
 
         from kubernetes_tpu.models.objects import pod_full_key
         from kubernetes_tpu.ops.pipeline import explain_backlog
 
         unbound = [pod for pod, dest, _, _ in rows if dest is None][:limit]
-        budget = limit - len(unbound)
+        budget = 0 if only == "unbound" else limit - len(unbound)
+        if only == "bound":
+            unbound = []
         bound = []
         for pod, dest, _, _ in rows:
             if dest is None or budget <= 0:
@@ -1236,6 +1325,12 @@ class BatchScheduler(Scheduler):
         return len(pending) + len(deferred)
 
 
+class _SessionInvalidated(Exception):
+    """The in-flight resolve already invalidated the session (and
+    counted the failure in fallback_count); the raising tick only
+    needs the fallback routing, not a second count."""
+
+
 class IncrementalBatchScheduler(BatchScheduler):
     """Session-backed batch mode: cluster state stays device-resident.
 
@@ -1257,10 +1352,32 @@ class IncrementalBatchScheduler(BatchScheduler):
     invalidates the session; the next tick rebuilds it from the
     authoritative watch caches. Handlers are idempotent, so replaying
     an event already reflected in a freshly built session is harmless.
+
+    Micro-tick cadence (the latency path): with ``microticks`` on (the
+    default), the drain is EVENT-driven — a single wake event fed by
+    FIFO arrivals, watch deltas, and commit releases replaces the
+    fixed-period drain, so an idle daemon solves a lone pod the moment
+    it arrives, while under churn the solve time itself coalesces
+    arrivals (plus an adaptive ``batch_window`` once ``coalesce_min``
+    pods drain instantly). The tick pipeline overlaps three stages:
+    tick k's ``bind_bulk`` HTTP commits run on a dedicated commit
+    worker while tick k+1's jitted solve runs on device and tick k+2's
+    pods stage on the host (``SolverSession.solve_async``). Decision /
+    SLI milestone order is preserved — the commit worker is a single
+    FIFO thread. ``prewarm_buckets`` compiles the small pod-bucket
+    executables at session build so a fresh bucket never stalls a live
+    tick.
     """
 
     def __init__(
-        self, config: SchedulerConfig, pod_bucket: int = 0, **kw
+        self,
+        config: SchedulerConfig,
+        pod_bucket: int = 0,
+        prewarm_buckets: int = 0,
+        microticks: bool = True,
+        coalesce_min: int = 64,
+        commit_depth: int = 4,
+        **kw,
     ):
         super().__init__(config, **kw)
         if self.policy_scalar or self.spec is not None:
@@ -1272,13 +1389,256 @@ class IncrementalBatchScheduler(BatchScheduler):
         import collections
 
         self.pod_bucket = pod_bucket  # fixed tick upload bucket (0=pow2)
+        self.prewarm_buckets = prewarm_buckets  # 0 = no pre-warm
+        self.microticks = microticks
+        # Instantaneously-drained pods at/above which the adaptive
+        # coalescing window engages (below it: solve immediately).
+        self.coalesce_min = coalesce_min
         self._session = None
         self._event_q: "collections.deque" = collections.deque()
+        # Session releases the commit worker requests (409/bind-error
+        # rollbacks): applied on the solve loop, never cross-thread.
+        self._release_q: "collections.deque" = collections.deque()
+        # One wake event, many feeds: FIFO arrivals, cluster deltas,
+        # commit releases — the micro-tick drain waits on THIS instead
+        # of polling pop(timeout).
+        self._wake = threading.Event()
+        config.pod_queue.attach_wake(self._wake)
+        # Bounded commit pipeline: depth>0 keeps backpressure — a solve
+        # loop outrunning the API plane blocks on put() instead of
+        # growing an unbounded bind backlog.
+        self._commit_q: "queue.Queue" = queue.Queue(maxsize=commit_depth)
+        self._commit_thread: Optional[threading.Thread] = None
+        # Deferred bound-pod explain contexts, newest-win (worker-idle
+        # drain attaches the tables post-hoc once the loop has been
+        # quiet for _EXPLAIN_QUIET_S).
+        self._deferred_explain: "collections.deque" = collections.deque(
+            maxlen=4
+        )
+        self._last_busy_mono = 0.0
+        # The dispatched-but-unresolved tick: (PendingSolve, ctx).
+        self._inflight = None
+        self._inflight_keys: frozenset = frozenset()
         config.cluster_events = self._on_cluster_event
 
-    # Called from reflector threads: enqueue only.
+    # Called from reflector threads: enqueue + wake only.
     def _on_cluster_event(self, kind: str, etype: str, obj) -> None:
         self._event_q.append((kind, etype, obj))
+        if (kind == "node" and etype == "ADDED") or (
+            kind == "pod" and etype == "DELETED"
+        ):
+            # Capacity freed: release backoff waiters so the backlog
+            # contests it the tick it appears. Deliberately NOT node
+            # MODIFIED — kubelet status heartbeats arrive every few
+            # seconds per node and would defeat the backoff entirely
+            # (a cordon lift rides the ordinary backoff deadline).
+            self._capacity_freed()
+        self._wake.set()
+
+    # -- commit pipeline ----------------------------------------------
+
+    def start(self) -> "IncrementalBatchScheduler":
+        if self.microticks and self._commit_thread is None:
+            self._commit_thread = threading.Thread(
+                target=self._commit_worker, daemon=True
+            )
+            self._commit_thread.start()
+        return super().start()  # type: ignore[return-value]
+
+    def stop(self) -> None:
+        self._stop.set()
+        super().stop()
+        # Flush the pipeline IN ORDER: queued jobs first (the worker
+        # drains them), THEN the outstanding solve — its commit runs
+        # inline now that _stop is set, and committing it while the
+        # worker still held earlier jobs would race and reorder ticks.
+        # If the run thread outlived the join (wedged in a compile),
+        # do NOT touch its in-flight state from this thread — an
+        # unsynchronized double resolve would double-charge host rows
+        # and double-issue binds.
+        if self._thread is None or not self._thread.is_alive():
+            try:
+                self._flush_commits()
+                self._resolve_inflight()
+            except Exception:
+                _LOG.debug(
+                    "in-flight solve flush on stop failed", exc_info=True
+                )
+        else:
+            _LOG.warning(
+                "scheduler run thread still alive at stop; leaving its "
+                "in-flight tick unresolved"
+            )
+        worker = self._commit_thread
+        if worker is not None:
+            self._commit_thread = None
+            self._commit_q.put(None)
+            worker.join(timeout=10)
+
+    @property
+    def _pipelined(self) -> bool:
+        """True while commits may ride the worker thread and solves may
+        stay in flight across ticks. Manual schedule_batch() calls on a
+        non-started daemon run fully synchronously."""
+        t = self._commit_thread
+        return (
+            self.microticks
+            and t is not None
+            and t.is_alive()
+            and not self._stop.is_set()
+        )
+
+    def _commit_worker(self) -> None:
+        while True:
+            try:
+                job = self._commit_q.get(timeout=0.1)
+            except queue.Empty:
+                # Idle gap: attach deferred bound-pod verdict tables
+                # (runs concurrently with the solve loop — on a busy
+                # box GIL contention beats serializing the dispatch
+                # onto the bind path).
+                self._run_deferred_explain()
+                continue
+            try:
+                if job is None:
+                    return
+                self._commit_job(job)
+            except Exception:
+                _LOG.exception("commit pipeline job failed")
+            finally:
+                self._commit_q.task_done()
+
+    def _flush_commits(self) -> None:
+        """Barrier: every queued commit job has executed. Used before a
+        session rebuild — the rebuilt snapshot reads the pod lister,
+        and a bind the worker has not committed yet would otherwise be
+        in neither the caches nor the modeler's assumptions."""
+        t = self._commit_thread
+        if t is not None and t.is_alive():
+            self._commit_q.join()
+
+    def _release(self, key: str) -> None:
+        """Route a session charge release (bind conflict/rollback) back
+        to the solve loop; the session is single-threaded by design."""
+        self._release_q.append(key)
+        self._wake.set()
+
+    def _drain_releases(self) -> None:
+        while self._release_q:
+            key = self._release_q.popleft()
+            if self._session is not None:
+                self._session.delete_assigned(key)
+
+    def _resolve_inflight(self, prefer_inline: bool = False) -> int:
+        """Block on the outstanding tick's readback (if any), then hand
+        its commit job to the pipeline. Returns the pods resolved.
+        prefer_inline: the caller has no further work queued (idle
+        resolve) — committing on THIS thread skips a GIL handoff to
+        the worker, which on small hosts costs more than it overlaps;
+        honored only when the worker has nothing in flight (order)."""
+        inflight, self._inflight = self._inflight, None
+        self._inflight_keys = frozenset()
+        if inflight is None:
+            return 0
+        handle, ctx = inflight
+        try:
+            results = handle.result()
+        except Exception:
+            # Device/readback failure mid-pipeline: invalidate the
+            # session and send the tick's pods back through the queue
+            # (the next tick rebuilds and re-solves them).
+            self._session = None
+            self.fallback_count += 1
+            for pod in ctx["pending"]:
+                self.config.pod_queue.add(pod)
+            return 0
+        self._finish_tick(
+            handle._session, results, ctx,
+            ctx.get("stage_s", 0.0) + handle.dispatch_s + handle.block_s,
+            prefer_inline=prefer_inline,
+        )
+        return len(ctx["pending"])
+
+    def _finish_tick(
+        self, session, results, ctx, solve_s, prefer_inline=False
+    ) -> None:
+        """Shared tick epilogue: convergence stats + solve latency onto
+        the ctx, then the commit submission (worker or inline) — one
+        implementation for the gang, synchronous, and resolved-
+        pipelined tick shapes."""
+        ctx["solve_s"] = solve_s
+        stats = dict(getattr(session, "last_stats", {}) or {})
+        stats["incremental"] = True
+        ctx["stats"] = stats
+        _ALGO_LATENCY.observe(solve_s)
+        self._submit_commit(results, ctx, prefer_inline=prefer_inline)
+
+    def _submit_commit(self, results, ctx, prefer_inline=False) -> None:
+        if self._pipelined and not (
+            prefer_inline and self._commit_q.unfinished_tasks == 0
+        ):
+            self._commit_q.put((results, ctx))
+        else:
+            self._commit_job((results, ctx))
+            self._drain_releases()
+
+    def prewarm(self) -> None:
+        """Build the session (and pre-compile its executables when
+        prewarm_buckets is set) NOW — callers that know traffic is
+        coming invoke this before start() so the first pod pays neither
+        the build nor a bucket compile."""
+        if self._session is None:
+            self._session = self._build_session()
+
+    def _explain_shed(self) -> bool:
+        # On the pipelined path, bound-pod verdict capture ALWAYS
+        # defers: the explain readback is a device dispatch of its own
+        # (~45ms on CPU hosts) and even a "cluster looks quiet right
+        # now" inline capture lands squarely on the next arrival's
+        # bind latency. Unbound pods still capture inline (operators
+        # explain THOSE); bound tables attach post-hoc from the commit
+        # worker's idle drain. Manual (non-started) ticks keep the
+        # synchronous full capture.
+        return self._pipelined
+
+    def _queue_deferred_explain(self, ctx) -> None:
+        self._deferred_explain.append(ctx)
+
+    #: Seconds the solve loop must be quiet before deferred bound-pod
+    #: tables attach — the capture's Python-side snapshot build would
+    #: otherwise contend (GIL) with live ticks on small hosts.
+    _EXPLAIN_QUIET_S = 0.5
+
+    def _run_deferred_explain(self) -> None:
+        """Worker-idle half of verdict capture: attach bound-pod
+        tables to Decision records already in the ring, but only once
+        the solve loop has been quiet for a beat. Best-effort by
+        design — the deque is bounded (newest ticks win: a cluster
+        saturated forever keeps only its latest tables), and the
+        occupancy view is read at attach time, so tables reflect the
+        cluster as of shortly after the bind (the exact pre/post-solve
+        states remain on the synchronous path). Unbound pods never
+        wait on this — their tables capture inline."""
+        if not self._deferred_explain:
+            return
+        if (
+            time.monotonic() - self._last_busy_mono < self._EXPLAIN_QUIET_S
+            or self._inflight is not None
+        ):
+            return
+        try:
+            ctx = self._deferred_explain.popleft()
+        except IndexError:
+            return
+        rows, decisions, nodes, services, assigned_pre, limit = ctx
+        try:
+            with tracing.phase("explain", pods=min(len(rows), limit)):
+                self._attach_verdicts(
+                    rows, decisions, nodes, services, assigned_pre,
+                    limit, only="bound",
+                )
+        except Exception:
+            _LOG.debug("deferred explain capture failed", exc_info=True)
 
     def _build_session(self):
         from kubernetes_tpu.ops import SolverSession
@@ -1287,8 +1647,13 @@ class IncrementalBatchScheduler(BatchScheduler):
         # Drop deltas that predate the snapshot we are about to read:
         # everything already in the caches is captured by the build;
         # anything racing in lands in the queue and replays after
-        # (idempotent). Clear FIRST, then read.
+        # (idempotent). Clear FIRST, then read. Pending charge
+        # RELEASES die with the old session too — they reference its
+        # charges, and applying one to the rebuilt session (whose
+        # snapshot already reflects the authoritative bindings) would
+        # delete a legitimate charge and overcommit the node.
         self._event_q.clear()
+        self._release_q.clear()
         nodes = cfg.nodes.store.list()
         services = cfg.service_lister.list()
         # pod_lister = scheduled cache ∪ live assumptions: pods WE just
@@ -1298,7 +1663,7 @@ class IncrementalBatchScheduler(BatchScheduler):
         assigned = cfg.pod_lister.list()
         # Headroom: node slots bucket up; vocab words sized for the
         # fleet's label/port/volume variety with slack for churn.
-        return SolverSession(
+        session = SolverSession(
             nodes,
             services=services,
             assigned=assigned,
@@ -1306,6 +1671,15 @@ class IncrementalBatchScheduler(BatchScheduler):
             mode=self.mode,
             pod_bucket=self.pod_bucket,
         )
+        if self.prewarm_buckets:
+            t0 = time.monotonic()
+            n = session.prewarm(self.prewarm_buckets)
+            _LOG.info(
+                "session pre-warm: %d executables compiled in %.1fs "
+                "(pod buckets up to %d + dirty-row scatter widths)",
+                n, time.monotonic() - t0, self.prewarm_buckets,
+            )
+        return session
 
     @staticmethod
     def _obj_key(obj) -> str:
@@ -1351,6 +1725,122 @@ class IncrementalBatchScheduler(BatchScheduler):
                     session.add_assigned(obj)
         return True
 
+    def _topup(self, pending: List[Pod]) -> List[Pod]:
+        """Stage late arrivals into the tick about to dispatch (called
+        after the previous tick's blocking resolve — anything queued
+        during that block rides THIS solve). Gang-labeled pods are
+        re-queued instead: they must go through the partition step at
+        the next tick's head, never bypass it."""
+        session = self._session
+        if not self.microticks or session is None:
+            return []
+        room = self.max_batch - len(pending)
+        if room <= 0:
+            return []
+        from kubernetes_tpu.scheduler import gang
+
+        q = self.config.pod_queue
+        seen = {self._obj_key(p) for p in pending}
+        # The staged batch solves in priority-sorted array order (the
+        # mechanism that holds a nominated pod's freed capacity): a
+        # late arrival may only APPEND if it doesn't outrank the
+        # batch's floor — a higher-priority pod waits one tick and
+        # heads the next sorted drain instead of solving behind
+        # lower-priority pods.
+        floor = min(
+            ((p.spec.priority or 0) for p in pending), default=0
+        )
+        extra: List[Pod] = []
+        while len(extra) < room:
+            pod = q.pop(timeout=0.0)
+            if pod is None:
+                break
+            try:
+                if pod.spec.node_name:
+                    continue
+                if gang.pod_group_name(pod) or (
+                    (pod.spec.priority or 0) > floor
+                ):
+                    q.add(pod)
+                    break
+                key = self._obj_key(pod)
+                if (
+                    key in seen
+                    or key in self._inflight_keys
+                    or session.has_assigned(key)
+                ):
+                    continue
+                seen.add(key)
+                session.add_pending(pod)
+                extra.append(pod)
+            except Exception:
+                # A popped pod must never be lost: it is either staged
+                # (in `extra`, requeued by the caller's fallback) or
+                # back in the queue before the error propagates.
+                q.add(pod)
+                raise
+        return extra
+
+    def _sweep(self) -> List[Pod]:
+        """Non-blocking drain of everything already queued (micro-tick
+        shape: never wait for stragglers — the solve itself coalesces
+        arrivals under churn)."""
+        q = self.config.pod_queue
+        batch: List[Pod] = []
+        while len(batch) < self.max_batch:
+            pod = q.pop(timeout=0.0)
+            if pod is None:
+                break
+            batch.append(pod)
+        return batch
+
+    def _drain(self, timeout: Optional[float]) -> List[Pod]:
+        if not self.microticks:
+            return super()._drain(timeout)
+        # Event-driven micro-tick drain: sweep what is queued; if
+        # nothing is, wait on the wake event (FIFO arrival, watch
+        # delta, commit release) instead of a fixed-period pop — a lone
+        # pod on an idle cluster solves the moment its watch event
+        # lands. With a solve in flight, never block: the caller must
+        # resolve it (its readback has been overlapping this wait).
+        batch = self._sweep()
+        if not batch:
+            if self._inflight is not None:
+                return []
+            self._wake.clear()
+            batch = self._sweep()  # re-check after clear: no lost wake
+            if not batch:
+                if not self._wake.wait(timeout):
+                    return []
+                batch = self._sweep()
+                if not batch:
+                    return []
+        # A cleared wake now means "no arrivals since this drain" —
+        # the explain-shed pressure signal reads it; clearing is safe
+        # because every consumer (sweep, release/delta drains) re-
+        # checks its queue each tick rather than relying on the event.
+        self._wake.clear()
+        if (
+            len(batch) >= self.coalesce_min
+            and len(batch) < self.max_batch
+            and self.batch_window > 0
+        ):
+            # Churn regime: the instantaneous sweep was busy, so pay a
+            # short coalescing window to amortize the solve — bounded
+            # by max_batch exactly like the fixed-period drain.
+            deadline = time.monotonic() + self.batch_window
+            while len(batch) < self.max_batch:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    break
+                pod = self.config.pod_queue.pop(timeout=wait)
+                if pod is None:
+                    break
+                batch.append(pod)
+        batch = [p for p in batch if not p.spec.node_name]
+        batch.sort(key=lambda p: -(p.spec.priority or 0))
+        return batch
+
     def schedule_batch(self, timeout: Optional[float] = 0.5) -> int:
         t_drain = time.monotonic()
         # Every-tick telemetry sample — see BatchScheduler.schedule_batch.
@@ -1358,16 +1848,29 @@ class IncrementalBatchScheduler(BatchScheduler):
         sli.observe_device_telemetry()
         pending = self._drain(timeout)
         if not pending:
+            # Flush the in-flight tick first: its readback has been
+            # overlapping the wait that just came back empty. Nothing
+            # else is queued, so commit inline — no worker handoff.
+            self._resolve_inflight(prefer_inline=True)
             # Keep the session current while idle so the next burst
             # doesn't pay a rebuild.
             if self._session is not None:
                 try:
+                    self._drain_releases()
                     if not self._apply_events(self._session):
                         self._session = None
                 except Exception:
                     # RebuildRequired, decode error, anything — the
                     # consumed delta is gone, so the session can no
                     # longer be trusted.
+                    self._session = None
+            elif self.prewarm_buckets and self.config.wait_for_sync(0):
+                # Idle + no session + pre-warm configured: build NOW so
+                # the first pod pays neither the build nor a compile.
+                try:
+                    self._session = self._build_session()
+                except Exception:
+                    _LOG.debug("eager session build failed", exc_info=True)
                     self._session = None
             else:
                 # No session to apply them to, and the next build
@@ -1389,11 +1892,26 @@ class IncrementalBatchScheduler(BatchScheduler):
     def _session_solve_and_commit(self, pending: List[Pod]) -> int:
         cfg = self.config
         start = time.monotonic()
+        self._last_busy_mono = start  # gates the deferred explain drain
         try:
             t0 = time.monotonic()
             if self._session is None:
+                # A stale in-flight handle (its session was invalidated
+                # by a failed tick) must commit before the rebuild
+                # snapshots the pod lister, or its binds double-book.
+                self._resolve_inflight()
+                self._flush_commits()
                 self._session = self._build_session()
+            # Capacity baseline for this tick's retry backoffs: sampled
+            # BEFORE the delta drain, so a victim exiting after this
+            # point releases the tick's rejects immediately even if the
+            # bump lands before their requeue worker starts waiting.
+            with self._capacity_cond:
+                epoch = self._capacity_epoch
+            self._drain_releases()
             if not self._apply_events(self._session):
+                self._resolve_inflight()
+                self._flush_commits()
                 self._session = self._build_session()
             groups = self._gang_groups(pending)
             deferred: List[Pod] = []
@@ -1407,19 +1925,49 @@ class IncrementalBatchScheduler(BatchScheduler):
             # queued (another scheduler instance; HA failover overlap)
             # — its watch event just charged the session. Feeding it to
             # solve() would double-charge and orphan the true charge
-            # when the 409 rollback fires.
+            # when the 409 rollback fires. A pod still IN FLIGHT from
+            # the previous dispatch is equally off-limits: its first
+            # placement has not landed yet.
             with tracing.phase("lower", pods=len(pending)):
                 for pod in pending:
                     key = (
                         f"{pod.metadata.namespace or 'default'}/"
                         f"{pod.metadata.name}"
                     )
-                    if not self._session.has_assigned(key):
+                    if (
+                        key not in self._inflight_keys
+                        and not self._session.has_assigned(key)
+                    ):
                         self._session.add_pending(pod)
+            ctx = {
+                "pending": pending,
+                "deferred": len(deferred),
+                "groups": groups,
+                "gkey_of": {
+                    f"{pending[i].metadata.namespace or 'default'}/"
+                    f"{pending[i].metadata.name}": g.key
+                    for g in groups
+                    for i in g.indices
+                },
+                "denied_keys": set(),
+                "start": start,
+                "epoch": epoch,
+            }
             if groups:
                 from kubernetes_tpu.ops import SessionGang
                 from kubernetes_tpu.scheduler.gang import OUTCOMES
 
+                # Gang ticks run synchronously: the all-or-nothing
+                # acceptance loop re-solves to a fixed point, so the
+                # previous tick must be fully resolved first.
+                self._resolve_inflight()
+                if self._session is None:
+                    # The resolve failed and invalidated the session
+                    # (its own pods are already requeued): this tick
+                    # falls through to the full-relower fallback.
+                    raise _SessionInvalidated(
+                        "session invalidated during in-flight resolve"
+                    )
                 gangs = [
                     SessionGang(
                         key=g.key,
@@ -1434,36 +1982,89 @@ class IncrementalBatchScheduler(BatchScheduler):
                     for g in groups
                 ]
                 results, denied_keys = self._session.solve_gang(gangs)
-                denied_keys = set(denied_keys)
+                ctx["denied_keys"] = set(denied_keys)
                 for g in gangs:
                     OUTCOMES.inc(
                         outcome=(
-                            "rejected" if g.key in denied_keys
+                            "rejected" if g.key in ctx["denied_keys"]
                             else "accepted"
                         )
                     )
-            else:
-                results = self._session.solve()
-                denied_keys = set()
-            solve_s = time.monotonic() - t0
-            _ALGO_LATENCY.observe(solve_s)
-        except Exception:
+                self._finish_tick(
+                    self._session, results, ctx, time.monotonic() - t0
+                )
+                return len(pending) + len(deferred)
+            # Pipelined dispatch: resolve the PREVIOUS tick (its
+            # readback overlapped this tick's drain/stage and its
+            # commit now rides the worker, overlapping THIS solve),
+            # then enqueue this tick's jitted solve and return without
+            # a host sync — the next drain overlaps its device time.
+            self._resolve_inflight()
+            if self._session is None:
+                # See the gang branch: a failed resolve invalidated
+                # the session; this tick goes through the fallback.
+                raise _SessionInvalidated(
+                    "session invalidated during in-flight resolve"
+                )
+            # Top-up: pods that arrived WHILE the resolve blocked join
+            # this tick instead of waiting out another solve — under
+            # saturation (solve time >= inter-arrival) this is what
+            # makes the batch size track the solve time instead of
+            # pinning every tick at one pod.
+            pending = pending + self._topup(pending)
+            ctx["pending"] = pending
+            ctx["stage_s"] = time.monotonic() - t0
+            handle = self._session.solve_async()
+            if self._pipelined:
+                self._inflight = (handle, ctx)
+                self._inflight_keys = frozenset(handle.keys)
+                return len(pending) + len(deferred)
+            results = handle.result()
+            self._finish_tick(
+                self._session, results, ctx,
+                ctx["stage_s"] + handle.dispatch_s + handle.block_s,
+            )
+            return len(pending) + len(deferred)
+        except Exception as e:
             # RebuildRequired, device error, anything: invalidate and
             # fall back to the parent's full-relower tick (which itself
-            # falls back to scalar if the device path is down).
+            # falls back to scalar if the device path is down). An
+            # in-flight solve MUST commit (and the worker drain) first
+            # — the fallback snapshots the pod lister, and uncommitted
+            # binds would let it double-book their capacity.
+            try:
+                self._resolve_inflight()
+                self._flush_commits()
+            except Exception:
+                _LOG.debug(
+                    "in-flight flush before fallback failed",
+                    exc_info=True,
+                )
             self._session = None
-            self.fallback_count += 1
+            if not isinstance(e, _SessionInvalidated):
+                # _SessionInvalidated's failure was already counted by
+                # the resolve that raised it.
+                self.fallback_count += 1
             for pod in pending:
                 cfg.pod_queue.add(pod)
             return super().schedule_batch(timeout=0.0)
 
-        by_key = {f"{p.metadata.namespace or 'default'}/{p.metadata.name}": p
-                  for p in pending}
-        gkey_of: Dict[str, str] = {
-            f"{pending[i].metadata.namespace or 'default'}/"
-            f"{pending[i].metadata.name}": g.key
-            for g in groups
-            for i in g.indices
+    def _commit_job(self, job) -> None:
+        """Commit one resolved tick: bulk binds, events, flight-
+        recorder/SLI records, the preemption pass, and requeues. Runs
+        on the commit worker thread when the pipeline is live (the
+        HTTP round-trips overlap the next tick's solve) and inline
+        otherwise. Jobs execute in tick order — the worker is one FIFO
+        thread — so no decision/SLI milestone is lost or reordered.
+        NEVER touches the session: charge releases are routed back to
+        the solve loop via _release()."""
+        results, ctx = job
+        cfg = self.config
+        gkey_of: Dict[str, str] = ctx["gkey_of"]
+        denied_keys = ctx["denied_keys"]
+        by_key = {
+            f"{p.metadata.namespace or 'default'}/{p.metadata.name}": p
+            for p in ctx["pending"]
         }
         by_ns: Dict[str, List] = {}
         group_binds: Dict[str, Tuple[str, List[Tuple[str, str]]]] = {}
@@ -1531,13 +2132,13 @@ class IncrementalBatchScheduler(BatchScheduler):
                 # Raced: someone else bound it. The session charged OUR
                 # placement; release it — the true binding arrives via
                 # the scheduled-pods watch and re-charges the right row.
-                self._session.delete_assigned(key)
+                self._release(key)
                 _SCHEDULED.inc(result="bind_conflict")
                 bind_outcome[key] = "bind_conflict"
             else:
                 # Bind error OR the gang's atomic batch rolled back
                 # (409 Aborted): release the session charge and retry.
-                self._session.delete_assigned(key)
+                self._release(key)
                 _SCHEDULED.inc(result="bind_error")
                 bind_outcome[key] = "bind_error"
                 rejected.append(pod)
@@ -1559,11 +2160,10 @@ class IncrementalBatchScheduler(BatchScheduler):
             else:
                 oc = bind_outcome.get(key, "bind_error")
             rows.append((pod, dest, oc, gkey_of.get(key)))
-        stats = dict(getattr(self._session, "last_stats", {}) or {})
-        stats["incremental"] = True
         self._record_decisions(
             rows, cfg.nodes.store.list(), cfg.service_lister.list(),
-            None, solve_s=solve_s, stats=stats,
+            None, solve_s=ctx.get("solve_s", 0.0),
+            stats=ctx.get("stats") or {"incremental": True},
         )
         # Preemption over this tick's unplaceable pods — same pass as
         # the parent daemon; the session is not consulted (victims are
@@ -1577,8 +2177,7 @@ class IncrementalBatchScheduler(BatchScheduler):
         if unbound:
             self._maybe_preempt(
                 unbound, cfg.nodes.store.list(), cfg.pod_lister.list(),
-                groups=groups,
+                groups=ctx["groups"],
             )
-        self._requeue_many(rejected)
-        _E2E_LATENCY.observe(time.monotonic() - start)
-        return len(pending) + len(deferred)
+        self._requeue_many(rejected, epoch=ctx.get("epoch"))
+        _E2E_LATENCY.observe(time.monotonic() - ctx["start"])

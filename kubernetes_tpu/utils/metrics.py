@@ -173,10 +173,16 @@ class Summary(_Metric):
         return out
 
 
-#: client_golang's DefBuckets: tuned for request/phase latencies in
-#: seconds, 5ms through 10s.
+#: client_golang's DefBuckets (5ms..10s), extended both ways for the
+#: latency SLOs: 0.075 fills the sub-100ms band the micro-tick
+#: pod-to-bind objective reads (0.01/0.025/0.05/0.075/0.1 give p99
+#: resolution under the 0.1s target), and the 30/60/120 tail keeps a
+#: saturated series honest — before it, any latency beyond 10s
+#: rendered as a CLAMPED p99 of exactly 10.0 (BENCH_r06's
+#: solve_phase_latency), indistinguishable from a measurement.
 DEFAULT_BUCKETS = (
-    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+    0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0,
 )
 
 

@@ -231,10 +231,12 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
 #: API-plane targets (ROADMAP item 1) without failing CPU CI boxes.
 BENCH_OBJECTIVES: Dict[str, Objective] = {
     "bind_latency_slo": Objective(
-        "bind_latency_slo", "bind_latency_p99_s", target=1.0,
+        "bind_latency_slo", "bind_latency_p99_s", target=0.1,
         kind="value_max", warn_ratio=0.0,
         description="p99 create -> binding watch-visible over the real "
-        "HTTP control plane",
+        "HTTP control plane; 100ms is the always-resident incremental "
+        "loop's bar at 1k nodes on TPU (bench callers may widen via "
+        "gate_s, e.g. for the reference 1s SLO on CPU CI boxes)",
     ),
     "churn_api_slo": Objective(
         "churn_api_slo", "churn_api_pods_per_sec", target=25000.0,

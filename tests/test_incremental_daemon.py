@@ -125,6 +125,16 @@ class TestIncrementalDaemon:
         assert wait_until(lambda: bound_node(client, "sel") == "n1", timeout=20)
         # Node removal empties its row: new pods avoid the gone node.
         client.delete("nodes", "n1")
+        # The DELETED delta rides its own watch stream; a micro-tick
+        # for a pod created in the same instant could legitimately
+        # solve against the last-known cluster view (the reference's
+        # cache-driven scheduler has the identical race). The contract
+        # under test is the ROW EMPTYING, so wait for the session to
+        # absorb the removal (the delta wake applies it promptly).
+        assert wait_until(
+            lambda: sched._session is None
+            or "n1" not in sched._session.node_index
+        )
         client.create(
             "pods",
             pod_wire("sel2", node_selector={"zone": "b"}),

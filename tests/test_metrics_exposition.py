@@ -50,6 +50,35 @@ class TestCounterGauge:
 
 
 class TestHistogram:
+    def test_default_bucket_ladder_golden(self):
+        """The ladder the latency SLOs read, pinned (ISSUE 12): sub-
+        100ms resolution (0.01/0.025/0.05/0.075/0.1) so the 100ms
+        pod-to-bind objective has quantile resolution UNDER its
+        target, and a 30/60/120 tail past client_golang's 10s cap so a
+        saturated series reports a real (interpolated) p99 instead of
+        a value clamped to exactly 10.0 — BENCH_r06's
+        solve_phase_latency 'p99 10.0' was the clamp, not a
+        measurement."""
+        assert metrics.DEFAULT_BUCKETS == (
+            0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 1.0, 2.5,
+            5.0, 10.0, 30.0, 60.0, 120.0,
+        )
+        # Sub-100ms band: four finite bounds strictly below 0.1.
+        assert [b for b in metrics.DEFAULT_BUCKETS if b < 0.1] == [
+            0.005, 0.01, 0.025, 0.05, 0.075,
+        ]
+        # A 12s-heavy series interpolates INSIDE (10, 30], not at the
+        # old clamp.
+        h = metrics.Histogram("ladder_seconds", "x")
+        for _ in range(100):
+            h.observe(12.0)
+        q = h.quantile(0.99)
+        assert 10.0 < q <= 30.0
+        # Rendered exposition carries the new bounds.
+        text = "\n".join(h.render())
+        assert 'le="0.075"' in text and 'le="30"' in text
+        assert 'le="120"' in text
+
     def test_type_line_and_buckets(self):
         h = metrics.Histogram(
             "req_seconds", "Request latency", buckets=(0.1, 1.0, 10.0)

@@ -272,7 +272,9 @@ class TestSLOEngine:
         assert empty["verdict"] == "no_data" and not empty["sampled"]
 
     def test_bench_objectives_are_the_published_definitions(self):
-        assert slo.BENCH_OBJECTIVES["bind_latency_slo"].target == 1.0
+        # 0.1: the always-resident incremental loop's sub-100ms p99
+        # pod-to-bind bar (PR 12); CPU CI legs widen it via gate_s.
+        assert slo.BENCH_OBJECTIVES["bind_latency_slo"].target == 0.1
         assert slo.BENCH_OBJECTIVES["churn_api_slo"].target == 25000.0
         assert slo.BENCH_OBJECTIVES["pod_crud_slo"].target == 20000.0
         for name in ("churn_api_slo", "pod_crud_slo"):

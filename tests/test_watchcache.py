@@ -400,6 +400,72 @@ class TestNoStoreScanSteadyState:
             endpoints.stop()
             cfg.stop()
 
+    def test_session_path_soak_issues_no_store_level_lists(self):
+        """ISSUE 12 extension of the pin: the PIPELINED incremental
+        daemon (micro-ticks, commit worker, capacity event-waits) on
+        its steady state — binds, retries, and watch-delta session
+        upkeep all ride informers; the kvstore's list() is never
+        called, even while pods churn through the session."""
+        from kubernetes_tpu.scheduler.daemon import (
+            IncrementalBatchScheduler,
+            SchedulerConfig,
+        )
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        for j in range(4):
+            client.create("nodes", node_wire(f"n{j}"))
+        cfg = SchedulerConfig(
+            Client(LocalTransport(api)), raw_scheduled_cache=True
+        ).start()
+        sched = None
+        try:
+            assert cfg.wait_for_sync(timeout=60)
+            sched = IncrementalBatchScheduler(cfg).start()
+            for i in range(8):
+                client.create("pods", pod_wire(f"inc{i}"))
+
+            def all_bound():
+                pods, _ = client.list("pods", namespace="default")
+                return pods and all(p.spec.node_name for p in pods)
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all_bound():
+                time.sleep(0.2)
+            assert all_bound()
+            # Steady state: count store-level lists over a churn window
+            # (deletes + creates keep the session's delta path and the
+            # commit pipeline busy).
+            calls = []
+            real_list = api.store.list
+
+            def counting_list(*a, **kw):
+                calls.append(a)
+                return real_list(*a, **kw)
+
+            api.store.list = counting_list
+            try:
+                for r in range(3):
+                    client.delete("pods", f"inc{r}", namespace="default")
+                    client.create("pods", pod_wire(f"inc-re{r}"))
+                    time.sleep(0.3)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    pods, _ = client.list("pods", namespace="default")
+                    if all(p.spec.node_name for p in pods):
+                        break
+                    time.sleep(0.2)
+            finally:
+                api.store.list = real_list
+            assert calls == [], (
+                f"store-level list() hit {len(calls)}x on the session "
+                f"path: {calls[:5]}"
+            )
+        finally:
+            if sched is not None:
+                sched.stop()
+            cfg.stop()
+
 
 class TestValidatorParity:
     FIXTURES = [

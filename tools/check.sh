@@ -396,4 +396,88 @@ slo_rc=$?
 if [ $rc -eq 0 ]; then
     rc=$slo_rc
 fi
+
+# Latency smoke (ISSUE 12): boot local-up with the (now default)
+# incremental session daemon — micro-ticks, pipelined commits,
+# compile-cache pre-warm — trickle pods through it, and assert the
+# PR-9 SLO contract flips to PASS on the bound-latency objective:
+# `ktctl slo` exits 0 and pod_bound_latency verdicts "pass". This is
+# the burn->pass acceptance gate of the always-resident solve loop,
+# reused as CI.
+echo "== latency smoke (micro-tick path) =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import time
+from contextlib import redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.cmd.localup import LocalCluster, build_parser
+
+N_PODS = 30
+
+args = build_parser().parse_args(
+    ["--port", "0", "--nodes", "2", "--batch-scheduler"]
+)
+cluster = LocalCluster(args).start()
+try:
+    client = Client(HTTPTransport(cluster.http.address))
+    # Wait out the pre-warm: the daemon builds its session (and
+    # compiles the small pod buckets) on its first idle tick — the
+    # trickle below must measure micro-ticks, not compiles.
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if getattr(cluster.scheduler, "_session", None) is not None:
+            break
+        time.sleep(0.25)
+    assert getattr(cluster.scheduler, "_session", None) is not None, (
+        "incremental session never pre-warmed"
+    )
+    def pod(name):
+        return {"kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "pause",
+                         "resources": {"limits": {"cpu": "50m",
+                                                  "memory": "32Mi"}}}]}}
+    for i in range(N_PODS):
+        client.create("pods", pod(f"lat-{i}"), namespace="default")
+        time.sleep(0.05)  # trickle: every pod gets its own micro-tick
+    deadline = time.monotonic() + 120
+    bound = 0
+    while time.monotonic() < deadline and bound < N_PODS:
+        pods, _ = client.list("pods", namespace="default")
+        bound = sum(1 for p in pods if p.spec.node_name)
+        if bound < N_PODS:
+            time.sleep(0.2)
+    assert bound == N_PODS, f"only {bound}/{N_PODS} bound"
+    # The SLO engine's verdict on the bound-latency objective must be
+    # a clean PASS (the pre-PR-12 state was burn: BENCH_r06).
+    from kubernetes_tpu.utils import slo
+    deadline = time.monotonic() + 30
+    obj = {}
+    while time.monotonic() < deadline:
+        report = slo.evaluate()
+        obj = {o["name"]: o for o in report["objectives"]}
+        if obj.get("pod_bound_latency", {}).get("samples", 0) >= N_PODS:
+            break
+        time.sleep(0.25)
+    pbl = obj.get("pod_bound_latency", {})
+    assert pbl.get("samples", 0) >= N_PODS, obj
+    assert pbl["verdict"] == "pass", (
+        f"pod_bound_latency must PASS on the micro-tick path: {pbl}"
+    )
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = ktctl.main(["slo"], client=client)
+    assert rc == 0, out.getvalue()
+    assert "pod_bound_latency" in out.getvalue()
+    print(f"latency smoke OK: {N_PODS} trickled pods bound; "
+          f"pod_bound_latency p99={pbl.get('p99')}s verdict=pass")
+finally:
+    cluster.stop()
+EOF
+lat_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$lat_rc
+fi
 exit $rc
