@@ -1165,6 +1165,133 @@ def cmd_slo(client: Client, args) -> int:
     return 0
 
 
+def _fmt_qty(v) -> str:
+    """Human-compact engineering figure for ledger table cells."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.4g}"
+
+
+def cmd_profile(client: Client, args) -> int:
+    """`ktctl profile [kernels|cpu|device]` — the device-time profiling
+    plane's CLI face:
+
+    - kernels: the XLA compile/cost ledger (GET /debug/kernels) — one
+      row per jitted kernel with compile counts/wall and the harvested
+      cost/memory analysis. Exits 1 with 'no compiles recorded' on a
+      cold process (the trace/explain/slo miss contract).
+    - cpu: the wall-clock sampling profiler (GET /debug/profile),
+      --format collapsed emits flamegraph.pl/speedscope folded stacks.
+    - device: an on-demand jax.profiler device trace
+      (GET /debug/device-profile?seconds=N); prints the server-side
+      trace directory.
+    """
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if args.what in ("cpu", "device") and hasattr(transport, "timeout"):
+        # The capture blocks the handler for --seconds; the transport's
+        # default 30s socket timeout would sever a longer capture
+        # mid-flight (and the server-side trace would keep running,
+        # 409-ing the retry).
+        transport.timeout = max(transport.timeout, args.seconds + 30.0)
+    if args.what == "kernels":
+        if get_json is not None:
+            data = get_json("/debug/kernels")
+        else:
+            # Injected in-process transport (LocalTransport): the
+            # ledger is process-local — read it via sys.modules so a
+            # process that never dispatched a kernel (ledger module
+            # never imported) reports the miss without loading jax.
+            led = sys.modules.get("kubernetes_tpu.ops.ledger")
+            data = (
+                led.DEFAULT.to_dict()
+                if led is not None
+                else {"kernels": [], "summary": {"compiles": 0}}
+            )
+        rows = data.get("kernels", [])
+        if not rows:
+            # Clean nonzero exit, empty stdout: a script gating on the
+            # ledger must see that nothing compiled, not a hollow table.
+            print("no compiles recorded", file=sys.stderr)
+            return 1
+        if args.output == "json":
+            print(json.dumps(data, indent=2))
+            return 0
+        if args.output == "yaml":
+            print(yaml.safe_dump(data, default_flow_style=False))
+            return 0
+        print(
+            f"{'KERNEL':44}{'CALLS':>7}{'COMPILES':>9}{'COMPILE_S':>10}"
+            f"{'FLOPS':>9}{'BYTES':>9}{'AI':>7}"
+        )
+        for r in rows:
+            shapes = r.get("shapes", ())
+
+            def peak(metric):
+                vals = [
+                    s.get(metric) for s in shapes
+                    if s.get(metric) is not None
+                ]
+                return max(vals) if vals else None
+
+            ai = peak("arithmetic_intensity")
+            print(
+                f"{r['kernel']:44}{r.get('calls', 0):>7}"
+                f"{r.get('compiles', 0):>9}"
+                f"{r.get('compile_seconds', 0.0):>10.3f}"
+                f"{_fmt_qty(peak('flops')):>9}"
+                f"{_fmt_qty(peak('bytes_accessed')):>9}"
+                f"{'-' if ai is None else f'{ai:.2f}':>7}"
+            )
+        summary = data.get("summary", {})
+        print(
+            f"total: {summary.get('compiles', 0)} compiles, "
+            f"{summary.get('compile_seconds_total', 0.0)}s compiling, "
+            f"{summary.get('pending_cost_rows', 0)} cost rows pending"
+        )
+        return 0
+    if args.what == "cpu":
+        get_text = getattr(transport, "get_text", None)
+        if get_text is not None:
+            body = get_text(
+                "/debug/profile",
+                query={"seconds": str(args.seconds), "format": args.fmt},
+            )
+        else:
+            from kubernetes_tpu.utils import debug
+
+            body = debug.sample_profile(seconds=args.seconds, fmt=args.fmt)
+        sys.stdout.write(body)
+        return 0
+    # device
+    if get_json is not None:
+        info = get_json(
+            "/debug/device-profile", query={"seconds": str(args.seconds)}
+        )
+    else:
+        from kubernetes_tpu.utils import profiler
+
+        try:
+            info = profiler.capture_device_trace(seconds=args.seconds)
+        except (profiler.TraceInProgress, profiler.ProfilerUnavailable) as e:
+            # Same one-line contract the HTTP path gets via 409/503 ->
+            # APIError; a traceback is not an error message.
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    if args.output == "json":
+        print(json.dumps(info, indent=2))
+        return 0
+    print(
+        f"device trace: {info.get('seconds')}s captured to "
+        f"{info.get('dir')} ({len(info.get('files', []))} files)"
+    )
+    return 0
+
+
 #: /metrics series prefixes `ktctl top cluster` surfaces (the telemetry
 #: plane's device/solver/watch families, not the whole exposition).
 _TOP_CLUSTER_PREFIXES = (
@@ -1403,6 +1530,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     sl = sub.add_parser("slo", parents=[common])
     sl.set_defaults(fn=cmd_slo)
+
+    pf2 = sub.add_parser("profile", parents=[common])
+    pf2.add_argument(
+        "what", nargs="?", default="kernels",
+        choices=["kernels", "cpu", "device"],
+    )
+    pf2.add_argument("--seconds", type=float, default=2.0)
+    pf2.add_argument(
+        "--format", dest="fmt", default="top",
+        choices=["top", "collapsed"],
+        help="cpu profile rendering: human-readable or folded stacks",
+    )
+    pf2.set_defaults(fn=cmd_profile)
 
     tc = sub.add_parser("trace", parents=[common])
     tc.add_argument("name", nargs="?", help="pod name (omit for all)")

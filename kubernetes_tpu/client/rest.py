@@ -384,6 +384,13 @@ class HTTPTransport(Transport):
         request."""
         return self._do("GET", path, query=query)
 
+    def get_text(
+        self, path: str, query: Optional[Dict[str, str]] = None
+    ) -> str:
+        """get_json's text/plain sibling (`/debug/profile`, /metrics):
+        the response body verbatim, same connection/auth/retry."""
+        return self._do("GET", path, query=query, raw=True)
+
     def request(self, verb, op, args, body=None, patch_type=None):
         if op == "create":
             resource, namespace = args

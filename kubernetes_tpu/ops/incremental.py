@@ -23,7 +23,6 @@ stays the source of truth, SURVEY.md §5 checkpoint model).
 
 from __future__ import annotations
 
-import functools
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -52,6 +51,7 @@ from kubernetes_tpu.models.objects import (
     Pod,
     Service,
 )
+from kubernetes_tpu.ops.ledger import traced_jit
 from kubernetes_tpu.ops.matrices import SVC_K
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, solve_with_state
 
@@ -64,7 +64,7 @@ class RebuildRequired(Exception):
 from kubernetes_tpu.ops.matrices import pow2_bucket as _bucket  # noqa: E402
 
 
-@functools.partial(jax.jit, donate_argnames=("nodes",))
+@traced_jit(donate_argnames=("nodes",))
 def _scatter_rows(nodes: Dict[str, jnp.ndarray], idx: jnp.ndarray, rows: Dict):
     return {k: nodes[k].at[idx].set(rows[k]) for k in nodes}
 
@@ -99,7 +99,8 @@ class PendingSolve:
 
     __slots__ = (
         "_session", "pending", "assignment", "tele",
-        "dispatch_s", "block_s", "_done", "_result",
+        "dispatch_s", "block_s", "dispatched_mono", "resolved_mono",
+        "_done", "_result",
     )
 
     def __init__(self, session, pending, assignment, tele, dispatch_s):
@@ -109,6 +110,11 @@ class PendingSolve:
         self.tele = tele  # (waves, sinkhorn_iters, sinkhorn_residual)
         self.dispatch_s = dispatch_s
         self.block_s = 0.0
+        # Duty-cycle accounting (utils/profiler.py): the in-flight
+        # window is dispatched_mono -> resolved_mono; block_s of it is
+        # host time spent blocked in result().
+        self.dispatched_mono = time.monotonic()
+        self.resolved_mono = 0.0
         self._done = assignment is None
         self._result: List[Tuple[str, Optional[str]]] = []
 
@@ -562,7 +568,8 @@ class SolverSession:
             self._pod_node[lp.key] = j
             self._apply_commit_host(j, lp)
             out.append((lp.key, self.node_names[j]))
-        handle.block_s = time.monotonic() - t0
+        handle.resolved_mono = time.monotonic()
+        handle.block_s = handle.resolved_mono - t0
         handle._result = out
         handle._done = True
 

@@ -1,0 +1,501 @@
+"""The XLA compile/cost ledger: every jitted kernel's compile history.
+
+After PR 12 the daemon is an always-on pipelined device program, yet
+nothing could say where device time goes: which kernel compiled when,
+at what wall cost, and what the compiled executable actually costs to
+run (FLOPs, HBM bytes, temp allocation). This module closes that gap
+with a ``traced_jit`` wrapper adopted at every ``jax.jit`` site under
+``ops/`` — the SAME inventory ktlint's KT006 pass cross-checks against
+``ops/parity.py`` ORACLE_TWINS, so ledger kernel names and registry
+keys are one namespace (``solver._solve_xla``,
+``preemption._victim_prefix_kernel.kernel``, ...).
+
+What gets recorded, per (kernel, staged-shape signature):
+
+- **compile events**: detected via the jit dispatch cache sentinel the
+  PR-7 recompilation test already watches (``_cache_size()`` growth
+  around a dispatch); the dispatch wall of a growing call ~= trace +
+  lower + XLA compile, because jit dispatch is async — execution does
+  not block it. Re-compiles after ``jax.clear_caches()`` count again
+  (they ARE new compiles); cache hits never do.
+- **cost/memory analysis**: ``Compiled.cost_analysis()`` /
+  ``memory_analysis()`` (FLOPs, bytes accessed, derived arithmetic
+  intensity, temp/arg/output bytes) harvested on a BACKGROUND thread
+  via an avals-only ``.lower().compile()`` — the AOT compile does not
+  share the dispatch cache in this jax, so harvesting inline would
+  double every compile stall on the tick path. Rows show
+  ``cost_status: pending`` until the harvest lands (tests and bench
+  block on ``wait_pending``).
+
+Surfaces: ``GET /debug/kernels`` (server/httpserver.py), ``ktctl
+profile kernels`` (exit 1 + "no compiles recorded" on a cold process),
+the ``solver_compile_seconds_total{kernel}`` counter, and bench.py's
+profiler summary.
+
+No module-level jax import — ops/preemption.py keeps its "a CPU-only
+host without jax configured never imports it at module load" contract
+and this module rides the same rule (jax loads at first TracedJit
+construction, which IS a jit construction).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.utils import metrics, sanitizer
+
+_LOG = logging.getLogger("kubernetes_tpu.ledger")
+
+#: Wall seconds spent compiling, by kernel — the counter bench.py and
+#: the SLO plane read next to solver_xla_compiles_total (which counts
+#: events; this one carries the time).
+COMPILE_SECONDS = metrics.DEFAULT.counter(
+    "solver_compile_seconds_total",
+    "Wall seconds spent in XLA solver compiles, by kernel",
+    ("kernel",),
+)
+
+#: KT_LEDGER_HARVEST=0 disables the background cost harvest (the
+#: second, avals-only compile per unique shape). The compile-event half
+#: of the ledger — names, shapes, wall times, counts — stays on.
+_HARVEST_ENABLED = os.environ.get("KT_LEDGER_HARVEST", "1") != "0"
+
+
+def _derive_kernel_name(fn) -> str:
+    """Registry-keyed kernel name: '<ops module>.<dotted def path>' —
+    the exact ORACLE_TWINS key format (nested jits keep their enclosing
+    function, '<locals>' stripped)."""
+    mod = (getattr(fn, "__module__", "") or "").rsplit(".", 1)[-1]
+    qual = (getattr(fn, "__qualname__", "") or getattr(fn, "__name__", "?"))
+    return f"{mod}.{qual.replace('.<locals>', '')}"
+
+
+def _leaf_sig(leaf) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        r = repr(leaf)
+        return r if len(r) <= 32 else r[:29] + "..."
+    import numpy as np
+
+    d = np.dtype(dtype)
+    return f"{d.kind}{d.itemsize * 8}[{','.join(str(s) for s in shape)}]"
+
+
+def _signature(args, kwargs) -> str:
+    """Compact staged-shape signature of one call — the ledger's
+    per-bucket key. Only computed on compile events (tree-flattening
+    every call would tax the micro-tick path for nothing)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    return ",".join(_leaf_sig(leaf) for leaf in leaves)
+
+
+def _avalize(args, kwargs):
+    """(args, kwargs) with array leaves replaced by ShapeDtypeStructs,
+    so the background harvest can re-lower WITHOUT touching live (or
+    donated-and-deleted) buffers — avals survive donation."""
+    import jax
+
+    def conv(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return x
+        sharding = None
+        try:
+            sharding = x.sharding
+        except Exception:
+            sharding = None
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        except TypeError:
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+    return jax.tree_util.tree_map(
+        conv, (args, kwargs), is_leaf=lambda x: hasattr(x, "shape")
+    )
+
+
+def _normalize_cost(analysis) -> Dict[str, float]:
+    """Compiled.cost_analysis() returns a dict (or a 1-list of dicts,
+    depending on jax version); keep the headline figures + the derived
+    arithmetic intensity."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not isinstance(analysis, dict):
+        return {}
+    flops = float(analysis.get("flops", 0.0) or 0.0)
+    nbytes = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    out = {"flops": flops, "bytes_accessed": nbytes}
+    if nbytes > 0:
+        out["arithmetic_intensity"] = round(flops / nbytes, 4)
+    return out
+
+
+class CompileLedger:
+    """Thread-safe per-kernel compile/cost rows. One instance per
+    process (``DEFAULT``); daemons and tests share it the way they
+    share the metrics registry."""
+
+    def __init__(self):
+        self._lock = sanitizer.lock("ledger.rows")
+        # kernel -> {"calls", "compiles", "compile_seconds",
+        #            "shapes": {signature -> shape row dict}}
+        self._rows: Dict[str, dict] = {}
+
+    # -- hot path ------------------------------------------------------
+
+    def note_call(self, kernel: str) -> None:
+        with self._lock:
+            row = self._rows.get(kernel)
+            if row is None:
+                row = self._rows[kernel] = {
+                    "calls": 0, "compiles": 0,
+                    "compile_seconds": 0.0, "shapes": {},
+                }
+            row["calls"] += 1
+
+    def record_compile(
+        self, kernel: str, signature: str, compile_s: float
+    ) -> None:
+        """One observed XLA compile (dispatch-cache growth). Repeat
+        compiles of a signature (jax.clear_caches) accumulate; cache
+        hits never reach here."""
+        COMPILE_SECONDS.inc(compile_s, kernel=kernel)
+        with self._lock:
+            row = self._rows.setdefault(
+                kernel,
+                {"calls": 0, "compiles": 0,
+                 "compile_seconds": 0.0, "shapes": {}},
+            )
+            row["calls"] += 1
+            row["compiles"] += 1
+            row["compile_seconds"] += compile_s
+            shape = row["shapes"].get(signature)
+            if shape is None:
+                shape = row["shapes"][signature] = {
+                    "signature": signature,
+                    "compiles": 0,
+                    "compile_seconds": 0.0,
+                    "first_compiled_unix": time.time(),
+                    "cost_status": "pending",
+                }
+            shape["compiles"] += 1
+            shape["compile_seconds"] += compile_s
+
+    # -- harvest results -----------------------------------------------
+
+    def attach_cost(
+        self, kernel: str, signature: str,
+        cost: Dict[str, float], memory: Dict[str, int],
+    ) -> None:
+        with self._lock:
+            shape = (
+                self._rows.get(kernel, {}).get("shapes", {}).get(signature)
+            )
+            if shape is None:
+                return
+            shape.update(cost)
+            shape.update(memory)
+            shape["cost_status"] = "ok"
+
+    def attach_error(self, kernel: str, signature: str, err: str) -> None:
+        with self._lock:
+            shape = (
+                self._rows.get(kernel, {}).get("shapes", {}).get(signature)
+            )
+            if shape is not None:
+                shape["cost_status"] = f"error: {err}"
+
+    # -- reads ---------------------------------------------------------
+
+    def kernels(self) -> List[str]:
+        with self._lock:
+            return sorted(self._rows)
+
+    def rows(self) -> List[dict]:
+        """Per-kernel rows (shape sub-rows sorted by signature), deep
+        enough a caller can mutate its copy."""
+        with self._lock:
+            out = []
+            for kernel in sorted(self._rows):
+                row = self._rows[kernel]
+                out.append(
+                    {
+                        "kernel": kernel,
+                        "calls": row["calls"],
+                        "compiles": row["compiles"],
+                        "compile_seconds": round(row["compile_seconds"], 6),
+                        "shapes": [
+                            dict(row["shapes"][sig])
+                            for sig in sorted(row["shapes"])
+                        ],
+                    }
+                )
+            return out
+
+    def summary(self) -> dict:
+        rows = self.rows()
+        compiles = sum(r["compiles"] for r in rows)
+
+        def best(metric: str) -> List[dict]:
+            ranked = sorted(
+                (
+                    (
+                        max(
+                            (s.get(metric, 0.0) or 0.0)
+                            for s in r["shapes"]
+                        ) if r["shapes"] else 0.0,
+                        r["kernel"],
+                    )
+                    for r in rows
+                ),
+                reverse=True,
+            )
+            return [
+                {"kernel": k, metric: v} for v, k in ranked[:3] if v > 0
+            ]
+
+        return {
+            "kernels": len(rows),
+            "compiles": compiles,
+            "calls_total": sum(r["calls"] for r in rows),
+            "compile_seconds_total": round(
+                sum(r["compile_seconds"] for r in rows), 6
+            ),
+            "pending_cost_rows": sum(
+                1
+                for r in rows
+                for s in r["shapes"]
+                if s.get("cost_status") == "pending"
+            ),
+            "top_flops": best("flops"),
+            "top_bytes": best("bytes_accessed"),
+        }
+
+    def to_dict(self) -> dict:
+        return {"kernels": self.rows(), "summary": self.summary()}
+
+    def wait_pending(self, timeout: float = 30.0) -> bool:
+        """Block until no shape row's cost_status is 'pending' (tests
+        + bench read the ledger after this). True = drained."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pending = any(
+                    s.get("cost_status") == "pending"
+                    for r in self._rows.values()
+                    for s in r["shapes"].values()
+                )
+            if not pending:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+
+DEFAULT = CompileLedger()
+
+
+# -- background cost harvest -------------------------------------------
+
+_HARVEST_Q: "queue.Queue" = queue.Queue()
+_HARVEST_STARTED = threading.Event()
+#: Interpreter shutdown in progress: stop compiling (an XLA compile
+#: running on the (daemon) harvest thread while CPython tears down
+#: aborts the process with "terminate called without an active
+#: exception"), mark queued rows instead, and let the worker drain.
+_SHUTDOWN = threading.Event()
+
+
+def _shutdown_harvest() -> None:
+    """Pre-teardown drain: flag shutdown (queued items resolve to an
+    error marker instead of compiling), post the exit sentinel, and
+    join the worker — it finishes at most the ONE compile already in
+    flight. Registered via threading._register_atexit so it runs
+    before CPython starts destroying thread states. The join is
+    BOUNDED: a pathological native compile must not pin interpreter
+    exit for minutes — past the cap we accept the (rare) residual risk
+    of tearing down under it rather than hanging a Ctrl-C."""
+    _SHUTDOWN.set()
+    if not _HARVEST_STARTED.is_set():
+        return
+    thread = _HARVEST_THREAD[0]
+    _HARVEST_Q.put(None)
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=60.0)
+        if thread.is_alive():  # pragma: no cover - pathological compile
+            _LOG.warning(
+                "ledger cost harvest still compiling after 60s at "
+                "interpreter exit; abandoning it"
+            )
+
+
+_HARVEST_THREAD: List[Optional[threading.Thread]] = [None]
+# concurrent.futures' trick: threading._register_atexit callbacks run
+# BEFORE threading._shutdown joins/freezes threads (plain atexit runs
+# too late to stop a native compile cleanly on every CPython).
+_register = getattr(threading, "_register_atexit", None)
+if _register is not None:
+    _register(_shutdown_harvest)
+else:  # pragma: no cover - very old CPython
+    import atexit
+
+    atexit.register(_shutdown_harvest)
+
+
+def _harvest_worker() -> None:
+    while True:
+        item = _HARVEST_Q.get()
+        if item is None:
+            _HARVEST_Q.task_done()
+            return
+        led, jitfn, aval_args, aval_kwargs, kernel, signature = item
+        if _SHUTDOWN.is_set():
+            led.attach_error(kernel, signature, "interpreter shutdown")
+            _HARVEST_Q.task_done()
+            continue
+        try:
+            compiled = jitfn.lower(*aval_args, **aval_kwargs).compile()
+            cost = _normalize_cost(compiled.cost_analysis())
+            ma = compiled.memory_analysis()
+            memory = {
+                "temp_bytes": int(
+                    getattr(ma, "temp_size_in_bytes", 0) or 0
+                ),
+                "argument_bytes": int(
+                    getattr(ma, "argument_size_in_bytes", 0) or 0
+                ),
+                "output_bytes": int(
+                    getattr(ma, "output_size_in_bytes", 0) or 0
+                ),
+                "generated_code_bytes": int(
+                    getattr(ma, "generated_code_size_in_bytes", 0) or 0
+                ),
+            }
+            led.attach_cost(kernel, signature, cost, memory)
+        except Exception as e:
+            _LOG.debug(
+                "cost harvest for %s failed", kernel, exc_info=True
+            )
+            led.attach_error(kernel, signature, repr(e))
+        finally:
+            _HARVEST_Q.task_done()
+
+
+def _schedule_harvest(led, jitfn, args, kwargs, kernel, signature) -> None:
+    """Queue a cost/memory harvest for `led`'s (kernel, signature) row.
+    The TARGET ledger rides the queue item: the row must resolve on
+    whichever ledger recorded the compile, not whatever DEFAULT points
+    at when the worker gets around to it."""
+    if not _HARVEST_ENABLED:
+        led.attach_error(kernel, signature, "harvest disabled")
+        return
+    try:
+        aval_args, aval_kwargs = _avalize(args, kwargs)
+    except Exception as e:
+        led.attach_error(kernel, signature, f"avalize: {e!r}")
+        return
+    if _SHUTDOWN.is_set():
+        led.attach_error(kernel, signature, "interpreter shutdown")
+        return
+    if not _HARVEST_STARTED.is_set():
+        _HARVEST_STARTED.set()
+        t = threading.Thread(
+            target=_harvest_worker, name="kt-ledger-harvest", daemon=True
+        )
+        _HARVEST_THREAD[0] = t
+        t.start()
+    _HARVEST_Q.put((led, jitfn, aval_args, aval_kwargs, kernel, signature))
+
+
+# -- the wrapper -------------------------------------------------------
+
+
+class TracedJit:
+    """``jax.jit`` with a compile ledger. Call-compatible with the
+    wrapped pjit function and forwards its introspection surface —
+    ``_cache_size()`` (the PR-7 sentinel tests and utils/sli.py read),
+    ``clear_cache()``, ``lower()`` — so adopting the wrapper changes
+    observability, never behavior."""
+
+    def __init__(self, fn, jit_kwargs: dict, kernel: Optional[str] = None):
+        import jax
+
+        self._fn = fn
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self.kernel = kernel or _derive_kernel_name(fn)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        jfn = self._jit
+        try:
+            before = jfn._cache_size()
+        except Exception:
+            before = None
+        t0 = time.perf_counter()
+        out = jfn(*args, **kwargs)
+        if before is None:
+            DEFAULT.note_call(self.kernel)
+            return out
+        try:
+            grew = jfn._cache_size() > before
+        except Exception:
+            grew = False
+        if not grew:
+            DEFAULT.note_call(self.kernel)
+            return out
+        # Dispatch is async, so a growing call's wall ~= trace + lower
+        # + XLA compile (execution doesn't block the return). Two
+        # threads racing the same wrapper could misattribute ONE event
+        # — tolerated: the bookkeeping must never serialize solves.
+        compile_s = time.perf_counter() - t0
+        try:
+            signature = _signature(args, kwargs)
+        except Exception:
+            signature = "?"
+        led = DEFAULT
+        led.record_compile(self.kernel, signature, compile_s)
+        _schedule_harvest(
+            led, self._jit, args, kwargs, self.kernel, signature
+        )
+        return out
+
+    # -- forwarded pjit surface ---------------------------------------
+
+    def _cache_size(self) -> int:
+        return self._jit._cache_size()
+
+    def clear_cache(self) -> None:
+        clear = getattr(self._jit, "clear_cache", None)
+        if clear is not None:
+            clear()
+
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+    def eval_shape(self, *args, **kwargs):
+        return self._jit.eval_shape(*args, **kwargs)
+
+
+def traced_jit(fn=None, *, kernel: Optional[str] = None, **jit_kwargs):
+    """Drop-in ``jax.jit`` replacement for ops/ kernels: identical
+    static_argnames/donate_argnames semantics, plus ledger accounting.
+    Usable bare (``@traced_jit``) or as a factory
+    (``@traced_jit(static_argnames=(...))``); ktlint's KT001/KT006
+    passes recognize both shapes as jit decoration."""
+    if fn is not None:
+        return TracedJit(fn, jit_kwargs, kernel)
+    return lambda f: TracedJit(f, jit_kwargs, kernel)

@@ -14,7 +14,6 @@ slightly different cluster sizes reuse the compiled executable
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
@@ -24,6 +23,7 @@ import numpy as np
 
 from kubernetes_tpu.models.algspec import DEFAULT_LOWERED, LoweredSpec
 from kubernetes_tpu.models.columnar import SVC_K, Snapshot  # noqa: F401
+from kubernetes_tpu.ops.ledger import traced_jit
 # (SVC_K re-exported: device consumers import it from here.)
 
 
@@ -223,7 +223,7 @@ def decode_predicate_bits(bits: int) -> list:
     ]
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups",))
+@traced_jit(static_argnames=("num_groups",))
 def gang_member_counts(
     placed: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int
 ) -> jnp.ndarray:

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from kubernetes_tpu.ops.ledger import traced_jit
+
 # Lane layout of the packed per-pod row (i32). Bitset word counts are
 # static per compiled kernel (shape-derived).
 #   [0]=cpu [1]=mem [2]=zero [3]=pinned [4]=svc
@@ -305,9 +307,7 @@ def _kernel(
     choice_ref[...] = choices
 
 
-@functools.partial(
-    jax.jit, static_argnames=("weights", "interpret")
-)
+@traced_jit(static_argnames=("weights", "interpret"))
 def _solve_packed(pods, nodes, weights, interpret=False):
     """Prep (pack/transpose/cast) + pallas_call + carry rebuild, fused
     under one jit."""

@@ -39,7 +39,6 @@ after victims exit — a nomination is a reservation, not a binding.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -61,6 +60,7 @@ from kubernetes_tpu.models.objects import (
     pod_is_terminating,
     pod_priority,
 )
+from kubernetes_tpu.ops.ledger import traced_jit
 from kubernetes_tpu.ops.matrices import pow2_bucket
 
 #: Sentinel "no feasible victim prefix" for per-node k arrays.
@@ -200,7 +200,7 @@ def _victim_prefix_kernel():
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnames=("num_nodes",))
+    @traced_jit(static_argnames=("num_nodes",))
     def kernel(
         v_cpu, v_mem, v_prio, v_node, v_alive,
         free_cpu, free_mem, free_pods, node_ok,

@@ -42,6 +42,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from kubernetes_tpu.ops.ledger import traced_jit
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS
 from kubernetes_tpu.ops.wave import _tie_hash, run_windowed, strip_assignments
 
@@ -161,8 +162,7 @@ def sinkhorn_assignments(dsnap, **kw):
     return stripped, waves
 
 
-@functools.partial(
-    jax.jit,
+@traced_jit(
     static_argnames=("weights", "window", "per_node_limit", "eps", "iters",
                      "price_cap", "tol"),
 )
@@ -208,8 +208,7 @@ def solve_sinkhorn(
     return assignment, waves
 
 
-@functools.partial(
-    jax.jit,
+@traced_jit(
     static_argnames=("weights", "window", "per_node_limit", "eps", "iters",
                      "price_cap", "tol"),
     donate_argnames=("nodes",),

@@ -27,7 +27,6 @@ ICI, and the occupancy updates stay local to the owning shard.
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -35,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.models.algspec import DEFAULT_LOWERED, LoweredSpec
+from kubernetes_tpu.ops.ledger import traced_jit
 from kubernetes_tpu.ops.matrices import DeviceSnapshot
 
 # Weighted-sum weights for the default provider (defaults.go:51-60):
@@ -324,15 +324,13 @@ def _scan_solve(pods, nodes, weights, lspec=DEFAULT_LOWERED):
     return jax.lax.scan(step, nodes, pods, unroll=8)
 
 
-@functools.partial(jax.jit, static_argnames=("weights", "lspec"))
+@traced_jit(static_argnames=("weights", "lspec"))
 def _solve_xla(pods, nodes, weights, lspec):
     _, assignment = _scan_solve(pods, nodes, weights, lspec)
     return assignment
 
 
-@functools.partial(
-    jax.jit, static_argnames=("weights", "lspec"), donate_argnames=("nodes",)
-)
+@traced_jit(static_argnames=("weights", "lspec"), donate_argnames=("nodes",))
 def _solve_with_state_xla(pods, nodes, weights, lspec):
     final, assignment = _scan_solve(pods, nodes, weights, lspec)
     return assignment, final
@@ -411,7 +409,7 @@ def _explain_row(pod: Dict, nodes: Dict, N: int):
     return bits, lr, bra, spread
 
 
-@jax.jit
+@traced_jit
 def explain_rows(pods: Dict[str, jnp.ndarray], nodes: Dict[str, jnp.ndarray]):
     """The explain readback: default-pipeline verdicts for a batch of
     pods, vmapped — (bits u32[P, N], lr i32[P, N], bra, spread). The

@@ -34,12 +34,12 @@ pod per loop iteration (plugin/pkg/scheduler/scheduler.go:113-158).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from kubernetes_tpu.ops.ledger import traced_jit
 from kubernetes_tpu.ops.solver import DEFAULT_WEIGHTS, _feasible, _scores
 
 UNDECIDED = -2  # assignment sentinel: not yet finalized
@@ -321,9 +321,7 @@ def run_windowed(
     return assignment, carry, waves, titers, residual
 
 
-@functools.partial(
-    jax.jit, static_argnames=("weights", "window", "per_node_limit")
-)
+@traced_jit(static_argnames=("weights", "window", "per_node_limit"))
 def solve_waves(
     pods: Dict[str, jnp.ndarray],
     nodes: Dict[str, jnp.ndarray],
@@ -338,8 +336,7 @@ def solve_waves(
     return assignment, waves
 
 
-@functools.partial(
-    jax.jit,
+@traced_jit(
     static_argnames=("weights", "window", "per_node_limit"),
     donate_argnames=("nodes",),
 )

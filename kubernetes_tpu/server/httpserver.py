@@ -242,6 +242,45 @@ class _Handler(BaseHTTPRequestHandler):
                 200, json.dumps(slo.evaluate()), "application/json"
             )
             return
+        if rest == ("kernels",):
+            # The XLA compile/cost ledger (ops/ledger.py): per-kernel
+            # compile events with cost/memory analysis — `ktctl profile
+            # kernels`' data source. A process that never dispatched a
+            # kernel has an empty ledger BY DEFINITION, so the module
+            # is read from sys.modules instead of imported: a thin
+            # control-plane apiserver must not load jax to say "no
+            # compiles recorded".
+            import sys as _sys
+
+            led = _sys.modules.get("kubernetes_tpu.ops.ledger")
+            payload = (
+                led.DEFAULT.to_dict()
+                if led is not None
+                else {"kernels": [], "summary": {"compiles": 0}}
+            )
+            self._send_text(
+                200, json.dumps(payload), "application/json"
+            )
+            return
+        if rest == ("device-profile",):
+            # On-demand device trace (utils/profiler.py wrapping
+            # jax.profiler.trace): blocks this handler thread for
+            # ?seconds= while every other thread's dispatches land in
+            # the trace; returns the server-side directory.
+            from kubernetes_tpu.utils import profiler
+
+            try:
+                seconds = float(self.query.get("seconds", "2"))
+            except ValueError:
+                raise APIError(400, "BadRequest", "seconds must be numeric")
+            try:
+                info = profiler.capture_device_trace(seconds=seconds)
+            except profiler.TraceInProgress as e:
+                raise APIError(409, "Conflict", str(e))
+            except profiler.ProfilerUnavailable as e:
+                raise APIError(503, "ServiceUnavailable", str(e))
+            self._send_text(200, json.dumps(info), "application/json")
+            return
         if rest == ("requests",):
             body = debug.DEFAULT_REQUEST_LOG.render()
         elif rest == ("stacks",):
@@ -251,13 +290,19 @@ class _Handler(BaseHTTPRequestHandler):
                 seconds = float(self.query.get("seconds", "2"))
             except ValueError:
                 raise APIError(400, "BadRequest", "seconds must be numeric")
-            body = debug.sample_profile(seconds=seconds)
+            fmt = self.query.get("format", "top")
+            if fmt not in ("top", "collapsed"):
+                raise APIError(
+                    400, "BadRequest", "format must be top or collapsed"
+                )
+            body = debug.sample_profile(seconds=seconds, fmt=fmt)
         else:
             raise APIError(
                 404, "NotFound",
                 "debug endpoints: /debug/requests /debug/stacks "
                 "/debug/profile /debug/traces /debug/decisions "
-                "/debug/solves /debug/slo",
+                "/debug/solves /debug/slo /debug/kernels "
+                "/debug/device-profile",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
 
@@ -348,6 +393,10 @@ class _Handler(BaseHTTPRequestHandler):
         # no cost. (In-process LocalTransport calls skip HTTP entirely
         # and join the caller's trace via the contextvar instead.)
         tid = self.headers.get(tracing.TRACE_HEADER)
+        # Stashed for the request log (reset per request — keep-alive
+        # reuses this handler instance): /debug/requests entries join
+        # /debug/traces on it.
+        self._request_trace_id = tid or ""
         if not tid:
             return self._dispatch_inner(verb)
         with tracing.trace(
@@ -483,7 +532,10 @@ class _Handler(BaseHTTPRequestHandler):
             _LATENCY.observe(duration, verb=verb, resource=resource)
             from kubernetes_tpu.utils import debug
 
-            debug.DEFAULT_REQUEST_LOG.record(verb, self.path, code, duration)
+            debug.DEFAULT_REQUEST_LOG.record(
+                verb, self.path, code, duration,
+                trace_id=getattr(self, "_request_trace_id", ""),
+            )
 
     def _check_auth(self, verb: str, rest: Tuple[str, ...]) -> None:
         """Authenticate + authorize an /api request. Reference:

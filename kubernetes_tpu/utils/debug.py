@@ -2,13 +2,17 @@
 
 Reference analogs:
 - pkg/httplog/ (request logging with verbosity) -> an in-memory ring of
-  recent requests served at /debug/requests.
+  recent requests served at /debug/requests; entries carry the request's
+  X-Trace-Id (when the client stamped one) so a slow request in the
+  ring can be looked up in /debug/traces directly.
 - net/http/pprof goroutine dump -> /debug/stacks renders every Python
   thread's current stack (the goroutine-dump equivalent for a threaded
   runtime).
 - pprof CPU profile -> /debug/profile?seconds=N runs an in-process
   wall-clock sampling profiler over sys._current_frames() (py-spy
-  style) and renders the hottest stacks.
+  style) and renders the hottest stacks — human-readable by default,
+  or folded stacks (?format=collapsed: flamegraph.pl / speedscope
+  input) for flamegraph tooling.
 """
 
 from __future__ import annotations
@@ -25,25 +29,35 @@ class RequestLog:
     """Fixed-size ring of recent HTTP requests (httplog analog)."""
 
     def __init__(self, size: int = 256):
-        self._ring: Deque[Tuple[float, str, str, int, float]] = (
+        self._ring: Deque[Tuple[float, str, str, int, float, str]] = (
             collections.deque(maxlen=size)
         )
         self._lock = threading.Lock()
 
     def record(
-        self, verb: str, path: str, code: int, duration_s: float
+        self,
+        verb: str,
+        path: str,
+        code: int,
+        duration_s: float,
+        trace_id: str = "",
     ) -> None:
         with self._lock:
-            self._ring.append((time.time(), verb, path, code, duration_s))
+            self._ring.append(
+                (time.time(), verb, path, code, duration_s, trace_id)
+            )
 
     def render(self) -> str:
         with self._lock:
             entries = list(self._ring)
-        lines = [f"{'TIME':23} {'CODE':5} {'MS':>8}  VERB PATH"]
-        for ts, verb, path, code, dur in reversed(entries):
+        lines = [
+            f"{'TIME':23} {'CODE':5} {'MS':>8}  {'TRACE':16} VERB PATH"
+        ]
+        for ts, verb, path, code, dur, tid in reversed(entries):
             stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
             lines.append(
-                f"{stamp:23} {code:<5} {dur * 1000:8.1f}  {verb} {path}"
+                f"{stamp:23} {code:<5} {dur * 1000:8.1f}  "
+                f"{(tid or '-'):16} {verb} {path}"
             )
         return "\n".join(lines) + "\n"
 
@@ -62,16 +76,16 @@ def dump_stacks() -> str:
     return "\n".join(out) + "\n"
 
 
-def sample_profile(seconds: float = 2.0, interval: float = 0.01) -> str:
-    """Wall-clock sampling profiler: periodically snapshot every
-    thread's stack and report the hottest ones. No instrumentation, no
-    tracing overhead on the profiled code — the same trade py-spy and
-    pprof's CPU profile make."""
-    if seconds != seconds:  # NaN slips through min/max clamps
-        seconds = 2.0
-    seconds = min(max(seconds, 0.1), 30.0)
+def _collect_samples(
+    seconds: float, interval: float
+) -> Tuple[Dict[Tuple[Tuple[str, int, str], ...], int], int]:
+    """(stack -> sample count, total samples): the sampling loop shared
+    by both render formats. Stacks are root-first tuples of (filename,
+    lineno, funcname) frames."""
     me = threading.get_ident()
-    counts: Dict[Tuple[str, ...], int] = collections.defaultdict(int)
+    counts: Dict[Tuple[Tuple[str, int, str], ...], int] = (
+        collections.defaultdict(int)
+    )
     samples = 0
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline:
@@ -82,11 +96,15 @@ def sample_profile(seconds: float = 2.0, interval: float = 0.01) -> str:
             f = frame
             while f is not None and len(stack) < 24:
                 code = f.f_code
-                stack.append(f"{code.co_filename}:{f.f_lineno} {code.co_name}")
+                stack.append((code.co_filename, f.f_lineno, code.co_name))
                 f = f.f_back
             counts[tuple(reversed(stack))] += 1
         samples += 1
         time.sleep(interval)
+    return counts, samples
+
+
+def _render_top(counts, samples: int, seconds: float) -> str:
     top = sorted(counts.items(), key=lambda kv: -kv[1])[:20]
     lines = [
         f"sampling profile: {samples} samples over {seconds:.1f}s "
@@ -95,6 +113,43 @@ def sample_profile(seconds: float = 2.0, interval: float = 0.01) -> str:
     ]
     for stack, n in top:
         lines.append(f"=== {n} samples ({100.0 * n / max(samples, 1):.1f}%) ===")
-        lines.extend(f"  {frame}" for frame in stack[-12:])
+        lines.extend(
+            f"  {fname}:{lineno} {func}"
+            for fname, lineno, func in stack[-12:]
+        )
         lines.append("")
     return "\n".join(lines) + "\n"
+
+
+def _render_collapsed(counts) -> str:
+    """Folded stacks: one 'frame;frame;frame count' line per distinct
+    stack, root first — flamegraph.pl / speedscope input. Frames are
+    'func (file:line)'; semicolons inside a frame would split the
+    fold, so they are scrubbed."""
+    lines = []
+    for stack, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+        if not stack:
+            continue
+        folded = ";".join(
+            f"{func} ({fname}:{lineno})".replace(";", ":")
+            for fname, lineno, func in stack
+        )
+        lines.append(f"{folded} {n}")
+    return "\n".join(lines) + "\n"
+
+
+def sample_profile(
+    seconds: float = 2.0, interval: float = 0.01, fmt: str = "top"
+) -> str:
+    """Wall-clock sampling profiler: periodically snapshot every
+    thread's stack and report the hottest ones. No instrumentation, no
+    tracing overhead on the profiled code — the same trade py-spy and
+    pprof's CPU profile make. fmt: "top" (human-readable hottest
+    stacks) or "collapsed" (folded stacks for flamegraph tooling)."""
+    if seconds != seconds:  # NaN slips through min/max clamps
+        seconds = 2.0
+    seconds = min(max(seconds, 0.1), 30.0)
+    counts, samples = _collect_samples(seconds, interval)
+    if fmt == "collapsed":
+        return _render_collapsed(counts)
+    return _render_top(counts, samples, seconds)

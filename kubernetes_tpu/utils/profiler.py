@@ -1,0 +1,154 @@
+"""Device-time profiling plane: micro-tick duty cycle, solve/commit
+overlap, and on-demand device traces.
+
+The compile/cost half of the profiling story lives in ops/ledger.py
+(it needs jax; this module must stay importable by a control-plane
+process that never touches the accelerator). Here lives the HOST-side
+accounting the micro-tick daemon feeds every tick, plus the
+``jax.profiler.trace`` wrapper behind ``GET /debug/device-profile``:
+
+- **duty cycle** (``scheduler_device_duty_cycle``): the fraction of a
+  micro-tick period the device spent busy — the in-flight window from
+  solve dispatch to ``PendingSolve.result()`` over the wall between
+  consecutive tick resolutions. An idle cluster reads ~0; a saturated
+  pipelined daemon should approach 1.0. Read it against
+  ``scheduler_overlap_efficiency`` — high duty + low overlap means the
+  host is BLOCKING on the device instead of overlapping it.
+
+- **overlap efficiency** (``scheduler_overlap_efficiency``): of the
+  device-busy window, the fraction the host spent doing useful work
+  (staging tick k+1, commit I/O) rather than blocked in the readback
+  — 1 - blocked/device_busy. This is the realized value of PR 12's
+  pipelined dispatch: a fixed-tick daemon measures ~0 here.
+
+- ``scheduler_device_busy_seconds_total``: the raw busy-seconds
+  counter behind the duty ratio, so dashboards can rate() it across
+  scrape intervals.
+
+- **device traces**: ``capture_device_trace(seconds)`` wraps
+  ``jax.profiler.trace`` around a sleep on the calling (HTTP handler)
+  thread while the daemon threads keep dispatching — the produced
+  directory opens in XProf/TensorBoard or perfetto. One capture at a
+  time per process (the profiler backend cannot nest).
+
+Everything here is microseconds-per-tick host bookkeeping;
+tests/test_profiler.py pins ledger + duty accounting at <5% of the
+bulk-churn drill (the PR-9 always-on budget).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Optional
+
+from kubernetes_tpu.utils import metrics, sanitizer
+
+#: Ratio ladders: duty/overlap are [0, 1] by construction, so the
+#: default latency buckets would dump everything into one bucket.
+RATIO_BUCKETS = (
+    0.01, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95,
+    0.99, 1.0,
+)
+
+DUTY_CYCLE = metrics.DEFAULT.histogram(
+    "scheduler_device_duty_cycle",
+    "Fraction of a micro-tick period the solve device spent busy "
+    "(dispatch -> readback over the tick wall)",
+    buckets=RATIO_BUCKETS,
+)
+OVERLAP = metrics.DEFAULT.histogram(
+    "scheduler_overlap_efficiency",
+    "Fraction of the device-busy window the host overlapped with "
+    "useful work instead of blocking on the readback",
+    buckets=RATIO_BUCKETS,
+)
+DEVICE_BUSY = metrics.DEFAULT.counter(
+    "scheduler_device_busy_seconds_total",
+    "Total seconds the solve device spent busy (in-flight solves)",
+)
+
+
+def observe_tick(
+    device_s: float, wall_s: float, blocked_s: float
+) -> None:
+    """One resolved micro-tick's accounting: ``device_s`` is the
+    dispatch->readback in-flight window, ``wall_s`` the period since
+    the previous tick resolved, ``blocked_s`` the host time spent
+    blocked inside ``result()``. Ratios clamp to [0, 1] — monotonic
+    clock jitter must not poison a histogram bucket."""
+    if device_s <= 0.0 or wall_s <= 0.0:
+        return
+    DEVICE_BUSY.inc(device_s)
+    DUTY_CYCLE.observe(min(1.0, device_s / wall_s))
+    OVERLAP.observe(
+        min(1.0, max(0.0, 1.0 - blocked_s / device_s))
+    )
+
+
+# -- on-demand device traces -------------------------------------------
+
+
+class ProfilerUnavailable(RuntimeError):
+    """jax (or its profiler backend) is not importable/startable in
+    this process."""
+
+
+class TraceInProgress(RuntimeError):
+    """A device trace capture is already running (the profiler backend
+    cannot nest sessions)."""
+
+
+_CAPTURE_LOCK = sanitizer.lock("profiler.capture")
+_CAPTURE_ACTIVE = [False]
+
+#: Capture length clamp — a typo'd ?seconds= must not pin an HTTP
+#: handler (and the trace buffer) for minutes.
+MAX_TRACE_SECONDS = 60.0
+
+
+def capture_device_trace(
+    seconds: float = 2.0, out_dir: Optional[str] = None
+) -> dict:
+    """Record ``seconds`` of device activity via ``jax.profiler.trace``
+    into a server-side directory (fresh tempdir unless ``out_dir``).
+    The caller's thread sleeps inside the session; every OTHER thread's
+    dispatches land in the trace — exactly what an operator wants from
+    a live daemon. Returns {dir, seconds, files}."""
+    if seconds != seconds:  # NaN slips through min/max clamps
+        seconds = 2.0
+    seconds = min(max(float(seconds), 0.1), MAX_TRACE_SECONDS)
+    try:
+        import jax
+    except Exception as e:  # pragma: no cover - jax is baked into CI
+        raise ProfilerUnavailable(f"jax unavailable: {e!r}")
+    with _CAPTURE_LOCK:
+        if _CAPTURE_ACTIVE[0]:
+            raise TraceInProgress(
+                "a device trace capture is already in progress"
+            )
+        _CAPTURE_ACTIVE[0] = True
+    try:
+        trace_dir = out_dir or tempfile.mkdtemp(prefix="kt-device-trace-")
+        try:
+            with jax.profiler.trace(trace_dir):
+                time.sleep(seconds)
+        except Exception as e:
+            raise ProfilerUnavailable(
+                f"device trace capture failed: {e!r}"
+            )
+        files = []
+        for root, _dirs, names in os.walk(trace_dir):
+            for name in names:
+                files.append(
+                    os.path.relpath(os.path.join(root, name), trace_dir)
+                )
+        return {
+            "dir": trace_dir,
+            "seconds": seconds,
+            "files": sorted(files),
+        }
+    finally:
+        with _CAPTURE_LOCK:
+            _CAPTURE_ACTIVE[0] = False

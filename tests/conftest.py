@@ -72,6 +72,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "profiler: device-time profiling-plane tests (compile/cost "
+        "ledger, duty-cycle/overlap series, ktctl profile, device "
+        "traces); tier-1 includes them — select just these with "
+        "-m profiler",
+    )
+    config.addinivalue_line(
+        "markers",
         "sanitize: run this test with the ktsan lock sanitizer enabled "
         "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
         "finding or leaked non-daemon thread; the concurrency-heavy "

@@ -169,6 +169,47 @@ class TestHTTPPropagation:
         assert "client_op" in names
         assert any(n.startswith("POST ") for n in names)
 
+    def test_request_log_carries_trace_id(self):
+        """/debug/requests joins /debug/traces: RequestLog entries
+        record the request's X-Trace-Id (when the client stamped one)
+        and render prints it, so a slow request in the ring can be
+        looked up in the trace buffer directly."""
+        from kubernetes_tpu.utils import debug
+
+        api = APIServer()
+        http = APIHTTPServer(api).start()
+        try:
+            client = Client(HTTPTransport(http.address))
+            with tracing.trace("logged_op", pod="plog"):
+                client.create("pods", pod_wire("plog"))
+                tid = tracing.current_trace_id()
+            assert tid
+            # An untraced request records with no id ('-' in render).
+            urllib.request.urlopen(
+                http.address + "/version", timeout=10
+            ).read()
+            # The handler records AFTER sending the response, so the
+            # client can observe the body before the log entry lands —
+            # poll briefly instead of racing it.
+            deadline = time.monotonic() + 5.0
+            while True:
+                text = debug.DEFAULT_REQUEST_LOG.render()
+                if "/version" in text or time.monotonic() > deadline:
+                    break
+                time.sleep(0.02)
+        finally:
+            http.stop()
+        assert "TRACE" in text.splitlines()[0]
+        traced = [ln for ln in text.splitlines() if tid in ln]
+        assert traced, f"trace id {tid} not in request log:\n{text}"
+        assert "POST" in traced[0]
+        untraced = [ln for ln in text.splitlines() if "/version" in ln]
+        assert untraced and " - " in untraced[0]
+        # The id resolves in the trace buffer — the join the log
+        # exists for.
+        out = tracing.DEFAULT_BUFFER.to_dicts(pod="plog")["traces"]
+        assert out and out[0]["traceId"] == tid
+
 
 SCHED_TIMEOUT = 60.0
 

@@ -480,4 +480,98 @@ lat_rc=$?
 if [ $rc -eq 0 ]; then
     rc=$lat_rc
 fi
+
+# Profile smoke (ISSUE 13): the device-time profiling plane end to
+# end — miss contract first (`ktctl profile kernels` exits 1 with "no
+# compiles recorded" before anything compiled), then boot local-up
+# with the micro-tick daemon, bind pods, and assert the populated
+# contract: `ktctl profile kernels` exits 0 with a non-empty ledger
+# (every compile row named like the KT006 registry) and
+# /debug/profile?format=collapsed returns folded stacks.
+echo "== profile smoke (compile ledger + collapsed stacks) =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import re
+import time
+import urllib.request
+from contextlib import redirect_stderr, redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.server.api import APIServer
+
+# Miss contract FIRST (nothing compiled in this process yet): exit 1,
+# empty stdout, the reason on stderr — mirror of ktctl trace/explain/slo.
+out, err = io.StringIO(), io.StringIO()
+with redirect_stdout(out), redirect_stderr(err):
+    rc = ktctl.main(
+        ["profile", "kernels"], client=Client(LocalTransport(APIServer()))
+    )
+assert rc == 1, f"empty-ledger ktctl profile must exit 1, got {rc}"
+assert out.getvalue() == ""
+assert "no compiles recorded" in err.getvalue()
+
+from kubernetes_tpu.cmd.localup import LocalCluster, build_parser
+
+N_PODS = 8
+args = build_parser().parse_args(
+    ["--port", "0", "--nodes", "2", "--batch-scheduler"]
+)
+cluster = LocalCluster(args).start()
+try:
+    client = Client(HTTPTransport(cluster.http.address))
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if getattr(cluster.scheduler, "_session", None) is not None:
+            break
+        time.sleep(0.25)
+    def pod(name):
+        return {"kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "pause",
+                         "resources": {"limits": {"cpu": "50m",
+                                                  "memory": "32Mi"}}}]}}
+    for i in range(N_PODS):
+        client.create("pods", pod(f"prof-{i}"), namespace="default")
+    deadline = time.monotonic() + 120
+    bound = 0
+    while time.monotonic() < deadline and bound < N_PODS:
+        pods, _ = client.list("pods", namespace="default")
+        bound = sum(1 for p in pods if p.spec.node_name)
+        if bound < N_PODS:
+            time.sleep(0.2)
+    assert bound == N_PODS, f"only {bound}/{N_PODS} bound"
+
+    # Populated contract: the ledger carries the solve-path kernels
+    # the daemon just compiled, named like the KT006 registry.
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = ktctl.main(["profile", "kernels"], client=client)
+    text = out.getvalue()
+    assert rc == 0, text
+    assert "solver._solve_with_state_xla" in text, text
+    data = client.t.get_json("/debug/kernels")
+    assert data["summary"]["compiles"] >= 1, data["summary"]
+
+    # Folded stacks for flamegraph tooling.
+    with urllib.request.urlopen(
+        cluster.http.address + "/debug/profile?seconds=0.5&format=collapsed",
+        timeout=30,
+    ) as resp:
+        folded = resp.read().decode()
+    lines = [ln for ln in folded.splitlines() if ln.strip()]
+    assert lines, "collapsed profile produced no stacks"
+    assert all(re.match(r"^.+ \d+$", ln) for ln in lines), lines[:3]
+    assert any(";" in ln for ln in lines), "no multi-frame stack folded"
+    print(f"profile smoke OK: {N_PODS} pods bound; "
+          f"{data['summary']['compiles']} compiles in the ledger "
+          f"({data['summary']['compile_seconds_total']}s); "
+          f"{len(lines)} folded stacks")
+finally:
+    cluster.stop()
+EOF
+prof_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$prof_rc
+fi
 exit $rc

@@ -35,17 +35,23 @@ _HOST_MODULES = {
 _CAST_BUILTINS = {"float", "int", "bool"}
 
 
+#: Name chains that denote jit compilation. traced_jit (ops/ledger.py)
+#: wraps jax.jit with the compile ledger — same purity contract, same
+#: static/donate cross-check.
+_JIT_CHAINS = (["jax", "jit"], ["jit"], ["traced_jit"], ["ledger", "traced_jit"])
+
+
 def _jit_decoration(dec: ast.AST) -> Optional[dict]:
     """If `dec` is a jit decorator, return {static, donate} name lists
     (None for 'not specified / dynamic'); else None."""
     chain = attr_chain(dec)
-    if chain in (["jax", "jit"], ["jit"]):
+    if chain in _JIT_CHAINS:
         return {"static": None, "donate": None}
     if isinstance(dec, ast.Call):
         fchain = attr_chain(dec.func)
         # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
         if fchain and fchain[-1] == "partial" and dec.args:
-            if attr_chain(dec.args[0]) in (["jax", "jit"], ["jit"]):
+            if attr_chain(dec.args[0]) in _JIT_CHAINS:
                 out = {"static": None, "donate": None}
                 for kw in dec.keywords:
                     if kw.arg == "static_argnames":
@@ -53,8 +59,9 @@ def _jit_decoration(dec: ast.AST) -> Optional[dict]:
                     elif kw.arg == "donate_argnames":
                         out["donate"] = str_constants(kw.value)
                 return out
-        # jax.jit(static_argnames=...) used as a decorator factory
-        if fchain in (["jax", "jit"], ["jit"]):
+        # jax.jit(static_argnames=...) / traced_jit(...) decorator
+        # factories
+        if fchain in _JIT_CHAINS:
             out = {"static": None, "donate": None}
             for kw in dec.keywords:
                 if kw.arg == "static_argnames":

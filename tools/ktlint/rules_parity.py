@@ -35,19 +35,26 @@ OPS_DIR = "kubernetes_tpu/ops"
 REGISTRY_PATH = "kubernetes_tpu/ops/parity.py"
 
 
+#: Decorator names that mean "this def is a jitted kernel". traced_jit
+#: (ops/ledger.py) is jax.jit plus the compile ledger — same parity
+#: contract, same registry.
+_JIT_NAMES = ("jit", "traced_jit")
+
+
 def _is_jit_decorator(dec: ast.AST) -> bool:
-    """jax.jit / jit bare, or functools.partial(jax.jit, ...) /
-    partial(jit, ...), or jax.jit(...) used as a decorator factory."""
+    """jax.jit / jit / traced_jit bare, or functools.partial(jax.jit,
+    ...) / partial(jit, ...), or jax.jit(...) / traced_jit(...) used as
+    a decorator factory."""
     chain = attr_chain(dec)
-    if chain and chain[-1] == "jit":
+    if chain and chain[-1] in _JIT_NAMES:
         return True
     if isinstance(dec, ast.Call):
         fchain = attr_chain(dec.func)
-        if fchain and fchain[-1] == "jit":
+        if fchain and fchain[-1] in _JIT_NAMES:
             return True
         if fchain and fchain[-1] == "partial" and dec.args:
             achain = attr_chain(dec.args[0])
-            return bool(achain) and achain[-1] == "jit"
+            return bool(achain) and achain[-1] in _JIT_NAMES
     return False
 
 
@@ -70,7 +77,7 @@ def jitted_kernels(tree: ast.Module, module_stem: str) -> List[Tuple[str, int]]:
                 child.value, ast.Call
             ):
                 fchain = attr_chain(child.value.func)
-                if fchain and fchain[-1] == "jit":
+                if fchain and fchain[-1] in _JIT_NAMES:
                     for t in child.targets:
                         if isinstance(t, ast.Name):
                             out.append(
