@@ -1617,6 +1617,22 @@ def main() -> None:
         }
     except Exception as e:
         record["ktsan_error"] = str(e)
+    # ktshape: the kernel contract checker's verdict rides beside the
+    # ktlint/ktsan counts — findings must chart at ZERO; the shardable
+    # list is the live go/no-go set for the pod-axis Mesh work
+    # (ROADMAP #2), so a kernel silently falling OFF it is visible.
+    try:
+        from tools.ktlint import ktshape as _ktshape
+
+        _ks = _ktshape.analyze()
+        record["ktshape_contracts"] = {
+            "kernels_checked": len(_ks.kernels),
+            "shardable": _ks.shardable,
+            "findings": len(_ks.findings),
+            "errors": len(_ks.errors),
+        }
+    except Exception as e:
+        record["ktshape_error"] = str(e)
     # Compile/cost ledger summary (ISSUE 13): total compile wall +
     # top-3 kernels by FLOPs/bytes from the always-on traced-jit
     # ledger the run's solves populated, next to the ktlint/ktsan

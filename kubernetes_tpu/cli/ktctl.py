@@ -1226,8 +1226,9 @@ def cmd_profile(client: Client, args) -> int:
             return 0
         print(
             f"{'KERNEL':44}{'CALLS':>7}{'COMPILES':>9}{'COMPILE_S':>10}"
-            f"{'FLOPS':>9}{'BYTES':>9}{'AI':>7}"
+            f"{'FLOPS':>9}{'BYTES':>9}{'AI':>7}  CONTRACT"
         )
+        mismatches = []
         for r in rows:
             shapes = r.get("shapes", ())
 
@@ -1238,6 +1239,23 @@ def cmd_profile(client: Client, args) -> int:
                 ]
                 return max(vals) if vals else None
 
+            # Declared-vs-observed staged shapes (ops/contracts.py):
+            # the worst verdict across this kernel's shape rows — one
+            # drifted bucket marks the kernel, details listed below.
+            verdicts = [s.get("contract") for s in shapes]
+            if any(v and v.startswith("mismatch") for v in verdicts):
+                contract = "MISMATCH"
+                mismatches.extend(
+                    (r["kernel"], s.get("signature", ""), s["contract"])
+                    for s in shapes
+                    if (s.get("contract") or "").startswith("mismatch")
+                )
+            elif "uncontracted" in verdicts:
+                contract = "uncontracted"
+            elif verdicts and all(v == "ok" for v in verdicts):
+                contract = "ok"
+            else:
+                contract = "-"
             ai = peak("arithmetic_intensity")
             print(
                 f"{r['kernel']:44}{r.get('calls', 0):>7}"
@@ -1245,8 +1263,10 @@ def cmd_profile(client: Client, args) -> int:
                 f"{r.get('compile_seconds', 0.0):>10.3f}"
                 f"{_fmt_qty(peak('flops')):>9}"
                 f"{_fmt_qty(peak('bytes_accessed')):>9}"
-                f"{'-' if ai is None else f'{ai:.2f}':>7}"
+                f"{'-' if ai is None else f'{ai:.2f}':>7}  {contract}"
             )
+        for kernel, signature, verdict in mismatches:
+            print(f"  {kernel} {signature}: {verdict}")
         summary = data.get("summary", {})
         print(
             f"total: {summary.get('compiles', 0)} compiles, "

@@ -76,26 +76,16 @@ def _derive_kernel_name(fn) -> str:
     return f"{mod}.{qual.replace('.<locals>', '')}"
 
 
-def _leaf_sig(leaf) -> str:
-    shape = getattr(leaf, "shape", None)
-    dtype = getattr(leaf, "dtype", None)
-    if shape is None or dtype is None:
-        r = repr(leaf)
-        return r if len(r) <= 32 else r[:29] + "..."
-    import numpy as np
-
-    d = np.dtype(dtype)
-    return f"{d.kind}{d.itemsize * 8}[{','.join(str(s) for s in shape)}]"
-
-
 def _signature(args, kwargs) -> str:
     """Compact staged-shape signature of one call — the ledger's
-    per-bucket key. Only computed on compile events (tree-flattening
-    every call would tax the micro-tick path for nothing)."""
-    import jax
+    per-bucket key. THE implementation lives in ops/contracts.py
+    (shape_signature) so the ledger's observed rows and the contract
+    checker's declared shapes are one string format; only computed on
+    compile events (tree-flattening every call would tax the
+    micro-tick path for nothing)."""
+    from kubernetes_tpu.ops.contracts import shape_signature
 
-    leaves = jax.tree_util.tree_leaves((args, kwargs))
-    return ",".join(_leaf_sig(leaf) for leaf in leaves)
+    return shape_signature(args, kwargs)
 
 
 def _avalize(args, kwargs):
@@ -223,7 +213,13 @@ class CompileLedger:
 
     def rows(self) -> List[dict]:
         """Per-kernel rows (shape sub-rows sorted by signature), deep
-        enough a caller can mutate its copy."""
+        enough a caller can mutate its copy. Every shape sub-row
+        carries a ``contract`` verdict — the observed staged-shape
+        signature joined against the kernel's declared contract
+        (ops/contracts.py), so a drifted shape shows up as a CONTRACT
+        mismatch in ``GET /debug/kernels`` / ``ktctl profile
+        kernels``. The join runs OUTSIDE the lock: it is pure string
+        work, but it is also not the hot path's business."""
         with self._lock:
             out = []
             for kernel in sorted(self._rows):
@@ -240,10 +236,20 @@ class CompileLedger:
                         ],
                     }
                 )
-            return out
+        try:
+            from kubernetes_tpu.ops.contracts import contract_verdict
 
-    def summary(self) -> dict:
-        rows = self.rows()
+            for r in out:
+                for s in r["shapes"]:
+                    s["contract"] = contract_verdict(
+                        r["kernel"], s.get("signature", "")
+                    )
+        except Exception:  # pragma: no cover - contracts must never
+            pass  # sink a ledger read
+        return out
+
+    def summary(self, rows: Optional[List[dict]] = None) -> dict:
+        rows = self.rows() if rows is None else rows
         compiles = sum(r["compiles"] for r in rows)
 
         def best(metric: str) -> List[dict]:
@@ -282,7 +288,10 @@ class CompileLedger:
         }
 
     def to_dict(self) -> dict:
-        return {"kernels": self.rows(), "summary": self.summary()}
+        # One rows() pass (the contract-verdict join rides it) shared
+        # by both halves of the payload.
+        rows = self.rows()
+        return {"kernels": rows, "summary": self.summary(rows)}
 
     def wait_pending(self, timeout: float = 30.0) -> bool:
         """Block until no shape row's cost_status is 'pending' (tests
@@ -488,6 +497,11 @@ class TracedJit:
 
     def eval_shape(self, *args, **kwargs):
         return self._jit.eval_shape(*args, **kwargs)
+
+    def trace(self, *args, **kwargs):
+        """Abstract trace (jaxpr, no compile, no execution) — the
+        contract checker's jaxpr-walk entry point."""
+        return self._jit.trace(*args, **kwargs)
 
 
 def traced_jit(fn=None, *, kernel: Optional[str] = None, **jit_kwargs):

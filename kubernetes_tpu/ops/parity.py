@@ -26,6 +26,14 @@ enclosing function: ``preemption._victim_prefix_kernel.kernel``).
 KT006 intentionally has no baseline: a new kernel lands WITH its twin
 or it does not land. Use ``exercised_as`` when the suite drives the
 kernel through a public wrapper rather than by its private name.
+
+Every key here ALSO needs a shape/dtype/sharding contract in
+``kubernetes_tpu/ops/contracts.py`` (CONTRACTS) — the ktshape checker
+(``python -m tools.ktlint --kernel-contracts``) enforces completeness
+in both directions, so this registry and the contract registry are one
+kernel inventory with two faces: the twin referees the DECISIONS, the
+contract pins the INTERFACE (bucket lattices, oracle dtypes, pod-axis
+coupling class).
 """
 
 from __future__ import annotations

@@ -301,10 +301,15 @@ def run_windowed(
         # infeasible-now is infeasible-forever); conflict losers stay
         # UNDECIDED and retry next wave.
         newly_unschedulable = valid & ~feasible
+        # Both branches dtype-pinned: bare int literals here are WEAK-
+        # typed and materialize a weak i32[W] (ktshape weak-type check)
+        # whose dtype would float with downstream promotion.
         value = jnp.where(
             accepted,
             choice,
-            jnp.where(newly_unschedulable, -1, UNDECIDED),
+            jnp.where(
+                newly_unschedulable, jnp.int32(-1), jnp.int32(UNDECIDED)
+            ),
         )
         assignment = assignment.at[idx].set(value, mode="drop")
         return assignment, carry, waves + 1, titers + c_iters, c_residual

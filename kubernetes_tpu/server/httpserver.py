@@ -245,11 +245,16 @@ class _Handler(BaseHTTPRequestHandler):
         if rest == ("kernels",):
             # The XLA compile/cost ledger (ops/ledger.py): per-kernel
             # compile events with cost/memory analysis — `ktctl profile
-            # kernels`' data source. A process that never dispatched a
-            # kernel has an empty ledger BY DEFINITION, so the module
-            # is read from sys.modules instead of imported: a thin
-            # control-plane apiserver must not load jax to say "no
-            # compiles recorded".
+            # kernels`' data source. Each shape row carries a
+            # `contract` verdict (ops/contracts.py): the observed
+            # staged-shape signature joined against the kernel's
+            # declared contract, so a drifted bucket reads as
+            # "mismatch: dim P=... off its lattice" right here. A
+            # process that never dispatched a kernel has an empty
+            # ledger BY DEFINITION, so the module is read from
+            # sys.modules instead of imported: a thin control-plane
+            # apiserver must not load jax to say "no compiles
+            # recorded".
             import sys as _sys
 
             led = _sys.modules.get("kubernetes_tpu.ops.ledger")

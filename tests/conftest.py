@@ -79,6 +79,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "ktshape: kernel shape/dtype/sharding contract-checker tests "
+        "(KT007 fixtures, abstract-eval/jaxpr-walk fixtures, live-tree "
+        "contract gate); tier-1 includes them — select just these with "
+        "-m ktshape",
+    )
+    config.addinivalue_line(
+        "markers",
         "sanitize: run this test with the ktsan lock sanitizer enabled "
         "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
         "finding or leaked non-daemon thread; the concurrency-heavy "

@@ -451,7 +451,7 @@ class TestFramework:
         data = json.loads(proc.stdout)
         assert data["counts"]["KT004"] == 1
         assert data["findings"][0]["rule"] == "KT004"
-        assert set(data["rules"]) == {f"KT00{i}" for i in range(1, 7)}
+        assert set(data["rules"]) == {f"KT00{i}" for i in range(1, 8)}
 
 
 # -- the tier-1 gate ---------------------------------------------------

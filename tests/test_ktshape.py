@@ -1,0 +1,678 @@
+"""ktshape (tools/ktlint/ktshape.py + kubernetes_tpu/ops/contracts.py):
+the kernel shape/dtype/sharding contract checker.
+
+Three layers, mirroring the ktlint/ktsan test conventions:
+
+- KT007 AST fixtures: violate / pass / pragma per check (host
+  round-trips in trace-time helpers, unbucketed device dims,
+  dtype-unpinned literal arrays);
+- abstract-interpretation fixtures driven through check_kernel: a
+  dtype-drifted kernel caught by eval_shape, a weak-literal kernel
+  caught by the jaxpr walk (the before/after shape of the wave.py
+  sweep fix), and a fake `pod_axis: shardable` kernel with a cross-pod
+  segment_sum caught by the coupling classifier;
+- live-tree gates: every ORACLE_TWINS kernel is contracted (and vice
+  versa), `python -m tools.ktlint --kernel-contracts` exits 0 with
+  zero findings, the checker performs ZERO kernel executions, and the
+  ledger's observed staged-shape signatures join back against the
+  contracts (the /debug/kernels CONTRACT column).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))  # tools/ is a repo-root namespace package
+
+from tools import ktlint  # noqa: E402
+from tools.ktlint import ktshape  # noqa: E402
+from tools.ktlint.framework import run as lint_run  # noqa: E402
+
+pytestmark = pytest.mark.ktshape
+
+
+def lint_src(tmp_path, source, relname="ops/x.py"):
+    """Lint one fixture file with KT007 only; returns the Report."""
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_run([path], ktlint.rules_by_id(["KT007"]), baseline=None)
+
+
+# -- KT007: host round-trips in trace-time helpers ---------------------
+
+
+class TestKT007TracedHelpers:
+    def test_detects_sync_in_reachable_helper(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax
+            import numpy as np
+
+            def _helper(x):
+                y = np.asarray(x)
+                return y.item()
+
+            @jax.jit
+            def kernel(x):
+                return _helper(x) + 1
+            """,
+        )
+        msgs = "\n".join(f.message for f in rep.findings)
+        assert "np.asarray" in msgs
+        assert ".item()" in msgs
+        assert "trace-time helper of jitted kernel()" in msgs
+
+    def test_callback_reference_joins_the_closure(self, tmp_path):
+        # A helper passed BY NAME (never called directly) is still
+        # traced — the wave family's `choose` callbacks ride this way.
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax
+
+            def _choose(x):
+                return int(x)
+
+            def _loop(x, choose):
+                return choose(x)
+
+            @jax.jit
+            def kernel(x):
+                return _loop(x, _choose)
+            """,
+        )
+        assert len(rep.findings) == 1
+        assert "int(x)" in rep.findings[0].message
+
+    def test_unreachable_host_helper_passes(self, tmp_path):
+        # Host-side wrappers AROUND the kernel may sync freely.
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def kernel(x):
+                return x * 2
+
+            def wrapper(x):
+                return np.asarray(kernel(x)).item()
+            """,
+        )
+        assert rep.findings == []
+
+    def test_out_of_scope_dir_ignored(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax
+
+            def _helper(x):
+                return float(x)
+
+            @jax.jit
+            def kernel(x):
+                return _helper(x)
+            """,
+            relname="models/x.py",
+        )
+        assert rep.findings == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax
+
+            def _helper(x):
+                return float(x)  # ktlint: disable=KT007
+
+            @jax.jit
+            def kernel(x):
+                return _helper(x)
+            """,
+        )
+        assert rep.findings == []
+        assert len(rep.suppressed) == 1
+
+
+# -- KT007: unbucketed device dims -------------------------------------
+
+
+class TestKT007UnbucketedDims:
+    def test_detects_len_and_count_dims(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+
+            def stage(backlog, cols):
+                a = jnp.zeros(len(backlog))
+                b = jnp.full(cols.count, -1.0)
+                c = jnp.arange(len(backlog))
+                return a, b, c
+            """,
+        )
+        msgs = "\n".join(f.message for f in rep.findings)
+        assert len(rep.findings) == 3
+        assert "len(...)" in msgs
+        assert ".count" in msgs
+        assert "pow2_bucket" in msgs
+
+    def test_shape_keyword_is_scanned_too(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+
+            def stage(backlog):
+                return jnp.zeros(shape=(len(backlog), 4))
+            """,
+        )
+        assert len(rep.findings) == 1
+        assert "len(...)" in rep.findings[0].message
+
+    def test_bucketed_dims_pass(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+            from kubernetes_tpu.ops.matrices import pow2_bucket
+
+            def stage(backlog, arr):
+                a = jnp.zeros(pow2_bucket(len(backlog)))
+                b = jnp.zeros(arr.shape[0])
+                c = jnp.zeros((128, 8), dtype=jnp.float32)
+                return a, b, c
+            """,
+        )
+        assert rep.findings == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+
+            def stage(backlog):
+                return jnp.zeros(len(backlog))  # ktlint: disable=KT007
+            """,
+        )
+        assert rep.findings == []
+        assert len(rep.suppressed) == 1
+
+
+# -- KT007: dtype-unpinned literal arrays ------------------------------
+
+
+class TestKT007UntypedArrays:
+    def test_detects_bare_array_and_literal_asarray(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+
+            A = jnp.array([1, 2, 3])
+            B = jnp.asarray([1.0, 2.0])
+            """,
+        )
+        assert len(rep.findings) == 2
+        msgs = "\n".join(f.message for f in rep.findings)
+        assert "without dtype=" in msgs
+
+    def test_pinned_and_array_sourced_pass(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+
+            def f(host_arr):
+                a = jnp.array([1, 2, 3], dtype=jnp.int32)
+                b = jnp.asarray(host_arr)  # dtype rides the array
+                c = jnp.asarray(host_arr, dtype=jnp.float32)
+                return a, b, c
+            """,
+        )
+        assert rep.findings == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            import jax.numpy as jnp
+
+            A = jnp.array([1, 2, 3])  # ktlint: disable=KT007
+            """,
+        )
+        assert rep.findings == []
+        assert len(rep.suppressed) == 1
+
+
+# -- contracts: signature matching -------------------------------------
+
+
+class TestSignatures:
+    def test_leaf_signature_format(self):
+        from kubernetes_tpu.ops import contracts
+
+        assert contracts.leaf_signature(np.zeros((4, 2), np.uint32)) == (
+            "u32[4,2]"
+        )
+        assert contracts.leaf_signature(np.zeros((), np.float32)) == "f32[]"
+        assert contracts.leaf_signature(7) == "7"
+
+    def test_gang_signature_match_and_lattice_drift(self):
+        from kubernetes_tpu.ops import contracts
+
+        ok, detail = contracts.match_signature(
+            "matrices.gang_member_counts", "b8[16],i32[16],8"
+        )
+        assert ok, detail
+        ok, detail = contracts.match_signature(
+            "matrices.gang_member_counts", "b8[24],i32[24],8"
+        )
+        assert not ok and "off its bucket lattice" in detail
+
+    def test_dtype_drift_is_a_mismatch(self):
+        from kubernetes_tpu.ops import contracts
+
+        ok, detail = contracts.match_signature(
+            "matrices.gang_member_counts", "f32[16],i32[16],8"
+        )
+        assert not ok and "observed" in detail
+
+    def test_solver_signature_roundtrip_with_optional_leaf(self):
+        # A signature generated FROM the contract matches it, and an
+        # optional policy leaf (aff_pin) may ride along or not.
+        from kubernetes_tpu.ops import contracts
+
+        c = contracts.CONTRACTS["solver._solve_xla"]
+        bindings = dict(c.samples[0])
+        args, kwargs = contracts.abstract_args(c, bindings)
+        sig = contracts.shape_signature(args, kwargs)
+        ok, detail = contracts.match_signature("solver._solve_xla", sig)
+        assert ok, detail
+        import jax
+
+        args[0]["aff_pin"] = jax.ShapeDtypeStruct(
+            (bindings["P"], 3), np.int32
+        )
+        sig2 = contracts.shape_signature(args, kwargs)
+        ok, detail = contracts.match_signature("solver._solve_xla", sig2)
+        assert ok, detail
+
+    def test_verdict_strings(self):
+        from kubernetes_tpu.ops import contracts
+
+        assert contracts.contract_verdict("nope.kernel", "") == (
+            "uncontracted"
+        )
+        assert contracts.contract_verdict(
+            "matrices.gang_member_counts", "b8[16],i32[16],8"
+        ) == "ok"
+        assert contracts.contract_verdict(
+            "matrices.gang_member_counts", "b8[24],i32[24],8"
+        ).startswith("mismatch")
+
+
+# -- abstract-interpretation fixtures ----------------------------------
+
+
+def _fixture_contract(results, pod_axis="shardable", dims=("P",),
+                      dtype="f32"):
+    from kubernetes_tpu.ops import contracts
+
+    return contracts.Contract(
+        kernel="fixture.k",
+        args=(("x", contracts.ArraySpec(tuple(dims), dtype)),),
+        results=results,
+        pod_dim="P",
+        pod_axis=pod_axis,
+        samples=({"P": 128},),
+    )
+
+
+class TestAbstractEval:
+    def test_dtype_drifted_kernel_is_caught(self):
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.ledger import traced_jit
+
+        @traced_jit
+        def k(x):
+            return x * 2.0  # f32, but the contract (oracle) says i32
+
+        findings = ktshape.check_kernel(
+            "fixture.k", k,
+            _fixture_contract(contracts.ArraySpec(("P",), "i32")),
+        )
+        assert any(
+            f.check == "abstract-eval" and "drifted" in f.message
+            for f in findings
+        ), findings
+
+    def test_shape_drift_is_caught(self):
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.ledger import traced_jit
+
+        @traced_jit
+        def k(x):
+            return x[: x.shape[0] // 2]
+
+        findings = ktshape.check_kernel(
+            "fixture.k", k,
+            _fixture_contract(contracts.ArraySpec(("P",), "f32")),
+        )
+        assert any(f.check == "abstract-eval" for f in findings), findings
+
+    def test_weak_literal_materialization_caught_and_fix_clean(self):
+        # The before/after shape of the wave.py sweep fix: bare int
+        # literals in a branch-select materialize a weak i32[P].
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.ledger import traced_jit
+
+        @traced_jit
+        def before(x):
+            return x + jnp.where(x > 0, -1, -2)
+
+        @traced_jit
+        def after(x):
+            return x + jnp.where(x > 0, jnp.int32(-1), jnp.int32(-2))
+
+        spec = _fixture_contract(contracts.ArraySpec(("P",), "f32"))
+        findings = ktshape.check_kernel("fixture.k", before, spec)
+        assert any(f.check == "weak-type" for f in findings), findings
+        assert ktshape.check_kernel("fixture.k", after, spec) == []
+
+    def test_fake_shardable_segment_sum_caught(self):
+        import jax
+
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.ledger import traced_jit
+
+        @traced_jit(static_argnames=("num_groups",))
+        def fake(placed, gids, num_groups):
+            return jax.ops.segment_sum(
+                placed.astype("int32"),
+                jax.numpy.clip(gids, 0, num_groups - 1),
+                num_segments=num_groups,
+            )
+
+        c = contracts.Contract(
+            kernel="fixture.fake",
+            args=(
+                ("placed", contracts.ArraySpec(("PG",), "b8")),
+                ("gids", contracts.ArraySpec(("PG",), "i32")),
+            ),
+            results=contracts.ArraySpec(("G",), "i32"),
+            pod_dim="PG",
+            pod_axis="shardable",  # a lie: segment_sum couples pods
+            samples=({"PG": 8, "G": 8},),
+            kwargs=(("num_groups", contracts.DimRef("G")),),
+        )
+        findings = ktshape.check_kernel("fixture.fake", fake, c)
+        assert any(
+            f.check == "pod-axis" and "declared shardable" in f.message
+            for f in findings
+        ), findings
+
+    def test_honest_shardable_passes_and_stale_reduces_flagged(self):
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.ledger import traced_jit
+
+        @traced_jit
+        def k(x):
+            return x * 2
+
+        spec_ok = _fixture_contract(contracts.ArraySpec(("P",), "f32"))
+        assert ktshape.check_kernel("fixture.k", k, spec_ok) == []
+        spec_stale = _fixture_contract(
+            contracts.ArraySpec(("P",), "f32"), pod_axis="reduces"
+        )
+        findings = ktshape.check_kernel("fixture.k", k, spec_stale)
+        assert any(
+            f.check == "pod-axis" and "tighten" in f.message
+            for f in findings
+        ), findings
+
+    def test_off_lattice_sample_rejected(self):
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.ledger import traced_jit
+
+        @traced_jit
+        def k(x):
+            return x * 2
+
+        c = contracts.Contract(
+            kernel="fixture.k",
+            args=(("x", contracts.ArraySpec(("P",), "f32")),),
+            results=contracts.ArraySpec(("P",), "f32"),
+            pod_dim="P",
+            pod_axis="shardable",
+            samples=({"P": 100},),  # 100 is not a pow2 bucket
+        )
+        findings = ktshape.check_kernel("fixture.k", k, c)
+        assert any(
+            f.check == "completeness" and "lattice" in f.message
+            for f in findings
+        ), findings
+
+
+# -- live-tree gates ----------------------------------------------------
+
+
+class TestLiveTree:
+    def test_registry_completeness_both_ways(self):
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.parity import ORACLE_TWINS
+
+        assert set(contracts.CONTRACTS) == set(ORACLE_TWINS)
+        for key, c in contracts.CONTRACTS.items():
+            assert c.kernel == key
+            assert c.pod_axis in contracts.POD_AXIS_KINDS
+
+    def test_completeness_findings_on_registry_drift(self):
+        from kubernetes_tpu.ops import contracts
+
+        stale = contracts.Contract(
+            kernel="solver._gone_kernel",
+            args=(("x", contracts.ArraySpec(("P",), "f32")),),
+            results=contracts.ArraySpec(("P",), "f32"),
+            pod_dim="P",
+            pod_axis="shardable",
+            samples=({"P": 128},),
+        )
+        contracts.CONTRACTS["solver._gone_kernel"] = stale
+        missing = contracts.CONTRACTS.pop("solver.explain_rows")
+        try:
+            rep = ktshape.analyze(kernels=[])
+            checks = {
+                (f.kernel, f.check) for f in rep.findings
+            }
+            assert ("solver._gone_kernel", "completeness") in checks
+            assert ("solver.explain_rows", "completeness") in checks
+        finally:
+            del contracts.CONTRACTS["solver._gone_kernel"]
+            contracts.CONTRACTS["solver.explain_rows"] = missing
+
+    def test_live_tree_gate_zero_findings(self):
+        """ACCEPTANCE: the CLI gate — every registered kernel
+        contracted and clean, the go/no-go list names explain_rows,
+        every 'reduces' kernel backed by real coupling evidence."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ktlint", "--kernel-contracts",
+             "--format=json"],
+            capture_output=True, text=True, timeout=300, cwd=str(ROOT),
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        data = json.loads(proc.stdout)
+        from kubernetes_tpu.ops.parity import ORACLE_TWINS
+
+        assert data["findings"] == []
+        assert data["errors"] == []
+        assert data["kernels_checked"] == len(ORACLE_TWINS)
+        assert "solver.explain_rows" in data["shardable"]
+        for row in data["kernels"]:
+            if row["pod_axis"] == "reduces":
+                assert row["coupling_evidence"] > 0, row
+            assert row["weak_intermediates"] == 0, row
+
+    def test_cli_rejects_paths_and_unknown_kernel_keys(self):
+        """`--kernel-contracts <path>` must error (rc 2), not silently
+        filter the gate to zero kernels and exit green."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ktlint", "--kernel-contracts",
+             "kubernetes_tpu/ops/"],
+            capture_output=True, text=True, timeout=120, cwd=str(ROOT),
+        )
+        assert proc.returncode == 2
+        assert "kernel keys" in proc.stderr
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ktlint", "--kernel-contracts",
+             "solver.explain_rows"],
+            capture_output=True, text=True, timeout=300, cwd=str(ROOT),
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_checker_performs_zero_kernel_executions(self):
+        """The no-device-execution guard: abstract eval only — the jit
+        dispatch caches and the compile ledger's call counts must not
+        move across a full analyze()."""
+        from kubernetes_tpu.ops import contracts, ledger
+
+        kernels = {
+            key: contracts.resolve_kernel(key)
+            for key in contracts.registry_keys()
+        }
+        cache_before = {k: fn._cache_size() for k, fn in kernels.items()}
+        calls_before = {
+            r["kernel"]: r["calls"] for r in ledger.DEFAULT.rows()
+        }
+        rep = ktshape.analyze()
+        assert rep.exit_code == 0, rep.render()
+        for key, fn in kernels.items():
+            assert fn._cache_size() == cache_before[key], (
+                f"{key} compiled during the contract check"
+            )
+        calls_after = {
+            r["kernel"]: r["calls"] for r in ledger.DEFAULT.rows()
+        }
+        assert calls_after == calls_before
+
+
+# -- ledger join (observed vs declared) --------------------------------
+
+
+def _dispatch_on_and_off_lattice():
+    """Two real gang_member_counts dispatches into the process ledger:
+    one on the pow2 lattice, one deliberately off it (pod axis 24)."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops import matrices
+
+    matrices.gang_member_counts(
+        jnp.asarray(np.zeros(16, bool)),
+        jnp.asarray(np.full(16, -1, np.int32)),
+        num_groups=8,
+    )
+    matrices.gang_member_counts(
+        jnp.asarray(np.zeros(24, bool)),
+        jnp.asarray(np.full(24, -1, np.int32)),
+        num_groups=8,
+    )
+
+
+class TestLedgerJoin:
+    def test_ledger_rows_carry_contract_verdicts(self):
+        from kubernetes_tpu.ops import ledger
+
+        _dispatch_on_and_off_lattice()
+        rows = {r["kernel"]: r for r in ledger.DEFAULT.rows()}
+        shapes = {
+            s["signature"]: s["contract"]
+            for s in rows["matrices.gang_member_counts"]["shapes"]
+        }
+        assert shapes["b8[16],i32[16],8"] == "ok"
+        assert shapes["b8[24],i32[24],8"].startswith("mismatch")
+        assert "PG=24" in shapes["b8[24],i32[24],8"]
+
+    def test_ktctl_profile_kernels_renders_contract_column(self, capsys):
+        from kubernetes_tpu.cli import ktctl
+        from kubernetes_tpu.client import Client, LocalTransport
+        from kubernetes_tpu.server.api import APIServer
+
+        _dispatch_on_and_off_lattice()
+        rc = ktctl.main(
+            ["profile", "kernels"],
+            client=Client(LocalTransport(APIServer())),
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "CONTRACT" in out
+        # The off-lattice dispatch surfaces as a MISMATCH row with the
+        # drifted dim spelled out below the table.
+        assert "MISMATCH" in out
+        assert "off its bucket lattice" in out
+
+
+# -- the pow2 lattice helpers (satellite: explicit edge coverage) ------
+
+
+class TestBucketLattice:
+    def test_pow2_bucket_edges(self):
+        from kubernetes_tpu.ops.matrices import pow2_bucket
+
+        assert pow2_bucket(0) == 128  # empty staging keeps the floor
+        assert pow2_bucket(1) == 128
+        assert pow2_bucket(127) == 128
+        assert pow2_bucket(128) == 128  # exact bucket is not inflated
+        assert pow2_bucket(129) == 256
+        assert pow2_bucket(8192) == 8192
+
+    def test_pow2_bucket_minimum_clamp(self):
+        from kubernetes_tpu.ops.matrices import pow2_bucket
+
+        assert pow2_bucket(0, minimum=8) == 8
+        assert pow2_bucket(3, minimum=8) == 8
+        assert pow2_bucket(8, minimum=8) == 8
+        assert pow2_bucket(9, minimum=8) == 16
+        assert pow2_bucket(7, minimum=1) == 8
+        assert pow2_bucket(1, minimum=1) == 1
+
+    def test_pod_axis_bucket_edges(self):
+        from kubernetes_tpu.ops.matrices import _pod_axis_bucket
+
+        assert _pod_axis_bucket(0, 128) == 128
+        assert _pod_axis_bucket(1, 128) == 128
+        assert _pod_axis_bucket(8191, 128) == 8192
+        assert _pod_axis_bucket(8192, 128) == 8192  # pow2 band edge
+        # Past the pow2 band: 1024-multiples, exact multiples kept.
+        assert _pod_axis_bucket(8193, 128) == 9216
+        assert _pod_axis_bucket(9216, 128) == 9216
+        assert _pod_axis_bucket(9217, 128) == 10240
+
+    def test_lattice_validators_agree_with_the_helpers(self):
+        # Every bucket the helpers can emit sits on the declared
+        # lattice (the contract checker and the staging layer must
+        # agree about what "bucketed" means).
+        from kubernetes_tpu.ops import contracts
+        from kubernetes_tpu.ops.matrices import _pod_axis_bucket, pow2_bucket
+
+        for n in (0, 1, 127, 128, 500, 8192, 8193, 20000):
+            assert contracts.dim_ok("P", _pod_axis_bucket(n, 128)), n
+        for n in (0, 1, 7, 8, 9, 1000):
+            assert contracts.dim_ok("PG", pow2_bucket(max(n, 1), 8)), n
+            assert contracts.dim_ok("V", pow2_bucket(max(n, 1), 8)), n
+            assert contracts.dim_ok("R", pow2_bucket(max(n, 1), 8)), n

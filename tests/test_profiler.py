@@ -253,6 +253,19 @@ class TestLedgerCompleteness:
                 and "temp_bytes" in s
                 for s in ok
             ), row["kernel"]
+            # Declared-vs-observed join (ops/contracts.py): the real
+            # staged shapes this workload dispatched sit ON the
+            # declared bucket lattice — every kernel shows an "ok"
+            # CONTRACT verdict. (At least one per kernel, not all
+            # rows: the ledger is process-global and test_ktshape
+            # dispatches a DELIBERATELY off-lattice shape.)
+            assert any(
+                s.get("contract") == "ok" for s in row["shapes"]
+            ), (
+                f"{row['kernel']}: no staged shape joins its "
+                f"contract: "
+                f"{[(s['signature'], s.get('contract')) for s in row['shapes']]}"
+            )
 
 
 class TestDutyCycle:

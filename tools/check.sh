@@ -51,6 +51,27 @@ if [ $rc -ne 0 ]; then
     echo "ktsan lock graph FAILED (zero cycles / zero *_locked violations is the gate)"
     exit $rc
 fi
+
+echo "== ktshape kernel contracts (abstract eval, no execution) =="
+JAX_PLATFORMS=cpu python -m tools.ktlint --kernel-contracts --format=json \
+    > /tmp/ktshape.json
+rc=$?
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/ktshape.json"))
+print(
+    f"ktshape: {d['kernels_checked']} kernel(s) checked, "
+    f"{len(d['shardable'])} pod-axis shardable "
+    f"({', '.join(d['shardable']) or 'none'}), "
+    f"{len(d['findings'])} finding(s)"
+)
+for f in d["findings"]:
+    print(f"  {f['kernel']}: [{f['check']}] {f['message']}")
+EOF
+if [ $rc -ne 0 ]; then
+    echo "ktshape FAILED (every ORACLE_TWINS kernel contracted + zero shape/dtype/sharding findings is the gate)"
+    exit $rc
+fi
 if [ "$1" = "--lint-only" ]; then
     exit 0
 fi

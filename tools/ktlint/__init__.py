@@ -14,12 +14,20 @@ KT004    bounded I/O: socket/HTTP operations carry explicit timeouts
 KT005    metric naming: snake_case, unit-suffixed, via metrics.DEFAULT
 KT006    parity: jitted ops kernels need a registered NumPy oracle
          twin (ops/parity.py) exercised by the named suite
+KT007    kernel recompilation hazards: host round-trips in trace-time
+         helpers, raw-cardinality device-array dims, dtype-unpinned
+         literal arrays (scope: kubernetes_tpu/ops/)
 =======  ==============================================================
 
 The interprocedural lock analysis (lock-order cycles KTSAN01, the
 cross-module ``*_locked`` contract KTSAN02/KTSAN03) lives in
 tools/ktlint/lockgraph.py and runs via ``python -m tools.ktlint
 --lock-graph`` — see that module's docstring.
+
+The kernel shape/dtype/sharding contract checker (abstract
+interpretation of jaxprs against ops/contracts.py, zero kernel
+executions) lives in tools/ktlint/ktshape.py and runs via ``python -m
+tools.ktlint --kernel-contracts`` — see that module's docstring.
 
 Suppress one finding with ``# ktlint: disable=KT00N`` (on the line or
 the line above); grandfather a backlog with the baseline file
@@ -46,6 +54,7 @@ from tools.ktlint.rules_except import ExceptionHygieneRule
 from tools.ktlint.rules_io import BoundedIORule
 from tools.ktlint.rules_metrics import MetricNamingRule
 from tools.ktlint.rules_parity import OracleTwinRule
+from tools.ktlint.rules_shape import ShapeHazardRule
 from tools.ktlint.lockgraph import (  # noqa: F401  (public API)
     LockGraphReport,
     analyze as lock_graph,
@@ -59,6 +68,7 @@ ALL_RULES = (
     BoundedIORule(),
     MetricNamingRule(),
     OracleTwinRule(),
+    ShapeHazardRule(),
 )
 
 

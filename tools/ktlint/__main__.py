@@ -52,7 +52,41 @@ def main(argv=None) -> int:
         help="with --lock-graph: merge a runtime edge graph dumped by "
         "a KT_SANITIZE_REPORT=<file> sanitizer run",
     )
+    ap.add_argument(
+        "--kernel-contracts", action="store_true",
+        help="run the kernel shape/dtype/sharding contract checker "
+        "instead of the per-file rules: abstract interpretation of "
+        "every ORACLE_TWINS kernel against ops/contracts.py (zero "
+        "kernel executions; forces JAX_PLATFORMS=cpu when unset)",
+    )
     args = ap.parse_args(argv)
+
+    if args.kernel_contracts:
+        from tools.ktlint import ktshape
+
+        if args.paths:
+            # Positional args are kernel-registry keys here, not file
+            # paths — an unrecognized one (or a file path out of
+            # habit) must error, not silently filter the gate down to
+            # zero kernels and exit green.
+            from kubernetes_tpu.ops.contracts import CONTRACTS
+            from kubernetes_tpu.ops.parity import ORACLE_TWINS
+
+            known = set(CONTRACTS) | set(ORACLE_TWINS)
+            unknown = [p for p in args.paths if p not in known]
+            if unknown:
+                print(
+                    "--kernel-contracts takes ORACLE_TWINS kernel keys "
+                    f"(e.g. 'solver._solve_xla'), not paths: {unknown}",
+                    file=sys.stderr,
+                )
+                return 2
+        report = ktshape.analyze(kernels=args.paths or None)
+        if args.format == "json":
+            print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+        else:
+            print(report.render(), file=sys.stderr)
+        return report.exit_code
 
     if args.lock_graph:
         from tools.ktlint import lockgraph

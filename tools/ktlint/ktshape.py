@@ -1,0 +1,512 @@
+"""ktshape — the kernel shape/dtype/sharding contract checker.
+
+``python -m tools.ktlint --kernel-contracts`` verifies every kernel in
+the KT006 ORACLE_TWINS registry against its declared contract
+(kubernetes_tpu/ops/contracts.py) WITHOUT executing anything — all
+evidence comes from abstract interpretation:
+
+- **completeness** — CONTRACTS and ORACLE_TWINS must cover exactly the
+  same kernel set (a kernel lands with its oracle twin AND its
+  contract), and each contract must be internally consistent (a
+  declared pod axis must actually appear in the argument schema).
+- **abstract eval** — ``jax.eval_shape`` over ``ShapeDtypeStruct``
+  probes at several bucket-lattice points: result tree/shape/dtype
+  must match the declaration (which pins the registered oracle twin's
+  dtypes), results must not be weak-typed, and nothing may promote to
+  f64 (x64 creep breaks bit-parity with the NumPy oracles).
+- **jaxpr walk** — trace each kernel at a probe point whose dim sizes
+  are all distinct (so the pod axis is identifiable by size) and walk
+  the jaxpr (including scan/while/pjit/pallas sub-jaxprs) for
+  (a) *materialized* weak-typed or f64 intermediates — weak scalar
+  literals broadcast into real arrays are silent promotion hazards;
+  loop counters and other weak SCALARS are ubiquitous and benign, so
+  only ndim >= 1 avals count — and (b) **pod-axis coupling**:
+  reductions, scans, sorts, cumsums, gathers/scatters, contractions,
+  or opaque pallas calls along the pod axis. A kernel declared
+  ``pod_axis: shardable`` with coupling evidence is a finding (it
+  would decide differently under a pod-axis Mesh); a kernel declared
+  ``reduces`` with NO evidence is one too (the declaration is stale —
+  tighten it). The surviving ``shardable`` set is the static go/no-go
+  list for threading a Mesh through the daemons (ROADMAP item #2).
+
+Zero kernel executions by construction: only ``eval_shape`` and
+``.trace`` are used (tests pin the jit dispatch caches untouched).
+Runs under ``JAX_PLATFORMS=cpu`` — the checker forces it when unset so
+a CI box never grabs an accelerator to type-check shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Reduction/contraction primitives whose reduced axes matter.
+_REDUCE_PRIMS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+}
+_CUM_PRIMS = {"cumsum", "cummax", "cummin", "cumprod", "cumlogsumexp"}
+_SCATTER_PRIMS = {
+    "scatter", "scatter-add", "scatter-mul", "scatter-min",
+    "scatter-max", "scatter-apply",
+}
+
+
+@dataclass
+class ShapeFinding:
+    kernel: str
+    check: str  # completeness | abstract-eval | weak-type | pod-axis | error
+    message: str
+
+    def render(self) -> str:
+        return f"{self.kernel}: [{self.check}] {self.message}"
+
+
+@dataclass
+class ShapeReport:
+    findings: List[ShapeFinding] = field(default_factory=list)
+    kernels: List[dict] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.errors) else 0
+
+    @property
+    def shardable(self) -> List[str]:
+        flagged = {f.kernel for f in self.findings}
+        return sorted(
+            k["kernel"]
+            for k in self.kernels
+            if k["pod_axis"] == "shardable" and k["kernel"] not in flagged
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "kernels_checked": len(self.kernels),
+            "kernels": self.kernels,
+            "shardable": self.shardable,
+            "findings": [
+                {"kernel": f.kernel, "check": f.check, "message": f.message}
+                for f in self.findings
+            ],
+            "errors": self.errors,
+        }
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines += [f"ERROR {e}" for e in self.errors]
+        lines.append(
+            f"ktshape: {len(self.kernels)} kernel(s) checked, "
+            f"{len(self.shardable)} pod-axis shardable "
+            f"({', '.join(self.shardable) or 'none'}), "
+            f"{len(self.findings)} finding(s)"
+        )
+        return "\n".join(lines)
+
+
+# -- jaxpr helpers ------------------------------------------------------
+
+
+def _sub_jaxprs(eqn):
+    for pv in eqn.params.values():
+        vals = pv if isinstance(pv, (list, tuple)) else [pv]
+        for item in vals:
+            if hasattr(item, "eqns"):
+                yield item
+            elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield item.jaxpr
+
+
+def _src_of(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return "?"
+        return f"{os.path.basename(frame.file_name)}:{frame.start_line}"
+    except Exception:
+        return "?"
+
+
+def _aval(var):
+    return getattr(var, "aval", None)
+
+
+def _shape_of(var) -> Tuple[int, ...]:
+    aval = _aval(var)
+    shape = getattr(aval, "shape", None)
+    return tuple(shape) if shape is not None else ()
+
+
+def _coupling_of(eqn, pod: int) -> Optional[str]:
+    """Why this eqn couples the pod axis (probe size `pod`), or None.
+    Conservative for reduction-style primitives; batching dims (vmap
+    residue — per-pod independent work) never count."""
+    prim = eqn.primitive.name
+    params = eqn.params
+    if prim == "scan":
+        length = params.get("length")
+        n_fixed = params.get("num_consts", 0) + params.get("num_carry", 0)
+        if length == pod and len(eqn.invars) > n_fixed:
+            return "scan over the pod axis (sequential dependence)"
+        return None
+    if prim in _REDUCE_PRIMS:
+        axes = params.get("axes", ())
+        shape = _shape_of(eqn.invars[0])
+        if any(a < len(shape) and shape[a] == pod for a in axes):
+            return f"{prim} reduces the pod axis"
+        return None
+    if prim in _CUM_PRIMS:
+        axis = params.get("axis", 0)
+        shape = _shape_of(eqn.invars[0])
+        if axis < len(shape) and shape[axis] == pod:
+            return f"{prim} along the pod axis"
+        return None
+    if prim == "sort":
+        dim = params.get("dimension", 0)
+        for v in eqn.invars:
+            shape = _shape_of(v)
+            if dim < len(shape) and shape[dim] == pod:
+                return "sort along the pod axis"
+        return None
+    if prim == "gather":
+        dnums = params.get("dimension_numbers")
+        slice_sizes = params.get("slice_sizes", ())
+        shape = _shape_of(eqn.invars[0])
+        batching = tuple(getattr(dnums, "operand_batching_dims", ()) or ())
+        for i, size in enumerate(shape):
+            if (
+                size == pod
+                and i not in batching
+                and i < len(slice_sizes)
+                and slice_sizes[i] != size
+            ):
+                return "gather indexes into the pod axis"
+        return None
+    if prim in _SCATTER_PRIMS:
+        dnums = params.get("dimension_numbers")
+        batching = tuple(getattr(dnums, "operand_batching_dims", ()) or ())
+        inserted = tuple(getattr(dnums, "inserted_window_dims", ()) or ())
+        to_operand = tuple(
+            getattr(dnums, "scatter_dims_to_operand_dims", ()) or ()
+        )
+        shape = _shape_of(eqn.invars[0])
+        for i, size in enumerate(shape):
+            if size == pod and i not in batching and (
+                i in inserted or i in to_operand
+            ):
+                return "scatter into the pod axis"
+        if len(eqn.invars) >= 3:
+            up_shape = _shape_of(eqn.invars[2])
+            window = tuple(getattr(dnums, "update_window_dims", ()) or ())
+            for i, size in enumerate(up_shape):
+                if size == pod and i not in window:
+                    return (
+                        f"{prim} accumulates pod-axis rows "
+                        "(segment reduction)"
+                    )
+        return None
+    if prim == "dot_general":
+        dnums = params.get("dimension_numbers")
+        if dnums:
+            (lc, rc), _ = dnums
+            for var, cdims in ((eqn.invars[0], lc), (eqn.invars[1], rc)):
+                shape = _shape_of(var)
+                if any(c < len(shape) and shape[c] == pod for c in cdims):
+                    return "dot_general contracts the pod axis"
+        return None
+    if prim == "pallas_call":
+        for v in eqn.invars:
+            if pod in _shape_of(v):
+                return "opaque pallas_call consumes the pod axis"
+        return None
+    if prim == "conv_general_dilated":
+        for v in eqn.invars[:2]:
+            if pod in _shape_of(v):
+                return "convolution touches the pod axis"
+        return None
+    return None
+
+
+def walk_jaxpr(
+    jaxpr, pod: Optional[int]
+) -> Tuple[List[Tuple[str, str]], List[Tuple[str, str, str]]]:
+    """Walk one (sub)jaxpr tree. Returns (couplings, weak_hits):
+    couplings = [(reason, src)], weak_hits = [(kind 'weak'|'f64',
+    aval description, src)] for MATERIALIZED (ndim >= 1) offenders."""
+    import numpy as np
+
+    couplings: List[Tuple[str, str]] = []
+    weak: List[Tuple[str, str, str]] = []
+    seen_srcs = set()
+
+    def walk(j):
+        for eqn in j.eqns:
+            for v in eqn.outvars:
+                aval = _aval(v)
+                dt = getattr(aval, "dtype", None) if aval is not None else None
+                if dt is None or getattr(aval, "ndim", 0) < 1:
+                    continue
+                desc = f"{eqn.primitive.name} -> {dt}{list(aval.shape)}"
+                if np.dtype(dt) in (np.float64, np.complex128):
+                    weak.append(("f64", desc, _src_of(eqn)))
+                elif getattr(aval, "weak_type", False):
+                    key = ("weak", _src_of(eqn))
+                    if key not in seen_srcs:
+                        seen_srcs.add(key)
+                        weak.append(("weak", desc, _src_of(eqn)))
+            if pod is not None:
+                reason = _coupling_of(eqn, pod)
+                if reason is not None:
+                    couplings.append((reason, _src_of(eqn)))
+            for sub in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return couplings, weak
+
+
+# -- per-kernel checks --------------------------------------------------
+
+
+def _leaf_desc(leaf) -> str:
+    return (
+        f"{getattr(leaf, 'dtype', '?')}{list(getattr(leaf, 'shape', ()))}"
+        f"{' (weak)' if getattr(leaf, 'weak_type', False) else ''}"
+    )
+
+
+def check_kernel(
+    name: str, fn, contract, meta: Optional[dict] = None
+) -> List[ShapeFinding]:
+    """Verify ONE kernel object against ONE contract — the unit the
+    fixture tests drive directly. `fn` must expose the jit surface
+    (eval_shape + trace); ops kernels do via TracedJit. `meta`, when
+    given, receives the walk's evidence counts for the summary row."""
+    import jax
+    import numpy as np
+
+    from kubernetes_tpu.ops import contracts as C
+
+    out: List[ShapeFinding] = []
+
+    if contract.pod_axis not in C.POD_AXIS_KINDS:
+        return [
+            ShapeFinding(
+                name, "completeness",
+                f"pod_axis {contract.pod_axis!r} is not one of "
+                f"{C.POD_AXIS_KINDS}",
+            )
+        ]
+    arg_dims = {
+        d
+        for _, spec in C.declared_array_leaves(contract)
+        for d in spec.dims
+    }
+    if contract.pod_axis == "replicated":
+        if contract.pod_dim is not None:
+            return [
+                ShapeFinding(
+                    name, "completeness",
+                    "pod_axis 'replicated' contradicts a declared "
+                    f"pod_dim {contract.pod_dim!r} — a kernel that "
+                    "stages pod-axis arrays must declare shardable or "
+                    "reduces",
+                )
+            ]
+    elif contract.pod_dim not in arg_dims:
+        return [
+            ShapeFinding(
+                name, "completeness",
+                f"pod_dim {contract.pod_dim!r} appears in no argument "
+                "leaf — the coupling declaration is unverifiable",
+            )
+        ]
+
+    # -- abstract eval over the bucket lattice -------------------------
+    for bindings in contract.samples:
+        for sym, size in bindings.items():
+            if not C.dim_ok(sym, size):
+                out.append(
+                    ShapeFinding(
+                        name, "completeness",
+                        f"sample point {sym}={size} is off the "
+                        f"{sym} lattice "
+                        f"({C.DIM_LATTICES[sym][0]})",
+                    )
+                )
+        try:
+            args, kwargs = C.abstract_args(contract, bindings)
+            observed = fn.eval_shape(*args, **kwargs)
+        except Exception as e:
+            out.append(
+                ShapeFinding(
+                    name, "abstract-eval",
+                    f"eval_shape failed at {bindings}: {e!r}",
+                )
+            )
+            continue
+        expected = C.expected_results(contract, bindings)
+        obs_leaves, obs_tree = jax.tree_util.tree_flatten(observed)
+        exp_leaves, exp_tree = jax.tree_util.tree_flatten(expected)
+        if obs_tree != exp_tree:
+            out.append(
+                ShapeFinding(
+                    name, "abstract-eval",
+                    f"result tree mismatch at {bindings}: observed "
+                    f"{obs_tree}, declared {exp_tree}",
+                )
+            )
+            continue
+        for i, (obs, exp) in enumerate(zip(obs_leaves, exp_leaves)):
+            if tuple(obs.shape) != tuple(exp.shape) or np.dtype(
+                obs.dtype
+            ) != np.dtype(exp.dtype):
+                out.append(
+                    ShapeFinding(
+                        name, "abstract-eval",
+                        f"result leaf {i} at {bindings}: observed "
+                        f"{_leaf_desc(obs)}, declared {_leaf_desc(exp)} "
+                        "— drifted from the registered oracle twin's "
+                        "contract",
+                    )
+                )
+            elif getattr(obs, "weak_type", False):
+                out.append(
+                    ShapeFinding(
+                        name, "weak-type",
+                        f"result leaf {i} at {bindings} is WEAK-typed "
+                        f"({_leaf_desc(obs)}) — its dtype floats with "
+                        "downstream promotion instead of the contract",
+                    )
+                )
+            if np.dtype(obs.dtype) in (np.float64, np.complex128):
+                out.append(
+                    ShapeFinding(
+                        name, "abstract-eval",
+                        f"result leaf {i} at {bindings} promoted to "
+                        f"{np.dtype(obs.dtype)} — x64 creep breaks "
+                        "oracle bit-parity",
+                    )
+                )
+
+    # -- jaxpr walk at the distinct-dims probe -------------------------
+    bindings = C._distinct_bindings(contract)
+    pod = bindings.get(contract.pod_dim) if contract.pod_dim else None
+    try:
+        args, kwargs = C.abstract_args(contract, bindings)
+        traced = fn.trace(*args, **kwargs)
+        jaxpr = traced.jaxpr.jaxpr
+    except Exception as e:
+        out.append(
+            ShapeFinding(
+                name, "error", f"trace failed at {bindings}: {e!r}"
+            )
+        )
+        return out
+    couplings, weak_hits = walk_jaxpr(jaxpr, pod)
+    if meta is not None:
+        meta["coupling_evidence"] = len(couplings)
+        meta["weak_intermediates"] = sum(
+            1 for k, _, _ in weak_hits if k == "weak"
+        )
+    for kind, desc, src in weak_hits:
+        if kind == "f64":
+            out.append(
+                ShapeFinding(
+                    name, "abstract-eval",
+                    f"f64 intermediate {desc} at {src} — x64 creep "
+                    "breaks oracle bit-parity",
+                )
+            )
+        else:
+            out.append(
+                ShapeFinding(
+                    name, "weak-type",
+                    f"weak-typed intermediate materialized: {desc} at "
+                    f"{src} — pin the scalar literal's dtype "
+                    "(jnp.int32(...)/jnp.float32(...))",
+                )
+            )
+    if contract.pod_axis == "shardable" and couplings:
+        ev = "; ".join(f"{r} at {s}" for r, s in couplings[:3])
+        out.append(
+            ShapeFinding(
+                name, "pod-axis",
+                f"declared shardable but the jaxpr couples pods: {ev} "
+                "— this kernel would decide differently under a "
+                "pod-axis Mesh",
+            )
+        )
+    if contract.pod_axis == "reduces" and pod is not None and not couplings:
+        out.append(
+            ShapeFinding(
+                name, "pod-axis",
+                "declared 'reduces' but no cross-pod primitive found — "
+                "tighten the declaration to 'shardable' (it widens the "
+                "Mesh go-list) or the contract is stale",
+            )
+        )
+    return out
+
+
+def _kernel_row(name: str, contract) -> dict:
+    return {
+        "kernel": name,
+        "pod_axis": contract.pod_axis,
+        "pod_dim": contract.pod_dim,
+        "samples": len(contract.samples),
+        "coupling_evidence": 0,
+        "weak_intermediates": 0,
+    }
+
+
+def analyze(kernels: Optional[Sequence[str]] = None) -> ShapeReport:
+    """Run the full contract check over the registry (or a named
+    subset). Imports jax — force the CPU platform when the caller
+    hasn't chosen one (shape checking must never grab a TPU)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report = ShapeReport()
+    try:
+        from kubernetes_tpu.ops import contracts as C
+    except Exception as e:  # pragma: no cover - broken tree
+        report.errors.append(f"cannot import ops/contracts.py: {e!r}")
+        return report
+
+    registry = set(C.registry_keys())
+    contracted = set(C.CONTRACTS)
+    for missing in sorted(registry - contracted):
+        report.findings.append(
+            ShapeFinding(
+                missing, "completeness",
+                "registered in ORACLE_TWINS but has no contract in "
+                "ops/contracts.py CONTRACTS — kernels land with their "
+                "contract or not at all",
+            )
+        )
+    for stale in sorted(contracted - registry):
+        report.findings.append(
+            ShapeFinding(
+                stale, "completeness",
+                "contracted in ops/contracts.py but not registered in "
+                "ORACLE_TWINS (stale after a rename/removal?)",
+            )
+        )
+
+    todo = sorted(contracted & registry)
+    if kernels is not None:
+        todo = [k for k in todo if k in set(kernels)]
+    for name in todo:
+        contract = C.CONTRACTS[name]
+        try:
+            fn = C.resolve_kernel(name)
+        except Exception as e:
+            report.errors.append(f"{name}: cannot resolve kernel: {e!r}")
+            continue
+        row = _kernel_row(name, contract)
+        report.findings.extend(check_kernel(name, fn, contract, meta=row))
+        report.kernels.append(row)
+    return report

@@ -1,0 +1,270 @@
+"""KT007 — kernel recompilation hazards (the ktshape AST family).
+
+The contract checker (tools/ktlint/ktshape.py) verifies declared
+shapes/dtypes by abstract interpretation; KT007 is its AST complement,
+catching the hazards that produce recompile storms or dtype drift
+BEFORE a kernel ever traces. Scope: ``kubernetes_tpu/ops/`` — the
+kernel layer.
+
+Three checks:
+
+- **host round-trips in traced context** — ``.item()``, ``.tolist()``,
+  ``int()/float()/bool()`` casts, ``np.asarray``/``np.array``,
+  ``jax.device_get`` inside a *trace-time helper*: a function that is
+  not itself jit-decorated but is referenced (called, or passed as a
+  callback) from a jitted kernel in the same file. KT001 already
+  polices directly-decorated bodies; KT007 closes the interprocedural
+  gap — ops/ kernels are built from helper pyramids (``_feasible``,
+  ``run_windowed``, ``choose`` callbacks) and a host sync buried two
+  helpers deep stalls the solve exactly the same.
+- **unbucketed device-array dims** — ``jnp.zeros/ones/full/empty/
+  arange`` whose size expression contains a raw cardinality
+  (``len(...)``, ``.count``, ``.n_pods``, ``.n_nodes``) not routed
+  through a bucket helper (``pow2_bucket``/``_pod_axis_bucket``/
+  ``_round_up``/``_svc_pad``/``_bucket``/``node_axis_multiple``).
+  Every distinct device-array shape is a fresh XLA executable; a shape
+  keyed on a raw cluster count recompiles on every drift (seconds per
+  compile — the storm the pow2 lattice exists to prevent).
+- **dtype-unpinned literal arrays** — ``jnp.array(...)`` without
+  ``dtype=``, and ``jnp.asarray(<literal>)`` without ``dtype=``:
+  dtype inference from Python literals is promotion-dependent (weak
+  f32 / i32 by accident), and kernel dtypes are contract-pinned to the
+  NumPy oracle twins' (ops/contracts.py).
+
+Standard pragmas apply (``# ktlint: disable=KT007``); ``--select
+KT007`` runs the family alone.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+from tools.ktlint.rules_jit import _jit_decoration
+
+#: Bucket helpers that launder a raw cardinality onto the lattice.
+_BUCKET_HELPERS = {
+    "pow2_bucket",
+    "_pod_axis_bucket",
+    "_round_up",
+    "_svc_pad",
+    "_bucket",
+    "node_axis_multiple",
+}
+
+#: jnp constructors whose first argument is a shape/size.
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+
+#: Raw-cardinality attribute names: live object counts, never shapes.
+_RAW_COUNT_ATTRS = {"count", "n_pods", "n_nodes"}
+
+_HOST_SYNC_CALLS = {
+    ("np", "asarray"): "np.asarray",
+    ("np", "array"): "np.array",
+    ("numpy", "asarray"): "numpy.asarray",
+    ("numpy", "array"): "numpy.array",
+    ("jax", "device_get"): "jax.device_get",
+}
+_CAST_BUILTINS = {"int", "float", "bool"}
+
+
+def _is_jnp_call(chain: List[str], name: str) -> bool:
+    """jnp.<name> / jax.numpy.<name>."""
+    return (
+        len(chain) >= 2
+        and chain[-1] == name
+        and (chain[0] in ("jnp",) or chain[:2] == ["jax", "numpy"])
+    )
+
+
+class _RawDimScanner(ast.NodeVisitor):
+    """Does a size expression contain a raw cardinality NOT dominated
+    by a bucket-helper call?"""
+
+    def __init__(self):
+        self.raw: List[ast.AST] = []
+
+    def visit_Call(self, node: ast.Call):
+        chain = attr_chain(node.func)
+        if chain and chain[-1] in _BUCKET_HELPERS:
+            return  # everything below is laundered onto the lattice
+        if chain == ["len"]:
+            self.raw.append(node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if node.attr in _RAW_COUNT_ATTRS:
+            self.raw.append(node)
+        self.generic_visit(node)
+
+
+class ShapeHazardRule(Rule):
+    id = "KT007"
+    title = "kernel recompilation hazards (host syncs, unbucketed dims)"
+
+    def applies(self, ctx: FileContext) -> bool:
+        return "ops" in ctx.path.parts
+
+    # -- traced-context closure ----------------------------------------
+
+    def _traced_helpers(self, ctx: FileContext) -> Dict[str, ast.AST]:
+        """Same-file functions reachable from a jitted kernel: seeds
+        are jit/traced_jit-decorated defs; any module-level def whose
+        NAME is loaded inside traced context (a call, or a callback
+        reference like ``choose=_priced_choose``) joins the closure.
+        Returns {helper name: def node} for the NON-decorated members
+        (KT001 owns the decorated bodies)."""
+        defs: Dict[str, ast.AST] = {}
+        seeds: List[ast.AST] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(_jit_decoration(d) for d in node.decorator_list):
+                seeds.append(node)
+            else:
+                defs.setdefault(node.name, node)
+        traced: Dict[str, ast.AST] = {}
+        frontier = list(seeds)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in defs
+                    and node.id not in traced
+                ):
+                    traced[node.id] = defs[node.id]
+                    frontier.append(defs[node.id])
+        return traced
+
+    def _check_helper(
+        self, ctx: FileContext, fn: ast.AST, helper_of: str
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("item", "tolist")
+            ):
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f".{node.func.attr}() in {fn.name}() — a trace-"
+                        f"time helper of jitted {helper_of}() — forces "
+                        "a device->host round-trip mid-solve",
+                    )
+                )
+            elif chain and tuple(chain[-2:]) in _HOST_SYNC_CALLS:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{'.'.join(chain)}() in {fn.name}() — a trace-"
+                        f"time helper of jitted {helper_of}() — forces "
+                        "a device->host sync inside the traced region",
+                    )
+                )
+            elif (
+                len(chain) == 1
+                and chain[0] in _CAST_BUILTINS
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+            ):
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{chain[0]}({node.args[0].id}) in {fn.name}() "
+                        f"— a trace-time helper of jitted {helper_of}()"
+                        " — concretizes a traced value (host sync / "
+                        "TracerError; hoist statics to the jit "
+                        "boundary)",
+                    )
+                )
+        return out
+
+    # -- the pass ------------------------------------------------------
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+
+        # (a) host round-trips in trace-time helpers.
+        jitted_names = {
+            node.name
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and any(_jit_decoration(d) for d in node.decorator_list)
+        }
+        anchor = ", ".join(sorted(jitted_names)) or "?"
+        for _, fn in sorted(self._traced_helpers(ctx).items()):
+            out.extend(self._check_helper(ctx, fn, anchor))
+
+        # (b) + (c): one walk over every call site.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            # (b) unbucketed dims in jnp constructors.
+            if chain[-1] in _SHAPE_CTORS and _is_jnp_call(chain, chain[-1]):
+                scanner = _RawDimScanner()
+                size_args = (
+                    list(node.args)
+                    if chain[-1] == "arange"
+                    else list(node.args[:1])
+                )
+                size_args += [
+                    kw.value for kw in node.keywords if kw.arg == "shape"
+                ]
+                for a in size_args:
+                    scanner.visit(a)
+                for raw in scanner.raw[:1]:
+                    what = (
+                        "len(...)"
+                        if isinstance(raw, ast.Call)
+                        else f".{raw.attr}"
+                    )
+                    out.append(
+                        ctx.finding(
+                            self.id, node,
+                            f"{'.'.join(chain)}() sized by raw "
+                            f"cardinality {what} — every distinct "
+                            "device shape is a fresh XLA executable; "
+                            "route the dim through pow2_bucket/"
+                            "_pod_axis_bucket so cluster drift reuses "
+                            "the compiled kernel",
+                        )
+                    )
+            # (c) dtype-unpinned literal arrays.
+            elif _is_jnp_call(chain, "array") and "dtype" not in kwargs:
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{'.'.join(chain)}() without dtype= — literal "
+                        "dtype inference is promotion-dependent; "
+                        "kernel dtypes are contract-pinned to the "
+                        "oracle twins (ops/contracts.py)",
+                    )
+                )
+            elif (
+                _is_jnp_call(chain, "asarray")
+                and "dtype" not in kwargs
+                and node.args
+                and isinstance(
+                    node.args[0], (ast.Constant, ast.List, ast.Tuple)
+                )
+            ):
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{'.'.join(chain)}(<literal>) without dtype= "
+                        "— Python literals infer weak/default dtypes; "
+                        "pin the dtype the contract declares",
+                    )
+                )
+        return out
