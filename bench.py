@@ -858,6 +858,46 @@ def apichurn_main() -> None:
     )
 
 
+def _soak_figure(n_nodes: int = 64, seed: int = 7) -> dict:
+    """ISSUE 15: a miniature chaos soak (tools/soak.py) inside the
+    bench run — hollow-node fleet, one apiserver kill -9 with WAL
+    replay, one abrupt daemon kill mid-gang, then a clean measurement
+    wave. The artifact carries the chaos plane's acceptance triple:
+    faults injected, invariant violations (must chart at ZERO), and
+    the post-fault bind p99."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.soak import run_soak
+
+    artifact = run_soak(
+        n_nodes=n_nodes, seed=seed,
+        epochs=[
+            "baseline", "apiserver_restart",
+            "daemon_restart_mid_gang", "final",
+        ],
+        verbose=False,
+    )
+    fired = sum(
+        s["fired"] for s in artifact["faults_injected"].values()
+    )
+    return {
+        "soak": {
+            "nodes": n_nodes,
+            "seed": seed,
+            "epochs": [e["epoch"] for e in artifact["epochs"]],
+            "faults_injected": fired,
+            "restarts": artifact["restarts"],
+            "pods_bound": artifact["pods_bound"],
+            "invariant_violations": len(artifact["invariant_violations"]),
+            "violation_detail": artifact["invariant_violations"][:5],
+            "post_fault_bind_p50_s": artifact["post_fault_bind_p50_s"],
+            "post_fault_bind_p99_s": artifact["post_fault_bind_p99_s"],
+            "wall_s": artifact["wall_s"],
+        }
+    }
+
+
 def _microtick_profile_figure(n_pods: int = 24) -> dict:
     """ISSUE 13: duty-cycle / overlap-efficiency figures from a LIVE
     micro-tick daemon (utils/profiler.py, fed by the pipelined
@@ -1575,6 +1615,12 @@ def main() -> None:
         # Device duty-cycle / overlap from a live micro-tick daemon
         # (ISSUE 13 acceptance: both series appear in the artifact).
         record.update(_microtick_profile_figure())
+        # Chaos soak (ISSUE 15): faults injected / violations=0 /
+        # post-fault bind p99 must appear in the artifact.
+        try:
+            record.update(_soak_figure())
+        except Exception as e:
+            record["soak_error"] = str(e)  # must never sink a bench run
     # Preemption counters ride the record alongside the per-phase
     # latency fields (phase_p50_s/phase_p99_s already carry the
     # "preempt" phase when it ran): solve outcomes by kind + victims

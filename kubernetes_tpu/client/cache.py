@@ -469,15 +469,27 @@ class Reflector:
         backoff = 0.05
         while not self._stop.is_set():
             try:
-                self._list_and_watch()
-                backoff = 0.05
+                progressed = self._list_and_watch()
             except Exception:
                 if self._stop.is_set():
                     return
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
+                continue
+            if progressed:
+                backoff = 0.05
+            elif not self._stop.is_set():
+                # Idle-close fallback (watcher being shed): the re-list
+                # itself must back off too, or a sustained drop storm
+                # becomes a full-LIST tight loop against the very
+                # server that is shedding us.
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
 
-    def _list_and_watch(self) -> None:
+    def _list_and_watch(self) -> bool:
+        """One LIST + watch cycle. Returns False only when the watch
+        was abandoned after consecutive EMPTY closes (no event ever
+        delivered) — _run then backs off before the next re-list."""
         # Typed clients return (items, version); raw ones a wire dict.
         items, version = self.client.list(
             self.resource,
@@ -509,6 +521,13 @@ class Reflector:
             for o in objs:
                 self.on_event(ADDED, o)
 
+        # Consecutive watch closes that delivered NOTHING: the server
+        # (or the store's slow-consumer guard, or an injected fault
+        # storm) is shedding this watcher. Re-dialing instantly would
+        # tight-loop list/watch against a struggling control plane —
+        # back off between re-dials and, past the threshold, fall back
+        # to a full re-list (return; _run owns that cadence).
+        idle_closes = 0
         while not self._stop.is_set():
             try:
                 stream = self.client.watch(
@@ -520,16 +539,32 @@ class Reflector:
                 )
             except APIError as e:
                 if e.code == 410:  # compacted: re-list
-                    return
+                    return True
                 raise
             self._stream = stream
             try:
-                self._consume(stream)
+                delivered = self._consume(stream)
             finally:
                 self._stream = None
                 stream.close()
+            if self._stop.is_set():
+                return True
+            if delivered:
+                idle_closes = 0
+                continue
+            idle_closes += 1
+            if idle_closes >= self._RELIST_AFTER_IDLE_CLOSES:
+                return False  # re-list (the watch window may be unservable)
+            self._stop.wait(min(0.05 * (2 ** idle_closes), 2.0))
+        return True
 
-    def _consume(self, stream) -> None:
+    #: Empty watch closes tolerated before falling back to a re-list.
+    _RELIST_AFTER_IDLE_CLOSES = 3
+
+    def _consume(self, stream) -> int:
+        """Drain `stream` until it closes; returns events processed
+        (the close-backoff signal above)."""
+        delivered = 0
         while not self._stop.is_set():
             # Long block: close() (from stop() or the store dropping a
             # slow consumer) wakes it immediately via the sentinel; the
@@ -538,10 +573,10 @@ class Reflector:
             ev = stream.next(timeout=10.0)
             if ev is None:
                 if stream.closed:
-                    return  # watch dropped; outer loop re-establishes
+                    return delivered  # dropped; outer loop re-establishes
                 continue
             if ev.type == ERROR:
-                return
+                return delivered
             if (
                 ev.type == DELETED
                 and not self.decode_deleted
@@ -561,8 +596,10 @@ class Reflector:
                 self.store.update(obj)
             elif ev.type == DELETED:
                 self.store.delete(obj)
+            delivered += 1
             if self.on_event:
                 self.on_event(ev.type, obj)
+        return delivered
 
 
 class Informer:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import ssl
 import threading
 import time
@@ -25,7 +26,7 @@ from kubernetes_tpu.models import serde
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.server.registry import RESOURCES
 from kubernetes_tpu.store.watch import Event
-from kubernetes_tpu.utils import tracing
+from kubernetes_tpu.utils import faults, tracing
 from kubernetes_tpu.utils.ratelimit import TokenBucket
 
 #: Failures that mean a pooled keep-alive connection went stale
@@ -48,6 +49,24 @@ _STALE_ERRORS = (
 #: a double-applied create, or a 409 the caller can't distinguish from
 #: a genuine name collision.
 _IDEMPOTENT_VERBS = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
+#: 5xx codes that mean "the server (or something in front of it) is
+#: transiently unavailable" — safe to retry on idempotent verbs. 500
+#: is excluded: an internal error usually reproduces, and hammering it
+#: just multiplies load on a struggling server.
+_TRANSIENT_5XX = frozenset({502, 503, 504})
+
+#: Jitter source for retry backoff — module-level and seeded (the
+#: Summary-reservoir precedent from PR 1) so fault-injection tests
+#: replay identical schedules.
+_RETRY_RNG = random.Random(0x5EED)
+
+
+class _ReplayStale(Exception):
+    """Internal: a REUSED keep-alive connection went stale before any
+    response byte — replay immediately on a fresh connection. Free
+    (never counts against the transient-failure retry budget): the
+    request provably never reached a live server."""
 
 
 class UnknownOutcomeError(ConnectionError):
@@ -188,6 +207,7 @@ class HTTPTransport(Transport):
         headers: Optional[Dict[str, str]] = None,
         ssl_context=None,
         serialize: bool = False,
+        max_retries: int = 3,
     ):
         u = urlparse(base_url)
         self.host = u.hostname or "127.0.0.1"
@@ -215,6 +235,12 @@ class HTTPTransport(Transport):
         # Watches are unaffected (they always own a dedicated socket).
         self._serial_lock = threading.Lock() if serialize else None
         self._shared_conn = None
+        # Transient-failure budget: connection errors / transient 5xx
+        # on IDEMPOTENT verbs retry up to this many times with capped,
+        # jittered exponential backoff (see _retry_backoff). 0 restores
+        # the historical fail-fast behavior. Distinct from the free
+        # stale-keep-alive replay, which never counts.
+        self.max_retries = max_retries
 
     def _connect(self, timeout=None) -> http.client.HTTPConnection:
         if self.ssl_context is not None:
@@ -296,8 +322,14 @@ class HTTPTransport(Transport):
         the response; a silent replay would double-apply (a create
         that actually succeeded would surface a spurious 409). POST
         raises UnknownOutcomeError so callers can reconcile. Other
-        read failures retry only GETs. A fresh connection's failure
-        propagates: that is a real outage."""
+        read failures retry only GETs.
+
+        A FRESH connection's failure is a real outage — and so is a
+        transient 5xx (502/503/504) from something restarting. Both
+        now retry idempotent verbs up to ``max_retries`` times with
+        capped, jittered exponential backoff (_retry_backoff) before
+        propagating; non-idempotent verbs still fail fast (a replayed
+        POST could double-apply)."""
         if self._serial_lock is not None:
             with self._serial_lock:
                 return self._do_locked(
@@ -307,6 +339,15 @@ class HTTPTransport(Transport):
         # nothing shared to guard; the _locked suffix means "under the
         # serial lock when one exists".  # ktlint: disable=KTSAN02
         return self._do_locked(verb, path, query, body, raw, content_type)
+
+    def _retry_backoff(self, attempt: int) -> None:
+        """Capped, jittered exponential wait before transient-failure
+        retry attempt `attempt` (1-based). Bounded by construction —
+        base 50ms doubling, 1s cap, max_retries attempts — so the total
+        added wait honors the same "no unbounded stall" contract KT004
+        enforces on the socket timeouts."""
+        delay = min(0.05 * (2 ** (attempt - 1)), 1.0)
+        time.sleep(delay * (0.5 + 0.5 * _RETRY_RNG.random()))
 
     def _do_locked(
         self,
@@ -328,54 +369,93 @@ class HTTPTransport(Transport):
         tid = tracing.current_trace_id()
         if tid:
             headers[tracing.TRACE_HEADER] = tid
+        attempts = 0
         while True:
-            conn, reused = self._pooled()
             try:
-                conn.request(verb, path, body=payload, headers=headers)
-            except _STALE_ERRORS:
-                self._discard()
-                if reused:
-                    continue  # request never left: safe for any verb
-                raise
-            except Exception:
-                self._discard()
-                raise
-            try:
-                resp = conn.getresponse()
-                raw_body = resp.read()
-            except http.client.RemoteDisconnected as e:
-                self._discard()
-                if reused and verb in _IDEMPOTENT_VERBS:
-                    continue  # clean close before any response bytes
-                if reused:
-                    # POST/PATCH on a stale connection: the server may
-                    # have applied the mutation before dying. Don't
-                    # replay; tell the caller the outcome is unknown.
-                    raise UnknownOutcomeError(verb, path) from e
-                raise
-            except _STALE_ERRORS:
-                self._discard()
-                if reused and verb == "GET":
+                if faults.enabled():
+                    # Chaos seams (client/chaos.py's policy transport
+                    # wraps whole transports; these sites sit INSIDE
+                    # the retry loop so injected resets/5xx exercise
+                    # the same recovery a real outage would).
+                    faults.fire(faults.HTTP_DELAY, path)
+                    faults.fire(faults.HTTP_RESET, path)
+                    faults.fire(faults.HTTP_5XX, path)
+                return self._attempt_locked(verb, path, payload, headers, raw)
+            except _ReplayStale:
+                continue  # stale keep-alive: free replay, no budget
+            except APIError as e:
+                if (
+                    e.code in _TRANSIENT_5XX
+                    and verb in _IDEMPOTENT_VERBS
+                    and attempts < self.max_retries
+                ):
+                    attempts += 1
+                    self._retry_backoff(attempts)
                     continue
                 raise
-            except Exception:
-                self._discard()
+            except _STALE_ERRORS:
+                # Fresh-connection/transport failure (a real outage,
+                # not a stale pool entry). UnknownOutcomeError is a
+                # ConnectionError too, but only non-idempotent verbs
+                # raise it — the verb check below re-raises it.
+                if verb in _IDEMPOTENT_VERBS and attempts < self.max_retries:
+                    attempts += 1
+                    self._retry_backoff(attempts)
+                    continue
                 raise
-            if resp.will_close:
-                self._discard()
-            if resp.status >= 400:
-                try:
-                    data = json.loads(raw_body or b"{}")
-                except json.JSONDecodeError:
-                    data = {}
-                raise APIError(
-                    data.get("code", resp.status),
-                    data.get("reason", "Unknown"),
-                    data.get("message", f"HTTP {resp.status}"),
-                )
-            if raw:
-                return raw_body.decode(errors="replace")
-            return json.loads(raw_body or b"{}")
+
+    def _attempt_locked(self, verb, path, payload, headers, raw):
+        """One request attempt over the pooled connection. Raises
+        _ReplayStale when a REUSED connection proved stale in a way
+        that is safe to replay for this verb; every other failure
+        propagates for _do_locked's transient-retry policy."""
+        conn, reused = self._pooled()
+        try:
+            conn.request(verb, path, body=payload, headers=headers)
+        except _STALE_ERRORS:
+            self._discard()
+            if reused:
+                raise _ReplayStale()  # request never left: any verb
+            raise
+        except Exception:
+            self._discard()
+            raise
+        try:
+            resp = conn.getresponse()
+            raw_body = resp.read()
+        except http.client.RemoteDisconnected as e:
+            self._discard()
+            if reused and verb in _IDEMPOTENT_VERBS:
+                raise _ReplayStale()  # clean close before any response bytes
+            if reused:
+                # POST/PATCH on a stale connection: the server may
+                # have applied the mutation before dying. Don't
+                # replay; tell the caller the outcome is unknown.
+                raise UnknownOutcomeError(verb, path) from e
+            raise
+        except _STALE_ERRORS:
+            self._discard()
+            if reused and verb == "GET":
+                raise _ReplayStale()
+            raise
+        except Exception:
+            self._discard()
+            raise
+        if resp.will_close:
+            self._discard()
+        if resp.status >= 400:
+            try:
+                data = json.loads(raw_body or b"{}")
+            except json.JSONDecodeError:
+                data = {}
+            raise APIError(
+                data.get("code", resp.status),
+                data.get("reason", "Unknown"),
+                data.get("message", f"HTTP {resp.status}"),
+            )
+        if raw:
+            return raw_body.decode(errors="replace")
+        return json.loads(raw_body or b"{}")
 
     def get_json(self, path: str, query: Optional[Dict[str, str]] = None):
         """Public raw GET for non-/api surfaces the typed verbs don't

@@ -36,7 +36,7 @@ from kubernetes_tpu.models.objects import (
 from kubernetes_tpu.models.quantity import parse_quantity
 from kubernetes_tpu.kubelet.runtime import ContainerRuntime, FakeRuntime
 from kubernetes_tpu.server.api import APIError
-from kubernetes_tpu.utils import metrics, tracing
+from kubernetes_tpu.utils import faults, metrics, tracing
 
 _LOG = logging.getLogger("kubernetes_tpu.kubelet")
 
@@ -395,6 +395,10 @@ class Kubelet:
         # needed on the first beat or after an error (node deleted /
         # apiserver restarted). At 100 kubelets the get+put pair doubled
         # heartbeat traffic exactly when delayed beats read as death.
+        if faults.enabled() and faults.fire(
+            faults.KUBELET_HEARTBEAT_DROP, self.node_name
+        ):
+            return  # chaos seam: a lost beat, not a dead kubelet
         node = self._hb_node
         if node is None:
             try:
@@ -620,6 +624,10 @@ class Kubelet:
         deadline, then this kubelet kills it and confirms with a
         grace-0 delete so watchers see exactly one DELETED."""
         uid = pod.metadata.uid or pod.metadata.name
+        # Chaos seam: the confirm path stalls (wedged volume teardown,
+        # slow runtime kill) — grace handling and the exactly-one-
+        # DELETED contract must survive the lag, not race it.
+        faults.fire(faults.KUBELET_TERMINATING_STALL, uid)
         if not self._terminating.get(uid):
             self._terminating[uid] = True
             try:

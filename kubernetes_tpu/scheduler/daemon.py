@@ -37,6 +37,7 @@ _LOG = logging.getLogger("kubernetes_tpu.scheduler")
 from kubernetes_tpu.scheduler.types import StaticNodeLister, StaticServiceLister
 from kubernetes_tpu.server.api import APIError
 from kubernetes_tpu.utils import (
+    faults,
     flightrecorder,
     metrics,
     profiler,
@@ -850,6 +851,11 @@ class BatchScheduler(Scheduler):
             for vkey in dec.victims:
                 vns, _, vname = vkey.partition("/")
                 try:
+                    # Chaos seam: an injected eviction failure takes
+                    # the same broad-except path a real transport
+                    # outage would — counted evict_failed below, no
+                    # nomination recorded, retried next tick.
+                    faults.fire(faults.SCHED_EVICT_ERROR, vkey)
                     cfg.client.evict(
                         vname, namespace=vns,
                         grace_period_seconds=self.eviction_grace_seconds,
@@ -1485,6 +1491,29 @@ class IncrementalBatchScheduler(BatchScheduler):
             self._commit_q.put(None)
             worker.join(timeout=10)
 
+    def kill(self) -> None:
+        """Abrupt-death analog of stop() — the chaos harness's kill -9
+        (tools/soak.py, the restart-invariant tests). Queued commit
+        jobs are DROPPED unexecuted and the in-flight solve abandoned:
+        a dead process commits nothing, so there is deliberately no
+        flush here. The session keeps charges for pods that never
+        bound; recovery is a FRESH daemon rebuilding its SolverSession
+        from LIST+watch."""
+        self._stop.set()
+        try:
+            while True:
+                self._commit_q.get_nowait()
+                self._commit_q.task_done()
+        except queue.Empty:
+            pass
+        self._commit_q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        worker = self._commit_thread
+        if worker is not None:
+            self._commit_thread = None
+            worker.join(timeout=10)
+
     @property
     def _pipelined(self) -> bool:
         """True while commits may ride the worker thread and solves may
@@ -2093,6 +2122,12 @@ class IncrementalBatchScheduler(BatchScheduler):
         thread — so no decision/SLI milestone is lost or reordered.
         NEVER touches the session: charge releases are routed back to
         the solve loop via _release()."""
+        # Chaos seam: the daemon "dies" between solve and commit — the
+        # job raises before any bind lands, the session keeps charges
+        # for pods that never bound, and recovery is a daemon restart
+        # that rebuilds its SolverSession from LIST+watch (the soak
+        # harness's daemon-restart-mid-gang epoch).
+        faults.fire(faults.SCHED_COMMIT_CRASH)
         results, ctx = job
         cfg = self.config
         gkey_of: Dict[str, str] = ctx["gkey_of"]

@@ -53,7 +53,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.store.watch import ADDED, DELETED, Event, MODIFIED, WatchStream
-from kubernetes_tpu.utils import sanitizer
+from kubernetes_tpu.utils import faults, sanitizer
 
 
 class StoreError(Exception):
@@ -443,7 +443,24 @@ class KVStore:
             exp = self._ttl.get(key)
             if exp is not None:
                 rec["e"] = exp
-        self._wal_file.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        data = json.dumps(rec, separators=(",", ":")) + "\n"
+        if faults.enabled() and faults.fire(faults.WAL_TORN_WRITE, key):
+            # Mid-append process death: a PREFIX of the record reaches
+            # the file (no newline), the write is never acked (raise),
+            # and recovery must truncate back to the last intact
+            # record. The store is DEAD from here (_closed): a torn
+            # line only exists because the process died mid-write, so
+            # later appends must never fuse onto the torn bytes — a
+            # live continuation would make replay truncate ACKED
+            # records that landed after it. Pair with crash() + a
+            # fresh store on the same data dir.
+            self._wal_file.write(data[: max(1, len(data) // 2)])
+            self._wal_file.flush()
+            self._closed = True
+            raise faults.FaultInjected(
+                f"kvstore.wal.torn_write: died mid-append of {key}"
+            )
+        self._wal_file.write(data)
         # flush=False is the batch path (create_many/atomic_update_many
         # and friends): records accumulate in the file object's buffer
         # and _wal_flush_locked writes them as ONE append at the end of
@@ -512,6 +529,13 @@ class KVStore:
                         "store closed before this write became durable"
                     )
                 try:
+                    # Chaos seam: an injected fsync failure surfaces to
+                    # the acking writer as a real I/O error — flushed
+                    # but not durable. INSIDE this try on purpose: like
+                    # a genuine OSError, it must be forgiven when a
+                    # concurrent snapshot rotation already made the
+                    # write durable (the rotated-handle branch below).
+                    faults.fire(faults.WAL_FSYNC)
                     os.fsync(wal.fileno())
                 except (ValueError, OSError):
                     with self._lock:
@@ -553,6 +577,10 @@ class KVStore:
                 json.dump({"version": self._version, "items": items}, f)
                 f.flush()
                 os.fsync(f.fileno())
+            # Chaos seam: crash-before-rename leaves only the .tmp file
+            # — recovery must keep serving the previous snapshot plus
+            # the (untruncated) WAL.
+            faults.fire(faults.SNAPSHOT_RENAME)
             os.replace(tmp, self._snap_path)
         if self._wal_file is not None:
             self._wal_file.close()
@@ -657,7 +685,31 @@ class KVStore:
         count. `obj` is the just-stored object (never mutated in place
         after storage); history shares the ref and replay copies it
         per delivery (watch())."""
-        self._wal_append_locked(version, etype, key, obj, flush=flush)
+        try:
+            self._wal_append_locked(version, etype, key, obj, flush=flush)
+        except faults.FaultInjected:
+            # Torn-write chaos site: the "process" died mid-append, so
+            # the in-memory apply (made by the caller just before this
+            # journal step) must roll back — the dead store's reads
+            # would otherwise serve an object watchers never saw and
+            # replay will not reconstruct. Stored objects carry their
+            # stamped resourceVersion, so the previous tuple rebuilds
+            # exactly. (TTL bookkeeping is left to the heap's lazy
+            # invalidation; the version-counter gap is harmless.)
+            if etype == ADDED:
+                self._data.pop(key, None)
+                self._ttl.pop(key, None)
+            elif etype == MODIFIED and prev is not None:
+                self._data[key] = (
+                    prev,
+                    int(prev.get("metadata", {}).get("resourceVersion", 0)),
+                )
+            elif etype == DELETED:
+                self._data[key] = (
+                    obj,
+                    int(obj.get("metadata", {}).get("resourceVersion", 0)),
+                )
+            raise
         if not self._history:
             self._oldest = version
         self._history.append((version, etype, key, obj))
@@ -1199,6 +1251,41 @@ class KVStore:
                 w for w in self._watchers if not w[2].closed
             ]
             self._rebuild_watch_index_locked()
+
+    def crash(self) -> None:
+        """Abandon the store the way a killed process would (the chaos
+        harness's kill -9 analog): watchers close, queued serialized
+        writes fail with StoreClosedError, the flock releases — and
+        unlike close(), NOTHING is fsynced and _synced_seq does not
+        advance, so a writer racing the crash is refused its durability
+        ack ("store closed before this write became durable") exactly
+        as it would be by a real death.
+
+        Fidelity note: file buffers still flush on handle close (we
+        share the page cache with any successor store, so OS-level loss
+        of flushed-not-fsynced bytes is not simulatable in-process).
+        The WAL_TORN_WRITE fault site models death MID-append; this
+        method models death between append and fsync."""
+        with self._lock:
+            self._closed = True
+            for w in self._watchers:
+                w[2].close()
+            self._watchers = []
+            self._unsharded = []
+            self._shard_buckets = {}
+            if self._write_q is not None:
+                self._write_q.put(None)
+                self._write_q = None
+            self._dispatch_q.put(None)
+            if self._wal_file is not None:
+                try:
+                    self._wal_file.close()
+                except OSError:
+                    pass
+                self._wal_file = None
+            if self._lockfd is not None:
+                os.close(self._lockfd)  # the OS releases a dead owner's flock
+                self._lockfd = None
 
     def close(self) -> None:
         with self._lock:

@@ -14,7 +14,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Iterator, List, Optional
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import faults, metrics
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -90,6 +90,14 @@ class WatchStream:
             return False
         if ev.version and ev.version <= self.floor:
             return True  # already covered by replay — drop, stay open
+        if faults.enabled() and self.resource and self.resource != "broadcast":
+            # Chaos seams, store-fed streams only (anonymous broadcast
+            # streams have no re-list recovery path to exercise): a
+            # forced slow-consumer drop takes the exact branch below;
+            # the delay site stalls delivery on the dispatcher thread.
+            if faults.fire(faults.WATCH_DROP, self.resource):
+                return self._drop_slow_consumer()
+            faults.fire(faults.WATCH_DELAY, self.resource)
         try:
             self._q.put_nowait(ev)
             depth = self._q.qsize()
@@ -97,21 +105,24 @@ class WatchStream:
                 QUEUE_DEPTH.set(depth, resource=self.resource)
             return True
         except queue.Full:
-            # Slow consumer: drop the stream (reference watchers are also
-            # terminated and must re-list; Reflector handles that) —
-            # OBSERVABLY: the counter + warn log are what tell an
-            # operator the churn figures just stopped including this
-            # consumer's fan-out cost.
-            STREAMS_DROPPED.inc(resource=self.resource)
-            QUEUE_DEPTH.set(self._q.qsize(), resource=self.resource)
-            _LOG.warning(
-                "dropping slow watch consumer (resource=%r, version "
-                "floor=%d, queue depth=%d/%d); it must re-list",
-                self.resource, self.floor, self._q.qsize(),
-                self._q.maxsize,
-            )
-            self.close()
-            return False
+            return self._drop_slow_consumer()
+
+    def _drop_slow_consumer(self) -> bool:
+        # Slow consumer: drop the stream (reference watchers are also
+        # terminated and must re-list; Reflector handles that) —
+        # OBSERVABLY: the counter + warn log are what tell an
+        # operator the churn figures just stopped including this
+        # consumer's fan-out cost.
+        STREAMS_DROPPED.inc(resource=self.resource)
+        QUEUE_DEPTH.set(self._q.qsize(), resource=self.resource)
+        _LOG.warning(
+            "dropping slow watch consumer (resource=%r, version "
+            "floor=%d, queue depth=%d/%d); it must re-list",
+            self.resource, self.floor, self._q.qsize(),
+            self._q.maxsize,
+        )
+        self.close()
+        return False
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
         """Next event, or None on close/timeout."""

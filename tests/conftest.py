@@ -86,6 +86,19 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "chaos: deterministic fault-injection tests (utils/faults.py "
+        "registry, injection sites, client resilience, crash-recovery "
+        "properties); tier-1 includes them — select just these with "
+        "-m chaos",
+    )
+    config.addinivalue_line(
+        "markers",
+        "soak: hollow-node soak-harness tests (tools/soak.py cluster, "
+        "fault epochs, invariant checker); tier-1 includes the small "
+        "ones — select with -m soak",
+    )
+    config.addinivalue_line(
+        "markers",
         "sanitize: run this test with the ktsan lock sanitizer enabled "
         "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
         "finding or leaked non-daemon thread; the concurrency-heavy "

@@ -1,0 +1,437 @@
+"""Chaos plane (ISSUE 15): the deterministic fault registry, its
+injection sites, the client resilience they exercise, and the soak
+harness's invariant machinery.
+
+Covered here:
+
+- registry mechanics: zero-cost when off, seeded per-site determinism,
+  spec parsing, budgets (times/every/after), stats/timeline;
+- site behavior end to end: torn WAL write + crash + replay, fsync
+  faults surfacing to writers, forced watch drops feeding the
+  Reflector's new close-backoff, kubelet heartbeat drops;
+- HTTPTransport transient retries (reset/5xx on idempotent verbs,
+  fail-fast on POST) driven through the http.request.* sites;
+- tools/soak.py: deterministic schedule, and a miniature end-to-end
+  run (apiserver crash + replay epoch) with zero invariant violations.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, HTTPTransport, LocalTransport
+from kubernetes_tpu.client.cache import Reflector, ThreadSafeStore
+from kubernetes_tpu.server.api import APIError, APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+from kubernetes_tpu.store.kvstore import KVStore
+from kubernetes_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with a disarmed registry."""
+    faults.clear()
+    faults.reset_stats(reseed=0)
+    yield
+    faults.clear()
+
+
+def wait_until(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def pod_wire(name):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "x"}]},
+    }
+
+
+# ---------------------------------------------------------------------------
+# registry mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_disabled_is_inert(self):
+        assert not faults.enabled()
+        assert faults.fire(faults.WAL_FSYNC) is False
+        # Disabled calls are not even counted (the zero-cost contract).
+        assert faults.stats() == {}
+
+    def test_per_site_determinism(self):
+        """Same seed -> same firing indices at a site, regardless of
+        what other sites did in between (per-site RNG + counters)."""
+        def run(interleave: bool):
+            faults.clear()
+            faults.reset_stats(reseed=99)
+            faults.inject(faults.WATCH_DROP, p=0.25, times=6)
+            faults.inject(faults.HTTP_DELAY, p=0.5, delay_s=0.0)
+            fired = []
+            for i in range(60):
+                if interleave:
+                    faults.fire(faults.HTTP_DELAY)  # consumes ITS rng only
+                if faults.fire(faults.WATCH_DROP):
+                    fired.append(i)
+            return fired
+
+        a = run(interleave=False)
+        b = run(interleave=True)
+        assert a == b and len(a) == 6
+
+    def test_budget_and_cadence_knobs(self):
+        rule = faults.inject(faults.WATCH_DROP, every=3, times=2, after=4)
+        fired = [
+            i for i in range(1, 20) if faults.fire(faults.WATCH_DROP)
+        ]
+        # after=4 skips calls 1-4; every=3 on the eligible counter
+        # fires at eligible calls 3 and 6 -> absolute calls 7 and 10.
+        assert fired == [7, 10]
+        assert rule.fired == 2
+
+    def test_spec_roundtrip_and_errors(self):
+        faults.configure(
+            "seed=5; kvstore.wal.fsync:every=10,times=2 ;"
+            "http.request.latency:p=0.5,delay=0.001"
+        )
+        assert faults.enabled()
+        by_site = {r["site"]: r for r in faults.rules()}
+        assert by_site["kvstore.wal.fsync"]["every"] == 10
+        assert by_site["http.request.latency"]["delay_s"] == 0.001
+        faults.configure("")
+        assert not faults.enabled()
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.configure("no.such.site:p=1")
+        with pytest.raises(ValueError, match="unknown knob"):
+            faults.configure("kvstore.wal.fsync:bogus=1")
+        with pytest.raises(ValueError, match="ever fire"):
+            faults.inject(faults.WAL_FSYNC)
+        with pytest.raises(TypeError, match="KT008"):
+            faults.inject("kvstore.wal.fsync", every=1)  # ktlint: disable=KT008
+
+    def test_stats_and_timeline(self):
+        faults.inject(faults.WATCH_DROP, every=2, times=2)
+        for _ in range(5):
+            faults.fire(faults.WATCH_DROP)
+        st = faults.stats()[faults.WATCH_DROP.name]
+        assert st == {"calls": 5, "fired": 2}
+        assert faults.timeline() == [
+            (faults.WATCH_DROP.name, 2), (faults.WATCH_DROP.name, 4),
+        ]
+        faults.reset_stats()
+        assert faults.timeline() == []
+
+    def test_error_kinds(self):
+        faults.inject(faults.WAL_FSYNC, every=1, times=1)
+        with pytest.raises(faults.InjectedIOError):
+            faults.fire(faults.WAL_FSYNC)
+        assert isinstance(faults.InjectedIOError("x"), OSError)
+        faults.clear()
+        faults.inject(faults.HTTP_5XX, every=1, times=1)
+        with pytest.raises(APIError) as ei:
+            faults.fire(faults.HTTP_5XX)
+        assert ei.value.code == 503
+        faults.clear()
+        faults.inject(faults.HTTP_RESET, every=1, times=1)
+        with pytest.raises(ConnectionResetError):
+            faults.fire(faults.HTTP_RESET)
+
+
+# ---------------------------------------------------------------------------
+# kvstore sites: torn write / fsync / snapshot rename + crash()
+# ---------------------------------------------------------------------------
+
+
+class TestKVStoreSites:
+    def test_torn_write_is_unacked_and_truncated_on_replay(self, tmp_path):
+        store = KVStore(data_dir=str(tmp_path))
+        store.create("/registry/pods/default/a", pod_wire("a"))
+        faults.inject(faults.WAL_TORN_WRITE, every=1, times=1)
+        with pytest.raises(faults.FaultInjected):
+            store.create("/registry/pods/default/b", pod_wire("b"))
+        faults.clear()
+        store.crash()
+        recovered = KVStore(data_dir=str(tmp_path))
+        try:
+            objs, _ = recovered.list("/registry/pods/")
+            assert [o["metadata"]["name"] for o in objs] == ["a"]
+            # The truncated WAL must accept appends again.
+            recovered.create("/registry/pods/default/c", pod_wire("c"))
+        finally:
+            recovered.close()
+
+    def test_fsync_fault_refuses_the_ack_but_state_recovers(self, tmp_path):
+        store = KVStore(data_dir=str(tmp_path))
+        faults.inject(faults.WAL_FSYNC, every=1, times=1)
+        with pytest.raises(faults.InjectedIOError):
+            store.create("/registry/pods/default/x", pod_wire("x"))
+        faults.clear()
+        # The record was appended+flushed; a later successful write's
+        # group commit makes both durable (the documented contract:
+        # fsync-before-ack, not fsync-per-record).
+        store.create("/registry/pods/default/y", pod_wire("y"))
+        store.crash()
+        recovered = KVStore(data_dir=str(tmp_path))
+        try:
+            objs, _ = recovered.list("/registry/pods/")
+            assert {o["metadata"]["name"] for o in objs} == {"x", "y"}
+        finally:
+            recovered.close()
+
+    def test_snapshot_rename_crash_keeps_previous_snapshot(self, tmp_path):
+        store = KVStore(data_dir=str(tmp_path), snapshot_every=100000)
+        for i in range(8):
+            store.create(f"/registry/pods/default/p{i}", pod_wire(f"p{i}"))
+        store.snapshot()  # good snapshot at version 8
+        store.create("/registry/pods/default/late", pod_wire("late"))
+        faults.inject(faults.SNAPSHOT_RENAME, every=1, times=1)
+        with pytest.raises(faults.InjectedIOError):
+            store.snapshot()
+        faults.clear()
+        store.crash()
+        recovered = KVStore(data_dir=str(tmp_path))
+        try:
+            objs, _ = recovered.list("/registry/pods/")
+            assert len(objs) == 9  # old snapshot + WAL tail, nothing lost
+        finally:
+            recovered.close()
+
+    def test_crash_refuses_durability_acks_in_flight(self, tmp_path):
+        """A writer racing crash() must error out, never hang, and its
+        write must not be silently acked as durable."""
+        store = KVStore(data_dir=str(tmp_path), serialized_writes=True)
+        results: "queue.Queue" = queue.Queue()
+
+        def writer(i):
+            try:
+                store.create(f"/registry/pods/default/w{i}", pod_wire(f"w{i}"))
+                results.put(("ok", i))
+            except Exception as e:
+                results.put(("err", repr(e)))
+
+        threads = [
+            threading.Thread(target=writer, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        store.crash()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "writer hung across crash()"
+        outcomes = [results.get(timeout=1) for _ in range(8)]
+        # Post-crash, writes must refuse cleanly.
+        with pytest.raises(Exception):
+            store.create("/registry/pods/default/late", pod_wire("late"))
+        # The contract under test: an "ok" is a DURABILITY ack, so
+        # every acked write must survive replay (crash() must never
+        # advance _synced_seq and silently ack a non-durable write).
+        recovered = KVStore(data_dir=str(tmp_path))
+        try:
+            survived = {
+                o["metadata"]["name"]
+                for o in recovered.list("/registry/pods/")[0]
+            }
+            for kind, i in outcomes:
+                if kind == "ok":
+                    assert f"w{i}" in survived, (
+                        f"acked write w{i} lost across crash+replay"
+                    )
+        finally:
+            recovered.close()
+
+
+# ---------------------------------------------------------------------------
+# watch drop site + Reflector close-backoff
+# ---------------------------------------------------------------------------
+
+
+class TestWatchResilience:
+    def test_forced_drop_forces_relist_and_converges(self):
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        store = ThreadSafeStore()
+        # Every push drops the stream for a while: the reflector must
+        # ride its close-backoff + re-list path, then converge once the
+        # storm budget is spent.
+        faults.inject(faults.WATCH_DROP, every=1, times=6)
+        refl = Reflector(client, "pods", store).start()
+        try:
+            assert refl.wait_for_sync()
+            for i in range(5):
+                client.create("pods", pod_wire(f"d{i}"))
+            assert wait_until(lambda: len(store) == 5, timeout=30), (
+                f"store never converged: {len(store)} of 5 "
+                f"(drops fired: {faults.stats()})"
+            )
+        finally:
+            refl.stop()
+
+    def test_idle_close_backoff_does_not_tight_loop(self):
+        """Consecutive empty watch closes back off instead of
+        re-dialing instantly: with every push dropped, the number of
+        watch re-establishments in a window stays small."""
+        api = APIServer()
+        opened = []
+        real_watch = api.watch
+
+        def counting_watch(*a, **k):
+            opened.append(time.monotonic())
+            return real_watch(*a, **k)
+
+        api.watch = counting_watch
+        client = Client(LocalTransport(api))
+        store = ThreadSafeStore()
+        faults.inject(faults.WATCH_DROP, every=1)  # unbounded storm
+        refl = Reflector(client, "pods", store).start()
+        try:
+            assert refl.wait_for_sync()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 1.5:
+                client.create("pods", pod_wire(f"s{time.monotonic_ns()}"))
+                time.sleep(0.05)
+            dials = len([t for t in opened if t >= t0])
+            # Tight-looping re-dials hundreds of times in 1.5s; the
+            # backoff (50ms doubling to 2s, re-list past 3 closes)
+            # keeps it to a handful.
+            assert dials <= 20, f"{dials} watch dials in 1.5s"
+        finally:
+            refl.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport retries (the client-resilience satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestHTTPRetries:
+    @pytest.fixture
+    def http_cluster(self):
+        api = APIServer()
+        srv = APIHTTPServer(api).start()
+        yield api, srv
+        srv.stop()
+
+    def test_idempotent_get_retries_transient_5xx(self, http_cluster):
+        api, srv = http_cluster
+        client = Client(HTTPTransport(srv.address))
+        client.create("pods", pod_wire("r1"), namespace="default")
+        faults.inject(faults.HTTP_5XX, every=1, times=2)
+        pod = client.get("pods", "r1", namespace="default")  # 2 injected 503s, then success
+        assert pod.metadata.name == "r1"
+        assert faults.stats()[faults.HTTP_5XX.name]["fired"] == 2
+
+    def test_retry_budget_is_capped(self, http_cluster):
+        api, srv = http_cluster
+        client = Client(HTTPTransport(srv.address, max_retries=2))
+        faults.inject(faults.HTTP_5XX, every=1)
+        with pytest.raises(APIError) as ei:
+            client.get("pods", "whatever", namespace="default")
+        assert ei.value.code == 503
+        # 1 initial + 2 retries, then give up.
+        assert faults.stats()[faults.HTTP_5XX.name]["fired"] == 3
+
+    def test_connection_reset_retries_idempotent_only(self, http_cluster):
+        api, srv = http_cluster
+        client = Client(HTTPTransport(srv.address))
+        client.create("pods", pod_wire("r2"), namespace="default")
+        faults.inject(faults.HTTP_RESET, every=1, times=1)
+        assert client.get("pods", "r2", namespace="default").metadata.name == "r2"
+        # POST fails fast: a replayed create could double-apply.
+        faults.clear()
+        faults.inject(faults.HTTP_RESET, every=1, times=1)
+        with pytest.raises(ConnectionError):
+            client.create("pods", pod_wire("r3"), namespace="default")
+
+    def test_latency_site_delays_but_succeeds(self, http_cluster):
+        api, srv = http_cluster
+        client = Client(HTTPTransport(srv.address))
+        client.create("pods", pod_wire("r4"), namespace="default")
+        faults.inject(faults.HTTP_DELAY, every=1, times=3, delay_s=0.05)
+        t0 = time.monotonic()
+        assert client.get("pods", "r4", namespace="default").metadata.name == "r4"
+        assert time.monotonic() - t0 >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# kubelet heartbeat drop
+# ---------------------------------------------------------------------------
+
+
+class TestKubeletSites:
+    def test_heartbeat_drop_skips_beats_without_killing_the_loop(self):
+        from kubernetes_tpu.kubelet.agent import Kubelet
+        from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+        api = APIServer()
+        kubelet = Kubelet(
+            Client(LocalTransport(api)), node_name="hb-n0",
+            runtime=FakeRuntime(), heartbeat_period=0.2,
+        )
+        kubelet.register_node()
+        client = Client(LocalTransport(api))
+
+        def beat_stamp():
+            node = client.get("nodes", "hb-n0")
+            return node.status.conditions[0].last_heartbeat_time
+
+        kubelet._heartbeat()
+        before = beat_stamp()
+        faults.inject(faults.KUBELET_HEARTBEAT_DROP, every=1)
+        time.sleep(1.1)
+        kubelet._heartbeat()  # dropped: no write
+        assert beat_stamp() == before
+        faults.clear()
+        time.sleep(1.1)  # now_iso has second granularity
+        kubelet._heartbeat()
+        assert beat_stamp() != before
+
+
+# ---------------------------------------------------------------------------
+# the soak harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.soak
+class TestSoakHarness:
+    def test_schedule_is_deterministic(self):
+        from tools.soak import EPOCHS, build_schedule
+
+        a = build_schedule(42, n_nodes=200)
+        b = build_schedule(42, n_nodes=200)
+        assert a == b
+        assert [e["epoch"] for e in a] == list(EPOCHS)
+        # Every armed rule names a REGISTERED site.
+        for entry in a:
+            if "rule" in entry:
+                assert entry["rule"]["site"] in faults.SITES
+        with pytest.raises(ValueError, match="unknown epoch"):
+            build_schedule(1, epochs=["nope"])
+
+    def test_mini_soak_apiserver_crash_epoch(self):
+        """End-to-end miniature: hollow fleet + incremental daemon +
+        an apiserver kill -9 (torn WAL write, crash, replay) — zero
+        invariant violations, every wave pod bound, the mirror equal
+        to the store across the restart."""
+        from tools.soak import run_soak
+
+        artifact = run_soak(
+            n_nodes=6, seed=11,
+            epochs=["baseline", "apiserver_restart"],
+            fsync=False, verbose=False,
+        )
+        assert artifact["invariant_violations"] == [], artifact
+        assert artifact["restarts"]["apiserver"] == 1
+        assert artifact["pods_bound"] >= 64  # two 32-pod waves
+        assert artifact["bind_p99_s"] is not None
+        assert not faults.enabled()  # run_soak leaves the registry off

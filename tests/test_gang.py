@@ -653,3 +653,78 @@ def test_http_smoke_podgroup_binds_all_or_nothing():
         stream.close()
     finally:
         server.stop()
+
+
+@pytest.mark.chaos
+def test_gang_all_or_nothing_across_daemon_crash_restart():
+    """ISSUE 15: the incremental daemon dies between the gang solve and
+    its atomic commit (scheduler.commit.crash). At NO observable point
+    may a proper subset of the gang be bound, and the restarted daemon
+    — rebuilding its SolverSession from LIST+watch — must converge the
+    whole gang."""
+    from tests.test_microtick import kill_daemon
+    from kubernetes_tpu.utils import faults
+
+    faults.clear()
+    faults.reset_stats(reseed=0)
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    for j in range(4):
+        client.create("nodes", node_wire(f"n{j}", cpu="4"))
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    assert cfg.wait_for_sync(timeout=60)
+    sched = IncrementalBatchScheduler(cfg).start()
+    killed = False
+    try:
+        # Warm-up commit lands clean so the NEXT job is the gang's.
+        client.create("pods", pod_wire("warm"), namespace="default")
+        assert wait_until(
+            lambda: client.get(
+                "pods", "warm", namespace="default"
+            ).spec.node_name
+        )
+        rule = faults.inject(faults.SCHED_COMMIT_CRASH, every=1, times=1)
+        client.create("podgroups", pg_wire("gx", min_member=4))
+        members = [f"gx-m{i}" for i in range(4)]
+        for m in members:
+            client.create(
+                "pods", pod_wire(m, group="gx"), namespace="default"
+            )
+        assert wait_until(lambda: rule.fired > 0, timeout=30), (
+            "gang commit crash never fired"
+        )
+        faults.clear()
+
+        def bound_count():
+            pods, _ = client.list(
+                "pods", namespace="default",
+                label_selector=f"{POD_GROUP_LABEL}=gx",
+            )
+            return sum(1 for p in pods if p.spec.node_name)
+
+        # Mid-crash: the atomic commit never ran — nothing is bound,
+        # and every poll from here to convergence must see 0 or 4.
+        observed = set()
+        kill_daemon(sched, cfg)
+        killed = True
+        cfg = SchedulerConfig(
+            Client(LocalTransport(api)), raw_scheduled_cache=True
+        ).start()
+        assert cfg.wait_for_sync(timeout=60)
+        sched = IncrementalBatchScheduler(cfg).start()
+        killed = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            n = bound_count()
+            observed.add(n)
+            if n == 4:
+                break
+            time.sleep(0.05)
+        assert 4 in observed, "restarted daemon never bound the gang"
+        assert observed <= {0, 4}, (
+            f"gang observed half-bound across restart: {sorted(observed)}"
+        )
+    finally:
+        faults.clear()
+        if not killed:
+            sched.stop()

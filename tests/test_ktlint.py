@@ -451,7 +451,111 @@ class TestFramework:
         data = json.loads(proc.stdout)
         assert data["counts"]["KT004"] == 1
         assert data["findings"][0]["rule"] == "KT004"
-        assert set(data["rules"]) == {f"KT00{i}" for i in range(1, 8)}
+        assert set(data["rules"]) == {f"KT00{i}" for i in range(1, 9)}
+
+
+# -- KT008 fault-site constants ---------------------------------------
+
+
+class TestKT008:
+    def test_detects_string_literal_sites(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            from kubernetes_tpu.utils import faults
+
+            def f():
+                faults.fire("kvstore.wal.fsync")
+                faults.inject("watch.stream.drop", every=1)
+            """,
+            "KT008",
+        )
+        assert len(rep.findings) == 2
+        assert all("site constant" in f.message for f in rep.findings)
+
+    def test_detects_bare_imported_fire(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            from kubernetes_tpu.utils.faults import fire
+
+            def f():
+                fire("http.request.reset")
+            """,
+            "KT008",
+        )
+        assert len(rep.findings) == 1
+
+    def test_detects_dotted_paths_through_parent_imports(self, tmp_path):
+        """`utils.faults.fire(...)` and the fully dotted spelling are
+        the same forked-inventory hazard as `faults.fire(...)`."""
+        rep = lint_src(
+            tmp_path,
+            """\
+            import kubernetes_tpu.utils.faults
+            from kubernetes_tpu import utils
+
+            def f():
+                utils.faults.fire("kvstore.wal.fsnc")
+                kubernetes_tpu.utils.faults.inject("watch.stream.drop", p=1)
+            """,
+            "KT008",
+        )
+        assert len(rep.findings) == 2
+
+    def test_detects_out_of_module_site_minting(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            from kubernetes_tpu.utils.faults import FaultSite
+
+            AD_HOC = FaultSite("my.sneaky.site", "trip")
+            """,
+            "KT008",
+        )
+        assert len(rep.findings) == 1
+        assert "mints a fault site" in rep.findings[0].message
+
+    def test_constant_references_and_dynamic_sites_pass(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            from kubernetes_tpu.utils import faults
+
+            def f():
+                faults.fire(faults.WAL_FSYNC)
+                faults.inject(faults.WATCH_DROP, p=0.1)
+                for site in faults.SITES.values():
+                    faults.fire(site)
+            """,
+            "KT008",
+        )
+        assert rep.findings == []
+
+    def test_files_without_faults_import_are_skipped(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            def fire(x):  # unrelated local helper
+                return x
+
+            fire("not a fault site")
+            """,
+            "KT008",
+        )
+        assert rep.findings == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        rep = lint_src(
+            tmp_path,
+            """\
+            from kubernetes_tpu.utils import faults
+
+            faults.fire("x.y")  # ktlint: disable=KT008
+            """,
+            "KT008",
+        )
+        assert rep.findings == [] and len(rep.suppressed) == 1
 
 
 # -- the tier-1 gate ---------------------------------------------------

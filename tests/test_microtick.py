@@ -12,7 +12,10 @@ The always-resident incremental loop's contracts:
 - the session pre-warm compiles every pod bucket up front so a fresh
   bucket never stalls a live tick;
 - SolverSession.solve_async keeps host and device state consistent
-  while deltas land mid-flight.
+  while deltas land mid-flight;
+- a daemon killed between solve dispatch and commit (ISSUE 15 chaos
+  plane) restarts into a fresh session with no double-bind and its
+  nomination state recovered by re-solving.
 """
 
 import time
@@ -27,7 +30,16 @@ from kubernetes_tpu.scheduler.daemon import (
     SchedulerConfig,
 )
 from kubernetes_tpu.server.api import APIServer
-from kubernetes_tpu.utils import flightrecorder, sli
+from kubernetes_tpu.utils import faults, flightrecorder, sli
+
+
+def kill_daemon(sched, cfg) -> None:
+    """Abrupt daemon death: IncrementalBatchScheduler.kill() (the one
+    canonical crash shape, shared with tools/soak.py) + informer
+    teardown — no commit flush, exactly what a crashed process would
+    (not) do."""
+    sched.kill()
+    cfg.stop()
 
 
 def wait_until(cond, timeout=30.0, interval=0.02):
@@ -307,3 +319,127 @@ class TestSessionPipeline:
         assert h1.done(), "second dispatch must resolve the first tick"
         assert [k for k, _ in h1.result()] == ["default/p0"]
         assert [k for k, _ in h2.result()] == ["default/p1"]
+
+
+@pytest.mark.chaos
+class TestDaemonRestartInvariants:
+    """ISSUE 15: kill the incremental daemon between solve dispatch and
+    commit (the scheduler.commit.crash chaos site), restart it, and
+    assert the recovery contracts — no double-bind, nominations
+    recovered by re-solving."""
+
+    @pytest.fixture(autouse=True)
+    def _disarm(self):
+        faults.clear()
+        faults.reset_stats(reseed=0)
+        yield
+        faults.clear()
+
+    def test_commit_crash_restart_binds_once(self, api, client):
+        for j in range(4):
+            client.create("nodes", node_wire(f"n{j}"))
+        v0 = api.store.version
+        cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+        assert cfg.wait_for_sync()
+        sched = IncrementalBatchScheduler(cfg).start()
+        killed = False
+        try:
+            # Warm-up commit lands clean; the NEXT commit job dies.
+            client.create("pods", pod_wire("warm"), namespace="default")
+            assert wait_until(lambda: bound_node(client, "warm"))
+            rule = faults.inject(faults.SCHED_COMMIT_CRASH, every=1, times=1)
+            names = [f"crash-{i}" for i in range(6)]
+            for n in names:
+                client.create("pods", pod_wire(n), namespace="default")
+            assert wait_until(lambda: rule.fired > 0, timeout=30), (
+                "commit crash never fired"
+            )
+            faults.clear()
+            # The daemon "died" mid-commit: its session still charges
+            # pods that never bound. Kill it abruptly and restart.
+            kill_daemon(sched, cfg)
+            killed = True
+            cfg = SchedulerConfig(
+                Client(LocalTransport(api)), raw_scheduled_cache=True
+            ).start()
+            assert cfg.wait_for_sync()
+            sched = IncrementalBatchScheduler(cfg).start()
+            killed = False
+            assert wait_until(
+                lambda: all(bound_node(client, n) for n in names),
+                timeout=60,
+            ), "restarted daemon never drained the crashed tick's pods"
+            # No double-bind: replay the full watch history — each pod
+            # must carry exactly ONE distinct non-empty nodeName, ever.
+            nodes_seen = {}
+            stream = client.watch("pods", namespace="default", since=v0)
+            while True:
+                ev = stream.next(timeout=0.5)
+                if ev is None:
+                    break
+                obj = ev.object
+                name = obj.get("metadata", {}).get("name", "")
+                node = obj.get("spec", {}).get("nodeName", "")
+                if node:
+                    nodes_seen.setdefault(name, set()).add(node)
+            stream.close()
+            for n in names + ["warm"]:
+                assert len(nodes_seen.get(n, set())) == 1, (
+                    f"{n} observed bound to {nodes_seen.get(n)}"
+                )
+        finally:
+            if not killed:
+                sched.stop()
+
+    def test_nomination_recovered_across_restart(self, api):
+        """Kill the daemon right after it nominates a preemptor (its
+        in-memory nomination table dies with it); the fresh daemon must
+        still get the preemptor bound — recovery is re-solving, not
+        remembering."""
+        from kubernetes_tpu.kubelet.agent import Kubelet
+        from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+        client = Client(LocalTransport(api))
+        client.create("nodes", node_wire("solo", cpu="1"))
+        kl = Kubelet(
+            Client(LocalTransport(api)), "solo", cpu="1",
+            sync_period=0.2, heartbeat_period=30, runtime=FakeRuntime(),
+        ).start()
+        cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+        assert cfg.wait_for_sync()
+        sched = IncrementalBatchScheduler(
+            cfg, eviction_grace_seconds=1
+        ).start()
+        killed = False
+        try:
+            hog = pod_wire("hog", cpu="900m")
+            client.create("pods", hog, namespace="default")
+            assert wait_until(lambda: bound_node(client, "hog"))
+            hi = pod_wire("hi-prio", cpu="900m")
+            hi["spec"]["priority"] = 100
+            client.create("pods", hi, namespace="default")
+
+            def nominated():
+                p = client.get("pods", "hi-prio", namespace="default")
+                return p.status.nominated_node_name == "solo"
+
+            assert wait_until(nominated, timeout=30), (
+                "preemptor never nominated"
+            )
+            kill_daemon(sched, cfg)
+            killed = True
+            cfg = SchedulerConfig(
+                Client(LocalTransport(api)), raw_scheduled_cache=True
+            ).start()
+            assert cfg.wait_for_sync()
+            sched = IncrementalBatchScheduler(
+                cfg, eviction_grace_seconds=1
+            ).start()
+            killed = False
+            assert wait_until(
+                lambda: bound_node(client, "hi-prio") == "solo", timeout=60
+            ), "nominated preemptor never bound after daemon restart"
+        finally:
+            if not killed:
+                sched.stop()
+            kl.stop()

@@ -336,6 +336,180 @@ class TestGroupCommitDurability:
             re_store.close()
 
 
+@pytest.mark.chaos
+class TestCrashRecoveryProperty:
+    """ISSUE 15: randomized write/snapshot/crash schedules, crashing
+    via injected faults at every WAL/snapshot boundary, asserting the
+    replayed state equals the pre-crash committed prefix.
+
+    The oracle is exact, not fuzzy, because each fault kind has a
+    deterministic durability verdict for the op it kills:
+
+    - ``torn_write``: the record is PARTIAL on disk (no newline) and
+      the write unacked — recovery truncates it away, so the op is
+      absent (the key keeps its pre-op value);
+    - ``wal_fsync``: the record was appended+flushed, only the
+      durability ack was refused — in-process (shared page cache) the
+      op survives the crash;
+    - ``snapshot_rename``: fires AFTER the triggering op's record was
+      appended — the op survives on the previous snapshot + full WAL;
+    - plain crash between acked ops: every acked op survives.
+    """
+
+    KEYS = [f"/registry/pods/default/p{i}" for i in range(10)]
+
+    def _apply_model(self, model, op, key, val):
+        if op == "delete":
+            model.pop(key, None)
+        else:
+            model[key] = val
+
+    def _run_schedule(self, base_dir, seed):
+        import random
+
+        from kubernetes_tpu.utils import faults
+
+        rng = random.Random(seed)
+        data_dir = os.path.join(str(base_dir), f"sched-{seed}")
+        store = KVStore(
+            data_dir=data_dir,
+            snapshot_every=rng.choice([3, 7, 100000]),
+        )
+        fault_kind = rng.choice(
+            ["torn_write", "wal_fsync", "snapshot_rename", "none"]
+        )
+        n_ops = rng.randrange(20, 45)
+        crash_at = rng.randrange(4, n_ops)
+        model = {}
+        serial = 0
+        crashed_op = None  # (op, key, value) the fault interrupted
+        try:
+            for i in range(n_ops):
+                key = rng.choice(self.KEYS)
+                if key in model:
+                    op = rng.choice(["set", "delete", "snapshot"])
+                else:
+                    op = "create"
+                serial += 1
+                val = pod_wire(f"v{serial}", labels={"serial": str(serial)})
+                if i == crash_at and fault_kind != "none":
+                    site = {
+                        "torn_write": faults.WAL_TORN_WRITE,
+                        "wal_fsync": faults.WAL_FSYNC,
+                        "snapshot_rename": faults.SNAPSHOT_RENAME,
+                    }[fault_kind]
+                    faults.inject(site, every=1, times=1)
+                try:
+                    if op == "create":
+                        store.create(key, val)
+                    elif op == "set":
+                        store.set(key, val)
+                    elif op == "delete":
+                        store.delete(key)
+                    else:
+                        store.snapshot()
+                        continue  # no object mutation to model
+                except faults.FaultInjected:
+                    crashed_op = (op, key, val)
+                    break  # the process "dies" here
+                self._apply_model(model, op, key, val)
+                if i == crash_at:
+                    break  # plain crash after an acked op (or the
+                    # armed fault's boundary wasn't crossed: a
+                    # snapshot op appends no WAL record)
+        finally:
+            faults.clear()
+            store.crash()
+        recovered = KVStore(data_dir=data_dir)
+        try:
+            # Exact oracle: read back every schedule key and compare
+            # against the committed prefix (values carry a serial).
+            committed = {
+                k: v["metadata"]["labels"]["serial"] for k, v in model.items()
+            }
+            # The key the fault interrupted gets its own deterministic
+            # verdict below; "snapshot" ops touched no key.
+            exempt_key = None
+            if crashed_op is not None and crashed_op[0] != "snapshot":
+                exempt_key = crashed_op[1]
+            for k in self.KEYS:
+                if k == exempt_key:
+                    continue
+                if k in committed:
+                    obj = recovered.get(k)
+                    assert (
+                        obj["metadata"]["labels"]["serial"] == committed[k]
+                    ), (
+                        f"seed {seed} ({fault_kind}): {k} replayed "
+                        f"serial {obj['metadata']['labels']['serial']}, "
+                        f"committed prefix says {committed[k]}"
+                    )
+                else:
+                    try:
+                        recovered.get(k)
+                    except Exception:
+                        continue  # absent, as committed prefix says
+                    raise AssertionError(
+                        f"seed {seed} ({fault_kind}): {k} replayed but "
+                        "is not in the committed prefix"
+                    )
+            if crashed_op is not None and crashed_op[0] != "snapshot":
+                op, k, val = crashed_op
+                want_serial = val["metadata"]["labels"]["serial"]
+
+                def lookup():
+                    try:
+                        return recovered.get(k)
+                    except Exception:
+                        return None
+
+                obj = lookup()
+                if fault_kind == "torn_write":
+                    # Torn record truncated on replay: the key holds
+                    # its pre-op committed value (or nothing).
+                    if k in committed:
+                        assert obj is not None and (
+                            obj["metadata"]["labels"]["serial"]
+                            == committed[k]
+                        ), f"seed {seed}: torn write corrupted {k}"
+                    else:
+                        assert obj is None or (
+                            obj["metadata"]["labels"]["serial"]
+                            != want_serial
+                        ), f"seed {seed}: torn write survived replay"
+                else:
+                    # wal_fsync / snapshot_rename fire AFTER the op's
+                    # record was appended+flushed: the op survives.
+                    if op == "delete":
+                        assert obj is None, (
+                            f"seed {seed} ({fault_kind}): flushed "
+                            "delete lost on replay"
+                        )
+                    else:
+                        assert obj is not None and (
+                            obj["metadata"]["labels"]["serial"]
+                            == want_serial
+                        ), (
+                            f"seed {seed} ({fault_kind}): flushed "
+                            "record lost on replay"
+                        )
+            # The version clock recovered intact: a new write bumps
+            # PAST everything replayed.
+            v_before = recovered.version
+            stored = recovered.create(
+                "/registry/pods/default/post", pod_wire("post")
+            )
+            assert int(stored["metadata"]["resourceVersion"]) > v_before
+        finally:
+            recovered.close()
+
+    def test_randomized_crash_schedules_replay_committed_prefix(
+        self, tmp_path
+    ):
+        for seed in range(12):
+            self._run_schedule(tmp_path, seed)
+
+
 class TestNoStoreScanSteadyState:
     def test_soak_tick_issues_no_store_level_lists(self):
         """The acceptance criterion: controllers, the batch daemon, and

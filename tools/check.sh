@@ -595,4 +595,22 @@ prof_rc=$?
 if [ $rc -eq 0 ]; then
     rc=$prof_rc
 fi
+
+# Soak smoke (ISSUE 15): ~200 hollow nodes (real kubelets, no-op
+# runtime) driving the full API→solve→bind→kubelet loop while the
+# seeded chaos schedule fires ONE apiserver kill -9 (torn WAL write →
+# crash → snapshot+WAL replay) and ONE abrupt scheduler-daemon kill
+# mid-gang (fresh daemon rebuilds its SolverSession from LIST+watch).
+# Gate: the invariant checker comes back green — replay consistency,
+# bind immutability, gang all-or-nothing, exactly-one-DELETED,
+# nominations recovered, SLO series advancing. Artifact in
+# /tmp/soak_smoke.json for dashboards.
+echo "== soak smoke (chaos plane, ~60s) =="
+env JAX_PLATFORMS=cpu python -m tools.soak --nodes 200 --seed 7 \
+    --epochs baseline,apiserver_restart,daemon_restart_mid_gang,final \
+    --out /tmp/soak_smoke.json
+soak_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$soak_rc  # invariant violations (exit 1) must fail CI
+fi
 exit $rc

@@ -17,6 +17,9 @@ KT006    parity: jitted ops kernels need a registered NumPy oracle
 KT007    kernel recompilation hazards: host round-trips in trace-time
          helpers, raw-cardinality device-array dims, dtype-unpinned
          literal arrays (scope: kubernetes_tpu/ops/)
+KT008    fault-injection sites are registered named constants
+         (utils/faults.py inventory); no string literals at
+         fire()/inject(), no site minting outside the registry
 =======  ==============================================================
 
 The interprocedural lock analysis (lock-order cycles KTSAN01, the
@@ -55,6 +58,7 @@ from tools.ktlint.rules_io import BoundedIORule
 from tools.ktlint.rules_metrics import MetricNamingRule
 from tools.ktlint.rules_parity import OracleTwinRule
 from tools.ktlint.rules_shape import ShapeHazardRule
+from tools.ktlint.rules_faults import FaultSiteRule
 from tools.ktlint.lockgraph import (  # noqa: F401  (public API)
     LockGraphReport,
     analyze as lock_graph,
@@ -69,6 +73,7 @@ ALL_RULES = (
     MetricNamingRule(),
     OracleTwinRule(),
     ShapeHazardRule(),
+    FaultSiteRule(),
 )
 
 

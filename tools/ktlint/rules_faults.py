@@ -1,0 +1,126 @@
+"""KT008 — fault-injection sites must be registered named constants.
+
+The chaos plane (kubernetes_tpu/utils/faults.py) keys everything on
+site identity: the soak schedule arms rules by site, the artifact
+reports per-site counters, and reviewers audit the blast radius by
+reading ONE inventory. A stringly-typed call — ``faults.fire(
+"kvstore.wal.fsync")`` — silently forks that inventory: a typo'd name
+never fires, never shows in stats, and the "tested under faults" claim
+quietly becomes false. Same discipline as the sanitizer's factory lock
+names (KT002 recognizes those for the same reason).
+
+Checked shapes:
+
+- ``faults.fire(...)`` / ``faults.inject(...)`` (or bare ``fire``/
+  ``inject`` imported from the faults module) whose first argument is
+  a string/constant literal instead of a site reference;
+- minting sites — ``faults.FaultSite(...)`` / the module's ``_site``
+  helper — anywhere outside ``kubernetes_tpu/utils/faults.py``: ad-hoc
+  sites bypass the audited inventory.
+
+A dynamic site variable (``fire(site)`` in a loop over the registry)
+is fine — the rule only rejects literals and out-of-module minting.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+
+_FAULTS_MODULE = "kubernetes_tpu.utils.faults"
+_FAULTS_FILE = "kubernetes_tpu/utils/faults.py"
+_CALLS = ("fire", "inject")
+_MINTERS = ("FaultSite", "_site")
+
+
+class FaultSiteRule(Rule):
+    id = "KT008"
+    title = "fault-injection sites must be registered named constants"
+
+    @staticmethod
+    def _alias_map(tree: ast.Module) -> dict:
+        """Name -> the dotted module path it refers to, for every
+        import that could reach the faults module: ``faults`` (or an
+        asname), ``utils`` from ``from kubernetes_tpu import utils``,
+        ``kubernetes_tpu`` from a plain dotted import, and members
+        imported straight from the faults module (``fire``, ...)."""
+        aliases: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _FAULTS_MODULE or _FAULTS_MODULE.startswith(
+                        alias.name + "."
+                    ):
+                        if alias.asname:
+                            aliases[alias.asname] = alias.name
+                        else:
+                            # `import a.b.c` binds the top-level `a`;
+                            # usage spells the full dotted path.
+                            top = alias.name.split(".", 1)[0]
+                            aliases[top] = top
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    full = f"{node.module}.{alias.name}"
+                    if full == _FAULTS_MODULE or _FAULTS_MODULE.startswith(
+                        full + "."
+                    ) or full.startswith(_FAULTS_MODULE + "."):
+                        aliases[alias.asname or alias.name] = full
+        return aliases
+
+    @staticmethod
+    def _resolve(chain: List[str], aliases: dict) -> str:
+        """The dotted path a chain like ['utils','faults','fire']
+        refers to, with its head substituted through the alias map;
+        '' when the head isn't a tracked import."""
+        head = aliases.get(chain[0])
+        if head is None:
+            return ""
+        return ".".join([head] + chain[1:])
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        aliases = self._alias_map(ctx.tree)
+        if not aliases and _FAULTS_FILE not in ctx.relpath:
+            return out
+        in_faults_module = ctx.relpath.endswith(_FAULTS_FILE)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            name = chain[-1]
+            resolved = self._resolve(chain, aliases)
+            is_faults_call = resolved == f"{_FAULTS_MODULE}.{name}"
+            if name in _CALLS and is_faults_call and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    out.append(
+                        ctx.finding(
+                            self.id, node,
+                            f"{name}() takes a registered site constant "
+                            f"(faults.WAL_FSYNC, ...), not the string "
+                            f"literal {first.value!r} — stringly-typed "
+                            "sites fork the audited inventory",
+                        )
+                    )
+            if (
+                name in _MINTERS
+                and not in_faults_module
+                and is_faults_call
+            ):
+                # FaultSite(...)/_site(...) outside the registry module
+                # mints an unaudited ad-hoc site.
+                out.append(
+                    ctx.finding(
+                        self.id, node,
+                        f"{name}() mints a fault site outside "
+                        f"{_FAULTS_FILE}; add it to the registry's "
+                        "inventory instead",
+                    )
+                )
+        return out

@@ -1,0 +1,1117 @@
+"""Hollow-node soak harness: the full API→solve→bind→kubelet loop under
+a deterministic fault schedule.
+
+Reference shape: kubemark hollow nodes (real kubelets over a no-op
+container runtime) driving the real control plane, crossed with a
+Jepsen-style seeded fault schedule. Everything here is REAL code under
+test — the durable kvstore (WAL + snapshots on a data dir), the
+apiserver with its watch cache, the incremental micro-tick scheduler,
+and a fleet of genuine ``Kubelet`` agents with ``FakeRuntime`` — only
+the containers are hollow. Faults come from the registered sites in
+``kubernetes_tpu/utils/faults.py`` plus two process-level moves the
+registry can't express: an apiserver "kill -9" (``KVStore.crash()`` +
+replay into a fresh store/APIServer on the same data dir) and an abrupt
+scheduler-daemon kill (queued commits dropped, no flush — the fresh
+daemon must rebuild its SolverSession from LIST+watch and converge).
+
+After every fault epoch an invariant checker asserts the contracts the
+test suite defines on the happy path:
+
+- **replay consistency**: kvstore LIST == the watch-derived mirror
+  (no pod lost or duplicated across WAL replay / re-lists);
+- **bind immutability**: a pod's nodeName never changes once set
+  (no double-bind across daemon restarts — the server-side bind guard,
+  observed end to end);
+- **gang all-or-nothing**: no PodGroup sits half-bound;
+- **exactly-one-DELETED**: no (key, uid) ever sees two DELETED events;
+- **nominations recovered or expired**: preemptors holding a
+  nomination eventually bind (or their nomination ages out and they
+  re-solve) — including across a daemon restart that lost the
+  nomination table;
+- **SLO series advancing**: the lifecycle SLI milestones
+  (decision/bound/running) kept counting through every fault.
+
+Determinism: the fault *schedule* — epoch order, armed rule parameters,
+wave sizes — is a pure function of ``--seed`` (``build_schedule``), and
+each fault site fires on a per-site seeded sequence (utils/faults.py),
+so a rerun with the same seed arms the same timeline. The artifact
+records both the schedule and the realized per-site firing log.
+
+Usage::
+
+    python -m tools.soak --nodes 1000 --seed 7            # full default schedule
+    python -m tools.soak --nodes 200 --seed 7 \
+        --epochs baseline,apiserver_restart,daemon_restart_mid_gang   # CI smoke
+
+Exit status 0 iff the run completed with ZERO invariant violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue as _queue
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.client.cache import Reflector, ThreadSafeStore
+from kubernetes_tpu.client.rest import Transport
+from kubernetes_tpu.kubelet.agent import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+from kubernetes_tpu.scheduler.daemon import (
+    IncrementalBatchScheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.server.api import APIError, APIServer
+from kubernetes_tpu.store.kvstore import KVStore
+from kubernetes_tpu.utils import faults, sli, tracing
+
+#: Epoch registry order — the full default schedule. build_schedule
+#: derives per-epoch parameters from the seed; the order is fixed so
+#: early epochs warm the cluster the later ones stress.
+EPOCHS = (
+    "baseline",
+    "watch_drops",
+    "wal_fsync",
+    "apiserver_restart",
+    "daemon_restart_mid_gang",
+    "preemption_storm",
+    "final",
+)
+
+
+# -- wire helpers (mirror objects arrive typed from LIST, wire dicts
+# -- from the watch stream; both shapes answer the same questions) -----
+
+
+def _meta(obj) -> Tuple[str, str, str]:
+    """(namespace-or-default, name, uid) over wire dicts or typed pods."""
+    if isinstance(obj, dict):
+        m = obj.get("metadata", {})
+        return m.get("namespace") or "default", m.get("name", ""), m.get("uid", "")
+    m = obj.metadata
+    return m.namespace or "default", m.name, m.uid
+
+
+def _pod_key(obj) -> str:
+    ns, name, _ = _meta(obj)
+    return f"{ns}/{name}"
+
+
+def _node_of(obj) -> str:
+    if isinstance(obj, dict):
+        return obj.get("spec", {}).get("nodeName", "") or ""
+    return obj.spec.node_name or ""
+
+
+def _terminating(obj) -> bool:
+    if isinstance(obj, dict):
+        return bool(obj.get("metadata", {}).get("deletionTimestamp"))
+    return bool(obj.metadata.deletion_timestamp)
+
+
+def _nominated(obj) -> str:
+    if isinstance(obj, dict):
+        return obj.get("status", {}).get("nominatedNodeName", "") or ""
+    return getattr(obj.status, "nominated_node_name", "") or ""
+
+
+def _pod_wire(name, cpu="100m", mem="64Mi", group="", priority=None) -> dict:
+    labels = {POD_GROUP_LABEL: group} if group else {}
+    spec: dict = {
+        "containers": [
+            {"name": "c", "image": "hollow",
+             "resources": {"limits": {"cpu": cpu, "memory": mem}}}
+        ]
+    }
+    if priority is not None:
+        spec["priority"] = priority
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "labels": labels},
+        "spec": spec,
+    }
+
+
+def _pg_wire(name, min_member) -> dict:
+    return {
+        "kind": "PodGroup",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"minMember": min_member},
+    }
+
+
+def _wait_until(cond, timeout, interval=0.1) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- restartable in-process control plane ------------------------------
+
+
+class RestartableTransport(Transport):
+    """LocalTransport against a SWAPPABLE apiserver: the soak cluster
+    replaces its APIServer across a simulated crash, and every client
+    in the process follows the swap. While the "process" is down,
+    requests fail with 503 — the same transient failure an HTTP client
+    would see — so components exercise their retry/re-list paths."""
+
+    def __init__(self, cluster: "SoakCluster"):
+        self._cluster = cluster
+
+    def _api(self) -> APIServer:
+        api = self._cluster.api
+        if api is None:
+            raise APIError(
+                503, "ServiceUnavailable", "apiserver restarting (soak)"
+            )
+        return api
+
+    def request(self, verb, op, args, body=None, patch_type=None):
+        api = self._api()
+        with tracing.span(f"api.{op}"):
+            fn = getattr(api, op)
+            if patch_type is not None:
+                return fn(*args, body, patch_type=patch_type)
+            if body is not None:
+                return fn(*args, body)
+            return fn(*args)
+
+    def watch(self, resource, namespace, since, lsel, fsel):
+        return self._api().watch(
+            resource, namespace, since=since,
+            label_selector=lsel, field_selector=fsel,
+        )
+
+
+class SoakCluster:
+    """Durable store + apiserver + incremental scheduler + hollow-node
+    fleet, all in-process, with crash/restart controls."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        data_dir: str,
+        heartbeat_period: float = 20.0,
+        sync_period: float = 3.0,
+        max_batch: int = 4096,
+        fsync: bool = True,
+    ):
+        self.n_nodes = n_nodes
+        self.data_dir = data_dir
+        self.heartbeat_period = heartbeat_period
+        self.sync_period = sync_period
+        self.max_batch = max_batch
+        self.fsync = fsync
+        self.api: Optional[APIServer] = None
+        self.store: Optional[KVStore] = None
+        self.transport = RestartableTransport(self)
+        self.kubelets: List[Kubelet] = []
+        self.scheduler: Optional[IncrementalBatchScheduler] = None
+        self.scheduler_config: Optional[SchedulerConfig] = None
+        self.restarts = {"apiserver": 0, "scheduler": 0}
+
+    def client(self) -> Client:
+        return Client(self.transport)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _build_store(self) -> KVStore:
+        # serialized_writes: the 1000-kubelet thread herd is exactly
+        # the shape the single hot applier exists for.
+        return KVStore(
+            data_dir=self.data_dir,
+            serialized_writes=True,
+            fsync=self.fsync,
+            snapshot_every=8192,
+        )
+
+    def start(self) -> "SoakCluster":
+        self.store = self._build_store()
+        self.api = APIServer(store=self.store)
+        self._build_scheduler()
+        # Hollow fleet: REAL kubelets, no containers. Registration +
+        # informer sync ride a small pool so a 1000-node fleet comes
+        # up in seconds, not serially.
+        self.kubelets = [
+            Kubelet(
+                self.client(),
+                node_name=f"hn-{i}",
+                runtime=FakeRuntime(),
+                heartbeat_period=self.heartbeat_period,
+                sync_period=self.sync_period,
+            )
+            for i in range(self.n_nodes)
+        ]
+        work: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        for k in self.kubelets:
+            work.put(k)
+        errors: List[str] = []
+
+        def starter():
+            while True:
+                try:
+                    k = work.get_nowait()
+                except _queue.Empty:
+                    return
+                try:
+                    k.start()
+                except Exception as e:  # noqa: BLE001 - collected below
+                    errors.append(f"{k.node_name}: {e!r}")
+
+        threads = [
+            threading.Thread(target=starter, daemon=True)
+            for _ in range(min(16, max(2, self.n_nodes // 8)))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"hollow fleet start failed: {errors[:3]}")
+        return self
+
+    def _build_scheduler(self) -> None:
+        cfg = SchedulerConfig(self.client(), raw_scheduled_cache=True)
+        cfg.start()
+        if not cfg.wait_for_sync(timeout=60):
+            raise RuntimeError("scheduler caches never synced")
+        self.scheduler_config = cfg
+        self.scheduler = IncrementalBatchScheduler(
+            cfg, max_batch=self.max_batch
+        ).start()
+
+    # -- chaos controls ------------------------------------------------
+
+    def restart_apiserver(self) -> None:
+        """kill -9 the apiserver: crash the store (no final fsync, no
+        graceful watcher drain beyond closing streams — queued writers
+        fail with StoreClosedError), then recover a fresh store from
+        the SAME data dir (snapshot + WAL replay, version clock intact)
+        and swap in a new APIServer. Every informer in the process
+        re-lists through the transport's 503 window."""
+        self.restarts["apiserver"] += 1
+        old, self.api = self.store, None
+        try:
+            if old is not None:
+                old.crash()
+            self.store = self._build_store()
+            self.api = APIServer(store=self.store)
+        except Exception:
+            self.api = None
+            raise
+
+    def restart_scheduler(self) -> None:
+        """Abrupt daemon kill: stop the solve loop, DROP queued commit
+        jobs unexecuted (a dead process commits nothing), abandon the
+        in-flight solve, then boot a fresh daemon whose SolverSession
+        rebuilds from LIST+watch. Pods whose commits died stay Pending
+        until the new daemon's informers feed them back in."""
+        self.restarts["scheduler"] += 1
+        sched, cfg = self.scheduler, self.scheduler_config
+        self.scheduler = None
+        self.scheduler_config = None
+        if sched is not None:
+            # stop() would flush queued commits — a crash doesn't.
+            sched.kill()
+        if cfg is not None:
+            try:
+                cfg.stop()
+            except Exception:
+                pass
+        self._build_scheduler()
+
+    def stop(self) -> None:
+        for k in self.kubelets:
+            try:
+                k.stop()
+            except Exception:
+                pass
+        sched, self.scheduler = self.scheduler, None
+        if sched is not None:
+            try:
+                sched.stop()
+            except Exception:
+                pass
+        cfg, self.scheduler_config = self.scheduler_config, None
+        if cfg is not None:
+            try:
+                cfg.stop()
+            except Exception:
+                pass
+        store, self.store = self.store, None
+        self.api = None
+        if store is not None:
+            store.close()
+
+
+# -- watch-derived mirror + event invariants ---------------------------
+
+
+class WatchMirror:
+    """A second, independent consumer of the pods watch: a Reflector-fed
+    mirror whose contents the checker compares against authoritative
+    LISTs, plus per-event invariants caught AS THEY HAPPEN — duplicate
+    DELETED per (key, uid) and a bound pod's nodeName changing."""
+
+    def __init__(self, client: Client):
+        self.store = ThreadSafeStore(key_func=_pod_key)
+        self.violations: List[dict] = []
+        self._deleted: Dict[Tuple[str, str], int] = {}
+        self._node_of: Dict[str, str] = {}  # uid -> first bound node
+        self._lock = threading.Lock()
+        self.reflector = Reflector(
+            client, "pods", self.store, on_event=self._on_event
+        )
+
+    def start(self) -> "WatchMirror":
+        self.reflector.start()
+        return self
+
+    def stop(self) -> None:
+        self.reflector.stop()
+
+    def _on_event(self, etype: str, obj) -> None:
+        key = _pod_key(obj)
+        _ns, _name, uid = _meta(obj)
+        if etype == "DELETED":
+            if not uid:
+                return
+            with self._lock:
+                n = self._deleted.get((key, uid), 0) + 1
+                self._deleted[(key, uid)] = n
+                self._node_of.pop(uid, None)
+            if n > 1:
+                self.violations.append({
+                    "invariant": "exactly_one_deleted",
+                    "detail": f"{key} (uid {uid}) saw DELETED x{n}",
+                })
+            return
+        node = _node_of(obj)
+        if not node or not uid:
+            return
+        with self._lock:
+            prev = self._node_of.get(uid)
+            if prev is None:
+                self._node_of[uid] = node
+        if prev is not None and prev != node:
+            self.violations.append({
+                "invariant": "bind_immutable",
+                "detail": f"{key} (uid {uid}) rebound {prev} -> {node}",
+            })
+
+    def bound_node(self, key: str) -> str:
+        obj = self.store.get(key)
+        return _node_of(obj) if obj is not None else ""
+
+    def has(self, key: str) -> bool:
+        return self.store.get(key) is not None
+
+    def snapshot(self) -> Dict[str, Tuple[str, str]]:
+        """key -> (uid, node) for every pod the mirror holds."""
+        out = {}
+        for obj in self.store.list():
+            ns, name, uid = _meta(obj)
+            out[f"{ns}/{name}"] = (uid, _node_of(obj))
+        return out
+
+
+# -- invariant checker -------------------------------------------------
+
+
+class InvariantChecker:
+    def __init__(self, cluster: SoakCluster, mirror: WatchMirror):
+        self.cluster = cluster
+        self.mirror = mirror
+        self.violations: List[dict] = []
+        self._sli_start = self._sli_counts()
+        self._sli_prev = dict(self._sli_start)
+
+    @staticmethod
+    def _sli_counts() -> Dict[str, int]:
+        return {
+            m: sli.STARTUP_LATENCY.count(milestone=m)
+            for m in ("decision", "bound", "running")
+        }
+
+    def _viol(self, epoch: str, invariant: str, detail: str) -> None:
+        self.violations.append(
+            {"epoch": epoch, "invariant": invariant, "detail": detail}
+        )
+
+    def _list_pods(self, client: Client):
+        pods, version = client.list("pods", namespace="default")
+        return pods, version
+
+    def quiesce(self, client: Client, timeout: float = 60.0) -> bool:
+        """Wait until the scheduler has no backlog or in-flight tick.
+        (Mirror freshness is NOT waited on here — the pods watch only
+        advances on pod events while heartbeats bump the store version
+        forever; the consistency check below retries on content.)"""
+        def settled():
+            sched = self.cluster.scheduler
+            if sched is None or self.cluster.store is None:
+                return False
+            return not len(sched.config.pod_queue) and sched._inflight is None
+
+        return _wait_until(settled, timeout, interval=0.2)
+
+    def check(self, epoch: str, client: Client) -> None:
+        """Run every invariant; append violations (never raises)."""
+        self.quiesce(client)
+        # Event-stream invariants detected live by the mirror.
+        while self.mirror.violations:
+            v = self.mirror.violations.pop(0)
+            self._viol(epoch, v["invariant"], v["detail"])
+        self._check_store_vs_mirror(epoch, client)
+        self._check_gangs(epoch, client)
+        self._check_nominations(epoch, client)
+        self._check_slo_epoch(epoch)
+
+    def _check_slo_epoch(self, epoch: str) -> None:
+        """Every SLI milestone series must advance across EVERY epoch
+        (each epoch binds a fresh wave, so new decision/bound/running
+        observations are owed). The kubelet's running stamp is
+        watch-driven and may trail the last bind by a sync beat —
+        wait, then flag."""
+        prev = self._sli_prev
+        last = [prev]
+
+        def advanced():
+            now = self._sli_counts()
+            last[0] = now
+            return all(now[m] > prev[m] for m in now)
+
+        if not _wait_until(advanced, timeout=30.0, interval=0.5):
+            stalled = [m for m in last[0] if last[0][m] <= prev[m]]
+            self._viol(
+                epoch, "slo_series_advancing",
+                f"milestones stalled across the epoch: {stalled} "
+                f"(prev={prev}, now={last[0]})",
+            )
+        self._sli_prev = last[0]
+
+    def _check_store_vs_mirror(self, epoch: str, client: Client) -> None:
+        """kvstore LIST == watch-derived mirror (retrying while the
+        watch catches up): no pod lost or duplicated across replay."""
+        last_diff = [""]
+
+        def consistent():
+            try:
+                pods, _v = self._list_pods(client)
+            except Exception as e:  # mid-restart: retry
+                last_diff[0] = f"LIST failed: {e!r}"
+                return False
+            truth = {}
+            for p in pods:
+                ns, name, uid = _meta(p)
+                truth[f"{ns}/{name}"] = (uid, _node_of(p))
+            mirror = self.mirror.snapshot()
+            if truth == mirror:
+                return True
+            missing = sorted(set(truth) - set(mirror))[:3]
+            extra = sorted(set(mirror) - set(truth))[:3]
+            drift = sorted(
+                k for k in set(truth) & set(mirror) if truth[k] != mirror[k]
+            )[:3]
+            last_diff[0] = (
+                f"missing_from_mirror={missing} phantom_in_mirror={extra} "
+                f"drift={drift} (|store|={len(truth)} |mirror|={len(mirror)})"
+            )
+            return False
+
+        if not _wait_until(consistent, timeout=30.0, interval=0.5):
+            self._viol(epoch, "replay_consistency", last_diff[0])
+
+    def _check_gangs(self, epoch: str, client: Client) -> None:
+        """No PodGroup may SETTLE half-bound: fewer than minMember
+        members bound while others sit Pending."""
+        def no_half_bound():
+            try:
+                groups, _ = client.list("podgroups", namespace="default")
+                pods, _ = self._list_pods(client)
+            except Exception:
+                return False
+            members: Dict[str, List] = {}
+            for p in pods:
+                if isinstance(p, dict):
+                    g = p.get("metadata", {}).get("labels", {}).get(
+                        POD_GROUP_LABEL, ""
+                    )
+                else:
+                    g = (p.metadata.labels or {}).get(POD_GROUP_LABEL, "")
+                if g:
+                    members.setdefault(g, []).append(p)
+            for pg in groups:
+                name = pg.metadata.name
+                mem = members.get(name, [])
+                live = [p for p in mem if not _terminating(p)]
+                bound = [p for p in live if _node_of(p)]
+                pending = [p for p in live if not _node_of(p)]
+                if bound and pending and len(bound) < pg.spec.min_member:
+                    self._last_gang = (
+                        f"gang {name}: {len(bound)} bound < minMember "
+                        f"{pg.spec.min_member} with {len(pending)} pending"
+                    )
+                    return False
+            return True
+
+        self._last_gang = ""
+        if not _wait_until(no_half_bound, timeout=45.0, interval=0.5):
+            self._viol(epoch, "gang_all_or_nothing", self._last_gang)
+
+    def _check_nominations(self, epoch: str, client: Client) -> None:
+        """Every pod holding a nomination either binds or sheds it
+        (expiry + re-solve) — including across daemon restarts that
+        lost the in-memory nomination table."""
+        def resolved():
+            try:
+                pods, _ = self._list_pods(client)
+            except Exception:
+                return False
+            stuck = [
+                _pod_key(p) for p in pods
+                if _nominated(p) and not _node_of(p) and not _terminating(p)
+            ]
+            self._last_nom = f"nominated-but-unbound: {stuck[:5]}"
+            return not stuck
+
+        self._last_nom = ""
+        if not _wait_until(resolved, timeout=60.0, interval=0.5):
+            self._viol(epoch, "nominations_recovered", self._last_nom)
+
+    def check_slo_advancing(self, epoch: str) -> None:
+        now = self._sli_counts()
+        stalled = [
+            m for m in now
+            if now[m] <= self._sli_start[m]
+        ]
+        if stalled:
+            self._viol(
+                epoch, "slo_series_advancing",
+                f"milestones never advanced across the run: {stalled} "
+                f"(start={self._sli_start}, end={now})",
+            )
+
+
+# -- churn driver ------------------------------------------------------
+
+
+class ChurnDriver:
+    """Creates/binds/deletes pod waves through a fault-tolerant client:
+    every API call retries through restart windows, and a wave
+    reconciles (re-creates pods lost to unacked torn writes) rather
+    than assuming its creates stuck."""
+
+    def __init__(self, cluster: SoakCluster, mirror: WatchMirror, rng):
+        self.cluster = cluster
+        self.mirror = mirror
+        self.rng = rng
+        self.client = cluster.client()
+        self.bind_latencies: List[float] = []
+        self._serial = 0
+
+    # -- fault-tolerant verbs -----------------------------------------
+
+    def _retrying(self, fn, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return fn()
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
+
+    def create_pods(self, wires: List[dict], tolerate: bool = False) -> None:
+        for start in range(0, len(wires), 512):
+            chunk = wires[start:start + 512]
+
+            def put(chunk=chunk):
+                res = self.client.create_bulk(
+                    "pods", chunk, namespace="default"
+                )
+                bad = [
+                    r for r in res
+                    if r.get("status") != "Success" and r.get("code") != 409
+                ]
+                if bad and not tolerate:
+                    raise RuntimeError(f"bulk create failed: {bad[:2]}")
+
+            try:
+                self._retrying(put)
+            except Exception:
+                if not tolerate:
+                    raise
+
+    def reconcile_missing(self, wires: List[dict]) -> int:
+        """Re-create wave pods the store does not hold (a torn-write
+        'create' the crash un-did was never acked — the client's job is
+        to reconcile by reading current state and retrying)."""
+        recreated = 0
+        for w in wires:
+            name = w["metadata"]["name"]
+
+            def ensure(w=w, name=name):
+                try:
+                    self.client.get("pods", name, namespace="default")
+                except APIError as e:
+                    if e.code != 404:
+                        raise
+                    self.client.create("pods", w, namespace="default")
+                    return True
+                return False
+
+            try:
+                if self._retrying(ensure):
+                    recreated += 1
+            except Exception:
+                pass
+        return recreated
+
+    def wait_bound(self, names: List[str], timeout: float) -> List[str]:
+        """Wait for binds via the mirror; records per-pod latencies.
+        Returns names that never bound (caller decides severity)."""
+        t0 = time.monotonic()
+        pending = {f"default/{n}" for n in names}
+        seen_at: Dict[str, float] = {}
+        deadline = t0 + timeout
+        while pending and time.monotonic() < deadline:
+            for key in list(pending):
+                if self.mirror.bound_node(key):
+                    seen_at[key] = time.monotonic() - t0
+                    pending.discard(key)
+            if pending:
+                time.sleep(0.1)
+        self.bind_latencies.extend(seen_at.values())
+        return sorted(k.split("/", 1)[1] for k in pending)
+
+    def delete_pods(self, names: List[str], graceful_frac: float = 0.5) -> None:
+        """Half graceful (the kubelet Terminating confirm path), half
+        immediate; waits until every key leaves the mirror."""
+        graceful = [n for n in names if self.rng.random() < graceful_frac]
+        graceful_set = set(graceful)
+        for n in names:
+
+            def rm(n=n):
+                try:
+                    self.client.delete(
+                        "pods", n, namespace="default",
+                        grace_period_seconds=1 if n in graceful_set else None,
+                    )
+                except APIError as e:
+                    if e.code != 404:
+                        raise
+
+            try:
+                self._retrying(rm)
+            except Exception:
+                pass
+        gone = lambda: all(  # noqa: E731
+            not self.mirror.has(f"default/{n}") for n in names
+        )
+        # Graceful deletes confirm at grace + the kubelet's next resync
+        # tick; generous bound (invariant checks re-verify after).
+        _wait_until(gone, timeout=90.0, interval=0.25)
+
+    def delete_group(self, gname: str, names: List[str]) -> None:
+        self.delete_pods(names, graceful_frac=0.0)
+
+        def rm():
+            try:
+                self.client.delete("podgroups", gname, namespace="default")
+            except APIError as e:
+                if e.code != 404:
+                    raise
+
+        try:
+            self._retrying(rm)
+        except Exception:
+            pass
+
+    # -- waves ---------------------------------------------------------
+
+    def next_prefix(self, tag: str) -> str:
+        self._serial += 1
+        # Epoch names carry underscores; pod names must be DNS-safe.
+        return f"soak-{tag.replace('_', '-')}-{self._serial}"
+
+    def plain_wave(
+        self, n_pods: int, tag: str, bind_timeout: float = 90.0,
+        tolerate: bool = False,
+    ) -> List[str]:
+        """Create → bind → delete a wave of plain pods. Returns the
+        names that never bound."""
+        prefix = self.next_prefix(tag)
+        names = [f"{prefix}-{i}" for i in range(n_pods)]
+        wires = [_pod_wire(n) for n in names]
+        self.create_pods(wires, tolerate=tolerate)
+        self.reconcile_missing(wires)
+        unbound = self.wait_bound(names, bind_timeout)
+        self.delete_pods(names)
+        return unbound
+
+
+# -- the schedule ------------------------------------------------------
+
+
+def build_schedule(
+    seed: int, epochs: Optional[List[str]] = None, n_nodes: int = 200
+) -> List[dict]:
+    """The deterministic fault timeline: one entry per epoch with every
+    armed-rule parameter and wave size resolved from the seed. Pure —
+    calling it twice with the same inputs returns identical schedules
+    (the reproducibility half of the acceptance bar)."""
+    rng = random.Random(f"soak-schedule:{seed}")
+    wave = max(32, min(2 * n_nodes, 512))
+    chosen = list(epochs) if epochs else list(EPOCHS)
+    unknown = set(chosen) - set(EPOCHS)
+    if unknown:
+        raise ValueError(
+            f"unknown epoch(s) {sorted(unknown)}; known: {', '.join(EPOCHS)}"
+        )
+    out = []
+    for name in EPOCHS:  # fixed order regardless of selection order
+        if name not in chosen:
+            continue
+        entry: dict = {"epoch": name, "wave_pods": wave}
+        if name == "watch_drops":
+            entry["rule"] = {
+                "site": faults.WATCH_DROP.name,
+                "p": round(rng.uniform(0.02, 0.08), 3),
+                "times": rng.randrange(6, 14),
+            }
+        elif name == "wal_fsync":
+            entry["rule"] = {
+                "site": faults.WAL_FSYNC.name,
+                "every": rng.randrange(20, 60),
+                "times": rng.randrange(4, 10),
+            }
+        elif name == "apiserver_restart":
+            entry["rule"] = {
+                "site": faults.WAL_TORN_WRITE.name,
+                "every": rng.randrange(40, 120),
+                "times": 1,
+            }
+        elif name == "daemon_restart_mid_gang":
+            entry["rule"] = {
+                "site": faults.SCHED_COMMIT_CRASH.name,
+                # Armed only once the warm-up wave is fully bound, so
+                # the FIRST commit job after arming — the gang tick —
+                # is the one that dies.
+                "every": 1,
+                "times": 1,
+            }
+            entry["warmup_pods"] = 8
+            entry["gangs"] = max(2, wave // 64)
+            entry["gang_size"] = rng.randrange(3, 6)
+        elif name == "preemption_storm":
+            entry["rule"] = {
+                "site": faults.SCHED_EVICT_ERROR.name,
+                "p": round(rng.uniform(0.3, 0.6), 3),
+                "times": rng.randrange(8, 24),
+            }
+            entry["preemptors"] = max(4, n_nodes // 50)
+        out.append(entry)
+    return out
+
+
+def _arm(rule: dict) -> "faults.FaultRule":
+    site = faults.SITES[rule["site"]]
+    kw = {k: v for k, v in rule.items() if k != "site"}
+    return faults.inject(site, **kw)
+
+
+# -- the run -----------------------------------------------------------
+
+
+def run_soak(
+    n_nodes: int = 200,
+    seed: int = 0,
+    epochs: Optional[List[str]] = None,
+    data_dir: Optional[str] = None,
+    fsync: bool = True,
+    verbose: bool = True,
+) -> dict:
+    """Execute the schedule; returns the artifact dict (see __main__).
+    Leaves the fault registry disarmed regardless of outcome."""
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(f"[soak +{time.monotonic() - t_start:6.1f}s] {msg}",
+                  flush=True)
+
+    t_start = time.monotonic()
+    faults.clear()
+    faults.reset_stats(reseed=seed)
+    schedule = build_schedule(seed, epochs, n_nodes=n_nodes)
+    tmp = None
+    if data_dir is None:
+        tmp = tempfile.mkdtemp(prefix="kt-soak-")
+        data_dir = tmp
+    # Kubelet cadences scale with fleet size: at 1000 nodes a 3s
+    # resync period means ~333 full pod-resyncs/s of pure GIL churn —
+    # the reference scales --node-status-update-frequency the same way.
+    cluster = SoakCluster(
+        n_nodes, data_dir, fsync=fsync,
+        heartbeat_period=max(20.0, n_nodes / 25.0),
+        sync_period=max(3.0, n_nodes / 100.0),
+    )
+    log(f"starting cluster: {n_nodes} hollow nodes, seed {seed}, "
+        f"data dir {data_dir}")
+    cluster.start()
+    log("fleet up")
+    mirror = WatchMirror(cluster.client()).start()
+    checker = InvariantChecker(cluster, mirror)
+    driver = ChurnDriver(cluster, mirror, rng=random.Random(f"churn:{seed}"))
+    epoch_reports: List[dict] = []
+    n_before_final: Optional[int] = None
+    n_first_fault = 0
+    try:
+        for entry in schedule:
+            name = entry["epoch"]
+            log(f"epoch {name}: {entry}")
+            t0 = time.monotonic()
+            if name == "final":
+                n_before_final = len(driver.bind_latencies)
+            elif name != "baseline" and not n_first_fault:
+                n_first_fault = len(driver.bind_latencies)
+            unbound: List[str] = []
+            try:
+                unbound = _run_epoch(cluster, driver, entry)
+            except Exception as e:
+                checker._viol(name, "epoch_crashed", repr(e))
+            finally:
+                faults.clear()
+            if unbound:
+                checker._viol(
+                    name, "backlog_drained",
+                    f"{len(unbound)} pods never bound: {unbound[:5]}",
+                )
+            checker.check(name, driver.client)
+            epoch_reports.append({
+                "epoch": name,
+                "wall_s": round(time.monotonic() - t0, 2),
+                "violations_so_far": len(checker.violations),
+            })
+            log(f"epoch {name} done ({epoch_reports[-1]['wall_s']}s, "
+                f"{len(checker.violations)} violation(s) so far)")
+        checker.check_slo_advancing("end")
+    finally:
+        faults.clear()
+        try:
+            mirror.stop()
+        except Exception:
+            pass
+        try:
+            cluster.stop()
+        except Exception:
+            pass
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    lat = sorted(driver.bind_latencies)
+    # The post-fault figure: what the final (clean, after-every-fault)
+    # epoch measured; when no final epoch was selected, everything
+    # from the FIRST fault epoch on (never the clean baseline — it
+    # would flatter the figure).
+    post_start = n_before_final if n_before_final is not None else n_first_fault
+    post_slice = sorted(driver.bind_latencies[post_start:])
+
+    def _p(q, xs):
+        return round(xs[min(len(xs) - 1, int(q * (len(xs) - 1)))], 4) \
+            if xs else None
+
+    artifact = {
+        "seed": seed,
+        "nodes": n_nodes,
+        "schedule": schedule,
+        "epochs": epoch_reports,
+        "restarts": cluster.restarts,
+        "faults_injected": faults.stats(),
+        "fault_timeline": [list(t) for t in faults.timeline()],
+        "pods_bound": len(lat),
+        "bind_p50_s": _p(0.50, lat),
+        "bind_p99_s": _p(0.99, lat),
+        "post_fault_bind_p50_s": _p(0.50, post_slice),
+        "post_fault_bind_p99_s": _p(0.99, post_slice),
+        "invariant_violations": checker.violations,
+        "wall_s": round(time.monotonic() - t_start, 1),
+    }
+    return artifact
+
+
+def _run_epoch(cluster: SoakCluster, driver: ChurnDriver, entry: dict):
+    """One epoch: arm → churn (+ process-level moves) → return pods
+    that never bound. The caller disarms and runs the checker."""
+    name = entry["epoch"]
+    wave = entry["wave_pods"]
+    if name in ("baseline", "final"):
+        return driver.plain_wave(wave, name)
+    if name == "watch_drops":
+        _arm(entry["rule"])
+        return driver.plain_wave(wave, name, bind_timeout=120.0)
+    if name == "wal_fsync":
+        _arm(entry["rule"])
+        # Writes may FAIL (flushed-not-durable acks refused): tolerate
+        # and reconcile — that is the client contract under storage
+        # faults.
+        prefix = driver.next_prefix(name)
+        names = [f"{prefix}-{i}" for i in range(wave)]
+        wires = [_pod_wire(n) for n in names]
+        driver.create_pods(wires, tolerate=True)
+        faults.clear()
+        driver.reconcile_missing(wires)
+        unbound = driver.wait_bound(names, 120.0)
+        driver.delete_pods(names)
+        return unbound
+    if name == "apiserver_restart":
+        rule = _arm(entry["rule"])
+        prefix = driver.next_prefix(name)
+        names = [f"{prefix}-{i}" for i in range(wave)]
+        wires = [_pod_wire(n) for n in names]
+        driver.create_pods(wires, tolerate=True)
+        # Wait (briefly) for the torn-write to fire mid-churn — the
+        # store is DEAD from that instant (writes refused, exactly as
+        # a real mid-append death) — then kill -9 and recover on the
+        # same data dir.
+        _wait_until(lambda: rule.fired > 0, timeout=10.0)
+        faults.clear()
+        cluster.restart_apiserver()
+        driver.reconcile_missing(wires)
+        unbound = driver.wait_bound(names, 240.0)
+        driver.delete_pods(names)
+        return unbound
+    if name == "daemon_restart_mid_gang":
+        gangs, gang_size = entry["gangs"], entry["gang_size"]
+        prefix = driver.next_prefix(name)
+        # Warm-up wave binds CLEAN first; arming after it means the
+        # next commit job — the gang tick — is the one that dies.
+        warmup = [f"{prefix}-w{i}" for i in range(entry["warmup_pods"])]
+        driver.create_pods([_pod_wire(n) for n in warmup])
+        driver.wait_bound(warmup, 90.0)
+        rule = _arm(entry["rule"])
+        groups = []
+        for g in range(gangs):
+            gname = f"{prefix}-g{g}"
+            driver._retrying(
+                lambda gname=gname: driver.client.create(
+                    "podgroups", _pg_wire(gname, gang_size),
+                    namespace="default",
+                )
+            )
+            names = [f"{gname}-m{i}" for i in range(gang_size)]
+            driver.create_pods([_pod_wire(n, group=gname) for n in names])
+            groups.append((gname, names))
+        # The commit crash fires mid-stream; kill the daemon while its
+        # session still carries charges for never-bound pods.
+        _wait_until(lambda: rule.fired > 0, timeout=15.0)
+        faults.clear()
+        cluster.restart_scheduler()
+        all_names = [n for _g, ns in groups for n in ns]
+        unbound = driver.wait_bound(all_names, 150.0)
+        for gname, names in groups:
+            driver.delete_group(gname, names)
+        driver.delete_pods(warmup)
+        return unbound
+    if name == "preemption_storm":
+        # Saturate every node with a low-priority filler, then launch
+        # high-priority preemptors that fit NOWHERE without evictions —
+        # under injected eviction failures.
+        prefix = driver.next_prefix(name)
+        fillers = [f"{prefix}-fill-{i}" for i in range(cluster.n_nodes)]
+        driver.create_pods(
+            [_pod_wire(n, cpu="3") for n in fillers]
+        )
+        driver.wait_bound(fillers, 150.0)
+        _arm(entry["rule"])
+        preemptors = [
+            f"{prefix}-hi-{i}" for i in range(entry["preemptors"])
+        ]
+        driver.create_pods(
+            [_pod_wire(n, cpu="2", priority=100) for n in preemptors]
+        )
+        # Preemptors must bind: nominate → evict (some injected
+        # failures, retried) → victims drain grace → bind.
+        unbound = driver.wait_bound(preemptors, 180.0)
+        faults.clear()
+        driver.delete_pods(preemptors)
+        driver.delete_pods(fillers, graceful_frac=0.0)
+        return unbound
+    raise ValueError(f"unknown epoch {name!r}")
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools.soak",
+        description="hollow-node chaos soak (see module docstring)",
+    )
+    p.add_argument("--nodes", type=int, default=200)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--epochs", default="",
+        help=f"comma-separated subset of: {','.join(EPOCHS)} "
+        "(default: all)",
+    )
+    p.add_argument(
+        "--no-fsync", dest="fsync", action="store_false", default=True,
+        help="trade the fsync-before-ack contract for wall time",
+    )
+    p.add_argument("--data-dir", default="", help="default: a tempdir")
+    p.add_argument("--out", default="", help="write the JSON artifact here")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    epochs = [e.strip() for e in args.epochs.split(",") if e.strip()] or None
+    artifact = run_soak(
+        n_nodes=args.nodes,
+        seed=args.seed,
+        epochs=epochs,
+        data_dir=args.data_dir or None,
+        fsync=args.fsync,
+        verbose=not args.quiet,
+    )
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+    fired = sum(s["fired"] for s in artifact["faults_injected"].values())
+    print(json.dumps({
+        k: artifact[k]
+        for k in ("seed", "nodes", "pods_bound", "bind_p99_s",
+                  "post_fault_bind_p99_s", "restarts", "wall_s")
+    }, sort_keys=True))
+    if artifact["invariant_violations"]:
+        print(f"soak FAILED: {len(artifact['invariant_violations'])} "
+              "invariant violation(s):", file=sys.stderr)
+        for v in artifact["invariant_violations"]:
+            print(f"  [{v['epoch']}] {v['invariant']}: {v['detail']}",
+                  file=sys.stderr)
+        return 1
+    print(f"soak OK: {fired} fault(s) fired across "
+          f"{len(artifact['epochs'])} epoch(s), "
+          f"{artifact['pods_bound']} pods bound, zero invariant violations")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
