@@ -982,6 +982,85 @@ def _microtick_profile_figure(n_pods: int = 24) -> dict:
     return fig
 
 
+def _capacity_figure(n_pods: int = 32) -> dict:
+    """ISSUE 16: capacity & fragmentation figures from a LIVE
+    micro-tick daemon — an in-process cluster loaded to a meaningful
+    fill so the fragmentation score and probe-shape headroom read
+    back non-trivially (the acceptance gate pins both keys in this
+    artifact; tools/update_readme_bench.py renders them)."""
+    from kubernetes_tpu.client import Client, LocalTransport
+    from kubernetes_tpu.scheduler.daemon import (
+        IncrementalBatchScheduler,
+        SchedulerConfig,
+    )
+    from kubernetes_tpu.server.api import APIServer
+    from kubernetes_tpu.utils import capacity as capmod
+
+    def node_wire(j):
+        return {
+            "kind": "Node", "metadata": {"name": f"cap-n{j}"},
+            "status": {
+                "capacity": {"cpu": "2", "memory": "4Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+
+    def pod_wire(name):
+        return {
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "image": "pause",
+                "resources": {"limits": {"cpu": "200m", "memory": "128Mi"}},
+            }]},
+        }
+
+    # Fresh measurement window: earlier segments drove daemons in this
+    # process and fed the same process-global monitor.
+    capmod.DEFAULT.reset()
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    for j in range(4):
+        client.create("nodes", node_wire(j))
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    cfg.wait_for_sync(60)
+    sched = IncrementalBatchScheduler(cfg)
+    bound = 0
+    try:
+        sched.start()
+        # 32 x 200m on 4 x 2000m: an ~80% cpu-tight fill, so the big
+        # slice probes lose headroom while small ones keep it.
+        for i in range(n_pods):
+            client.create("pods", pod_wire(f"cap-p{i}"))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pods, _ = client.list("pods", namespace="default")
+            bound = sum(1 for p in pods if p.spec.node_name)
+            if bound >= n_pods and capmod.DEFAULT.snapshot()["sampled"]:
+                break
+            time.sleep(0.1)
+    finally:
+        sched.stop()
+        cfg.stop()
+    snap = capmod.DEFAULT.snapshot()
+    fig = {"capacity_pods_bound": bound}
+    if snap.get("sampled"):
+        fig.update(
+            {
+                "fragmentation_score": snap["fragmentation_score"],
+                "slice_alloc_success_rate": snap[
+                    "slice_alloc_success_rate"
+                ],
+                "capacity_samples": snap["samples"],
+                "capacity_stranded_nodes": snap["stranded_node_count"],
+                "cluster_headroom_pods": {
+                    p["shape"]: p["headroom_pods"] for p in snap["probes"]
+                },
+            }
+        )
+    return fig
+
+
 def churn_main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     rate = int(os.environ.get("BENCH_CHURN_RATE", "1000"))  # pods/s each way
@@ -1615,6 +1694,13 @@ def main() -> None:
         # Device duty-cycle / overlap from a live micro-tick daemon
         # (ISSUE 13 acceptance: both series appear in the artifact).
         record.update(_microtick_profile_figure())
+        # Capacity & fragmentation plane (ISSUE 16 acceptance:
+        # fragmentation_score / slice_alloc_success_rate appear in the
+        # artifact).
+        try:
+            record.update(_capacity_figure())
+        except Exception as e:
+            record["capacity_error"] = str(e)  # never sink a bench run
         # Chaos soak (ISSUE 15): faults injected / violations=0 /
         # post-fault bind p99 must appear in the artifact.
         try:

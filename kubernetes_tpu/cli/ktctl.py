@@ -754,30 +754,62 @@ def cmd_top(client: Client, args) -> int:
 
     if args.what == "cluster":
         return _cmd_top_cluster(client, args)
+    if args.what == "capacity":
+        return _cmd_top_capacity(client, args)
     nodes, _ = client.list("nodes")
+    node_util = {}
     if args.what == "nodes":
-        print(f"{'NAME':20}{'PODS':6}{'RSS':>12}{'DISK-USED':>11}")
+        # UTIL% rides the capacity plane's per-node utilization (the
+        # scheduler's own staged occupancy view) rather than a second
+        # kubelet scrape — one sample source, no extra round-trips.
+        try:
+            cap = _fetch_capacity_report(client, args)
+            if cap.get("sampled"):
+                node_util = cap.get("node_utilization", {}) or {}
+        except Exception:
+            node_util = {}
+        print(f"{'NAME':20}{'PODS':6}{'RSS':>12}{'DISK-USED':>11}{'UTIL%':>8}")
     else:
         print(f"{'POD-UID':38}{'CONTAINER':14}{'STATE':10}{'RSS':>12}{'RESTARTS':>9}")
     for node in nodes:
-        url = f"{args.server}/api/v1/nodes/{node.metadata.name}/proxy/stats"
-        try:
-            with urllib.request.urlopen(url, timeout=10) as resp:
-                stats = _json.loads(resp.read())
-        except (urllib.error.URLError, OSError) as e:
-            print(f"# {node.metadata.name}: unreachable ({e})", file=sys.stderr)
-            continue
-        pods = stats.get("pods", {})
+        stats = None
+        if args.server:
+            url = (
+                f"{args.server}/api/v1/nodes/{node.metadata.name}"
+                "/proxy/stats"
+            )
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    stats = _json.loads(resp.read())
+            except (urllib.error.URLError, OSError) as e:
+                print(
+                    f"# {node.metadata.name}: unreachable ({e})",
+                    file=sys.stderr,
+                )
         if args.what == "nodes":
+            # Kubelet stats may be unreachable (or there is no HTTP
+            # server at all — injected transport): the scheduler-side
+            # UTIL% column still renders, the kubelet columns dash out.
+            pods = (stats or {}).get("pods", {})
             rss = sum(
                 c.get("rssBytes", 0) for cs in pods.values() for c in cs
             )
-            disk = stats.get("disk", {}).get("usedFraction", 0)
+            disk = (stats or {}).get("disk", {}).get("usedFraction", 0)
+            # Binding-resource utilization: the max of cpu/mem/pods
+            # ratios — the one that fills first is the one that blocks
+            # the next placement.
+            util = node_util.get(node.metadata.name)
+            util_s = f"{max(util):.0%}" if util else "-"
             print(
-                f"{node.metadata.name:20}{len(pods):<6}"
-                f"{_human_bytes(rss):>12}{disk:>10.0%}"
+                f"{node.metadata.name:20}"
+                f"{len(pods) if stats else '-':<6}"
+                f"{_human_bytes(rss) if stats else '-':>12}"
+                f"{f'{disk:.0%}' if stats else '-':>10}{util_s:>8}"
             )
         else:
+            if stats is None:
+                continue
+            pods = stats.get("pods", {})
             for uid, containers in sorted(pods.items()):
                 for c in containers:
                     print(
@@ -1323,16 +1355,116 @@ _TOP_CLUSTER_PREFIXES = (
     "solver_device_transfer_bytes_total",
     "solver_xla_",
     "device_memory_bytes",
+    "cluster_fragmentation_score",
+    "cluster_headroom_pods",
+    "slice_alloc_success_rate",
+    "scheduler_backlog_pressure",
+    "capacity_zero_headroom_ticks_total",
 )
+
+
+def _fetch_capacity_report(client: Client, args) -> Dict:
+    """The capacity report: GET /debug/capacity over HTTP transports,
+    or the process-local monitor for injected LocalTransport clients
+    (same split as `ktctl slo` — utils/capacity keeps jax off its
+    import path, so the local read is safe in a thin CLI process)."""
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        return get_json("/debug/capacity")
+    from kubernetes_tpu.utils import capacity
+
+    return capacity.DEFAULT.snapshot()
+
+
+def _cmd_top_capacity(client: Client, args) -> int:
+    """`ktctl top capacity` — the capacity & fragmentation plane:
+    cluster fragmentation score, per-probe-shape headroom table, top-k
+    stranded nodes, and backlog pressure (GET /debug/capacity). Exits 1
+    with 'no capacity samples recorded' on a cluster whose scheduler
+    has not sampled yet (the trace/explain/slo miss contract)."""
+    report = _fetch_capacity_report(client, args)
+    if not report.get("sampled"):
+        # Clean nonzero exit, empty stdout: a script gating on capacity
+        # must see that nothing was measured, not a hollow table.
+        print("no capacity samples recorded", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(report, default_flow_style=False))
+        return 0
+    backlog = report.get("backlog", {})
+    print(
+        f"fragmentation: {report.get('fragmentation_score', 0.0):.4f}  "
+        f"slice-alloc: {report.get('slice_alloc_success_rate', 0.0):.0%}  "
+        f"live-nodes: {report.get('live_nodes', 0)}  "
+        f"stranded: {report.get('stranded_node_count', 0)}"
+    )
+    print(
+        f"backlog: depth={backlog.get('depth', 0)} "
+        f"oldest={backlog.get('oldest_age_s', 0.0):.2f}s "
+        f"pressure={backlog.get('pressure', 0.0):.2f}"
+    )
+    print()
+    print(
+        f"{'SHAPE':20}{'CPU(m)':>8}{'MEM(MiB)':>10}{'MIN':>5}"
+        f"{'HEADROOM':>10}{'FRAG':>8}  ALLOC"
+    )
+    for p in report.get("probes", ()):
+        print(
+            f"{p.get('shape', ''):20}{p.get('cpu_milli', 0):>8.0f}"
+            f"{p.get('mem_mib', 0):>10.0f}{p.get('min_member', 1):>5}"
+            f"{p.get('headroom_pods', 0):>10}"
+            f"{p.get('fragmentation', 0.0):>8.3f}"
+            f"  {'yes' if p.get('allocatable') else 'NO'}"
+        )
+    stranded = report.get("stranded_nodes", ())
+    if stranded:
+        print()
+        print(f"{'STRANDED-NODE':20}{'FREE-CPU(m)':>12}{'FREE-MEM(MiB)':>14}")
+        for n in stranded:
+            print(
+                f"{n.get('name', ''):20}{n.get('free_cpu_milli', 0):>12.0f}"
+                f"{n.get('free_mem_mib', 0):>14.0f}"
+            )
+    trend = report.get("trend", ())
+    if trend:
+        print()
+        print(
+            f"trend ({len(trend)} samples): "
+            + " ".join(f"{v:.3f}" for v in trend[-12:])
+        )
+    return 0
 
 
 def _cmd_top_cluster(client: Client, args) -> int:
     """`ktctl top cluster` — the cluster-level resource view: SLO
-    verdict table plus the raw telemetry-plane series from /metrics
-    (device memory, transfer bytes, compile cache, watch fan-out)."""
+    verdict table, the capacity plane's headline row, plus the raw
+    telemetry-plane series from /metrics (device memory, transfer
+    bytes, compile cache, watch fan-out)."""
     report = _fetch_slo_report(client, args)
     for line in _render_slo_table(report):
         print(line)
+    cap = _fetch_capacity_report(client, args)
+    if cap.get("sampled"):
+        worst = min(
+            (p for p in cap.get("probes", ())),
+            key=lambda p: p.get("headroom_pods", 0),
+            default=None,
+        )
+        head = (
+            f"min-headroom {worst.get('headroom_pods', 0)} pods "
+            f"({worst.get('shape', '')})"
+            if worst is not None
+            else "no probes"
+        )
+        print()
+        print(
+            f"CAPACITY  fragmentation={cap.get('fragmentation_score', 0.0):.4f}"
+            f"  {head}  stranded-nodes={cap.get('stranded_node_count', 0)}"
+        )
     transport = client.t
     if getattr(transport, "get_json", None) is not None and args.server:
         import urllib.request
@@ -1545,7 +1677,7 @@ def build_parser() -> argparse.ArgumentParser:
     ee.set_defaults(fn=cmd_exec)
 
     tp = sub.add_parser("top", parents=[common])
-    tp.add_argument("what", choices=["nodes", "pods", "cluster"])
+    tp.add_argument("what", choices=["nodes", "pods", "cluster", "capacity"])
     tp.set_defaults(fn=cmd_top)
 
     sl = sub.add_parser("slo", parents=[common])

@@ -1,0 +1,206 @@
+"""Capacity & fragmentation kernels: cluster headroom, stranded
+capacity, and slice allocatability as one dense reduction.
+
+Roadmap item 5 (descheduler/defragmenter + autoscaler) needs fleet
+capacity signals that the dense pod x node formulation makes nearly
+free: the node occupancy columns are already staged (device-resident
+in the incremental session's carry, host-mirrored in ``session.h``),
+so one extra jitted reduction per resolved micro-tick yields the full
+vocabulary — per-node free vectors, utilization ratios, and for a set
+of canonical **probe pod shapes** (the backlog's observed shape
+quantiles plus configured slice shapes):
+
+- ``headroom[q]``: how many pods of probe shape ``q`` still fit —
+  per-node integral fit (greedy: a node hosts ``floor(free/request)``
+  probes per resource, min across resources and the pods allowance),
+  mask-reduced over live nodes. For identical-shape members this IS
+  the gang bound: the largest all-or-nothing group of shape ``q``
+  placeable right now is ``headroom[q]`` (per-node integral fits are
+  independent), so slice allocatability reuses the gang acceptance
+  predicate ``headroom >= minMember`` (``gang_member_counts`` vs
+  minMember, scheduler/gang.py).
+
+- ``frag[q]``: the stranded-capacity fraction — of the aggregate free
+  capacity measured in probe-``q`` units (the FRACTIONAL fit, free
+  capacity divided by the probe's bottleneck request, no floor), the
+  share no single node can actually host: ``1 - usable/potential``.
+  A fleet that could hold 40 probes if free capacity were contiguous
+  but fits only 10 scores 0.75 for that shape.
+
+- ``frag_score``: the capacity-weighted aggregate over live probes —
+  ``1 - sum_q(headroom) / sum_q(potential)`` — the single always-on
+  ``cluster_fragmentation_score`` series.
+
+Integer-exactness discipline: every cross-node/cross-probe reduction
+sums **int32** (integral fits; fractional fits quantized to 1/FRAC_Q
+probe units, per-node fits clipped to FIT_CAP) so results are
+independent of XLA's reduction order and the KT006 NumPy twin
+(``ops.oracle.capacity_report_numpy``) matches bit-for-bit — the same
+trick the solver's parity chain leans on. The remaining float work is
+elementwise (divisions, comparisons), where IEEE f32 agrees between
+XLA:CPU/TPU and NumPy. Overflow budget: N * FIT_CAP * FRAC_Q = 2^30
+at N=8192 fully saturated nodes — and real clusters sit far below the
+clip (FIT_CAP is ~75x the kubelet's default 110-pod allowance).
+
+Probe semantics: a probe is (cpu milli, mem MiB, minMember) in the
+same units as the NODE_SCHEMA columns. ``probe_live`` masks padding
+rows (probe count pads to a pow2 bucket so the executable is reused
+across backlog-quantile churn). Zero-request probes fit wherever the
+pods allowance allows, mirroring the solver's zero_req rule.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.ledger import traced_jit
+
+#: Fractional fits are quantized to 1/FRAC_Q probe units (int32) so
+#: cross-node sums are reduction-order independent and the NumPy twin
+#: is bit-exact; 1/16 of one probe is far below fragmentation signal.
+FRAC_Q = 16
+
+#: Per-node fit clip: keeps the quantized cross-node sums inside int32
+#: (see module docstring's overflow budget) while sitting far above any
+#: real kubelet pods allowance.
+FIT_CAP = 2.0**13
+
+#: Stand-in for "unconstrained" per-resource fits (zero-request
+#: probes) before the min with the pods allowance and FIT_CAP.
+BIG_FIT = 2.0**20
+
+
+@traced_jit
+def capacity_report(
+    cpu_cap,
+    mem_cap,
+    pods_cap,
+    cpu_fit,
+    mem_fit,
+    pods_used,
+    over,
+    sched,
+    probe_cpu,
+    probe_mem,
+    probe_min,
+    probe_live,
+):
+    """The capacity plane's one dense pass: free vectors, utilization
+    ratios, per-probe headroom/fragmentation, slice allocatability,
+    and per-node stranded flags.
+
+    Node columns are the NODE_SCHEMA occupancy view (the solver's
+    greedy-fit charge ``cpu_fit``/``mem_fit``, which excludes
+    terminal-phase and Terminating pods upstream); ``over`` marks
+    overcommitted nodes (unplaceable, like the solver treats them),
+    ``sched`` readiness. Returns a flat tuple:
+
+    ``(util_cpu f32[N], util_mem f32[N], util_pods f32[N],
+    fit_int i32[Q,N], headroom i32[Q], frag f32[Q], slice_ok b8[Q],
+    stranded b8[N], frag_score f32[], stranded_cpu f32[],
+    stranded_mem f32[])``
+    """
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
+    big = jnp.float32(BIG_FIT)
+    live = sched & ~over
+    livef = live.astype(jnp.float32)
+
+    free_cpu = jnp.maximum(cpu_cap - cpu_fit, f0) * livef
+    free_mem = jnp.maximum(mem_cap - mem_fit, f0) * livef
+    free_pods = jnp.maximum(pods_cap - pods_used, f0) * livef
+
+    # Utilization = charged/capacity, clamped (overcommit reads 1.0).
+    # Dead/padding nodes read 0 here and carry live=False in
+    # `stranded`'s mask; the host side filters on the same columns.
+    def util(used_part, cap):
+        return jnp.where(
+            (cap > f0) & live,
+            jnp.clip(used_part / jnp.maximum(cap, f1), f0, f1),
+            f0,
+        )
+
+    util_cpu = util(cpu_fit, cpu_cap)
+    util_mem = util(mem_fit, mem_cap)
+    util_pods = util(pods_used, pods_cap)
+
+    # Per-(probe, node) fits. Fractional fit = free capacity in probe
+    # units, bottlenecked across resources (no floor); integral fit
+    # floors per resource (floor of a min == min of floors).
+    pc = probe_cpu[:, None]
+    pm = probe_mem[:, None]
+    per_cpu = jnp.where(pc > f0, free_cpu[None, :] / jnp.maximum(pc, f1), big)
+    per_mem = jnp.where(pm > f0, free_mem[None, :] / jnp.maximum(pm, f1), big)
+    fit_frac = jnp.minimum(
+        jnp.minimum(per_cpu, per_mem), free_pods[None, :]
+    )
+    fit_frac = jnp.clip(fit_frac, f0, jnp.float32(FIT_CAP))
+    fit_int = jnp.floor(fit_frac).astype(jnp.int32)
+    frac_milli = jnp.floor(fit_frac * jnp.float32(FRAC_Q)).astype(jnp.int32)
+
+    plive = probe_live.astype(jnp.int32)
+    usable = jnp.sum(fit_int, axis=1) * plive  # i32[Q]
+    potential = jnp.sum(frac_milli, axis=1) * plive  # i32[Q], 1/FRAC_Q units
+    headroom = usable
+    frag = jnp.where(
+        potential > jnp.int32(0),
+        f1
+        - (usable.astype(jnp.float32) * jnp.float32(FRAC_Q))
+        / potential.astype(jnp.float32),
+        f0,
+    )
+    frag = jnp.clip(frag, f0, f1) * probe_live.astype(jnp.float32)
+    slice_ok = probe_live & (headroom >= jnp.maximum(probe_min, jnp.int32(1)))
+
+    # Capacity-weighted aggregate over live probes (reduces over the
+    # probe axis): integer totals keep it reduction-order exact.
+    total_usable = jnp.sum(usable)
+    total_potential = jnp.sum(potential)
+    frag_score = jnp.where(
+        total_potential > jnp.int32(0),
+        f1
+        - (total_usable.astype(jnp.float32) * jnp.float32(FRAC_Q))
+        / total_potential.astype(jnp.float32),
+        f0,
+    )
+    frag_score = jnp.clip(frag_score, f0, f1)
+
+    # Stranded node: live, has leftover cpu/mem, hosts ZERO probes of
+    # every live shape (its free capacity is unusable as probes see it).
+    hosts_any = jnp.any((fit_int > jnp.int32(0)) & probe_live[:, None], axis=0)
+    any_live_probe = jnp.any(probe_live)
+    stranded = (
+        live
+        & ((free_cpu > f0) | (free_mem > f0))
+        & ~hosts_any
+        & any_live_probe
+    )
+
+    # Stranded share of aggregate free capacity, per resource —
+    # int32-summed (the columns hold integral milli/MiB values).
+    def stranded_frac(free):
+        free_i = free.astype(jnp.int32)
+        tot = jnp.sum(free_i)
+        strand = jnp.sum(free_i * stranded.astype(jnp.int32))
+        return jnp.where(
+            tot > jnp.int32(0),
+            strand.astype(jnp.float32) / tot.astype(jnp.float32),
+            f0,
+        )
+
+    stranded_cpu = stranded_frac(free_cpu)
+    stranded_mem = stranded_frac(free_mem)
+
+    return (
+        util_cpu,
+        util_mem,
+        util_pods,
+        fit_int,
+        headroom,
+        frag,
+        slice_ok,
+        stranded,
+        frag_score,
+        stranded_cpu,
+        stranded_mem,
+    )

@@ -148,6 +148,9 @@ DIM_LATTICES: Dict[str, Tuple[str, object]] = {
     "M": ("preemption node axis: pow2 >= 8", lambda n: _is_pow2(n) and n >= 8),
     # Dirty-row scatter width (SolverSession._flush_dirty).
     "R": ("scatter width: pow2 >= 8", lambda n: _is_pow2(n) and n >= 8),
+    # Capacity probe-shape axis (utils/capacity.py pads the probe set —
+    # backlog quantiles + configured slice shapes — to pow2 buckets).
+    "Q": ("probe-shape axis: pow2 >= 4", lambda n: _is_pow2(n) and n >= 4),
     # Policy-lowering minor axes: sized by the configured policy
     # (affinity label count, anti-affinity zone vocab) — static per
     # lowered spec, not bucketed.
@@ -456,6 +459,47 @@ CONTRACTS: Dict[str, Contract] = {
             "cumsums couple them by construction"
         ),
     ),
+    "capacity.capacity_report": Contract(
+        kernel="capacity.capacity_report",
+        args=(
+            ("cpu_cap", _f32("N")),
+            ("mem_cap", _f32("N")),
+            ("pods_cap", _f32("N")),
+            ("cpu_fit", _f32("N")),
+            ("mem_fit", _f32("N")),
+            ("pods_used", _f32("N")),
+            ("over", _b8("N")),
+            ("sched", _b8("N")),
+            ("probe_cpu", _f32("Q")),
+            ("probe_mem", _f32("Q")),
+            ("probe_min", _i32("Q")),
+            ("probe_live", _b8("Q")),
+        ),
+        results=(
+            _f32("N"),  # util_cpu
+            _f32("N"),  # util_mem
+            _f32("N"),  # util_pods
+            ArraySpec(("Q", "N"), "i32"),  # fit_int
+            _i32("Q"),  # headroom
+            _f32("Q"),  # frag
+            _b8("Q"),  # slice_ok
+            _b8("N"),  # stranded
+            _f32(),  # frag_score
+            _f32(),  # stranded_cpu
+            _f32(),  # stranded_mem
+        ),
+        pod_dim="Q",
+        pod_axis="reduces",
+        samples=(
+            {"Q": 4, "N": 128},
+            {"Q": 8, "N": 256},
+        ),
+        notes=(
+            "probes ARE canonical pod shapes: headroom/fragmentation "
+            "totals reduce over the probe axis (and stranded-node "
+            "detection any()s across it)"
+        ),
+    ),
 }
 
 
@@ -473,7 +517,7 @@ def _distinct_bindings(contract: Contract) -> Dict[str, int]:
     pool = {
         "P": 384, "PG": 24, "G": 48, "N": 256, "LW": 2, "PW": 4, "VW": 6,
         "S": 640, "K": SVC_K, "V": 40, "M": 16, "R": 12,
-        "A": 3, "Z": 5, "S1": 641,
+        "A": 3, "Z": 5, "S1": 641, "Q": 32,
     }
     return {s: pool[s] for s in symbols if s in pool}
 

@@ -373,3 +373,130 @@ def validate_assignment_numpy(snap: Snapshot, assignment) -> None:
         uport[j] |= p.port_bits[i]
         uvol_any[j] |= p.vol_any_bits[i]
         uvol_rw[j] |= p.vol_rw_bits[i]
+
+def capacity_report_numpy(
+    cpu_cap,
+    mem_cap,
+    pods_cap,
+    cpu_fit,
+    mem_fit,
+    pods_used,
+    over,
+    sched,
+    probe_cpu,
+    probe_mem,
+    probe_min,
+    probe_live,
+):
+    """Exact host twin of ops.capacity.capacity_report (KT006).
+
+    Same float32 elementwise arithmetic, same int32-quantized
+    reductions — cross-node/cross-probe sums are integer, so this twin
+    matches the device kernel BIT-FOR-BIT (no tolerance), unlike the
+    Go-semantics solve oracle above whose divergence is the signal.
+    See tests/test_solver_parity.py TestCapacityParity."""
+    from kubernetes_tpu.ops.capacity import BIG_FIT, FIT_CAP, FRAC_Q
+
+    f32 = np.float32
+    cpu_cap = np.asarray(cpu_cap, f32)
+    mem_cap = np.asarray(mem_cap, f32)
+    pods_cap = np.asarray(pods_cap, f32)
+    cpu_fit = np.asarray(cpu_fit, f32)
+    mem_fit = np.asarray(mem_fit, f32)
+    pods_used = np.asarray(pods_used, f32)
+    over = np.asarray(over, bool)
+    sched = np.asarray(sched, bool)
+    probe_cpu = np.asarray(probe_cpu, f32)
+    probe_mem = np.asarray(probe_mem, f32)
+    probe_min = np.asarray(probe_min, np.int32)
+    probe_live = np.asarray(probe_live, bool)
+
+    f0, f1, big = f32(0.0), f32(1.0), f32(BIG_FIT)
+    live = sched & ~over
+    livef = live.astype(f32)
+
+    free_cpu = np.maximum(cpu_cap - cpu_fit, f0) * livef
+    free_mem = np.maximum(mem_cap - mem_fit, f0) * livef
+    free_pods = np.maximum(pods_cap - pods_used, f0) * livef
+
+    def util(used_part, cap):
+        return np.where(
+            (cap > f0) & live,
+            np.clip(used_part / np.maximum(cap, f1), f0, f1),
+            f0,
+        ).astype(f32)
+
+    util_cpu = util(cpu_fit, cpu_cap)
+    util_mem = util(mem_fit, mem_cap)
+    util_pods = util(pods_used, pods_cap)
+
+    pc = probe_cpu[:, None]
+    pm = probe_mem[:, None]
+    per_cpu = np.where(pc > f0, free_cpu[None, :] / np.maximum(pc, f1), big)
+    per_mem = np.where(pm > f0, free_mem[None, :] / np.maximum(pm, f1), big)
+    fit_frac = np.minimum(np.minimum(per_cpu, per_mem), free_pods[None, :])
+    fit_frac = np.clip(fit_frac, f0, f32(FIT_CAP)).astype(f32)
+    fit_int = np.floor(fit_frac).astype(np.int32)
+    frac_milli = np.floor(fit_frac * f32(FRAC_Q)).astype(np.int32)
+
+    plive = probe_live.astype(np.int32)
+    usable = (fit_int.sum(axis=1, dtype=np.int32) * plive).astype(np.int32)
+    potential = (
+        frac_milli.sum(axis=1, dtype=np.int32) * plive
+    ).astype(np.int32)
+    headroom = usable
+    frag = np.where(
+        potential > 0,
+        f1
+        - (usable.astype(f32) * f32(FRAC_Q))
+        / np.maximum(potential, 1).astype(f32),
+        f0,
+    ).astype(f32)
+    frag = (np.clip(frag, f0, f1) * probe_live.astype(f32)).astype(f32)
+    slice_ok = probe_live & (
+        headroom >= np.maximum(probe_min, np.int32(1))
+    )
+
+    total_usable = np.int32(usable.sum(dtype=np.int32))
+    total_potential = np.int32(potential.sum(dtype=np.int32))
+    if total_potential > 0:
+        frag_score = f32(
+            f1 - (f32(total_usable) * f32(FRAC_Q)) / f32(total_potential)
+        )
+    else:
+        frag_score = f0
+    frag_score = f32(np.clip(frag_score, f0, f1))
+
+    hosts_any = ((fit_int > 0) & probe_live[:, None]).any(axis=0)
+    any_live_probe = bool(probe_live.any())
+    stranded = (
+        live
+        & ((free_cpu > f0) | (free_mem > f0))
+        & ~hosts_any
+        & any_live_probe
+    )
+
+    def stranded_frac(free):
+        free_i = free.astype(np.int32)
+        tot = np.int32(free_i.sum(dtype=np.int32))
+        strand = np.int32(
+            (free_i * stranded.astype(np.int32)).sum(dtype=np.int32)
+        )
+        return f32(f32(strand) / f32(tot)) if tot > 0 else f0
+
+    stranded_cpu = stranded_frac(free_cpu)
+    stranded_mem = stranded_frac(free_mem)
+
+    return (
+        util_cpu,
+        util_mem,
+        util_pods,
+        fit_int,
+        headroom,
+        frag,
+        slice_ok,
+        stranded,
+        np.float32(frag_score),
+        np.float32(stranded_cpu),
+        np.float32(stranded_mem),
+    )

@@ -41,6 +41,12 @@ from __future__ import annotations
 # NOTE: must stay a literal dict — KT006 reads it by AST, without
 # importing jax.
 ORACLE_TWINS = {
+    "capacity.capacity_report": {
+        # Bit-exact twin (int32-quantized reductions): the parity suite
+        # asserts array_equal on every leaf, no tolerance.
+        "oracle": "ops.oracle.capacity_report_numpy",
+        "suite": "tests/test_solver_parity.py",
+    },
     "incremental._scatter_rows": {
         "oracle": "ops.oracle.scatter_rows_numpy",
         "suite": "tests/test_ktsan.py",

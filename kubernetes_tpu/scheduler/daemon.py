@@ -562,6 +562,7 @@ class BatchScheduler(Scheduler):
 
             self.sidecar = SidecarSolver(sidecar_path)
         self.fallback_count = 0
+        self._capacity_sampled_mono = 0.0
         # Policy routing (round-2 VERDICT Weak #1): a non-default spec
         # either lowers to the scan solver or pins the batch to the
         # scalar path — decided once, loudly.
@@ -951,6 +952,82 @@ class BatchScheduler(Scheduler):
             if ts:
                 sli.INFORMER_STALENESS.set(now - ts, resource=resource)
 
+    # -- capacity & fragmentation plane --------------------------------
+
+    #: Idle-tick capacity refresh cadence (the PR 9 staleness rule:
+    #: telemetry must keep moving on an idle cluster, but a full sample
+    #: per empty poll tick would be pure overhead).
+    CAPACITY_IDLE_REFRESH_S = 2.0
+
+    def start(self) -> "BatchScheduler":
+        # The capacity kernel's cold XLA compile (~1.5s) must never
+        # land in-band: a solve-thread stall that long lets a fast
+        # wave finish bind+running before the commit worker announces
+        # its decision milestones. Warm both probe-count buckets on a
+        # background thread before traffic arrives.
+        def _warm():
+            try:
+                from kubernetes_tpu.utils import capacity as capmod
+
+                capmod.DEFAULT.warm(len(self.config.nodes.store.list()))
+            except Exception:
+                _LOG.debug("capacity warm failed", exc_info=True)
+
+        threading.Thread(
+            target=_warm, daemon=True, name="capacity-warm"
+        ).start()
+        return super().start()  # type: ignore[return-value]
+
+    def _sample_capacity(self, pending: Optional[List[Pod]] = None) -> None:
+        """One capacity-plane sample (utils/capacity.py) inside its own
+        ``capacity`` phase span: occupancy columns straight off the
+        session's host mirror when one exists (the already-staged
+        matrices), otherwise rebuilt from the watch caches. Runs per
+        resolved tick plus the idle refresh below. Telemetry only —
+        it never raises into the tick."""
+        try:
+            from kubernetes_tpu.models.columnar import (
+                mem_to_mib_ceil,
+                pod_resource_limits,
+            )
+            from kubernetes_tpu.utils import capacity as capmod
+
+            cfg = self.config
+            if pending:
+                shapes = []
+                for pod in pending:
+                    cpu, mem = pod_resource_limits(pod)
+                    shapes.append((float(cpu), float(mem_to_mib_ceil(mem))))
+                capmod.DEFAULT.note_backlog_shapes(shapes)
+            session = getattr(self, "_session", None)
+            with tracing.phase("capacity"):
+                if session is not None:
+                    cols, names = capmod.session_columns(session)
+                else:
+                    cols, names = capmod.cluster_columns(
+                        cfg.nodes.store.list(), cfg.pod_lister.list()
+                    )
+                capmod.DEFAULT.sample(
+                    cols,
+                    names,
+                    backlog_depth=len(cfg.pod_queue),
+                    oldest_age_s=sli.DEFAULT.oldest_unbound_age_s(),
+                )
+            self._capacity_sampled_mono = time.monotonic()
+        except Exception:
+            _LOG.debug("capacity sample failed", exc_info=True)
+
+    def _refresh_capacity_idle(self) -> None:
+        """Idle-tick half of the sampling cadence: refresh the capacity
+        series when no tick has sampled them for a beat, so the plane
+        stays live (and the trend ring honest) on a quiet cluster."""
+        if (
+            time.monotonic() - getattr(self, "_capacity_sampled_mono", 0.0)
+            < self.CAPACITY_IDLE_REFRESH_S
+        ):
+            return
+        self._sample_capacity()
+
     # -- flight recorder ----------------------------------------------
 
     def _record_decisions(
@@ -1127,6 +1204,7 @@ class BatchScheduler(Scheduler):
         sli.observe_device_telemetry()
         pending = self._drain(timeout)
         if not pending:
+            self._refresh_capacity_idle()
             return 0
         # One trace per cycle (a per-pod trace at 50k-pod batches would
         # be pure overhead): the pod set rides the trace for filtering,
@@ -1334,6 +1412,7 @@ class BatchScheduler(Scheduler):
                 unbound, nodes, cfg.pod_lister.list(), groups=groups
             )
         self._requeue_many(rejected)
+        self._sample_capacity(pending)
         _E2E_LATENCY.observe(time.monotonic() - start)
         return len(pending) + len(deferred)
 
@@ -1635,6 +1714,9 @@ class IncrementalBatchScheduler(BatchScheduler):
         ctx["stats"] = stats
         _ALGO_LATENCY.observe(solve_s)
         self._submit_commit(results, ctx, prefer_inline=prefer_inline)
+        # Post-tick capacity sample off the session host mirror — the
+        # matrices this very tick solved against, no re-staging.
+        self._sample_capacity(ctx.get("pending"))
 
     def _submit_commit(self, results, ctx, prefer_inline=False) -> None:
         if self._pipelined and not (
@@ -1940,6 +2022,7 @@ class IncrementalBatchScheduler(BatchScheduler):
                 # snapshots the caches anyway: don't let deltas pile
                 # up unboundedly in a quiet cluster.
                 self._event_q.clear()
+            self._refresh_capacity_idle()
             return 0
         with tracing.trace(
             "schedule_batch",

@@ -242,6 +242,23 @@ class _Handler(BaseHTTPRequestHandler):
                 200, json.dumps(slo.evaluate()), "application/json"
             )
             return
+        if rest == ("capacity",):
+            # The capacity & fragmentation plane (utils/capacity.py):
+            # last sample's fragmentation score, probe-shape headroom
+            # table, top-k stranded nodes, per-node utilization and the
+            # fragmentation trend ring — `ktctl top capacity`'s data
+            # source. A cluster whose scheduler never sampled returns
+            # sampled:false (the ktctl miss contract keys on it). The
+            # module keeps jax off its import path, so a thin
+            # control-plane apiserver can serve the cold shape.
+            from kubernetes_tpu.utils import capacity
+
+            self._send_text(
+                200,
+                json.dumps(capacity.DEFAULT.snapshot()),
+                "application/json",
+            )
+            return
         if rest == ("kernels",):
             # The XLA compile/cost ledger (ops/ledger.py): per-kernel
             # compile events with cost/memory analysis — `ktctl profile
@@ -307,7 +324,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "debug endpoints: /debug/requests /debug/stacks "
                 "/debug/profile /debug/traces /debug/decisions "
                 "/debug/solves /debug/slo /debug/kernels "
-                "/debug/device-profile",
+                "/debug/capacity /debug/device-profile",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
 

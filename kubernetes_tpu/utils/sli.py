@@ -314,6 +314,25 @@ class LifecycleSLICollector:
         with self._lock:
             return len(self._tracks)
 
+    #: Bound on the oldest-unbound scan below — tracks are insertion-
+    #: ordered so the oldest unbound pod sits near the front; a cap
+    #: keeps the per-tick capacity sample O(1) even at MAX_TRACKED.
+    _AGE_SCAN_LIMIT = 1024
+
+    def oldest_unbound_age_s(self) -> float:
+        """Age (seconds) of the oldest tracked pod that has not reached
+        the bound milestone — the backlog-pressure age watermark
+        (utils/capacity.py multiplies it by the FIFO depth). 0.0 when
+        nothing is waiting."""
+        now = time.monotonic()
+        with self._lock:
+            for i, t in enumerate(self._tracks.values()):
+                if i >= self._AGE_SCAN_LIMIT:
+                    break
+                if not t[2]:  # not yet bound
+                    return max(now - t[0], 0.0)
+        return 0.0
+
     def reset(self) -> None:
         with self._lock:
             self._tracks.clear()

@@ -222,6 +222,20 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
         description="XLA solver compiles observed; shape-bucket padding "
         "keeps this bounded (PR-7 recompilation sentinel)",
     ),
+    Objective(
+        "capacity_fragmentation", "cluster_fragmentation_score",
+        target=0.5, severity="warn",
+        description="cluster fragmentation score (stranded capacity for "
+        "the canonical probe-pod shapes), p99 — sustained high scores "
+        "mean the free capacity exists but is unusable shards",
+    ),
+    Objective(
+        "capacity_zero_headroom", "capacity_zero_headroom_ticks_total",
+        kind="counter_max", target=0.0,
+        description="scheduler ticks where pods were waiting and some "
+        "live probe shape had ZERO cluster headroom — capacity "
+        "starvation no reshuffle can fix",
+    ),
 )
 
 

@@ -86,6 +86,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "capacity: capacity & fragmentation observability-plane tests "
+        "(capacity kernel twins, monitor, /debug/capacity, ktctl top "
+        "capacity, capacity SLO objectives); tier-1 includes them — "
+        "select just these with -m capacity",
+    )
+    config.addinivalue_line(
+        "markers",
         "chaos: deterministic fault-injection tests (utils/faults.py "
         "registry, injection sites, client resilience, crash-recovery "
         "properties); tier-1 includes them — select just these with "

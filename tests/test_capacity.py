@@ -1,0 +1,484 @@
+"""Capacity & fragmentation observability plane (ISSUE 16): the
+CapacityMonitor (probe assembly, series feeding, trend ring, snapshot
+contract), the /debug/capacity HTTP surface, `ktctl top capacity` and
+the cluster/nodes capacity rows, the two capacity SLO objectives, the
+live daemons' sampling cadence (per resolved tick + idle refresh), and
+the <5% always-on overhead guard.
+
+The kernel/oracle bit-exactness itself lives with the other solver
+twins in tests/test_solver_parity.py (TestCapacityParity)."""
+
+import io
+import json
+import threading
+import time
+from contextlib import redirect_stderr, redirect_stdout
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.utils import capacity as capmod
+from kubernetes_tpu.utils import metrics, slo
+
+pytestmark = pytest.mark.capacity
+
+
+def _pod_wire(name, cpu="100m", mem="64Mi"):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "pause",
+                    "resources": {"limits": {"cpu": cpu, "memory": mem}},
+                }
+            ]
+        },
+    }
+
+
+def _node_wire(name, cpu="4", mem="8Gi", pods="110"):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {}},
+        "status": {
+            "capacity": {"cpu": cpu, "memory": mem, "pods": pods},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def _cols(n, cpu_cap=1000.0, mem_cap=1024.0, pods_cap=40.0, cpu_fit=0.0):
+    """Minimal occupancy columns: n identical live nodes."""
+    ones = np.ones(n, np.float32)
+    return {
+        "cpu_cap": ones * cpu_cap,
+        "mem_cap": ones * mem_cap,
+        "pods_cap": ones * pods_cap,
+        "cpu_fit": ones * cpu_fit,
+        "mem_fit": np.zeros(n, np.float32),
+        "pods_used": np.zeros(n, np.float32),
+        "over": np.zeros(n, bool),
+        "sched": np.ones(n, bool),
+    }
+
+
+class TestProbeSet:
+    def test_defaults_are_the_slice_shapes(self):
+        m = capmod.CapacityMonitor()
+        assert m.probe_set() == list(capmod.DEFAULT_SLICE_SHAPES)
+
+    def test_backlog_quantiles_join_the_probes(self):
+        m = capmod.CapacityMonitor()
+        m.note_backlog_shapes([(100.0, 64.0)] * 9 + [(900.0, 512.0)])
+        probes = {name: (cpu, mem, k) for name, cpu, mem, k in m.probe_set()}
+        assert probes["backlog-p50"] == (100.0, 64.0, 1)
+        assert probes["backlog-max"] == (900.0, 512.0, 1)
+        # p90 interpolates between the two shapes and is ceil'd.
+        cpu90 = probes["backlog-p90"][0]
+        assert 100.0 < cpu90 <= 900.0 and cpu90 == np.ceil(cpu90)
+
+    def test_configure_replaces_slices(self):
+        m = capmod.CapacityMonitor()
+        m.configure([("tpu-v4-8", 8000.0, 16384.0, 8)])
+        assert m.probe_set() == [("tpu-v4-8", 8000.0, 16384.0, 8)]
+        m.reset()
+        assert m.probe_set() == list(capmod.DEFAULT_SLICE_SHAPES)
+
+    def test_shape_window_is_bounded(self):
+        m = capmod.CapacityMonitor()
+        m.note_backlog_shapes([(float(i), 1.0) for i in range(10_000)])
+        assert len(m._recent_shapes) == capmod.SHAPE_WINDOW
+
+
+class TestMonitor:
+    def test_cold_snapshot_contract(self):
+        m = capmod.CapacityMonitor()
+        snap = m.snapshot()
+        assert snap["kind"] == "CapacityReport"
+        assert snap["sampled"] is False and snap["samples"] == 0
+        assert snap["probes"] == [] and snap["trend"] == []
+
+    def test_sample_headroom_math(self):
+        """2 empty 1000m nodes, 600m probe: one fits per node (integral
+        greedy fit), so headroom 2 and minMember 2 is allocatable."""
+        m = capmod.CapacityMonitor()
+        m.configure([("g", 600.0, 64.0, 2)])
+        body = m.sample(_cols(2), ["a", "b"])
+        assert body is not None and body["sampled"]
+        (probe,) = body["probes"]
+        assert probe["headroom_pods"] == 2 and probe["allocatable"]
+        assert body["slice_alloc_success_rate"] == 1.0
+        assert body["live_nodes"] == 2
+        assert set(body["node_utilization"]) == {"a", "b"}
+
+    def test_full_cluster_is_starved_and_stranded(self):
+        m = capmod.CapacityMonitor()
+        m.configure([("g", 600.0, 64.0, 1)])
+        body = m.sample(
+            _cols(3, cpu_fit=900.0),  # 100m free: probe can't fit
+            ["a", "b", "c"],
+            backlog_depth=4,
+            oldest_age_s=2.5,
+        )
+        (probe,) = body["probes"]
+        assert probe["headroom_pods"] == 0 and not probe["allocatable"]
+        assert body["fragmentation_score"] == 1.0
+        assert body["stranded_node_count"] == 3
+        assert len(body["stranded_nodes"]) == 3
+        assert body["backlog"] == {
+            "depth": 4, "oldest_age_s": 2.5, "pressure": 10.0,
+        }
+
+    def test_trend_ring_and_samples_advance(self):
+        m = capmod.CapacityMonitor()
+        for _ in range(3):
+            m.sample(_cols(2), ["a", "b"])
+        snap = m.snapshot()
+        assert snap["samples"] == 3 and len(snap["trend"]) == 3
+        assert m.snapshot()["trend"] == snap["trend"]  # snapshot is a copy
+
+    def test_zero_headroom_counter_gated_on_backlog(self):
+        """The starvation counter only moves when pods are actually
+        waiting — a full-but-idle cluster is not burning its SLO."""
+        m = capmod.CapacityMonitor()
+        m.configure([("g", 600.0, 64.0, 1)])
+        full = _cols(2, cpu_fit=900.0)
+        before = capmod.ZERO_HEADROOM.value()
+        m.sample(full, ["a", "b"], backlog_depth=0)
+        assert capmod.ZERO_HEADROOM.value() == before
+        m.sample(full, ["a", "b"], backlog_depth=1, oldest_age_s=0.5)
+        assert capmod.ZERO_HEADROOM.value() == before + 1
+        # Headroom available: waiting pods alone don't count either.
+        m.sample(_cols(2), ["a", "b"], backlog_depth=1, oldest_age_s=0.5)
+        assert capmod.ZERO_HEADROOM.value() == before + 1
+
+    def test_sample_never_raises(self):
+        m = capmod.CapacityMonitor()
+        assert m.sample({}, []) is None  # missing columns
+        assert m.snapshot()["sampled"] is False
+
+    def test_padding_rows_stay_dead(self):
+        """np.pad rows (sched=False) must contribute nothing: same
+        report for a 3-node cluster and its 128-padded staging."""
+        m = capmod.CapacityMonitor()
+        body3 = m.sample(_cols(3), ["a", "b", "c"])
+        cols = _cols(3)
+        padded = {
+            k: np.pad(v, (0, 125)) for k, v in cols.items()
+        }
+        body128 = m.sample(padded, ["a", "b", "c"])
+        assert body3["fragmentation_score"] == body128["fragmentation_score"]
+        assert body3["probes"] == body128["probes"]
+        assert body3["live_nodes"] == body128["live_nodes"] == 3
+
+
+class TestSLOObjectives:
+    def test_objectives_are_registered(self):
+        objs = {o.name: o for o in slo.DEFAULT_OBJECTIVES}
+        frag = objs["capacity_fragmentation"]
+        assert frag.series == "cluster_fragmentation_score"
+        assert frag.severity == "warn" and frag.target == 0.5
+        zero = objs["capacity_zero_headroom"]
+        assert zero.series == "capacity_zero_headroom_ticks_total"
+        assert zero.kind == "counter_max" and zero.target == 0.0
+        assert zero.severity == "gate"
+
+    def test_fragmentation_warns_not_burns(self):
+        reg = metrics.Registry()
+        h = reg.histogram(
+            "cluster_fragmentation_score", "x",
+            buckets=capmod.RATIO_BUCKETS,
+        )
+        for _ in range(20):
+            h.observe(0.9)
+        objs = {o.name: o for o in slo.DEFAULT_OBJECTIVES}
+        e = slo.evaluate_objective(objs["capacity_fragmentation"], registry=reg)
+        assert e["verdict"] == "warn", e
+
+    def test_zero_headroom_burns(self):
+        reg = metrics.Registry()
+        c = reg.counter("capacity_zero_headroom_ticks_total", "x")
+        objs = {o.name: o for o in slo.DEFAULT_OBJECTIVES}
+        e = slo.evaluate_objective(objs["capacity_zero_headroom"], registry=reg)
+        assert e["verdict"] == "pass", e  # a zero counter passes
+        c.inc()
+        e = slo.evaluate_objective(objs["capacity_zero_headroom"], registry=reg)
+        assert e["verdict"] == "burn", e
+
+
+class TestHTTPSurface:
+    def test_debug_capacity_cold_and_sampled(self, monkeypatch):
+        import urllib.error
+        import urllib.request
+
+        from kubernetes_tpu.server.api import APIServer
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        monkeypatch.setattr(capmod, "DEFAULT", capmod.CapacityMonitor())
+        api = APIServer()
+        srv = APIHTTPServer(api).start()
+        try:
+            with urllib.request.urlopen(
+                srv.address + "/debug/capacity", timeout=10
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["kind"] == "CapacityReport"
+            assert body["sampled"] is False
+            capmod.DEFAULT.sample(_cols(2), ["a", "b"])
+            with urllib.request.urlopen(
+                srv.address + "/debug/capacity", timeout=10
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["sampled"] and body["samples"] == 1
+            assert {p["shape"] for p in body["probes"]} == {
+                n for n, _, _, _ in capmod.DEFAULT_SLICE_SHAPES
+            }
+            # The 404 contract advertises the endpoint.
+            try:
+                urllib.request.urlopen(
+                    srv.address + "/debug/nope", timeout=10
+                )
+                assert False, "404 expected"
+            except urllib.error.HTTPError as e:
+                assert "/debug/capacity" in e.read().decode()
+        finally:
+            srv.stop()
+
+
+class TestKtctl:
+    @staticmethod
+    def _run(client, argv):
+        from kubernetes_tpu.cli import ktctl
+
+        out, err = io.StringIO(), io.StringIO()
+        with redirect_stdout(out), redirect_stderr(err):
+            rc = ktctl.main(argv, client=client)
+        return rc, out.getvalue(), err.getvalue()
+
+    @pytest.fixture
+    def client(self, monkeypatch):
+        from kubernetes_tpu.client import Client, LocalTransport
+        from kubernetes_tpu.server.api import APIServer
+
+        monkeypatch.setattr(capmod, "DEFAULT", capmod.CapacityMonitor())
+        return Client(LocalTransport(APIServer()))
+
+    def test_miss_contract(self, client):
+        """Cold cluster: exit 1, 'no capacity samples recorded' on
+        stderr, EMPTY stdout (the trace/explain/slo miss contract)."""
+        rc, out, err = self._run(client, ["top", "capacity"])
+        assert rc == 1
+        assert out == ""
+        assert "no capacity samples recorded" in err
+
+    def test_table_json_yaml(self, client):
+        capmod.DEFAULT.note_backlog_shapes([(100.0, 64.0)])
+        capmod.DEFAULT.sample(
+            _cols(2), ["a", "b"], backlog_depth=2, oldest_age_s=1.0
+        )
+        rc, out, _ = self._run(client, ["top", "capacity"])
+        assert rc == 0
+        assert "fragmentation:" in out and "SHAPE" in out
+        assert "slice-8x2000m" in out and "backlog-p50" in out
+        rc, out, _ = self._run(client, ["top", "capacity", "-o", "json"])
+        assert rc == 0
+        parsed = json.loads(out)
+        assert parsed["kind"] == "CapacityReport" and parsed["sampled"]
+        rc, out, _ = self._run(client, ["top", "capacity", "-o", "yaml"])
+        assert rc == 0 and "kind: CapacityReport" in out
+
+    def test_top_cluster_capacity_row(self, client):
+        capmod.DEFAULT.sample(_cols(2), ["a", "b"])
+        rc, out, _ = self._run(client, ["top", "cluster"])
+        assert rc == 0
+        (row,) = [l for l in out.splitlines() if l.startswith("CAPACITY")]
+        assert "fragmentation=" in row and "min-headroom" in row
+        # The capacity series also ride the TELEMETRY section.
+        assert "cluster_fragmentation_score" in out
+
+    def test_top_nodes_util_column(self, client):
+        """`ktctl top nodes` carries UTIL% from the capacity plane's
+        per-node view (no second kubelet scrape)."""
+        client.create("nodes", _node_wire("n0"))
+        cols = _cols(1, cpu_cap=4000.0, cpu_fit=3000.0)
+        capmod.DEFAULT.sample(cols, ["n0"])
+        rc, out, err = self._run(client, ["top", "nodes"])
+        assert rc == 0
+        assert "UTIL%" in out.splitlines()[0]
+        # 3000/4000 cpu is the binding resource: 75%. No HTTP server
+        # here, so the kubelet columns dash out and UTIL% still joins.
+        (row,) = [l for l in out.splitlines() if l.startswith("n0")]
+        assert "75%" in row
+
+
+def _mk_cluster():
+    """In-process cluster: apiserver + LocalTransport + plain
+    BatchScheduler (no session — the cluster_columns sampling path)."""
+    from kubernetes_tpu.client import Client, LocalTransport
+    from kubernetes_tpu.scheduler.daemon import (
+        BatchScheduler,
+        SchedulerConfig,
+    )
+    from kubernetes_tpu.server.api import APIServer
+
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    for j in range(2):
+        client.create("nodes", _node_wire(f"n{j}"))
+    cfg = SchedulerConfig(Client(LocalTransport(api))).start()
+    assert cfg.wait_for_sync(timeout=60), "caches never synced"
+    return api, client, cfg, BatchScheduler(cfg)
+
+
+class TestLiveDaemons:
+    def test_batch_scheduler_samples_per_tick(self, monkeypatch):
+        """The plain BatchScheduler (no session) samples through
+        cluster_columns after every resolved tick, noting the tick's
+        backlog shapes — so the probe table grows backlog quantiles."""
+        monkeypatch.setattr(capmod, "DEFAULT", capmod.CapacityMonitor())
+        api, client, cfg, sched = _mk_cluster()
+        try:
+            for i in range(4):
+                client.create("pods", _pod_wire(f"cap-{i}", cpu="250m"))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                sched.schedule_batch(timeout=0.2)
+                if capmod.DEFAULT.snapshot().get("sampled"):
+                    break
+            snap = capmod.DEFAULT.snapshot()
+            assert snap["sampled"], "tick never sampled capacity"
+            shapes = {p["shape"] for p in snap["probes"]}
+            assert "backlog-p50" in shapes and "slice-1x250m" in shapes
+            assert snap["live_nodes"] == 2
+            # Idle ticks keep the plane fresh past the refresh window.
+            first = snap["samples"]
+            monkeypatch.setattr(sched, "CAPACITY_IDLE_REFRESH_S", 0.0)
+            sched.schedule_batch(timeout=0.01)
+            assert capmod.DEFAULT.snapshot()["samples"] > first
+        finally:
+            cfg.stop()
+
+    def test_incremental_scheduler_samples_from_session(self, monkeypatch):
+        """The session-backed daemon samples off the host mirror it
+        just solved against, inside its own `capacity` phase span."""
+        from kubernetes_tpu.client import Client, LocalTransport
+        from kubernetes_tpu.scheduler.daemon import (
+            IncrementalBatchScheduler,
+            SchedulerConfig,
+        )
+        from kubernetes_tpu.server.api import APIServer
+        from kubernetes_tpu.utils import tracing
+
+        monkeypatch.setattr(capmod, "DEFAULT", capmod.CapacityMonitor())
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        config = SchedulerConfig(Client(LocalTransport(api))).start()
+        assert config.wait_for_sync(timeout=60)
+        sched = IncrementalBatchScheduler(config).start()
+        try:
+            for j in range(2):
+                client.create("nodes", _node_wire(f"n{j}"))
+            frag_before = capmod.FRAG_SCORE.count()
+            for i in range(6):
+                client.create("pods", _pod_wire(f"inc-{i}"))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                snap = capmod.DEFAULT.snapshot()
+                # The idle refresh may sample the pre-node cluster
+                # first; wait for a sample that saw both nodes.
+                if snap.get("sampled") and snap.get("live_nodes") == 2:
+                    break
+                time.sleep(0.05)
+            assert snap["sampled"], "micro-tick never sampled capacity"
+            assert snap["live_nodes"] == 2
+            assert {"n0", "n1"} <= set(snap["node_utilization"])
+            # The always-on series moved with the sample.
+            assert capmod.FRAG_SCORE.count() > frag_before
+            assert capmod.HEADROOM.value(shape="slice-1x250m") >= 0
+            # The sample ran inside its own phase span.
+            assert tracing.PHASE_SECONDS.count(phase="capacity") >= 1
+        finally:
+            sched.stop()
+            config.stop()
+
+
+class TestOverheadGuard:
+    """Per-tick capacity sampling must stay affordable enough for the
+    always-on cadence: <5% of the bulk-churn drill's wall (the same
+    bar the SLI collector holds in test_sli.py)."""
+
+    def test_capacity_cost_under_5pct_of_bulk_churn(self):
+        from kubernetes_tpu.client import Client, HTTPTransport
+        from kubernetes_tpu.server.api import APIServer
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        n_pods, batch = 2000, 500
+        # Warm the one-time compile out of both timed sections (the
+        # daemons pay it once per process, not per tick).
+        m = capmod.CapacityMonitor()
+        m.note_backlog_shapes([(100.0, 64.0)] * 8)
+        warm_cols = _cols(256)
+        assert m.sample(warm_cols, [f"n{j}" for j in range(256)])
+
+        api = APIServer()
+        srv = APIHTTPServer(api, max_in_flight=800).start()
+        try:
+            client = Client(HTTPTransport(srv.address))
+            stream = Client(HTTPTransport(srv.address)).watch(
+                "pods", namespace="default"
+            )
+            seen = {"n": 0}
+
+            def consume():
+                while seen["n"] < 2 * n_pods:
+                    ev = stream.next(timeout=10.0)
+                    if ev is None:
+                        if stream.closed:
+                            return
+                        continue
+                    seen["n"] += 1
+
+            watcher = threading.Thread(target=consume, daemon=True)
+            t0 = time.perf_counter()
+            watcher.start()
+            for s in range(0, n_pods, batch):
+                items = [
+                    _pod_wire(f"cap-ov-{i}") for i in range(s, s + batch)
+                ]
+                res = client.create_bulk("pods", items, namespace="default")
+                assert all(r.get("status") == "Success" for r in res)
+            for s in range(0, n_pods, batch):
+                client.delete_bulk(
+                    "pods",
+                    [f"cap-ov-{i}" for i in range(s, s + batch)],
+                    namespace="default",
+                )
+            watcher.join(timeout=30)
+            drill_wall = time.perf_counter() - t0
+            stream.close()
+            assert seen["n"] >= 2 * n_pods, seen
+        finally:
+            srv.stop()
+
+        # Standalone per-tick cost: one capacity sample per drill batch
+        # (the daemons sample once per resolved tick), 256-node columns.
+        # Best of three repeats: a GC pass landing inside one repeat
+        # must not fail the guard.
+        names = [f"n{j}" for j in range(256)]
+        ticks = 2 * n_pods // batch
+        cost = float("inf")
+        for _repeat in range(3):
+            t0 = time.perf_counter()
+            for _ in range(ticks):
+                m.note_backlog_shapes([(100.0, 64.0)] * 4)
+                m.sample(
+                    warm_cols, names, backlog_depth=3, oldest_age_s=0.4
+                )
+            cost = min(cost, time.perf_counter() - t0)
+        assert cost < 0.05 * drill_wall, (
+            f"capacity sampling cost {cost:.4f}s is >=5% of the "
+            f"{drill_wall:.4f}s bulk-churn drill"
+        )

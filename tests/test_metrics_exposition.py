@@ -496,6 +496,82 @@ def test_profiler_metrics_exposed():
     )
 
 
+def test_lint_metrics_knows_capacity_names(tmp_path):
+    """The capacity & fragmentation plane family (utils/capacity.py) is
+    known to the linter: node_utilization_ratio and the zero-headroom
+    _total counter pass the standard rule on their own, the unit-less
+    score/rate/headroom/pressure series are explicitly allowlisted, and
+    a novel suffix-less capacity name still fails (the allowlist names
+    metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, CAPACITY_METRICS
+
+    assert CAPACITY_METRICS == {
+        "cluster_fragmentation_score",
+        "cluster_headroom_pods",
+        "slice_alloc_success_rate",
+        "scheduler_backlog_pressure",
+    }
+    assert CAPACITY_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.histogram("cluster_fragmentation_score", "x")\n'
+        'B = metrics.DEFAULT.histogram('
+        '"node_utilization_ratio", "x", ("resource",))\n'
+        'C = metrics.DEFAULT.gauge("cluster_headroom_pods", "x", ("shape",))\n'
+        'D = metrics.DEFAULT.histogram("slice_alloc_success_rate", "x")\n'
+        'E = metrics.DEFAULT.gauge("scheduler_backlog_pressure", "x")\n'
+        'F = metrics.DEFAULT.counter('
+        '"capacity_zero_headroom_ticks_total", "x")\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("cluster_stranded", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_capacity_metrics_exposed():
+    """Exposition golden for the capacity-plane family: the score/rate
+    histograms render cumulative +le buckets on the ratio ladder, the
+    pressure gauge and zero-headroom counter carry their declared
+    types, and the per-shape headroom gauge escapes hostile shape
+    label values (an operator-configured probe name can never corrupt
+    the exposition)."""
+    from kubernetes_tpu.utils import capacity as capmod
+
+    capmod.FRAG_SCORE.observe(0.35)
+    capmod.SLICE_ALLOC.observe(0.75)
+    capmod.HEADROOM.set(12.0, shape='we"ird\\shape\nx')
+    capmod.BACKLOG_PRESSURE.set(2.5)
+    capmod.NODE_UTIL.observe(0.55, resource="cpu")
+    capmod.ZERO_HEADROOM.inc()
+    text = metrics.DEFAULT.render()
+    assert "# TYPE cluster_fragmentation_score histogram" in text
+    assert 'cluster_fragmentation_score_bucket{le="0.4"}' in text
+    assert 'cluster_fragmentation_score_bucket{le="+Inf"}' in text
+    assert "# TYPE slice_alloc_success_rate histogram" in text
+    assert 'slice_alloc_success_rate_bucket{le="0.8"}' in text
+    assert "# TYPE node_utilization_ratio histogram" in text
+    assert 'node_utilization_ratio_bucket{resource="cpu",le="0.6"}' in text
+    assert "# TYPE cluster_headroom_pods gauge" in text
+    # Label escaping on the shape label.
+    assert (
+        'cluster_headroom_pods{shape="we\\"ird\\\\shape\\nx"} 12.0' in text
+    )
+    assert "# TYPE scheduler_backlog_pressure gauge" in text
+    assert "scheduler_backlog_pressure 2.5" in text
+    assert "# TYPE capacity_zero_headroom_ticks_total counter" in text
+
+
 def test_lint_metrics_knows_preemption_names(tmp_path):
     """The preemption_* family (scheduler/daemon.py) is known to the
     linter: the _total counters pass the standard rule, the unitless

@@ -224,6 +224,13 @@ class TestLedgerCompleteness:
         solve_with_state_pallas(                            # _solve_packed
             d.pods, d.nodes, DEFAULT_WEIGHTS, interpret=True
         )
+        from kubernetes_tpu.utils.capacity import (
+            DEFAULT as capacity_monitor,
+            cluster_columns,
+        )
+
+        cols, names = cluster_columns(nodes, [])
+        assert capacity_monitor.sample(cols, names)         # capacity_report
 
         assert ledger.DEFAULT.wait_pending(180), (
             "cost harvest never drained"

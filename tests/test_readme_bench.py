@@ -22,3 +22,40 @@ def test_readme_bench_table_matches_newest_artifact():
         "README.md's bench table has drifted from the newest BENCH "
         "artifact — run `python tools/update_readme_bench.py`."
     )
+
+
+def test_capacity_row_renders_from_figure_keys():
+    """ISSUE 16: artifacts carrying the capacity-plane figure keys get
+    a table row with the fragmentation score, slice-alloc rate, and
+    the tightest probe shape."""
+    from tools import update_readme_bench as urb
+
+    block = urb.render("BENCH_test.json", {
+        "fragmentation_score": 0.176471,
+        "slice_alloc_success_rate": 0.666667,
+        "cluster_headroom_pods": {"slice-1x250m": 4, "slice-4x500m": 0},
+    })
+    (row,) = [
+        line for line in block.splitlines()
+        if "Capacity & fragmentation" in line
+    ]
+    assert "**0.176**" in row, row
+    assert "67%" in row, row
+    assert "slice-4x500m" in row and "0 pods headroom" in row, row
+
+
+def test_capacity_row_omitted_when_keys_absent():
+    """Pre-ISSUE-16 artifacts must not invent a capacity row (the
+    generator's contract: absent keys -> omitted row, never a crash)."""
+    from tools import update_readme_bench as urb
+
+    block = urb.render("BENCH_test.json", {"pod_crud_ops_per_sec": 100.0})
+    assert "Capacity & fragmentation" not in block
+    # Headroom map absent but score present: row renders without the
+    # tightest-probe clause rather than crashing on min() of nothing.
+    block = urb.render("BENCH_test.json", {"fragmentation_score": 0.5})
+    (row,) = [
+        line for line in block.splitlines()
+        if "Capacity & fragmentation" in line
+    ]
+    assert "tightest probe" not in row, row

@@ -628,3 +628,134 @@ class TestSpreadingParityRegressions:
         nodes = [mk_node("n0", cpu=4000)]
         scalar, batch = assert_parity(pods, nodes, assigned=[done])
         assert scalar == ["n0"]  # failed pod's cpu is released
+
+
+def random_capacity_args(seed):
+    """Random occupancy-column + probe-shape inputs for the capacity
+    kernel twins — the raw f32/i32/b8 arrays both sides consume, over
+    the same cap/fit value space the column builders emit (integral
+    milli-cpu and MiB columns, masked/overcommitted nodes, dead
+    probes, zero-request probes)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 300))
+    q = int(rng.integers(1, 12))
+    cpu_cap = rng.choice([0.0, 1000.0, 2000.0, 4000.0, 8000.0], n).astype(
+        np.float32
+    )
+    mem_cap = rng.choice([0.0, 1024.0, 4096.0, 8192.0], n).astype(np.float32)
+    pods_cap = rng.choice([0.0, 3.0, 10.0, 40.0, 110.0], n).astype(np.float32)
+    cpu_fit = np.floor(cpu_cap * rng.random(n) * 1.2).astype(np.float32)
+    mem_fit = np.floor(mem_cap * rng.random(n) * 1.2).astype(np.float32)
+    pods_used = np.floor(pods_cap * rng.random(n)).astype(np.float32)
+    over = rng.random(n) < 0.1
+    sched = rng.random(n) > 0.15
+    probe_cpu = rng.choice(
+        [0.0, 50.0, 100.0, 250.0, 500.0, 2000.0], q
+    ).astype(np.float32)
+    probe_mem = rng.choice([0.0, 16.0, 64.0, 256.0, 2048.0], q).astype(
+        np.float32
+    )
+    probe_min = rng.integers(1, 9, q).astype(np.int32)
+    probe_live = rng.random(q) > 0.2
+    return (
+        cpu_cap, mem_cap, pods_cap, cpu_fit, mem_fit, pods_used, over,
+        sched, probe_cpu, probe_mem, probe_min, probe_live,
+    )
+
+
+@pytest.mark.capacity
+class TestCapacityParity:
+    """ops/capacity.capacity_report vs ops.oracle.capacity_report_numpy:
+    BIT-EXACT on every leaf (np.array_equal, no tolerance) — the
+    kernel's cross-node/cross-probe reductions are int32-quantized
+    precisely so reduction order cannot split the twins."""
+
+    @staticmethod
+    def _assert_bit_exact(args):
+        import numpy as np
+
+        from kubernetes_tpu.ops.capacity import capacity_report
+        from kubernetes_tpu.ops.oracle import capacity_report_numpy
+
+        dev = capacity_report(*args)
+        ora = capacity_report_numpy(*args)
+        assert len(dev) == len(ora) == 11
+        for i, (d, o) in enumerate(zip(dev, ora)):
+            d, o = np.asarray(d), np.asarray(o)
+            assert d.shape == o.shape, f"leaf {i}: {d.shape} != {o.shape}"
+            assert d.dtype == o.dtype, f"leaf {i}: {d.dtype} != {o.dtype}"
+            assert np.array_equal(d, o), f"leaf {i} diverged"
+        return ora
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_columns_bit_exact(self, seed):
+        self._assert_bit_exact(random_capacity_args(seed))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_cluster_columns_bit_exact(self, seed):
+        """The watch-cache column builder (utils/capacity.py
+        cluster_columns) feeding both twins on randomized object-graph
+        clusters — the plain BatchScheduler's whole sampling path."""
+        import numpy as np
+
+        from kubernetes_tpu.utils.capacity import cluster_columns
+
+        pods, nodes, assigned, services = random_cluster(seed)
+        for p, d in zip(pods, schedule_backlog_tpu(pods, nodes, assigned)):
+            if d is not None:
+                p.spec.node_name = d
+        cols, names = cluster_columns(nodes, list(assigned) + list(pods))
+        probe_cpu = np.asarray([100.0, 500.0, 2000.0, 0.0], np.float32)
+        probe_mem = np.asarray([64.0, 512.0, 2048.0, 0.0], np.float32)
+        probe_min = np.asarray([1, 4, 8, 1], np.int32)
+        probe_live = np.asarray([True, True, True, False])
+        self._assert_bit_exact(
+            (
+                cols["cpu_cap"], cols["mem_cap"], cols["pods_cap"],
+                cols["cpu_fit"], cols["mem_fit"], cols["pods_used"],
+                cols["over"], cols["sched"],
+                probe_cpu, probe_mem, probe_min, probe_live,
+            )
+        )
+
+    def test_terminating_and_terminal_pods_release_columns(self):
+        """cluster_columns frees Terminating and terminal-phase pods'
+        charges — their capacity is (about to be) free, so the probes
+        must see it (filterNonRunningPods semantics)."""
+        from kubernetes_tpu.utils.capacity import cluster_columns
+
+        a = mk_pod("a0", cpu=3900, mem_mib=64)
+        a.spec.node_name = "n0"
+        cols, _ = cluster_columns([mk_node("n0", cpu=4000)], [a])
+        assert cols["cpu_fit"][0] == 3900
+        a.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+        cols, _ = cluster_columns([mk_node("n0", cpu=4000)], [a])
+        assert cols["cpu_fit"][0] == 0
+        a.metadata.deletion_timestamp = None
+        a.status.phase = "Succeeded"
+        cols, _ = cluster_columns([mk_node("n0", cpu=4000)], [a])
+        assert cols["cpu_fit"][0] == 0
+
+    def test_gang_probe_allocatability(self):
+        """A probe's minMember is the gang acceptance bound: headroom
+        below it reads not-allocatable even when single pods still
+        fit (all-or-nothing, same rule as the gang solver)."""
+        import numpy as np
+
+        ones = np.ones(2, np.float32)
+        zeros = np.zeros(2, np.float32)
+        args = (
+            ones * 1000.0, ones * 1024.0, ones * 40.0,  # caps
+            zeros, zeros, zeros,  # nothing charged
+            np.zeros(2, bool), np.ones(2, bool),  # all live
+            np.asarray([600.0, 600.0], np.float32),
+            np.asarray([64.0, 64.0], np.float32),
+            np.asarray([2, 3], np.int32),  # gang bounds
+            np.ones(2, bool),
+        )
+        out = self._assert_bit_exact(args)
+        headroom, slice_ok = out[4], out[6]
+        assert list(headroom) == [2, 2]  # one 600m pod per 1000m node
+        assert list(slice_ok) == [True, False]  # minMember 2 ok, 3 not

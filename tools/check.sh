@@ -596,6 +596,135 @@ if [ $rc -eq 0 ]; then
     rc=$prof_rc
 fi
 
+# Capacity smoke (ISSUE 16): the capacity & fragmentation plane end
+# to end — miss contract first (`ktctl top capacity` exits 1 with "no
+# capacity samples recorded" before any daemon sampled), then fill a
+# small cluster until every probe shape hits ZERO headroom with free
+# capacity still on every node (the textbook stranded state) and
+# assert the populated contract: /debug/capacity reports stranded
+# nodes, `ktctl top capacity` exits 0 with the probe table, and the
+# capacity_fragmentation SLO objective flips to warn.
+echo "== capacity smoke (fragmentation + stranded headroom) =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stderr, redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.scheduler.daemon import (
+    IncrementalBatchScheduler, SchedulerConfig,
+)
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+N_NODES = 6
+
+api = APIServer()
+srv = APIHTTPServer(api, max_in_flight=800).start()
+client = Client(HTTPTransport(srv.address))
+
+# Miss contract FIRST (no sample taken yet): exit 1, empty stdout,
+# the reason on stderr — mirror of ktctl slo/trace/explain.
+out, err = io.StringIO(), io.StringIO()
+with redirect_stdout(out), redirect_stderr(err):
+    rc = ktctl.main(["top", "capacity"], client=client)
+assert rc == 1, (rc, out.getvalue(), err.getvalue())
+assert out.getvalue() == "", out.getvalue()
+assert "no capacity samples recorded" in err.getvalue(), err.getvalue()
+
+client.create_bulk("nodes", [
+    {"kind": "Node", "metadata": {"name": f"n{j}"},
+     "status": {"capacity": {"cpu": "1", "memory": "2Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}]}}
+    for j in range(N_NODES)
+])
+cfg = SchedulerConfig(Client(HTTPTransport(srv.address))).start()
+assert cfg.wait_for_sync(timeout=60), "scheduler caches never synced"
+sched = IncrementalBatchScheduler(cfg).start()
+
+def pod(name):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "pause",
+                     "resources": {"limits": {"cpu": "800m",
+                                              "memory": "256Mi"}}}]}}
+
+# One 800m pod per 1000m node: every node keeps 200m free, which no
+# probe shape (smallest: 250m) can use — zero headroom everywhere
+# while capacity still exists. Two more stay Pending for backlog
+# pressure.
+res = client.create_bulk(
+    "pods", [pod(f"cap-{i}") for i in range(N_NODES + 2)],
+    namespace="default",
+)
+assert all(r.get("status") == "Success" for r in res)
+deadline = time.monotonic() + 120
+bound = 0
+while time.monotonic() < deadline and bound < N_NODES:
+    pods, _ = client.list("pods", namespace="default")
+    bound = sum(1 for p in pods if p.spec.node_name)
+    if bound < N_NODES:
+        time.sleep(0.25)
+assert bound == N_NODES, f"only {bound}/{N_NODES} bound"
+
+def capacity_report():
+    with urllib.request.urlopen(
+        srv.address + "/debug/capacity", timeout=10
+    ) as r:
+        return json.loads(r.read())
+
+deadline = time.monotonic() + 30
+snap = {}
+while time.monotonic() < deadline:
+    snap = capacity_report()
+    if (snap.get("sampled") and snap.get("stranded_node_count", 0) > 0
+            and any(p["headroom_pods"] == 0 for p in snap["probes"])):
+        break
+    time.sleep(0.25)
+assert snap.get("sampled"), snap
+assert snap["stranded_node_count"] > 0, snap
+zero = [p["shape"] for p in snap["probes"] if p["headroom_pods"] == 0]
+assert zero, snap["probes"]
+assert snap["fragmentation_score"] > 0.5, snap
+
+# The SLO plane must read the same state: capacity_fragmentation warns.
+def slo_report():
+    with urllib.request.urlopen(srv.address + "/debug/slo", timeout=10) as r:
+        return json.loads(r.read())
+
+deadline = time.monotonic() + 30
+frag_obj = {}
+while time.monotonic() < deadline:
+    objs = {o["name"]: o for o in slo_report()["objectives"]}
+    frag_obj = objs.get("capacity_fragmentation", {})
+    if frag_obj.get("verdict") in ("warn", "burn"):
+        break
+    time.sleep(0.25)
+assert frag_obj.get("verdict") in ("warn", "burn"), frag_obj
+
+# Populated ktctl contract: exit 0, probe table present.
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["top", "capacity"], client=client)
+text = out.getvalue()
+assert rc == 0, text
+assert "slice-1x250m" in text and "fragmentation" in text, text
+sched.stop()
+srv.stop()
+print(f"capacity smoke OK: {N_NODES} nodes filled to zero headroom "
+      f"({', '.join(zero)}); fragmentation="
+      f"{snap['fragmentation_score']} stranded="
+      f"{snap['stranded_node_count']} -> capacity_fragmentation "
+      f"{frag_obj['verdict']}; miss contract held")
+EOF
+cap_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$cap_rc
+fi
+
 # Soak smoke (ISSUE 15): ~200 hollow nodes (real kubelets, no-op
 # runtime) driving the full API→solve→bind→kubelet loop while the
 # seeded chaos schedule fires ONE apiserver kill -9 (torn WAL write →

@@ -116,6 +116,25 @@ PROFILER_METRICS = {
 }
 ALLOWLIST |= PROFILER_METRICS
 
+#: Capacity & fragmentation plane family (utils/capacity.py, sampled
+#: by scheduler/daemon.py — see docs/architecture.md "Capacity &
+#: fragmentation plane"). node_utilization_ratio carries _ratio and
+#: capacity_zero_headroom_ticks_total carries _total on their own;
+#: the score/rate histograms are unit-less [0, 1] ratios on the
+#: profiler's ratio ladder, cluster_headroom_pods is a unitless
+#: snapshot gauge (a count of placeable probe pods, like
+#: gang_pending_groups), and scheduler_backlog_pressure is a composite
+#: (pods x seconds) watermark — all allowlisted explicitly so the
+#: linter documents the whole family rather than silently tolerating
+#: it.
+CAPACITY_METRICS = {
+    "cluster_fragmentation_score",
+    "cluster_headroom_pods",
+    "slice_alloc_success_rate",
+    "scheduler_backlog_pressure",
+}
+ALLOWLIST |= CAPACITY_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"

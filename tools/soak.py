@@ -72,6 +72,7 @@ from kubernetes_tpu.scheduler.daemon import (
 )
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.store.kvstore import KVStore
+from kubernetes_tpu.utils import capacity as capmod
 from kubernetes_tpu.utils import faults, sli, tracing
 
 #: Epoch registry order — the full default schedule. build_schedule
@@ -439,6 +440,8 @@ class InvariantChecker:
         self.violations: List[dict] = []
         self._sli_start = self._sli_counts()
         self._sli_prev = dict(self._sli_start)
+        self.capacity_timeline: List[dict] = []
+        self._cap_prev = self._cap_samples()
 
     @staticmethod
     def _sli_counts() -> Dict[str, int]:
@@ -446,6 +449,10 @@ class InvariantChecker:
             m: sli.STARTUP_LATENCY.count(milestone=m)
             for m in ("decision", "bound", "running")
         }
+
+    @staticmethod
+    def _cap_samples() -> int:
+        return int(capmod.DEFAULT.snapshot().get("samples", 0))
 
     def _viol(self, epoch: str, invariant: str, detail: str) -> None:
         self.violations.append(
@@ -480,6 +487,7 @@ class InvariantChecker:
         self._check_gangs(epoch, client)
         self._check_nominations(epoch, client)
         self._check_slo_epoch(epoch)
+        self._check_capacity_epoch(epoch)
 
     def _check_slo_epoch(self, epoch: str) -> None:
         """Every SLI milestone series must advance across EVERY epoch
@@ -503,6 +511,37 @@ class InvariantChecker:
                 f"(prev={prev}, now={last[0]})",
             )
         self._sli_prev = last[0]
+
+    def _check_capacity_epoch(self, epoch: str) -> None:
+        """The capacity monitor must take at least one new sample per
+        epoch (per resolved tick + idle refresh, ISSUE 16) — a stalled
+        counter means the fragmentation/headroom plane went dark under
+        faults. The advance is also recorded as a per-epoch timeline
+        row in the artifact."""
+        prev = self._cap_prev
+
+        def advanced():
+            return self._cap_samples() > prev
+
+        if not _wait_until(advanced, timeout=30.0, interval=0.5):
+            self._viol(
+                epoch, "capacity_sampling_advancing",
+                f"capacity samples stalled across the epoch "
+                f"(prev={prev}, now={self._cap_samples()})",
+            )
+        snap = capmod.DEFAULT.snapshot()
+        self._cap_prev = int(snap.get("samples", 0))
+        row = {"epoch": epoch, "samples": self._cap_prev}
+        if snap.get("sampled"):
+            row.update({
+                "fragmentation_score": snap["fragmentation_score"],
+                "slice_alloc_success_rate": snap[
+                    "slice_alloc_success_rate"
+                ],
+                "stranded_node_count": snap["stranded_node_count"],
+                "backlog_pressure": snap["backlog"]["pressure"],
+            })
+        self.capacity_timeline.append(row)
 
     def _check_store_vs_mirror(self, epoch: str, client: Client) -> None:
         """kvstore LIST == watch-derived mirror (retrying while the
@@ -948,6 +987,7 @@ def run_soak(
         "bind_p99_s": _p(0.99, lat),
         "post_fault_bind_p50_s": _p(0.50, post_slice),
         "post_fault_bind_p99_s": _p(0.99, post_slice),
+        "capacity_timeline": checker.capacity_timeline,
         "invariant_violations": checker.violations,
         "wall_s": round(time.monotonic() - t_start, 1),
     }
