@@ -1439,6 +1439,86 @@ def _cmd_top_capacity(client: Client, args) -> int:
     return 0
 
 
+def _fetch_rebalance_report(client: Client, args) -> Dict:
+    """The rebalance report: GET /debug/rebalance over HTTP
+    transports, or the process-local monitor for injected
+    LocalTransport clients (utils/rebalance keeps jax off its import
+    path — same split as the capacity fetch above)."""
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        return get_json("/debug/rebalance")
+    from kubernetes_tpu.utils import rebalance
+
+    return rebalance.DEFAULT.snapshot()
+
+
+def cmd_rebalance(client: Client, args) -> int:
+    """`ktctl rebalance plan|status` — the rebalancing plane: the
+    descheduler's last defrag plan (per-move table) or its cycle
+    status (scores, move outcomes, improvement trend). Exits 1 with
+    'no rebalance samples recorded' until the first executed defrag
+    cycle (the trace/explain/slo/capacity miss contract)."""
+    report = _fetch_rebalance_report(client, args)
+    if not report.get("sampled"):
+        # Clean nonzero exit, empty stdout: a script gating on defrag
+        # must see that nothing ran, not a hollow table.
+        print("no rebalance samples recorded", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(report, default_flow_style=False))
+        return 0
+    cycle = report.get("last_cycle", {})
+    plan = report.get("last_plan", {})
+    if args.what == "plan":
+        print(
+            f"score: {plan.get('score_before', 0.0):.4f} -> "
+            f"{plan.get('score_after', 0.0):.4f} (forecast)  "
+            f"budget: {plan.get('move_budget', 0)}  "
+            f"movable: {plan.get('movable_pods', 0)}"
+        )
+        dropped = plan.get("dropped_partial_gangs", ())
+        if dropped:
+            print("dropped partial gangs: " + " ".join(dropped))
+        print()
+        print(f"{'POD':32}{'FROM':16}{'TO':16}{'GAIN':>6}  KIND")
+        for m in plan.get("moves", ()):
+            kind = "gang" if m.get("gang") else (
+                "drain" if m.get("forced") else "defrag"
+            )
+            print(
+                f"{m.get('pod', ''):32}{m.get('from', ''):16}"
+                f"{m.get('to', ''):16}{m.get('gain', 0):>6}  {kind}"
+            )
+        return 0
+    print(
+        f"cycles: {report.get('samples', 0)}  last: "
+        f"{cycle.get('score_before', 0.0):.4f} -> "
+        f"{cycle.get('score_after', 0.0):.4f} "
+        f"(improvement {cycle.get('improvement', 0.0):.4f}, "
+        f"{cycle.get('moves_executed', 0)} moves, "
+        f"{cycle.get('trigger', '')})"
+    )
+    outcomes = report.get("outcomes", {})
+    if outcomes:
+        print(
+            "moves: "
+            + "  ".join(
+                f"{k}={outcomes[k]}" for k in sorted(outcomes)
+            )
+        )
+    trend = report.get("trend", ())
+    if trend:
+        print(
+            f"trend ({len(trend)} cycles): "
+            + " ".join(f"{v:.3f}" for v in trend[-12:])
+        )
+    return 0
+
+
 def _cmd_top_cluster(client: Client, args) -> int:
     """`ktctl top cluster` — the cluster-level resource view: SLO
     verdict table, the capacity plane's headline row, plus the raw
@@ -1682,6 +1762,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sl = sub.add_parser("slo", parents=[common])
     sl.set_defaults(fn=cmd_slo)
+
+    rb = sub.add_parser("rebalance", parents=[common])
+    rb.add_argument("what", nargs="?", default="status",
+                    choices=["plan", "status"])
+    rb.set_defaults(fn=cmd_rebalance)
 
     pf2 = sub.add_parser("profile", parents=[common])
     pf2.add_argument(

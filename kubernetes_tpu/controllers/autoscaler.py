@@ -1,0 +1,254 @@
+"""Autoscaler: elastic node pools closing the capacity loop.
+
+The capacity plane (PR 16) distinguishes two starvation modes: a
+fragmented cluster (free capacity exists as unusable shards — the
+descheduler's job) and a genuinely full one (``capacity_zero_headroom
+_ticks_total`` burning while pods wait — no reshuffle can fix it,
+only capacity can). This controller handles the second mode, and the
+reverse: sustained low utilization with an empty backlog means paid
+capacity idling, so the pool shrinks back.
+
+Grow: ``grow_after`` consecutive polls observing starvation (the
+zero-headroom counter advanced since the last poll, OR a non-empty
+pending backlog with no schedulable headroom signal) add
+``grow_step`` nodes through the pool provider.
+
+Shrink: ``shrink_after`` consecutive polls of mean live-node CPU
+utilization below ``low_util`` with an EMPTY backlog start a drain:
+the emptiest pool node is cordoned (``spec.unschedulable`` — the
+columns drop it from every solve), its pods move out through the
+descheduler's graceful journal/evict/nominate path (``drain_node`` —
+the SAME eviction machinery as defrag, never a force-delete), and
+only once the node is observably empty does the provider retire it.
+A node that refuses to empty stays cordoned and the drain retries
+next poll — shrink never races its own evictions.
+
+The pool provider is duck-typed (see tools/soak.py's hollow-node
+pool): ``name``, ``size()``, ``grow(n) -> [node_names]``,
+``shrink(node_name)``. Providers own node object lifecycle (a real
+provider deregisters the kubelet; the hollow pool stops the thread
+and deletes the Node).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.capacity import ZERO_HEADROOM, cluster_columns
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.autoscaler")
+
+POOL_SIZE = metrics.DEFAULT.gauge(
+    "autoscaler_pool_size",
+    "Current node count of each elastic pool",
+    ("pool",),
+)
+SCALE_EVENTS = metrics.DEFAULT.counter(
+    "autoscaler_scale_events_total",
+    "Pool resize decisions by direction (up/down)",
+    ("direction",),
+)
+_SYNCS = metrics.DEFAULT.counter(
+    "autoscaler_syncs_total", "Autoscaler evaluation passes", ("result",)
+)
+
+
+class Autoscaler:
+    """Periodic pool-size controller. ``sync_once()`` works without
+    ``start()`` — tests and the soak harness drive polls directly."""
+
+    def __init__(
+        self,
+        client,
+        pool,
+        sync_period: float = 10.0,
+        min_size: int = 1,
+        max_size: int = 16,
+        grow_after: int = 3,
+        grow_step: int = 1,
+        shrink_after: int = 6,
+        low_util: float = 0.25,
+        descheduler=None,
+    ):
+        self.client = client
+        self.pool = pool
+        self.sync_period = sync_period
+        self.min_size = int(min_size)
+        self.max_size = int(max_size)
+        self.grow_after = int(grow_after)
+        self.grow_step = int(grow_step)
+        self.shrink_after = int(shrink_after)
+        self.low_util = float(low_util)
+        if descheduler is None:
+            from kubernetes_tpu.controllers.descheduler import Descheduler
+
+            descheduler = Descheduler(client)
+        self.descheduler = descheduler
+        self._starve_polls = 0
+        self._idle_polls = 0
+        self._last_burn: Optional[float] = None
+        self._draining: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        POOL_SIZE.set(self.pool.size(), pool=self.pool.name)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+                _SYNCS.inc(result="ok")
+            except Exception:
+                _LOG.exception("autoscaler sync failed")
+                _SYNCS.inc(result="error")
+            self._stop.wait(self.sync_period)
+
+    # -- the poll ----------------------------------------------------------
+
+    def sync_once(self) -> dict:
+        """One evaluation: read the cluster, fold the starvation/idle
+        streak counters, act when a streak completes. Returns the poll
+        summary (the soak harness asserts on it)."""
+        nodes, _ = self.client.list("nodes")
+        pods, _ = self.client.list("pods")
+        cols, names = cluster_columns(nodes, pods)
+        pending = sum(
+            1
+            for p in pods
+            if not p.spec.node_name
+            and p.status.phase not in ("Succeeded", "Failed")
+        )
+
+        burn = ZERO_HEADROOM.value()
+        burned = self._last_burn is not None and burn > self._last_burn
+        self._last_burn = burn
+
+        # cpu_fit is the greedy-fit CHARGE (capacity_report semantics):
+        # utilization = charged/capacity, same as util_cpu in-kernel.
+        live = np.asarray(cols["sched"], bool)
+        caps = np.asarray(cols["cpu_cap"], np.float32)
+        fits = np.asarray(cols["cpu_fit"], np.float32)
+        util = 0.0
+        mask = live & (caps > 0)
+        if mask.any():
+            util = float(np.mean(np.clip(fits[mask] / caps[mask], 0.0, 1.0)))
+
+        starving = burned or pending > 0
+        idle = not pending and util < self.low_util
+        if starving:
+            self._starve_polls += 1
+            self._idle_polls = 0
+        elif idle:
+            self._idle_polls += 1
+            self._starve_polls = 0
+        else:
+            self._starve_polls = 0
+            self._idle_polls = 0
+
+        summary = {
+            "kind": "AutoscalerPoll",
+            "pool": self.pool.name,
+            "size": self.pool.size(),
+            "pending": pending,
+            "mean_cpu_util": round(util, 4),
+            "starve_polls": self._starve_polls,
+            "idle_polls": self._idle_polls,
+            "action": "none",
+        }
+
+        if self._draining is not None:
+            summary["action"] = self._continue_drain(pods)
+        elif (
+            self._starve_polls >= self.grow_after
+            and self.pool.size() < self.max_size
+        ):
+            step = min(self.grow_step, self.max_size - self.pool.size())
+            added = self.pool.grow(step)
+            self._starve_polls = 0
+            SCALE_EVENTS.inc(direction="up")
+            summary["action"] = "grow"
+            summary["added"] = list(added or [])
+        elif (
+            self._idle_polls >= self.shrink_after
+            and self.pool.size() > self.min_size
+        ):
+            summary["action"] = self._start_drain(nodes, pods)
+
+        POOL_SIZE.set(self.pool.size(), pool=self.pool.name)
+        summary["size"] = self.pool.size()
+        return summary
+
+    # -- shrink machinery --------------------------------------------------
+
+    def _pool_nodes(self, nodes) -> List:
+        members = set(getattr(self.pool, "node_names", lambda: [])() or [])
+        if members:
+            return [n for n in nodes if n.metadata.name in members]
+        return list(nodes)
+
+    def _start_drain(self, nodes, pods) -> str:
+        """Cordon the emptiest pool node and kick its drain."""
+        counts = {}
+        for p in pods:
+            if p.spec.node_name and p.status.phase not in (
+                "Succeeded",
+                "Failed",
+            ):
+                counts[p.spec.node_name] = counts.get(p.spec.node_name, 0) + 1
+        candidates = [
+            n
+            for n in self._pool_nodes(nodes)
+            if not (n.spec.unschedulable if n.spec else False)
+        ]
+        if not candidates:
+            return "none"
+        victim = min(
+            candidates,
+            key=lambda n: (counts.get(n.metadata.name, 0), n.metadata.name),
+        )
+        name = victim.metadata.name
+        try:
+            self.client.patch(
+                "nodes", name, {"spec": {"unschedulable": True}}
+            )
+        except APIError:
+            return "none"
+        self._draining = name
+        self.descheduler.drain_node(name)
+        return "drain"
+
+    def _continue_drain(self, pods) -> str:
+        """Finish (or keep pushing) the in-flight drain: retire the
+        node only once nothing non-terminal remains bound to it."""
+        name = self._draining
+        remaining = [
+            p
+            for p in pods
+            if p.spec.node_name == name
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+        if remaining:
+            self.descheduler.drain_node(name)
+            return "draining"
+        self.pool.shrink(name)
+        self._draining = None
+        self._idle_polls = 0
+        SCALE_EVENTS.inc(direction="down")
+        return "shrink"

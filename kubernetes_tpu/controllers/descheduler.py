@@ -1,0 +1,535 @@
+"""Descheduler: the continuous-rebalancing control loop.
+
+Closes the loop the capacity plane (PR 16) only observed: when the
+cluster fragmentation score crosses the threshold while pods are
+waiting, the free capacity exists but is unusable shards — no
+scheduling decision can fix it, only moving bound pods can. Each
+cycle re-solves the bound cluster with the ``plan_moves`` defrag
+kernel (utils/rebalance.build_plan) and executes the minimal-move
+plan through the SAME graceful-eviction + nomination machinery the
+preemption pass uses (scheduler/daemon._preempt) — the descheduler
+never force-deletes anything.
+
+Move protocol (crash-safe by construction):
+
+1. journal the move intent as a PodTemplate labeled
+   ``REBALANCE_JOURNAL_LABEL`` (value = destination node) carrying the
+   pod's full metadata+spec — written BEFORE the eviction, so from
+   this point the move can always be replayed;
+2. graceful eviction (the pods/{name}/eviction subresource; 404 =
+   already gone, counts as evicted);
+3. ``DESCHED_MOVE_CRASH`` fault site fires HERE — between the
+   eviction and the recreation, the exact window where a crash would
+   otherwise strand the pod;
+4. wait for the pod to leave the store (kubelet confirms the grace
+   deadline for grace > 0); a timeout leaves the journal in place for
+   recovery instead of guessing;
+5. recreate the pod (same name, NEW uid — bind-immutability-per-uid
+   is preserved, the binding belongs to the old incarnation) stamped
+   with ``REBALANCE_DEST_ANNOTATION`` (the columnar staging honors it
+   as a HostName pin) and a nominatedNodeName patch, so the micro-tick
+   daemon rebinds it at the planned destination;
+6. delete the journal entry — the move is durable.
+
+Recovery runs at the START of every cycle: orphaned journal entries
+(step 3/4 crash) whose pod is missing are replayed — the pod is
+recreated and re-pends; entries whose pod exists are stale and
+dropped. A crashed defrag therefore strands nothing, which is exactly
+what the ``rebalance_stranded_pods`` SLO gate asserts.
+
+Gang moves: build_plan already made move groups gang-atomic; this
+controller additionally commits a gang group's bindings itself via
+``bind_bulk(atomic=True)`` after recreating all members — a slice
+lands at its destinations as one transaction instead of trickling
+through per-pod scheduler ticks. Singleton moves ride the nomination.
+
+Disruption is bounded PDB-style: at most ``disruption_cap`` evictions
+per tick (a whole gang group counts against the cap; the first group
+of a tick always runs so a gang larger than the cap can still ever
+move). Stale nominations are swept: a recreated pod still Pending
+past ``nomination_ttl_s`` gets its pin cleared (annotation blanked)
+so a destination that filled up concurrently cannot wedge it — it
+re-enters the normal solve as a free pod.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+from datetime import datetime, timezone
+from typing import Dict, List, Optional, Sequence
+
+from kubernetes_tpu.models.objects import (
+    REBALANCE_DEST_ANNOTATION,
+    REBALANCE_JOURNAL_LABEL,
+    ObjectMeta,
+    Pod,
+    PodStatus,
+    PodTemplate,
+    PodTemplateSpec,
+    pod_full_key,
+)
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import capacity as capacity_mon
+from kubernetes_tpu.utils import faults, flightrecorder, metrics
+from kubernetes_tpu.utils import rebalance as rebalance_mon
+from kubernetes_tpu.utils.capacity import cluster_columns
+from kubernetes_tpu.utils.rebalance import (
+    DEFAULT_MOVE_BUDGET,
+    build_plan,
+    fragment_score,
+)
+
+_LOG = logging.getLogger("kubernetes_tpu.controllers.descheduler")
+
+_SYNCS = metrics.DEFAULT.counter(
+    "descheduler_syncs_total", "Descheduler sync passes", ("result",)
+)
+
+#: Journal PodTemplate name prefix (one entry per in-flight move).
+JOURNAL_PREFIX = "rebalance-move-"
+
+
+def _parse_ts(ts: str) -> Optional[float]:
+    if not ts:
+        return None
+    try:
+        return (
+            datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")
+            .replace(tzinfo=timezone.utc)
+            .timestamp()
+        )
+    except ValueError:
+        return None
+
+
+class Descheduler:
+    """Periodic/triggered defragmenter. ``sync_once()`` works without
+    ``start()`` (read-through LISTs) — tests and ``drain_node`` drive
+    it directly; the started thread adds the periodic trigger."""
+
+    def __init__(
+        self,
+        client,
+        sync_period: float = 10.0,
+        frag_threshold: float = 0.5,
+        move_budget: int = DEFAULT_MOVE_BUDGET,
+        disruption_cap: int = 4,
+        grace_period_seconds: int = 0,
+        nomination_ttl_s: float = 30.0,
+        wait_timeout_s: float = 5.0,
+    ):
+        self.client = client
+        self.sync_period = sync_period
+        self.frag_threshold = float(frag_threshold)
+        self.move_budget = int(move_budget)
+        self.disruption_cap = int(disruption_cap)
+        self.grace_period_seconds = int(grace_period_seconds)
+        self.nomination_ttl_s = float(nomination_ttl_s)
+        self.wait_timeout_s = float(wait_timeout_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Descheduler":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+                _SYNCS.inc(result="ok")
+            except Exception:
+                _LOG.exception("descheduler sync failed")
+                _SYNCS.inc(result="error")
+            self._stop.wait(self.sync_period)
+
+    # -- the cycle ---------------------------------------------------------
+
+    def sync_once(
+        self, force: bool = False, forced_nodes: Sequence[str] = ()
+    ) -> dict:
+        """One full pass: journal recovery, nomination sweep, trigger
+        check, plan, execute, measure. Returns the cycle summary."""
+        recovered = self.recover()
+        self._sweep_nominations()
+
+        nodes, _ = self.client.list("nodes")
+        pods, _ = self.client.list("pods")
+        cols, names = cluster_columns(nodes, pods)
+        probes = capacity_mon.DEFAULT.probe_set()
+        pending = [
+            p
+            for p in pods
+            if not p.spec.node_name
+            and p.status.phase not in ("Succeeded", "Failed")
+        ]
+
+        plan = build_plan(
+            cols,
+            names,
+            pods,
+            probes,
+            move_budget=self.move_budget,
+            forced_nodes=forced_nodes,
+        )
+        summary = {
+            "kind": "DeschedulerCycle",
+            "recovered": recovered,
+            "triggered": False,
+            "moves_executed": 0,
+        }
+        if plan is None:
+            return summary
+        rebalance_mon.DEFAULT.record_plan(plan)
+        if forced_nodes:
+            # Drain semantics: evacuate the named nodes, nothing more.
+            # The kernel also surfaces opportunistic gain-positive
+            # moves elsewhere in the cluster; executing those during a
+            # drain would evict pods the caller never asked to touch
+            # (and a draining autoscaler would then see its own
+            # re-pending evictees as backlog and grow right back).
+            keep = {m["group"] for m in plan["moves"] if m["forced"]}
+            plan = dict(plan)
+            plan["moves"] = [m for m in plan["moves"] if m["group"] in keep]
+
+        triggered = (
+            force
+            or bool(forced_nodes)
+            or (plan["score_before"] >= self.frag_threshold and pending)
+        )
+        summary["score_before"] = plan["score_before"]
+        if not triggered or not plan["moves"]:
+            return summary
+        summary["triggered"] = True
+
+        executed = self._execute(plan)
+        rebalance_mon.DEFAULT.record_move("planned", len(plan["moves"]))
+
+        # Measure, don't trust: the after-score comes from a fresh
+        # LIST of the post-eviction cluster, not the kernel's forecast.
+        nodes, _ = self.client.list("nodes")
+        pods, _ = self.client.list("pods")
+        cols, _ = cluster_columns(nodes, pods)
+        after = fragment_score(cols, probes)
+        if after is None:
+            after = plan["score_after"]
+        trigger = (
+            "drain" if forced_nodes else ("forced" if force else "periodic")
+        )
+        cycle = rebalance_mon.DEFAULT.record_cycle(
+            plan["score_before"], after, executed, trigger=trigger
+        )
+        summary.update(cycle)
+        summary["moves_executed"] = executed
+        return summary
+
+    def run_once(self, force: bool = False) -> dict:
+        """Alias trigger: one forced defrag cycle (ktctl / tests)."""
+        return self.sync_once(force=force)
+
+    def drain_node(self, node_name: str) -> dict:
+        """Forced cycle that empties one node (the autoscaler's
+        cordon-drain half) — every pod on it moves regardless of gain,
+        through the same graceful journal/evict/recreate path."""
+        return self.sync_once(force=True, forced_nodes=(node_name,))
+
+    # -- recovery + sweeps -------------------------------------------------
+
+    def recover(self) -> int:
+        """Replay orphaned move journals: an entry whose pod is gone
+        means the descheduler died between eviction and recreation —
+        recreate the pod now (it re-pends and binds); an entry whose
+        pod exists is finished business — drop it."""
+        try:
+            entries, _ = self.client.list(
+                "podtemplates", label_selector=REBALANCE_JOURNAL_LABEL
+            )
+        except APIError:
+            return 0
+        recovered = 0
+        for entry in entries:
+            labels = entry.metadata.labels or {}
+            if REBALANCE_JOURNAL_LABEL not in labels:
+                continue
+            ns = entry.metadata.namespace or "default"
+            name = entry.template.metadata.name
+            if not name:
+                self._delete_journal(entry.metadata.name, ns)
+                continue
+            try:
+                self.client.get("pods", name, namespace=ns)
+                exists = True
+            except APIError as e:
+                if e.code != 404:
+                    continue  # can't tell — leave the journal alone
+                exists = False
+            if exists:
+                self._delete_journal(entry.metadata.name, ns)
+                continue
+            dest = labels.get(REBALANCE_JOURNAL_LABEL, "")
+            try:
+                self.client.create(
+                    "pods",
+                    self._replacement(entry.template, dest),
+                    namespace=ns,
+                )
+                rebalance_mon.DEFAULT.record_move("recovered")
+                recovered += 1
+                self._delete_journal(entry.metadata.name, ns)
+            except APIError as e:
+                if e.code == 409:
+                    self._delete_journal(entry.metadata.name, ns)
+                elif 400 <= e.code < 500:
+                    # Terminal rejection: recovery is exhausted for
+                    # this entry — the evicted pod is stranded (the
+                    # SLO gate burns) and the journal drops so the
+                    # counter can't double-burn next cycle.
+                    rebalance_mon.DEFAULT.record_move("stranded")
+                    self._delete_journal(entry.metadata.name, ns)
+                # 5xx / transport: keep the journal, retry next cycle.
+        return recovered
+
+    def _sweep_nominations(self) -> None:
+        """Settle in-flight nominations: a recreated pod that BOUND
+        completes its move (annotation blanked, outcome ``rebound``);
+        one still Pending past the nomination TTL has its pin cleared
+        (outcome ``failed`` — the pod re-enters the solve unpinned,
+        nothing is stranded)."""
+        try:
+            pods, _ = self.client.list("pods")
+        except APIError:
+            return
+        now = time.time()
+        for p in pods:
+            dest = (p.metadata.annotations or {}).get(
+                REBALANCE_DEST_ANNOTATION, ""
+            )
+            if not dest:
+                continue
+            if p.spec.node_name:
+                outcome = "rebound"
+            else:
+                born = _parse_ts(p.metadata.creation_timestamp)
+                if born is not None and now - born < self.nomination_ttl_s:
+                    continue  # still within its window
+                outcome = "failed"
+            try:
+                # Blank, don't delete: merge-patch to "" — the
+                # columnar pin and the movable filter both key on
+                # truthiness, and blanking needs no null semantics.
+                self.client.patch(
+                    "pods",
+                    p.metadata.name,
+                    {
+                        "metadata": {
+                            "annotations": {REBALANCE_DEST_ANNOTATION: ""}
+                        }
+                    },
+                    namespace=p.metadata.namespace or "default",
+                )
+                rebalance_mon.DEFAULT.record_move(outcome)
+            except APIError:
+                continue
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, plan: dict) -> int:
+        """Run the plan's move groups under the disruption cap.
+        Returns evictions executed."""
+        pods, _ = self.client.list("pods")
+        by_key = {pod_full_key(p): p for p in pods}
+        groups: Dict[str, List[dict]] = {}
+        order: List[str] = []
+        for m in plan["moves"]:
+            if m["group"] not in groups:
+                order.append(m["group"])
+            groups.setdefault(m["group"], []).append(m)
+
+        executed = 0
+        for gi, g in enumerate(order):
+            moves = groups[g]
+            if executed and executed + len(moves) > self.disruption_cap:
+                break  # PDB-style: the cap holds (first group exempt)
+            is_gang = any(m["gang"] for m in moves)
+            done = []
+            for m in moves:
+                pod = by_key.get(m["pod"])
+                if pod is None:
+                    continue
+                if self._move(pod, m, defer_bind=is_gang):
+                    executed += 1
+                    done.append(m)
+            if is_gang and done:
+                self._commit_gang(done)
+        return executed
+
+    def _move(self, pod, m: dict, defer_bind: bool = False) -> bool:
+        """One journal/evict/recreate/nominate move. True when the
+        eviction landed (the disruption actually happened)."""
+        ns = pod.metadata.namespace or "default"
+        name = pod.metadata.name
+        key = m["pod"]
+        journal = PodTemplate(
+            metadata=ObjectMeta(
+                name=f"{JOURNAL_PREFIX}{name}",
+                namespace=ns,
+                labels={REBALANCE_JOURNAL_LABEL: m["to"]},
+            ),
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(
+                    name=name,
+                    namespace=ns,
+                    labels=dict(pod.metadata.labels or {}),
+                    annotations=dict(pod.metadata.annotations or {}),
+                ),
+                spec=copy.deepcopy(pod.spec),
+            ),
+        )
+        try:
+            self.client.create("podtemplates", journal, namespace=ns)
+        except APIError as e:
+            if e.code != 409:  # an orphan from a prior crash is fine
+                rebalance_mon.DEFAULT.record_move("failed")
+                return False
+        try:
+            self.client.evict(
+                name,
+                namespace=ns,
+                grace_period_seconds=self.grace_period_seconds,
+            )
+        except APIError as e:
+            if e.code != 404:  # gone already = evicted
+                rebalance_mon.DEFAULT.record_move("failed")
+                self._delete_journal(journal.metadata.name, ns)
+                return False
+        rebalance_mon.DEFAULT.record_move("evicted")
+        try:
+            self.client.record_event(
+                pod,
+                "RebalanceEvict",
+                f"defragmentation move {m['from']} -> {m['to']} "
+                f"(gain {m['gain']})",
+                source="descheduler",
+                namespace=ns,
+            )
+        except APIError:
+            pass
+
+        # THE crash window: the pod is evicted, the replacement does
+        # not exist yet. Only the journal stands between a crash here
+        # and a stranded pod — which is exactly what the chaos soak's
+        # mid-defrag kill epoch asserts.
+        faults.fire(faults.DESCHED_MOVE_CRASH, key)
+
+        if not self._wait_gone(name, ns):
+            # Terminating but not confirmed: leave the journal; the
+            # recovery pass recreates once the store lets go.
+            return True
+        try:
+            self.client.create(
+                "pods", self._replacement(journal.template, m["to"]),
+                namespace=ns,
+            )
+        except APIError:
+            rebalance_mon.DEFAULT.record_move("failed")
+            return True  # journal survives -> recovery will replay
+        if not defer_bind:
+            try:
+                self.client.patch(
+                    "pods",
+                    name,
+                    {"status": {"nominatedNodeName": m["to"]}},
+                    namespace=ns,
+                )
+            except APIError:
+                pass
+        flightrecorder.DEFAULT.record_preemption(
+            key,
+            "rebalance_nominated",
+            node=m["to"],
+            reason=f"defrag move from {m['from']} (gain {m['gain']})",
+        )
+        self._delete_journal(journal.metadata.name, ns)
+        return True
+
+    def _commit_gang(self, done: List[dict]) -> None:
+        """Atomically bind a gang group's recreated members at their
+        planned destinations — the slice lands as one transaction (any
+        conflict rejects the whole batch; the pods then re-pend pinned
+        and the gang solver places them)."""
+        ns = done[0]["namespace"]
+        try:
+            self.client.bind_bulk(
+                [(m["name"], m["to"]) for m in done],
+                namespace=ns,
+                atomic=True,
+            )
+            rebalance_mon.DEFAULT.record_move("rebound", len(done))
+            for m in done:
+                # Bound by us: settle the nomination immediately.
+                try:
+                    self.client.patch(
+                        "pods",
+                        m["name"],
+                        {
+                            "metadata": {
+                                "annotations": {REBALANCE_DEST_ANNOTATION: ""}
+                            }
+                        },
+                        namespace=ns,
+                    )
+                except APIError:
+                    pass
+        except APIError:
+            pass  # pods stay pinned+pending; the solver lands them
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _replacement(self, template: PodTemplateSpec, dest: str) -> Pod:
+        """The evicted pod's next incarnation: same name/labels, fresh
+        uid (the server assigns one — bind-immutability-per-uid holds),
+        unbound, pinned at the planned destination."""
+        spec = copy.deepcopy(template.spec)
+        spec.node_name = ""
+        annotations = dict(template.metadata.annotations or {})
+        if dest:
+            annotations[REBALANCE_DEST_ANNOTATION] = dest
+        return Pod(
+            metadata=ObjectMeta(
+                name=template.metadata.name,
+                namespace=template.metadata.namespace or "default",
+                labels=dict(template.metadata.labels or {}),
+                annotations=annotations,
+            ),
+            spec=spec,
+            status=PodStatus(phase="Pending"),
+        )
+
+    def _wait_gone(self, name: str, ns: str) -> bool:
+        deadline = time.time() + self.wait_timeout_s
+        while time.time() < deadline:
+            try:
+                self.client.get("pods", name, namespace=ns)
+            except APIError as e:
+                if e.code == 404:
+                    return True
+                return False
+            time.sleep(0.05)
+        return False
+
+    def _delete_journal(self, name: str, ns: str) -> None:
+        try:
+            self.client.delete("podtemplates", name, namespace=ns)
+        except APIError:
+            pass

@@ -35,6 +35,12 @@ class ControllerManager:
         enable_service_accounts: bool = True,
         enable_pv_binder: bool = True,
         enable_gangs: bool = True,
+        # Rebalancing plane (PR 17): the descheduler actively EVICTS
+        # bound pods, so it is strictly opt-in; the autoscaler only
+        # runs when handed a pool provider to resize.
+        enable_descheduler: bool = False,
+        descheduler_frag_threshold: float = 0.5,
+        autoscaler_pool=None,
         # Reference defaults (see nodelifecycle.py): grace 40s,
         # eviction 5min there — 120s here keeps recovery drills sane.
         node_grace_period: float = 40.0,
@@ -94,6 +100,21 @@ class ControllerManager:
                 ),
             )
             self.controllers.append(self.gangs)
+        if enable_descheduler or autoscaler_pool is not None:
+            from kubernetes_tpu.controllers.descheduler import Descheduler
+
+            self.descheduler = Descheduler(
+                client, frag_threshold=descheduler_frag_threshold
+            )
+            if enable_descheduler:
+                self.controllers.append(self.descheduler)
+            if autoscaler_pool is not None:
+                from kubernetes_tpu.controllers.autoscaler import Autoscaler
+
+                self.autoscaler = Autoscaler(
+                    client, autoscaler_pool, descheduler=self.descheduler
+                )
+                self.controllers.append(self.autoscaler)
         if enable_pv_binder:
             self.pv_binder = PersistentVolumeClaimBinder(client)
             self.controllers.append(self.pv_binder)

@@ -50,6 +50,7 @@ from kubernetes_tpu.models.algspec import (
 from kubernetes_tpu.models.objects import (
     Node,
     Pod,
+    REBALANCE_DEST_ANNOTATION,
     RESOURCE_CPU,
     RESOURCE_MEMORY,
     RESOURCE_PODS,
@@ -540,6 +541,20 @@ class SnapshotBuilder:
             vol_rw_lists.append(vol_rw)
             if spec.node_name:
                 pinned[i] = node_index_get(spec.node_name, -2)
+            else:
+                # Rebalance nomination: a pod the descheduler recreated
+                # after a defrag eviction carries its planned
+                # destination as an annotation (mirrored in
+                # status.nominatedNodeName); honor it as a HostName pin
+                # so the micro-tick daemon rebinds it there. Unknown
+                # node -> unpinned (-1): a destination that vanished
+                # mid-move must not strand the pod, it just re-solves
+                # anywhere.
+                dest = (p.metadata.annotations or {}).get(
+                    REBALANCE_DEST_ANNOTATION, ""
+                )
+                if dest:
+                    pinned[i] = node_index_get(dest, -1)
             ids, first = membership_ids(p)
             if len(ids):
                 k = min(len(ids), SVC_K)

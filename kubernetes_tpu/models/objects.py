@@ -714,6 +714,22 @@ class PodTemplate:
 # gang lifecycle controller.
 POD_GROUP_LABEL = "pod-group.kubernetes-tpu.io/name"
 
+# Rebalance-move destination annotation: the descheduler stamps this on
+# the replacement pod it recreates after a graceful eviction, and the
+# solver's columnar staging honors it as a HostName pin (alongside the
+# status.nominatedNodeName reservation) so the micro-tick daemon
+# rebinds the pod at its planned destination. The descheduler clears
+# stale stamps from pods that stay Pending past the nomination window,
+# returning them to ordinary (unpinned) solving.
+REBALANCE_DEST_ANNOTATION = "rebalance.kubernetes-tpu.io/destination"
+
+# Label marking a PodTemplate as a journaled rebalance move intent
+# (value: the move's destination node). Written BEFORE the eviction,
+# deleted after the replacement pod is recreated — crash recovery
+# replays orphaned intents so a move interrupted between eviction and
+# recreation strands nothing.
+REBALANCE_JOURNAL_LABEL = "rebalance.kubernetes-tpu.io/move"
+
 
 @dataclass
 class PodGroupSpec:

@@ -151,6 +151,9 @@ DIM_LATTICES: Dict[str, Tuple[str, object]] = {
     # Capacity probe-shape axis (utils/capacity.py pads the probe set —
     # backlog quantiles + configured slice shapes — to pow2 buckets).
     "Q": ("probe-shape axis: pow2 >= 4", lambda n: _is_pow2(n) and n >= 4),
+    # Rebalance movable-pod axis (utils/rebalance.py pads the sorted
+    # movable worklist to pow2 buckets).
+    "D": ("rebalance pod axis: pow2 >= 8", lambda n: _is_pow2(n) and n >= 8),
     # Policy-lowering minor axes: sized by the configured policy
     # (affinity label count, anti-affinity zone vocab) — static per
     # lowered spec, not bucketed.
@@ -500,6 +503,48 @@ CONTRACTS: Dict[str, Contract] = {
             "detection any()s across it)"
         ),
     ),
+    "rebalance.plan_moves": Contract(
+        kernel="rebalance.plan_moves",
+        args=(
+            ("cpu_cap", _f32("N")),
+            ("mem_cap", _f32("N")),
+            ("pods_cap", _f32("N")),
+            ("cpu_fit", _f32("N")),
+            ("mem_fit", _f32("N")),
+            ("pods_used", _f32("N")),
+            ("over", _b8("N")),
+            ("sched", _b8("N")),
+            ("pod_cpu", _f32("D")),
+            ("pod_mem", _f32("D")),
+            ("pod_node", _i32("D")),
+            ("pod_live", _b8("D")),
+            ("pod_force", _b8("D")),
+            ("probe_cpu", _f32("Q")),
+            ("probe_mem", _f32("Q")),
+            ("probe_min", _i32("Q")),
+            ("probe_live", _b8("Q")),
+            ("move_budget", _i32()),
+        ),
+        results=(
+            _i32("D"),  # dest
+            _b8("D"),  # moved
+            _i32("D"),  # gain
+            _i32(),  # n_moves
+            _f32(),  # score_before
+            _f32(),  # score_after
+        ),
+        pod_dim="D",
+        pod_axis="reduces",
+        samples=(
+            {"D": 8, "N": 128, "Q": 4},
+            {"D": 64, "N": 256, "Q": 8},
+        ),
+        notes=(
+            "best-fit-decreasing scan over the movable-pod axis with "
+            "an evolving occupancy carry — later moves see earlier "
+            "ones by construction"
+        ),
+    ),
 }
 
 
@@ -517,7 +562,7 @@ def _distinct_bindings(contract: Contract) -> Dict[str, int]:
     pool = {
         "P": 384, "PG": 24, "G": 48, "N": 256, "LW": 2, "PW": 4, "VW": 6,
         "S": 640, "K": SVC_K, "V": 40, "M": 16, "R": 12,
-        "A": 3, "Z": 5, "S1": 641, "Q": 32,
+        "A": 3, "Z": 5, "S1": 641, "Q": 32, "D": 64,
     }
     return {s: pool[s] for s in symbols if s in pool}
 

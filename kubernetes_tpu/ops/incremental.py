@@ -44,6 +44,7 @@ from kubernetes_tpu.models.columnar import (
     pod_volumes,
 )
 from kubernetes_tpu.models.objects import (
+    REBALANCE_DEST_ANNOTATION,
     RESOURCE_CPU,
     RESOURCE_MEMORY,
     RESOURCE_PODS,
@@ -156,6 +157,10 @@ class _LoweredPod:
     # set, not the dense membership row: a pod matching > SVC_K
     # services would otherwise diverge host vs device (advisor r1).
     svc_topk: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    # Soft pin (rebalance nomination, not spec.nodeName): an unknown
+    # destination resolves to UNPINNED (-1) instead of infeasible (-2)
+    # — a dest node that vanished mid-move must not strand the pod.
+    pin_soft: bool = False
 
 
 class SolverSession:
@@ -256,6 +261,19 @@ class SolverSession:
         vol_any = [self._vocab_id(self.vol_vocab, self.VW, v) for v, _ in vols]
         vol_rw = [self._vocab_id(self.vol_vocab, self.VW, v) for v, rw in vols if rw]
         ids, first = self._matcher.membership_ids(pod)
+        # Rebalance nomination: mirror models/columnar.py — a pod the
+        # descheduler recreated after a defrag eviction carries its
+        # planned destination as an annotation; honor it as a soft
+        # HostName pin so the incremental daemon rebinds it there
+        # (without this, the solver happily re-packs the mover onto
+        # the very node the defrag cycle just drained).
+        pinned_name = pod.spec.node_name or ""
+        pin_soft = False
+        if not pinned_name:
+            pinned_name = (pod.metadata.annotations or {}).get(
+                REBALANCE_DEST_ANNOTATION, ""
+            )
+            pin_soft = bool(pinned_name)
         return _LoweredPod(
             svc_topk=ids[:SVC_K],
             key=pod_key(pod),
@@ -266,7 +284,8 @@ class SolverSession:
             port_ids=port_ids,
             vol_any_ids=vol_any,
             vol_rw_ids=vol_rw,
-            pinned_name=pod.spec.node_name or "",
+            pinned_name=pinned_name,
+            pin_soft=pin_soft,
             svc=first,
         )
 
@@ -740,7 +759,9 @@ class SolverSession:
             arr["vol_any"][i] = bitset(lp.vol_any_ids, self.VW)
             arr["vol_rw"][i] = bitset(lp.vol_rw_ids, self.VW)
             if lp.pinned_name:
-                arr["pinned"][i] = self.node_index.get(lp.pinned_name, -2)
+                arr["pinned"][i] = self.node_index.get(
+                    lp.pinned_name, -1 if lp.pin_soft else -2
+                )
             else:
                 arr["pinned"][i] = -1
             arr["svc"][i] = lp.svc

@@ -67,6 +67,13 @@ ORACLE_TWINS = {
         "suite": "tests/test_solver_parity.py",
         "exercised_as": "preempt_backlog_scalar",
     },
+    "rebalance.plan_moves": {
+        # Bit-exact twin (the capacity plane's int32-quantized fit
+        # math + a Python rewrite of the lax.scan): array_equal on
+        # every leaf, no tolerance.
+        "oracle": "ops.oracle.plan_moves_numpy",
+        "suite": "tests/test_solver_parity.py",
+    },
     "sinkhorn.solve_sinkhorn_stats": {
         "oracle": "ops.oracle.validate_assignment_numpy",
         "suite": "tests/test_sinkhorn.py",

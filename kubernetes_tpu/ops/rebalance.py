@@ -1,0 +1,241 @@
+"""Rebalancing kernel: the descheduler's migration plan as one dense
+scan over the movable-pod axis.
+
+Roadmap item 5's device half. The capacity plane (ops/capacity.py)
+measures fragmentation; this kernel spends that measurement: given the
+cluster's occupancy columns and a worklist of movable bound pods
+(host-sorted largest-first — best-fit-decreasing), it re-places each
+pod against the *evolving* occupancy carry and emits a minimal-move
+migration plan:
+
+- **destination choice** is best-fit: among feasible live nodes
+  (schedulable, not overcommitted, fits cpu/mem and one pods-allowance
+  slot, not the pod's current node) pick the one with the least
+  leftover capacity in the pod's own units — consolidation pressure,
+  the inverse of the solver's spreading default, because defrag WANTS
+  tight packing so whole nodes drain free.
+- **gain** is the marginal fragmentation-score improvement in the
+  capacity plane's own objective: the change in summed integral probe
+  fits (``capacity_report``'s ``headroom`` numerator) at the two
+  touched nodes, int32 in probe units. The aggregate frag score is
+  ``1 - usable*FRAC_Q/potential`` and cross-node free capacity (the
+  ``potential`` denominator) is conserved by a move, so ranking by
+  delta-usable IS ranking by score improvement.
+- a move commits only while the **move budget** lasts and only if
+  ``gain > 0`` — unless the pod is **forced** (``pod_force``: the
+  autoscaler's cordon-drain path, where the source node is leaving and
+  any feasible destination beats stranding).
+
+The scan carries the occupancy columns forward through every committed
+move, so later pods see earlier moves — the plan is self-consistent
+and can be executed in emission order. Bit-exactness discipline is
+inherited from ops/capacity.py: every cross-node/cross-probe reduction
+sums int32 (fits clipped to FIT_CAP, fractions quantized to 1/FRAC_Q),
+argmin tie-breaks take the first minimum in both XLA and NumPy, and
+the remaining float work is elementwise f32 — so the KT006 twin
+(``ops.oracle.plan_moves_numpy``) matches bit-for-bit, no tolerance.
+
+Gang atomicity is deliberately NOT in the kernel: the host half
+(utils/rebalance.py) groups the per-pod rows by gang and drops partial
+groups, because gang membership is label metadata the columns never
+carry — same split as the solver (device proposes, gang.py accepts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.capacity import BIG_FIT, FIT_CAP, FRAC_Q
+from kubernetes_tpu.ops.ledger import traced_jit
+
+#: Sentinel best-fit key for infeasible destinations: above any real
+#: quantized leftover (FIT_CAP * FRAC_Q = 2^17) by a wide margin.
+NO_FIT_KEY = 2**30
+
+
+@traced_jit
+def plan_moves(
+    cpu_cap,
+    mem_cap,
+    pods_cap,
+    cpu_fit,
+    mem_fit,
+    pods_used,
+    over,
+    sched,
+    pod_cpu,
+    pod_mem,
+    pod_node,
+    pod_live,
+    pod_force,
+    probe_cpu,
+    probe_mem,
+    probe_min,
+    probe_live,
+    move_budget,
+):
+    """One defrag plan: re-place every movable pod best-fit against the
+    evolving occupancy carry, commit moves with positive probe-fit gain
+    (or forced drains) under a move budget.
+
+    Node columns are the NODE_SCHEMA occupancy view (same eight
+    ``capacity_report`` consumes). Pod rows are the movable worklist:
+    requests in column units, ``pod_node`` the current placement index,
+    ``pod_live`` masking padding rows, ``pod_force`` the drain flag.
+    Probes are the capacity plane's probe-shape set — the objective.
+    ``move_budget`` is an i32 scalar array. Returns a flat tuple:
+
+    ``(dest i32[D], moved b8[D], gain i32[D], n_moves i32[],
+    score_before f32[], score_after f32[])``
+
+    ``dest`` is -1 for uncommitted rows; ``gain`` is the committed
+    move's delta-usable (0 otherwise); the scores are the capacity
+    plane's exact ``frag_score`` over the carry before and after.
+    """
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
+    big = jnp.float32(BIG_FIT)
+    live = sched & ~over
+    livef = live.astype(jnp.float32)
+    n = cpu_cap.shape[0]
+    plive_i = probe_live.astype(jnp.int32)
+
+    def node_fits(free_cpu, free_mem, free_pods):
+        """Per-probe integral/quantized fits for free vectors of any
+        trailing shape — capacity_report's fit math verbatim."""
+        pc = probe_cpu[:, None]
+        pm = probe_mem[:, None]
+        per_cpu = jnp.where(
+            pc > f0, free_cpu[None, :] / jnp.maximum(pc, f1), big
+        )
+        per_mem = jnp.where(
+            pm > f0, free_mem[None, :] / jnp.maximum(pm, f1), big
+        )
+        fit_frac = jnp.minimum(
+            jnp.minimum(per_cpu, per_mem), free_pods[None, :]
+        )
+        fit_frac = jnp.clip(fit_frac, f0, jnp.float32(FIT_CAP))
+        fit_int = jnp.floor(fit_frac).astype(jnp.int32)
+        frac_q = jnp.floor(fit_frac * jnp.float32(FRAC_Q)).astype(jnp.int32)
+        return fit_int, frac_q
+
+    def free_vectors(cf, mf, pu):
+        free_cpu = jnp.maximum(cpu_cap - cf, f0) * livef
+        free_mem = jnp.maximum(mem_cap - mf, f0) * livef
+        free_pods = jnp.maximum(pods_cap - pu, f0) * livef
+        return free_cpu, free_mem, free_pods
+
+    def frag_score(cf, mf, pu):
+        """capacity_report's aggregate score over one occupancy state:
+        int32 totals, f32 ratio, clipped [0, 1]."""
+        fit_int, frac_q = node_fits(*free_vectors(cf, mf, pu))
+        usable = jnp.sum(jnp.sum(fit_int, axis=1) * plive_i)
+        potential = jnp.sum(jnp.sum(frac_q, axis=1) * plive_i)
+        score = jnp.where(
+            potential > jnp.int32(0),
+            f1
+            - (usable.astype(jnp.float32) * jnp.float32(FRAC_Q))
+            / potential.astype(jnp.float32),
+            f0,
+        )
+        return jnp.clip(score, f0, f1)
+
+    def node_usable(fc, fm, fp):
+        """One node's summed integral probe fit (i32 scalar) — the
+        gain evaluation at a touched node."""
+        pcu = jnp.where(probe_cpu > f0, fc / jnp.maximum(probe_cpu, f1), big)
+        pme = jnp.where(probe_mem > f0, fm / jnp.maximum(probe_mem, f1), big)
+        ff = jnp.clip(jnp.minimum(jnp.minimum(pcu, pme), fp), f0,
+                      jnp.float32(FIT_CAP))
+        return jnp.sum(jnp.floor(ff).astype(jnp.int32) * plive_i)
+
+    score_before = frag_score(cpu_fit, mem_fit, pods_used)
+
+    def step(carry, pod):
+        cf, mf, pu, moves = carry
+        cpu, mem, src, alive, force = pod
+        free_cpu, free_mem, free_pods = free_vectors(cf, mf, pu)
+
+        src_c = jnp.clip(src, 0, n - 1)
+        src_valid = (src >= 0) & (src < n)
+        is_src = (jnp.arange(n, dtype=jnp.int32) == src_c) & src_valid
+
+        feasible = (
+            live
+            & (free_cpu >= cpu)
+            & (free_mem >= mem)
+            & (free_pods >= f1)
+            & ~is_src
+        )
+
+        # Best-fit key: quantized leftover capacity at the candidate,
+        # measured in the pod's own units (zero-request dims read
+        # unconstrained); first-minimum argmin in both XLA and NumPy.
+        kc = jnp.where(cpu > f0, (free_cpu - cpu) / jnp.maximum(cpu, f1), big)
+        km = jnp.where(mem > f0, (free_mem - mem) / jnp.maximum(mem, f1), big)
+        key_frac = jnp.clip(
+            jnp.minimum(kc, km), f0, jnp.float32(FIT_CAP)
+        )
+        key = jnp.floor(key_frac * jnp.float32(FRAC_Q)).astype(jnp.int32)
+        key = jnp.where(feasible, key, jnp.int32(NO_FIT_KEY))
+        dst = jnp.argmin(key).astype(jnp.int32)
+        any_feasible = jnp.any(feasible)
+
+        # Gain: delta summed integral probe fit at the two touched
+        # nodes (free capacity elsewhere is untouched). Source free
+        # capacity GROWS by the pod's requests; destination SHRINKS.
+        src_live = src_valid & live[src_c]
+
+        u_src_before = jnp.where(
+            src_live,
+            node_usable(free_cpu[src_c], free_mem[src_c], free_pods[src_c]),
+            jnp.int32(0),
+        )
+        u_src_after = jnp.where(
+            src_live,
+            node_usable(
+                jnp.maximum(cpu_cap[src_c] - (cf[src_c] - cpu), f0),
+                jnp.maximum(mem_cap[src_c] - (mf[src_c] - mem), f0),
+                jnp.maximum(pods_cap[src_c] - (pu[src_c] - f1), f0),
+            ),
+            jnp.int32(0),
+        )
+        u_dst_before = node_usable(free_cpu[dst], free_mem[dst],
+                                   free_pods[dst])
+        u_dst_after = node_usable(
+            jnp.maximum(cpu_cap[dst] - (cf[dst] + cpu), f0),
+            jnp.maximum(mem_cap[dst] - (mf[dst] + mem), f0),
+            jnp.maximum(pods_cap[dst] - (pu[dst] + f1), f0),
+        )
+        gain = (u_src_after + u_dst_after) - (u_src_before + u_dst_before)
+
+        commit = (
+            alive
+            & any_feasible
+            & (moves < move_budget)
+            & ((gain > jnp.int32(0)) | force)
+        )
+        cmf = commit.astype(jnp.float32)
+        dst_hot = (jnp.arange(n, dtype=jnp.int32) == dst).astype(jnp.float32)
+        src_hot = is_src.astype(jnp.float32)
+        cf = cf + cmf * cpu * (dst_hot - src_hot)
+        mf = mf + cmf * mem * (dst_hot - src_hot)
+        pu = pu + cmf * (dst_hot - src_hot)
+        moves = moves + commit.astype(jnp.int32)
+
+        out = (
+            jnp.where(commit, dst, jnp.int32(-1)),
+            commit,
+            jnp.where(commit, gain, jnp.int32(0)),
+        )
+        return (cf, mf, pu, moves), out
+
+    init = (cpu_fit, mem_fit, pods_used, jnp.int32(0))
+    (cf, mf, pu, n_moves), (dest, moved, gain) = jax.lax.scan(
+        step,
+        init,
+        (pod_cpu, pod_mem, pod_node, pod_live, pod_force),
+    )
+    score_after = frag_score(cf, mf, pu)
+    return dest, moved, gain, n_moves, score_before, score_after

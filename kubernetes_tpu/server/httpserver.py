@@ -259,6 +259,21 @@ class _Handler(BaseHTTPRequestHandler):
                 "application/json",
             )
             return
+        if rest == ("rebalance",):
+            # The rebalancing plane (utils/rebalance.py): last defrag
+            # plan/cycle, move-outcome table and improvement trend —
+            # `ktctl rebalance`'s data source. sampled:false until the
+            # descheduler executes its first cycle (the ktctl miss
+            # contract keys on it); jax stays off the import path so a
+            # thin apiserver can serve the cold shape.
+            from kubernetes_tpu.utils import rebalance
+
+            self._send_text(
+                200,
+                json.dumps(rebalance.DEFAULT.snapshot()),
+                "application/json",
+            )
+            return
         if rest == ("kernels",):
             # The XLA compile/cost ledger (ops/ledger.py): per-kernel
             # compile events with cost/memory analysis — `ktctl profile
@@ -324,7 +339,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "debug endpoints: /debug/requests /debug/stacks "
                 "/debug/profile /debug/traces /debug/decisions "
                 "/debug/solves /debug/slo /debug/kernels "
-                "/debug/capacity /debug/device-profile",
+                "/debug/capacity /debug/rebalance /debug/device-profile",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
 

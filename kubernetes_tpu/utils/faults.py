@@ -188,6 +188,14 @@ SCHED_EVICT_ERROR = _site(
     doc="victim eviction fails transiently; the preemption pass must "
         "count evict_failed and retry without recording a nomination",
 )
+# descheduler move execution (controllers/descheduler.py):
+DESCHED_MOVE_CRASH = _site(
+    "descheduler.move.crash", "error", exc=_fi,
+    doc="descheduler dies mid-move, after the eviction but before the "
+        "replacement pod is recreated — the journaled move intent "
+        "(PodTemplate) must let recovery re-pend the pod so a crashed "
+        "defrag strands nothing",
+)
 # kubelet sync loop (kubelet/agent.py):
 KUBELET_TERMINATING_STALL = _site(
     "kubelet.terminating.stall", "delay",

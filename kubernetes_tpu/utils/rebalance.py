@@ -1,0 +1,469 @@
+"""Rebalancing plane — the host half.
+
+The dense defrag pass lives in ops/rebalance.py (jitted, KT006 twin,
+ktshape contract); this module owns everything around it: movable-pod
+worklist assembly (largest-first, the best-fit-decreasing order the
+kernel's scan expects), gang-atomic move grouping and the move-budget
+group clip, the always-on metric series, and the ``/debug/rebalance``
+snapshot. Like utils/capacity.py it must stay importable by a pure
+control-plane process — jax is only touched inside :func:`build_plan`
+(the descheduler is the only caller).
+
+Series (KT005 family ``REBALANCE_METRICS`` + standard suffixes):
+
+- ``rebalance_moves_total{outcome}`` — counter over the move pipeline:
+  ``planned`` (kernel emitted, survived gang/budget clipping),
+  ``evicted`` (graceful eviction landed), ``rebound`` (replacement pod
+  bound at a node), ``recovered`` (crash-orphaned journal replayed —
+  the pod was re-created by the recovery pass), ``failed``
+  (eviction/recreate error; move abandoned with the source pod intact
+  or journal-recovered), and ``stranded`` (journal recovery exhausted
+  — the SLO gate's numerator).
+- ``rebalance_score_improvement`` — histogram of per-cycle
+  ``score_before - score_after`` on the capacity plane's
+  fragmentation score ([0, 1] ratio ladder).
+- ``rebalance_moves_per_improvement`` — histogram of evictions spent
+  per unit of measured score improvement — the defrag-efficiency SLO
+  series (a cycle that moves much and improves little burns it).
+- ``rebalance_stranded_pods_total`` — counter behind the
+  stranded-pod-after-defrag SLO gate.
+
+Gang atomicity: the kernel plans per-pod (gang membership is label
+metadata the columns never carry); this module groups the plan's moves
+by PodGroup and drops any gang whose movable members were only PARTLY
+replanned — a slice defrags as a unit or not at all. Non-gang pods
+are singleton groups. The budget clips at group granularity, best
+summed-gain groups first.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.profiler import RATIO_BUCKETS
+
+MOVES = metrics.DEFAULT.counter(
+    "rebalance_moves_total",
+    "Descheduler move pipeline by outcome: planned/evicted/rebound/"
+    "failed/stranded",
+    ("outcome",),
+)
+IMPROVEMENT = metrics.DEFAULT.histogram(
+    "rebalance_score_improvement",
+    "Per-defrag-cycle drop in the cluster fragmentation score "
+    "(score_before - score_after, clamped at 0)",
+    buckets=RATIO_BUCKETS,
+)
+MOVES_PER_IMPROVEMENT = metrics.DEFAULT.histogram(
+    "rebalance_moves_per_improvement",
+    "Evictions spent per unit of measured fragmentation-score "
+    "improvement in one defrag cycle (saturates at the ladder cap "
+    "when a cycle moves pods without moving the score)",
+)
+STRANDED = metrics.DEFAULT.counter(
+    "rebalance_stranded_pods_total",
+    "Pods evicted by a defrag move that never re-bound (move journal "
+    "recovery exhausted) — the stranded-pod-after-defrag SLO gate",
+)
+
+#: Movable worklist pads to pow2 buckets >= this (DIM_LATTICES "D").
+POD_BUCKET_MIN = 8
+
+#: Default per-cycle move budget (the descheduler may override).
+DEFAULT_MOVE_BUDGET = 32
+
+#: Saturation value observed into the efficiency histogram when a
+#: cycle executes moves but the score does not improve (the ladder's
+#: top finite bucket, so the SLO quantile reads a real breach).
+EFFICIENCY_SATURATION = 120.0
+
+#: Rebalance trend ring length (/debug/rebalance's improvement feed).
+TREND_LEN = 120
+
+
+def _pow2(n: int, minimum: int) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def movable_pods(pods, forced_nodes: Sequence[str] = ()) -> List:
+    """The defrag worklist from a pods listing: bound, live phase, not
+    Terminating, not itself a mid-move replacement (carrying the
+    destination annotation). ``forced_nodes`` (cordon-drain sources)
+    only widens eligibility conceptually — filtering is the same, the
+    force flag is applied per-pod in :func:`build_plan`."""
+    from kubernetes_tpu.models.objects import (
+        REBALANCE_DEST_ANNOTATION,
+        pod_is_terminating,
+    )
+
+    out = []
+    for p in pods:
+        if not p.spec.node_name:
+            continue
+        if p.status.phase in ("Succeeded", "Failed"):
+            continue
+        if pod_is_terminating(p):
+            continue
+        if (p.metadata.annotations or {}).get(REBALANCE_DEST_ANNOTATION):
+            continue
+        out.append(p)
+    return out
+
+
+def build_plan(
+    cols: Dict[str, np.ndarray],
+    node_names: Sequence[Optional[str]],
+    pods,
+    probes: Sequence[Tuple[str, float, float, int]],
+    move_budget: int = DEFAULT_MOVE_BUDGET,
+    forced_nodes: Sequence[str] = (),
+) -> Optional[dict]:
+    """One defrag plan: stage the movable worklist largest-first, run
+    the ``plan_moves`` kernel against the occupancy columns, then
+    apply the host-side gang-atomic grouping and the group-granular
+    budget clip. Returns the plan dict, or None when there is nothing
+    movable / the kernel path failed — it never raises (the
+    descheduler calls it on a periodic loop)."""
+    try:
+        return _build_plan(
+            cols, node_names, pods, probes, int(move_budget),
+            frozenset(forced_nodes),
+        )
+    except Exception:
+        return None
+
+
+def _build_plan(cols, node_names, pods, probes, move_budget, forced):
+    from kubernetes_tpu.models.columnar import (
+        mem_to_mib_ceil,
+        pod_resource_limits,
+    )
+    from kubernetes_tpu.models.objects import POD_GROUP_LABEL, pod_full_key
+    from kubernetes_tpu.ops.rebalance import plan_moves
+
+    movable = movable_pods(pods)
+    if not movable or move_budget <= 0:
+        return None
+    index = {
+        str(name): j for j, name in enumerate(node_names) if name is not None
+    }
+
+    rows = []
+    for p in movable:
+        cpu, mem = pod_resource_limits(p)
+        mem = mem_to_mib_ceil(mem)
+        rows.append((float(cpu), float(mem), p))
+    # Best-fit-decreasing: largest pods place first while the carry is
+    # emptiest; name-tiebreak keeps the plan deterministic.
+    rows.sort(key=lambda r: (-r[0], -r[1], r[2].metadata.name))
+
+    d = len(rows)
+    dp = _pow2(max(d, 1), POD_BUCKET_MIN)
+    pod_cpu = np.zeros(dp, np.float32)
+    pod_mem = np.zeros(dp, np.float32)
+    pod_node = np.full(dp, -1, np.int32)
+    pod_live = np.zeros(dp, bool)
+    pod_force = np.zeros(dp, bool)
+    for i, (cpu, mem, p) in enumerate(rows):
+        pod_cpu[i] = cpu
+        pod_mem[i] = mem
+        pod_node[i] = index.get(p.spec.node_name, -1)
+        pod_live[i] = pod_node[i] >= 0
+        pod_force[i] = p.spec.node_name in forced
+
+    q = len(probes)
+    qp = _pow2(max(q, 1), 4)
+    probe_cpu = np.zeros(qp, np.float32)
+    probe_mem = np.zeros(qp, np.float32)
+    probe_min = np.ones(qp, np.int32)
+    probe_live = np.zeros(qp, bool)
+    for i, (_name, cpu, mem, minm) in enumerate(probes):
+        probe_cpu[i] = cpu
+        probe_mem[i] = mem
+        probe_min[i] = max(int(minm), 1)
+        probe_live[i] = True
+
+    n = int(np.asarray(cols["cpu_cap"]).shape[0])
+    npad = _pow2(max(n, 1), 128)
+
+    def col(name, dtype):
+        a = np.asarray(cols[name]).astype(dtype, copy=False)
+        if a.shape[0] != npad:
+            a = np.pad(a, (0, npad - a.shape[0]))
+        return a
+
+    dest, moved, gain, n_moves, score_before, score_after = (
+        np.asarray(x)
+        for x in plan_moves(
+            col("cpu_cap", np.float32),
+            col("mem_cap", np.float32),
+            col("pods_cap", np.float32),
+            col("cpu_fit", np.float32),
+            col("mem_fit", np.float32),
+            col("pods_used", np.float32),
+            col("over", bool),
+            col("sched", bool),
+            pod_cpu,
+            pod_mem,
+            pod_node,
+            pod_live,
+            pod_force,
+            probe_cpu,
+            probe_mem,
+            probe_min,
+            probe_live,
+            np.int32(move_budget),
+        )
+    )
+
+    def gang_key(p):
+        g = (p.metadata.labels or {}).get(POD_GROUP_LABEL, "")
+        ns = p.metadata.namespace or "default"
+        return f"{ns}/{g}" if g else ""
+
+    moves = []
+    gang_total: Dict[str, int] = {}
+    gang_moved: Dict[str, int] = {}
+    for i, (_cpu, _mem, p) in enumerate(rows):
+        g = gang_key(p)
+        if g:
+            gang_total[g] = gang_total.get(g, 0) + 1
+            if moved[i]:
+                gang_moved[g] = gang_moved.get(g, 0) + 1
+        if not moved[i]:
+            continue
+        j = int(dest[i])
+        to = (
+            node_names[j]
+            if j < len(node_names) and node_names[j] is not None
+            else None
+        )
+        if to is None:
+            continue  # destination landed on a padding row: unusable
+        moves.append(
+            {
+                "pod": pod_full_key(p),
+                "name": p.metadata.name,
+                "namespace": p.metadata.namespace or "default",
+                "from": p.spec.node_name,
+                "to": str(to),
+                "gain": int(gain[i]),
+                "forced": bool(pod_force[i]),
+                "group": g or pod_full_key(p),
+                "gang": bool(g),
+            }
+        )
+
+    # Gang-atomic: a gang whose movable members were only partly
+    # replanned defrags not at all this cycle (a half-moved slice is
+    # worse fragmentation, not less).
+    partial = {
+        g for g, tot in gang_total.items()
+        if 0 < gang_moved.get(g, 0) < tot
+    }
+    n_planned = len(moves)
+    moves = [m for m in moves if m["group"] not in partial]
+
+    # Budget clip at group granularity, best summed-gain groups first
+    # (forced drain groups always keep their slot — a cordoned node
+    # must empty). Deterministic: gain desc, then group key.
+    groups: Dict[str, dict] = {}
+    for m in moves:
+        e = groups.setdefault(
+            m["group"],
+            {"group": m["group"], "moves": 0, "gain": 0,
+             "forced": False, "gang": m["gang"]},
+        )
+        e["moves"] += 1
+        e["gain"] += m["gain"]
+        e["forced"] = e["forced"] or m["forced"]
+    ranked = sorted(
+        groups.values(),
+        key=lambda e: (not e["forced"], -e["gain"], e["group"]),
+    )
+    kept_groups = set()
+    used = 0
+    for e in ranked:
+        if used + e["moves"] > move_budget and not e["forced"]:
+            continue
+        kept_groups.add(e["group"])
+        used += e["moves"]
+    moves = [m for m in moves if m["group"] in kept_groups]
+
+    before = float(score_before)
+    after = float(score_after)
+    return {
+        "kind": "RebalancePlan",
+        "score_before": round(before, 6),
+        "score_after": round(after, 6),
+        "improvement": round(max(before - after, 0.0), 6),
+        "move_budget": int(move_budget),
+        "movable_pods": d,
+        "planned_moves": n_planned,
+        "dropped_partial_gangs": sorted(partial),
+        "moves": moves,
+        "groups": [
+            dict(e) for e in ranked if e["group"] in kept_groups
+        ],
+    }
+
+
+def fragment_score(
+    cols: Dict[str, np.ndarray],
+    probes: Sequence[Tuple[str, float, float, int]],
+) -> Optional[float]:
+    """The current fragmentation score of the occupancy columns under
+    the probe set — the ``plan_moves`` kernel run with an all-dead
+    worklist and a zero budget (score_before IS the score; the tiny
+    fixed D=8 bucket means one cached XLA shape). None on failure."""
+    try:
+        from kubernetes_tpu.ops.rebalance import plan_moves
+
+        q = len(probes)
+        qp = _pow2(max(q, 1), 4)
+        probe_cpu = np.zeros(qp, np.float32)
+        probe_mem = np.zeros(qp, np.float32)
+        probe_min = np.ones(qp, np.int32)
+        probe_live = np.zeros(qp, bool)
+        for i, (_name, cpu, mem, minm) in enumerate(probes):
+            probe_cpu[i] = cpu
+            probe_mem[i] = mem
+            probe_min[i] = max(int(minm), 1)
+            probe_live[i] = True
+        n = int(np.asarray(cols["cpu_cap"]).shape[0])
+        npad = _pow2(max(n, 1), 128)
+
+        def col(name, dtype):
+            a = np.asarray(cols[name]).astype(dtype, copy=False)
+            if a.shape[0] != npad:
+                a = np.pad(a, (0, npad - a.shape[0]))
+            return a
+
+        out = plan_moves(
+            col("cpu_cap", np.float32),
+            col("mem_cap", np.float32),
+            col("pods_cap", np.float32),
+            col("cpu_fit", np.float32),
+            col("mem_fit", np.float32),
+            col("pods_used", np.float32),
+            col("over", bool),
+            col("sched", bool),
+            np.zeros(POD_BUCKET_MIN, np.float32),
+            np.zeros(POD_BUCKET_MIN, np.float32),
+            np.full(POD_BUCKET_MIN, -1, np.int32),
+            np.zeros(POD_BUCKET_MIN, bool),
+            np.zeros(POD_BUCKET_MIN, bool),
+            probe_cpu,
+            probe_mem,
+            probe_min,
+            probe_live,
+            np.int32(0),
+        )
+        return float(np.asarray(out[4]))
+    except Exception:
+        return None
+
+
+class RebalanceMonitor:
+    """Process-global rebalance bookkeeping: plan/cycle history, the
+    move-outcome counters, and the snapshot served by
+    ``GET /debug/rebalance``. Thread-safe; recording never raises."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._trend: deque = deque(maxlen=TREND_LEN)
+        self.samples = 0
+        self._last_plan: Optional[dict] = None
+        self._last_cycle: Optional[dict] = None
+        self._outcomes: Dict[str, int] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._trend.clear()
+            self.samples = 0
+            self._last_plan = None
+            self._last_cycle = None
+            self._outcomes = {}
+
+    def record_move(self, outcome: str, count: int = 1) -> None:
+        """One move-pipeline transition (planned/evicted/rebound/
+        failed/stranded) — feeds the counter family and the snapshot's
+        outcome table; ``stranded`` also burns the SLO gate."""
+        if count <= 0:
+            return
+        MOVES.inc(count, outcome=outcome)
+        if outcome == "stranded":
+            STRANDED.inc(count)
+        with self._lock:
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + count
+
+    def record_plan(self, plan: dict) -> None:
+        with self._lock:
+            self._last_plan = plan
+
+    def record_cycle(
+        self,
+        score_before: float,
+        score_after: float,
+        moves_executed: int,
+        trigger: str = "periodic",
+    ) -> dict:
+        """Fold one executed defrag cycle into the series: improvement
+        histogram, the efficiency (moves-per-improvement) series, and
+        the snapshot/trend. Returns the cycle summary dict."""
+        improvement = max(float(score_before) - float(score_after), 0.0)
+        IMPROVEMENT.observe(improvement)
+        if moves_executed > 0:
+            if improvement > 0:
+                MOVES_PER_IMPROVEMENT.observe(
+                    min(moves_executed / improvement, EFFICIENCY_SATURATION)
+                )
+            else:
+                MOVES_PER_IMPROVEMENT.observe(EFFICIENCY_SATURATION)
+        cycle = {
+            "trigger": trigger,
+            "score_before": round(float(score_before), 6),
+            "score_after": round(float(score_after), 6),
+            "improvement": round(improvement, 6),
+            "moves_executed": int(moves_executed),
+        }
+        with self._lock:
+            self.samples += 1
+            self._trend.append(round(improvement, 6))
+            self._last_cycle = cycle
+        return cycle
+
+    def snapshot(self) -> dict:
+        """The ``/debug/rebalance`` body. ``sampled: false`` until the
+        first defrag cycle — the ktctl miss contract keys on it."""
+        with self._lock:
+            if self.samples == 0:
+                return {
+                    "kind": "RebalanceReport",
+                    "sampled": False,
+                    "samples": 0,
+                    "moves": [],
+                    "outcomes": {},
+                    "trend": [],
+                }
+            return {
+                "kind": "RebalanceReport",
+                "sampled": True,
+                "samples": self.samples,
+                "last_plan": dict(self._last_plan or {}),
+                "last_cycle": dict(self._last_cycle or {}),
+                "moves": list((self._last_plan or {}).get("moves", [])),
+                "outcomes": dict(self._outcomes),
+                "trend": list(self._trend),
+            }
+
+
+DEFAULT = RebalanceMonitor()

@@ -236,6 +236,21 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
         "live probe shape had ZERO cluster headroom — capacity "
         "starvation no reshuffle can fix",
     ),
+    Objective(
+        "rebalance_efficiency", "rebalance_moves_per_improvement",
+        target=64.0, severity="warn",
+        description="evictions spent per unit of measured "
+        "fragmentation-score improvement, p99 — a defrag cycle must "
+        "pay for its disruption (moves are cheap only when the score "
+        "actually drops)",
+    ),
+    Objective(
+        "rebalance_stranded_pods", "rebalance_stranded_pods_total",
+        kind="counter_max", target=0.0,
+        description="pods evicted by a defrag move that never "
+        "re-bound (journal recovery exhausted) — the "
+        "stranded-pod-after-defrag gate",
+    ),
 )
 
 

@@ -93,6 +93,19 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "rebalance: continuous-rebalancing plane tests (plan_moves "
+        "kernel twins, descheduler move protocol, /debug/rebalance, "
+        "ktctl rebalance, rebalance SLO objectives); tier-1 includes "
+        "them — select just these with -m rebalance",
+    )
+    config.addinivalue_line(
+        "markers",
+        "autoscale: elastic node-pool autoscaler tests (grow on "
+        "starvation, cordon-drain-shrink on idle, pool metrics); "
+        "tier-1 includes them — select just these with -m autoscale",
+    )
+    config.addinivalue_line(
+        "markers",
         "chaos: deterministic fault-injection tests (utils/faults.py "
         "registry, injection sites, client resilience, crash-recovery "
         "properties); tier-1 includes them — select just these with "

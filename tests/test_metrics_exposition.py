@@ -607,3 +607,126 @@ def test_lint_metrics_knows_preemption_names(tmp_path):
     proc = _ktlint_kt005(root, bad)
     assert proc.returncode == 1
     assert "lacks a unit suffix" in proc.stderr
+
+
+def test_lint_metrics_knows_rebalance_names(tmp_path):
+    """The rebalance plane family (utils/rebalance.py) is known to the
+    linter: the _total counters pass the standard rule on their own,
+    the unitless improvement/efficiency histograms are explicitly
+    allowlisted, and a novel suffix-less rebalance name still fails
+    (the allowlist names metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, REBALANCE_METRICS
+
+    assert REBALANCE_METRICS == {
+        "rebalance_moves_total",
+        "rebalance_score_improvement",
+        "rebalance_moves_per_improvement",
+        "rebalance_stranded_pods_total",
+    }
+    assert REBALANCE_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.counter('
+        '"rebalance_moves_total", "x", ("outcome",))\n'
+        'B = metrics.DEFAULT.histogram("rebalance_score_improvement", "x")\n'
+        'C = metrics.DEFAULT.histogram('
+        '"rebalance_moves_per_improvement", "x")\n'
+        'D = metrics.DEFAULT.counter("rebalance_stranded_pods_total", "x")\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("rebalance_churn", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_lint_metrics_knows_autoscaler_names(tmp_path):
+    """The autoscaler family (controllers/autoscaler.py) is known to
+    the linter: autoscaler_scale_events_total passes the standard rule
+    on its own, the unitless per-pool size gauge is explicitly
+    allowlisted, and a novel suffix-less autoscaler name still fails."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, AUTOSCALER_METRICS
+
+    assert AUTOSCALER_METRICS == {
+        "autoscaler_pool_size",
+        "autoscaler_scale_events_total",
+    }
+    assert AUTOSCALER_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("autoscaler_pool_size", "x", ("pool",))\n'
+        'B = metrics.DEFAULT.counter('
+        '"autoscaler_scale_events_total", "x", ("direction",))\n'
+        'C = metrics.DEFAULT.counter('
+        '"autoscaler_syncs_total", "x", ("result",))\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("autoscaler_backlog", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_rebalance_metrics_exposed():
+    """Exposition golden for the rebalance-plane family: the
+    improvement histogram renders cumulative +le buckets on the ratio
+    ladder, the moves-per-improvement efficiency histogram lands on
+    the default ladder, and the move counter carries its outcome
+    label with declared type."""
+    from kubernetes_tpu.utils import rebalance as rebmod
+
+    rebmod.MOVES.inc(outcome="evicted")
+    rebmod.MOVES.inc(outcome="rebound")
+    rebmod.IMPROVEMENT.observe(0.35)
+    rebmod.MOVES_PER_IMPROVEMENT.observe(7.0)
+    rebmod.STRANDED.inc()
+    text = metrics.DEFAULT.render()
+    assert "# TYPE rebalance_moves_total counter" in text
+    assert 'rebalance_moves_total{outcome="evicted"} 1.0' in text
+    assert 'rebalance_moves_total{outcome="rebound"} 1.0' in text
+    assert "# TYPE rebalance_score_improvement histogram" in text
+    assert 'rebalance_score_improvement_bucket{le="0.4"}' in text
+    assert 'rebalance_score_improvement_bucket{le="+Inf"}' in text
+    assert "# TYPE rebalance_moves_per_improvement histogram" in text
+    assert 'rebalance_moves_per_improvement_bucket{le="10"}' in text
+    assert "# TYPE rebalance_stranded_pods_total counter" in text
+
+
+def test_autoscaler_metrics_exposed():
+    """Exposition golden for the autoscaler family: the per-pool size
+    gauge escapes hostile pool label values (an operator-named pool
+    can never corrupt the exposition) and the scale-events counter
+    carries its direction label with declared type."""
+    from kubernetes_tpu.controllers.autoscaler import (
+        POOL_SIZE,
+        SCALE_EVENTS,
+    )
+
+    POOL_SIZE.set(3.0, pool='we"ird\\pool\nx')
+    SCALE_EVENTS.inc(direction="up")
+    SCALE_EVENTS.inc(direction="down")
+    text = metrics.DEFAULT.render()
+    assert "# TYPE autoscaler_pool_size gauge" in text
+    # Label escaping on the pool label.
+    assert 'autoscaler_pool_size{pool="we\\"ird\\\\pool\\nx"} 3.0' in text
+    assert "# TYPE autoscaler_scale_events_total counter" in text
+    assert 'autoscaler_scale_events_total{direction="up"} 1.0' in text
+    assert 'autoscaler_scale_events_total{direction="down"} 1.0' in text

@@ -231,6 +231,11 @@ class TestLedgerCompleteness:
 
         cols, names = cluster_columns(nodes, [])
         assert capacity_monitor.sample(cols, names)         # capacity_report
+        from kubernetes_tpu.utils.capacity import DEFAULT_SLICE_SHAPES
+        from kubernetes_tpu.utils.rebalance import fragment_score
+
+        # fragment_score IS plan_moves at zero budget (rebalance.plan_moves)
+        assert fragment_score(cols, DEFAULT_SLICE_SHAPES) is not None
 
         assert ledger.DEFAULT.wait_pending(180), (
             "cost harvest never drained"

@@ -59,3 +59,27 @@ def test_capacity_row_omitted_when_keys_absent():
         if "Capacity & fragmentation" in line
     ]
     assert "tightest probe" not in row, row
+
+
+def test_rebalance_row_renders_from_figure_keys():
+    """ISSUE 17: artifacts carrying the rebalance-plane figure keys
+    get a table row with the before -> after fragmentation scores and
+    the move count; absent keys omit the row (pre-ISSUE-17 artifacts
+    never invent one)."""
+    from tools import update_readme_bench as urb
+
+    block = urb.render("BENCH_test.json", {
+        "fragmentation_score_before": 0.076923,
+        "fragmentation_score_after": 0.025641,
+        "rebalance_moves_executed": 2,
+        "rebalance_probe_bound": True,
+    })
+    (row,) = [
+        line for line in block.splitlines()
+        if "Rebalancing plane" in line
+    ]
+    assert "**0.077 → 0.026**" in row, row
+    assert "2 moves" in row, row
+    assert "post-defrag slice probe bound" in row, row
+    block = urb.render("BENCH_test.json", {"pod_crud_ops_per_sec": 100.0})
+    assert "Rebalancing plane" not in block

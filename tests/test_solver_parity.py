@@ -759,3 +759,106 @@ class TestCapacityParity:
         headroom, slice_ok = out[4], out[6]
         assert list(headroom) == [2, 2]  # one 600m pod per 1000m node
         assert list(slice_ok) == [True, False]  # minMember 2 ok, 3 not
+
+
+def random_rebalance_args(seed):
+    """Random occupancy + movable-worklist + probe inputs for the
+    defrag-plan kernel twins: the capacity value space plus a pod axis
+    (requests in column units, current placement indices including
+    invalid/-1 rows, dead padding, forced drains) and a move budget."""
+    import numpy as np
+
+    (
+        cpu_cap, mem_cap, pods_cap, cpu_fit, mem_fit, pods_used, over,
+        sched, probe_cpu, probe_mem, probe_min, probe_live,
+    ) = random_capacity_args(seed)
+    rng = np.random.default_rng(seed + 7919)
+    n = cpu_cap.shape[0]
+    d = int(rng.integers(1, 80))
+    pod_cpu = rng.choice([0.0, 50.0, 100.0, 250.0, 600.0, 2000.0], d).astype(
+        np.float32
+    )
+    pod_mem = rng.choice([0.0, 16.0, 64.0, 512.0, 2048.0], d).astype(
+        np.float32
+    )
+    pod_node = rng.integers(-2, n + 2, d).astype(np.int32)
+    pod_live = rng.random(d) > 0.2
+    pod_force = rng.random(d) < 0.15
+    move_budget = np.int32(rng.integers(0, d + 4))
+    return (
+        cpu_cap, mem_cap, pods_cap, cpu_fit, mem_fit, pods_used, over,
+        sched, pod_cpu, pod_mem, pod_node, pod_live, pod_force,
+        probe_cpu, probe_mem, probe_min, probe_live, move_budget,
+    )
+
+
+@pytest.mark.rebalance
+class TestRebalanceParity:
+    """ops/rebalance.plan_moves vs ops.oracle.plan_moves_numpy:
+    BIT-EXACT on every leaf (np.array_equal, no tolerance) — the
+    defrag scan's gains and scores are int32-quantized and its best-fit
+    argmin takes the first minimum on both sides, so reduction order
+    and tie-breaks cannot split the twins."""
+
+    @staticmethod
+    def _assert_bit_exact(args):
+        import numpy as np
+
+        from kubernetes_tpu.ops.oracle import plan_moves_numpy
+        from kubernetes_tpu.ops.rebalance import plan_moves
+
+        dev = plan_moves(*args)
+        ora = plan_moves_numpy(*args)
+        assert len(dev) == len(ora) == 6
+        for i, (d, o) in enumerate(zip(dev, ora)):
+            d, o = np.asarray(d), np.asarray(o)
+            assert d.shape == o.shape, f"leaf {i}: {d.shape} != {o.shape}"
+            assert d.dtype == o.dtype, f"leaf {i}: {d.dtype} != {o.dtype}"
+            assert np.array_equal(d, o), f"leaf {i} diverged"
+        return ora
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_worklists_bit_exact(self, seed):
+        self._assert_bit_exact(random_rebalance_args(seed))
+
+    def test_consolidation_moves_and_scores(self):
+        """The canonical defrag shape: three 500m pods spread over
+        three 1000m nodes leave 500m shards a 700m probe cannot use;
+        pairing two pods up frees a whole node and both twins agree
+        the score drops."""
+        import numpy as np
+
+        ones = np.ones(4, np.float32)
+        args = (
+            ones * 1000.0, ones * 1024.0, ones * 40.0,
+            np.asarray([500.0, 500.0, 500.0, 0.0], np.float32),
+            np.asarray([64.0, 64.0, 64.0, 0.0], np.float32),
+            np.asarray([1.0, 1.0, 1.0, 0.0], np.float32),
+            np.zeros(4, bool), np.ones(4, bool),
+            np.asarray([500.0] * 3 + [0.0], np.float32),
+            np.asarray([64.0] * 3 + [0.0], np.float32),
+            np.asarray([0, 1, 2, -1], np.int32),
+            np.asarray([True, True, True, False]),
+            np.zeros(4, bool),
+            np.asarray([700.0], np.float32),
+            np.asarray([256.0], np.float32),
+            np.asarray([1], np.int32),
+            np.asarray([True]),
+            np.int32(8),
+        )
+        out = self._assert_bit_exact(args)
+        dest, moved, gain, n_moves, before, after = out
+        assert int(n_moves) >= 1
+        assert bool(np.any(moved))
+        assert float(after) < float(before)
+        assert all(int(g) > 0 for g, m in zip(gain, moved) if m)
+
+    def test_budget_zero_plans_nothing(self):
+        import numpy as np
+
+        args = list(random_rebalance_args(3))
+        args[-1] = np.int32(0)
+        out = self._assert_bit_exact(tuple(args))
+        assert int(out[3]) == 0 and not bool(np.any(out[1]))
+        # Scores still measure: an all-frozen plan is a score probe.
+        assert float(out[4]) == float(out[5])

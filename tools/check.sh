@@ -725,18 +725,155 @@ if [ $rc -eq 0 ]; then
     rc=$cap_rc
 fi
 
+# Rebalance smoke (ISSUE 17): the continuous-rebalancing plane end to
+# end — miss contract first (`ktctl rebalance status` exits 1 with
+# "no rebalance samples recorded" before any cycle ran), then stage
+# the textbook fragmented cluster (three 1000m fillers born bound on
+# every 4000m node: a 1000m shard free each, so the 2000m slice probe
+# has zero headroom cluster-wide), run ONE forced defrag cycle, and
+# assert the populated contract: measured fragmentation drops, every
+# mover re-binds at its pinned destination, the move journal drains,
+# a 2000m probe binds post-defrag, and `ktctl rebalance status`
+# exits 0 — with zero stranded pods.
+echo "== rebalance smoke (defrag cycle + pinned rebinds) =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stderr, redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.controllers.descheduler import Descheduler
+from kubernetes_tpu.models.objects import (
+    REBALANCE_DEST_ANNOTATION, REBALANCE_JOURNAL_LABEL,
+)
+from kubernetes_tpu.scheduler.daemon import (
+    IncrementalBatchScheduler, SchedulerConfig,
+)
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+N_NODES = 6
+
+api = APIServer()
+srv = APIHTTPServer(api, max_in_flight=800).start()
+client = Client(HTTPTransport(srv.address))
+
+# Miss contract FIRST (no defrag cycle ran yet): exit 1, empty
+# stdout, the reason on stderr — mirror of ktctl top capacity.
+out, err = io.StringIO(), io.StringIO()
+with redirect_stdout(out), redirect_stderr(err):
+    rc = ktctl.main(["rebalance", "status"], client=client)
+assert rc == 1, (rc, out.getvalue(), err.getvalue())
+assert out.getvalue() == "", out.getvalue()
+assert "no rebalance samples recorded" in err.getvalue(), err.getvalue()
+
+client.create_bulk("nodes", [
+    {"kind": "Node", "metadata": {"name": f"n{j}"},
+     "status": {"capacity": {"cpu": "4", "memory": "8Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}]}}
+    for j in range(N_NODES)
+])
+
+def pod(name, cpu, node=""):
+    spec = {"containers": [{"name": "c", "image": "pause",
+            "resources": {"limits": {"cpu": cpu, "memory": "256Mi"}}}]}
+    if node:
+        spec["nodeName"] = node  # born bound: the static-pod shape
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": spec}
+
+res = client.create_bulk(
+    "pods",
+    [pod(f"f{j}-{k}", "1", node=f"n{j}")
+     for j in range(N_NODES) for k in range(3)],
+    namespace="default",
+)
+assert all(r.get("status") == "Success" for r in res)
+
+cfg = SchedulerConfig(Client(HTTPTransport(srv.address))).start()
+assert cfg.wait_for_sync(timeout=60), "scheduler caches never synced"
+sched = IncrementalBatchScheduler(cfg).start()
+
+d = Descheduler(client, frag_threshold=0.01, move_budget=8,
+                disruption_cap=8, wait_timeout_s=10.0)
+summary = d.sync_once(force=True)
+assert summary["triggered"] and summary["moves_executed"] > 0, summary
+assert summary["score_after"] < summary["score_before"], summary
+
+# Every mover re-binds at its pinned destination; the journal drains.
+deadline = time.monotonic() + 60
+settled = False
+while time.monotonic() < deadline and not settled:
+    pods, _ = client.list("pods", namespace="default")
+    movers = [p for p in pods if (p.metadata.annotations or {}).get(
+        REBALANCE_DEST_ANNOTATION)]
+    journals, _ = client.list(
+        "podtemplates", label_selector=REBALANCE_JOURNAL_LABEL)
+    settled = bool(movers) and not journals and all(
+        p.spec.node_name == (p.metadata.annotations or {}).get(
+            REBALANCE_DEST_ANNOTATION) for p in movers)
+    if not settled:
+        time.sleep(0.25)
+assert settled, "movers never settled at their pins / journal stuck"
+pods, _ = client.list("pods", namespace="default")
+assert len(pods) == N_NODES * 3, f"a move stranded a pod: {len(pods)}"
+
+# The payoff: the 2000m probe that had zero headroom pre-defrag binds.
+client.create("pods", pod("probe", "2"), namespace="default")
+deadline = time.monotonic() + 60
+probe_node = ""
+while time.monotonic() < deadline and not probe_node:
+    probe_node = client.get(
+        "pods", "probe", namespace="default").spec.node_name or ""
+    if not probe_node:
+        time.sleep(0.25)
+assert probe_node, "post-defrag 2000m probe never bound"
+
+with urllib.request.urlopen(
+    srv.address + "/debug/rebalance", timeout=10
+) as r:
+    snap = json.loads(r.read())
+assert snap["sampled"] and snap["samples"] >= 1, snap
+assert snap["outcomes"].get("stranded", 0) == 0, snap
+
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["rebalance", "status"], client=client)
+text = out.getvalue()
+assert rc == 0, text
+assert "evicted=" in text, text
+sched.stop()
+srv.stop()
+print(f"rebalance smoke OK: fragmentation "
+      f"{summary['score_before']} -> {summary['score_after']} in "
+      f"{summary['moves_executed']} moves; probe bound on "
+      f"{probe_node}; journal drained; zero stranded; miss contract "
+      f"held")
+EOF
+reb_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$reb_rc
+fi
+
 # Soak smoke (ISSUE 15): ~200 hollow nodes (real kubelets, no-op
 # runtime) driving the full API→solve→bind→kubelet loop while the
 # seeded chaos schedule fires ONE apiserver kill -9 (torn WAL write →
 # crash → snapshot+WAL replay) and ONE abrupt scheduler-daemon kill
-# mid-gang (fresh daemon rebuilds its SolverSession from LIST+watch).
-# Gate: the invariant checker comes back green — replay consistency,
-# bind immutability, gang all-or-nothing, exactly-one-DELETED,
-# nominations recovered, SLO series advancing. Artifact in
+# mid-gang (fresh daemon rebuilds its SolverSession from LIST+watch),
+# plus ONE defrag_churn epoch (ISSUE 17: fragment the fleet, let the
+# descheduler consolidate, probes bind — fragmentation_score_before >
+# _after lands in the artifact's capacity_timeline). Gate: the
+# invariant checker comes back green — replay consistency, bind
+# immutability, gang all-or-nothing, exactly-one-DELETED, nominations
+# recovered, move journal drained, SLO series advancing. Artifact in
 # /tmp/soak_smoke.json for dashboards.
-echo "== soak smoke (chaos plane, ~60s) =="
+echo "== soak smoke (chaos + rebalance plane, ~90s) =="
 env JAX_PLATFORMS=cpu python -m tools.soak --nodes 200 --seed 7 \
-    --epochs baseline,apiserver_restart,daemon_restart_mid_gang,final \
+    --epochs baseline,apiserver_restart,daemon_restart_mid_gang,defrag_churn,final \
     --out /tmp/soak_smoke.json
 soak_rc=$?
 if [ $rc -eq 0 ]; then

@@ -135,6 +135,34 @@ CAPACITY_METRICS = {
 }
 ALLOWLIST |= CAPACITY_METRICS
 
+#: Rebalancing-plane family (utils/rebalance.py, driven by
+#: controllers/descheduler.py — see docs/architecture.md "Rebalancing
+#: plane"). rebalance_moves_total and rebalance_stranded_pods_total
+#: carry _total on their own; the improvement histogram is a unit-less
+#: [0, 1] score delta on the profiler's ratio ladder and
+#: rebalance_moves_per_improvement is a composite efficiency quotient
+#: (evictions per score unit, the defrag-efficiency SLO series) — the
+#: whole family is declared so the linter documents it rather than
+#: silently tolerating the unsuffixed members.
+REBALANCE_METRICS = {
+    "rebalance_moves_total",
+    "rebalance_score_improvement",
+    "rebalance_moves_per_improvement",
+    "rebalance_stranded_pods_total",
+}
+ALLOWLIST |= REBALANCE_METRICS
+
+#: Elastic node-pool autoscaler family (controllers/autoscaler.py).
+#: autoscaler_scale_events_total carries _total on its own;
+#: autoscaler_pool_size is a unitless snapshot gauge (a node count per
+#: pool, like cluster_headroom_pods) — declared as a family for the
+#: same documentation reason.
+AUTOSCALER_METRICS = {
+    "autoscaler_pool_size",
+    "autoscaler_scale_events_total",
+}
+ALLOWLIST |= AUTOSCALER_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"

