@@ -1881,6 +1881,26 @@ def main() -> None:
         }
     except Exception as e:
         record["ktshape_error"] = str(e)
+    # ktmesh: the static SPMD budget verdict — budget findings must
+    # chart at ZERO, and the collective totals show the communication
+    # the declared shardings cost (drift in either is a sharding
+    # regression or a stale CommBudget pin).
+    try:
+        from tools.ktlint import ktmesh as _ktmesh
+
+        _km = _ktmesh.analyze()
+        record["ktmesh_budgets"] = {
+            "kernels_checked": len(_km.kernels),
+            "collectives_total": _km.collectives_total,
+            "collective_bytes_total": _km.collective_bytes_total,
+            "skipped": sum(
+                1 for k in _km.kernels if k["status"] == "skipped"
+            ),
+            "budget_findings": len(_km.findings),
+            "errors": len(_km.errors),
+        }
+    except Exception as e:
+        record["ktmesh_error"] = str(e)
     # Compile/cost ledger summary (ISSUE 13): total compile wall +
     # top-3 kernels by FLOPs/bytes from the always-on traced-jit
     # ledger the run's solves populated, next to the ktlint/ktsan

@@ -1258,7 +1258,7 @@ def cmd_profile(client: Client, args) -> int:
             return 0
         print(
             f"{'KERNEL':44}{'CALLS':>7}{'COMPILES':>9}{'COMPILE_S':>10}"
-            f"{'FLOPS':>9}{'BYTES':>9}{'AI':>7}  CONTRACT"
+            f"{'FLOPS':>9}{'BYTES':>9}{'AI':>7}  {'CONTRACT':9} COMM"
         )
         mismatches = []
         for r in rows:
@@ -1288,6 +1288,26 @@ def cmd_profile(client: Client, args) -> int:
                 contract = "ok"
             else:
                 contract = "-"
+            # Collective-inventory verdict (harvest-attached; same
+            # worst-across-shape-rows logic): DRIFT when any staged
+            # bucket compiled an undeclared collective kind.
+            comms = [s.get("collectives_verdict") for s in shapes]
+            if any(v and v.startswith("drift") for v in comms):
+                comm = "DRIFT"
+                mismatches.extend(
+                    (r["kernel"], s.get("signature", ""),
+                     s["collectives_verdict"])
+                    for s in shapes
+                    if (s.get("collectives_verdict") or "").startswith(
+                        "drift"
+                    )
+                )
+            elif comms and all(v == "ok" for v in comms):
+                comm = "ok"
+            elif "uncontracted" in comms:
+                comm = "uncontracted"
+            else:
+                comm = "-"
             ai = peak("arithmetic_intensity")
             print(
                 f"{r['kernel']:44}{r.get('calls', 0):>7}"
@@ -1295,7 +1315,8 @@ def cmd_profile(client: Client, args) -> int:
                 f"{r.get('compile_seconds', 0.0):>10.3f}"
                 f"{_fmt_qty(peak('flops')):>9}"
                 f"{_fmt_qty(peak('bytes_accessed')):>9}"
-                f"{'-' if ai is None else f'{ai:.2f}':>7}  {contract}"
+                f"{'-' if ai is None else f'{ai:.2f}':>7}  "
+                f"{contract:9} {comm}"
             )
         for kernel, signature, verdict in mismatches:
             print(f"  {kernel} {signature}: {verdict}")

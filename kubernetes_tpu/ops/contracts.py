@@ -15,7 +15,16 @@ be enforced only dynamically and partially:
   pod axis (``shardable``: the precondition for sharding the pod axis
   over a Mesh, ROADMAP item #2), intentionally couples pods
   (``reduces``: scans/segment reductions), or never touches the pod
-  axis at all (``replicated``).
+  axis at all (``replicated``);
+- **mesh sharding + communication budget** — HOW the kernel partitions
+  over a 1-D Mesh (which symbolic dim is sharded over which axis — a
+  symbolic PartitionSpec per array leaf, see :func:`partition_specs`)
+  and the exact collective inventory XLA's SPMD partitioner inserts
+  for it (:class:`CommBudget`, pinned at the distinct-dims probe
+  point). ``tools/ktlint/ktmesh.py`` VERIFIES the budget by
+  partitioned-lowering under a forced multi-device CPU mesh (compile,
+  never execute); the ledger joins runtime compiles against it via
+  :func:`comm_verdict`.
 
 This module DECLARES those invariants, one :class:`Contract` per
 ORACLE_TWINS key; ``tools/ktlint/ktshape.py`` VERIFIES them without
@@ -47,19 +56,25 @@ from kubernetes_tpu.ops.parity import ORACLE_TWINS
 
 __all__ = [
     "ArraySpec",
+    "CommBudget",
     "Contract",
     "CONTRACTS",
     "DIM_LATTICES",
+    "MeshSharding",
     "Static",
     "DimRef",
     "POD_AXIS_KINDS",
     "abstract_args",
+    "collective_inventory",
+    "comm_verdict",
     "contract_verdict",
     "declared_array_leaves",
     "leaf_signature",
     "match_signature",
+    "partition_specs",
     "resolve_kernel",
     "shape_signature",
+    "sharded_abstract_args",
 ]
 
 
@@ -201,6 +216,58 @@ class DimRef:
 
 
 @dataclass(frozen=True)
+class CommBudget:
+    """The exact collective set one kernel's partitioned lowering may
+    emit under its declared :class:`MeshSharding`, pinned at the
+    distinct-dims probe point (jax 0.4.x GSPMD on the forced 8-device
+    host platform). ktmesh compares the compiled module's inventory
+    against this EXACTLY — a phantom collective (sharding regression)
+    and a vanished one (stale budget) are both findings."""
+
+    all_gather: int = 0
+    all_reduce: int = 0
+    reduce_scatter: int = 0
+    collective_permute: int = 0
+    all_to_all: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        """Sparse {HLO op name: count} — keys match the hyphenated
+        names :func:`collective_inventory` counts, zero entries
+        dropped so declared == observed is a plain dict compare."""
+        pairs = (
+            ("all-gather", self.all_gather),
+            ("all-reduce", self.all_reduce),
+            ("reduce-scatter", self.reduce_scatter),
+            ("collective-permute", self.collective_permute),
+            ("all-to-all", self.all_to_all),
+        )
+        return {k: v for k, v in pairs if v}
+
+    def total(self) -> int:
+        return sum(self.as_dict().values())
+
+
+@dataclass(frozen=True)
+class MeshSharding:
+    """How one kernel partitions over a 1-D Mesh: ``dim`` is the
+    symbolic dim sharded across mesh axis ``axis`` (None: every leaf
+    replicated — the kernel runs identically on every device).
+    The per-leaf PartitionSpec is DERIVED (:func:`partition_specs`):
+    an array leaf shards exactly its ``dim`` dims, everything else
+    replicates — the same layout ``matrices.shardings_for`` produces
+    at runtime, so the static budget and the production staging agree
+    by construction. ``lower_overrides`` pins contract kwargs for the
+    mesh lowering only (e.g. the pallas kernel needs interpret=True to
+    compile on the host platform)."""
+
+    dim: Optional[str]
+    axis: str  # "pods" | "nodes"
+    budget: CommBudget = CommBudget()
+    lower_overrides: Tuple[Tuple[str, object], ...] = ()
+    notes: str = ""
+
+
+@dataclass(frozen=True)
 class Contract:
     """One kernel's declared interface. ``args`` are (name, spec-tree)
     in call order — spec-tree is an ArraySpec, a dict of ArraySpecs
@@ -209,7 +276,9 @@ class Contract:
     ArraySpecs). ``pod_dim`` names which symbol is the pod axis (None:
     the kernel never sees pods); ``pod_axis`` declares its coupling
     class. ``samples`` are the bucket-lattice points the checker
-    abstract-evaluates at."""
+    abstract-evaluates at. ``sharding`` declares the mesh partitioning
+    + communication budget (ktmesh's subject; every registered kernel
+    must carry one)."""
 
     kernel: str
     args: Tuple[Tuple[str, object], ...]
@@ -219,6 +288,7 @@ class Contract:
     samples: Tuple[Dict[str, int], ...]
     kwargs: Tuple[Tuple[str, object], ...] = ()
     notes: str = ""
+    sharding: Optional[MeshSharding] = None
 
 
 POD_AXIS_KINDS = ("shardable", "reduces", "replicated")
@@ -324,6 +394,17 @@ CONTRACTS: Dict[str, Contract] = {
         pod_axis="reduces",
         samples=_SOLVE_SAMPLES,
         notes="sequential scan over the pod axis — the parity path",
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=24, all_reduce=8),
+            notes=(
+                "the MULTICHIP layout: node columns sharded, pod "
+                "columns replicated (the scan couples pods "
+                "sequentially, so the pod axis cannot shard); the "
+                "per-step argmax runs as a cross-shard reduce + "
+                "node-axis gathers"
+            ),
+        ),
     ),
     "solver._solve_with_state_xla": Contract(
         kernel="solver._solve_with_state_xla",
@@ -338,6 +419,10 @@ CONTRACTS: Dict[str, Contract] = {
         pod_axis="reduces",
         samples=_SOLVE_SAMPLES,
         notes="scan + donated occupancy carry",
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=24, all_reduce=8),
+        ),
     ),
     "solver.explain_rows": Contract(
         kernel="solver.explain_rows",
@@ -355,6 +440,16 @@ CONTRACTS: Dict[str, Contract] = {
             "vmapped per-pod verdicts against FIXED occupancy — every "
             "pod independent; the proven go-case for the pod-axis Mesh"
         ),
+        sharding=MeshSharding(
+            dim="P", axis="pods",
+            budget=CommBudget(),
+            notes=(
+                "THE go-case: pod columns sharded over the pod axis, "
+                "node columns replicated — must lower with ZERO "
+                "collectives (any collective here means the "
+                "embarrassingly-parallel claim broke)"
+            ),
+        ),
     ),
     "wave.solve_waves": Contract(
         kernel="wave.solve_waves",
@@ -364,6 +459,16 @@ CONTRACTS: Dict[str, Contract] = {
         pod_axis="reduces",
         samples=_SOLVE_SAMPLES,
         notes="windowed commit loop: waves gather/scatter the pod axis",
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=2, all_reduce=11),
+            notes=(
+                "per-wave feasibility scored on node shards, wave "
+                "commits psum'd — a dozen windowed rounds instead of "
+                "the scan's P per-pod rounds (why auto resolves to "
+                "wave on a mesh)"
+            ),
+        ),
     ),
     "wave.solve_waves_with_state": Contract(
         kernel="wave.solve_waves_with_state",
@@ -372,6 +477,10 @@ CONTRACTS: Dict[str, Contract] = {
         pod_dim="P",
         pod_axis="reduces",
         samples=_SOLVE_SAMPLES,
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=2, all_reduce=11),
+        ),
     ),
     "sinkhorn.solve_sinkhorn_stats": Contract(
         kernel="sinkhorn.solve_sinkhorn_stats",
@@ -381,6 +490,14 @@ CONTRACTS: Dict[str, Contract] = {
         pod_axis="reduces",
         samples=_SOLVE_SAMPLES,
         notes="Sinkhorn-priced windowed loop + convergence telemetry",
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=2, all_reduce=15),
+            notes=(
+                "wave's inventory + the Sinkhorn price iteration's "
+                "extra node-shard psums (row/col marginals)"
+            ),
+        ),
     ),
     "sinkhorn.solve_sinkhorn_with_state": Contract(
         kernel="sinkhorn.solve_sinkhorn_with_state",
@@ -389,6 +506,10 @@ CONTRACTS: Dict[str, Contract] = {
         pod_dim="P",
         pod_axis="reduces",
         samples=_SOLVE_SAMPLES,
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=2, all_reduce=15),
+        ),
     ),
     "pallas_scan._solve_packed": Contract(
         kernel="pallas_scan._solve_packed",
@@ -403,6 +524,17 @@ CONTRACTS: Dict[str, Contract] = {
         samples=_SOLVE_SAMPLES,
         kwargs=(("interpret", Static(False)),),
         notes="whole sequential solve as one pallas_call (VMEM carry)",
+        sharding=MeshSharding(
+            dim=None, axis="nodes",
+            budget=CommBudget(),
+            lower_overrides=(("interpret", True),),
+            notes=(
+                "single-device only by design (the VMEM carry cannot "
+                "shard): fully replicated, zero collectives; Mosaic "
+                "cannot lower on the host platform, so the mesh probe "
+                "compiles the interpreter path"
+            ),
+        ),
     ),
     "matrices.gang_member_counts": Contract(
         kernel="matrices.gang_member_counts",
@@ -416,6 +548,15 @@ CONTRACTS: Dict[str, Contract] = {
         ),
         kwargs=(("num_groups", DimRef("G")),),
         notes="masked segment_sum over the pod axis — gang acceptance",
+        sharding=MeshSharding(
+            dim="PG", axis="pods",
+            budget=CommBudget(all_reduce=1),
+            notes=(
+                "the canonical reduces-kernel budget: pod rows "
+                "sharded, per-shard segment_sum, ONE psum over the "
+                "pod axis — and nothing more"
+            ),
+        ),
     ),
     "incremental._scatter_rows": Contract(
         kernel="incremental._scatter_rows",
@@ -432,6 +573,15 @@ CONTRACTS: Dict[str, Contract] = {
             {"N": 256, "LW": 2, "PW": 2, "VW": 4, "S": 16, "R": 64},
         ),
         notes="node-row patch; never sees the pod axis",
+        sharding=MeshSharding(
+            dim=None, axis="nodes",
+            budget=CommBudget(),
+            notes=(
+                "dirty-row scatter stays replicated: sharding the "
+                "node axis would turn every row patch into a "
+                "collective-permute round on the micro-tick path"
+            ),
+        ),
     ),
     "preemption._victim_prefix_kernel.kernel": Contract(
         kernel="preemption._victim_prefix_kernel.kernel",
@@ -460,6 +610,15 @@ CONTRACTS: Dict[str, Contract] = {
         notes=(
             "victim rows ARE pods: the lexsort + per-node prefix "
             "cumsums couple them by construction"
+        ),
+        sharding=MeshSharding(
+            dim=None, axis="nodes",
+            budget=CommBudget(),
+            notes=(
+                "replicated: victim sets are small (pow2 >= 8, not "
+                "the 500k pod axis) and the lexsort would serialize "
+                "across shards anyway"
+            ),
         ),
     ),
     "capacity.capacity_report": Contract(
@@ -502,6 +661,15 @@ CONTRACTS: Dict[str, Contract] = {
             "totals reduce over the probe axis (and stranded-node "
             "detection any()s across it)"
         ),
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_reduce=6),
+            notes=(
+                "node columns sharded (the probe axis is tiny), "
+                "per-probe headroom counts and the frag/stranded "
+                "totals psum across node shards"
+            ),
+        ),
     ),
     "rebalance.plan_moves": Contract(
         kernel="rebalance.plan_moves",
@@ -543,6 +711,15 @@ CONTRACTS: Dict[str, Contract] = {
             "best-fit-decreasing scan over the movable-pod axis with "
             "an evolving occupancy carry — later moves see earlier "
             "ones by construction"
+        ),
+        sharding=MeshSharding(
+            dim="N", axis="nodes",
+            budget=CommBudget(all_gather=12, all_reduce=5),
+            notes=(
+                "node occupancy sharded; each best-fit step gathers "
+                "the per-shard scores and psums the move verdicts "
+                "(the movable-pod scan itself is sequential)"
+            ),
         ),
     ),
 }
@@ -606,20 +783,25 @@ def _np_dtype(token: str):
     return getattr(np, name)
 
 
-def _materialize(spec, bindings: Dict[str, int]):
+def _materialize(spec, bindings: Dict[str, int], leaf_sharding=None):
     """spec-tree -> ShapeDtypeStruct pytree (statics resolve to their
-    sample values)."""
+    sample values). ``leaf_sharding(ArraySpec) -> jax sharding`` tags
+    each array aval for partitioned lowering (the ktmesh probe)."""
     import jax
 
     if isinstance(spec, ArraySpec):
         if spec.optional:
             return None  # optional leaves are omitted from probes
         shape = tuple(bindings[d] for d in spec.dims)
+        if leaf_sharding is not None:
+            return jax.ShapeDtypeStruct(
+                shape, _np_dtype(spec.dtype), sharding=leaf_sharding(spec)
+            )
         return jax.ShapeDtypeStruct(shape, _np_dtype(spec.dtype))
     if isinstance(spec, dict):
         out = {}
         for k in sorted(spec):
-            v = _materialize(spec[k], bindings)
+            v = _materialize(spec[k], bindings, leaf_sharding)
             if v is not None:
                 out[k] = v
         return out
@@ -661,6 +843,160 @@ def expected_results(contract: Contract, bindings: Dict[str, int]):
         return tuple(mat(s) for s in spec)
 
     return mat(contract.results)
+
+
+# -- mesh shardings + collective inventories (ktmesh's substrate) ------
+
+
+def partition_spec(
+    leaf: ArraySpec, sharding: MeshSharding
+) -> Tuple[Optional[str], ...]:
+    """One array leaf's symbolic PartitionSpec under the contract's
+    sharding: the sharded dim carries the mesh axis name, everything
+    else replicates. ``('nodes', None)`` for an (N, S) leaf sharded
+    over dim 'N' on axis 'nodes'."""
+    return tuple(
+        sharding.axis if d == sharding.dim else None for d in leaf.dims
+    )
+
+
+def partition_specs(contract: Contract) -> Dict[str, object]:
+    """The whole contract's symbolic PartitionSpecs, arguments and
+    results — the declarative sharding surface tests and docs quote.
+    Array leaves map to axis tuples, statics/DimRefs to None."""
+    sh = contract.sharding
+    if sh is None:
+        raise ValueError(f"{contract.kernel}: no sharding leaf declared")
+
+    def mat(spec):
+        if isinstance(spec, ArraySpec):
+            return partition_spec(spec, sh)
+        if isinstance(spec, dict):
+            return {k: mat(spec[k]) for k in sorted(spec)}
+        if isinstance(spec, (Static, DimRef)):
+            return None
+        return tuple(mat(s) for s in spec)
+
+    return {
+        "args": {name: mat(spec) for name, spec in contract.args},
+        "results": mat(contract.results),
+    }
+
+
+def sharded_abstract_args(
+    contract: Contract, bindings: Dict[str, int], mesh
+) -> Tuple[tuple, dict]:
+    """:func:`abstract_args` with every array aval tagged with the
+    NamedSharding its symbolic PartitionSpec implies on `mesh`, and
+    the sharding leaf's lower_overrides applied to the kwargs — the
+    exact input ktmesh partitioned-lowers (and the runtime cross-check
+    in tests/test_multichip.py re-lowers)."""
+    import jax  # noqa: F401  (NamedSharding needs an initialized jax)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = contract.sharding
+    if sh is None:
+        raise ValueError(f"{contract.kernel}: no sharding leaf declared")
+
+    def leaf_sharding(spec: ArraySpec):
+        return NamedSharding(mesh, PartitionSpec(*partition_spec(spec, sh)))
+
+    args = tuple(
+        _materialize(spec, bindings, leaf_sharding)
+        for _, spec in contract.args
+    )
+    kwargs = {
+        name: _materialize(spec, bindings, leaf_sharding)
+        for name, spec in contract.kwargs
+    }
+    for name, value in sh.lower_overrides:
+        kwargs[name] = value
+    return args, kwargs
+
+
+#: One partitioned-HLO collective op: result dtype, result dims, kind.
+#: Matched per line so the all-gather `dimensions={d}` attribute (the
+#: gathered dim — what the pod-axis full-gather check needs) can be
+#: read off the same line.
+_COLLECTIVE_LINE_RE = re.compile(
+    r"= (?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\][^ ]* "
+    r"(?P<kind>all-gather|all-reduce|reduce-scatter|collective-permute"
+    r"|all-to-all)\("
+)
+_GATHER_DIM_RE = re.compile(r"dimensions=\{(\d+)\}")
+
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f8": 1, "s8": 1, "u8": 1, "pred": 1,
+}
+
+
+def collective_inventory(hlo_text: str) -> Dict[str, object]:
+    """Walk one compiled/partitioned HLO module's text for collective
+    ops. Returns {"counts": {kind: n}, "bytes": {kind: result bytes},
+    "total": n, "ops": [per-op dicts]} — each op carries kind, dtype,
+    shape, bytes, and (all-gather/all-to-all) the gathered dim index.
+    Pure regex over ``Compiled.as_text()``: no jax import, so the
+    ledger's harvest thread and ktmesh share THIS implementation
+    without the control plane loading anything."""
+    counts: Dict[str, int] = {}
+    byte_volume: Dict[str, int] = {}
+    ops: List[Dict[str, object]] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_LINE_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group("kind")
+        dims = (
+            tuple(int(d) for d in m.group("dims").split(","))
+            if m.group("dims")
+            else ()
+        )
+        width = _HLO_DTYPE_BYTES.get(m.group("dtype"), 4)
+        n_elem = 1
+        for d in dims:
+            n_elem *= d
+        counts[kind] = counts.get(kind, 0) + 1
+        byte_volume[kind] = byte_volume.get(kind, 0) + n_elem * width
+        op: Dict[str, object] = {
+            "kind": kind,
+            "dtype": m.group("dtype"),
+            "shape": list(dims),
+            "bytes": n_elem * width,
+        }
+        if kind in ("all-gather", "all-to-all"):
+            gm = _GATHER_DIM_RE.search(line)
+            if gm is not None:
+                op["gather_dim"] = int(gm.group(1))
+        ops.append(op)
+    return {
+        "counts": counts,
+        "bytes": byte_volume,
+        "total": sum(counts.values()),
+        "ops": ops,
+    }
+
+
+def comm_verdict(kernel: str, counts: Dict[str, int]) -> str:
+    """The COMM column for one ledger shape row: the collective KINDS
+    a runtime compile emitted, joined against the declared budget.
+    Lenient on counts — runtime buckets differ from the pinned probe
+    point, and ktmesh owns the exact-count gate there — but strict on
+    kinds: a collective kind outside the declared budget is sharding
+    drift no matter the shape. Single-device compiles have empty
+    inventories and are trivially 'ok'."""
+    contract = CONTRACTS.get(kernel)
+    if contract is None or contract.sharding is None:
+        return "uncontracted"
+    if not counts:
+        return "ok"
+    declared = set(contract.sharding.budget.as_dict())
+    extra = sorted(set(counts) - declared)
+    if extra:
+        return f"drift: undeclared {','.join(extra)}"
+    return "ok"
 
 
 def resolve_kernel(key: str):

@@ -26,6 +26,12 @@ What gets recorded, per (kernel, staged-shape signature):
   double every compile stall on the tick path. Rows show
   ``cost_status: pending`` until the harvest lands (tests and bench
   block on ``wait_pending``).
+- **collective inventory**: the compiled module's collective op counts
+  (ops/contracts.py ``collective_inventory`` — the same parser ktmesh
+  pins budgets with) plus a ``collectives_verdict`` joining them
+  against the kernel's declared CommBudget: an undeclared collective
+  KIND at any staged shape is sharding drift (``drift: ...``), shown
+  as the COMM column in ``ktctl profile kernels``.
 
 Surfaces: ``GET /debug/kernels`` (server/httpserver.py), ``ktctl
 profile kernels`` (exit 1 + "no compiles recorded" on a cold process),
@@ -394,6 +400,25 @@ def _harvest_worker() -> None:
                     getattr(ma, "generated_code_size_in_bytes", 0) or 0
                 ),
             }
+            # Collective inventory + COMM verdict: the harvest is the
+            # ONE place the compiled/partitioned module exists, so the
+            # sharding story rides the same row as cost/memory. The
+            # shared parser lives in ops/contracts.py (pure regex —
+            # ktmesh pins exact budgets at its probe point; the
+            # runtime verdict only flags UNDECLARED collective kinds,
+            # because staged bucket sizes vary the counts).
+            try:
+                from kubernetes_tpu.ops.contracts import (
+                    collective_inventory, comm_verdict,
+                )
+
+                inv = collective_inventory(compiled.as_text())
+                memory["collectives"] = inv["counts"]
+                memory["collectives_verdict"] = comm_verdict(
+                    kernel, inv["counts"]
+                )
+            except Exception:  # pragma: no cover - inventory must
+                pass  # never sink a cost harvest
             led.attach_cost(kernel, signature, cost, memory)
         except Exception as e:
             _LOG.debug(
@@ -444,6 +469,10 @@ class TracedJit:
         import jax
 
         self._fn = fn
+        # Retained for introspection: ktmesh's runtime<->static
+        # cross-check rebuilds this jit (same static/donate argnames)
+        # to lower the kernel under probe shardings.
+        self.jit_kwargs = dict(jit_kwargs)
         self._jit = jax.jit(fn, **jit_kwargs)
         self.kernel = kernel or _derive_kernel_name(fn)
         functools.update_wrapper(self, fn)

@@ -251,12 +251,32 @@ def node_axis_multiple(
     return max(pad_to, int(np.prod([mesh.shape[a] for a in mesh.axis_names])))
 
 
+def host_mesh(
+    n: int, axis: str = "nodes"
+) -> Optional[jax.sharding.Mesh]:
+    """The sanctioned mesh constructor for the kernel layer: a 1-D mesh
+    over the first `n` visible devices, or None when a mesh is not
+    viable (n < 2, or fewer than n devices — e.g. a host platform that
+    was not forced to multiple CPU devices). Sessions, the
+    KT_MESH_DEVICES escape hatch, and test fixtures all route through
+    here so ops/ shares one topology (KT009 flags ad-hoc Mesh
+    construction elsewhere in the package)."""
+    if n < 2:
+        return None
+    devices = jax.devices()
+    if len(devices) < n:
+        return None
+    return jax.sharding.Mesh(np.asarray(devices[:n]), axis_names=(axis,))
+
+
 def shardings_for(mesh: Optional[jax.sharding.Mesh], node_axis: str = "nodes"):
     """(node_sharding, pod_sharding) for a mesh (or the default device)."""
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
         return NamedSharding(mesh, PS(node_axis)), NamedSharding(mesh, PS())
+    # The ONE sanctioned default-device read in ops/ (no-mesh staging).
+    # ktlint: disable=KT009
     device = jax.devices()[0]
     return device, device
 

@@ -34,6 +34,59 @@ from kubernetes_tpu.utils import sanitizer, tracing
 
 
 _AUTO_NO_MESH_WARNED = False
+_ENV_MESH_WARNED = False
+
+
+def env_mesh():
+    """The KT_MESH_DEVICES=N escape hatch: a host-platform mesh for
+    daemons that have no session-threaded mesh yet (ROADMAP item 2).
+    Returns a mesh over the first N visible devices via the sanctioned
+    matrices seam, or None when the variable is unset, not a valid
+    integer >= 2, or fewer than N devices are visible (each non-unset
+    failure warns once — a typo'd hatch must not silently fall back to
+    the unsharded path). Lazy jax import: the batch module stays
+    importable on jax-free control-plane hosts."""
+    import os
+
+    raw = os.environ.get("KT_MESH_DEVICES")
+    if raw is None:
+        return None
+    global _ENV_MESH_WARNED
+
+    def _warn_once(msg):
+        global _ENV_MESH_WARNED
+        if not _ENV_MESH_WARNED:
+            _ENV_MESH_WARNED = True
+            import logging
+
+            logging.getLogger(__name__).warning(msg)
+
+    try:
+        n = int(raw)
+    except ValueError:
+        _warn_once(
+            f"KT_MESH_DEVICES={raw!r} is not an integer — ignoring the "
+            "escape hatch (unsharded solve)"
+        )
+        return None
+    if n < 2:
+        if n != 1:  # =1 is an explicit "no mesh", not a misconfig
+            _warn_once(
+                f"KT_MESH_DEVICES={n} < 2 cannot form a mesh — ignoring "
+                "the escape hatch (unsharded solve)"
+            )
+        return None
+    from kubernetes_tpu.ops import matrices
+
+    mesh = matrices.host_mesh(n)
+    if mesh is None:
+        _warn_once(
+            f"KT_MESH_DEVICES={n} requested but fewer devices are "
+            "visible — ignoring the escape hatch (unsharded solve); on "
+            "CPU hosts also set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    return mesh
 
 
 def resolve_batch_mode(mode: str, mesh=None) -> str:
@@ -52,11 +105,16 @@ def resolve_batch_mode(mode: str, mesh=None) -> str:
 
     Today NO shipped daemon constructs a mesh (ADVICE r5: both
     production call sites pass mesh=None), so in the daemons `auto`
-    always resolves to scan until ROADMAP item 2 threads a real
+    resolves to scan until ROADMAP item 2 threads a real
     jax.sharding.Mesh through the schedulers — the one-time warning
-    below keeps that honest for operators reading logs."""
+    below keeps that honest for operators reading logs. The
+    KT_MESH_DEVICES=N environment escape hatch (:func:`env_mesh`)
+    bridges the gap: when set and no mesh was passed, auto consults a
+    host-platform mesh built through the matrices seam."""
     if mode != "auto":
         return mode
+    if mesh is None:
+        mesh = env_mesh()
     if mesh is None:
         global _AUTO_NO_MESH_WARNED
         if not _AUTO_NO_MESH_WARNED:
@@ -66,9 +124,11 @@ def resolve_batch_mode(mode: str, mesh=None) -> str:
             logging.getLogger(__name__).warning(
                 "--batch-mode auto resolved to 'scan': no device mesh "
                 "is threaded through this scheduler (the daemons never "
-                "construct one yet — ROADMAP item 2), so auto currently "
-                "ALWAYS selects scan in production; the wave path "
-                "engages only when a solve runs over a real mesh"
+                "construct one yet — ROADMAP item 2) and KT_MESH_DEVICES "
+                "is unset, so auto currently ALWAYS selects scan in "
+                "production; the wave path engages only when a solve "
+                "runs over a real mesh (or the KT_MESH_DEVICES=N "
+                "escape hatch builds one)"
             )
         return "scan"
     return "wave"

@@ -119,6 +119,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "ktmesh: static SPMD partitioning-analyzer tests (KT009 "
+        "fixtures, sharding contracts / collective inventories / "
+        "communication budgets, live-tree mesh gate); tier-1 includes "
+        "them — select just these with -m ktmesh",
+    )
+    config.addinivalue_line(
+        "markers",
         "sanitize: run this test with the ktsan lock sanitizer enabled "
         "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
         "finding or leaked non-daemon thread; the concurrency-heavy "
@@ -140,6 +147,44 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+@pytest.fixture(scope="session")
+def host_mesh():
+    """Factory for 1-D host-platform meshes over the forced 8-device
+    CPU platform, routed through the ONE sanctioned constructor
+    (ops.matrices.host_mesh) so tests exercise the same seam sessions
+    and the KT_MESH_DEVICES hatch use. Call with n (and optionally the
+    axis name); asserts the mesh actually formed — under this conftest
+    8 devices are guaranteed, so None means the env setup broke."""
+    from kubernetes_tpu.ops import matrices
+
+    def make(n: int, axis: str = "nodes"):
+        mesh = matrices.host_mesh(n, axis=axis)
+        assert mesh is not None, (
+            f"host_mesh({n}) returned None with {len(jax.devices())} "
+            "visible devices — the forced 8-device CPU platform did "
+            "not take (XLA_FLAGS set after backend init?)"
+        )
+        return mesh
+
+    return make
+
+
+@pytest.fixture()
+def mesh_subprocess_env():
+    """os.environ copy for subprocesses that must see the same forced
+    8-device CPU platform as the in-process tests (CLI gates, ktmesh
+    subprocess runs). A bare copy is NOT enough on machines where the
+    parent inherited different XLA_FLAGS pre-conftest."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return env
 
 
 @pytest.fixture(autouse=True)

@@ -348,6 +348,34 @@ def test_batch_mode_auto_resolution_keyed_on_mesh_argument():
         assert resolve_batch_mode(m, mesh=None) == m
 
 
+def test_batch_mode_auto_honors_kt_mesh_devices(monkeypatch):
+    """The KT_MESH_DEVICES=N escape hatch (this test process sees 8
+    forced CPU devices): auto consults env_mesh() when no mesh was
+    passed, so operators can engage the wave path before ROADMAP item
+    2 threads a session mesh through the daemons. Unset, =1 (explicit
+    no-mesh), and garbage values all fall back to the unsharded scan
+    instead of crashing the scheduler."""
+    from kubernetes_tpu.scheduler import batch
+
+    monkeypatch.setenv("KT_MESH_DEVICES", "8")
+    assert batch.env_mesh() is not None
+    assert batch.resolve_batch_mode("auto") == "wave"
+    # Explicit modes are never second-guessed by the hatch.
+    for m in ("scan", "wave", "sinkhorn"):
+        assert batch.resolve_batch_mode(m) == m
+    # An explicit mesh argument wins regardless of the env.
+    assert batch.resolve_batch_mode("auto", mesh=object()) == "wave"
+
+    monkeypatch.delenv("KT_MESH_DEVICES")
+    assert batch.env_mesh() is None
+    assert batch.resolve_batch_mode("auto") == "scan"
+
+    for bad in ("1", "0", "not-a-number", "1000000"):
+        monkeypatch.setenv("KT_MESH_DEVICES", bad)
+        assert batch.env_mesh() is None, bad
+        assert batch.resolve_batch_mode("auto") == "scan"
+
+
 def test_batch_mode_auto_meshless_warns_once(caplog):
     """ADVICE r5: no shipped daemon threads a mesh, so auto always
     resolves to scan in production — resolve_batch_mode says so in the

@@ -451,7 +451,7 @@ class TestFramework:
         data = json.loads(proc.stdout)
         assert data["counts"]["KT004"] == 1
         assert data["findings"][0]["rule"] == "KT004"
-        assert set(data["rules"]) == {f"KT00{i}" for i in range(1, 9)}
+        assert set(data["rules"]) == {f"KT00{i}" for i in range(1, 10)}
 
 
 # -- KT008 fault-site constants ---------------------------------------

@@ -5,6 +5,9 @@ here exercises real jax.sharding.Mesh partitioning: the node axis of
 the solver state is sharded, XLA SPMD inserts the argmax reduce +
 all-gather collectives, and the assignment must BIT-MATCH the
 single-device solve (and the scalar oracle) on identical snapshots.
+Meshes come from the session `host_mesh` fixture — the sanctioned
+ops.matrices.host_mesh seam, the same one sessions and the
+KT_MESH_DEVICES hatch use.
 
 Reference seam being validated: the scheduler hot loop
 (plugin/pkg/scheduler/generic_scheduler.go:106-171) re-expressed as a
@@ -14,7 +17,6 @@ node-sharded scan — SURVEY.md §2.15 / §7 step 7.
 import jax
 import numpy as np
 import pytest
-from jax.sharding import Mesh
 
 from kubernetes_tpu.models.columnar import build_snapshot
 from kubernetes_tpu.ops import device_snapshot
@@ -24,14 +26,8 @@ from kubernetes_tpu.scheduler.batch import parity_report, schedule_backlog_scala
 from tests.test_solver_parity import random_cluster
 
 
-def _mesh(n):
-    devs = jax.devices()
-    assert len(devs) >= n, f"conftest should provide 8 devices, saw {len(devs)}"
-    return Mesh(np.array(devs[:n]), axis_names=("nodes",))
-
-
-def _solve_on_mesh(snap, n_devices):
-    mesh = _mesh(n_devices)
+def _solve_on_mesh(snap, mesh):
+    n_devices = mesh.devices.size
     dsnap = device_snapshot(snap, mesh=mesh, pad_to=max(8, n_devices))
     with mesh:
         return solve_assignments(dsnap)
@@ -42,24 +38,83 @@ class TestShardedBitParity:
 
     @pytest.mark.parametrize("n_devices", [2, 4, 8])
     @pytest.mark.parametrize("seed", range(4))
-    def test_mesh_matches_single_device(self, n_devices, seed):
+    def test_mesh_matches_single_device(self, n_devices, seed, host_mesh):
         pods, nodes, assigned, services = random_cluster(seed)
         snap = build_snapshot(pods, nodes, assigned_pods=assigned, services=services)
         single = solve_assignments(device_snapshot(snap))
-        sharded = _solve_on_mesh(snap, n_devices)
+        sharded = _solve_on_mesh(snap, host_mesh(n_devices))
         np.testing.assert_array_equal(single, sharded)
 
     @pytest.mark.parametrize("seed", range(4))
-    def test_mesh_matches_scalar_oracle(self, seed):
+    def test_mesh_matches_scalar_oracle(self, seed, host_mesh):
         """End-to-end: 8-way sharded solve vs the Go-semantics oracle."""
         pods, nodes, assigned, services = random_cluster(100 + seed)
         scalar = schedule_backlog_scalar(pods, nodes, assigned, services)
         snap = build_snapshot(pods, nodes, assigned_pods=assigned, services=services)
-        assignment = _solve_on_mesh(snap, 8)
+        assignment = _solve_on_mesh(snap, host_mesh(8))
         node_names = [n.metadata.name for n in nodes]
         batch = [node_names[a] if a >= 0 else None for a in assignment]
         parity, mismatches = parity_report(scalar, batch)
         assert parity == 1.0, f"mismatches: {mismatches[:5]}"
+
+
+@pytest.mark.ktmesh
+class TestRuntimeStaticCrossCheck:
+    """The executed module's collective inventory must equal ktmesh's
+    static prediction for the same kernel at the same bucket — the
+    bridge between `--mesh-analysis` (compile-only, abstract avals) and
+    what a real sharded solve actually runs. If GSPMD partitions real
+    staged arrays differently from the contract-sharded avals, the
+    static budgets are fiction; this test is what makes them evidence.
+    """
+
+    def test_solver_inventory_matches_static_prediction(self, host_mesh):
+        from kubernetes_tpu.ops import contracts as C
+        from tools.ktlint import ktmesh
+
+        mesh = host_mesh(8)
+        pods, nodes, _assigned, _services = random_cluster(7)
+        snap = build_snapshot(pods, nodes)
+        dsnap = device_snapshot(snap, mesh=mesh)
+
+        # AOT-lower the REAL staged (sharded) arrays, execute the very
+        # module whose text we inventory, and sanity-check its output
+        # against the dispatch-path solve.
+        kern = C.resolve_kernel("solver._solve_xla")
+        with mesh:
+            compiled = kern.lower(
+                dsnap.pods, dsnap.nodes, dsnap.weights, dsnap.lowered
+            ).compile()
+            out = compiled(dsnap.pods, dsnap.nodes)
+            out.block_until_ready()
+            reference = solve_assignments(dsnap)
+        np.testing.assert_array_equal(
+            np.asarray(out)[: dsnap.n_pods], reference
+        )
+        observed = C.collective_inventory(compiled.as_text())
+
+        # ktmesh's prediction at the bucket we ACTUALLY executed:
+        # bindings read off the staged shapes, not the probe defaults.
+        bindings = {
+            "P": dsnap.pods["cpu"].shape[0],
+            "N": dsnap.nodes["cpu_cap"].shape[0],
+            "LW": dsnap.pods["sel"].shape[1],
+            "PW": dsnap.pods["port"].shape[1],
+            "VW": dsnap.pods["vol_any"].shape[1],
+            "K": dsnap.pods["svc_ids"].shape[1],
+            "S": dsnap.nodes["svc_counts"].shape[1],
+        }
+        predicted = ktmesh.static_inventory(
+            "solver._solve_xla", mesh, bindings
+        )
+        assert observed["counts"] == predicted["counts"], (
+            f"runtime inventory {observed['counts']} != static "
+            f"prediction {predicted['counts']} at {bindings}"
+        )
+        assert observed["bytes"] == predicted["bytes"]
+        # A node-sharded scan is not collective-free: the cross-check
+        # must be comparing real communication, not two empty dicts.
+        assert observed["total"] > 0
 
 
 class TestDryrunEntrypoints:
@@ -114,16 +169,18 @@ class TestShardedParityAtScale:
             ]
         return build_snapshot(pods, nodes, services=services)
 
-    def test_scan_bit_parity_at_scale(self, big_snap):
+    def test_scan_bit_parity_at_scale(self, big_snap, host_mesh):
         single = solve_assignments(device_snapshot(big_snap))
-        sharded = _solve_on_mesh(big_snap, 8)
+        sharded = _solve_on_mesh(big_snap, host_mesh(8))
         np.testing.assert_array_equal(single, sharded)
         assert int((single >= 0).sum()) == self.N_PODS
 
-    def test_wave_deterministic_and_matches_single_at_scale(self, big_snap):
+    def test_wave_deterministic_and_matches_single_at_scale(
+        self, big_snap, host_mesh
+    ):
         from kubernetes_tpu.ops.wave import solve_waves
 
-        mesh = _mesh(8)
+        mesh = host_mesh(8)
         dsnap = device_snapshot(big_snap, mesh=mesh, pad_to=8)
         with mesh:
             out1, w1 = solve_waves(dsnap.pods, dsnap.nodes)
@@ -138,7 +195,9 @@ class TestShardedParityAtScale:
         a1 = np.where(a1 >= dsnap.n_nodes, -1, a1)
         np.testing.assert_array_equal(single, a1)
 
-    def test_sinkhorn_deterministic_and_matches_single_at_scale(self, big_snap):
+    def test_sinkhorn_deterministic_and_matches_single_at_scale(
+        self, big_snap, host_mesh
+    ):
         """Sinkhorn at the same realistic sharded shape as scan/wave
         (closing the last toy-shape-only mode): deterministic across
         runs and identical to the single-device solve."""
@@ -147,7 +206,7 @@ class TestShardedParityAtScale:
             solve_sinkhorn,
         )
 
-        mesh = _mesh(8)
+        mesh = host_mesh(8)
         dsnap = device_snapshot(big_snap, mesh=mesh, pad_to=8)
         with mesh:
             out1, _ = solve_sinkhorn(dsnap.pods, dsnap.nodes)
@@ -182,10 +241,10 @@ class TestShardedNorthStar:
         )
         return build_snapshot(pods, nodes, services=services)
 
-    def test_wave_matches_single_device(self, star_snap):
+    def test_wave_matches_single_device(self, star_snap, host_mesh):
         from kubernetes_tpu.ops.wave import solve_waves, wave_assignments
 
-        mesh = _mesh(8)
+        mesh = host_mesh(8)
         dsnap = device_snapshot(star_snap, mesh=mesh, pad_to=8)
         with mesh:
             out, _waves = solve_waves(dsnap.pods, dsnap.nodes)
@@ -196,13 +255,13 @@ class TestShardedNorthStar:
         np.testing.assert_array_equal(single, sharded)
         assert int((sharded >= 0).sum()) == self.N_PODS
 
-    def test_sinkhorn_matches_single_device(self, star_snap):
+    def test_sinkhorn_matches_single_device(self, star_snap, host_mesh):
         from kubernetes_tpu.ops.sinkhorn import (
             sinkhorn_assignments,
             solve_sinkhorn,
         )
 
-        mesh = _mesh(8)
+        mesh = host_mesh(8)
         dsnap = device_snapshot(star_snap, mesh=mesh, pad_to=8)
         with mesh:
             out, _waves = solve_sinkhorn(dsnap.pods, dsnap.nodes)

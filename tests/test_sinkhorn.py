@@ -9,9 +9,6 @@ non-negotiable and checked with the same replay as the wave tests."""
 import numpy as np
 import pytest
 
-import jax
-from jax.sharding import Mesh
-
 from kubernetes_tpu.models.columnar import build_snapshot
 from kubernetes_tpu.ops import device_snapshot
 from kubernetes_tpu.ops.sinkhorn import sinkhorn_assignments, solve_sinkhorn
@@ -108,14 +105,13 @@ class TestCongestionPricing:
 
 
 class TestSinkhornOnMesh:
-    def test_sharded_matches_single_device(self):
+    def test_sharded_matches_single_device(self, host_mesh):
         pods, nodes, assigned, services = random_cluster(5)
         snap = build_snapshot(pods, nodes, assigned, services)
         single = device_snapshot(snap)
         base, _ = sinkhorn_assignments(single, window=16)
 
-        devices = np.array(jax.devices()[:8])
-        mesh = Mesh(devices, axis_names=("nodes",))
+        mesh = host_mesh(8)
         sharded = device_snapshot(snap, mesh=mesh, pad_to=8)
         with mesh:
             out, _ = solve_sinkhorn(sharded.pods, sharded.nodes, window=16)

@@ -72,6 +72,28 @@ if [ $rc -ne 0 ]; then
     echo "ktshape FAILED (every ORACLE_TWINS kernel contracted + zero shape/dtype/sharding findings is the gate)"
     exit $rc
 fi
+echo "== ktmesh SPMD partitioning (partitioned lowering, no execution) =="
+JAX_PLATFORMS=cpu python -m tools.ktlint --mesh-analysis --format=json \
+    > /tmp/ktmesh.json
+rc=$?
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/ktmesh.json"))
+print(
+    f"ktmesh: {d['kernels_checked']} kernel(s) on {d['devices']} "
+    f"device(s), {d['collectives_total']} collective(s) "
+    f"({d['collective_bytes_total']} bytes), {d['skipped']} skipped, "
+    f"{len(d['findings'])} finding(s)"
+)
+for f in d["findings"]:
+    print(f"  {f['kernel']}: [{f['check']}] {f['message']}")
+for err in d["errors"]:
+    print(f"  ERROR {err}")
+EOF
+if [ $rc -ne 0 ]; then
+    echo "ktmesh FAILED (every kernel within its declared communication budget — re-pin ops/contracts.py deliberately or fix the sharding)"
+    exit $rc
+fi
 if [ "$1" = "--lint-only" ]; then
     exit 0
 fi

@@ -20,6 +20,9 @@ KT007    kernel recompilation hazards: host round-trips in trace-time
 KT008    fault-injection sites are registered named constants
          (utils/faults.py inventory); no string literals at
          fire()/inject(), no site minting outside the registry
+KT009    mesh hygiene in ops/: device_put carries an explicit
+         sharding, no jax.devices() indexing/slicing, no pmap, mesh
+         construction only via the matrices seam
 =======  ==============================================================
 
 The interprocedural lock analysis (lock-order cycles KTSAN01, the
@@ -31,6 +34,12 @@ The kernel shape/dtype/sharding contract checker (abstract
 interpretation of jaxprs against ops/contracts.py, zero kernel
 executions) lives in tools/ktlint/ktshape.py and runs via ``python -m
 tools.ktlint --kernel-contracts`` — see that module's docstring.
+
+The static SPMD partitioning analyzer (partitioned lowering of every
+kernel under a forced multi-device CPU mesh, collective inventories
+verified against the declared communication budgets) lives in
+tools/ktlint/ktmesh.py and runs via ``python -m tools.ktlint
+--mesh-analysis [--devices N]`` — see that module's docstring.
 
 Suppress one finding with ``# ktlint: disable=KT00N`` (on the line or
 the line above); grandfather a backlog with the baseline file
@@ -59,6 +68,7 @@ from tools.ktlint.rules_metrics import MetricNamingRule
 from tools.ktlint.rules_parity import OracleTwinRule
 from tools.ktlint.rules_shape import ShapeHazardRule
 from tools.ktlint.rules_faults import FaultSiteRule
+from tools.ktlint.rules_mesh import MeshHygieneRule
 from tools.ktlint.lockgraph import (  # noqa: F401  (public API)
     LockGraphReport,
     analyze as lock_graph,
@@ -74,6 +84,7 @@ ALL_RULES = (
     OracleTwinRule(),
     ShapeHazardRule(),
     FaultSiteRule(),
+    MeshHygieneRule(),
 )
 
 

@@ -59,7 +59,50 @@ def main(argv=None) -> int:
         "every ORACLE_TWINS kernel against ops/contracts.py (zero "
         "kernel executions; forces JAX_PLATFORMS=cpu when unset)",
     )
+    ap.add_argument(
+        "--mesh-analysis", action="store_true",
+        help="run the static SPMD partitioning analyzer instead of "
+        "the per-file rules: partitioned-lower every ORACLE_TWINS "
+        "kernel under a forced multi-device CPU mesh and verify its "
+        "collective inventory against the declared communication "
+        "budget (compile only, zero kernel executions; <2 visible "
+        "devices degrades to 'skipped' + exit 0)",
+    )
+    ap.add_argument(
+        "--devices", type=int, default=8,
+        help="with --mesh-analysis: host-platform device count to "
+        "force (and mesh size); only binds if jax's CPU backend has "
+        "not initialized yet (default: 8)",
+    )
     args = ap.parse_args(argv)
+
+    if args.mesh_analysis:
+        from tools.ktlint import ktmesh
+
+        if args.paths:
+            # Same contract as --kernel-contracts: positional args are
+            # kernel-registry keys, and an unknown one must error, not
+            # silently shrink the gate to zero kernels.
+            from kubernetes_tpu.ops.contracts import CONTRACTS
+            from kubernetes_tpu.ops.parity import ORACLE_TWINS
+
+            known = set(CONTRACTS) | set(ORACLE_TWINS)
+            unknown = [p for p in args.paths if p not in known]
+            if unknown:
+                print(
+                    "--mesh-analysis takes ORACLE_TWINS kernel keys "
+                    f"(e.g. 'solver.explain_rows'), not paths: {unknown}",
+                    file=sys.stderr,
+                )
+                return 2
+        report = ktmesh.analyze(
+            devices=args.devices, kernels=args.paths or None
+        )
+        if args.format == "json":
+            print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+        else:
+            print(report.render(), file=sys.stderr)
+        return report.exit_code
 
     if args.kernel_contracts:
         from tools.ktlint import ktshape

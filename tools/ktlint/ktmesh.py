@@ -1,0 +1,380 @@
+"""ktmesh — the static SPMD partitioning analyzer.
+
+``python -m tools.ktlint --mesh-analysis [--devices N]`` verifies every
+kernel in the KT006 ORACLE_TWINS registry against its declared
+:class:`~kubernetes_tpu.ops.contracts.MeshSharding` leaf WITHOUT
+executing anything: each kernel is partitioned-LOWERED (compile only —
+``TracedJit.lower(...).compile()`` on avals, never called) under a
+forced multi-device CPU mesh (``XLA_FLAGS=
+--xla_force_host_platform_device_count=N``, no TPU needed), and the
+compiled/partitioned module's text is walked for the **collective
+inventory** GSPMD inserted — all-gather / all-reduce / reduce-scatter /
+collective-permute / all-to-all op counts and byte volumes.
+
+Verified, per kernel:
+
+- **completeness** — every ORACLE_TWINS kernel carries a contract AND
+  a sharding leaf (both ways, like every other contract field), the
+  leaf's sharded dim appears in the argument schema, and its axis is a
+  real mesh axis (``pods``/``nodes``).
+- **communication budget** — the inventory must match the declared
+  :class:`~kubernetes_tpu.ops.contracts.CommBudget` EXACTLY at the
+  pinned probe point: a phantom collective is a sharding regression
+  (the classic silent-scaling-loss bug, cf. the GSPMD/Megatron
+  communication analyses in PAPERS.md); a vanished one is a stale
+  budget. ``explain_rows`` must lower collective-FREE under pod-axis
+  sharding — the go-case ROADMAP item 1 rests on.
+- **no pod-axis full-gather** — no all-gather may materialize the full
+  pod axis (gathered dim size == the pod dim's probe size). Probe dim
+  sizes are all DISTINCT (contracts._distinct_bindings) precisely so
+  this size test cannot alias another axis.
+- **ktshape coupling cross-check** — a kernel ktshape classifies
+  ``shardable`` that is sharded over its pod dim yet emits ANY
+  collective is a finding (the embarrassingly-parallel claim broke);
+  a ``reduces`` kernel whose sharding leaf shards a real dim yet
+  lowers collective-free is one too (the declaration or the leaf is
+  stale). Kernels whose leaf declares full replication (dim=None:
+  pallas/preemption/scatter) are exempt — an empty inventory is their
+  contract, not a contradiction.
+
+Off-mesh degradation: with fewer than two visible devices every kernel
+reports ``skipped`` and the analyzer exits 0 — a laptop without the
+forced host platform must not fail CI, it just cannot add evidence.
+
+Runs under ``JAX_PLATFORMS=cpu`` (forced when unset) and sets the
+host-platform device-count flag BEFORE jax's CPU backend initializes —
+which happens at first use, so setting it at analyze() start works
+even when jax is already imported but idle.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+#: Mesh axis names a sharding leaf may declare — the two axes of the
+#: paper's dense pod x node formulation.
+MESH_AXES = ("pods", "nodes")
+
+
+@dataclass
+class MeshFinding:
+    kernel: str
+    check: str  # completeness | budget | pod-gather | coupling-xcheck | error
+    message: str
+
+    def render(self) -> str:
+        return f"{self.kernel}: [{self.check}] {self.message}"
+
+
+@dataclass
+class MeshReport:
+    devices: int = 0
+    findings: List[MeshFinding] = field(default_factory=list)
+    kernels: List[dict] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.errors) else 0
+
+    @property
+    def collectives_total(self) -> int:
+        return sum(k.get("collectives_total", 0) for k in self.kernels)
+
+    @property
+    def collective_bytes_total(self) -> int:
+        return sum(k.get("collective_bytes", 0) for k in self.kernels)
+
+    def to_json(self) -> dict:
+        return {
+            "devices": self.devices,
+            "kernels_checked": len(self.kernels),
+            "kernels": self.kernels,
+            "collectives_total": self.collectives_total,
+            "collective_bytes_total": self.collective_bytes_total,
+            "skipped": sum(
+                1 for k in self.kernels if k["status"] == "skipped"
+            ),
+            "findings": [
+                {"kernel": f.kernel, "check": f.check, "message": f.message}
+                for f in self.findings
+            ],
+            "errors": self.errors,
+        }
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines += [f"ERROR {e}" for e in self.errors]
+        skipped = sum(1 for k in self.kernels if k["status"] == "skipped")
+        lines.append(
+            f"ktmesh: {len(self.kernels)} kernel(s) on {self.devices} "
+            f"device(s), {self.collectives_total} collective(s) "
+            f"({self.collective_bytes_total} bytes), "
+            f"{skipped} skipped, {len(self.findings)} finding(s)"
+        )
+        return "\n".join(lines)
+
+
+# -- per-kernel probe ---------------------------------------------------
+
+
+def _build_mesh(n: int, axis: str):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), axis_names=(axis,))
+
+
+def static_inventory(
+    name: str, mesh, bindings: Optional[Dict[str, int]] = None
+) -> Dict[str, object]:
+    """ktmesh's static prediction for ONE kernel on `mesh`: partitioned
+    lowering at `bindings` (default: the distinct-dims probe point)
+    under the contract's sharding leaf, collective inventory of the
+    compiled module. The runtime<->static cross-check in
+    tests/test_multichip.py calls this with the bucket it actually
+    executed."""
+    from kubernetes_tpu.ops import contracts as C
+
+    contract = C.CONTRACTS[name]
+    bindings = dict(bindings or C._distinct_bindings(contract))
+    args, kwargs = C.sharded_abstract_args(contract, bindings, mesh)
+    kern = C.resolve_kernel(name)
+    compiled = kern.lower(*args, **kwargs).compile()
+    return C.collective_inventory(compiled.as_text())
+
+
+def check_kernel(
+    name: str, contract, n_devices: int, meta: Optional[dict] = None
+) -> List[MeshFinding]:
+    """Partitioned-lower ONE kernel and verify its inventory against
+    the declared budget — the unit the drift-injection tests drive
+    with doctored contracts. `meta` (the summary row) receives the
+    observed counts/bytes and the status."""
+    from kubernetes_tpu.ops import contracts as C
+
+    out: List[MeshFinding] = []
+    sh = contract.sharding
+    meta = meta if meta is not None else {}
+
+    if sh is None:
+        meta["status"] = "error"
+        return [
+            MeshFinding(
+                name, "completeness",
+                "contract has no sharding leaf — every registered "
+                "kernel declares its mesh partitioning + communication "
+                "budget (ops/contracts.py MeshSharding)",
+            )
+        ]
+    if sh.axis not in MESH_AXES:
+        meta["status"] = "error"
+        return [
+            MeshFinding(
+                name, "completeness",
+                f"sharding axis {sh.axis!r} is not one of {MESH_AXES}",
+            )
+        ]
+    arg_dims = {
+        d
+        for _, spec in C.declared_array_leaves(contract)
+        for d in spec.dims
+    }
+    if sh.dim is not None and sh.dim not in arg_dims:
+        meta["status"] = "error"
+        return [
+            MeshFinding(
+                name, "completeness",
+                f"sharded dim {sh.dim!r} appears in no argument leaf — "
+                "the partitioning declaration is unverifiable",
+            )
+        ]
+
+    bindings = C._distinct_bindings(contract)
+    if sh.dim is not None and bindings[sh.dim] % n_devices != 0:
+        meta["status"] = "skipped"
+        meta["skip_reason"] = (
+            f"probe size {sh.dim}={bindings[sh.dim]} not divisible "
+            f"by {n_devices} devices"
+        )
+        return out
+
+    t0 = time.perf_counter()
+    try:
+        mesh = _build_mesh(n_devices, sh.axis)
+        args, kwargs = C.sharded_abstract_args(contract, bindings, mesh)
+        kern = C.resolve_kernel(name)
+        compiled = kern.lower(*args, **kwargs).compile()
+        inventory = C.collective_inventory(compiled.as_text())
+    except Exception as e:
+        meta["status"] = "error"
+        out.append(
+            MeshFinding(
+                name, "error",
+                f"partitioned lowering failed at {bindings}: {e!r}",
+            )
+        )
+        return out
+    meta.update(
+        status="ok",
+        collectives=inventory["counts"],
+        collectives_total=inventory["total"],
+        collective_bytes=sum(inventory["bytes"].values()),
+        seconds=round(time.perf_counter() - t0, 3),
+    )
+
+    declared = sh.budget.as_dict()
+    if inventory["counts"] != declared:
+        out.append(
+            MeshFinding(
+                name, "budget",
+                f"collective inventory {inventory['counts'] or '{}'} "
+                f"!= declared budget {declared or '{}'} — a phantom "
+                "collective is a sharding regression, a vanished one "
+                "a stale CommBudget; re-pin deliberately or fix the "
+                "kernel",
+            )
+        )
+
+    pod_size = bindings.get(contract.pod_dim) if contract.pod_dim else None
+    if pod_size is not None:
+        for op in inventory["ops"]:
+            gdim = op.get("gather_dim")
+            if (
+                op["kind"] == "all-gather"
+                and gdim is not None
+                and gdim < len(op["shape"])
+                and op["shape"][gdim] == pod_size
+            ):
+                out.append(
+                    MeshFinding(
+                        name, "pod-gather",
+                        f"all-gather materializes the FULL pod axis "
+                        f"({op['dtype']}{op['shape']}, gathered dim "
+                        f"{gdim} == {contract.pod_dim}={pod_size}) — "
+                        "the classic way a sharded solver silently "
+                        "loses all scaling",
+                    )
+                )
+
+    if (
+        contract.pod_axis == "shardable"
+        and sh.dim == contract.pod_dim
+        and inventory["total"] > 0
+    ):
+        out.append(
+            MeshFinding(
+                name, "coupling-xcheck",
+                f"ktshape classifies this kernel 'shardable' yet its "
+                f"pod-sharded lowering emits {inventory['counts']} — "
+                "pods are NOT independent under a Mesh; one of the two "
+                "analyses is wrong",
+            )
+        )
+    if (
+        contract.pod_axis == "reduces"
+        and sh.dim is not None
+        and inventory["total"] == 0
+    ):
+        out.append(
+            MeshFinding(
+                name, "coupling-xcheck",
+                "ktshape classifies this kernel 'reduces' yet its "
+                "sharded lowering is collective-free — either the "
+                "sharding leaf replicates the coupled axis away or the "
+                "coupling class is stale",
+            )
+        )
+    return out
+
+
+def _kernel_row(name: str, contract) -> dict:
+    sh = contract.sharding
+    return {
+        "kernel": name,
+        "pod_axis": contract.pod_axis,
+        "sharded_dim": sh.dim if sh else None,
+        "mesh_axis": sh.axis if sh else None,
+        "budget": sh.budget.as_dict() if sh else None,
+        "status": "pending",
+        "collectives": {},
+        "collectives_total": 0,
+        "collective_bytes": 0,
+    }
+
+
+# -- the full pass ------------------------------------------------------
+
+
+def analyze(
+    devices: int = 8, kernels: Optional[Sequence[str]] = None
+) -> MeshReport:
+    """Run the full mesh analysis over the registry (or a named
+    subset). Forces JAX_PLATFORMS=cpu and the host-platform device
+    count when the caller hasn't chosen — the flag only binds if the
+    CPU backend hasn't initialized yet, so an already-warm jax keeps
+    whatever topology it has (the in-process test gate runs on
+    conftest's forced 8 devices)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    report = MeshReport()
+    try:
+        from kubernetes_tpu.ops import contracts as C
+    except Exception as e:  # pragma: no cover - broken tree
+        report.errors.append(f"cannot import ops/contracts.py: {e!r}")
+        return report
+
+    registry = set(C.registry_keys())
+    contracted = set(C.CONTRACTS)
+    for missing in sorted(registry - contracted):
+        report.findings.append(
+            MeshFinding(
+                missing, "completeness",
+                "registered in ORACLE_TWINS but has no contract (and "
+                "so no sharding leaf) in ops/contracts.py",
+            )
+        )
+    for stale in sorted(contracted - registry):
+        report.findings.append(
+            MeshFinding(
+                stale, "completeness",
+                "contracted in ops/contracts.py but not registered in "
+                "ORACLE_TWINS (stale after a rename/removal?)",
+            )
+        )
+
+    try:
+        import jax
+
+        n_avail = len(jax.devices())
+    except Exception as e:  # pragma: no cover - no jax at all
+        report.errors.append(f"cannot initialize jax: {e!r}")
+        return report
+    n = min(devices, n_avail)
+    report.devices = n
+
+    todo = sorted(contracted & registry)
+    if kernels is not None:
+        todo = [k for k in todo if k in set(kernels)]
+    for name in todo:
+        contract = C.CONTRACTS[name]
+        row = _kernel_row(name, contract)
+        if n < 2:
+            row["status"] = "skipped"
+            row["skip_reason"] = (
+                f"{n} visible device(s) — a mesh needs >= 2 (run under "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            )
+            report.kernels.append(row)
+            continue
+        report.findings.extend(check_kernel(name, contract, n, meta=row))
+        report.kernels.append(row)
+    return report

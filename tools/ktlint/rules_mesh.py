@@ -1,0 +1,123 @@
+"""KT009: mesh hygiene in ops/ — the AST half of the ktmesh pass.
+
+The kernel layer is becoming mesh-capable (ROADMAP item 1): staged
+arrays carry NamedShardings, the node axis shards, and the ktmesh
+budgets pin what communication each kernel may emit. Four idioms
+silently break that world and are cheap to catch statically:
+
+- ``jax.device_put(x)`` with no explicit sharding/device — the array
+  lands wherever jax defaults (device 0), so a sharded pipeline
+  quietly concentrates its inputs on one chip. Every staging put names
+  its placement.
+- **indexing or slicing ``jax.devices()`` / ``jax.local_devices()``**
+  (``jax.devices()[0]``, ``jax.devices()[:8]``) — hard-codes a device
+  count or pins work to chip 0; topology belongs to the Mesh, and the
+  ONE sanctioned default-device seam is ``matrices.shardings_for``
+  (pragma'd at its definition).
+- ``jax.pmap`` — the legacy per-device-replica path; this codebase
+  partitions with ``jit`` + ``NamedSharding`` (GSPMD), and mixing the
+  two models corrupts the ktmesh budget story (pmap collectives never
+  appear in a jit lowering's inventory).
+- **Mesh construction outside the sanctioned seam** — ``Mesh(...)`` /
+  ``jax.sharding.Mesh(...)`` anywhere in ops/ except
+  ``ops/matrices.py`` (``host_mesh``/``shardings_for``, the seams the
+  session and the ``KT_MESH_DEVICES`` escape hatch route through). Ad
+  hoc meshes fragment the one-topology invariant the budgets assume.
+
+Scope: ``ops`` modules only (the mesh-capable layer) — the control
+plane never imports jax, and tests/tools legitimately build probe
+meshes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ktlint.framework import FileContext, Finding, Rule, attr_chain
+
+#: The one ops/ file allowed to construct meshes: the staging layer's
+#: sanctioned seam (shardings_for / host_mesh).
+_MESH_SEAM = "matrices.py"
+
+_DEVICE_LISTS = {
+    ("jax", "devices"),
+    ("jax", "local_devices"),
+}
+
+
+class MeshHygieneRule(Rule):
+    id = "KT009"
+    title = (
+        "mesh hygiene in ops/: explicit shardings on device_put, no "
+        "jax.devices() indexing, no pmap, mesh construction only via "
+        "the matrices seam"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return "ops" in ctx.path.parts
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        in_seam = ctx.path.name == _MESH_SEAM
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                chain = tuple(attr_chain(node.func))
+                if chain == ("jax", "device_put"):
+                    if len(node.args) < 2 and not any(
+                        kw.arg in ("device", "sharding")
+                        for kw in node.keywords
+                    ):
+                        findings.append(
+                            ctx.finding(
+                                self.id, node,
+                                "jax.device_put without an explicit "
+                                "sharding/device — in a mesh-capable "
+                                "module the array silently lands on "
+                                "device 0; pass the staging sharding "
+                                "(matrices.shardings_for)",
+                            )
+                        )
+                elif chain and not in_seam and (
+                    chain == ("Mesh",)
+                    or chain[-2:] == ("sharding", "Mesh")
+                    or chain == ("jax", "Mesh")
+                ):
+                    findings.append(
+                        ctx.finding(
+                            self.id, node,
+                            "Mesh construction outside the sanctioned "
+                            "seam — ops/ builds meshes only through "
+                            "matrices.host_mesh / matrices."
+                            "shardings_for so the whole kernel layer "
+                            "shares one topology",
+                        )
+                    )
+            elif isinstance(node, ast.Subscript):
+                inner = node.value
+                if isinstance(inner, ast.Call):
+                    chain = tuple(attr_chain(inner.func))
+                    if chain in _DEVICE_LISTS:
+                        findings.append(
+                            ctx.finding(
+                                self.id, node,
+                                f"indexing/slicing {'.'.join(chain)}() "
+                                "hard-codes device topology — chip "
+                                "counts and default devices belong to "
+                                "the Mesh (matrices.host_mesh) or the "
+                                "shardings_for seam",
+                            )
+                        )
+            elif isinstance(node, ast.Attribute):
+                chain = tuple(attr_chain(node))
+                if chain == ("jax", "pmap"):
+                    findings.append(
+                        ctx.finding(
+                            self.id, node,
+                            "jax.pmap is the legacy replica path — "
+                            "this codebase partitions with jit + "
+                            "NamedSharding (GSPMD); pmap collectives "
+                            "are invisible to the ktmesh budgets",
+                        )
+                    )
+        return findings
