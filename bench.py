@@ -898,6 +898,86 @@ def _soak_figure(n_nodes: int = 64, seed: int = 7) -> dict:
     }
 
 
+def _failover_figure(n_nodes: int = 8, rounds: int = 5) -> dict:
+    """ISSUE 19: the failover drill behind failover_to_first_bind_s —
+    with a pod already trickled in, kill the active scheduler abruptly,
+    activate the PREWARMED standby (informers hot, SolverSession
+    built), and clock kill -> that pod's bind becoming visible.
+    p50/p99 over `rounds` drills; the 1 s p99 gate is the warm-standby
+    budget. Lease-expiry wait is deliberately excluded here (it is a
+    configured duration, not a performance property — check.sh's
+    failover smoke and tier-1 cover the e2e lease path)."""
+    from kubernetes_tpu.client import Client, LocalTransport
+    from kubernetes_tpu.scheduler.standby import WarmStandbyScheduler
+    from kubernetes_tpu.server.api import APIServer
+    from kubernetes_tpu.utils import slo as _slo
+
+    api = APIServer()
+
+    def client():
+        return Client(LocalTransport(api))
+
+    c = client()
+    for j in range(n_nodes):
+        c.create("nodes", _churn_node_wire(j))
+    samples = []
+    active = WarmStandbyScheduler(client(), sync_timeout=120.0)
+    active.activate()
+    try:
+        # Warm the solve path first (bucket compile) — the drill
+        # measures failover on a fleet that has served traffic, which
+        # is the only fleet a failover can happen on.
+        c.create("pods", _churn_pod_wire("failover-warmup"))
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if c.get("pods", "failover-warmup", namespace="default"
+                     ).spec.node_name:
+                break
+            time.sleep(0.005)
+        else:
+            raise RuntimeError("failover warmup pod never bound")
+        for r in range(rounds):
+            # Prewarm the successor BEFORE the crash — the HA deploy
+            # shape (HAScheduler keeps exactly one warm non-leader).
+            standby = WarmStandbyScheduler(client(), sync_timeout=120.0)
+            standby.prewarm()
+            active.kill()
+            t0 = time.monotonic()
+            name = f"failover-r{r}"
+            c.create("pods", _churn_pod_wire(name))
+            standby.activate()
+            deadline = t0 + 60.0
+            while time.monotonic() < deadline:
+                pod = c.get("pods", name, namespace="default")
+                if pod.spec.node_name:
+                    break
+                time.sleep(0.002)
+            else:
+                raise RuntimeError(f"failover round {r}: pod never bound")
+            samples.append(time.monotonic() - t0)
+            active = standby
+    finally:
+        active.stop()
+    samples.sort()
+    p50 = samples[len(samples) // 2]
+    p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+    obj = _slo.BENCH_OBJECTIVES["failover_to_first_bind_s"]
+    print(
+        f"# failover: {rounds} scheduler-leader kills at {n_nodes} nodes "
+        f"— kill-to-first-bind p50 {p50 * 1000:.0f}ms, "
+        f"p99 {p99 * 1000:.0f}ms (gate {obj.target:.1f}s)",
+        file=sys.stderr,
+    )
+    return {
+        "failover_rounds": rounds,
+        "failover_nodes": n_nodes,
+        "failover_to_first_bind_p50_s": round(p50, 4),
+        "failover_to_first_bind_p99_s": round(p99, 4),
+        "failover_slo_target_s": obj.target,
+        "failover_slo": _slo.verdict_for_value(obj, p99),
+    }
+
+
 def _microtick_profile_figure(n_pods: int = 24) -> dict:
     """ISSUE 13: duty-cycle / overlap-efficiency figures from a LIVE
     micro-tick daemon (utils/profiler.py, fed by the pipelined
@@ -1823,6 +1903,12 @@ def main() -> None:
             record.update(_soak_figure())
         except Exception as e:
             record["soak_error"] = str(e)  # must never sink a bench run
+        # HA failover drill (ISSUE 19 acceptance: scheduler-leader
+        # kill -> warm standby's first bind under the 1 s p99 gate).
+        try:
+            record.update(_failover_figure())
+        except Exception as e:
+            record["failover_error"] = str(e)  # never sink a bench run
     # Preemption counters ride the record alongside the per-phase
     # latency fields (phase_p50_s/phase_p99_s already carry the
     # "preempt" phase when it ran): solve outcomes by kind + victims

@@ -433,6 +433,15 @@ class Reflector:
         # relist — the scheduler daemons' informer-staleness SLI
         # (utils/sli.INFORMER_STALENESS) reads it per solve tick.
         self.last_event_mono = 0.0
+        # Watch-resume flag: set once a cycle reaches its watch phase,
+        # cleared at each cycle start. When a cycle dies IN the watch
+        # (endpoint rotated away, connection reset), the next cycle
+        # skips the full re-LIST and resumes the watch from
+        # last_sync_version — the new apiserver's watch cache usually
+        # still covers it; 410 (compacted/too-old) falls back to LIST.
+        self._resume_watch = False
+        # Full LISTs issued (the resume regression test's observable).
+        self.list_count = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._synced = threading.Event()
@@ -490,6 +499,61 @@ class Reflector:
         """One LIST + watch cycle. Returns False only when the watch
         was abandoned after consecutive EMPTY closes (no event ever
         delivered) — _run then backs off before the next re-list."""
+        resume = self._resume_watch and self.last_sync_version > 0
+        self._resume_watch = False
+        if not resume:
+            self._list()
+
+        # Consecutive watch closes that delivered NOTHING: the server
+        # (or the store's slow-consumer guard, or an injected fault
+        # storm) is shedding this watcher. Re-dialing instantly would
+        # tight-loop list/watch against a struggling control plane —
+        # back off between re-dials and, past the threshold, fall back
+        # to a full re-list (return; _run owns that cadence).
+        idle_closes = 0
+        # From here on a transport failure (the apiserver died, the
+        # client rotated endpoints) resumes the WATCH next cycle
+        # instead of re-LISTing: the store is synced and
+        # last_sync_version tracks every delivered event, so the new
+        # replica's watch cache can usually serve the delta directly.
+        self._resume_watch = True
+        while not self._stop.is_set():
+            try:
+                stream = self.client.watch(
+                    self.resource,
+                    namespace=self.namespace,
+                    since=self.last_sync_version,
+                    label_selector=self.label_selector,
+                    field_selector=self.field_selector,
+                )
+            except APIError as e:
+                if e.code == 410:  # compacted/too-old: full re-list
+                    self._resume_watch = False
+                    return True
+                raise
+            self._stream = stream
+            try:
+                delivered = self._consume(stream)
+            finally:
+                self._stream = None
+                stream.close()
+            if self._stop.is_set():
+                return True
+            if delivered:
+                idle_closes = 0
+                continue
+            idle_closes += 1
+            if idle_closes >= self._RELIST_AFTER_IDLE_CLOSES:
+                # Deliberate fallback: the watch window may be
+                # unservable — the next cycle must LIST, not resume.
+                self._resume_watch = False
+                return False
+            self._stop.wait(min(0.05 * (2 ** idle_closes), 2.0))
+        return True
+
+    def _list(self) -> None:
+        """Full LIST + store replace + synthesized deltas (one half of
+        a list/watch cycle; resumed cycles skip it)."""
         # Typed clients return (items, version); raw ones a wire dict.
         items, version = self.client.list(
             self.resource,
@@ -497,6 +561,7 @@ class Reflector:
             label_selector=self.label_selector,
             field_selector=self.field_selector,
         )
+        self.list_count += 1
         objs = [self.decode(o) if isinstance(o, dict) else o for o in items]
         # Objects that vanished during a watch outage must surface as
         # DELETED on relist (DeltaFIFO.replace synthesizes Deleted the
@@ -520,43 +585,6 @@ class Reflector:
                 self.on_event(DELETED, o)
             for o in objs:
                 self.on_event(ADDED, o)
-
-        # Consecutive watch closes that delivered NOTHING: the server
-        # (or the store's slow-consumer guard, or an injected fault
-        # storm) is shedding this watcher. Re-dialing instantly would
-        # tight-loop list/watch against a struggling control plane —
-        # back off between re-dials and, past the threshold, fall back
-        # to a full re-list (return; _run owns that cadence).
-        idle_closes = 0
-        while not self._stop.is_set():
-            try:
-                stream = self.client.watch(
-                    self.resource,
-                    namespace=self.namespace,
-                    since=self.last_sync_version,
-                    label_selector=self.label_selector,
-                    field_selector=self.field_selector,
-                )
-            except APIError as e:
-                if e.code == 410:  # compacted: re-list
-                    return True
-                raise
-            self._stream = stream
-            try:
-                delivered = self._consume(stream)
-            finally:
-                self._stream = None
-                stream.close()
-            if self._stop.is_set():
-                return True
-            if delivered:
-                idle_closes = 0
-                continue
-            idle_closes += 1
-            if idle_closes >= self._RELIST_AFTER_IDLE_CLOSES:
-                return False  # re-list (the watch window may be unservable)
-            self._stop.wait(min(0.05 * (2 ** idle_closes), 2.0))
-        return True
 
     #: Empty watch closes tolerated before falling back to a re-list.
     _RELIST_AFTER_IDLE_CLOSES = 3

@@ -202,16 +202,40 @@ class _HTTPWatchStream:
 class HTTPTransport(Transport):
     def __init__(
         self,
-        base_url: str,
+        base_url,
         timeout: float = 30.0,
         headers: Optional[Dict[str, str]] = None,
         ssl_context=None,
         serialize: bool = False,
         max_retries: int = 3,
     ):
-        u = urlparse(base_url)
-        self.host = u.hostname or "127.0.0.1"
-        self.port = u.port or (443 if u.scheme == "https" else 80)
+        # base_url: one URL, or a list of them (the HA control plane's
+        # N stateless apiservers). Requests pin to one endpoint until
+        # it fails transiently — then _rotate() advances to the next
+        # replica INSIDE the existing retry loop, so a leader death or
+        # a replica restart costs one backoff, not an outage.
+        urls = [base_url] if isinstance(base_url, str) else list(base_url)
+        if not urls:
+            raise ValueError("HTTPTransport needs at least one endpoint")
+        scheme = ""
+        self.endpoints: List[Tuple[str, int]] = []
+        for raw in urls:
+            u = urlparse(raw)
+            scheme = scheme or u.scheme
+            self.endpoints.append(
+                (
+                    u.hostname or "127.0.0.1",
+                    u.port or (443 if u.scheme == "https" else 80),
+                )
+            )
+        self._ep_lock = threading.Lock()
+        self._ep_idx = 0
+        # Endpoint generation: bumped by _rotate(); pooled keep-alive
+        # connections stamp the generation they dialed under, so every
+        # thread (not just the one that saw the failure) re-dials the
+        # new endpoint on its next request instead of keeping a socket
+        # to the sick one.
+        self._ep_gen = 0
         self.timeout = timeout
         # Static per-request headers (kubeconfig bearer/basic auth).
         self.headers = dict(headers or {})
@@ -219,7 +243,7 @@ class HTTPTransport(Transport):
         # HTTPSConnection; pass a context carrying a client cert/key
         # for x509 authentication against the apiserver.
         self.ssl_context = ssl_context
-        if u.scheme == "https" and ssl_context is None:
+        if scheme == "https" and ssl_context is None:
             self.ssl_context = ssl.create_default_context()
         # Keep-alive: one persistent connection per thread. A fresh
         # TCP connection per request cost ~10x on CRUD throughput
@@ -233,7 +257,7 @@ class HTTPTransport(Transport):
         # connection PER THREAD, and at 100 daemons the apiserver's
         # thread-per-connection tier drowns in its own thread count.
         # Watches are unaffected (they always own a dedicated socket).
-        self._serial_lock = threading.Lock() if serialize else None
+        self._lock = threading.Lock() if serialize else None
         self._shared_conn = None
         # Transient-failure budget: connection errors / transient 5xx
         # on IDEMPOTENT verbs retry up to this many times with capped,
@@ -241,6 +265,24 @@ class HTTPTransport(Transport):
         # the historical fail-fast behavior. Distinct from the free
         # stale-keep-alive replay, which never counts.
         self.max_retries = max_retries
+
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._ep_idx][0]
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[self._ep_idx][1]
+
+    def _rotate(self) -> None:
+        """Advance to the next endpoint after a transient failure and
+        invalidate every pooled connection (generation bump). With one
+        endpoint this is just the pool discard the retry already did."""
+        with self._ep_lock:
+            if len(self.endpoints) > 1:
+                self._ep_idx = (self._ep_idx + 1) % len(self.endpoints)
+            self._ep_gen += 1
+        self._discard()
 
     def _connect(self, timeout=None) -> http.client.HTTPConnection:
         if self.ssl_context is not None:
@@ -263,21 +305,40 @@ class HTTPTransport(Transport):
         return conn
 
     def _pooled(self) -> tuple:
-        """(connection, reused) for this thread (or the shared one)."""
-        if self._serial_lock is not None:
-            if self._shared_conn is not None:
+        """(connection, reused) for this thread (or the shared one).
+        A pooled connection whose endpoint generation is stale (the
+        transport rotated since it dialed) is discarded and re-dialed
+        against the current endpoint."""
+        gen = self._ep_gen
+        if self._lock is not None:
+            if (
+                self._shared_conn is not None
+                and getattr(self, "_shared_gen", -1) == gen
+            ):
                 return self._shared_conn, True
+            if self._shared_conn is not None:
+                try:
+                    self._shared_conn.close()
+                except Exception:
+                    pass
             self._shared_conn = self._connect(timeout=self.timeout)
+            self._shared_gen = gen
             return self._shared_conn, False
         conn = getattr(self._local, "conn", None)
-        if conn is not None:
+        if conn is not None and getattr(self._local, "gen", -1) == gen:
             return conn, True
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
         conn = self._connect(timeout=self.timeout)
         self._local.conn = conn
+        self._local.gen = gen
         return conn, False
 
     def _discard(self) -> None:
-        if self._serial_lock is not None:
+        if self._lock is not None:
             conn, self._shared_conn = self._shared_conn, None
         else:
             conn = getattr(self._local, "conn", None)
@@ -330,8 +391,8 @@ class HTTPTransport(Transport):
         capped, jittered exponential backoff (_retry_backoff) before
         propagating; non-idempotent verbs still fail fast (a replayed
         POST could double-apply)."""
-        if self._serial_lock is not None:
-            with self._serial_lock:
+        if self._lock is not None:
+            with self._lock:
                 return self._do_locked(
                     verb, path, query, body, raw, content_type
                 )
@@ -390,6 +451,9 @@ class HTTPTransport(Transport):
                     and attempts < self.max_retries
                 ):
                     attempts += 1
+                    # This endpoint answered but is sick — try the
+                    # next replica (no-op rotation when there is one).
+                    self._rotate()
                     self._retry_backoff(attempts)
                     continue
                 raise
@@ -400,6 +464,7 @@ class HTTPTransport(Transport):
                 # raise it — the verb check below re-raises it.
                 if verb in _IDEMPOTENT_VERBS and attempts < self.max_retries:
                     attempts += 1
+                    self._rotate()
                     self._retry_backoff(attempts)
                     continue
                 raise
@@ -612,9 +677,21 @@ class HTTPTransport(Transport):
         # timeout once the stream is established: watch connections
         # are LONG-lived and legitimately silent for minutes, and a
         # read timeout mid-readline would tear down every idle watch.
-        conn = self._connect(timeout=self.timeout)
-        conn.request("GET", path, headers=self.headers)
-        resp = conn.getresponse()
+        # A dial/handshake failure rotates through the remaining
+        # endpoints once before propagating — the Reflector then
+        # resumes the watch on the replica it landed on.
+        last_exc = None
+        for _ in range(max(1, len(self.endpoints))):
+            try:
+                conn = self._connect(timeout=self.timeout)
+                conn.request("GET", path, headers=self.headers)
+                resp = conn.getresponse()
+                break
+            except _STALE_ERRORS as e:
+                last_exc = e
+                self._rotate()
+        else:
+            raise last_exc
         if resp.status >= 400:
             data = json.loads(resp.read() or b"{}")
             conn.close()

@@ -1,0 +1,263 @@
+"""Warm-standby scheduler: lease-gated failover without the cold start.
+
+A cold scheduler failover pays three latencies in series: the LIST+watch
+resync of every informer, the SolverSession build (host staging + device
+upload), and the first bucket compile. PR 12 made the session always-
+resident for the LIVE daemon; this module keeps the SAME state resident
+on a follower. The standby runs its informers hot (started + synced) and
+holds a prewarmed-but-NOT-started ``IncrementalBatchScheduler``: watch
+deltas accumulate in the daemon's event queue via the
+``SchedulerConfig.cluster_events`` hook, so the device-resident session
+is at most one replay behind the cluster. Activation is then just
+``daemon.start()`` — the first tick drains the accumulated deltas
+(handlers are idempotent) and solves the backlog immediately, which is
+what puts failover-to-first-bind under the 1 s SLO
+(``utils/slo.py: failover_to_first_bind_s``).
+
+``HAScheduler`` ties the standby to a fencing lease (utils/lease.py):
+``on_elected`` activates, ``on_lost`` kills the daemon abruptly (a
+deposed leader must stop binding NOW — its fencing token is stale) and
+rebuilds a fresh warm standby so the process can stand for election
+again. The kill-then-rebuild shape follows utils/leaderelect.py's
+HAHotStandby ``_up``/``_down`` idempotent factory pattern.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.scheduler.daemon import (
+    IncrementalBatchScheduler,
+    SchedulerConfig,
+)
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.lease import LeaseClient, LeaseElector
+
+_LOG = logging.getLogger("kubernetes_tpu.scheduler.standby")
+
+#: Seconds from lease activation to the standby daemon running — the
+#: control-plane half of failover_to_first_bind_s (the rest is the
+#: first tick's solve + bind, measured end-to-end by bench/soak).
+_ACTIVATION_LATENCY = metrics.DEFAULT.summary(
+    "scheduler_standby_activation_seconds",
+    "Warm-standby activation latency (lease grant to daemon running)",
+)
+
+
+class WarmStandbyScheduler:
+    """A prewarmed-but-idle IncrementalBatchScheduler.
+
+    Lifecycle: ``prewarm()`` starts the informers, waits for sync and
+    builds the device session; ``activate()`` starts the solve loop;
+    ``kill()``/``stop()`` tear down. Each instance activates at most
+    once — a deposed leader builds a FRESH standby (the killed daemon's
+    session may hold charges for binds that never landed)."""
+
+    def __init__(
+        self,
+        client,
+        sync_timeout: float = 10.0,
+        daemon_factory: Optional[
+            Callable[[SchedulerConfig], IncrementalBatchScheduler]
+        ] = None,
+        **config_kw,
+    ):
+        self.client = client
+        self.sync_timeout = sync_timeout
+        # raw cache default: the incremental daemon never decodes
+        # scheduled pods it discards by key (SchedulerConfig docstring).
+        config_kw.setdefault("raw_scheduled_cache", True)
+        self.config = SchedulerConfig(client, **config_kw)
+        # Daemon construction installs the cluster_events hook — MUST
+        # precede config.start() so no delta is missed.
+        if daemon_factory is not None:
+            self.daemon = daemon_factory(self.config)
+        else:
+            self.daemon = IncrementalBatchScheduler(self.config)
+        self._warm = False
+        self._active = False
+        self.activated_mono: Optional[float] = None
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def prewarm(self) -> "WarmStandbyScheduler":
+        """Start informers, sync, build the device session. Watch
+        deltas from here on queue in the daemon (not applied — the
+        daemon is not started) and replay on activation."""
+        if self._warm:
+            return self
+        self.config.start()
+        if not self.config.wait_for_sync(self.sync_timeout):
+            raise TimeoutError("standby informers failed to sync")
+        # Session built from the freshly synced caches; deltas that
+        # raced the build replay idempotently at activation.
+        self.daemon.prewarm()
+        self._warm = True
+        return self
+
+    def activate(self) -> IncrementalBatchScheduler:
+        """Start the solve loop. Idempotent; returns the live daemon."""
+        if self._active:
+            return self.daemon
+        if not self._warm:
+            self.prewarm()
+        self.daemon.start()
+        self._active = True
+        self.activated_mono = time.monotonic()
+        return self.daemon
+
+    def stop(self) -> None:
+        """Graceful teardown (flushes the commit pipeline)."""
+        if self._active:
+            self.daemon.stop()
+            self._active = False
+        if self._warm:
+            self.config.stop()
+            self._warm = False
+
+    def kill(self) -> None:
+        """Abrupt teardown — the deposed-leader / chaos path. Queued
+        commits are dropped (daemon.kill()); a dead leader binds
+        nothing after its lease is gone."""
+        if self._active:
+            self.daemon.kill()
+            self._active = False
+        if self._warm:
+            try:
+                self.config.stop()
+            except Exception:
+                _LOG.debug("standby config stop failed", exc_info=True)
+            self._warm = False
+
+
+class HAScheduler:
+    """Lease-elected scheduler with a warm standby behind it.
+
+    Run one per control-plane replica. Exactly one replica's lease
+    acquisition succeeds (fencing token bumps per election —
+    ``leader_elections_total{tier="scheduler"}``); that replica
+    activates its prewarmed daemon. On lease loss the daemon is killed
+    abruptly and a fresh standby is prewarmed, so the replica re-enters
+    the election warm."""
+
+    def __init__(
+        self,
+        client,
+        identity: str,
+        lease_name: str = "kt-scheduler",
+        lease_duration: float = 5.0,
+        renew_period: float = 1.0,
+        retry_period: float = 1.0,
+        standby_factory: Optional[
+            Callable[[], WarmStandbyScheduler]
+        ] = None,
+        on_activated: Optional[Callable[[int], None]] = None,
+    ):
+        self.client = client
+        self.identity = identity
+        self._factory = standby_factory or (
+            lambda: WarmStandbyScheduler(client)
+        )
+        self._on_activated = on_activated or (lambda _t: None)
+        self.lease = LeaseClient(
+            client,
+            lease_name,
+            identity,
+            tier="scheduler",
+            lease_duration=lease_duration,
+        )
+        self.elector = LeaseElector(
+            self.lease,
+            renew_period=renew_period,
+            retry_period=retry_period,
+            on_elected=self._elected,
+            on_lost=self._deposed,
+        )
+        self.standby: Optional[WarmStandbyScheduler] = None
+        self.token: Optional[int] = None
+        # Serializes elected/deposed transitions against start/stop —
+        # elector callbacks run on the elector thread.
+        self._transition = threading.Lock()
+        self._stopping = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self.token is not None
+
+    @property
+    def daemon(self) -> Optional[IncrementalBatchScheduler]:
+        sb = self.standby
+        return sb.daemon if sb is not None and sb.active else None
+
+    def start(self) -> "HAScheduler":
+        """Prewarm the standby FIRST, then stand for election — a
+        replica that wins before it is warm would pay the cold start
+        the standby exists to avoid."""
+        with self._transition:
+            self._stopping = False
+            if self.standby is None:
+                self.standby = self._factory().prewarm()
+        self.elector.start()
+        return self
+
+    def stop(self) -> None:
+        with self._transition:
+            self._stopping = True
+        self.elector.stop()  # fires on_lost if leading
+        with self._transition:
+            sb, self.standby = self.standby, None
+            if sb is not None:
+                sb.stop()
+
+    # -- elector callbacks (elector thread) ---------------------------
+
+    def _elected(self, token: int) -> None:
+        with self._transition:
+            if self._stopping:
+                return
+            self.token = token
+            sb = self.standby
+            if sb is None:
+                sb = self.standby = self._factory().prewarm()
+            granted = time.monotonic()
+            sb.activate()
+            _ACTIVATION_LATENCY.observe(time.monotonic() - granted)
+            _LOG.info(
+                "%s: scheduler leadership acquired (token %d); warm "
+                "standby activated", self.identity, token,
+            )
+        try:
+            self._on_activated(token)
+        except Exception:
+            _LOG.debug("on_activated callback failed", exc_info=True)
+
+    def _deposed(self) -> None:
+        with self._transition:
+            self.token = None
+            sb, self.standby = self.standby, None
+            if sb is not None:
+                # Stale fencing token: stop binding NOW (abrupt).
+                sb.kill()
+            _LOG.warning(
+                "%s: scheduler leadership lost; daemon killed",
+                self.identity,
+            )
+            if self._stopping:
+                return
+            # Re-enter the election warm.
+            try:
+                self.standby = self._factory().prewarm()
+            except Exception:
+                _LOG.warning(
+                    "%s: standby rebuild failed; will retry on next "
+                    "election", self.identity, exc_info=True,
+                )

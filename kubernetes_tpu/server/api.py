@@ -386,6 +386,16 @@ class APIServer:
         # Live component health checks (componentstatuses probes on
         # read; pkg/registry/componentstatus/rest.go).
         self._component_checks: Dict[str, object] = {}
+        # HA control plane handle (store/replication.py): a
+        # ReplicationHub when this apiserver fronts the leader store, a
+        # FollowerReplica when it fronts a replica. Drives the /healthz
+        # replication subcheck, /replication/append ingest, and the
+        # follower's mutating-verb forward (httpserver.py). None =
+        # single-node, the historical shape.
+        self.replication = None
+        # A follower apiserver forwards writes here (the leader's base
+        # URL); set alongside `replication` by the HA wiring.
+        self.leader_url = ""
         # Service allocation pools (pkg/master/master.go:440-455) with
         # the reference's restart repair pass: rebuild the bitmaps from
         # whatever services the (possibly pre-existing) store holds
@@ -398,6 +408,10 @@ class APIServer:
         for port in service_node_ports_in_use(stored_services):
             self.service_node_ports.mark(port)
         # Ensure the default namespace exists (reference auto-creates).
+        # A replica-mode store is read-only from this side — the
+        # namespace arrives through replication from the leader.
+        if getattr(self.store, "replica", False):
+            return
         try:
             self.store.create(
                 "/registry/namespaces/default",

@@ -376,6 +376,37 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except Exception as e:
             checks["watchHub"] = {"status": "unhealthy", "message": str(e)}
+        rep = getattr(self.api, "replication", None)
+        if rep is not None:
+            # HA subcheck: role + commit index + per-follower lag
+            # (leader side) or journaled/commit watermarks (follower).
+            # A dead follower link flips the check unhealthy — the
+            # load balancer should stop preferring this replica's
+            # writes before quorum stalls, not after.
+            try:
+                st = rep.status()
+                followers = st.get("followers", [])
+                dead = [
+                    f["name"] for f in followers if not f.get("alive", True)
+                ]
+                check = {
+                    "status": "unhealthy" if dead else "ok",
+                    "role": st.get("role", ""),
+                    "commitIndex": st.get("commitIndex", 0),
+                    "followerLag": {
+                        f["name"]: f.get("lagVersions", 0)
+                        for f in followers
+                    },
+                }
+                if dead:
+                    check["message"] = (
+                        "unreachable followers: " + ", ".join(dead)
+                    )
+                checks["replication"] = check
+            except Exception as e:
+                checks["replication"] = {
+                    "status": "unhealthy", "message": str(e),
+                }
         try:
             size, cap = flightrecorder.DEFAULT.ring_stats()
             checks["flightRecorder"] = (
@@ -399,6 +430,95 @@ class _Handler(BaseHTTPRequestHandler):
                 "checks": checks,
             },
         )
+
+    def _serve_replication(self, verb: str, rest: Tuple[str, ...]) -> None:
+        """The WAL-shipping ingest plane (store/replication.py).
+
+        POST /replication/append — leader hub -> this follower:
+        {"lines": [...], "commit": N} journals + applies; {"bootstrap":
+        state} installs a dump_state() snapshot; commit=-1 is a pure
+        status probe. Bodies are internal wire format — no version
+        conversion, no auth (peer plane, like /healthz).
+        GET /replication/status — role/commit/lag introspection."""
+        rep = getattr(self.api, "replication", None)
+        if rest == ("status",) and verb == "GET":
+            if rep is None:
+                raise APIError(
+                    404, "NotFound", "replication not configured"
+                )
+            self._send_json(200, rep.status())
+            return
+        if rest != ("append",) or verb != "POST":
+            raise APIError(
+                404, "NotFound",
+                "replication endpoints: POST /replication/append, "
+                "GET /replication/status",
+            )
+        from kubernetes_tpu.store.replication import (
+            FollowerReplica,
+            ReplicationError,
+        )
+
+        if not isinstance(rep, FollowerReplica):
+            raise APIError(
+                409, "Conflict",
+                "this apiserver does not front a follower replica",
+            )
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError as e:
+            raise APIError(400, "BadRequest", f"invalid JSON body: {e}")
+        try:
+            if "bootstrap" in body:
+                rep.bootstrap(body["bootstrap"])
+                journaled = rep.store.journaled_version
+            else:
+                journaled = rep.append(
+                    list(body.get("lines", ())),
+                    int(body.get("commit", -1)),
+                )
+        except ReplicationError as e:
+            # 409: the shipper must NOT retry into a promoted follower
+            # (a stale leader's stream) — it surfaces as a dead link.
+            raise APIError(409, "Conflict", str(e))
+        self._send_json(200, dict(rep.status(), journaled=journaled))
+
+    def _forward_leader(self, verb: str) -> Tuple[str, int]:
+        """Follower write path: relay the request verbatim to the
+        leader apiserver and pass its response through. The follower
+        stays a pure read fan-out — its store is a replica and refuses
+        local mutation; clients keep one endpoint list and never need
+        to know who leads (the reference gets this for free from etcd:
+        any member proxies writes to the raft leader)."""
+        import urllib.error
+        import urllib.request
+
+        url = self.api.leader_url.rstrip("/") + self.path
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        data = self.rfile.read(length) if length else None
+        headers = {}
+        for h in ("Content-Type", "Authorization", tracing.TRACE_HEADER):
+            if self.headers.get(h):
+                headers[h] = self.headers[h]
+        req = urllib.request.Request(
+            url, data=data, headers=headers, method=verb
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = resp.read()
+                code = resp.status
+                ctype = resp.headers.get("Content-Type", "application/json")
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            code = e.code
+            ctype = e.headers.get("Content-Type", "application/json")
+        except urllib.error.URLError as e:
+            raise APIError(
+                502, "BadGateway", f"leader forward failed: {e}"
+            )
+        self._send_text(code, body, ctype)
+        return "forwarded", code
 
     def _route(self) -> Tuple[str, ...]:
         parsed = urlparse(self.path)
@@ -452,6 +572,13 @@ class _Handler(BaseHTTPRequestHandler):
             parts = self._route()
             if parts == ("healthz",):
                 self._serve_healthz()
+                return
+            if parts and parts[0] == "replication":
+                # Internal replication plane (store/replication.py
+                # HTTPLink): peer traffic, ahead of the auth chain like
+                # /healthz — the WAL stream must keep flowing while the
+                # user-facing auth config churns.
+                self._serve_replication(verb, parts[1:])
                 return
             if parts == ("metrics",):
                 self._send_text(
@@ -642,6 +769,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _api_v1(self, verb: str, rest: Tuple[str, ...]) -> Tuple[str, int]:
         api = self.api
+        if (
+            verb in ("POST", "PUT", "DELETE", "PATCH")
+            and api.leader_url
+            and getattr(api.store, "replica", False)
+        ):
+            # Stateless-apiserver write path: this replica's store is
+            # read-only; every mutation forwards to the leader. Reads
+            # and watches stay local (the watch cache fans out on every
+            # replica — that's the whole point of N apiservers).
+            return self._forward_leader(verb)
         q = self.query
         lsel = q.get("labelSelector", "")
         fsel = q.get("fieldSelector", "")

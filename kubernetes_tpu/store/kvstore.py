@@ -277,6 +277,19 @@ class KVStore:
         # fan-out, with the stored (read-only) object — no copy. See
         # subscribe().
         self._subscribers: tuple = ()
+        # WAL taps (the replication hub's feed): called UNDER self._lock
+        # with (version, raw_line) for every journaled mutation, in
+        # version order, with the exact bytes the WAL got — the line a
+        # follower must append verbatim for its log to be byte-identical
+        # to the leader's. Taps run only after the local append
+        # succeeded, so a torn (unacked) record is never shipped. See
+        # add_wal_tap().
+        self._wal_taps: tuple = ()
+        # Optional quorum gate: when set (by the replication hub on the
+        # leader), every write ack additionally waits for the record to
+        # reach the replicated commit index — fsync-before-ack extended
+        # to quorum-before-ack. See set_commit_gate().
+        self._commit_gate = None
         # Fan-out rides its own thread: writers only append to this
         # queue under the lock; the dispatcher does the per-event copy
         # and per-watcher predicate work OFF the write path, so write
@@ -322,6 +335,17 @@ class KVStore:
         # across restarts), monotonic for in-memory ones (immune to
         # NTP steps — the pre-durability behavior).
         self._now = time.time if data_dir else time.monotonic
+        # Replica mode (set_replica_mode): the store is a follower
+        # mirror — direct writes are refused (mutations arrive only
+        # through replicate()) and TTL entries never expire locally
+        # (the leader's expiry lands as a replicated DELETED record; a
+        # local expiry would fork the version clock). The journal/
+        # apply split: _repl_pending holds journaled-but-uncommitted
+        # (version, raw_line) entries; _repl_journaled is the highest
+        # journaled version (what the leader's quorum counts).
+        self._replica = False
+        self._repl_pending: deque = deque()
+        self._repl_journaled = 0
         self._fsync = fsync
         self._snapshot_every = snapshot_every
         self._wal_file = None
@@ -359,6 +383,7 @@ class KVStore:
             os.ftruncate(self._lockfd, 0)  # clear any longer stale pid
             os.write(self._lockfd, str(os.getpid()).encode())
             replayed = self._recover()
+            self._repl_journaled = self._version
             self._ttl_heap = [(t, k) for k, t in self._ttl.items()]
             heapq.heapify(self._ttl_heap)
             self._next_expiry = min(self._ttl.values(), default=math.inf)
@@ -394,6 +419,8 @@ class KVStore:
                 self._data[key] = (obj, ver)
                 if exp is not None:
                     self._ttl[key] = exp
+            # Recovery runs in __init__, before the store is shared
+            # with any other thread.  # ktlint: disable=KT002
             self._version = snap_version
         replayed = 0
         if os.path.exists(self._wal_path):
@@ -423,6 +450,8 @@ class KVStore:
                                     self._ttl[key] = rec["e"]
                                 else:
                                     self._ttl.pop(key, None)
+                            # Same: pre-share WAL replay, no
+                            # readers yet.  # ktlint: disable=KT002
                             self._version = max(self._version, v)
                             replayed += 1
                     good_offset += len(raw)
@@ -435,7 +464,7 @@ class KVStore:
         self, version: int, etype: str, key: str, obj: dict,
         flush: bool = True,
     ) -> None:
-        if self._wal_file is None:
+        if self._wal_file is None and not self._wal_taps:
             return
         rec = {"v": version, "t": etype, "k": key}
         if etype != DELETED:
@@ -444,35 +473,48 @@ class KVStore:
             if exp is not None:
                 rec["e"] = exp
         data = json.dumps(rec, separators=(",", ":")) + "\n"
-        if faults.enabled() and faults.fire(faults.WAL_TORN_WRITE, key):
-            # Mid-append process death: a PREFIX of the record reaches
-            # the file (no newline), the write is never acked (raise),
-            # and recovery must truncate back to the last intact
-            # record. The store is DEAD from here (_closed): a torn
-            # line only exists because the process died mid-write, so
-            # later appends must never fuse onto the torn bytes — a
-            # live continuation would make replay truncate ACKED
-            # records that landed after it. Pair with crash() + a
-            # fresh store on the same data dir.
-            self._wal_file.write(data[: max(1, len(data) // 2)])
-            self._wal_file.flush()
-            self._closed = True
-            raise faults.FaultInjected(
-                f"kvstore.wal.torn_write: died mid-append of {key}"
-            )
-        self._wal_file.write(data)
-        # flush=False is the batch path (create_many/atomic_update_many
-        # and friends): records accumulate in the file object's buffer
-        # and _wal_flush_locked writes them as ONE append at the end of
-        # the lock hold — the "single WAL append" half of group commit.
-        if flush:
-            self._wal_file.flush()
-        # fsync does NOT happen here (we hold self._lock): callers ack
-        # through _wal_sync after releasing it — the group-commit seam.
-        self._wal_seq += 1
-        self._wal_count += 1
-        if self._wal_count >= self._snapshot_every:
-            self._snapshot_locked()
+        if self._wal_file is not None:
+            if faults.enabled() and faults.fire(faults.WAL_TORN_WRITE, key):
+                # Mid-append process death: a PREFIX of the record
+                # reaches the file (no newline), the write is never
+                # acked (raise), and recovery must truncate back to the
+                # last intact record. The store is DEAD from here
+                # (_closed): a torn line only exists because the
+                # process died mid-write, so later appends must never
+                # fuse onto the torn bytes — a live continuation would
+                # make replay truncate ACKED records that landed after
+                # it. Pair with crash() + a fresh store on the same
+                # data dir. The raise also happens BEFORE the WAL taps:
+                # a torn record must never reach a follower.
+                self._wal_file.write(data[: max(1, len(data) // 2)])
+                self._wal_file.flush()
+                self._closed = True
+                raise faults.FaultInjected(
+                    f"kvstore.wal.torn_write: died mid-append of {key}"
+                )
+            self._wal_file.write(data)
+            # flush=False is the batch path (create_many/
+            # atomic_update_many and friends): records accumulate in
+            # the file object's buffer and _wal_flush_locked writes
+            # them as ONE append at the end of the lock hold — the
+            # "single WAL append" half of group commit.
+            if flush:
+                self._wal_file.flush()
+            # fsync does NOT happen here (we hold self._lock): callers
+            # ack through _wal_sync after releasing it — the group-
+            # commit seam.
+            self._wal_seq += 1
+            self._wal_count += 1
+            if self._wal_count >= self._snapshot_every:
+                self._snapshot_locked()
+        for tap in self._wal_taps:
+            # O(append-to-buffer) by contract: taps enqueue the raw
+            # line for an off-thread shipper; the actual network send
+            # never happens under this lock.
+            try:
+                tap(version, data)
+            except Exception:
+                pass  # a broken replication link must not fail writes
 
     def _wal_flush_locked(self) -> None:
         """Flush buffered batch appends to the OS (one write syscall
@@ -483,10 +525,25 @@ class KVStore:
     def _sync_batch_locked_free(self) -> None:
         """One group-commit fsync covering everything appended so far
         (the serialized write thread's per-batch flush). Caller must
-        NOT hold self._lock. No-op for in-memory / fsync=off stores."""
+        NOT hold self._lock. No-op for in-memory / fsync=off stores.
+        Deliberately NOT _ack_write: the applier thread must never park
+        on the replication quorum — each caller waits for its own
+        commit in _ack_write instead."""
         with self._lock:
             seq = self._wal_seq
         self._wal_sync(seq)
+
+    def _ack_write(self, seq: int) -> None:
+        """The full before-ack pipeline for one local write: group-
+        commit fsync (_wal_sync), then — when a replication hub gates
+        this store — quorum commit. Every public mutation funnels its
+        ack through here, so "acked" always means "durable on this
+        node AND on a quorum of replicas" once replication is attached.
+        Callers must NOT hold self._lock."""
+        self._wal_sync(seq)
+        gate = self._commit_gate
+        if gate is not None:
+            gate()
 
     def _wal_sync(self, seq: int) -> None:
         """Group commit: make WAL record `seq` durable before the
@@ -633,6 +690,14 @@ class KVStore:
         with self._lock:
             return self._version
 
+    @property
+    def journaled_version(self) -> int:
+        """Highest version durable in this store's log — the replica
+        ack the leader counts toward quorum (>= version while an
+        uncommitted replicated tail is pending)."""
+        with self._lock:
+            return max(self._repl_journaled, self._version)
+
     def _bump(self) -> int:
         # Every mutation funnels through here under self._lock. A
         # closed store must REFUSE writes rather than ack them with
@@ -641,6 +706,15 @@ class KVStore:
         # recovery will ever see.
         if self._closed:
             raise StoreError("store is closed")
+        if self._replica:
+            # Follower mirrors take mutations ONLY through
+            # apply_replicated: a local write would mint a version the
+            # leader also mints, forking the logical clock. This is
+            # also the store-tier fencing backstop — a stale leader's
+            # late write against a demoted store is refused here.
+            raise StoreError("store is a read-only replica")
+        # Every caller holds self._lock (the apply paths); _bump is
+        # the locked clock's helper.  # ktlint: disable=KT002
         self._version += 1
         return self._version
 
@@ -650,6 +724,8 @@ class KVStore:
         return obj
 
     def _expire_locked(self) -> None:
+        if self._replica:
+            return  # expiry replicates from the leader as DELETED records
         if self._now() < self._next_expiry:
             return  # nothing can have expired yet — O(1) common path
         now = self._now()
@@ -710,6 +786,15 @@ class KVStore:
                     int(obj.get("metadata", {}).get("resourceVersion", 0)),
                 )
             raise
+        self._publish_locked(version, etype, key, obj, prev)
+
+    def _publish_locked(
+        self, version: int, etype: str, key: str, obj: dict,
+        prev: Optional[dict] = None,
+    ) -> None:
+        """History-ring + dispatch half of _record_locked — shared with
+        the replicated-apply path, which journals raw leader bytes
+        instead of re-serializing but must feed watchers identically."""
         if not self._history:
             self._oldest = version
         self._history.append((version, etype, key, obj))
@@ -809,7 +894,7 @@ class KVStore:
                 return self._wal_seq
 
         seq = self._apply_write(op)
-        self._wal_sync(seq)  # fsync-before-ack, amortized across writers
+        self._ack_write(seq)  # fsync-before-ack, amortized across writers
         return _copy_obj(obj)
 
     def create_many(
@@ -854,7 +939,7 @@ class KVStore:
                 return out, self._wal_seq
 
         results, seq = self._apply_write(op)
-        self._wal_sync(seq)  # ONE fsync for the whole batch
+        self._ack_write(seq)  # ONE fsync for the whole batch
         return results
 
     def delete_many(self, keys: List[str]) -> List:
@@ -879,7 +964,7 @@ class KVStore:
                 return out, self._wal_seq
 
         results, seq = self._apply_write(op)
-        self._wal_sync(seq)
+        self._ack_write(seq)
         return results
 
     def get(self, key: str) -> dict:
@@ -916,7 +1001,7 @@ class KVStore:
                 return self._wal_seq
 
         seq = self._apply_write(op)
-        self._wal_sync(seq)
+        self._ack_write(seq)
         return _copy_obj(obj)
 
     def delete(self, key: str, expected_version: Optional[int] = None) -> dict:
@@ -937,7 +1022,7 @@ class KVStore:
                 return obj, self._wal_seq
 
         obj, seq = self._apply_write(op)
-        self._wal_sync(seq)
+        self._ack_write(seq)
         return _copy_obj(obj)
 
     def list(self, prefix: str, copy: bool = True) -> Tuple[List[dict], int]:
@@ -1037,7 +1122,7 @@ class KVStore:
                 return stored, self._wal_seq
 
         stored, seq = self._apply_write(op)
-        self._wal_sync(seq)
+        self._ack_write(seq)
         return _copy_obj(stored)
 
     def atomic_update_many(
@@ -1123,7 +1208,7 @@ class KVStore:
                 return out, self._wal_seq
 
         results, seq = self._apply_write(batch)
-        self._wal_sync(seq)
+        self._ack_write(seq)
         # copy_results=False hands back the STORED objects (read-only
         # contract) — callers that only inspect status/metadata (the
         # bind commit path, bulk update) skip a per-item json round
@@ -1170,6 +1255,179 @@ class KVStore:
         per-event copies."""
         with self._lock:
             self._subscribers = self._subscribers + (fn,)
+
+    # -- Replication (store/replication.py rides these seams) ---------
+
+    def add_wal_tap(self, fn: Callable) -> None:
+        """Register a WAL tap: fn(version, raw_line) is invoked UNDER
+        self._lock, in version order, with the exact newline-terminated
+        bytes the local WAL received — the replication hub's feed. Taps
+        must only enqueue (no I/O, no store calls)."""
+        with self._lock:
+            self._wal_taps = self._wal_taps + (fn,)
+
+    def set_commit_gate(self, fn: Optional[Callable]) -> None:
+        """Install (or clear, with None) the quorum gate: a zero-arg
+        callable every write ack runs AFTER its fsync, off-lock. The
+        replication hub points this at its wait-committed barrier so a
+        leader acks at raft-lite quorum, not just local durability."""
+        self._commit_gate = fn
+
+    def set_replica_mode(self, replica: bool) -> None:
+        """Mark this store a follower mirror (writes refused, TTLs
+        passive — see _bump/_expire_locked) or promote it back to a
+        writable leader."""
+        with self._lock:
+            self._replica = replica
+
+    @property
+    def replica(self) -> bool:
+        return self._replica
+
+    def replicate(self, raw_lines: List[str], commit: int) -> Tuple[int, int]:
+        """Follower ingest — raft's log/state-machine split on one
+        store. Leader-shipped WAL lines are journaled VERBATIM (byte-
+        identical follower logs are the promotion oracle; no re-
+        serialization can drift) and made durable before return, so the
+        leader may count this follower toward quorum for every
+        journaled version. Only the prefix at or below `commit` (the
+        leader's commit index) is applied to the live mirror — memory,
+        history ring, subscribers, watchers — exactly as _recover
+        would replay it, so a follower apiserver's watch cache stays
+        warm while the uncommitted tail stays invisible. Lines at or
+        below the journaled version are skipped (idempotent under link
+        retries). Returns (journaled_version, applied_version)."""
+
+        def op():
+            with self._lock:
+                if self._closed:
+                    raise StoreError("store is closed")
+                for data in raw_lines:
+                    v = json.loads(data)["v"]
+                    if v <= self._repl_journaled:
+                        continue
+                    # Pending BEFORE journal: _wal_raw_locked's deferred-
+                    # compaction guard must already see this entry.
+                    self._repl_pending.append((v, data))
+                    self._wal_raw_locked(v, data)
+                    self._repl_journaled = v
+                self._commit_replicated_locked(commit)
+                self._wal_flush_locked()
+                return self._repl_journaled, self._version, self._wal_seq
+
+        journaled, applied, seq = self._apply_write(op)
+        self._wal_sync(seq)
+        return journaled, applied
+
+    def _commit_replicated_locked(self, commit: int) -> None:
+        """Apply journaled entries up to the leader commit index."""
+        while self._repl_pending and self._repl_pending[0][0] <= commit:
+            v, data = self._repl_pending.popleft()
+            rec = json.loads(data)
+            key, etype = rec["k"], rec["t"]
+            if etype == DELETED:
+                prev_t = self._data.pop(key, None)
+                self._ttl.pop(key, None)
+                obj = prev_t[0] if prev_t is not None else {
+                    "metadata": {"name": key.rsplit("/", 1)[-1]}
+                }
+                prev = None
+            else:
+                obj = rec["o"]
+                prev_t = self._data.get(key)
+                prev = prev_t[0] if prev_t is not None else None
+                self._data[key] = (obj, v)
+                exp = rec.get("e")
+                if exp is not None:
+                    self._ttl[key] = exp
+                    heapq.heappush(self._ttl_heap, (exp, key))
+                    self._next_expiry = min(self._next_expiry, exp)
+                else:
+                    self._ttl.pop(key, None)
+            self._version = v
+            self._publish_locked(v, etype, key, obj, prev)
+
+    def promote_replica(self) -> int:
+        """Promote this follower to a writable leader exposing EXACTLY
+        the committed prefix: the journaled-but-uncommitted tail is
+        discarded (truncated out of the WAL — an unacked record must
+        never surface after failover, the crash-recovery oracle
+        extended to replication) and replica mode flips off. Returns
+        the version the new leader serves from."""
+        with self._lock:
+            dropped = sum(
+                len(d.encode("utf-8")) for _v, d in self._repl_pending
+            )
+            self._repl_pending.clear()
+            self._repl_journaled = self._version
+            if self._wal_file is not None and dropped:
+                with sanitizer.allow_blocking(
+                    "promotion truncates the uncommitted tail; "
+                    "stop-the-world like snapshot compaction"
+                ):
+                    self._wal_file.flush()
+                    size = os.path.getsize(self._wal_path)
+                    os.truncate(self._wal_path, max(0, size - dropped))
+                    if self._fsync:
+                        os.fsync(self._wal_file.fileno())
+            self._replica = False
+            return self._version
+
+    def _wal_raw_locked(self, version: int, data: str) -> None:
+        """Journal one leader-shipped line byte-for-byte (the verbatim
+        half of replicate; flush batched by the caller). Compaction is
+        deferred while uncommitted entries are pending: a snapshot
+        folds MEMORY state and truncates the WAL, which would silently
+        drop the journaled-not-applied tail."""
+        if self._wal_file is not None:
+            self._wal_file.write(data)
+            self._wal_seq += 1
+            self._wal_count += 1
+            if (
+                self._wal_count >= self._snapshot_every
+                and not self._repl_pending
+            ):
+                self._snapshot_locked()
+        for tap in self._wal_taps:  # chained replication stays possible
+            try:
+                tap(version, data)
+            except Exception:
+                pass
+
+    def dump_state(self) -> dict:
+        """Consistent bootstrap snapshot for a late-joining follower —
+        same shape as the on-disk snapshot ({version, items:[key, obj,
+        version, expiry]}). Objects are copied: the dump outlives this
+        lock hold and usually crosses a process/HTTP boundary."""
+        with self._lock:
+            self._expire_locked()
+            items = [
+                [k, obj, ver, self._ttl.get(k)]
+                for k, (obj, ver) in sorted(self._data.items())
+            ]
+            version = self._version
+        return {
+            "version": version,
+            "items": [[k, _copy_obj(o), v, e] for k, o, v, e in items],
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Install a leader bootstrap snapshot into this (empty)
+        follower; durable followers immediately fold it into their own
+        snapshot file so a restart recovers to the same point."""
+        with self._lock:
+            if self._data or self._version:
+                raise StoreError("load_state requires an empty store")
+            for key, obj, ver, exp in state["items"]:
+                self._data[key] = (obj, ver)
+                if exp is not None:
+                    self._ttl[key] = exp
+                    heapq.heappush(self._ttl_heap, (exp, key))
+                    self._next_expiry = min(self._next_expiry, exp)
+            self._version = state["version"]
+            self._repl_journaled = self._version
+            if self._wal_file is not None:
+                self._snapshot_locked()
 
     def expire_now(self) -> None:
         """Process due TTL expirations (O(1) when none are due). Read

@@ -206,6 +206,19 @@ KUBELET_HEARTBEAT_DROP = _site(
     "kubelet.heartbeat.drop", "trip",
     doc="skip a node status heartbeat (lost beat, not a dead kubelet)",
 )
+# lease-based leader election (utils/lease.py):
+LEASE_RENEW_LOST = _site(
+    "lease.renew.lost", "error", exc=_fi,
+    doc="the holder's renew CAS is lost in flight (network partition "
+        "from the lease store); the holder must demote itself once the "
+        "lease window expires on its own clock, never before",
+)
+LEASE_CLOCK_SKEW = _site(
+    "lease.clock.skew", "trip",
+    doc="the holder's local clock runs slow by one lease duration: it "
+        "believes it still holds an expired lease while a rival steals "
+        "it — the fencing token is what keeps its stale writes out",
+)
 
 
 # -- rule state ---------------------------------------------------------

@@ -1,0 +1,349 @@
+"""CAS-renewed lease with a monotonic fencing token.
+
+utils/leaderelect.py (podmaster.go's recipe) answers "who runs the
+daemon"; this module answers the harder half of that question: "whose
+*writes* are still legitimate". A lease object lives in the store (an
+annotated Endpoints record in kube-system, CAS'd through resourceVersion
+exactly like the elector's lock) and additionally carries a **fencing
+token** — an integer bumped on every change of effective holder, never
+on a plain renewal. Any actor doing work on behalf of the lease attaches
+its token; validate()/require() refuse tokens older than the current one,
+so a stale holder — paused, partitioned, or running on a slow clock —
+cannot corrupt state after a takeover even though it still *believes*
+it is the leader. (The classic Chubby/ZooKeeper fencing argument: lease
+expiry alone cannot stop a holder that does not know the time.)
+
+Failure seams (seeded, deterministic — utils/faults.py):
+- ``lease.renew.lost``: the holder's renew CAS vanishes in flight; the
+  holder must keep believing only until the lease window expires on its
+  own clock, and its token must fence once a rival steals.
+- ``lease.clock.skew``: the holder's clock starts running slow by one
+  lease duration, so it believes an expired lease is live — the exact
+  scenario fencing exists for.
+
+``LeaseElector`` wraps the client in the renew/steal loop (same shape
+as LeaderElector, plus the token threaded into the callbacks) and is
+what gates the warm-standby scheduler (scheduler/standby.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from kubernetes_tpu.server.api import APIError
+from kubernetes_tpu.utils import faults, metrics
+
+LEASE_NAMESPACE = "kube-system"
+HOLDER_KEY = "lease.kubernetes-tpu.io/holder"
+RENEW_KEY = "lease.kubernetes-tpu.io/renew-time"
+TOKEN_KEY = "lease.kubernetes-tpu.io/fencing-token"
+
+ELECTIONS = metrics.DEFAULT.counter(
+    "leader_elections_total",
+    "Leadership acquisitions (fencing-token bumps) per control-plane tier",
+    labels=("tier",),
+)
+
+
+class LeaseFenceError(Exception):
+    """A write carried a fencing token older than the current lease —
+    the writer lost leadership and must stop."""
+
+
+class LeaseRecord:
+    """Immutable snapshot of the lease object."""
+
+    __slots__ = ("holder", "token", "renewed", "resource_version")
+
+    def __init__(self, holder: str, token: int, renewed: float,
+                 resource_version: Optional[int]):
+        self.holder = holder
+        self.token = token
+        self.renewed = renewed
+        self.resource_version = resource_version
+
+    def __repr__(self) -> str:
+        return (
+            f"<Lease holder={self.holder!r} token={self.token} "
+            f"renewed={self.renewed:.3f}>"
+        )
+
+
+class LeaseClient:
+    """CAS lease mechanics for one identity over one named lease.
+
+    `clock` is injectable (property tests drive whole renew/expire/
+    steal schedules without sleeping). The LEASE_CLOCK_SKEW fault makes
+    THIS identity's view of that clock run slow by one lease duration
+    from the moment it fires — the store's record always carries true
+    clock times (written by whoever renews), only the local holder
+    belief skews."""
+
+    def __init__(
+        self,
+        client,
+        name: str,
+        identity: str,
+        tier: str = "scheduler",
+        lease_duration: float = 5.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.client = client
+        self.name = name
+        self.identity = identity
+        self.tier = tier
+        self.lease_duration = lease_duration
+        self._clock = clock
+        self._skew = 0.0
+        # Local belief: what this identity thinks it holds. Updated
+        # only by its own acquire/renew outcomes and its own (possibly
+        # skewed) clock — exactly the information a real process has.
+        self._held_token: Optional[int] = None
+        self._renewed_local = 0.0
+
+    # -- clock --------------------------------------------------------
+
+    def now(self) -> float:
+        if faults.enabled() and faults.fire(
+            faults.LEASE_CLOCK_SKEW, self.identity
+        ):
+            self._skew += self.lease_duration
+        return self._clock() - self._skew
+
+    # -- record I/O ---------------------------------------------------
+
+    def _read_obj(self):
+        try:
+            return self.client.get(
+                "endpoints", self.name, namespace=LEASE_NAMESPACE
+            )
+        except APIError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    @staticmethod
+    def _record_of(obj) -> LeaseRecord:
+        ann = obj.metadata.annotations or {}
+        try:
+            renewed = float(ann.get(RENEW_KEY, "0") or "0")
+        except ValueError:
+            renewed = 0.0
+        try:
+            token = int(ann.get(TOKEN_KEY, "0") or "0")
+        except ValueError:
+            token = 0
+        rv = None
+        try:
+            rv = int(obj.metadata.resource_version or 0)
+        except (TypeError, ValueError):
+            pass
+        return LeaseRecord(ann.get(HOLDER_KEY, ""), token, renewed, rv)
+
+    def read(self) -> Optional[LeaseRecord]:
+        obj = self._read_obj()
+        return None if obj is None else self._record_of(obj)
+
+    def try_acquire(self) -> Optional[int]:
+        """Acquire, steal, or renew; returns the fencing token while
+        held after this call, None otherwise. A plain renewal keeps the
+        token; any change of effective holder — fresh create, steal of
+        an expired lease, or re-acquisition after this identity's own
+        lease lapsed — bumps it (and counts as an election)."""
+        now = self.now()
+        obj = self._read_obj()
+        rec = None if obj is None else self._record_of(obj)
+        if rec is None:
+            # No lease yet: atomic create; the loser of the race 409s.
+            try:
+                self.client.create(
+                    "endpoints",
+                    {
+                        "kind": "Endpoints",
+                        "metadata": {
+                            "name": self.name,
+                            "namespace": LEASE_NAMESPACE,
+                            "annotations": {
+                                HOLDER_KEY: self.identity,
+                                RENEW_KEY: str(self._clock()),
+                                TOKEN_KEY: "1",
+                            },
+                        },
+                    },
+                    namespace=LEASE_NAMESPACE,
+                )
+            except APIError as e:
+                if e.code == 409:
+                    return self.held_token()
+                raise
+            self._held_token = 1
+            self._renewed_local = now
+            ELECTIONS.inc(tier=self.tier)
+            return 1
+        true_now = self._clock()
+        renewing = (
+            rec.holder == self.identity and self._held_token == rec.token
+        )
+        expired = true_now - rec.renewed >= self.lease_duration
+        if not renewing and not expired:
+            return self.held_token()  # someone else holds a live lease
+        if renewing and not expired:
+            token = rec.token
+        else:
+            token = rec.token + 1  # takeover: new fencing epoch
+        if renewing:
+            # The renew CAS can be lost in flight (partition from the
+            # lease store). The holder's record write never landed;
+            # its local belief decays on its own clock below.
+            faults.fire(faults.LEASE_RENEW_LOST, self.identity)
+        try:
+            # CAS against the resourceVersion of the SAME read the
+            # decision used: any rival write in between conflicts.
+            ann = dict(obj.metadata.annotations or {})
+            ann[HOLDER_KEY] = self.identity
+            ann[RENEW_KEY] = str(true_now)
+            ann[TOKEN_KEY] = str(token)
+            obj.metadata.annotations = ann
+            self.client.update("endpoints", obj, namespace=LEASE_NAMESPACE)
+        except faults.FaultInjected:
+            raise
+        except APIError as e:
+            if e.code in (404, 409):
+                return self.held_token()  # lost the race
+            raise
+        self._held_token = token
+        self._renewed_local = now
+        if not renewing:
+            ELECTIONS.inc(tier=self.tier)
+        return token
+
+    def release(self) -> None:
+        """Drop the lease cooperatively (renew-time zeroed so a standby
+        can take over immediately); local belief clears regardless."""
+        token, self._held_token = self._held_token, None
+        if token is None:
+            return
+        try:
+            obj = self.client.get(
+                "endpoints", self.name, namespace=LEASE_NAMESPACE
+            )
+            ann = dict(obj.metadata.annotations or {})
+            if ann.get(HOLDER_KEY) != self.identity:
+                return
+            ann[RENEW_KEY] = "0"
+            obj.metadata.annotations = ann
+            self.client.update("endpoints", obj, namespace=LEASE_NAMESPACE)
+        except APIError:
+            pass  # best effort: expiry reclaims it anyway
+
+    # -- belief + fencing ---------------------------------------------
+
+    def held_token(self) -> Optional[int]:
+        """The token this identity BELIEVES it holds, decayed on its
+        own (possibly skewed) clock — None once the window lapses."""
+        if self._held_token is None:
+            return None
+        if self.now() - self._renewed_local >= self.lease_duration:
+            return None  # could have been stolen; stop acting
+        return self._held_token
+
+    def validate(self, token: Optional[int]) -> bool:
+        """True iff `token` is the CURRENT fencing token — the check a
+        resource guards writes with. Reads the record (the fencing
+        authority is the store, never anyone's local clock)."""
+        if token is None:
+            return False
+        rec = self.read()
+        return rec is not None and rec.token == token
+
+    def require(self, token: Optional[int]) -> None:
+        if not self.validate(token):
+            rec = self.read()
+            raise LeaseFenceError(
+                f"{self.identity}: fencing token {token} is stale "
+                f"(current: {rec.token if rec else 'none'})"
+            )
+
+
+class LeaseElector:
+    """Renew/steal loop over a LeaseClient (LeaderElector's shape, with
+    the fencing token threaded through). on_elected(token) fires once
+    per acquisition; on_renewed(token) on every successful renew;
+    on_lost() when the belief window lapses or a rival CAS'd past."""
+
+    def __init__(
+        self,
+        lease: LeaseClient,
+        renew_period: float = 1.0,
+        retry_period: float = 1.0,
+        on_elected: Optional[Callable[[int], None]] = None,
+        on_renewed: Optional[Callable[[int], None]] = None,
+        on_lost: Optional[Callable[[], None]] = None,
+    ):
+        self.lease = lease
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.on_elected = on_elected or (lambda _t: None)
+        self.on_renewed = on_renewed or (lambda _t: None)
+        self.on_lost = on_lost or (lambda: None)
+        self.token: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.token is not None
+
+    def start(self) -> "LeaseElector":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"lease-{self.lease.name}-{self.lease.identity}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.token is not None:
+            self.token = None
+            self.lease.release()
+            try:
+                self.on_lost()
+            except Exception:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                token = self.lease.try_acquire()
+            except Exception:
+                # Transient failure (including an injected renew-lost):
+                # keep believing only within the local lease window.
+                token = self.lease.held_token()
+            if self._stop.is_set():
+                return
+            if token is not None and self.token is None:
+                self.token = token
+                try:
+                    self.on_elected(token)
+                except Exception:
+                    pass
+            elif token is not None:
+                self.token = token
+                try:
+                    self.on_renewed(token)
+                except Exception:
+                    pass
+            elif self.token is not None:
+                self.token = None
+                try:
+                    self.on_lost()
+                except Exception:
+                    pass
+            self._stop.wait(
+                self.renew_period if self.is_leader else self.retry_period
+            )

@@ -277,6 +277,15 @@ BENCH_OBJECTIVES: Dict[str, Objective] = {
         kind="value_min", severity="warn",
         description="bulk CRUD ops floor over HTTP",
     ),
+    "failover_to_first_bind_s": Objective(
+        "failover_to_first_bind_s", "failover_to_first_bind_p99_s",
+        target=1.0, kind="value_max", warn_ratio=0.0,
+        description="scheduler-leader kill -> the warm standby's first "
+        "bind watch-visible, p99; the warm-standby path (prewarmed "
+        "SolverSession + hot informers + lease takeover) must land "
+        "this under a second — the cold path pays LIST + session "
+        "build + bucket compile and cannot",
+    ),
 }
 
 

@@ -1,0 +1,285 @@
+"""Fencing-lease property tests (utils/lease.py): CAS renew/expire/
+steal schedules driven on an injected clock — no sleeping, fully
+deterministic per seed.
+
+Properties under test:
+- the fencing token is monotonic and bumps exactly on every change of
+  effective holder (never on a plain renewal);
+- at most one identity's believed token validates at any instant;
+- a stale holder — renew CAS lost in flight, or running on a slow
+  clock — has its writes refused (LeaseFenceError) after a takeover,
+  even while it still believes it leads.
+
+The seeded fault sites LEASE_RENEW_LOST and LEASE_CLOCK_SKEW
+(utils/faults.py) drive the two failure seams the module documents."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.server.api import APIServer
+from kubernetes_tpu.utils import faults
+from kubernetes_tpu.utils.lease import (
+    LeaseClient,
+    LeaseElector,
+    LeaseFenceError,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_stats()
+    yield
+    faults.clear()
+    faults.reset_stats()
+
+
+def mk_cluster(identities, lease_duration=5.0, clock=None):
+    api = APIServer()
+    client = Client(LocalTransport(api))
+    clock = clock or FakeClock()
+    return clock, {
+        ident: LeaseClient(
+            client, "kt-sched", ident, lease_duration=lease_duration,
+            clock=clock,
+        )
+        for ident in identities
+    }
+
+
+class TestLeaseMechanics:
+    def test_first_acquire_creates_with_token_one(self):
+        clock, lc = mk_cluster(["a", "b"])
+        assert lc["a"].try_acquire() == 1
+        # A live lease held by a rival is respected.
+        assert lc["b"].try_acquire() is None
+        rec = lc["b"].read()
+        assert (rec.holder, rec.token) == ("a", 1)
+
+    def test_renewal_keeps_token(self):
+        clock, lc = mk_cluster(["a"])
+        assert lc["a"].try_acquire() == 1
+        clock.advance(2.0)
+        assert lc["a"].try_acquire() == 1  # renewal, same epoch
+        assert lc["a"].read().token == 1
+
+    def test_expiry_steal_bumps_token(self):
+        clock, lc = mk_cluster(["a", "b"])
+        assert lc["a"].try_acquire() == 1
+        clock.advance(5.1)  # lease expired on the true clock
+        assert lc["b"].try_acquire() == 2
+        assert lc["a"].held_token() is None  # belief decayed too
+        with pytest.raises(LeaseFenceError):
+            lc["a"].require(1)
+
+    def test_release_allows_immediate_takeover(self):
+        clock, lc = mk_cluster(["a", "b"])
+        assert lc["a"].try_acquire() == 1
+        lc["a"].release()
+        assert lc["b"].try_acquire() == 2  # no expiry wait
+
+    def test_own_lapse_then_reacquire_bumps_token(self):
+        """Re-acquisition after this identity's own lease lapsed is a
+        NEW fencing epoch — work queued under the old token must
+        fence, because a rival may have held in between."""
+        clock, lc = mk_cluster(["a"])
+        assert lc["a"].try_acquire() == 1
+        clock.advance(5.1)
+        assert lc["a"].try_acquire() == 2
+
+
+class TestRenewLostFault:
+    def test_holder_believes_through_window_then_fences(self):
+        """LEASE_RENEW_LOST: the renew CAS vanishes in flight. The
+        holder keeps believing only until the window lapses on its own
+        clock — and once a rival steals, the old token is refused."""
+        clock, lc = mk_cluster(["a", "b"])
+        assert lc["a"].try_acquire() == 1
+        rule = faults.inject(faults.LEASE_RENEW_LOST, every=1)
+        clock.advance(2.0)
+        with pytest.raises(faults.FaultInjected):
+            lc["a"].try_acquire()  # renewal lost in flight
+        assert rule.fired
+        # Belief persists inside the window (never demote early)...
+        assert lc["a"].held_token() == 1
+        clock.advance(3.2)
+        # ...and decays once it lapses (never believe late).
+        assert lc["a"].held_token() is None
+        faults.clear()
+        # The record still says renewed at t0: expired for real now.
+        assert lc["b"].try_acquire() == 2
+        with pytest.raises(LeaseFenceError):
+            lc["a"].require(1)
+        assert lc["b"].validate(2)
+
+
+class TestClockSkewFault:
+    def test_slow_clock_belief_outlives_lease_and_fences(self):
+        """LEASE_CLOCK_SKEW: the holder's clock starts running slow by
+        one lease duration, so it BELIEVES an expired lease is live —
+        the exact scenario the fencing token exists for."""
+        clock, lc = mk_cluster(["a", "b"])
+        assert lc["a"].try_acquire() == 1
+        # Arm AFTER the acquisition: the skew hits the running holder.
+        rule = faults.inject(faults.LEASE_CLOCK_SKEW, every=1, times=1)
+        assert lc["a"].held_token() == 1  # trips the skew on a's clock
+        assert rule.fired
+        clock.advance(5.1)  # truly expired
+        # a still believes: its skewed clock reads inside the window.
+        assert lc["a"].held_token() == 1
+        # b steals the expired lease regardless of a's belief.
+        assert lc["b"].try_acquire() == 2
+        assert lc["a"].held_token() == 1  # STILL believes (stale)
+        # The store is the fencing authority: a's writes are refused.
+        with pytest.raises(LeaseFenceError):
+            lc["a"].require(lc["a"].held_token())
+        assert lc["b"].validate(2)
+
+
+class TestLeaseProperties:
+    """Randomized renew/expire/steal schedules (seeded): global token
+    monotonicity, bump-on-holder-change-only, and at most one
+    validated believer at every step."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_schedules(self, seed):
+        rng = random.Random(seed)
+        idents = ["a", "b", "c"]
+        clock, lc = mk_cluster(idents, lease_duration=5.0)
+        last_token = 0
+        last_holder = None
+        for _step in range(120):
+            actor = rng.choice(idents)
+            action = rng.random()
+            if action < 0.55:
+                got = lc[actor].try_acquire()
+                rec = lc[actor].read()
+                if rec is not None:
+                    # Global monotonicity.
+                    assert rec.token >= last_token
+                    if rec.holder != last_holder:
+                        # Holder change => strict bump. (The same
+                        # holder may ALSO bump — re-acquiring after
+                        # its own lapse is a new fencing epoch.)
+                        assert rec.token > last_token, (
+                            f"seed={seed}: holder {last_holder}->"
+                            f"{rec.holder} without a token bump"
+                        )
+                    last_token, last_holder = rec.token, rec.holder
+                if got is not None:
+                    assert got == lc[actor].read().token
+            elif action < 0.7:
+                lc[actor].release()
+                rec = lc[actor].read()
+                if rec is not None:
+                    last_token = rec.token
+                    if rec.holder == actor:
+                        # Released: renew-time zeroed, holder field
+                        # stale until the next steal.
+                        last_holder = None
+            else:
+                clock.advance(rng.uniform(0.2, 3.0))
+            # At most ONE identity's believed token validates.
+            validated = [
+                i
+                for i in idents
+                if lc[i].validate(lc[i].held_token())
+            ]
+            assert len(validated) <= 1, (
+                f"seed={seed}: two validated holders {validated}"
+            )
+
+    @pytest.mark.parametrize("seed", [10, 11])
+    def test_schedules_with_renew_lost_storm(self, seed):
+        """Same properties with a probabilistic renew-lost fault armed
+        — lost renewals may demote holders early but can never create
+        two validated believers or a token regression."""
+        rng = random.Random(seed)
+        idents = ["a", "b"]
+        clock, lc = mk_cluster(idents, lease_duration=4.0)
+        faults.reset_stats(reseed=seed)
+        faults.inject(faults.LEASE_RENEW_LOST, p=0.4)
+        last_token = 0
+        for _step in range(100):
+            actor = rng.choice(idents)
+            if rng.random() < 0.6:
+                try:
+                    lc[actor].try_acquire()
+                except faults.FaultInjected:
+                    pass
+                rec = lc[actor].read()
+                if rec is not None:
+                    assert rec.token >= last_token
+                    last_token = rec.token
+            else:
+                clock.advance(rng.uniform(0.3, 2.5))
+            validated = [
+                i
+                for i in idents
+                if lc[i].validate(lc[i].held_token())
+            ]
+            assert len(validated) <= 1
+
+
+class TestLeaseElector:
+    def test_single_elector_leads_and_threads_token(self):
+        import time as _time
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        lease = LeaseClient(client, "kt-sched", "a", lease_duration=0.6)
+        seen = []
+        e = LeaseElector(
+            lease, renew_period=0.05, retry_period=0.05,
+            on_elected=seen.append,
+        ).start()
+        try:
+            deadline = _time.monotonic() + 5
+            while _time.monotonic() < deadline and not e.is_leader:
+                _time.sleep(0.01)
+            assert e.is_leader
+            assert seen == [1]
+        finally:
+            e.stop()
+        assert not e.is_leader
+
+    def test_exactly_one_of_many_leads(self):
+        import time as _time
+
+        api = APIServer()
+        client = Client(LocalTransport(api))
+        electors = [
+            LeaseElector(
+                LeaseClient(
+                    client, "kt-sched", f"id{i}", lease_duration=0.6
+                ),
+                renew_period=0.05,
+                retry_period=0.05,
+            ).start()
+            for i in range(3)
+        ]
+        try:
+            deadline = _time.monotonic() + 5
+            while _time.monotonic() < deadline and (
+                sum(e.is_leader for e in electors) != 1
+            ):
+                _time.sleep(0.01)
+            assert sum(e.is_leader for e in electors) == 1
+            _time.sleep(0.3)  # stable
+            assert sum(e.is_leader for e in electors) == 1
+        finally:
+            for e in electors:
+                e.stop()

@@ -730,3 +730,86 @@ def test_autoscaler_metrics_exposed():
     assert "# TYPE autoscaler_scale_events_total counter" in text
     assert 'autoscaler_scale_events_total{direction="up"} 1.0' in text
     assert 'autoscaler_scale_events_total{direction="down"} 1.0' in text
+
+
+def test_lint_metrics_knows_replication_names(tmp_path):
+    """The HA control-plane family (store/replication.py,
+    utils/lease.py, scheduler/standby.py) is known to the linter:
+    leader_elections_total and the activation summary pass the
+    standard rule on their own, the unitless commit-index watermark
+    and follower-lag version count are explicitly allowlisted, and a
+    novel suffix-less replication name still fails (the allowlist
+    names metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, REPLICATION_METRICS
+
+    assert REPLICATION_METRICS == {
+        "replication_commit_index",
+        "replication_follower_lag_versions",
+        "leader_elections_total",
+        "scheduler_standby_activation_seconds",
+    }
+    assert REPLICATION_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge('
+        '"replication_commit_index", "x", ("role",))\n'
+        'B = metrics.DEFAULT.gauge('
+        '"replication_follower_lag_versions", "x", ("follower",))\n'
+        'C = metrics.DEFAULT.counter('
+        '"leader_elections_total", "x", ("tier",))\n'
+        'D = metrics.DEFAULT.summary('
+        '"scheduler_standby_activation_seconds", "x")\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("replication_backlog", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_replication_metrics_exposed():
+    """Exposition golden for the HA control-plane family: commit
+    index renders per role with declared gauge type, the follower-lag
+    gauge escapes hostile follower names (a link name can never
+    corrupt the exposition), and the per-tier election counter
+    renders with declared counter type."""
+    from kubernetes_tpu.store.replication import COMMIT_INDEX, FOLLOWER_LAG
+    from kubernetes_tpu.utils.lease import ELECTIONS
+
+    COMMIT_INDEX.set(42.0, role="leader")
+    COMMIT_INDEX.set(40.0, role="follower:f1")
+    FOLLOWER_LAG.set(2.0, follower='f"1\\x\ny')
+    # The counter is process-global: earlier elector tests in the
+    # suite may have counted real elections already — golden on the
+    # delta, not an absolute.
+    sched_base = ELECTIONS.value(tier="scheduler")
+    kv_base = ELECTIONS.value(tier="kvstore")
+    ELECTIONS.inc(tier="scheduler")
+    ELECTIONS.inc(tier="kvstore")
+    text = metrics.DEFAULT.render()
+    assert "# TYPE replication_commit_index gauge" in text
+    assert 'replication_commit_index{role="leader"} 42.0' in text
+    assert 'replication_commit_index{role="follower:f1"} 40.0' in text
+    assert "# TYPE replication_follower_lag_versions gauge" in text
+    # Label escaping on the follower label.
+    assert (
+        'replication_follower_lag_versions{follower="f\\"1\\\\x\\ny"} 2.0'
+        in text
+    )
+    assert "# TYPE leader_elections_total counter" in text
+    assert (
+        f'leader_elections_total{{tier="scheduler"}} {sched_base + 1.0}'
+        in text
+    )
+    assert (
+        f'leader_elections_total{{tier="kvstore"}} {kv_base + 1.0}' in text
+    )

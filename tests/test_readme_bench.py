@@ -83,3 +83,26 @@ def test_rebalance_row_renders_from_figure_keys():
     assert "post-defrag slice probe bound" in row, row
     block = urb.render("BENCH_test.json", {"pod_crud_ops_per_sec": 100.0})
     assert "Rebalancing plane" not in block
+
+
+def test_failover_row_renders_from_figure_keys():
+    """ISSUE 19: artifacts carrying the HA failover drill keys get a
+    table row with the kill-to-first-bind p50/p99 and the SLO verdict;
+    absent keys omit the row."""
+    from tools import update_readme_bench as urb
+
+    block = urb.render("BENCH_test.json", {
+        "failover_to_first_bind_p50_s": 0.0105,
+        "failover_to_first_bind_p99_s": 0.0156,
+        "failover_rounds": 5,
+        "failover_slo_target_s": 1.0,
+        "failover_slo": "pass",
+    })
+    (row,) = [
+        line for line in block.splitlines() if "HA failover" in line
+    ]
+    assert "5 drills" in row, row
+    assert "10 / **16 ms**" in row, row
+    assert "1 s SLO **pass**" in row, row
+    block = urb.render("BENCH_test.json", {"pod_crud_ops_per_sec": 100.0})
+    assert "HA failover" not in block

@@ -1,0 +1,211 @@
+"""Warm-standby scheduler + lease-elected HA (scheduler/standby.py).
+
+The failover contract: the standby's informers run hot and its
+SolverSession is prewarmed, so activation is just daemon.start() —
+the first tick drains the accumulated watch deltas and binds the
+backlog. A deposed leader is killed abruptly (stale fencing token)
+and rebuilds a fresh standby."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import Client, LocalTransport
+from kubernetes_tpu.client.rest import HTTPTransport
+from kubernetes_tpu.scheduler.standby import (
+    HAScheduler,
+    WarmStandbyScheduler,
+)
+from kubernetes_tpu.server.api import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+
+def wait_until(cond, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def node_wire(name, cpu="8", mem="16Gi"):
+    return {
+        "kind": "Node",
+        "metadata": {"name": name},
+        "status": {
+            "capacity": {"cpu": cpu, "memory": mem, "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def pod_wire(name, cpu="100m", mem="64Mi"):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "pause",
+                    "resources": {"limits": {"cpu": cpu, "memory": mem}},
+                }
+            ]
+        },
+    }
+
+
+def bound_names(client):
+    pods, _ = client.list("pods", namespace="default")
+    return {p.metadata.name for p in pods if p.spec.node_name}
+
+
+class TestWarmStandby:
+    def test_prewarm_accumulates_deltas_then_activates(self):
+        api = APIServer()
+        c = Client(LocalTransport(api))
+        for i in range(3):
+            c.create("nodes", node_wire(f"n{i}"))
+        sb = WarmStandbyScheduler(c, sync_timeout=30)
+        try:
+            sb.prewarm()
+            assert sb.warm and not sb.active
+            # Deltas arriving while warm queue in the daemon; nothing
+            # binds yet (the solve loop is not running).
+            c.create("pods", pod_wire("queued"))
+            time.sleep(0.3)
+            assert bound_names(c) == set()
+            # Activation drains the backlog on the first tick.
+            sb.activate()
+            assert sb.active
+            assert wait_until(lambda: "queued" in bound_names(c))
+            # Live deltas keep flowing after activation.
+            c.create("pods", pod_wire("live"))
+            assert wait_until(lambda: "live" in bound_names(c))
+        finally:
+            sb.stop()
+
+    def test_activate_is_idempotent_and_auto_prewarms(self):
+        api = APIServer()
+        c = Client(LocalTransport(api))
+        c.create("nodes", node_wire("n0"))
+        sb = WarmStandbyScheduler(c, sync_timeout=30)
+        try:
+            d1 = sb.activate()  # cold activate: prewarms internally
+            d2 = sb.activate()
+            assert d1 is d2
+            assert sb.warm and sb.active
+        finally:
+            sb.stop()
+
+
+class TestHAScheduler:
+    def _cluster(self):
+        api = APIServer()
+        srv = APIHTTPServer(api).start()
+
+        def client():
+            return Client(HTTPTransport(srv.address))
+
+        c = client()
+        for i in range(4):
+            c.create("nodes", node_wire(f"n{i}"))
+        return srv, client, c
+
+    def _ha(self, client_factory, name):
+        return HAScheduler(
+            client_factory(),
+            name,
+            lease_duration=0.6,
+            renew_period=0.1,
+            retry_period=0.1,
+            standby_factory=lambda: WarmStandbyScheduler(
+                client_factory(), sync_timeout=30
+            ),
+        )
+
+    def test_failover_activates_warm_standby_fast(self):
+        """Kill the scheduler leader; the rival's PREWARMED standby
+        takes the lease and its first bind lands — the e2e shape
+        behind the failover_to_first_bind_s SLO (the strict 1 s gate
+        is bench/check's; tier-1 asserts the path, generously)."""
+        srv, client_factory, c = self._cluster()
+        ha = []
+        try:
+            ha = [self._ha(client_factory, n) for n in ("alpha", "beta")]
+            for h in ha:
+                h.start()
+            assert wait_until(
+                lambda: sum(h.is_leader for h in ha) == 1, timeout=60
+            )
+            leader = next(h for h in ha if h.is_leader)
+            standby = next(h for h in ha if h is not leader)
+            # The standby is warm (informers hot, session prewarmed)
+            # while NOT leading.
+            assert wait_until(
+                lambda: standby.standby is not None and standby.standby.warm
+            )
+            assert standby.daemon is None
+            c.create("pods", pod_wire("before"))
+            assert wait_until(lambda: "before" in bound_names(c))
+            # Crash the leader: daemon dies AND renewals stop, with no
+            # graceful abdication — the lease must expire on its own.
+            leader.elector._stop.set()
+            leader.standby.kill()
+            killed = time.monotonic()
+            assert wait_until(lambda: standby.is_leader, timeout=30), (
+                "standby never took the lease"
+            )
+            c.create("pods", pod_wire("after"))
+            assert wait_until(
+                lambda: "after" in bound_names(c), timeout=30
+            ), "standby never bound after takeover"
+            # Loose e2e bound: lease expiry (~0.6s) + retry + first
+            # tick. The warm path must not pay a LIST or session build.
+            assert time.monotonic() - killed < 15.0
+            # Fencing epochs advanced across the takeover.
+            assert standby.token > 1 or leader.token is None
+        finally:
+            for h in ha:
+                try:
+                    h.stop()
+                except Exception:
+                    pass
+            srv.stop()
+
+    def test_deposed_leader_rebuilds_warm_standby(self):
+        """A deposed leader kills its daemon and re-enters the
+        election warm (fresh standby), ready to take over again."""
+        srv, client_factory, c = self._cluster()
+        ha = None
+        rival = None
+        try:
+            ha = self._ha(client_factory, "alpha").start()
+            assert wait_until(lambda: ha.is_leader, timeout=60)
+            first_sb = ha.standby
+            # A rival steals the lease while alpha is wedged (simulate
+            # by pausing alpha's renewals past the lease window).
+            ha.elector._stop.set()
+            ha.elector._thread.join(timeout=10)
+            rival = self._ha(client_factory, "beta").start()
+            assert wait_until(lambda: rival.is_leader, timeout=30)
+            # Alpha notices on its next acquire attempt... its elector
+            # is stopped, so drive the deposition directly (the
+            # callback path the elector thread would take).
+            ha._deposed()
+            assert ha.token is None
+            assert wait_until(
+                lambda: ha.standby is not None
+                and ha.standby is not first_sb
+                and ha.standby.warm
+            ), "deposed leader never rebuilt a warm standby"
+            assert not ha.standby.active
+        finally:
+            for h in (ha, rival):
+                if h is not None:
+                    try:
+                        h.stop()
+                    except Exception:
+                        pass
+            srv.stop()

@@ -881,6 +881,157 @@ if [ $rc -eq 0 ]; then
     rc=$reb_rc
 fi
 
+# Failover smoke (ISSUE 19): the HA control plane end to end, both
+# tiers, over the real HTTP planes. Tier 1 — a 3-replica kvstore
+# (leader + two WAL-shipped followers); kill -9 the leader (store
+# crash, HTTP down), promote a follower, and the multi-endpoint client
+# rotates onto it and keeps writing. Tier 2 — abrupt scheduler kill
+# with a PREWARMED warm standby; kill -> first bind must land inside
+# the failover_to_first_bind_s gate (1 s, utils/slo.py). Then `ktctl
+# slo` over the survivor exits 0.
+echo "== failover smoke (HA control plane: kvstore promote + warm standby) =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client
+from kubernetes_tpu.client.rest import HTTPTransport
+from kubernetes_tpu.scheduler.standby import WarmStandbyScheduler
+from kubernetes_tpu.server.api import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+from kubernetes_tpu.store.kvstore import KVStore
+from kubernetes_tpu.store.replication import (
+    FollowerReplica, HTTPLink, ReplicationHub,
+)
+from kubernetes_tpu.utils import slo as _slo
+
+
+def wait(cond, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def node_wire(j):
+    return {
+        "kind": "Node", "metadata": {"name": f"n{j}"},
+        "status": {
+            "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def pod_wire(name):
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{
+            "name": "c", "image": "pause",
+            "resources": {"limits": {"cpu": "100m", "memory": "64Mi"}},
+        }]},
+    }
+
+
+# Tier 1 — replicated kvstore over the HTTP replication plane.
+leader_store = KVStore()
+leader_api = APIServer(store=leader_store)
+leader_http = APIHTTPServer(leader_api).start()
+hub = ReplicationHub(leader_store).attach()
+leader_api.replication = hub
+followers = []
+for fname in ("f1", "f2"):
+    rep = FollowerReplica(name=fname)
+    fapi = APIServer(store=rep.store)
+    fapi.replication = rep
+    fapi.leader_url = leader_http.address
+    fhttp = APIHTTPServer(fapi).start()
+    hub.add_follower(HTTPLink(fhttp.address, name=fname))
+    followers.append((rep, fapi, fhttp))
+
+# One client, both endpoints: pins to the leader until it dies.
+client = Client(HTTPTransport(
+    [leader_http.address, followers[0][2].address]
+))
+for j in range(6):
+    client.create("nodes", node_wire(j))
+client.create("pods", pod_wire("pre-crash"))
+assert wait(lambda: hub.status()["commitIndex"] == leader_store.version), (
+    "followers never reached the leader's commit index"
+)
+
+# kill -9 the kvstore leader: HTTP down, store crashed, hub gone.
+leader_http.stop(release_store=False)
+leader_store.crash()
+hub.stop()
+rep1, f1_api, f1_http = followers[0]
+promoted = rep1.promote()
+assert promoted.version >= 0
+h = json.loads(urllib.request.urlopen(f1_http.address + "/healthz").read())
+assert h["checks"]["replication"]["role"] == "leader", h
+
+# The same client rotates onto the promoted follower: the committed
+# prefix is all there, and writes land locally (no forwarding).
+assert wait(lambda: any(
+    p.metadata.name == "pre-crash"
+    for p in client.list("pods", namespace="default")[0]
+)), "committed pre-crash write lost across promotion"
+client.create("pods", pod_wire("post-promote"))
+assert client.get(
+    "pods", "post-promote", namespace="default"
+).metadata.name == "post-promote"
+
+# Tier 2 — scheduler failover on the surviving replica. Warm the
+# solve path first (bucket compile); then the drill.
+active = WarmStandbyScheduler(
+    Client(HTTPTransport(f1_http.address)), sync_timeout=60.0
+)
+active.activate()
+assert wait(lambda: client.get(
+    "pods", "post-promote", namespace="default"
+).spec.node_name), "warmup pod never bound"
+standby = WarmStandbyScheduler(
+    Client(HTTPTransport(f1_http.address)), sync_timeout=60.0
+)
+standby.prewarm()
+active.kill()
+t0 = time.monotonic()
+client.create("pods", pod_wire("takeover"))
+standby.activate()
+assert wait(lambda: client.get(
+    "pods", "takeover", namespace="default"
+).spec.node_name, timeout=30.0), "standby never bound after takeover"
+bind_s = time.monotonic() - t0
+obj = _slo.BENCH_OBJECTIVES["failover_to_first_bind_s"]
+assert _slo.verdict_for_value(obj, bind_s) == "pass", (
+    f"failover first bind {bind_s:.3f}s breaches the {obj.target:.0f}s gate"
+)
+
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["slo"], client=client)
+assert rc == 0, out.getvalue()
+
+standby.stop()
+for _, _, fhttp in followers:
+    fhttp.stop()
+print(f"failover smoke OK: kvstore leader killed -> follower promoted, "
+      f"client rotated, committed prefix intact; scheduler killed -> "
+      f"warm standby first bind {bind_s * 1000:.0f} ms "
+      f"(gate {obj.target:.0f} s); ktctl slo rc 0")
+EOF
+fo_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$fo_rc
+fi
+
 # Soak smoke (ISSUE 15): ~200 hollow nodes (real kubelets, no-op
 # runtime) driving the full API→solve→bind→kubelet loop while the
 # seeded chaos schedule fires ONE apiserver kill -9 (torn WAL write →
@@ -891,11 +1042,15 @@ fi
 # _after lands in the artifact's capacity_timeline). Gate: the
 # invariant checker comes back green — replay consistency, bind
 # immutability, gang all-or-nothing, exactly-one-DELETED, nominations
-# recovered, move journal drained, SLO series advancing. Artifact in
-# /tmp/soak_smoke.json for dashboards.
-echo "== soak smoke (chaos + rebalance plane, ~90s) =="
+# recovered, move journal drained, SLO series advancing. The
+# leader_kill_each_tier epoch (ISSUE 19) additionally kills the
+# kvstore leader (WAL-shipped follower promotes, byte-identical
+# committed prefix) and the scheduler leader (warm standby activates;
+# kill -> first bind lands in the artifact's failover_to_first_bind_s
+# series). Artifact in /tmp/soak_smoke.json for dashboards.
+echo "== soak smoke (chaos + rebalance + HA plane, ~2min) =="
 env JAX_PLATFORMS=cpu python -m tools.soak --nodes 200 --seed 7 \
-    --epochs baseline,apiserver_restart,daemon_restart_mid_gang,defrag_churn,final \
+    --epochs baseline,apiserver_restart,daemon_restart_mid_gang,defrag_churn,leader_kill_each_tier,final \
     --out /tmp/soak_smoke.json
 soak_rc=$?
 if [ $rc -eq 0 ]; then

@@ -163,6 +163,22 @@ AUTOSCALER_METRICS = {
 }
 ALLOWLIST |= AUTOSCALER_METRICS
 
+#: HA control-plane family (store/replication.py, utils/lease.py,
+#: scheduler/standby.py — see docs/architecture.md "HA control
+#: plane"). leader_elections_total and the standby activation summary
+#: carry standard suffixes on their own; replication_commit_index is a
+#: store-version watermark and replication_follower_lag_versions a
+#: count of store versions (like watch_fanout_lag_versions) — both
+#: unit-less by nature and allowlisted explicitly so the linter
+#: documents the whole family rather than silently tolerating it.
+REPLICATION_METRICS = {
+    "replication_commit_index",
+    "replication_follower_lag_versions",
+    "leader_elections_total",
+    "scheduler_standby_activation_seconds",
+}
+ALLOWLIST |= REPLICATION_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"

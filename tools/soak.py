@@ -76,8 +76,14 @@ from kubernetes_tpu.scheduler.daemon import (
     IncrementalBatchScheduler,
     SchedulerConfig,
 )
+from kubernetes_tpu.scheduler.standby import WarmStandbyScheduler
 from kubernetes_tpu.server.api import APIError, APIServer
 from kubernetes_tpu.store.kvstore import KVStore
+from kubernetes_tpu.store.replication import (
+    FollowerReplica,
+    LocalLink,
+    ReplicationHub,
+)
 from kubernetes_tpu.utils import capacity as capmod
 from kubernetes_tpu.utils import faults, sli, tracing
 
@@ -94,6 +100,7 @@ EPOCHS = (
     "defrag_churn",
     "defrag_daemon_crash",
     "pool_elastic",
+    "leader_kill_each_tier",
     "final",
 )
 
@@ -774,6 +781,7 @@ class ChurnDriver:
         self.client = cluster.client()
         self.bind_latencies: List[float] = []
         self.rebalance_log: List[dict] = []
+        self.failover_bind_s: List[float] = []
         self._serial = 0
 
     # -- fault-tolerant verbs -----------------------------------------
@@ -1002,6 +1010,11 @@ def build_schedule(
                     "every": 2,
                     "times": 1,
                 }
+        elif name == "leader_kill_each_tier":
+            # HA failover drill: kvstore leader crash → follower
+            # promotion, then scheduler leader kill → warm-standby
+            # activation. Process-level moves, no armed fault rule.
+            entry["trickle_pods"] = max(4, wave // 32)
         elif name == "pool_elastic":
             # Backlog no base node can hold (6000m > the fleet's 4000m
             # nodes); only grown 8000m pool nodes fit it. After the
@@ -1150,6 +1163,7 @@ def run_soak(
         "post_fault_bind_p99_s": _p(0.99, post_slice),
         "capacity_timeline": checker.capacity_timeline,
         "rebalance_cycles": driver.rebalance_log,
+        "failover_to_first_bind_s": driver.failover_bind_s,
         "invariant_violations": checker.violations,
         "wall_s": round(time.monotonic() - t_start, 1),
     }
@@ -1259,7 +1273,101 @@ def _run_epoch(cluster: SoakCluster, driver: ChurnDriver, entry: dict):
         return _run_defrag_epoch(cluster, driver, entry, crash=True)
     if name == "pool_elastic":
         return _run_pool_epoch(cluster, driver, entry)
+    if name == "leader_kill_each_tier":
+        return _run_leader_kill_epoch(cluster, driver, entry)
     raise ValueError(f"unknown epoch {name!r}")
+
+
+def _run_leader_kill_epoch(
+    cluster: SoakCluster, driver: ChurnDriver, entry: dict
+) -> List[str]:
+    """Kill the leader of EACH HA control-plane tier, mid-churn.
+
+    Tier 1 (kvstore): a ReplicationHub forms a leader+follower pair
+    around the live store (write acks gated on the follower's journal
+    — quorum of 2), the leader crashes mid-wave, and the PROMOTED
+    follower — serving exactly the committed prefix — backs a fresh
+    APIServer. Every acked write must survive; the replay-consistency
+    invariant re-verifies after the epoch.
+
+    Tier 2 (scheduler): a WarmStandbyScheduler prewarms against the
+    live cluster (informers hot, SolverSession device-resident), the
+    active daemon is killed abruptly, a trickle of pods lands with NO
+    scheduler running, and the standby's activation must bind them —
+    the kill→first-bind wall time is the artifact's
+    failover_to_first_bind_s sample."""
+    name = entry["epoch"]
+    prefix = driver.next_prefix(name)
+    wave = entry["wave_pods"]
+
+    # ---- tier 1: kvstore leader ------------------------------------
+    follower = FollowerReplica(store=KVStore(), name="soak-standby")
+    hub = ReplicationHub(cluster.store, name="soak-leader").attach()
+    hub.add_follower(LocalLink(follower, "soak-standby"))
+    names = [f"{prefix}-kv-{i}" for i in range(wave)]
+    wires = [_pod_wire(n) for n in names]
+    half = wave // 2
+    driver.create_pods(wires[:half], tolerate=True)
+    # Crash the leader mid-wave. A real crash never stops the hub
+    # cleanly — crash first (in-flight writers die with the store),
+    # then retire the shippers so nothing parks on a dead quorum.
+    cluster.restarts["apiserver"] += 1
+    old, cluster.api = cluster.store, None
+    try:
+        old.crash()
+    except Exception:
+        pass
+    hub.stop()
+    promoted = follower.promote()
+    cluster.store = promoted
+    cluster.api = APIServer(store=promoted)
+    # The second half of the wave lands on the promoted store; the
+    # first half reconciles (unacked creates may have died with the
+    # old leader — acked ones MUST be in the promoted store already).
+    driver.create_pods(wires[half:], tolerate=True)
+    driver.reconcile_missing(wires)
+    unbound = driver.wait_bound(names, 240.0)
+
+    # ---- tier 2: scheduler leader ----------------------------------
+    standby = WarmStandbyScheduler(cluster.client(), sync_timeout=120.0)
+    standby.prewarm()
+    # Abrupt kill: queued commits dropped, no flush, no abdication.
+    cluster.restarts["scheduler"] += 1
+    sched, cfg = cluster.scheduler, cluster.scheduler_config
+    cluster.scheduler = None
+    cluster.scheduler_config = None
+    t_kill = time.monotonic()
+    if sched is not None:
+        sched.kill()
+    if cfg is not None:
+        try:
+            cfg.stop()
+        except Exception:
+            pass
+    # Trickled pods land with no scheduler alive...
+    trickle = [f"{prefix}-fo-{i}" for i in range(entry["trickle_pods"])]
+    driver.create_pods([_pod_wire(n) for n in trickle], tolerate=True)
+    # ...then the warm standby activates and its first tick drains
+    # the accumulated deltas.
+    standby.activate()
+    first_bound = _wait_until(
+        lambda: any(
+            driver.mirror.bound_node(f"default/{n}") for n in trickle
+        ),
+        timeout=120.0,
+    )
+    if first_bound:
+        driver.failover_bind_s.append(
+            round(time.monotonic() - t_kill, 4)
+        )
+    unbound += driver.wait_bound(trickle, 120.0)
+    # The standby IS the scheduler now: hand its daemon/config to the
+    # cluster so later epochs and stop() manage the live pair.
+    cluster.scheduler = standby.daemon
+    cluster.scheduler_config = standby.config
+    driver.delete_pods(trickle)
+    driver.delete_pods(names)
+    return unbound
 
 
 def _run_defrag_epoch(
