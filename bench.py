@@ -898,6 +898,53 @@ def _soak_figure(n_nodes: int = 64, seed: int = 7) -> dict:
     }
 
 
+def _alerts_overhead_figure() -> dict:
+    """ISSUE 20: the bulk-churn drill re-run with the health plane
+    LIVE — retention sampler snapshotting every registry series plus
+    the burn-rate alert engine evaluating every rule as a sampler
+    hook, at a cadence 10x production (0.5s vs 5s). The figure is the
+    plane's own measured cost over the drill's wall, gated at <5%:
+    ``timeseries_sample_seconds`` times the whole sweep INCLUDING
+    hooks, so the fraction covers retention + evaluation together."""
+    import time as _time
+
+    from kubernetes_tpu.utils import alerts as _alerts
+    from kubernetes_tpu.utils import timeseries as _ts
+
+    def sample_wall() -> float:
+        return sum(
+            s for (_c, s, _b) in _ts.SAMPLE_SECONDS.snapshot().values()
+        )
+
+    interval_s = 0.5
+    _alerts.ensure_started(interval_s=interval_s)
+    wall0 = sample_wall()
+    trans0 = len(_alerts.DEFAULT.transitions())
+    t0 = _time.monotonic()
+    try:
+        fig = _bulk_churn_figure()
+    finally:
+        _ts.SAMPLER.stop()
+    drill_wall = max(_time.monotonic() - t0, 1e-9)
+    overhead = (sample_wall() - wall0) / drill_wall
+    snap = _alerts.DEFAULT.snapshot()
+    fig["alerts"] = {
+        "rules_evaluated": len(_alerts.DEFAULT.rules),
+        "evaluations": snap["evaluations"],
+        "firing": snap["firing"],
+        "transitions": len(_alerts.DEFAULT.transitions()) - trans0,
+        "sampler_interval_s": interval_s,
+        "retained_series": int(_ts.RETAINED.value()),
+        "sampler_overhead_fraction": round(overhead, 5),
+        "overhead_gate_fraction": 0.05,
+        # The acceptance gate: the health plane must cost <5% of the
+        # drill it observes (at 10x the production cadence, so the
+        # production fraction is ~an order of magnitude lower still).
+        "overhead_ok": overhead < 0.05,
+    }
+    return fig
+
+
 def _failover_figure(n_nodes: int = 8, rounds: int = 5) -> dict:
     """ISSUE 19: the failover drill behind failover_to_first_bind_s —
     with a pod already trickled in, kill the active scheduler abruptly,
@@ -1872,8 +1919,10 @@ def main() -> None:
         record.update(_crud_figure(n_workers=2, n_tasks=20))
         # API-plane ingestion through the bulk fast path (ISSUE 6
         # headline: one WAL group commit per batch, watch-cache reads,
-        # byte-counted watch visibility).
-        record.update(_bulk_churn_figure())
+        # byte-counted watch visibility) — run with the health plane
+        # live so record["alerts"] carries the sampler+engine overhead
+        # fraction against its <5% gate (ISSUE 20).
+        record.update(_alerts_overhead_figure())
         # The headline metric's second half (VERDICT r4 #1): churn +
         # p99 pod-to-bind latency through the REAL HTTP control plane.
         record.update(

@@ -756,6 +756,8 @@ def cmd_top(client: Client, args) -> int:
         return _cmd_top_cluster(client, args)
     if args.what == "capacity":
         return _cmd_top_capacity(client, args)
+    if args.what == "health":
+        return _cmd_top_health(client, args)
     nodes, _ = client.list("nodes")
     node_util = {}
     if args.what == "nodes":
@@ -1474,6 +1476,159 @@ def _fetch_rebalance_report(client: Client, args) -> Dict:
     return rebalance.DEFAULT.snapshot()
 
 
+def _fetch_alert_report(client: Client, args) -> Dict:
+    """The alert report: GET /debug/alerts over HTTP transports, or
+    the process-local engine for injected LocalTransport clients
+    (utils/alerts keeps jax off its import path — same split as the
+    slo/capacity fetches above)."""
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        return get_json("/debug/alerts")
+    from kubernetes_tpu.utils import alerts
+
+    return alerts.DEFAULT.snapshot()
+
+
+def cmd_alerts(client: Client, args) -> int:
+    """`ktctl alerts` — the burn-rate alerting plane: one row per
+    declarative rule with its multi-window multi-burn-rate state
+    (inactive/pending/firing/resolved), the observed value against the
+    threshold, and the recent transition log (GET /debug/alerts).
+    Exits 1 with 'no alert evaluations recorded' until the retention
+    sampler has fed the engine at least one evaluation pass (the
+    trace/explain/slo miss contract)."""
+    report = _fetch_alert_report(client, args)
+    if not report.get("sampled"):
+        # Clean nonzero exit, empty stdout: a script gating on alerts
+        # must see that nothing was evaluated, not a hollow all-clear.
+        print("no alert evaluations recorded", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(report, default_flow_style=False))
+        return 0
+
+    def num(v):
+        return "-" if v is None else f"{v:.4g}"
+
+    print(
+        f"{'RULE':26}{'SERIES':34}{'SEVERITY':9}{'STATE':10}"
+        f"{'VALUE':>9}{'THRESHOLD':>10}{'SINCE':>8}"
+    )
+    for r in report.get("rules", ()):
+        since = r.get("sinceS")
+        print(
+            f"{r.get('name', ''):26}{r.get('series', ''):34}"
+            f"{r.get('severity', ''):9}{r.get('state', ''):10}"
+            f"{num(r.get('value')):>9}{num(r.get('threshold')):>10}"
+            f"{'-' if since is None else f'{since:.0f}s':>8}"
+        )
+    firing = report.get("firing", ())
+    print(f"firing: {len(firing)}" + (f" ({' '.join(firing)})" if firing else ""))
+    transitions = report.get("transitions", ())
+    if transitions:
+        print()
+        print("RECENT TRANSITIONS")
+        for t in transitions[-args.limit:]:
+            print(
+                f"  {t.get('rule', ''):26}{t.get('from', ''):>9} -> "
+                f"{t.get('to', ''):9}value={num(t.get('value'))}"
+            )
+    return 0
+
+
+def _fetch_health_rollup(client: Client, args) -> Dict:
+    """The HA-aware health rollup: GET /debug/health over HTTP
+    transports. For injected LocalTransport clients the server-side
+    components (healthz subchecks, replication, leases) have no
+    process-local equivalent, so the rollup degrades to the two
+    process-global planes — SLO verdicts and alert state."""
+    transport = client.t
+    get_json = getattr(transport, "get_json", None)
+    if get_json is not None:
+        return get_json("/debug/health")
+    from kubernetes_tpu.utils import alerts, slo
+
+    slo_report = slo.evaluate()
+    alert_snap = alerts.DEFAULT.snapshot()
+    slo_verdict = slo_report.get("verdict", "no_data")
+    components = {
+        "slo": {
+            "verdict": "pass" if slo_verdict == "no_data" else slo_verdict,
+            "sampled": bool(slo_report.get("sampled")),
+            "objectivesBurning": [
+                o["name"] for o in slo_report.get("objectives", ())
+                if o.get("verdict") == "burn"
+            ],
+        },
+        "alerts": {
+            "verdict": "burn" if any(
+                r.get("state") == "firing" and r.get("severity") == "page"
+                for r in alert_snap.get("rules", ())
+            ) else ("warn" if alert_snap.get("firing") else "pass"),
+            "status": "firing" if alert_snap.get("firing") else "ok",
+            "firing": list(alert_snap.get("firing", ())),
+        },
+    }
+    return {
+        "kind": "HealthRollup",
+        "verdict": slo.worst(*[c["verdict"] for c in components.values()]),
+        "sampled": bool(slo_report.get("sampled")) or bool(alert_snap.get("sampled")),
+        "components": components,
+    }
+
+
+def _cmd_top_health(client: Client, args) -> int:
+    """`ktctl top health` — the HA-aware health rollup: one verdict
+    per control-plane component (apiserver subchecks, replication,
+    leases, SLO plane, alert plane) folded into an overall cluster
+    verdict (GET /debug/health). Exits 1 with 'no health samples
+    recorded' until either the SLO or alert plane has measured
+    anything (the trace/explain/slo miss contract)."""
+    report = _fetch_health_rollup(client, args)
+    if not report.get("sampled"):
+        # Clean nonzero exit, empty stdout: a script gating on health
+        # must see that nothing was measured, not a hollow green board.
+        print("no health samples recorded", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(report, indent=2))
+        return 0
+    if args.output == "yaml":
+        print(yaml.safe_dump(report, default_flow_style=False))
+        return 0
+    print(f"overall: {report.get('verdict', 'no_data')}")
+    print()
+    print(f"{'COMPONENT':16}{'VERDICT':9}DETAIL")
+    for name, comp in sorted(report.get("components", {}).items()):
+        detail = ""
+        if name == "replication":
+            lag = comp.get("maxFollowerLag")
+            detail = (
+                f"role={comp.get('role', '')}"
+                + (f" max-follower-lag={lag}" if lag is not None else "")
+            )
+        elif name == "leases":
+            stale = [r["name"] for r in comp.get("records", ()) if r.get("stale")]
+            detail = (
+                f"tracked={len(comp.get('records', ()))}"
+                + (f" stale={','.join(stale)}" if stale else "")
+            )
+        elif name == "slo":
+            burning = comp.get("objectivesBurning", ())
+            detail = f"burning={','.join(burning)}" if burning else "all objectives ok"
+        elif name == "alerts":
+            firing = comp.get("firing", ())
+            detail = f"firing={','.join(firing)}" if firing else "no alerts firing"
+        elif comp.get("status"):
+            detail = str(comp["status"])
+        print(f"{name:16}{comp.get('verdict', ''):9}{detail}")
+    return 0
+
+
 def cmd_rebalance(client: Client, args) -> int:
     """`ktctl rebalance plan|status` — the rebalancing plane: the
     descheduler's last defrag plan (per-move table) or its cycle
@@ -1778,11 +1933,18 @@ def build_parser() -> argparse.ArgumentParser:
     ee.set_defaults(fn=cmd_exec)
 
     tp = sub.add_parser("top", parents=[common])
-    tp.add_argument("what", choices=["nodes", "pods", "cluster", "capacity"])
+    tp.add_argument(
+        "what", choices=["nodes", "pods", "cluster", "capacity", "health"]
+    )
     tp.set_defaults(fn=cmd_top)
 
     sl = sub.add_parser("slo", parents=[common])
     sl.set_defaults(fn=cmd_slo)
+
+    al = sub.add_parser("alerts", parents=[common])
+    al.add_argument("--limit", type=int, default=16,
+                    help="transitions to show, newest last")
+    al.set_defaults(fn=cmd_alerts)
 
     rb = sub.add_parser("rebalance", parents=[common])
     rb.add_argument("what", nargs="?", default="status",

@@ -244,10 +244,19 @@ def start_apiserver(args):
 def apiserver_main(argv: Optional[List[str]] = None) -> int:
     args = apiserver_parser().parse_args(argv)
     srv = start_apiserver(args)
+    # Health plane (retention sampler + alert engine) lives in the
+    # apiserver process for the daemon topology — /debug/alerts,
+    # /debug/timeseries and /debug/health read it process-locally.
+    # KT_TIMESERIES=0 opts a deployment out.
+    from kubernetes_tpu.client import Client, LocalTransport
+    from kubernetes_tpu.utils import alerts, timeseries
+
+    alerts.ensure_started(client=Client(LocalTransport(srv.api)))
     print(f"apiserver listening on {srv.address}")
     try:
         _wait_forever()
     finally:
+        timeseries.SAMPLER.stop()
         srv.stop()
     return 0
 

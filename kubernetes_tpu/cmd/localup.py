@@ -257,6 +257,14 @@ class LocalCluster:
         self.api.register_component(
             "controller-manager", self._manager_health
         )
+        # Health plane: retention sampler + burn-rate alert engine
+        # (utils/alerts wires the sampler hook; alert state transitions
+        # surface as cluster Events through this client). Honors
+        # KT_TIMESERIES=0 for processes that must not grow a sampler
+        # thread.
+        from kubernetes_tpu.utils import alerts
+
+        alerts.ensure_started(client=self._client())
         return self
 
     @staticmethod
@@ -301,6 +309,12 @@ class LocalCluster:
     def stop(self) -> None:
         import shutil
 
+        from kubernetes_tpu.utils import timeseries
+
+        # The sampler is module-global (one per process, like the
+        # metrics registry); local-up owns the process, so tearing the
+        # cluster down stops it — tests must not leak the thread.
+        timeseries.SAMPLER.stop()
         if getattr(self, "dns", None) is not None:
             self.dns.stop()
         if getattr(self, "proxy", None) is not None:

@@ -318,6 +318,44 @@ class _Handler(BaseHTTPRequestHandler):
                 raise APIError(503, "ServiceUnavailable", str(e))
             self._send_text(200, json.dumps(info), "application/json")
             return
+        if rest == ("alerts",):
+            # The burn-rate alert engine (utils/alerts.py): per-rule
+            # state machine snapshot + recent transitions — `ktctl
+            # alerts`' data source. sampled:false until the health
+            # plane evaluated at least once over a sampled retention
+            # store (the ktctl miss contract keys on it).
+            from kubernetes_tpu.utils import alerts
+
+            self._send_text(
+                200, json.dumps(alerts.DEFAULT.snapshot()),
+                "application/json",
+            )
+            return
+        if rest == ("timeseries",):
+            # The retention plane (utils/timeseries.py): series
+            # inventory, or — with ?series= — windowed figures
+            # (rate/increase/delta/quantiles) per label set over
+            # ?window= seconds.
+            from kubernetes_tpu.utils import timeseries
+
+            try:
+                window_s = float(self.query.get("window", "300"))
+            except ValueError:
+                raise APIError(400, "BadRequest", "window must be numeric")
+            self._send_text(
+                200,
+                json.dumps(
+                    timeseries.DEFAULT.snapshot(
+                        series=self.query.get("series", ""),
+                        window_s=window_s,
+                    )
+                ),
+                "application/json",
+            )
+            return
+        if rest == ("health",):
+            self._serve_debug_health()
+            return
         if rest == ("requests",):
             body = debug.DEFAULT_REQUEST_LOG.render()
         elif rest == ("stacks",):
@@ -339,16 +377,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "debug endpoints: /debug/requests /debug/stacks "
                 "/debug/profile /debug/traces /debug/decisions "
                 "/debug/solves /debug/slo /debug/kernels "
-                "/debug/capacity /debug/rebalance /debug/device-profile",
+                "/debug/capacity /debug/rebalance /debug/device-profile "
+                "/debug/alerts /debug/timeseries /debug/health",
             )
         self._send_text(200, body, "text/plain; charset=utf-8")
 
-    def _serve_healthz(self) -> None:
-        """/healthz with JSON subchecks (kvstore, watch hub, flight
-        recorder), 200 only when every check passes — the reference's
-        bare "ok" told an operator nothing about WHICH dependency was
-        sick. Stays ahead of the auth chain like the plain probe did
-        (load balancers and kubelets probe unauthenticated)."""
+    def _health_checks(self) -> dict:
+        """The /healthz subcheck dict (kvstore, watch hub, replication,
+        flight recorder) — also the component half of the /debug/health
+        rollup, so the probe and the rollup can never disagree about a
+        dependency's state."""
         from kubernetes_tpu.utils import flightrecorder
 
         checks = {}
@@ -421,6 +459,15 @@ class _Handler(BaseHTTPRequestHandler):
             checks["flightRecorder"] = {
                 "status": "unhealthy", "message": str(e),
             }
+        return checks
+
+    def _serve_healthz(self) -> None:
+        """/healthz with JSON subchecks (kvstore, watch hub, flight
+        recorder), 200 only when every check passes — the reference's
+        bare "ok" told an operator nothing about WHICH dependency was
+        sick. Stays ahead of the auth chain like the plain probe did
+        (load balancers and kubelets probe unauthenticated)."""
+        checks = self._health_checks()
         healthy = all(c.get("status") == "ok" for c in checks.values())
         self._send_json(
             200 if healthy else 503,
@@ -428,6 +475,141 @@ class _Handler(BaseHTTPRequestHandler):
                 "kind": "Health",
                 "status": "ok" if healthy else "unhealthy",
                 "checks": checks,
+            },
+        )
+
+    #: A follower trailing the leader's commit index by more than this
+    #: many versions verdicts the replication component "warn" before
+    #: the link actually dies (mirrors the alert rule's threshold).
+    _REPLICATION_LAG_WARN = 1024
+    #: A lease record whose renew timestamp is older than this reads
+    #: stale — holders renew every ~1s against 5s windows, so 30s of
+    #: silence means the tier is leaderless or wedged.
+    _LEASE_STALE_S = 30.0
+
+    def _serve_debug_health(self) -> None:
+        """GET /debug/health: the HA-aware rollup. Joins the /healthz
+        subchecks, /replication/status (role, commit index, follower
+        lag), the lease records in kube-system, the SLO report, and
+        the alert engine into per-component pass/warn/burn verdicts
+        plus one overall worst — the `ktctl top health` data source.
+        `sampled` keys the miss contract: an unmeasured cluster (no
+        SLI samples AND no alert evaluations) exits the CLI 1."""
+        from kubernetes_tpu.utils import alerts, slo
+
+        checks = self._health_checks()
+        components = {}
+        for name, c in checks.items():
+            comp = dict(c)
+            comp["verdict"] = "pass" if c.get("status") == "ok" else "burn"
+            components[name] = comp
+        rep = components.get("replication")
+        if rep is not None and rep["verdict"] == "pass":
+            # Alive links can still be falling behind: sustained lag is
+            # the pre-quorum-loss signal (warn, not burn — the link is
+            # up and catching up is still possible).
+            lag = max(rep.get("followerLag", {}).values(), default=0)
+            if lag > self._REPLICATION_LAG_WARN:
+                rep["verdict"] = "warn"
+                rep["message"] = f"follower lag {lag} versions"
+        # Lease tier: every lease record in kube-system (scheduler
+        # standby, kvstore tiers) with holder/token/age. A stale or
+        # holderless lease is warn — the tier is between leaders, which
+        # the warm standby exists to make brief.
+        try:
+            from kubernetes_tpu.utils.lease import (
+                HOLDER_KEY,
+                RENEW_KEY,
+                TOKEN_KEY,
+            )
+
+            items = self.api.list("endpoints", namespace="kube-system")[
+                "items"
+            ]
+            leases = []
+            verdict = "pass"
+            now = time.time()
+            for obj in items:
+                ann = (obj.get("metadata", {}) or {}).get(
+                    "annotations", {}
+                ) or {}
+                if HOLDER_KEY not in ann:
+                    continue
+                try:
+                    renewed = float(ann.get(RENEW_KEY, "0") or "0")
+                except ValueError:
+                    renewed = 0.0
+                age = max(0.0, now - renewed) if renewed else None
+                stale = age is None or age > self._LEASE_STALE_S
+                holder = ann.get(HOLDER_KEY, "")
+                leases.append(
+                    {
+                        "name": obj.get("metadata", {}).get("name", ""),
+                        "holder": holder,
+                        "token": ann.get(TOKEN_KEY, ""),
+                        "ageS": None if age is None else round(age, 1),
+                        "stale": stale,
+                    }
+                )
+                if stale or not holder:
+                    verdict = "warn"
+            if leases:
+                components["leases"] = {
+                    "status": "ok" if verdict == "pass" else "stale",
+                    "verdict": verdict,
+                    "leases": leases,
+                }
+        except Exception as e:
+            components["leases"] = {
+                "status": "unhealthy", "verdict": "warn", "message": str(e),
+            }
+        slo_report = slo.evaluate()
+        components["slo"] = {
+            "status": slo_report["verdict"],
+            "verdict": (
+                slo_report["verdict"]
+                if slo_report["verdict"] != "no_data"
+                else "pass"
+            ),
+            "sampled": slo_report["sampled"],
+            "objectivesBurning": [
+                e["name"]
+                for e in slo_report["objectives"]
+                if e["verdict"] in ("warn", "burn")
+            ],
+        }
+        alert_snap = alerts.DEFAULT.snapshot()
+        firing = alert_snap["firing"]
+        sev = {
+            r["name"]: r["severity"] for r in alert_snap["rules"]
+        }
+        if not alert_snap["sampled"]:
+            alert_verdict = "pass"  # unmeasured: surfaced via `sampled`
+        elif any(sev.get(n) == "page" for n in firing):
+            alert_verdict = "burn"
+        elif firing:
+            alert_verdict = "warn"
+        else:
+            alert_verdict = "pass"
+        components["alerts"] = {
+            "status": "firing" if firing else "ok",
+            "verdict": alert_verdict,
+            "sampled": alert_snap["sampled"],
+            "firing": firing,
+            "evaluations": alert_snap["evaluations"],
+        }
+        overall = slo.worst(
+            *[c["verdict"] for c in components.values()]
+        )
+        self._send_json(
+            200,
+            {
+                "kind": "HealthRollup",
+                "verdict": overall,
+                "sampled": bool(
+                    slo_report["sampled"] or alert_snap["sampled"]
+                ),
+                "components": components,
             },
         )
 
@@ -498,9 +680,21 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0) or 0)
         data = self.rfile.read(length) if length else None
         headers = {}
-        for h in ("Content-Type", "Authorization", tracing.TRACE_HEADER):
+        for h in ("Content-Type", "Authorization"):
             if self.headers.get(h):
                 headers[h] = self.headers[h]
+        # One trace end-to-end across the hop: reuse the client's
+        # X-Trace-Id when it stamped one; otherwise mint an id HERE so
+        # the follower's request-log entry and the leader's carry the
+        # same trace id (before this, an unstamped forwarded mutation
+        # appeared as two unrelated requests at /debug/requests).
+        tid = (
+            self.headers.get(tracing.TRACE_HEADER)
+            or tracing.current_trace_id()
+            or tracing.new_trace_id()
+        )
+        headers[tracing.TRACE_HEADER] = tid
+        self._request_trace_id = tid
         req = urllib.request.Request(
             url, data=data, headers=headers, method=verb
         )

@@ -219,6 +219,13 @@ LEASE_CLOCK_SKEW = _site(
         "believes it still holds an expired lease while a rival steals "
         "it — the fencing token is what keeps its stale writes out",
 )
+# health-plane retention sampler (utils/timeseries.py):
+TIMESERIES_SAMPLE_SKIP = _site(
+    "timeseries.sample.skip", "trip",
+    doc="the retention sampler misses a cadence beat (GC pause / "
+        "stalled scrape analog); windowed queries must degrade to the "
+        "surviving samples, never extrapolate through the gap",
+)
 
 
 # -- rule state ---------------------------------------------------------

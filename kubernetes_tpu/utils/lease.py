@@ -46,6 +46,17 @@ ELECTIONS = metrics.DEFAULT.counter(
     labels=("tier",),
 )
 
+RENEW_LATENCY = metrics.DEFAULT.histogram(
+    "lease_renew_latency_seconds",
+    "Lease CAS round-trip (read + conditional write) per op — renew "
+    "for the live holder's heartbeat, acquire for create/steal/observe "
+    "passes. Must stay well under the lease window: a holder whose "
+    "renews take longer than the window demotes itself on slow "
+    "storage (utils/slo.py lease_renew_latency; utils/alerts.py "
+    "lease_renew_latency burn rule).",
+    labels=("op",),
+)
+
 
 class LeaseFenceError(Exception):
     """A write carried a fencing token older than the current lease —
@@ -152,6 +163,16 @@ class LeaseClient:
         token; any change of effective holder — fresh create, steal of
         an expired lease, or re-acquisition after this identity's own
         lease lapsed — bumps it (and counts as an election)."""
+        t0 = time.monotonic()
+        self._last_op = "acquire"
+        try:
+            return self._try_acquire()
+        finally:
+            # Failed/slow CAS rounds count too — a renew that times out
+            # is exactly the latency the SLO and burn rule exist for.
+            RENEW_LATENCY.observe(time.monotonic() - t0, op=self._last_op)
+
+    def _try_acquire(self) -> Optional[int]:
         now = self.now()
         obj = self._read_obj()
         rec = None if obj is None else self._record_of(obj)
@@ -186,6 +207,8 @@ class LeaseClient:
         renewing = (
             rec.holder == self.identity and self._held_token == rec.token
         )
+        if renewing:
+            self._last_op = "renew"
         expired = true_now - rec.renewed >= self.lease_duration
         if not renewing and not expired:
             return self.held_token()  # someone else holds a live lease

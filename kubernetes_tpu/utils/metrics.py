@@ -85,6 +85,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def snapshot(self) -> Dict[Tuple[str, ...], float]:
+        """Point-in-time copy of every series (the retention sampler's
+        read — utils/timeseries.py; one lock hold for the family)."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> List[str]:
         out = self._header("counter")
         with self._lock:
@@ -105,6 +111,11 @@ class Gauge(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
+
+    def snapshot(self) -> Dict[Tuple[str, ...], float]:
+        """Point-in-time copy of every series (utils/timeseries.py)."""
+        with self._lock:
+            return dict(self._values)
 
     def render(self) -> List[str]:
         out = self._header("gauge")
@@ -191,6 +202,26 @@ def _fmt_float(v: float) -> str:
     return f"{v:g}"
 
 
+def bucket_quantile(bounds, counts, total, q: float) -> float:
+    """histogram_quantile over raw (non-cumulative) per-bucket counts:
+    linear within the bucket holding rank q*total; observations beyond
+    the highest finite bound report that bound. Shared by the live
+    Histogram and the retention plane's windowed bucket DELTAS
+    (utils/timeseries.quantile_over_time) so a windowed p99 and a
+    lifetime p99 can never disagree about what interpolation means."""
+    if total <= 0:
+        return math.nan
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for ub, c in zip(bounds, counts):
+        if c and cum + c >= rank:
+            return lo + (ub - lo) * max(0.0, min(1.0, (rank - cum) / c))
+        cum += c
+        lo = ub
+    return bounds[-1]
+
+
 class Histogram(_Metric):
     """Cumulative-bucket histogram (the Prometheus exposition model's
     native latency type): per label set, one count per `le` bucket plus
@@ -239,15 +270,17 @@ class Histogram(_Metric):
                 return math.nan
             counts = list(s["buckets"])
             total = s["count"]
-        rank = q * total
-        cum = 0.0
-        lo = 0.0
-        for ub, c in zip(self.buckets, counts):
-            if c and cum + c >= rank:
-                return lo + (ub - lo) * max(0.0, min(1.0, (rank - cum) / c))
-            cum += c
-            lo = ub
-        return self.buckets[-1]
+        return bucket_quantile(self.buckets, counts, total, q)
+
+    def snapshot(self) -> Dict[Tuple[str, ...], Tuple[int, float, Tuple[int, ...]]]:
+        """Point-in-time (count, sum, raw per-bucket counts) per series
+        — what the retention sampler rings so windowed quantiles can be
+        interpolated from bucket deltas (utils/timeseries.py)."""
+        with self._lock:
+            return {
+                k: (s["count"], s["sum"], tuple(s["buckets"]))
+                for k, s in self._stats.items()
+            }
 
     def render(self) -> List[str]:
         out = self._header("histogram")
@@ -292,6 +325,12 @@ class Registry:
         series lookup — utils/slo.py)."""
         with self._lock:
             return self._metrics.get(name)
+
+    def all(self) -> List[_Metric]:
+        """Every registered metric (the retention sampler's sweep —
+        utils/timeseries.py)."""
+        with self._lock:
+            return list(self._metrics.values())
 
     def counter(self, name, help_="", labels=()) -> Counter:
         return self.register(Counter(name, help_, labels))  # type: ignore

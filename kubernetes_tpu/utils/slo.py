@@ -23,15 +23,23 @@ Objective kinds:
                   evaluate as the WORST set, like HighLatencyRequests)
     counter_max   the summed counter must stay <= target (e.g. zero
                   dropped watch streams)
+    gauge_max     the worst (max) live gauge value must stay <= target
+                  (watermarks — replication follower lag)
     value_max     a directly supplied figure must stay <= target
     value_min     a directly supplied figure must stay >= target
                   (throughput floors; bench's churn/CRUD gates)
 
-Windows: the underlying series are cumulative since their last
-``reset()``; ``window_s`` documents the objective's intended
-evaluation window (SLO gates and benches open fresh windows by
-resetting the series, exactly how ``reset_request_latency`` works for
-the HighLatencyRequests gate).
+Windows: ``window_s`` is REAL when the retention plane has history
+(utils/timeseries.py, PR 20): quantile_max evaluates the interpolated
+quantile of the window's bucket DELTAS, counter_max the windowed
+increase, gauge_max the windowed max — so a recovered burn returns to
+``pass`` within one window. Without history (sampler never started —
+unit tests, thin apiservers, bench's reset-based windows), objectives
+fall back to the lifetime-cumulative series exactly as before; each
+report entry carries ``windowed: true|false`` so a reader knows which
+path verdicted. SLO gates and benches may still open fresh windows by
+resetting the series (``reset_request_latency``); the fallback
+preserves those semantics bit-for-bit.
 """
 
 from __future__ import annotations
@@ -64,7 +72,8 @@ class Objective:
     name: str
     series: str
     target: float
-    kind: str = "quantile_max"  # quantile_max|counter_max|value_max|value_min
+    #: quantile_max|counter_max|gauge_max|value_max|value_min
+    kind: str = "quantile_max"
     percentile: float = 0.99
     #: Label filter as (name, value) pairs (hashable for frozen);
     #: partial filters evaluate the worst matching label set.
@@ -75,8 +84,9 @@ class Objective:
     #: For max kinds: values above warn_ratio*target verdict "warn"
     #: before the target is breached. 0 disables the warn band.
     warn_ratio: float = 0.75
-    #: Intended evaluation window (documentation; series are cumulative
-    #: since their last reset — see module docstring).
+    #: Evaluation window: when > 0 AND the retention plane has history
+    #: for the series, the objective verdicts the window's deltas;
+    #: otherwise the lifetime-cumulative fallback (module docstring).
     window_s: float = 0.0
     description: str = ""
 
@@ -92,7 +102,7 @@ def verdict_for_value(obj: Objective, value: Optional[float]) -> str:
     if value > obj.target:
         return breach
     if (
-        obj.kind in ("quantile_max", "value_max")
+        obj.kind in ("quantile_max", "value_max", "gauge_max")
         and obj.warn_ratio
         and value > obj.warn_ratio * obj.target
     ):
@@ -109,11 +119,21 @@ def _matching_label_sets(metric, labels: Dict[str, str]):
             yield lm
 
 
-def evaluate_objective(obj: Objective, registry=None) -> dict:
-    """Evaluate one objective against the registry's current window.
-    Returns a dict entry for the SLO report: measured value, p50/p99
-    context, sample count, and the verdict."""
+def evaluate_objective(obj: Objective, registry=None, history=None) -> dict:
+    """Evaluate one objective. Returns a dict entry for the SLO
+    report: measured value, p50/p99 context, sample count, and the
+    verdict.
+
+    `history` is the retention plane (utils/timeseries.Retention;
+    defaults to its process-global store). When the objective declares
+    a window AND history holds enough samples for the series, the
+    verdict comes from the window's deltas; otherwise the lifetime
+    cumulative fallback below verdicts exactly as pre-PR-20."""
     registry = metrics.DEFAULT if registry is None else registry
+    if history is None:
+        from kubernetes_tpu.utils import timeseries
+
+        history = timeseries.DEFAULT
     labels = dict(obj.labels)
     entry = {
         "name": obj.name,
@@ -129,36 +149,104 @@ def evaluate_objective(obj: Objective, registry=None) -> dict:
         entry["percentile"] = obj.percentile
     if obj.description:
         entry["description"] = obj.description
+    if obj.window_s > 0:
+        entry["windowS"] = obj.window_s
     metric = registry.get(obj.series) if hasattr(registry, "get") else None
     if metric is None:
         entry["verdict"] = "no_data"
         return entry
+    # A series registered under the objective's name but with the wrong
+    # shape (a counter where a histogram is expected) is unmeasurable,
+    # not a crash — /debug/health keeps serving.
+    needed = "quantile" if obj.kind == "quantile_max" else "value"
+    if not hasattr(metric, needed):
+        entry["verdict"] = "no_data"
+        return entry
+    use_window = (
+        obj.window_s > 0
+        and history is not None
+        and getattr(history, "sampled", False)
+    )
     value: Optional[float] = None
+    windowed = False
     if obj.kind == "counter_max":
-        # A counter with no series yet IS zero (nothing has been
-        # counted): verdict pass, but samples stay 0 so the report's
-        # `sampled` flag (the ktctl slo miss contract) is untouched.
-        total = 0.0
+        if use_window:
+            # Windowed increase summed across matching label sets; a
+            # series whose ring lacks two samples contributes nothing
+            # (None) — all-None falls through to lifetime.
+            w_total: Optional[float] = None
+            for lm in _matching_label_sets(metric, labels):
+                inc = history.increase(obj.series, obj.window_s, lm)
+                if inc is not None:
+                    w_total = (w_total or 0.0) + inc
+            if w_total is not None:
+                value = w_total
+                entry["samples"] = int(w_total)
+                windowed = True
+        if not windowed:
+            # A counter with no series yet IS zero (nothing has been
+            # counted): verdict pass, but samples stay 0 so the
+            # report's `sampled` flag (the ktctl slo miss contract) is
+            # untouched.
+            total = 0.0
+            for lm in _matching_label_sets(metric, labels):
+                total += metric.value(**lm)
+            value = total
+            entry["samples"] = int(total)
+    elif obj.kind == "gauge_max":
+        # Watermark objective: the WORST live (or windowed-max) value
+        # across matching label sets — replication follower lag's
+        # shape: any one follower trailing far is the problem.
+        n_sets = 0
         for lm in _matching_label_sets(metric, labels):
-            total += metric.value(**lm)
-        value = total
-        entry["samples"] = int(total)
+            if use_window:
+                v = history.max_over_time(obj.series, obj.window_s, lm)
+                if v is not None:
+                    windowed = True
+                else:
+                    v = metric.value(**lm)
+            else:
+                v = metric.value(**lm)
+            n_sets += 1
+            if value is None or v > value:
+                value = v
+        entry["samples"] = n_sets
     elif obj.kind == "quantile_max":
         samples = 0
         p50 = None
-        for lm in _matching_label_sets(metric, labels):
-            q = metric.quantile(obj.percentile, **lm)
-            if math.isnan(q):
-                continue
-            # Worst matching label set carries the verdict — the
-            # HighLatencyRequests shape for partially-filtered series.
-            if value is None or q > value:
-                value = q
-            q50 = metric.quantile(0.5, **lm)
-            if not math.isnan(q50) and (p50 is None or q50 > p50):
-                p50 = q50
-            count = getattr(metric, "count", None)
-            samples += count(**lm) if count is not None else 0
+        if use_window:
+            for lm in _matching_label_sets(metric, labels):
+                q = history.quantile_over_time(
+                    obj.series, obj.percentile, obj.window_s, lm
+                )
+                if q is None:
+                    continue
+                windowed = True
+                # Worst matching label set carries the verdict.
+                if value is None or q > value:
+                    value = q
+                q50 = history.quantile_over_time(
+                    obj.series, 0.5, obj.window_s, lm
+                )
+                if q50 is not None and (p50 is None or q50 > p50):
+                    p50 = q50
+                hw = history.hist_window(obj.series, obj.window_s, lm)
+                samples += hw[0] if hw is not None else 0
+        if not windowed:
+            for lm in _matching_label_sets(metric, labels):
+                q = metric.quantile(obj.percentile, **lm)
+                if math.isnan(q):
+                    continue
+                # Worst matching label set carries the verdict — the
+                # HighLatencyRequests shape for partially-filtered
+                # series.
+                if value is None or q > value:
+                    value = q
+                q50 = metric.quantile(0.5, **lm)
+                if not math.isnan(q50) and (p50 is None or q50 > p50):
+                    p50 = q50
+                count = getattr(metric, "count", None)
+                samples += count(**lm) if count is not None else 0
         entry["samples"] = samples
         if p50 is not None:
             entry["p50"] = round(p50, 6)
@@ -172,6 +260,7 @@ def evaluate_objective(obj: Objective, registry=None) -> dict:
         # (verdict_for_value); evaluating them here reports no_data.
         entry["verdict"] = "no_data"
         return entry
+    entry["windowed"] = windowed
     if value is not None:
         entry["value"] = round(value, 6)
     entry["verdict"] = verdict_for_value(obj, value)
@@ -186,34 +275,35 @@ def evaluate_objective(obj: Objective, registry=None) -> dict:
 DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
     Objective(
         "pod_startup_latency", "pod_startup_latency_seconds", target=5.0,
-        labels=(("milestone", "running"),),
+        labels=(("milestone", "running"),), window_s=300.0,
         description="watch-visible create -> kubelet Running, p99",
     ),
     Objective(
         "pod_bound_latency", "pod_startup_latency_seconds", target=1.0,
-        labels=(("milestone", "bound"),),
+        labels=(("milestone", "bound"),), window_s=300.0,
         description="watch-visible create -> binding visible, p99 "
         "(the reference's 99%-in-1s scheduling SLO)",
     ),
     Objective(
         "pod_decision_latency", "pod_startup_latency_seconds", target=1.0,
         labels=(("milestone", "decision"),), severity="warn",
+        window_s=300.0,
         description="watch-visible create -> flight-recorder decision, p99",
     ),
     Objective(
         "watch_fanout_lag", "watch_fanout_lag_versions", target=4096.0,
-        severity="warn", warn_ratio=0.0,
+        severity="warn", warn_ratio=0.0, window_s=300.0,
         description="store versions a watch delivery trails the applied "
         "watermark by, p99",
     ),
     Objective(
         "watch_stream_drops", "watch_streams_dropped_total",
-        kind="counter_max", target=0.0,
+        kind="counter_max", target=0.0, window_s=300.0,
         description="slow-consumer watch streams dropped (forced relists)",
     ),
     Objective(
         "solve_phase_latency", "scheduler_phase_seconds", target=1.0,
-        labels=(("phase", "solve"),), severity="warn",
+        labels=(("phase", "solve"),), severity="warn", window_s=300.0,
         description="device solve dispatch phase, p99",
     ),
     Objective(
@@ -250,6 +340,25 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
         description="pods evicted by a defrag move that never "
         "re-bound (journal recovery exhausted) — the "
         "stranded-pod-after-defrag gate",
+    ),
+    # HA tier (PR 20, satellite of the PR 19 control plane): cover
+    # replication and lease health out of the box, not only in the
+    # bench failover gate. Warn severity: advisory until the alerting
+    # plane's burn rules escalate (utils/alerts.py).
+    Objective(
+        "replication_follower_lag", "replication_follower_lag_versions",
+        kind="gauge_max", target=4096.0, severity="warn", warn_ratio=0.0,
+        window_s=300.0,
+        description="store versions the slowest follower trails the "
+        "leader's commit index by (worst follower; sustained lag is "
+        "the pre-quorum-loss signal)",
+    ),
+    Objective(
+        "lease_renew_latency", "lease_renew_latency_seconds", target=1.0,
+        severity="warn", window_s=300.0,
+        description="lease acquire/renew CAS round-trip, p99 — must "
+        "stay well under the 5s lease window or holders start "
+        "demoting themselves on slow storage",
     ),
 )
 
@@ -290,7 +399,8 @@ BENCH_OBJECTIVES: Dict[str, Objective] = {
 
 
 def evaluate(
-    objectives: Optional[Iterable[Objective]] = None, registry=None
+    objectives: Optional[Iterable[Objective]] = None, registry=None,
+    history=None,
 ) -> dict:
     """Evaluate the objective set into an SLOReport dict (the
     /debug/slo response shape): per-objective entries plus the overall
@@ -298,7 +408,8 @@ def evaluate(
     the ``ktctl slo`` empty-cluster miss contract keys on it)."""
     objectives = DEFAULT_OBJECTIVES if objectives is None else objectives
     entries: List[dict] = [
-        evaluate_objective(o, registry=registry) for o in objectives
+        evaluate_objective(o, registry=registry, history=history)
+        for o in objectives
     ]
     # Overall verdict: worst MEASURED verdict — an objective with no
     # data yet must not drag a healthy cluster's overall to no_data

@@ -126,6 +126,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "health: cluster health-plane tests (time-series retention, "
+        "burn-rate alert engine, /debug/{alerts,timeseries,health}, "
+        "ktctl alerts / top health); tier-1 includes them — select "
+        "just these with -m health",
+    )
+    config.addinivalue_line(
+        "markers",
         "sanitize: run this test with the ktsan lock sanitizer enabled "
         "(KT_SANITIZE=locks equivalent) and fail it on any sanitizer "
         "finding or leaked non-daemon thread; the concurrency-heavy "

@@ -1,0 +1,367 @@
+"""Multi-window multi-burn-rate alert engine (utils/alerts.py).
+
+State-machine unit tests on a private Retention + Registry with
+explicit ``now=`` clocks: pending hold-down (for_s must elapse before
+firing), flap suppression (a blip shorter than the hold-down lands
+back at inactive, never fires), resolve hysteresis (resolve_s of
+continuous quiet before resolved — and a re-trip mid-quiet resets the
+clock), exactly-one Event per transition, the multi-window AND
+condition, the burn multiplier applying to counter_rate rules only,
+and the engine's miss/snapshot surfaces.
+"""
+
+import dataclasses
+
+import pytest
+
+from kubernetes_tpu.utils import alerts, metrics, timeseries
+
+pytestmark = pytest.mark.health
+
+
+def _rule(**kw):
+    base = dict(
+        name="lag_high",
+        series="lag_versions",
+        threshold=100.0,
+        kind="gauge_max",
+        windows=(alerts.BurnWindow(long_s=60.0, short_s=20.0, burn=1.0),),
+        for_s=10.0,
+        resolve_s=15.0,
+        severity="page",
+    )
+    base.update(kw)
+    return alerts.AlertRule(**base)
+
+
+class _Plant:
+    """A gauge series driven by hand: set(value, t) samples the
+    registry into the retention ring at the given fake time, then
+    eval(t) runs one engine pass."""
+
+    def __init__(self, rule=None, clock_scale=1.0):
+        self.reg = metrics.Registry()
+        self.gauge = self.reg.gauge("lag_versions", "x")
+        self.ret = timeseries.Retention()
+        self.rule = rule or _rule()
+        self.engine = alerts.AlertEngine(
+            retention=self.ret, rules=(self.rule,), clock_scale=clock_scale
+        )
+
+    def set(self, value, t):
+        self.gauge.set(float(value))
+        self.ret.sample_now(registry=self.reg, now=t)
+
+    def eval(self, t):
+        return self.engine.evaluate(now=t)
+
+    def state(self):
+        return self.engine._state[self.rule.name]["state"]
+
+
+class TestStateMachine:
+    def test_pending_hold_down_then_firing(self):
+        p = _Plant()
+        p.set(10, 0.0)
+        p.set(10, 5.0)
+        assert p.eval(5.0) == []  # quiet: no state entry transition
+        p.set(500, 10.0)
+        out = p.eval(10.0)
+        assert [t["to"] for t in out] == ["pending"]
+        # Hold-down not elapsed: still pending, no new transition.
+        p.set(500, 15.0)
+        assert p.eval(15.0) == []
+        assert p.state() == "pending"
+        # for_s=10 elapsed since pending began at t=10.
+        p.set(500, 21.0)
+        out = p.eval(21.0)
+        assert [t["to"] for t in out] == ["firing"]
+        assert p.engine.firing() == ["lag_high"]
+
+    def test_flap_suppression_pending_back_to_inactive(self):
+        p = _Plant()
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        assert [t["to"] for t in p.eval(5.0)] == ["pending"]
+        # The blip clears before for_s elapses: back to inactive —
+        # the hold-down ate the flap, nothing ever fired.
+        p.set(10, 8.0)
+        p.set(10, 9.0)
+        # Shrink the windows' view by moving past them: set enough
+        # quiet samples that max-over-window drops under threshold.
+        for t in range(10, 75, 5):
+            p.set(10, float(t))
+        out = p.eval(74.0)
+        assert [t["to"] for t in out] == ["inactive"]
+        assert p.engine.firing() == []
+        assert all(t["to"] != "firing" for t in p.engine.transitions())
+
+    def _fire(self, p):
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        p.eval(5.0)
+        p.set(500, 16.0)
+        p.eval(16.0)
+        assert p.state() == "firing"
+
+    def test_resolve_hysteresis(self):
+        p = _Plant()
+        self._fire(p)
+        # Quiet from t=20 on. The spike at t=16 leaves the SHORT 20s
+        # window after t=36 (the AND condition clears there even
+        # though the long window still holds it), so the first quiet
+        # eval is t=40 and resolve_s=15 lands resolution at t=55 —
+        # every eval before that must stay firing.
+        for t in range(20, 120, 5):
+            p.set(10, float(t))
+            p.eval(float(t))
+            if t < 55:
+                assert p.state() == "firing", t
+        assert p.state() == "resolved"
+        assert p.engine.firing() == []
+
+    def test_retrip_during_quiet_resets_resolve_clock(self):
+        p = _Plant()
+        self._fire(p)
+        # Quiet evals; the condition clears at t=38 (spike out of the
+        # short window), starting the resolve clock.
+        for t in (20.0, 26.0, 32.0, 38.0, 44.0):
+            p.set(10, t)
+            p.eval(t)
+        assert p.state() == "firing"  # 44 - 38 = 6 < resolve_s
+        # Re-trip inside the resolve window: clear_since must reset.
+        p.set(500, 46.0)
+        p.eval(46.0)
+        assert p.state() == "firing"
+        # Without the reset, the OLD clock (cleared t=38) would have
+        # resolved at t=53 — these must all stay firing.
+        for t in range(48, 62, 2):
+            p.set(10, float(t))
+            p.eval(float(t))
+            assert p.state() == "firing", t
+        # Full quiet: the re-trip leaves the short window after t=66,
+        # and a FULL resolve_s later it finally resolves.
+        for t in range(62, 120, 2):
+            p.set(10, float(t))
+            p.eval(float(t))
+        assert p.state() == "resolved"
+        # Exactly one resolved transition despite two quiet stretches.
+        resolved = [
+            t for t in p.engine.transitions() if t["to"] == "resolved"
+        ]
+        assert len(resolved) == 1
+
+    def test_for_s_zero_fires_immediately(self):
+        p = _Plant(rule=_rule(for_s=0.0))
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        out = p.eval(5.0)
+        assert [t["to"] for t in out] == ["firing"]
+
+    def test_no_data_is_not_active(self):
+        p = _Plant()
+        assert p.eval(0.0) == []
+        assert p.engine.firing() == []
+
+
+class TestCondition:
+    def test_long_window_alone_does_not_trip(self):
+        # Short window quiet + long window hot = recovering incident:
+        # must NOT (re-)trip. Drive it directly on the condition.
+        p = _Plant(rule=_rule(windows=(
+            alerts.BurnWindow(long_s=60.0, short_s=10.0, burn=1.0),
+        )))
+        p.set(500, 0.0)   # hot sample, old
+        p.set(500, 5.0)
+        p.set(10, 45.0)   # short window (35..45] sees only quiet
+        p.set(10, 44.0)
+        active, value, hit = p.engine._condition(p.rule, now=45.0)
+        assert not active and hit is None
+
+    def test_burn_multiplier_scales_counter_rate_only(self):
+        w = alerts.BurnWindow(long_s=60.0, short_s=20.0, burn=10.0)
+        gauge_rule = _rule(threshold=100.0, windows=(w,))
+        rate_rule = _rule(
+            name="drops", series="d_total", kind="counter_rate",
+            threshold=1.0, windows=(w,),
+        )
+        reg = metrics.Registry()
+        g = reg.gauge("lag_versions", "x")
+        c = reg.counter("d_total", "x")
+        ret = timeseries.Retention()
+        eng = alerts.AlertEngine(
+            retention=ret, rules=(gauge_rule, rate_rule)
+        )
+        # Gauge at 150 (> 100): trips with burn=10 untouched (the
+        # threshold is NOT multiplied to 1000 for gauge_max).
+        g.set(150.0)
+        c.inc(5)  # 5/s over 10s? no: 50 increments below
+        ret.sample_now(registry=reg, now=0.0)
+        g.set(150.0)
+        c.inc(50)  # 50 over 10s = 5/s — above 1.0 but BELOW 1.0*10
+        ret.sample_now(registry=reg, now=10.0)
+        active, _v, hit = eng._condition(gauge_rule, now=10.0)
+        assert active and hit["threshold"] == 100.0
+        active, _v, _hit = eng._condition(rate_rule, now=10.0)
+        assert not active  # 5/s <= burn-scaled 10/s
+
+    def test_any_window_pair_suffices(self):
+        # Slow pair trips even when the fast pair sees nothing (its
+        # windows hold < 2 samples).
+        fast = alerts.BurnWindow(long_s=4.0, short_s=1.0, burn=1.0)
+        slow = alerts.BurnWindow(long_s=60.0, short_s=30.0, burn=1.0)
+        p = _Plant(rule=_rule(windows=(fast, slow)))
+        p.set(500, 0.0)
+        p.set(500, 20.0)
+        active, _v, hit = p.engine._condition(p.rule, now=40.0)
+        assert active and hit["longS"] == 60.0
+
+    def test_worst_label_set_carries_the_rule(self):
+        reg = metrics.Registry()
+        g = reg.gauge("lag_versions", "x", ("follower",))
+        ret = timeseries.Retention()
+        rule = _rule()
+        eng = alerts.AlertEngine(retention=ret, rules=(rule,))
+        g.set(10.0, follower="f1")
+        g.set(900.0, follower="f2")
+        ret.sample_now(registry=reg, now=0.0)
+        ret.sample_now(registry=reg, now=10.0)
+        active, value, _hit = eng._condition(rule, now=10.0)
+        assert active and value == 900.0
+
+
+class _EventStub:
+    def __init__(self):
+        self.calls = []
+
+    def record_event(self, involved, reason="", message="", source=""):
+        self.calls.append((involved["metadata"]["name"], reason, message))
+
+
+class TestEvents:
+    def test_exactly_one_event_per_transition(self):
+        p = _Plant(rule=_rule(for_s=0.0, resolve_s=10.0))
+        stub = _EventStub()
+        p.engine.attach_events(stub)
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        p.eval(5.0)
+        # Steady firing: repeated evaluations post nothing new.
+        for t in range(6, 12):
+            p.set(500, float(t))
+            p.eval(float(t))
+        assert [c[1] for c in stub.calls] == ["AlertFiring"]
+        # Age out + hysteresis: exactly one AlertResolved.
+        for t in range(12, 120, 2):
+            p.set(10, float(t))
+            p.eval(float(t))
+        assert [c[1] for c in stub.calls] == ["AlertFiring", "AlertResolved"]
+        name, _reason, msg = stub.calls[0]
+        assert name == "lag_high"
+        assert "inactive -> firing" in msg and "severity page" in msg
+
+    def test_event_poster_exception_never_blocks_the_machine(self):
+        p = _Plant(rule=_rule(for_s=0.0))
+
+        class Boom:
+            def record_event(self, *a, **kw):
+                raise RuntimeError("broadcaster down")
+
+        p.engine.attach_events(Boom())
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        out = p.eval(5.0)
+        assert [t["to"] for t in out] == ["firing"]
+
+
+class TestEngineSurfaces:
+    def test_miss_contract_needs_evals_and_samples(self):
+        eng = alerts.AlertEngine(retention=timeseries.Retention())
+        assert not eng.sampled  # zero evaluations
+        eng.evaluate(now=0.0)
+        assert not eng.sampled  # evaluated, but retention never sampled
+        p = _Plant()
+        p.set(1, 0.0)
+        assert not p.engine.sampled
+        p.eval(0.0)
+        assert p.engine.sampled
+
+    def test_snapshot_shape(self):
+        p = _Plant(rule=_rule(for_s=0.0))
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        p.eval(5.0)
+        snap = p.engine.snapshot()
+        assert snap["kind"] == "AlertReport"
+        assert snap["sampled"] and snap["firing"] == ["lag_high"]
+        (row,) = snap["rules"]
+        assert row["name"] == "lag_high"
+        assert row["state"] == "firing"
+        assert row["severity"] == "page"
+        assert row["value"] == 500.0
+        assert row["trippedWindow"]["longS"] == 60.0
+        assert snap["transitions"][-1]["to"] == "firing"
+
+    def test_clock_scale_compresses_everything(self):
+        # Scale 0.1: for_s=10 becomes 1s, windows 60/20 become 6/2.
+        p = _Plant(clock_scale=0.1)
+        p.set(500, 0.0)
+        p.set(500, 1.0)
+        assert [t["to"] for t in p.eval(1.0)] == ["pending"]
+        p.set(500, 2.1)
+        assert [t["to"] for t in p.eval(2.1)] == ["firing"]
+
+    def test_configure_resets_state(self):
+        p = _Plant(rule=_rule(for_s=0.0))
+        p.set(10, 0.0)
+        p.set(500, 5.0)
+        p.eval(5.0)
+        assert p.engine.firing()
+        p.engine.configure(rules=(p.rule,))
+        assert p.engine.firing() == []
+        assert p.engine.transitions() == []
+        assert not p.engine.sampled
+
+    def test_transitions_ring_is_bounded(self):
+        p = _Plant(rule=_rule(for_s=0.0, resolve_s=0.0))
+        eng = p.engine
+        # Flip the state by hand through _transition to fill the ring.
+        st = {"state": "inactive", "since": 0.0, "clear_since": None}
+        for i in range(eng.MAX_TRANSITIONS + 40):
+            eng._transition(
+                st, p.rule, "firing" if i % 2 == 0 else "resolved",
+                float(i), 1.0,
+            )
+        assert len(eng.transitions()) == eng.MAX_TRANSITIONS
+
+
+class TestDefaultRules:
+    def test_default_rules_cover_the_published_objectives(self):
+        names = {r.name for r in alerts.DEFAULT_RULES}
+        assert names == {
+            "bind_latency_burn",
+            "watch_fanout_lag",
+            "watch_drop_storm",
+            "replication_follower_lag",
+            "lease_renew_latency",
+            "backlog_pressure",
+            "fragmentation_burn",
+        }
+        for r in alerts.DEFAULT_RULES:
+            assert r.windows == (alerts.FAST, alerts.SLOW)
+            assert r.for_s > 0 and r.resolve_s > 0
+            assert r.kind in ("quantile", "counter_rate", "gauge_max")
+
+    def test_published_burn_windows(self):
+        # The SRE-workbook pairs: 1h/5m at 14.4x and 6h/30m at 6x.
+        assert (alerts.FAST.long_s, alerts.FAST.short_s) == (3600.0, 300.0)
+        assert alerts.FAST.burn == 14.4
+        assert (alerts.SLOW.long_s, alerts.SLOW.short_s) == (21600.0, 1800.0)
+        assert alerts.SLOW.burn == 6.0
+
+    def test_rules_are_immutable_replace_to_tune(self):
+        r = alerts.DEFAULT_RULES[0]
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            r.threshold = 0.0
+        tuned = dataclasses.replace(r, threshold=0.123)
+        assert tuned.threshold == 0.123 and tuned.name == r.name

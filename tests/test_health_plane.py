@@ -1,0 +1,346 @@
+"""Cluster health-plane e2e surface (PR 20).
+
+Windowed SLO evaluation both ways (a lifetime burn that recovered
+verdicts pass inside the window; a clean lifetime with a fresh
+in-window burn verdicts burn), the three HTTP debug endpoints
+(/debug/alerts, /debug/timeseries, /debug/health) over a live
+apiserver — cold-miss payloads, populated queries, and the 400 on a
+non-numeric window — and the `ktctl alerts` / `ktctl top health` miss
+and populated contracts over LocalTransport.
+
+Tests that feed the PROCESS-GLOBAL retention/engine (the endpoints and
+the CLI read module DEFAULTs) reset them in teardown so the windowed
+fallback in unrelated suites keeps seeing an unsampled plane.
+"""
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+from contextlib import contextmanager, redirect_stderr, redirect_stdout
+
+import pytest
+
+from kubernetes_tpu.utils import alerts, metrics, slo, timeseries
+
+pytestmark = pytest.mark.health
+
+
+def _reset_globals():
+    timeseries.DEFAULT.reset()
+    alerts.DEFAULT.configure(rules=alerts.DEFAULT_RULES, clock_scale=1.0)
+
+
+@contextmanager
+def _quiet_global_registry():
+    """Earlier suites observe into the process-global metrics.DEFAULT,
+    so the lifetime SLO fallback would report THEIR burns inside the
+    health rollup here; pin a fresh registry for the duration.
+
+    Everything that registers process-global metrics at import time is
+    imported BEFORE the swap — a first-import inside the window would
+    bind its metric objects to the throwaway registry forever and the
+    exposition goldens downstream would lose them."""
+    import kubernetes_tpu.store.replication  # noqa: F401
+    import kubernetes_tpu.utils.flightrecorder  # noqa: F401
+    import kubernetes_tpu.utils.lease  # noqa: F401
+    from kubernetes_tpu.cli import ktctl  # noqa: F401
+    from kubernetes_tpu.server import api, httpserver  # noqa: F401
+
+    saved = metrics.DEFAULT
+    metrics.DEFAULT = metrics.Registry()
+    try:
+        yield
+    finally:
+        metrics.DEFAULT = saved
+
+
+class TestWindowedSLO:
+    """utils/slo.py window_s semantics: the verdict follows the
+    window's DELTAS when retention history exists, and falls back to
+    the lifetime cumulative path (exactly the pre-window behavior)
+    when it does not."""
+
+    def _history(self, reg):
+        # Two retention samples 10s apart ending "now" on the live
+        # monotonic clock (the slo engine queries against it).
+        ret = timeseries.Retention()
+        t1 = time.monotonic()
+        return ret, (t1 - 10.0, t1)
+
+    def test_recovered_burn_passes_in_window_but_burns_lifetime(self):
+        reg = metrics.Registry()
+        h = reg.histogram("bind_seconds", "x")
+        ret = timeseries.Retention()
+        t1 = time.monotonic()
+        for _ in range(100):
+            h.observe(8.0)  # the incident
+        ret.sample_now(registry=reg, now=t1 - 10.0)
+        for _ in range(100):
+            h.observe(0.01)  # the recovery, inside the window
+        ret.sample_now(registry=reg, now=t1)
+        obj = slo.Objective(
+            "bind", "bind_seconds", target=1.0, window_s=60.0
+        )
+        e = slo.evaluate_objective(obj, registry=reg, history=ret)
+        assert e["windowed"] is True
+        assert e["verdict"] == "pass", e
+        # Same objective, no retention history: lifetime p99 still
+        # carries the incident — the pre-PR-20 fallback verdict.
+        cold = slo.evaluate_objective(
+            obj, registry=reg, history=timeseries.Retention()
+        )
+        assert cold["windowed"] is False
+        assert cold["verdict"] == "burn", cold
+
+    def test_fresh_burn_inside_window_burns_despite_clean_lifetime(self):
+        reg = metrics.Registry()
+        h = reg.histogram("bind_seconds", "x")
+        ret = timeseries.Retention()
+        t1 = time.monotonic()
+        for _ in range(100):
+            h.observe(0.01)  # a long healthy history
+        ret.sample_now(registry=reg, now=t1 - 10.0)
+        for _ in range(80):
+            h.observe(8.0)  # the fresh incident, inside the window
+        ret.sample_now(registry=reg, now=t1)
+        obj = slo.Objective(
+            "bind", "bind_seconds", target=1.0, percentile=0.5,
+            kind="quantile_max", window_s=60.0,
+        )
+        e = slo.evaluate_objective(obj, registry=reg, history=ret)
+        assert e["windowed"] is True
+        assert e["verdict"] == "burn", e
+        # Lifetime p50 is dominated by the healthy majority: the
+        # cumulative fallback would still read pass — the window is
+        # what makes the fresh incident visible.
+        cold = slo.evaluate_objective(
+            obj, registry=reg, history=timeseries.Retention()
+        )
+        assert cold["windowed"] is False
+        assert cold["verdict"] == "pass", cold
+
+    def test_counter_burn_outside_window_passes_windowed(self):
+        reg = metrics.Registry()
+        c = reg.counter("drops_total", "x", ("resource",))
+        ret = timeseries.Retention()
+        t1 = time.monotonic()
+        c.inc(50, resource="pods")  # an old storm
+        ret.sample_now(registry=reg, now=t1 - 10.0)
+        ret.sample_now(registry=reg, now=t1)  # quiet since
+        obj = slo.Objective(
+            "drops", "drops_total", target=0.0, kind="counter_max",
+            window_s=60.0,
+        )
+        e = slo.evaluate_objective(obj, registry=reg, history=ret)
+        assert e["windowed"] is True and e["verdict"] == "pass"
+        cold = slo.evaluate_objective(
+            obj, registry=reg, history=timeseries.Retention()
+        )
+        assert cold["windowed"] is False and cold["verdict"] == "burn"
+
+    def test_wrong_shaped_series_is_no_data_not_a_crash(self):
+        # A counter registered under a latency objective's name is
+        # unmeasurable, not a crash — /debug/health proxies this
+        # evaluation, so an exception here would 500 the rollup.
+        reg = metrics.Registry()
+        c = reg.counter("bind_seconds", "x")
+        c.inc(100)
+        ret = timeseries.Retention()
+        t1 = time.monotonic()
+        ret.sample_now(registry=reg, now=t1 - 10.0)
+        ret.sample_now(registry=reg, now=t1)
+        obj = slo.Objective(
+            "bind", "bind_seconds", target=1.0, window_s=60.0
+        )
+        e = slo.evaluate_objective(obj, registry=reg, history=ret)
+        assert e["verdict"] == "no_data"
+        assert e["samples"] == 0
+
+    def test_windowless_objective_never_uses_history(self):
+        reg = metrics.Registry()
+        reg.histogram("bind_seconds", "x").observe(0.1)
+        ret, (t0, t1) = self._history(reg)
+        ret.sample_now(registry=reg, now=t0)
+        ret.sample_now(registry=reg, now=t1)
+        obj = slo.Objective("bind", "bind_seconds", target=1.0)
+        e = slo.evaluate_objective(obj, registry=reg, history=ret)
+        assert e["windowed"] is False
+        assert "windowS" not in e
+
+    def test_published_objectives_declare_windows(self):
+        by_name = {o.name: o for o in slo.DEFAULT_OBJECTIVES}
+        # Satellite 2: the replication-lag and lease-renew advisory
+        # objectives are part of the published set.
+        assert by_name["replication_follower_lag"].severity == "warn"
+        assert by_name["replication_follower_lag"].kind == "gauge_max"
+        assert by_name["lease_renew_latency"].severity == "warn"
+        windowed = [o for o in slo.DEFAULT_OBJECTIVES if o.window_s > 0]
+        assert len(windowed) >= 6
+
+
+class TestDebugEndpoints:
+    def _srv(self):
+        from kubernetes_tpu.server.api import APIServer
+        from kubernetes_tpu.server.httpserver import APIHTTPServer
+
+        api = APIServer()
+        return APIHTTPServer(api).start()
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(srv.address + path, timeout=10) as r:
+            return json.loads(r.read())
+
+    def test_cold_miss_payloads(self):
+        _reset_globals()
+        srv = self._srv()
+        try:
+            with _quiet_global_registry():
+                a = self._get(srv, "/debug/alerts")
+                assert a["kind"] == "AlertReport" and a["sampled"] is False
+                assert {r["name"] for r in a["rules"]} == {
+                    r.name for r in alerts.DEFAULT_RULES
+                }
+                t = self._get(srv, "/debug/timeseries")
+                assert t["kind"] == "TimeseriesReport"
+                assert t["sampled"] is False and t["series"] == []
+                h = self._get(srv, "/debug/health")
+                assert h["kind"] == "HealthRollup"
+                assert h["sampled"] is False
+                assert {"slo", "alerts"} <= set(h["components"])
+        finally:
+            srv.stop()
+            _reset_globals()
+
+    def test_bad_window_is_400(self):
+        srv = self._srv()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(srv, "/debug/timeseries?series=x&window=bogus")
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_populated_endpoints(self):
+        srv = self._srv()
+        reg = metrics.Registry()
+        g = reg.gauge("hp_lag_versions", "x")
+        rule = alerts.AlertRule(
+            name="hp_lag_high", series="hp_lag_versions",
+            threshold=100.0, kind="gauge_max",
+            windows=(alerts.BurnWindow(60.0, 20.0, 1.0),),
+            for_s=0.0, resolve_s=60.0, severity="page",
+        )
+        try:
+            t1 = time.monotonic()
+            g.set(500.0)
+            timeseries.DEFAULT.sample_now(registry=reg, now=t1 - 5.0)
+            timeseries.DEFAULT.sample_now(registry=reg, now=t1)
+            alerts.DEFAULT.configure(rules=(rule,))
+            alerts.DEFAULT.evaluate()
+
+            ts = self._get(
+                srv, "/debug/timeseries?series=hp_lag_versions&window=60"
+            )
+            assert ts["sampled"] is True
+            q = ts["query"]
+            assert q["found"] and q["type"] == "gauge"
+            assert q["labelSets"][0]["max"] == 500.0
+
+            a = self._get(srv, "/debug/alerts")
+            assert a["sampled"] is True
+            assert a["firing"] == ["hp_lag_high"]
+            (row,) = a["rules"]
+            assert row["state"] == "firing" and row["value"] == 500.0
+
+            h = self._get(srv, "/debug/health")
+            assert h["sampled"] is True
+            comp = h["components"]["alerts"]
+            assert comp["verdict"] == "burn"  # a firing page rule
+            assert comp["firing"] == ["hp_lag_high"]
+            assert h["verdict"] == "burn"
+        finally:
+            srv.stop()
+            _reset_globals()
+
+
+class TestKtctlContracts:
+    def _client(self):
+        from kubernetes_tpu.client import Client, LocalTransport
+        from kubernetes_tpu.server.api import APIServer
+
+        return Client(LocalTransport(APIServer()))
+
+    def _run(self, argv, client):
+        from kubernetes_tpu.cli import ktctl
+
+        out, err = io.StringIO(), io.StringIO()
+        with redirect_stdout(out), redirect_stderr(err):
+            rc = ktctl.main(argv, client=client)
+        return rc, out.getvalue(), err.getvalue()
+
+    def test_alerts_miss_contract(self):
+        _reset_globals()
+        rc, out, err = self._run(["alerts"], self._client())
+        assert rc == 1
+        assert out == ""
+        assert "no alert evaluations recorded" in err
+
+    def test_top_health_miss_contract(self, monkeypatch):
+        from kubernetes_tpu.cli import ktctl
+
+        # The SLO plane is process-global and other suites may have
+        # observed real samples; pin the fetch to an unmeasured
+        # rollup to model the freshly booted cluster (check.sh proves
+        # the same contract in a genuinely fresh process).
+        monkeypatch.setattr(
+            ktctl,
+            "_fetch_health_rollup",
+            lambda client, args: {
+                "kind": "HealthRollup", "verdict": "no_data",
+                "sampled": False, "components": {},
+            },
+        )
+        rc, out, err = self._run(["top", "health"], self._client())
+        assert rc == 1
+        assert out == ""
+        assert "no health samples recorded" in err
+
+    def test_alerts_and_top_health_populated(self):
+        reg = metrics.Registry()
+        g = reg.gauge("hp_cli_lag_versions", "x")
+        rule = alerts.AlertRule(
+            name="hp_cli_lag", series="hp_cli_lag_versions",
+            threshold=100.0, kind="gauge_max",
+            windows=(alerts.BurnWindow(60.0, 20.0, 1.0),),
+            for_s=0.0, resolve_s=60.0, severity="ticket",
+        )
+        try:
+            with _quiet_global_registry():
+                t1 = time.monotonic()
+                g.set(900.0)
+                timeseries.DEFAULT.sample_now(registry=reg, now=t1 - 5.0)
+                timeseries.DEFAULT.sample_now(registry=reg, now=t1)
+                alerts.DEFAULT.configure(rules=(rule,))
+                alerts.DEFAULT.evaluate()
+                client = self._client()
+
+                rc, out, err = self._run(["alerts"], client)
+                assert rc == 0, err
+                assert "hp_cli_lag" in out and "firing" in out
+                assert "firing: 1 (hp_cli_lag)" in out
+                assert "RECENT TRANSITIONS" in out
+
+                rc, out, _err = self._run(["alerts", "-o", "json"], client)
+                assert rc == 0
+                assert json.loads(out)["firing"] == ["hp_cli_lag"]
+
+                rc, out, err = self._run(["top", "health"], client)
+                assert rc == 0, err
+                # A firing ticket-severity rule degrades overall to
+                # warn (page severity would be burn).
+                assert "overall: warn" in out
+                assert "alerts" in out and "hp_cli_lag" in out
+        finally:
+            _reset_globals()

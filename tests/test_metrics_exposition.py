@@ -813,3 +813,90 @@ def test_replication_metrics_exposed():
     assert (
         f'leader_elections_total{{tier="kvstore"}} {kv_base + 1.0}' in text
     )
+
+
+def test_lint_metrics_knows_health_names(tmp_path):
+    """The health-plane family (utils/timeseries.py, utils/alerts.py,
+    utils/lease.py) is known to the linter: the sample counter /
+    sample-latency histogram / transition counter / renew-latency
+    histogram pass the standard rule on their own, the unitless
+    retained-series gauge and per-rule firing state gauge are
+    explicitly allowlisted, and a novel suffix-less alert name still
+    fails (the allowlist names metrics, not a prefix)."""
+    from tools.ktlint.rules_metrics import ALLOWLIST, HEALTH_METRICS
+
+    assert HEALTH_METRICS == {
+        "timeseries_samples_total",
+        "timeseries_retained_series",
+        "timeseries_sample_seconds",
+        "alerts_firing",
+        "alert_transitions_total",
+        "lease_renew_latency_seconds",
+    }
+    assert HEALTH_METRICS <= ALLOWLIST
+    root = pathlib.Path(__file__).resolve().parent.parent
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "g.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.counter("timeseries_samples_total", "x")\n'
+        'B = metrics.DEFAULT.gauge("timeseries_retained_series", "x")\n'
+        'C = metrics.DEFAULT.histogram("timeseries_sample_seconds", "x")\n'
+        'D = metrics.DEFAULT.gauge("alerts_firing", "x", ("rule",))\n'
+        'E = metrics.DEFAULT.counter('
+        '"alert_transitions_total", "x", ("rule", "state"))\n'
+        'F = metrics.DEFAULT.histogram('
+        '"lease_renew_latency_seconds", "x", ("op",))\n'
+    )
+    proc = _ktlint_kt005(root, good)
+    assert proc.returncode == 0, proc.stderr
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "b.py").write_text(
+        "from kubernetes_tpu.utils import metrics\n"
+        'A = metrics.DEFAULT.gauge("alerts_pending", "x")\n'
+    )
+    proc = _ktlint_kt005(root, bad)
+    assert proc.returncode == 1
+    assert "lacks a unit suffix" in proc.stderr
+
+
+def test_health_metrics_exposed():
+    """Exposition golden for the health-plane family: the sampler's
+    counter/gauge/histogram render with declared types, the per-rule
+    firing gauge escapes hostile rule names, and the transition
+    counter renders its (rule, state) pair. Process-global counters
+    may have been moved by earlier suites — golden on deltas."""
+    from kubernetes_tpu.utils.alerts import FIRING, TRANSITIONS
+    from kubernetes_tpu.utils.lease import RENEW_LATENCY
+    from kubernetes_tpu.utils.timeseries import (
+        RETAINED,
+        SAMPLE_SECONDS,
+        SAMPLES,
+    )
+
+    samples_base = SAMPLES.value()
+    SAMPLES.inc()
+    RETAINED.set(7.0)
+    SAMPLE_SECONDS.observe(0.002)
+    FIRING.set(1.0, rule='r"1\\x\ny')
+    trans_base = TRANSITIONS.value(rule="bind_latency_burn", state="firing")
+    TRANSITIONS.inc(rule="bind_latency_burn", state="firing")
+    RENEW_LATENCY.observe(0.01, op="renew")
+    text = metrics.DEFAULT.render()
+    assert "# TYPE timeseries_samples_total counter" in text
+    assert f"timeseries_samples_total {samples_base + 1.0}" in text
+    assert "# TYPE timeseries_retained_series gauge" in text
+    assert "timeseries_retained_series 7.0" in text
+    assert "# TYPE timeseries_sample_seconds histogram" in text
+    assert "timeseries_sample_seconds_bucket" in text
+    assert "# TYPE alerts_firing gauge" in text
+    # Label escaping on the rule label.
+    assert 'alerts_firing{rule="r\\"1\\\\x\\ny"} 1.0' in text
+    assert "# TYPE alert_transitions_total counter" in text
+    assert (
+        f'alert_transitions_total{{rule="bind_latency_burn",'
+        f'state="firing"}} {trans_base + 1.0}' in text
+    )
+    assert "# TYPE lease_renew_latency_seconds histogram" in text
+    assert 'lease_renew_latency_seconds_count{op="renew"}' in text

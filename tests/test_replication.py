@@ -293,6 +293,54 @@ class TestHTTPPlane:
             for _, _, http in followers:
                 http.stop()
 
+    def test_forwarded_write_shares_one_trace_id(self):
+        """A write through a follower is ONE operation: the follower's
+        request-log entry and the leader's carry the SAME trace id —
+        minted on the follower when the client sent no X-Trace-Id, and
+        reused verbatim when it did (before the fix, an unstamped
+        forwarded mutation appeared as two unrelated requests at
+        /debug/requests)."""
+        import urllib.request
+
+        from kubernetes_tpu.utils import debug
+
+        _store, _api, leader_http, hub, followers = self._cluster()
+        f1_http = followers[0][2]
+        try:
+            c = Client(HTTPTransport(f1_http.address))
+            c.create("pods", pod_wire("traced"))
+            posts = [
+                e for e in list(debug.DEFAULT_REQUEST_LOG._ring)
+                if e[1] == "POST" and e[2].endswith("/pods")
+            ]
+            # The leader's hop logs first (it responds before the
+            # follower's own finally runs), then the follower's.
+            assert len(posts) >= 2
+            tids = {e[5] for e in posts[-2:]}
+            assert len(tids) == 1, posts[-2:]
+            assert tids.pop(), "trace id was never minted on the hop"
+            # A client-stamped id is reused verbatim across both hops.
+            req = urllib.request.Request(
+                f1_http.address + "/api/v1/namespaces/default/pods",
+                data=json.dumps(pod_wire("traced2")).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Trace-Id": "trace-fwd-regress",
+                },
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=10).read()
+            stamped = [
+                e for e in list(debug.DEFAULT_REQUEST_LOG._ring)
+                if e[5] == "trace-fwd-regress"
+            ]
+            assert len(stamped) == 2  # follower hop + leader hop
+        finally:
+            hub.stop()
+            leader_http.stop()
+            for _, _, http in followers:
+                http.stop()
+
     def test_healthz_replication_subcheck(self):
         import urllib.request
 

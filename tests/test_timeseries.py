@@ -1,0 +1,331 @@
+"""In-memory time-series retention plane (utils/timeseries.py).
+
+Covers the Retention ring store (bounded per-series rings, windowed
+increase/rate/delta/max/avg/quantile queries, counter-reset tolerance,
+the miss semantics of a window holding fewer than two samples), the
+background Sampler (hook registration/dedup, sweep accounting,
+idempotent start, clean stop), and the /debug/timeseries snapshot
+payload both bare and with a query attached.
+
+All tests drive private Registry + Retention instances with explicit
+``now=`` clocks — nothing here starts the process-global SAMPLER or
+pollutes timeseries.DEFAULT (the windowed-SLO fallback in other
+modules keys off DEFAULT.sampled).
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.utils import metrics, timeseries
+
+pytestmark = pytest.mark.health
+
+
+def _counter_reg():
+    reg = metrics.Registry()
+    c = reg.counter("drops_total", "x", ("resource",))
+    return reg, c
+
+
+class TestRetentionSampling:
+    def test_sample_now_retains_all_metric_types(self):
+        reg = metrics.Registry()
+        reg.counter("c_total", "x").inc(3)
+        reg.gauge("g_ratio", "x").set(0.5)
+        reg.histogram("h_seconds", "x").observe(0.2)
+        ret = timeseries.Retention()
+        assert not ret.sampled
+        touched = ret.sample_now(registry=reg, now=1.0)
+        assert touched == 3
+        assert ret.sampled and ret.samples == 1
+        assert set(ret.series_names()) == {"c_total", "g_ratio", "h_seconds"}
+
+    def test_summaries_are_skipped(self):
+        # Summary reservoirs are not delta-composable across snapshots
+        # — the retention plane must not pretend they are.
+        reg = metrics.Registry()
+        reg.summary("s_seconds", "x").observe(1.0)
+        ret = timeseries.Retention()
+        assert ret.sample_now(registry=reg, now=1.0) == 0
+        assert ret.series_names() == []
+
+    def test_rings_are_bounded(self):
+        reg = metrics.Registry()
+        g = reg.gauge("g_ratio", "x")
+        ret = timeseries.Retention(retain_samples=4)
+        for i in range(10):
+            g.set(float(i))
+            ret.sample_now(registry=reg, now=float(i))
+        # Only the newest retain_samples survive: the delta across a
+        # huge window sees sample 6 as its oldest point.
+        assert ret.delta("g_ratio", 1e9, now=10.0) == 9.0 - 6.0
+
+    def test_label_sets_and_reset(self):
+        reg, c = _counter_reg()
+        c.inc(resource="pods")
+        c.inc(resource="nodes")
+        ret = timeseries.Retention()
+        ret.sample_now(registry=reg, now=1.0)
+        sets = ret.label_sets("drops_total")
+        assert {frozenset(d.items()) for d in sets} == {
+            frozenset({("resource", "pods")}),
+            frozenset({("resource", "nodes")}),
+        }
+        ret.reset()
+        assert not ret.sampled
+        assert ret.series_names() == []
+
+
+class TestWindowedQueries:
+    def test_increase_needs_two_samples_and_respects_window(self):
+        reg, c = _counter_reg()
+        ret = timeseries.Retention()
+        c.inc(5, resource="pods")
+        ret.sample_now(registry=reg, now=0.0)
+        # One sample: no delta to take yet.
+        assert ret.increase(
+            "drops_total", 60.0, {"resource": "pods"}, now=0.0
+        ) is None
+        c.inc(7, resource="pods")
+        ret.sample_now(registry=reg, now=10.0)
+        assert ret.increase(
+            "drops_total", 60.0, {"resource": "pods"}, now=10.0
+        ) == 7.0
+        # A window that excludes the first sample is back to one point.
+        assert ret.increase(
+            "drops_total", 5.0, {"resource": "pods"}, now=10.0
+        ) is None
+
+    def test_increase_tolerates_counter_reset(self):
+        # Process restart: the counter restarts from zero. The
+        # negative step is dropped, not summed backwards — increase is
+        # the sum of positive deltas only (conservative: the remnant
+        # counted between the last pre-restart sample and the crash is
+        # gone, it never goes negative).
+        reg, c = _counter_reg()
+        ret = timeseries.Retention()
+        c.inc(10, resource="pods")
+        ret.sample_now(registry=reg, now=0.0)
+        c.inc(2, resource="pods")
+        ret.sample_now(registry=reg, now=10.0)
+        # Simulate the restart with a fresh registry sharing the name.
+        reg2, c2 = _counter_reg()
+        c2.inc(3, resource="pods")
+        ret.sample_now(registry=reg2, now=20.0)
+        c2.inc(4, resource="pods")
+        ret.sample_now(registry=reg2, now=30.0)
+        assert ret.increase(
+            "drops_total", 60.0, {"resource": "pods"}, now=30.0
+        ) == 2.0 + 4.0
+
+    def test_rate_uses_observed_span_not_nominal_window(self):
+        # 12 increments over 4 observed seconds inside a 60s window:
+        # the rate is 3/s, not 0.2/s — a sparse ring must not dilute a
+        # burst.
+        reg, c = _counter_reg()
+        ret = timeseries.Retention()
+        c.inc(3, resource="pods")
+        ret.sample_now(registry=reg, now=0.0)
+        c.inc(12, resource="pods")
+        ret.sample_now(registry=reg, now=4.0)
+        assert ret.rate(
+            "drops_total", 60.0, {"resource": "pods"}, now=4.0
+        ) == pytest.approx(3.0)
+
+    def test_gauge_delta_max_avg(self):
+        reg = metrics.Registry()
+        g = reg.gauge("lag_versions", "x")
+        ret = timeseries.Retention()
+        for now, v in ((0.0, 10.0), (1.0, 50.0), (2.0, 30.0)):
+            g.set(v)
+            ret.sample_now(registry=reg, now=now)
+        assert ret.delta("lag_versions", 60.0, now=2.0) == 20.0
+        assert ret.max_over_time("lag_versions", 60.0, now=2.0) == 50.0
+        assert ret.avg_over_time("lag_versions", 60.0, now=2.0) == 30.0
+        # Signed: a recovering gauge reports a negative delta.
+        assert ret.delta("lag_versions", 1.5, now=2.0) == -20.0
+        assert ret.max_over_time("lag_versions", 60.0, now=100.0) is None
+
+    def test_quantile_over_time_is_window_local(self):
+        # Old observations outside the window must not drag the
+        # windowed quantile: 100 slow obs land between the first two
+        # samples, 100 fast ones between the last two — the recovery
+        # window's p99 is fast even though lifetime p99 is slow. This
+        # is the mechanism behind windowed SLO recovery.
+        reg = metrics.Registry()
+        h = reg.histogram("lat_seconds", "x")
+        ret = timeseries.Retention()
+        h.observe(8.0)
+        ret.sample_now(registry=reg, now=0.0)
+        for _ in range(99):
+            h.observe(8.0)
+        ret.sample_now(registry=reg, now=10.0)
+        slow = ret.quantile_over_time("lat_seconds", 0.99, 60.0, now=10.0)
+        assert slow is not None and slow > 5.0
+        for _ in range(100):
+            h.observe(0.01)
+        ret.sample_now(registry=reg, now=20.0)
+        fast = ret.quantile_over_time("lat_seconds", 0.99, 12.0, now=20.0)
+        assert fast is not None and fast < 0.1
+        # Zero new observations inside the window: None (caller
+        # decides between no_data and lifetime fallback).
+        ret.sample_now(registry=reg, now=30.0)
+        assert ret.quantile_over_time(
+            "lat_seconds", 0.99, 11.0, now=30.0
+        ) is None
+
+    def test_hist_window_counter_reset_uses_last_snapshot(self):
+        reg = metrics.Registry()
+        h = reg.histogram("lat_seconds", "x")
+        ret = timeseries.Retention()
+        for _ in range(50):
+            h.observe(1.0)
+        ret.sample_now(registry=reg, now=0.0)
+        # Restarted process: count went backwards; the last snapshot
+        # alone IS the since-restart window.
+        reg2 = metrics.Registry()
+        h2 = reg2.histogram("lat_seconds", "x")
+        h2.observe(0.5)
+        h2.observe(0.7)
+        ret.sample_now(registry=reg2, now=10.0)
+        count, _s, buckets = ret.hist_window("lat_seconds", 60.0, now=10.0)
+        assert count == 2
+        assert sum(buckets) == 2
+
+    def test_unknown_series_and_labels_are_none(self):
+        ret = timeseries.Retention()
+        assert ret.increase("nope_total", 60.0) is None
+        reg, c = _counter_reg()
+        c.inc(resource="pods")
+        ret.sample_now(registry=reg, now=0.0)
+        ret.sample_now(registry=reg, now=1.0)
+        assert ret.rate(
+            "drops_total", 60.0, {"resource": "nodes"}, now=1.0
+        ) is None
+
+    def test_kind_mismatched_queries_are_none_not_crashes(self):
+        # A query aimed at the wrong kind answers None: histogram
+        # queries on scalar rings and scalar queries on histogram
+        # rings must not 500 the /debug endpoints that proxy them.
+        reg = metrics.Registry()
+        c = reg.counter("mm_total", "x")
+        h = reg.histogram("mm_seconds", "x")
+        c.inc(5)
+        h.observe(1.0)
+        ret = timeseries.Retention()
+        ret.sample_now(registry=reg, now=0.0)
+        c.inc(5)
+        h.observe(2.0)
+        ret.sample_now(registry=reg, now=10.0)
+        # histogram-shaped queries on a scalar (counter) ring
+        assert ret.hist_window("mm_total", 60.0, now=10.0) is None
+        assert ret.quantile_over_time(
+            "mm_total", 0.99, 60.0, now=10.0
+        ) is None
+        # scalar queries on a histogram ring
+        assert ret.increase("mm_seconds", 60.0, now=10.0) is None
+        assert ret.rate("mm_seconds", 60.0, now=10.0) is None
+        assert ret.delta("mm_seconds", 60.0, now=10.0) is None
+        assert ret.max_over_time("mm_seconds", 60.0, now=10.0) is None
+        assert ret.avg_over_time("mm_seconds", 60.0, now=10.0) is None
+        # the matched queries on the same rings still answer
+        assert ret.increase("mm_total", 60.0, now=10.0) == 5.0
+        assert ret.hist_window("mm_seconds", 60.0, now=10.0)[0] == 1
+
+
+class TestSnapshotPayload:
+    def _ret(self):
+        # The snapshot query path measures against the live monotonic
+        # clock (it serves /debug/timeseries), so the samples must sit
+        # on that clock, 10s apart, ending "now".
+        import time
+
+        t1 = time.monotonic()
+        reg = metrics.Registry()
+        c = reg.counter("drops_total", "x", ("resource",))
+        h = reg.histogram("lat_seconds", "x")
+        ret = timeseries.Retention()
+        c.inc(2, resource="pods")
+        h.observe(0.1)
+        ret.sample_now(registry=reg, now=t1 - 10.0)
+        c.inc(4, resource="pods")
+        h.observe(0.3)
+        ret.sample_now(registry=reg, now=t1)
+        return ret
+
+    def test_bare_snapshot_lists_series(self):
+        snap = self._ret().snapshot()
+        assert snap["kind"] == "TimeseriesReport"
+        assert snap["sampled"] is True and snap["samples"] == 2
+        assert {"drops_total", "lat_seconds"} <= set(snap["series"])
+        assert snap["retainSamples"] > 0
+        assert "query" not in snap  # bare inventory, no ?series=
+
+    def test_query_snapshot_counter(self):
+        snap = self._ret().snapshot(series="drops_total", window_s=60.0)
+        q = snap["query"]
+        assert q["found"] and q["type"] == "counter"
+        assert q["windowS"] == 60.0
+        (row,) = q["labelSets"]
+        assert row["labels"] == {"resource": "pods"}
+        assert row["samplesInWindow"] == 2
+        assert row["increase"] == 4.0
+        assert row["rate"] == pytest.approx(0.4, rel=0.05)
+
+    def test_query_snapshot_histogram_quantiles(self):
+        snap = self._ret().snapshot(series="lat_seconds", window_s=60.0)
+        (row,) = snap["query"]["labelSets"]
+        assert row["increase"] == 1  # one observation landed in-window
+        assert 0 < row["p50"] <= row["p99"]
+
+    def test_query_snapshot_miss(self):
+        q = self._ret().snapshot(series="nope_total", window_s=60.0)["query"]
+        assert q == {"series": "nope_total", "found": False}
+
+
+class TestSampler:
+    def test_sweep_runs_hooks_and_counts(self):
+        ret = timeseries.Retention()
+        s = timeseries.Sampler(ret)
+        calls = []
+
+        def hook():
+            calls.append(1)
+
+        s.add_hook(hook)
+        s.add_hook(hook)  # dedup: registering twice runs once
+        before = timeseries.SAMPLES.value()
+        s.sweep()
+        assert calls == [1]
+        assert timeseries.SAMPLES.value() == before + 1
+        assert ret.sampled
+
+    def test_hook_exception_does_not_kill_the_sweep(self):
+        ret = timeseries.Retention()
+        s = timeseries.Sampler(ret)
+        ran = []
+        s.add_hook(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        s.add_hook(lambda: ran.append(1))
+        s.sweep()
+        assert ran == [1]
+        assert ret.sampled  # the sample itself still landed
+
+    def test_start_is_idempotent_and_stop_joins(self):
+        ret = timeseries.Retention()
+        s = timeseries.Sampler(ret)
+        try:
+            s.start(interval_s=0.05)
+            t1 = s._thread
+            s.start(interval_s=0.05)
+            assert s._thread is t1  # second start is a no-op
+            assert s.running
+            assert t1.daemon
+        finally:
+            s.stop()
+        assert not s.running
+        alive = [t.name for t in threading.enumerate()]
+        assert "kt-timeseries-sampler" not in alive
+
+    def test_stop_without_start_is_noop(self):
+        timeseries.Sampler(timeseries.Retention()).stop()

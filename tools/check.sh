@@ -1032,6 +1032,158 @@ if [ $rc -eq 0 ]; then
     rc=$fo_rc
 fi
 
+# Alert smoke (ISSUE 20): the health plane end to end in a fresh
+# process — `ktctl alerts` / `ktctl top health` miss contracts first
+# (exit 1, empty stdout, reason on stderr), then the HTTP control
+# plane under a seeded watch-drop storm with the burn-rate engine on
+# compressed clocks: watch_drop_storm must transition to firing while
+# the storm runs, resolve after it clears, and the three debug
+# endpoints (/debug/alerts, /debug/timeseries, /debug/health) must
+# serve the populated contracts over HTTP.
+echo "== alert smoke (burn-rate firing + resolution) =="
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import dataclasses
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stderr, redirect_stdout
+
+from kubernetes_tpu.cli import ktctl
+from kubernetes_tpu.client import Client, HTTPTransport
+from kubernetes_tpu.scheduler.daemon import (
+    IncrementalBatchScheduler, SchedulerConfig,
+)
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.httpserver import APIHTTPServer
+from kubernetes_tpu.utils import alerts, faults, timeseries
+
+api = APIServer()
+srv = APIHTTPServer(api, max_in_flight=800).start()
+client = Client(HTTPTransport(srv.address))
+
+# Miss contracts FIRST (no evaluations yet): exit 1, empty stdout,
+# the reason on stderr — the trace/explain/slo contract.
+for argv, msg in (
+    (["alerts"], "no alert evaluations recorded"),
+    (["top", "health"], "no health samples recorded"),
+):
+    out, err = io.StringIO(), io.StringIO()
+    with redirect_stdout(out), redirect_stderr(err):
+        rc = ktctl.main(argv, client=client)
+    assert rc == 1, (argv, rc, err.getvalue())
+    assert out.getvalue() == "", (argv, out.getvalue())
+    assert msg in err.getvalue(), (argv, err.getvalue())
+
+# Drill config: compressed clocks (1h/5m windows -> 6s/0.5s) and a
+# drop-rate threshold the seeded storm must cross; every other rule
+# keeps its production shape.
+drill = tuple(
+    dataclasses.replace(r, threshold=0.005)
+    if r.name == "watch_drop_storm" else r
+    for r in alerts.DEFAULT_RULES
+)
+alerts.DEFAULT.configure(rules=drill, clock_scale=1.0 / 600.0)
+alerts.ensure_started(interval_s=0.25, client=client)
+
+client.create_bulk("nodes", [
+    {"kind": "Node", "metadata": {"name": f"n{j}"},
+     "status": {"capacity": {"cpu": "64", "memory": "256Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}]}}
+    for j in range(8)
+])
+cfg = SchedulerConfig(
+    Client(HTTPTransport(srv.address)), raw_scheduled_cache=True
+).start()
+assert cfg.wait_for_sync(timeout=60), "scheduler caches never synced"
+sched = IncrementalBatchScheduler(cfg, max_batch=512).start()
+
+# The storm: seeded slow-consumer drops on the watch fan-out while a
+# pod wave churns the streams.
+faults.inject(faults.WATCH_DROP, p=0.2, times=12)
+
+def pod(name):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "app",
+                     "resources": {"limits": {"cpu": "50m",
+                                              "memory": "32Mi"}}}]}}
+
+res = client.create_bulk(
+    "pods", [pod(f"al-{i}") for i in range(200)], namespace="default"
+)
+assert all(r.get("status") == "Success" for r in res)
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if "watch_drop_storm" in alerts.DEFAULT.firing():
+        break
+    time.sleep(0.25)
+assert "watch_drop_storm" in alerts.DEFAULT.firing(), (
+    f"storm never fired: {alerts.DEFAULT.snapshot()['rules']}"
+)
+
+# Populated contract while firing: table shows the rule firing.
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["alerts"], client=client)
+text = out.getvalue()
+assert rc == 0, text
+assert "watch_drop_storm" in text and "firing" in text, text
+
+# Clear the fault; the short windows drain in seconds at this scale,
+# then the scaled hysteresis resolves the rule.
+faults.clear()
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if not alerts.DEFAULT.firing():
+        break
+    time.sleep(0.25)
+assert not alerts.DEFAULT.firing(), alerts.DEFAULT.firing()
+
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["alerts"], client=client)
+text = out.getvalue()
+assert rc == 0 and "resolved" in text, text
+
+# The transition Events landed on the cluster (exactly once per
+# transition; the alert engine posts through the shared broadcaster).
+client.flush_events()
+events, _ = client.list("events", namespace="default")
+reasons = [e.reason for e in events if "watch_drop_storm" in (e.message or "")]
+assert "AlertFiring" in reasons and "AlertResolved" in reasons, reasons
+
+# The other two endpoints, populated, over HTTP.
+with urllib.request.urlopen(
+    srv.address + "/debug/timeseries?series=watch_streams_dropped_total"
+    "&window=60", timeout=10,
+) as r:
+    ts = json.loads(r.read())
+assert ts["sampled"] and ts["query"]["found"], ts
+assert ts["query"]["labelSets"], ts
+with urllib.request.urlopen(srv.address + "/debug/health", timeout=10) as r:
+    health = json.loads(r.read())
+assert health["kind"] == "HealthRollup" and health["sampled"], health
+assert "alerts" in health["components"], health
+out = io.StringIO()
+with redirect_stdout(out):
+    rc = ktctl.main(["top", "health"], client=client)
+assert rc == 0 and "overall:" in out.getvalue(), out.getvalue()
+
+timeseries.SAMPLER.stop()
+sched.stop()
+srv.stop()
+print("alert smoke OK: watch_drop_storm fired under the storm, "
+      "resolved after it cleared; Events posted; "
+      "/debug/{alerts,timeseries,health} + ktctl alerts/top health "
+      "contracts held")
+EOF
+alert_rc=$?
+if [ $rc -eq 0 ]; then
+    rc=$alert_rc
+fi
+
 # Soak smoke (ISSUE 15): ~200 hollow nodes (real kubelets, no-op
 # runtime) driving the full API→solve→bind→kubelet loop while the
 # seeded chaos schedule fires ONE apiserver kill -9 (torn WAL write →

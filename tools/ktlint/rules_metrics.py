@@ -179,6 +179,25 @@ REPLICATION_METRICS = {
 }
 ALLOWLIST |= REPLICATION_METRICS
 
+#: Health-plane family (utils/timeseries.py, utils/alerts.py,
+#: utils/lease.py — see docs/architecture.md "Alerting & health
+#: plane"). timeseries_samples_total / timeseries_sample_seconds /
+#: alert_transitions_total / lease_renew_latency_seconds carry
+#: standard suffixes on their own; timeseries_retained_series is a
+#: unitless snapshot gauge (a count of retained label sets, like
+#: cluster_headroom_pods) and alerts_firing a 0/1 state gauge per
+#: rule — both allowlisted explicitly so the linter documents the
+#: whole family rather than silently tolerating it.
+HEALTH_METRICS = {
+    "timeseries_samples_total",
+    "timeseries_retained_series",
+    "timeseries_sample_seconds",
+    "alerts_firing",
+    "alert_transitions_total",
+    "lease_renew_latency_seconds",
+}
+ALLOWLIST |= HEALTH_METRICS
+
 
 class MetricNamingRule(Rule):
     id = "KT005"

@@ -29,7 +29,15 @@ test suite defines on the happy path:
   re-solve) — including across a daemon restart that lost the
   nomination table;
 - **SLO series advancing**: the lifecycle SLI milestones
-  (decision/bound/running) kept counting through every fault.
+  (decision/bound/running) kept counting through every fault;
+- **alert oracle**: fault epochs declare the burn-rate alert rules
+  they must fire (``expected_alerts`` in the schedule); the checker
+  asserts each one transitioned to ``firing`` during its epoch and
+  that every alert resolved by end of run. The engine runs on
+  compressed clocks (``SOAK_ALERT_SCALE``) with drill-tuned
+  thresholds, exercising the same rule/state-machine code production
+  runs. Fault epochs with no declared alerts are reported in the
+  artifact as coverage gaps (not failures).
 
 Determinism: the fault *schedule* — epoch order, armed rule parameters,
 wave sizes — is a pure function of ``--seed`` (``build_schedule``), and
@@ -84,8 +92,10 @@ from kubernetes_tpu.store.replication import (
     LocalLink,
     ReplicationHub,
 )
+from kubernetes_tpu.utils import alerts as alertmod
 from kubernetes_tpu.utils import capacity as capmod
 from kubernetes_tpu.utils import faults, sli, tracing
+from kubernetes_tpu.utils import timeseries as tsmod
 
 #: Epoch registry order — the full default schedule. build_schedule
 #: derives per-epoch parameters from the seed; the order is fixed so
@@ -103,6 +113,41 @@ EPOCHS = (
     "leader_kill_each_tier",
     "final",
 )
+
+#: Alert-engine clock compression for the run: 1h/5m burn windows
+#: become 6s/0.5s, the 60s hold-down 0.1s, the 120s hysteresis 0.2s —
+#: the soak exercises the production state machine, not production
+#: patience. Short windows must stay a few sampler beats wide
+#: (ALERT_SAMPLE_S below) or windowed rates degrade to no-data.
+SOAK_ALERT_SCALE = 1.0 / 600.0
+
+#: Retention sampler cadence during the soak (seconds).
+ALERT_SAMPLE_S = 0.25
+
+
+def _soak_alert_rules() -> tuple:
+    """DEFAULT_RULES with drill-tuned thresholds: the fault schedule's
+    storms are small by production standards (a dozen watch drops, a
+    few-percent fragmentation score), so the drill lowers the two
+    oracle'd thresholds to levels the armed faults must cross while
+    keeping every rule's kind, windows, and state machine intact."""
+    import dataclasses
+
+    drill = {
+        # ~1 drop per fast-long window trips it (prod: 0.02/s budget).
+        "watch_drop_storm": 0.005,
+        # Between the fleet's ambient windowed p99 (measured ~0.010
+        # clean, ~0.016 right after a defrag consolidates) and the
+        # fragmenting fill's score (~0.037): fires only while the
+        # shard pattern holds, resolves once the descheduler pairs
+        # the fillers up and the windows drain.
+        "fragmentation_burn": 0.02,
+    }
+    return tuple(
+        dataclasses.replace(r, threshold=drill[r.name])
+        if r.name in drill else r
+        for r in alertmod.DEFAULT_RULES
+    )
 
 
 # -- wire helpers (mirror objects arrive typed from LIST, wire dicts
@@ -538,6 +583,7 @@ class InvariantChecker:
         self._sli_prev = dict(self._sli_start)
         self.capacity_timeline: List[dict] = []
         self._cap_prev = self._cap_samples()
+        self._alerts_t0 = time.monotonic()
 
     @staticmethod
     def _sli_counts() -> Dict[str, int]:
@@ -572,7 +618,9 @@ class InvariantChecker:
 
         return _wait_until(settled, timeout, interval=0.2)
 
-    def check(self, epoch: str, client: Client) -> None:
+    def check(
+        self, epoch: str, client: Client, entry: Optional[dict] = None,
+    ) -> None:
         """Run every invariant; append violations (never raises)."""
         self.quiesce(client)
         # Event-stream invariants detected live by the mirror.
@@ -585,6 +633,48 @@ class InvariantChecker:
         self._check_move_journal(epoch, client)
         self._check_slo_epoch(epoch)
         self._check_capacity_epoch(epoch)
+        self._check_alerts_epoch(epoch, entry)
+
+    def _check_alerts_epoch(
+        self, epoch: str, entry: Optional[dict],
+    ) -> None:
+        """The alert oracle: every rule the schedule declared for this
+        epoch must have been FIRING at some point during it — a
+        ``-> firing`` transition since the previous epoch's check, a
+        ``firing -> resolved`` transition since then (it was firing
+        inside the epoch before resolving), or a firing state still
+        held over from a condition that never cleared. The high-water
+        mark advances regardless of outcome so a late firing can't
+        retroactively satisfy the next epoch."""
+        expected = list((entry or {}).get("expected_alerts") or ())
+        engine = alertmod.DEFAULT
+
+        def fired_since(rule: str) -> bool:
+            if any(
+                t["rule"] == rule
+                and (t["to"] == "firing" or t["from"] == "firing")
+                and t["t_mono"] >= self._alerts_t0
+                for t in engine.transitions()
+            ):
+                return True
+            return rule in engine.firing()
+
+        for rule in expected:
+            # The storm ran during the epoch; firing may still be one
+            # hold-down beat away when the churn settles.
+            if not _wait_until(
+                lambda: fired_since(rule), timeout=15.0, interval=0.25
+            ):
+                states = {
+                    r["name"]: r["state"]
+                    for r in engine.snapshot()["rules"]
+                }
+                self._viol(
+                    epoch, "alert_fired",
+                    f"expected alert {rule} never fired during the "
+                    f"epoch (states: {states})",
+                )
+        self._alerts_t0 = time.monotonic()
 
     def _check_slo_epoch(self, epoch: str) -> None:
         """Every SLI milestone series must advance across EVERY epoch
@@ -954,6 +1044,9 @@ def build_schedule(
                 "p": round(rng.uniform(0.02, 0.08), 3),
                 "times": rng.randrange(6, 14),
             }
+            # The alert oracle: this storm MUST trip the drop-rate
+            # burn rule while the epoch runs (and resolve by run end).
+            entry["expected_alerts"] = ["watch_drop_storm"]
         elif name == "wal_fsync":
             entry["rule"] = {
                 "site": faults.WAL_FSYNC.name,
@@ -1002,6 +1095,10 @@ def build_schedule(
             # backlog", not a calibrated absolute level.
             entry["frag_threshold"] = round(rng.uniform(0.01, 0.03), 3)
             entry["move_budget"] = rng.randrange(8, 17)
+            # The fragmenting fill pushes the measured score past the
+            # drill threshold (0.008 < the 0.01 floor above): the
+            # fragmentation burn rule must fire while the shards pend.
+            entry["expected_alerts"] = ["fragmentation_burn"]
             if name == "defrag_daemon_crash":
                 entry["rule"] = {
                     "site": faults.DESCHED_MOVE_CRASH.name,
@@ -1072,6 +1169,17 @@ def run_soak(
         f"data dir {data_dir}")
     cluster.start()
     log("fleet up")
+    # Health plane on compressed clocks: fresh retention, drill-tuned
+    # rules, transition Events posted to the cluster under test. The
+    # engine and sampler are the production singletons — the oracle
+    # exercises the same code local-up/daemons run.
+    tsmod.DEFAULT.reset()
+    alertmod.DEFAULT.configure(
+        rules=_soak_alert_rules(), clock_scale=SOAK_ALERT_SCALE,
+    )
+    alertmod.ensure_started(
+        interval_s=ALERT_SAMPLE_S, client=cluster.client()
+    )
     mirror = WatchMirror(cluster.client()).start()
     checker = InvariantChecker(cluster, mirror)
     driver = ChurnDriver(cluster, mirror, rng=random.Random(f"churn:{seed}"))
@@ -1099,7 +1207,7 @@ def run_soak(
                     name, "backlog_drained",
                     f"{len(unbound)} pods never bound: {unbound[:5]}",
                 )
-            checker.check(name, driver.client)
+            checker.check(name, driver.client, entry)
             cycles = [
                 c for c in driver.rebalance_log if c["epoch"] == name
             ]
@@ -1123,8 +1231,22 @@ def run_soak(
             log(f"epoch {name} done ({epoch_reports[-1]['wall_s']}s, "
                 f"{len(checker.violations)} violation(s) so far)")
         checker.check_slo_advancing("end")
+        # Resolution half of the oracle: with every fault disarmed and
+        # the final clean wave bound, nothing may still be firing —
+        # the short burn windows drain in seconds at SOAK_ALERT_SCALE,
+        # then the scaled hysteresis resolves the rule.
+        if not _wait_until(
+            lambda: not alertmod.DEFAULT.firing(),
+            timeout=60.0, interval=0.5,
+        ):
+            checker._viol(
+                "end", "alerts_resolved",
+                f"still firing after the clean final epoch: "
+                f"{alertmod.DEFAULT.firing()}",
+            )
     finally:
         faults.clear()
+        tsmod.SAMPLER.stop()
         try:
             mirror.stop()
         except Exception:
@@ -1148,6 +1270,7 @@ def run_soak(
         return round(xs[min(len(xs) - 1, int(q * (len(xs) - 1)))], 4) \
             if xs else None
 
+    alert_snap = alertmod.DEFAULT.snapshot()
     artifact = {
         "seed": seed,
         "nodes": n_nodes,
@@ -1164,6 +1287,22 @@ def run_soak(
         "capacity_timeline": checker.capacity_timeline,
         "rebalance_cycles": driver.rebalance_log,
         "failover_to_first_bind_s": driver.failover_bind_s,
+        "alerts": {
+            "clock_scale": SOAK_ALERT_SCALE,
+            "rules_evaluated": len(alertmod.DEFAULT.rules),
+            "evaluations": alert_snap["evaluations"],
+            "firing_at_end": alert_snap["firing"],
+            # The firing timeline: every state transition the run
+            # caused, in order (the oracle's evidence trail).
+            "timeline": alertmod.DEFAULT.transitions(),
+            # Fault epochs that declared no expected alerts — reported
+            # coverage gaps, not failures: each is a storm the alert
+            # plane does not yet oracle.
+            "coverage_gaps": sorted(
+                e["epoch"] for e in schedule
+                if e.get("rule") and not e.get("expected_alerts")
+            ),
+        },
         "invariant_violations": checker.violations,
         "wall_s": round(time.monotonic() - t_start, 1),
     }
@@ -1567,6 +1706,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         for k in ("seed", "nodes", "pods_bound", "bind_p99_s",
                   "post_fault_bind_p99_s", "restarts", "wall_s")
     }, sort_keys=True))
+    al = artifact["alerts"]
+    fired_rules = sorted({
+        t["rule"] for t in al["timeline"] if t["to"] == "firing"
+    })
+    print(
+        f"alerts: {len(al['timeline'])} transition(s), "
+        f"fired={','.join(fired_rules) or 'none'}, "
+        f"firing-at-end={','.join(al['firing_at_end']) or 'none'}"
+        + (
+            f", coverage-gaps={','.join(al['coverage_gaps'])}"
+            if al["coverage_gaps"] else ""
+        )
+    )
     if artifact["invariant_violations"]:
         print(f"soak FAILED: {len(artifact['invariant_violations'])} "
               "invariant violation(s):", file=sys.stderr)
